@@ -1,0 +1,32 @@
+"""mxnet_tpu: a TPU-native deep-learning framework with MXNet 1.x's
+capabilities (reference: thomelane/incubator-mxnet — see SURVEY.md).
+
+Not a port: the compute path is JAX/XLA/Pallas and parallelism is
+`jax.sharding` over device meshes; the *user-facing surface* (NDArray,
+autograd, Gluon, Symbol/Module, KVStore, io, metric, optimizer) mirrors the
+reference so model code carries over.
+
+Conventional entry point::
+
+    import mxnet_tpu as mx
+    x = mx.nd.ones((2, 3), ctx=mx.tpu(0))
+"""
+from __future__ import annotations
+
+__version__ = "0.1.0"
+
+from .base import MXNetError, register_env, get_env, list_env
+from .context import Context, cpu, gpu, tpu, cpu_pinned, num_gpus, num_tpus, \
+    current_context
+from . import context
+from . import engine
+from . import ndarray
+from . import ndarray as nd
+from .ndarray import NDArray
+from . import autograd
+from . import random
+
+
+def waitall() -> None:
+    """Block until all queued computation finishes (reference: mx.nd.waitall)."""
+    engine.wait_all()

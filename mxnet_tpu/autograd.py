@@ -1,0 +1,318 @@
+"""Imperative autograd: ``record()`` scopes, tape, ``backward()``.
+
+Reference role: src/imperative/imperative.cc + python/mxnet/autograd.py —
+when recording is on, every op invoke appends a tape node; ``Backward`` builds
+the gradient graph via the NNVM ``Gradient`` pass and pushes it through the
+engine (SURVEY.md §3.2).
+
+TPU-native design: instead of per-op registered ``FGradient`` symbolic
+rewrites, each dispatched op is recorded as a ``jax.vjp`` closure — JAX's
+tracer derives the backward computation, and the saved residuals live in the
+closure exactly like the reference's saved NDArrays on the tape.  ``backward``
+is then a reverse-topological walk accumulating cotangents.  The dispatch of
+the backward ops is async through XLA just as the reference's was through the
+threaded engine.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Any, List, Optional, Sequence
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["record", "pause", "train_mode", "predict_mode", "is_recording",
+           "is_training", "mark_variables", "backward", "grad", "get_symbol",
+           "Function"]
+
+_state = threading.local()
+
+
+def _st():
+    if not hasattr(_state, "recording"):
+        _state.recording = False
+        _state.training = False
+    return _state
+
+
+def is_recording() -> bool:
+    return _st().recording
+
+
+def is_training() -> bool:
+    return _st().training
+
+
+class _RecordingScope:
+    def __init__(self, recording: Optional[bool], training: Optional[bool]):
+        self._rec = recording
+        self._train = training
+        self._prev = None
+
+    def __enter__(self):
+        st = _st()
+        self._prev = (st.recording, st.training)
+        if self._rec is not None:
+            st.recording = self._rec
+        if self._train is not None:
+            st.training = self._train
+        return self
+
+    def __exit__(self, *a):
+        st = _st()
+        st.recording, st.training = self._prev
+
+
+def record(train_mode: bool = True) -> _RecordingScope:
+    """Record operations for gradient computation; sets train mode."""
+    return _RecordingScope(True, train_mode)
+
+
+def pause(train_mode: bool = False) -> _RecordingScope:
+    """Suspend recording (e.g. for metric updates, running-stat writes)."""
+    return _RecordingScope(False, train_mode)
+
+
+def train_mode() -> _RecordingScope:
+    return _RecordingScope(None, True)
+
+
+def predict_mode() -> _RecordingScope:
+    return _RecordingScope(None, False)
+
+
+# ---------------------------------------------------------------------------
+# Tape
+# ---------------------------------------------------------------------------
+
+class TapeNode:
+    """One recorded op: a vjp closure + links to producer entries of inputs."""
+    __slots__ = ("name", "vjp_fn", "parents", "out_avals", "multi_out")
+
+    def __init__(self, name, vjp_fn, parents, out_avals, multi_out):
+        self.name = name
+        self.vjp_fn = vjp_fn
+        self.parents = parents        # list[Optional[AGInfo]] aligned w/ inputs
+        self.out_avals = out_avals    # [(shape, dtype)] per output
+        self.multi_out = multi_out
+
+
+class AGInfo:
+    """Autograd entry attached to an NDArray (reference: AGInfo on nnvm node)."""
+    __slots__ = ("node", "index", "grad", "grad_req", "fresh")
+
+    def __init__(self, node: Optional[TapeNode] = None, index: int = 0,
+                 grad=None, grad_req: str = "write"):
+        self.node = node
+        self.index = index
+        self.grad = grad              # NDArray gradient buffer (variables only)
+        self.grad_req = grad_req
+        self.fresh = True             # 'write' semantics: first accum overwrites
+
+    @property
+    def is_variable(self) -> bool:
+        return self.grad is not None
+
+
+def mark_variables(variables, gradients, grad_reqs="write") -> None:
+    """Attach gradient buffers to arrays (reference: MXAutogradMarkVariables)."""
+    if isinstance(grad_reqs, str):
+        grad_reqs = [grad_reqs] * len(variables)
+    for v, g, req in zip(variables, gradients, grad_reqs):
+        v._ag = AGInfo(node=None, index=0, grad=g, grad_req=req)
+
+
+def _zeros_ct(aval):
+    import jax.numpy as jnp
+    shape, dtype = aval
+    return jnp.zeros(shape, dtype)
+
+
+def _is_float0(ct) -> bool:
+    from jax.dtypes import float0
+    return getattr(ct, "dtype", None) == float0
+
+
+def backward(heads: Sequence, head_grads=None, retain_graph: bool = False,
+             train_mode: bool = True) -> None:
+    """Run backward from ``heads`` accumulating into variables' ``.grad``.
+
+    Reference: Imperative::Backward (SURVEY.md §3.2) — builds the gradient
+    graph from the tape and executes it through the engine; here each tape
+    node's ``jax.vjp`` closure is invoked in reverse topological order and the
+    resulting ops dispatch asynchronously through XLA.
+    """
+    import jax.numpy as jnp
+
+    heads = list(heads)
+    if head_grads is None:
+        head_grads = [None] * len(heads)
+
+    # ---- collect reachable graph + topo order ----
+    visited = {}
+    order: List[TapeNode] = []
+
+    def visit(node: TapeNode):
+        state = visited.get(id(node))
+        if state == 2:
+            return
+        if state == 1:
+            raise MXNetError("cycle in autograd tape")
+        visited[id(node)] = 1
+        for p in node.parents:
+            if p is not None and p.node is not None:
+                visit(p.node)
+        visited[id(node)] = 2
+        order.append(node)
+
+    pending = {}  # id(node) -> list[Optional[ct]] per output
+
+    def add_ct(node: TapeNode, idx: int, ct):
+        lst = pending.setdefault(id(node), [None] * len(node.out_avals))
+        lst[idx] = ct if lst[idx] is None else lst[idx] + ct
+
+    any_graph = False
+    for h, hg in zip(heads, head_grads):
+        info = getattr(h, "_ag", None)
+        if info is None:
+            continue
+        seed = (jnp.ones(h.shape, h.dtype) if hg is None else hg._read())
+        if info.node is None:
+            # head is itself a variable
+            _accum_var(info, seed)
+            any_graph = True
+            continue
+        visit(info.node)
+        add_ct(info.node, info.index, seed)
+        any_graph = True
+    if not any_graph:
+        raise MXNetError("this array is not connected to the recorded graph; "
+                         "call backward inside/after autograd.record()")
+
+    # ---- reverse walk ----
+    for node in reversed(order):
+        cts = pending.pop(id(node), None)
+        if cts is None:
+            continue
+        full = tuple(ct if ct is not None else _zeros_ct(av)
+                     for ct, av in zip(cts, node.out_avals))
+        out_ct = full if node.multi_out else full[0]
+        in_cts = node.vjp_fn(out_ct)
+        if not retain_graph:
+            node.vjp_fn = None
+        for parent, ct in zip(node.parents, in_cts):
+            if parent is None or _is_float0(ct) or ct is None:
+                continue
+            if parent.is_variable:
+                _accum_var(parent, ct)
+            elif parent.node is not None:
+                add_ct(parent.node, parent.index, ct)
+
+
+def _accum_var(info: AGInfo, ct) -> None:
+    if info.grad_req == "null":
+        return
+    g = info.grad
+    if info.grad_req == "write" and info.fresh:
+        g._set_data(ct.astype(g._read().dtype) if ct.dtype != g.dtype else ct)
+        info.fresh = False
+    else:
+        cur = g._read()
+        g._set_data(cur + ct.astype(cur.dtype))
+
+
+def grad(heads, variables, head_grads=None, retain_graph=None,
+         create_graph=False, train_mode=True):
+    """Functional gradient: returns grads of ``heads`` w.r.t. ``variables``.
+
+    Reference: mx.autograd.grad.  create_graph (higher-order) is supported by
+    re-recording through the vjp closures is NOT yet implemented — raises.
+    """
+    from .ndarray import zeros
+    if create_graph:
+        raise MXNetError("create_graph=True not yet supported")
+    heads = heads if isinstance(heads, (list, tuple)) else [heads]
+    # Tape parents captured the variables' AGInfo objects at record time, so
+    # redirect gradients by swapping buffers on those same infos.
+    infos = []
+    for v in variables:
+        info = getattr(v, "_ag", None)
+        if info is None:
+            raise MXNetError("each variable must have attach_grad() called "
+                             "before the computation was recorded")
+        infos.append((info, info.grad, info.grad_req, info.fresh))
+    gbufs = [zeros(v.shape, ctx=v.context, dtype=v.dtype) for v in variables]
+    for (info, *_), g in zip(infos, gbufs):
+        info.grad, info.grad_req, info.fresh = g, "write", True
+    try:
+        backward(heads, head_grads, retain_graph=bool(retain_graph),
+                 train_mode=train_mode)
+    finally:
+        for info, g0, req0, fresh0 in infos:
+            info.grad, info.grad_req, info.fresh = g0, req0, fresh0
+    return gbufs
+
+
+def get_symbol(x):
+    """Reference parity stub: returns the traced Symbol for an output.
+
+    The symbolic view of recorded computation lives in mxnet_tpu.symbol; the
+    imperative tape here records vjp closures, not nnvm nodes, so this raises
+    with guidance (use HybridBlock/hybridize or the Symbol API directly).
+    """
+    raise MXNetError("get_symbol is not supported on the imperative tape; "
+                     "use HybridBlock.hybridize() or the Symbol API")
+
+
+class Function:
+    """Custom differentiable function (reference: mx.autograd.Function).
+
+    Subclass and implement ``forward(self, *inputs)`` and
+    ``backward(self, *output_grads)`` operating on NDArrays.  Internally the
+    pair is registered on the tape as a single node whose vjp calls the
+    user's ``backward`` under ``pause()``.
+    """
+
+    def __init__(self):
+        self._saved = None
+
+    def save_for_backward(self, *arrays):
+        self._saved = arrays
+
+    @property
+    def saved_tensors(self):
+        return self._saved
+
+    def forward(self, *inputs):
+        raise NotImplementedError
+
+    def backward(self, *output_grads):
+        raise NotImplementedError
+
+    def __call__(self, *inputs):
+        from .ndarray import NDArray, array as _mkarr
+        with pause():
+            outputs = self.forward(*inputs)
+        multi = isinstance(outputs, (list, tuple))
+        outs = list(outputs) if multi else [outputs]
+        if is_recording():
+            parents = [getattr(x, "_ag", None) if isinstance(x, NDArray)
+                       else None for x in inputs]
+            if any(p is not None for p in parents):
+                fn = self
+
+                def vjp_fn(out_ct):
+                    cts = out_ct if isinstance(out_ct, tuple) else (out_ct,)
+                    with pause():
+                        in_grads = fn.backward(*[_mkarr(c) for c in cts])
+                    if not isinstance(in_grads, (list, tuple)):
+                        in_grads = [in_grads]
+                    return tuple(g._read() if isinstance(g, NDArray) else g
+                                 for g in in_grads)
+
+                node = TapeNode(type(self).__name__, vjp_fn, parents,
+                                [(o.shape, o.dtype) for o in outs], multi)
+                for i, o in enumerate(outs):
+                    o._ag = AGInfo(node=node, index=i)
+        return outputs

@@ -1,0 +1,150 @@
+"""Foundations: error model, env-var config registry, dtype maps.
+
+TPU-native rebuild of the roles played in the reference by dmlc-core
+(logging/CHECK macros, `dmlc::GetEnv` env-var config — SURVEY.md §5.6) and
+`python/mxnet/base.py` (error propagation, name managers).  There is no C ABI
+here: the "core" is JAX/XLA, so errors are plain Python exceptions and the
+config registry is a typed view over ``os.environ``.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any, Callable, Dict, Optional
+
+import numpy as _np
+
+__all__ = [
+    "MXNetError",
+    "register_env",
+    "get_env",
+    "list_env",
+    "string_types",
+    "numeric_types",
+    "integer_types",
+    "dtype_np",
+    "dtype_name",
+    "default_dtype",
+]
+
+string_types = (str,)
+numeric_types = (float, int, _np.generic)
+integer_types = (int, _np.integer)
+
+
+class MXNetError(RuntimeError):
+    """Default error type for this framework.
+
+    Mirrors the reference's ``mxnet.base.MXNetError`` which surfaces C-side
+    ``dmlc::Error``; here errors originate in Python/JAX directly.
+    """
+
+
+# ---------------------------------------------------------------------------
+# Environment-variable config registry (reference: ~100 MXNET_* vars read via
+# dmlc::GetEnv, documented in docs/faq/env_var.md — SURVEY.md §5.6).
+# ---------------------------------------------------------------------------
+
+class _EnvEntry:
+    __slots__ = ("name", "default", "typ", "help")
+
+    def __init__(self, name: str, default: Any, typ: Callable, help: str):
+        self.name = name
+        self.default = default
+        self.typ = typ
+        self.help = help
+
+
+_env_registry: Dict[str, _EnvEntry] = {}
+_env_lock = threading.Lock()
+
+
+def _parse_bool(v: Any) -> bool:
+    if isinstance(v, bool):
+        return v
+    return str(v).strip().lower() in ("1", "true", "yes", "on")
+
+
+def register_env(name: str, default: Any, typ: Callable = str, help: str = "") -> None:
+    """Register an ``MXNET_*`` style environment variable with a typed default."""
+    if typ is bool:
+        typ = _parse_bool
+    with _env_lock:
+        _env_registry[name] = _EnvEntry(name, default, typ, help)
+
+
+def get_env(name: str, default: Any = None) -> Any:
+    """Read a registered env var, applying its type; unregistered names fall
+    back to raw ``os.environ`` access with ``default``."""
+    entry = _env_registry.get(name)
+    raw = os.environ.get(name)
+    if entry is None:
+        return raw if raw is not None else default
+    if raw is None:
+        return entry.default
+    try:
+        return entry.typ(raw)
+    except (TypeError, ValueError):
+        return entry.default
+
+
+def list_env() -> Dict[str, Any]:
+    """All registered env vars with their current effective values."""
+    return {k: get_env(k) for k in sorted(_env_registry)}
+
+
+# Core knobs (subset of the reference's env_var.md; registered at import so
+# `list_env()` documents them).
+register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
+             "Engine type: NaiveEngine (sync, debug) or ThreadedEnginePerDevice (async).")
+register_env("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
+             "Fuse op sequences into bulked dispatch segments (maps to jit).")
+register_env("MXNET_ENFORCE_DETERMINISM", False, bool,
+             "Request deterministic kernel selection (XLA default is deterministic).")
+register_env("MXNET_GPU_MEM_POOL_RESERVE", 5, int,
+             "Percent of device memory to keep free (advisory under XLA).")
+register_env("MXNET_TEST_SEED", None, int, "Seed override for the test harness.")
+register_env("MXNET_SAFE_ACCUMULATION", True, bool,
+             "Accumulate fp16/bf16 reductions in fp32.")
+register_env("MXNET_DEFAULT_DTYPE", "float32", str,
+             "Default dtype for new arrays (float32; set bfloat16 for TPU-native).")
+
+
+# ---------------------------------------------------------------------------
+# Dtypes
+# ---------------------------------------------------------------------------
+
+_DTYPE_ALIASES: Dict[str, str] = {
+    "float32": "float32", "float64": "float64", "float16": "float16",
+    "bfloat16": "bfloat16", "uint8": "uint8", "int8": "int8",
+    "int32": "int32", "int64": "int64", "int16": "int16", "uint16": "uint16",
+    "uint32": "uint32", "uint64": "uint64", "bool": "bool",
+}
+
+
+def dtype_np(dtype: Any) -> "_np.dtype":
+    """Canonicalize a dtype spec (str / np.dtype / jnp dtype) to np.dtype.
+
+    bfloat16 round-trips via ml_dtypes (numpy has no native bfloat16).
+    """
+    if dtype is None:
+        return _np.dtype(default_dtype())
+    if isinstance(dtype, str):
+        name = _DTYPE_ALIASES.get(dtype)
+        if name is None:
+            raise MXNetError(f"unknown dtype {dtype!r}")
+        if name == "bfloat16":
+            import ml_dtypes
+            return _np.dtype(ml_dtypes.bfloat16)
+        return _np.dtype(name)
+    return _np.dtype(dtype)
+
+
+def dtype_name(dtype: Any) -> str:
+    """Canonical string name for a dtype."""
+    d = _np.dtype(dtype) if not isinstance(dtype, str) else dtype_np(dtype)
+    return str(d.name) if d.name != "bfloat16" else "bfloat16"
+
+
+def default_dtype() -> str:
+    return os.environ.get("MXNET_DEFAULT_DTYPE", "float32")

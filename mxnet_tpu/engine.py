@@ -1,0 +1,117 @@
+"""Dispatch engine: ordering + synchronization over JAX's async runtime.
+
+Reference role: src/engine/ — the threaded dependency engine that serializes
+conflicting reads/writes of NDArray variables and runs everything async
+(SURVEY.md §2.1, "the heart of MXNet's async-everything model").
+
+TPU-native design: XLA/PJRT *already* provides async dispatch with data-flow
+ordering — every jax op returns immediately with a future-like Array, and
+consumers are ordered by value dependence.  What the reference's engine adds
+beyond that is (a) ordering of *mutations* (NDArray is mutable), and
+(b) explicit sync points.  Mutation ordering here is achieved structurally:
+an in-place op produces a *new* immutable buffer and bumps the NDArray's
+version, so conflicting writes are serialized by the GIL-ordered version
+update rather than by a scheduler (see ndarray.py).  This module therefore
+carries the *interface*: engine-type selection (NaiveEngine = force-sync for
+debugging, exactly the reference's MXNET_ENGINE_TYPE escape hatch), sync
+points (wait_for_var / wait_all), and a bulk/dispatch-statistics hook used by
+the profiler.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Any
+
+from .base import get_env
+
+__all__ = ["Engine", "engine", "is_naive", "wait_all"]
+
+
+class Engine:
+    """Process-wide engine singleton (interface-compatible with the reference's
+    ``Engine::Get()``)."""
+
+    _inst = None
+    _lock = threading.Lock()
+
+    def __init__(self):
+        self._type = os.environ.get("MXNET_ENGINE_TYPE",
+                                    "ThreadedEnginePerDevice")
+        self._num_ops = 0
+        self._listeners = []  # profiler hooks: fn(op_name, metadata)
+
+    @classmethod
+    def get(cls) -> "Engine":
+        with cls._lock:
+            if cls._inst is None:
+                cls._inst = Engine()
+            return cls._inst
+
+    # -- mode --------------------------------------------------------------
+    @property
+    def engine_type(self) -> str:
+        return self._type
+
+    def set_engine_type(self, name: str) -> None:
+        self._type = name
+
+    @property
+    def is_naive(self) -> bool:
+        return self._type == "NaiveEngine"
+
+    # -- dispatch hooks ----------------------------------------------------
+    def on_push(self, op_name: str, outputs: Any) -> None:
+        """Called by the invoke path after dispatching an op.
+
+        In NaiveEngine mode, block until the results are ready — the direct
+        analog of the reference's synchronous debug engine.
+        """
+        self._num_ops += 1
+        for fn in self._listeners:
+            fn(op_name, outputs)
+        if self.is_naive:
+            import jax
+            jax.block_until_ready(outputs)
+
+    def add_listener(self, fn) -> None:
+        self._listeners.append(fn)
+
+    def remove_listener(self, fn) -> None:
+        if fn in self._listeners:
+            self._listeners.remove(fn)
+
+    @property
+    def num_ops_dispatched(self) -> int:
+        return self._num_ops
+
+    # -- sync points -------------------------------------------------------
+    def wait_for_var(self, data) -> None:
+        """Block until a value is computed (reference: Engine::WaitForVar)."""
+        import jax
+        jax.block_until_ready(data)
+
+    def wait_all(self) -> None:
+        """Block until all outstanding computation completes
+        (reference: Engine::WaitForAll / MXNDArrayWaitAll)."""
+        import jax
+        try:
+            for arr in jax.live_arrays():
+                try:
+                    arr.block_until_ready()
+                except Exception:  # deleted/donated buffers
+                    pass
+        except Exception:
+            pass
+
+
+def engine() -> Engine:
+    return Engine.get()
+
+
+def is_naive() -> bool:
+    return Engine.get().is_naive
+
+
+def wait_all() -> None:
+    Engine.get().wait_all()

@@ -1,0 +1,93 @@
+"""``mx.nd`` namespace: NDArray + the generated operator frontends.
+
+Reference role: python/mxnet/ndarray/ — op wrappers generated at import time
+from the C-side registry (SURVEY.md §2.5).  Here the wrappers are generated
+from the in-process registry populated by the ops_* modules; the same
+registry also drives mx.sym, so the namespaces stay in lockstep.
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .ndarray import (NDArray, array, from_jax, zeros, ones, empty, full,
+                      arange, zeros_like as _zeros_like_ctor,
+                      ones_like as _ones_like_ctor)
+from . import register as _register_mod
+from .register import (get_op, list_ops, invoke_by_name, make_frontend,
+                       register_op)
+
+# populate the registry
+from . import ops_elemwise as _ops_elemwise      # noqa: F401
+from . import ops_reduce as _ops_reduce          # noqa: F401
+from . import ops_matrix as _ops_matrix          # noqa: F401
+from . import ops_nn as _ops_nn                  # noqa: F401
+from . import ops_optimizer as _ops_optimizer    # noqa: F401
+from . import random                              # noqa: F401
+
+_mod = _sys.modules[__name__]
+
+for _name in list_ops():
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, make_frontend(get_op(_name)))
+# aliases registered under alternative names
+for _name, _op in list(_register_mod._registry.items()):
+    if not hasattr(_mod, _name):
+        setattr(_mod, _name, make_frontend(_op))
+
+
+# ---------------------------------------------------------------------------
+# frontends that need special handling
+# ---------------------------------------------------------------------------
+
+def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=None, **kwargs):
+    """Dropout; active only under autograd.train_mode (or mode='always'),
+    matching the reference op's behavior."""
+    from .. import autograd as _ag
+    from .. import random as _grandom
+    if mode != "always" and not _ag.is_training():
+        return identity(data)                                 # noqa: F821
+    key = _grandom.next_key()
+    return invoke_by_name("Dropout", [data, from_jax(key, ctx=data.context)],
+                          {"p": p, "axes": tuple(axes)})
+
+
+dropout = Dropout
+
+
+# random_* flat aliases of the random submodule (reference API parity)
+random_uniform = random.uniform
+random_normal = random.normal
+random_randint = random.randint
+random_gamma = random.gamma
+random_exponential = random.exponential
+random_poisson = random.poisson
+random_negative_binomial = random.negative_binomial
+sample_multinomial = random.multinomial
+shuffle = random.shuffle
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None,
+           **kwargs):
+    return random.normal(loc=loc, scale=scale, shape=shape, dtype=dtype,
+                         ctx=ctx, out=out)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None,
+            **kwargs):
+    return random.uniform(low=low, high=high, shape=shape, dtype=dtype,
+                          ctx=ctx, out=out)
+
+
+def waitall():
+    from ..engine import wait_all
+    wait_all()
+
+
+def save(fname, data):
+    from .utils import save as _save
+    _save(fname, data)
+
+
+def load(fname):
+    from .utils import load as _load
+    return _load(fname)

@@ -1,0 +1,197 @@
+"""Elementwise unary/binary/scalar operators.
+
+Reference parity: src/operator/tensor/elemwise_unary_op*.cc,
+elemwise_binary_broadcast_op*.cc, elemwise_binary_scalar_op*.cc (SURVEY.md
+§2.2 — "mostly 1:1 with jax.numpy/lax").  Parity quirks preserved:
+comparison and logical ops return 0/1 in the *input float dtype*, not bool,
+and scalar operands are cast to the array's dtype before the op (both are
+MXNet conventions that differ from numpy).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op, simple_op
+
+
+def _jnp():
+    import jax.numpy as jnp
+    return jnp
+
+
+def _jsp():
+    import jax.scipy.special as jsp
+    return jsp
+
+
+# --------------------------------------------------------------------------
+# unary
+# --------------------------------------------------------------------------
+
+def _register_unary():
+    import jax
+    import jax.numpy as jnp
+    import jax.scipy.special as jsp
+
+    unary = {
+        "relu": lambda x: jnp.maximum(x, 0),
+        "sigmoid": jax.nn.sigmoid,
+        "hard_sigmoid": lambda x: jnp.clip(0.2 * x + 0.5, 0.0, 1.0),
+        "tanh": jnp.tanh,
+        "softsign": lambda x: x / (1 + jnp.abs(x)),
+        "softrelu": jax.nn.softplus,
+        "exp": jnp.exp,
+        "expm1": jnp.expm1,
+        "log": jnp.log,
+        "log10": jnp.log10,
+        "log2": jnp.log2,
+        "log1p": jnp.log1p,
+        "sqrt": jnp.sqrt,
+        "rsqrt": lambda x: 1.0 / jnp.sqrt(x),
+        "cbrt": jnp.cbrt,
+        "rcbrt": lambda x: 1.0 / jnp.cbrt(x),
+        "square": jnp.square,
+        "abs": jnp.abs,
+        "sign": jnp.sign,
+        "round": jnp.round,
+        "rint": jnp.rint,
+        "fix": jnp.trunc,
+        "floor": jnp.floor,
+        "ceil": jnp.ceil,
+        "trunc": jnp.trunc,
+        "negative": jnp.negative,
+        "reciprocal": lambda x: 1.0 / x,
+        "erf": jax.lax.erf,
+        "erfinv": jax.lax.erf_inv,
+        "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
+        "gammaln": jsp.gammaln,
+        "sin": jnp.sin,
+        "cos": jnp.cos,
+        "tan": jnp.tan,
+        "arcsin": jnp.arcsin,
+        "arccos": jnp.arccos,
+        "arctan": jnp.arctan,
+        "sinh": jnp.sinh,
+        "cosh": jnp.cosh,
+        "arcsinh": jnp.arcsinh,
+        "arccosh": jnp.arccosh,
+        "arctanh": jnp.arctanh,
+        "degrees": jnp.degrees,
+        "radians": jnp.radians,
+    }
+    for name, fn in unary.items():
+        simple_op(name, fn)
+
+    simple_op("logical_not",
+              lambda x: (x == 0).astype(x.dtype))
+    register_op("clip", lambda a_min, a_max:
+                (lambda x: jnp.clip(x, a_min, a_max)))
+
+
+# --------------------------------------------------------------------------
+# binary broadcast
+# --------------------------------------------------------------------------
+
+def _cmp(fn):
+    """MXNet comparisons return 0/1 in the lhs dtype (not bool)."""
+    def f(x, y):
+        return fn(x, y).astype(x.dtype)
+    return f
+
+
+def _register_binary():
+    import jax.numpy as jnp
+
+    binary = {
+        "broadcast_add": jnp.add,
+        "broadcast_sub": jnp.subtract,
+        "broadcast_mul": jnp.multiply,
+        "broadcast_div": jnp.divide,
+        "broadcast_mod": jnp.mod,
+        "broadcast_power": jnp.power,
+        "broadcast_maximum": jnp.maximum,
+        "broadcast_minimum": jnp.minimum,
+        "broadcast_hypot": jnp.hypot,
+    }
+    alias = {
+        "broadcast_add": ("elemwise_add", "_plus"),
+        "broadcast_sub": ("elemwise_sub", "_minus"),
+        "broadcast_mul": ("elemwise_mul",),
+        "broadcast_div": ("elemwise_div",),
+        "broadcast_power": ("_power", "pow"),
+        "broadcast_maximum": ("maximum",),
+        "broadcast_minimum": ("minimum",),
+    }
+    for name, fn in binary.items():
+        simple_op(name, fn, aliases=alias.get(name, ()))
+
+    cmps = {
+        "broadcast_equal": jnp.equal,
+        "broadcast_not_equal": jnp.not_equal,
+        "broadcast_greater": jnp.greater,
+        "broadcast_greater_equal": jnp.greater_equal,
+        "broadcast_lesser": jnp.less,
+        "broadcast_lesser_equal": jnp.less_equal,
+        "broadcast_logical_and": lambda x, y: jnp.logical_and(x != 0, y != 0),
+        "broadcast_logical_or": lambda x, y: jnp.logical_or(x != 0, y != 0),
+        "broadcast_logical_xor": lambda x, y: jnp.logical_xor(x != 0, y != 0),
+    }
+    for name, fn in cmps.items():
+        simple_op(name, _cmp(fn), differentiable=False)
+
+
+# --------------------------------------------------------------------------
+# scalar variants — the scalar arrives as a 0-d array input (one compile per
+# shape rather than per constant) and is cast to the array dtype (MXNet rule)
+# --------------------------------------------------------------------------
+
+def _scalar(fn, reverse=False):
+    def f(x, s):
+        s = s.astype(x.dtype)
+        return fn(s, x) if reverse else fn(x, s)
+    return f
+
+
+def _scalar_cmp(fn, reverse=False):
+    def f(x, s):
+        s = s.astype(x.dtype)
+        r = fn(s, x) if reverse else fn(x, s)
+        return r.astype(x.dtype)
+    return f
+
+
+def _register_scalar():
+    import jax.numpy as jnp
+
+    pairs = {
+        "_plus_scalar": (jnp.add, False),
+        "_minus_scalar": (jnp.subtract, False),
+        "_rminus_scalar": (jnp.subtract, True),
+        "_mul_scalar": (jnp.multiply, False),
+        "_div_scalar": (jnp.divide, False),
+        "_rdiv_scalar": (jnp.divide, True),
+        "_mod_scalar": (jnp.mod, False),
+        "_rmod_scalar": (jnp.mod, True),
+        "_power_scalar": (jnp.power, False),
+        "_rpower_scalar": (jnp.power, True),
+        "_maximum_scalar": (jnp.maximum, False),
+        "_minimum_scalar": (jnp.minimum, False),
+    }
+    for name, (fn, rev) in pairs.items():
+        simple_op(name, _scalar(fn, rev))
+
+    cmp_pairs = {
+        "_equal_scalar": (jnp.equal, False),
+        "_not_equal_scalar": (jnp.not_equal, False),
+        "_greater_scalar": (jnp.greater, False),
+        "_greater_equal_scalar": (jnp.greater_equal, False),
+        "_lesser_scalar": (jnp.less, False),
+        "_lesser_equal_scalar": (jnp.less_equal, False),
+    }
+    for name, (fn, rev) in cmp_pairs.items():
+        simple_op(name, _scalar_cmp(fn, rev), differentiable=False)
+
+
+_register_unary()
+_register_binary()
+_register_scalar()

@@ -1,0 +1,384 @@
+"""Shape manipulation, indexing, linear algebra, sequence ops.
+
+Reference parity: src/operator/tensor/{matrix_op.cc, dot.cc, indexing_op.cc,
+init_op.cc, control_flow_op.cc}, src/operator/sequence_*.cc, swapaxis.cc,
+pad.cc (SURVEY.md §2.2).  MXNet conventions preserved: ``dot`` contracts the
+last axis of lhs with the first of rhs (not matmul broadcasting); ``slice``
+accepts None entries for "from the edge"; Embedding/take indices may arrive
+as float arrays and are truncated to int.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op, simple_op
+from .ndarray import _thaw_key
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # ---- contraction -----------------------------------------------------
+    def dot_maker(transpose_a=False, transpose_b=False):
+        def fn(a, b):
+            if transpose_a:
+                a = jnp.transpose(a)
+            if transpose_b:
+                b = jnp.transpose(b)
+            return jnp.tensordot(a, b, axes=1)
+        return fn
+    register_op("dot", dot_maker)
+
+    def batch_dot_maker(transpose_a=False, transpose_b=False):
+        def fn(a, b):
+            if transpose_a:
+                a = jnp.swapaxes(a, -1, -2)
+            if transpose_b:
+                b = jnp.swapaxes(b, -1, -2)
+            return jnp.matmul(a, b)
+        return fn
+    register_op("batch_dot", batch_dot_maker, aliases=("linalg_gemm2_batched",))
+
+    def linalg_gemm2_maker(transpose_a=False, transpose_b=False, alpha=1.0):
+        def fn(a, b):
+            if transpose_a:
+                a = jnp.swapaxes(a, -1, -2)
+            if transpose_b:
+                b = jnp.swapaxes(b, -1, -2)
+            return alpha * jnp.matmul(a, b)
+        return fn
+    register_op("linalg_gemm2", linalg_gemm2_maker)
+
+    # ---- shape ops -------------------------------------------------------
+    def reshape_maker(shape=None, reverse=False):
+        def fn(x):
+            return jnp.reshape(x, shape)
+        return fn
+    register_op("reshape", reshape_maker, aliases=("Reshape",))
+
+    def transpose_maker(axes=None):
+        def fn(x):
+            return jnp.transpose(x, axes if axes else None)
+        return fn
+    register_op("transpose", transpose_maker)
+
+    def expand_dims_maker(axis=0):
+        def fn(x):
+            return jnp.expand_dims(x, axis)
+        return fn
+    register_op("expand_dims", expand_dims_maker)
+
+    def squeeze_maker(axis=None):
+        def fn(x):
+            return jnp.squeeze(x, axis)
+        return fn
+    register_op("squeeze", squeeze_maker)
+
+    def flatten_maker():
+        def fn(x):
+            return jnp.reshape(x, (x.shape[0], -1))
+        return fn
+    register_op("flatten", flatten_maker, aliases=("Flatten",))
+
+    def swapaxes_maker(dim1=0, dim2=0):
+        def fn(x):
+            return jnp.swapaxes(x, dim1, dim2)
+        return fn
+    register_op("swapaxes", swapaxes_maker, aliases=("SwapAxis",))
+
+    def cast_maker(dtype="float32"):
+        from ..base import dtype_np
+
+        def fn(x):
+            return x.astype(dtype_np(dtype))
+        return fn
+    register_op("cast", cast_maker, aliases=("Cast",))
+
+    def amp_cast_maker(dtype="float32"):
+        from ..base import dtype_np
+
+        def fn(x):
+            return x.astype(dtype_np(dtype))
+        return fn
+    register_op("amp_cast", amp_cast_maker)
+
+    simple_op("zeros_like", jnp.zeros_like, differentiable=False)
+    simple_op("ones_like", jnp.ones_like, differentiable=False)
+    simple_op("shape_array",
+              lambda x: jnp.asarray(_np.asarray(x.shape), jnp.int32),
+              differentiable=False, use_jit=False)
+    simple_op("size_array",
+              lambda x: jnp.asarray([x.size], jnp.int32),
+              differentiable=False, use_jit=False)
+
+    # ---- concat / split / stack -----------------------------------------
+    def concat_maker(dim=1, num_args=None):
+        def fn(*xs):
+            return jnp.concatenate(xs, axis=dim)
+        return fn
+    register_op("concat", concat_maker, aliases=("Concat",))
+
+    def stack_maker(axis=0, num_args=None):
+        def fn(*xs):
+            return jnp.stack(xs, axis=axis)
+        return fn
+    register_op("stack", stack_maker)
+
+    def split_maker(num_outputs=1, axis=1, squeeze_axis=False):
+        def fn(x):
+            parts = jnp.split(x, num_outputs, axis=axis)
+            if squeeze_axis:
+                parts = [jnp.squeeze(p, axis=axis) for p in parts]
+            return tuple(parts) if num_outputs > 1 else parts[0]
+        return fn
+    register_op("split", split_maker, aliases=("SliceChannel",))
+
+    # ---- slicing ---------------------------------------------------------
+    def slice_maker(begin=(), end=(), step=None):
+        def fn(x):
+            idx = []
+            stp = step if step is not None else (None,) * len(begin)
+            for b, e, s in zip(begin, end, stp):
+                idx.append(slice(b, e, s))
+            return x[tuple(idx)]
+        return fn
+    register_op("slice", slice_maker)
+
+    def slice_axis_maker(axis=0, begin=0, end=None):
+        def fn(x):
+            idx = [slice(None)] * x.ndim
+            idx[axis % x.ndim] = slice(begin, end)
+            return x[tuple(idx)]
+        return fn
+    register_op("slice_axis", slice_axis_maker)
+
+    def slice_like_maker(axes=()):
+        def fn(x, like):
+            idx = [slice(None)] * x.ndim
+            axes_ = axes if axes else range(x.ndim)
+            for a in axes_:
+                idx[a % x.ndim] = slice(0, like.shape[a % x.ndim])
+            return x[tuple(idx)]
+        return fn
+    register_op("slice_like", slice_like_maker)
+
+    def basic_index_maker(key=None):
+        def fn(x):
+            return x[_thaw_key(key)]
+        return fn
+    register_op("_basic_index", basic_index_maker)
+
+    def adv_index_maker():
+        def fn(x, idx):
+            return x[idx.astype(jnp.int32)] if jnp.issubdtype(
+                idx.dtype, jnp.floating) else x[idx]
+        return fn
+    register_op("_advanced_index", adv_index_maker)
+
+    # ---- indexing --------------------------------------------------------
+    def take_maker(axis=0, mode="clip"):
+        def fn(a, indices):
+            idx = indices.astype(jnp.int32)
+            return jnp.take(a, idx, axis=axis, mode=mode)
+        return fn
+    register_op("take", take_maker)
+
+    def embedding_maker(input_dim=None, output_dim=None, dtype="float32",
+                        sparse_grad=False):
+        def fn(data, weight):
+            return jnp.take(weight, data.astype(jnp.int32), axis=0,
+                            mode="clip")
+        return fn
+    register_op("Embedding", embedding_maker, aliases=("embedding",))
+
+    def gather_nd_maker():
+        def fn(data, indices):
+            idx = indices.astype(jnp.int32)
+            m = idx.shape[0]
+            return data[tuple(idx[i] for i in range(m))]
+        return fn
+    register_op("gather_nd", gather_nd_maker)
+
+    def scatter_nd_maker(shape=None):
+        def fn(data, indices):
+            idx = indices.astype(jnp.int32)
+            m = idx.shape[0]
+            out = jnp.zeros(shape, data.dtype)
+            return out.at[tuple(idx[i] for i in range(m))].set(data)
+        return fn
+    register_op("scatter_nd", scatter_nd_maker)
+
+    def one_hot_maker(depth=1, on_value=1.0, off_value=0.0, dtype="float32"):
+        def fn(indices):
+            oh = jax.nn.one_hot(indices.astype(jnp.int32), depth)
+            return (oh * (on_value - off_value) + off_value).astype(
+                jnp.dtype(dtype))
+        return fn
+    register_op("one_hot", one_hot_maker, differentiable=False)
+
+    simple_op("where", lambda c, x, y: jnp.where(c != 0, x, y))
+
+    def pick_maker(axis=-1, keepdims=False, mode="clip"):
+        def fn(data, index):
+            idx = index.astype(jnp.int32)
+            ax = axis % data.ndim
+            idxe = jnp.expand_dims(idx, ax)
+            r = jnp.take_along_axis(data, idxe, axis=ax)
+            return r if keepdims else jnp.squeeze(r, axis=ax)
+        return fn
+    register_op("pick", pick_maker)
+
+    # ---- tile / repeat / flip / pad -------------------------------------
+    def tile_maker(reps=()):
+        def fn(x):
+            return jnp.tile(x, reps)
+        return fn
+    register_op("tile", tile_maker)
+
+    def repeat_maker(repeats=1, axis=None):
+        def fn(x):
+            return jnp.repeat(x, repeats, axis=axis)
+        return fn
+    register_op("repeat", repeat_maker)
+
+    def reverse_maker(axis=()):
+        def fn(x):
+            return jnp.flip(x, axis)
+        return fn
+    register_op("reverse", reverse_maker, aliases=("flip",))
+
+    def pad_maker(mode="constant", pad_width=(), constant_value=0.0):
+        def fn(x):
+            pw = [(pad_width[2 * i], pad_width[2 * i + 1])
+                  for i in range(len(pad_width) // 2)]
+            if mode == "constant":
+                return jnp.pad(x, pw, constant_values=constant_value)
+            if mode == "edge":
+                return jnp.pad(x, pw, mode="edge")
+            if mode == "reflect":
+                return jnp.pad(x, pw, mode="reflect")
+            raise ValueError(mode)
+        return fn
+    register_op("pad", pad_maker, aliases=("Pad",))
+
+    # ---- broadcasting ----------------------------------------------------
+    def broadcast_to_maker(shape=()):
+        def fn(x):
+            tgt = tuple(s if s != 0 else x.shape[i]
+                        for i, s in enumerate(shape))
+            return jnp.broadcast_to(x, tgt)
+        return fn
+    register_op("broadcast_to", broadcast_to_maker)
+
+    def broadcast_like_maker(lhs_axes=None, rhs_axes=None):
+        def fn(x, like):
+            return jnp.broadcast_to(x, like.shape)
+        return fn
+    register_op("broadcast_like", broadcast_like_maker)
+
+    def broadcast_axis_maker(axis=(), size=()):
+        def fn(x):
+            ax = axis if isinstance(axis, (tuple, list)) else (axis,)
+            sz = size if isinstance(size, (tuple, list)) else (size,)
+            tgt = list(x.shape)
+            for a, s in zip(ax, sz):
+                tgt[a % x.ndim] = s
+            return jnp.broadcast_to(x, tuple(tgt))
+        return fn
+    register_op("broadcast_axis", broadcast_axis_maker,
+                aliases=("broadcast_axes",))
+
+    # ---- sequence ops (axis 0 = time by default, MXNet convention) ------
+    def sequence_mask_maker(use_sequence_length=False, value=0.0, axis=0):
+        def fn(data, *maybe_len):
+            if not use_sequence_length:
+                return data
+            seq_len = maybe_len[0]
+            T = data.shape[axis]
+            pos = jnp.arange(T)
+            # mask shape: broadcast pos along batch
+            if axis == 0:
+                mask = pos[:, None] < seq_len[None, :].astype(pos.dtype)
+                ext = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+            else:  # axis == 1
+                mask = pos[None, :] < seq_len[:, None].astype(pos.dtype)
+                ext = mask.reshape(mask.shape + (1,) * (data.ndim - 2))
+            return jnp.where(ext, data, jnp.asarray(value, data.dtype))
+        return fn
+    register_op("SequenceMask", sequence_mask_maker,
+                aliases=("sequence_mask",))
+
+    def sequence_last_maker(use_sequence_length=False, axis=0):
+        def fn(data, *maybe_len):
+            if not use_sequence_length:
+                return jnp.take(data, -1, axis=axis)
+            seq_len = maybe_len[0].astype(jnp.int32) - 1
+            if axis == 0:
+                return data[seq_len, jnp.arange(data.shape[1])]
+            return data[jnp.arange(data.shape[0]), seq_len]
+        return fn
+    register_op("SequenceLast", sequence_last_maker,
+                aliases=("sequence_last",))
+
+    def sequence_reverse_maker(use_sequence_length=False, axis=0):
+        def fn(data, *maybe_len):
+            if not use_sequence_length:
+                return jnp.flip(data, axis=axis)
+            seq_len = maybe_len[0].astype(jnp.int32)
+            T = data.shape[0]
+            pos = jnp.arange(T)[:, None]
+            rev = seq_len[None, :] - 1 - pos
+            idx = jnp.where(pos < seq_len[None, :], rev, pos)
+            ext = idx.reshape(idx.shape + (1,) * (data.ndim - 2))
+            return jnp.take_along_axis(
+                data, jnp.broadcast_to(ext, data.shape), axis=0)
+        return fn
+    register_op("SequenceReverse", sequence_reverse_maker,
+                aliases=("sequence_reverse",))
+
+    # ---- misc ------------------------------------------------------------
+    def diag_maker(k=0, axis1=0, axis2=1):
+        def fn(x):
+            if x.ndim == 1:
+                return jnp.diag(x, k)
+            return jnp.diagonal(x, offset=k, axis1=axis1, axis2=axis2)
+        return fn
+    register_op("diag", diag_maker)
+
+    def depth_to_space_maker(block_size=1):
+        def fn(x):
+            b, c, h, w = x.shape
+            bs = block_size
+            y = x.reshape(b, bs, bs, c // (bs * bs), h, w)
+            y = y.transpose(0, 3, 4, 1, 5, 2)
+            return y.reshape(b, c // (bs * bs), h * bs, w * bs)
+        return fn
+    register_op("depth_to_space", depth_to_space_maker)
+
+    def space_to_depth_maker(block_size=1):
+        def fn(x):
+            b, c, h, w = x.shape
+            bs = block_size
+            y = x.reshape(b, c, h // bs, bs, w // bs, bs)
+            y = y.transpose(0, 3, 5, 1, 2, 4)
+            return y.reshape(b, c * bs * bs, h // bs, w // bs)
+        return fn
+    register_op("space_to_depth", space_to_depth_maker)
+
+    simple_op("stop_gradient", lax.stop_gradient, aliases=("BlockGrad",))
+    simple_op("make_loss", lambda x: x, aliases=("MakeLoss",))
+    simple_op("identity", lambda x: x, aliases=("_copy",))
+
+    def smooth_l1_maker(scalar=1.0):
+        def fn(x):
+            s2 = scalar * scalar
+            return jnp.where(jnp.abs(x) < 1.0 / s2,
+                             0.5 * s2 * jnp.square(x),
+                             jnp.abs(x) - 0.5 / s2)
+        return fn
+    register_op("smooth_l1", smooth_l1_maker)
+
+
+_register()

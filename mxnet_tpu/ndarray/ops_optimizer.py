@@ -1,0 +1,147 @@
+"""Fused optimizer update ops.
+
+Reference parity: src/operator/optimizer_op.cc — sgd_update, sgd_mom_update,
+multi-precision variants (fp32 master weights for fp16/bf16 params),
+adam_update, ftrl_update, signum/signsgd (SURVEY.md §2.2).  TPU-native
+design: each update is one jitted XLA computation; the learning rate arrives
+as a 0-d array *input* (not a baked constant) so LR schedules do not trigger
+recompilation.  The frontends in mxnet_tpu.optimizer call these with
+``out=weight`` so the update is in-place in the NDArray sense (new donated
+buffer, version bump).
+"""
+from __future__ import annotations
+
+from .register import register_op
+
+
+def _register():
+    import jax.numpy as jnp
+
+    def _prep_grad(grad, wd, weight, rescale_grad, clip_gradient):
+        g = grad * rescale_grad
+        if clip_gradient is not None and clip_gradient > 0:
+            g = jnp.clip(g, -clip_gradient, clip_gradient)
+        return g + wd * weight
+
+    def sgd_update_maker(wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                         lazy_update=True):
+        def fn(weight, grad, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            return weight - lr * g
+        return fn
+    register_op("sgd_update", sgd_update_maker, differentiable=False)
+
+    def sgd_mom_update_maker(momentum=0.0, wd=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0, lazy_update=True):
+        def fn(weight, grad, mom, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            mom_new = momentum * mom - lr * g
+            return (weight + mom_new, mom_new)
+        return fn
+    register_op("sgd_mom_update", sgd_mom_update_maker, differentiable=False)
+
+    def mp_sgd_mom_update_maker(momentum=0.0, wd=0.0, rescale_grad=1.0,
+                                clip_gradient=-1.0, lazy_update=True):
+        def fn(weight, grad, mom, weight32, lr):
+            # master weights in fp32 (reference multi-precision SGD)
+            lr = lr.astype(jnp.float32)
+            g32 = grad.astype(jnp.float32)
+            g = _prep_grad(g32, wd, weight32, rescale_grad, clip_gradient)
+            mom_new = momentum * mom - lr * g
+            w32 = weight32 + mom_new
+            return (w32.astype(weight.dtype), mom_new, w32)
+        return fn
+    register_op("mp_sgd_mom_update", mp_sgd_mom_update_maker,
+                differentiable=False)
+
+    def nag_mom_update_maker(momentum=0.0, wd=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0):
+        def fn(weight, grad, mom, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            mom_new = momentum * mom + g
+            return (weight - lr * (g + momentum * mom_new), mom_new)
+        return fn
+    register_op("nag_mom_update", nag_mom_update_maker, differentiable=False)
+
+    def adam_update_maker(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                          rescale_grad=1.0, clip_gradient=-1.0,
+                          lazy_update=True):
+        def fn(weight, grad, mean, var, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            m = beta1 * mean + (1 - beta1) * g
+            v = beta2 * var + (1 - beta2) * jnp.square(g)
+            w = weight - lr * m / (jnp.sqrt(v) + epsilon)
+            return (w, m, v)
+        return fn
+    register_op("adam_update", adam_update_maker, differentiable=False)
+
+    def ftrl_update_maker(lamda1=0.01, beta=1.0, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+        def fn(weight, grad, z, n, lr):
+            lr = lr.astype(weight.dtype)
+            g = grad * rescale_grad
+            if clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            n_new = n + jnp.square(g)
+            sigma = (jnp.sqrt(n_new) - jnp.sqrt(n)) / lr
+            z_new = z + g - sigma * weight
+            w = jnp.where(
+                jnp.abs(z_new) <= lamda1,
+                jnp.zeros_like(weight),
+                -(z_new - jnp.sign(z_new) * lamda1) /
+                ((beta + jnp.sqrt(n_new)) / lr + wd))
+            return (w, z_new, n_new)
+        return fn
+    register_op("ftrl_update", ftrl_update_maker, differentiable=False)
+
+    def signsgd_update_maker(wd=0.0, rescale_grad=1.0, clip_gradient=-1.0):
+        def fn(weight, grad, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            return weight - lr * jnp.sign(g)
+        return fn
+    register_op("signsgd_update", signsgd_update_maker, differentiable=False)
+
+    def signum_update_maker(momentum=0.0, wd=0.0, rescale_grad=1.0,
+                            clip_gradient=-1.0, wd_lh=0.0):
+        def fn(weight, grad, mom, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            mom_new = momentum * mom - (1 - momentum) * g
+            return (weight + lr * jnp.sign(mom_new), mom_new)
+        return fn
+    register_op("signum_update", signum_update_maker, differentiable=False)
+
+    def rmsprop_update_maker(gamma1=0.95, epsilon=1e-8, wd=0.0,
+                             rescale_grad=1.0, clip_gradient=-1.0,
+                             clip_weights=-1.0):
+        def fn(weight, grad, n, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+            w = weight - lr * g / jnp.sqrt(n_new + epsilon)
+            if clip_weights > 0:
+                w = jnp.clip(w, -clip_weights, clip_weights)
+            return (w, n_new)
+        return fn
+    register_op("rmsprop_update", rmsprop_update_maker, differentiable=False)
+
+    def adagrad_update_maker(epsilon=1e-7, wd=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0):
+        def fn(weight, grad, history, lr):
+            lr = lr.astype(weight.dtype)
+            g = grad * rescale_grad
+            if clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            h_new = history + jnp.square(g)
+            w = weight - lr * (g / jnp.sqrt(h_new + epsilon) + wd * weight)
+            return (w, h_new)
+        return fn
+    register_op("adagrad_update", adagrad_update_maker, differentiable=False)
+
+
+_register()

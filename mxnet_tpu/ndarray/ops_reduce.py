@@ -1,0 +1,139 @@
+"""Reductions and ordering ops.
+
+Reference parity: src/operator/tensor/broadcast_reduce_op*.cc (sum/mean/prod/
+max/min/norm with axis/keepdims/exclude) and ordering_op.cc (topk/sort/
+argsort) — SURVEY.md §2.2.  MXNet conventions preserved: ``exclude=True``
+reduces over every axis *except* those given; argmax/argmin return float
+arrays (index values in the input's float dtype); topk defaults to returning
+indices along the last axis in descending order.
+"""
+from __future__ import annotations
+
+from .register import register_op
+
+
+def _norm_axis(axis, exclude=False):
+    """Canonicalize the axis spec; resolution against ndim happens in-fn."""
+    if axis is None:
+        return None
+    if isinstance(axis, int):
+        axis = (axis,)
+    return tuple(axis)
+
+
+def _axes_for(x, axis, exclude):
+    if axis is None:
+        return None
+    axes = tuple(a % x.ndim for a in axis)
+    if exclude:
+        axes = tuple(a for a in range(x.ndim) if a not in axes)
+    return axes
+
+
+def _make_reduce(jfn, acc32=False):
+    def maker(axis=None, keepdims=False, exclude=False):
+        axis = _norm_axis(axis)
+
+        def fn(x):
+            import jax.numpy as jnp
+            axes = _axes_for(x, axis, exclude)
+            if acc32 and x.dtype in (jnp.float16, jnp.bfloat16):
+                # MXNET_SAFE_ACCUMULATION: low-precision sums accumulate fp32
+                return jfn(x.astype(jnp.float32), axis=axes,
+                           keepdims=keepdims).astype(x.dtype)
+            return jfn(x, axis=axes, keepdims=keepdims)
+        return fn
+    return maker
+
+
+def _register():
+    import jax.numpy as jnp
+
+    register_op("sum", _make_reduce(jnp.sum, acc32=True),
+                aliases=("sum_axis",))
+    register_op("mean", _make_reduce(jnp.mean, acc32=True))
+    register_op("prod", _make_reduce(jnp.prod))
+    register_op("nansum", _make_reduce(jnp.nansum, acc32=True))
+    register_op("nanprod", _make_reduce(jnp.nanprod))
+    register_op("max", _make_reduce(jnp.max), aliases=("max_axis",))
+    register_op("min", _make_reduce(jnp.min), aliases=("min_axis",))
+
+    def norm_maker(ord=2, axis=None, keepdims=False, out_dtype=None):
+        axis_t = _norm_axis(axis)
+
+        def fn(x):
+            axes = _axes_for(x, axis_t, False)
+            acc = x.astype(jnp.float32) if x.dtype in (jnp.float16, jnp.bfloat16) else x
+            if ord == 1:
+                r = jnp.sum(jnp.abs(acc), axis=axes, keepdims=keepdims)
+            else:
+                r = jnp.sqrt(jnp.sum(jnp.square(acc), axis=axes,
+                                     keepdims=keepdims))
+            return r.astype(out_dtype or x.dtype)
+        return fn
+    register_op("norm", norm_maker)
+
+    def argmax_maker(axis=None, keepdims=False):
+        def fn(x):
+            r = jnp.argmax(x, axis=axis, keepdims=keepdims)
+            # MXNet returns indices in float32
+            return r.astype(jnp.float32)
+        return fn
+    register_op("argmax", argmax_maker, differentiable=False)
+
+    def argmin_maker(axis=None, keepdims=False):
+        def fn(x):
+            return jnp.argmin(x, axis=axis, keepdims=keepdims).astype(jnp.float32)
+        return fn
+    register_op("argmin", argmin_maker, differentiable=False)
+
+    def argmax_channel_maker():
+        def fn(x):
+            return jnp.argmax(x, axis=1).astype(jnp.float32)
+        return fn
+    register_op("argmax_channel", argmax_channel_maker, differentiable=False)
+
+    # ---- ordering --------------------------------------------------------
+    def topk_maker(axis=-1, k=1, ret_typ="indices", is_ascend=False,
+                   dtype="float32"):
+        def fn(x):
+            ax = axis % x.ndim
+            xs = jnp.moveaxis(x, ax, -1)
+            key = xs if is_ascend else -xs
+            idx = jnp.argsort(key, axis=-1)[..., :k]
+            vals = jnp.take_along_axis(xs, idx, axis=-1)
+            idx_f = jnp.moveaxis(idx, -1, ax).astype(jnp.dtype(dtype))
+            vals_m = jnp.moveaxis(vals, -1, ax)
+            if ret_typ == "indices":
+                return idx_f
+            if ret_typ == "value":
+                return vals_m
+            if ret_typ == "both":
+                return (vals_m, idx_f)
+            if ret_typ == "mask":
+                m = jnp.zeros(xs.shape, x.dtype)
+                m = jnp.put_along_axis(m, idx, jnp.ones((), x.dtype),
+                                       axis=-1, inplace=False)
+                return jnp.moveaxis(m, -1, ax)
+            raise ValueError(ret_typ)
+        return fn
+    register_op("topk", topk_maker, differentiable=False)
+
+    def sort_maker(axis=-1, is_ascend=True):
+        def fn(x):
+            r = jnp.sort(x, axis=axis)
+            return r if is_ascend else jnp.flip(r, axis=axis)
+        return fn
+    register_op("sort", sort_maker)
+
+    def argsort_maker(axis=-1, is_ascend=True, dtype="float32"):
+        def fn(x):
+            r = jnp.argsort(x, axis=axis)
+            if not is_ascend:
+                r = jnp.flip(r, axis=axis)
+            return r.astype(jnp.dtype(dtype))
+        return fn
+    register_op("argsort", argsort_maker, differentiable=False)
+
+
+_register()

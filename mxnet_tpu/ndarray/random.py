@@ -1,0 +1,182 @@
+"""Sampling frontends: ``mx.nd.random.*`` / ``mx.nd.random_*``.
+
+Reference parity: src/operator/random/sample_op.cc (SURVEY.md §2.2) — the
+same distributions (uniform/normal/gamma/exponential/poisson/negative
+binomial/randint/multinomial), with shapes/dtypes/ctx semantics of the
+reference frontends.  Keys come from the process-global stream in
+mxnet_tpu.random; draws are not differentiable (as in the reference).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import dtype_np
+from ..context import current_context
+from .. import random as _grandom
+from .ndarray import NDArray
+
+__all__ = ["uniform", "normal", "randn", "randint", "exponential", "gamma",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle", "bernoulli"]
+
+
+def _prep(shape, ctx, dtype):
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    if shape is None:
+        shape = (1,)
+    if isinstance(shape, int):
+        shape = (shape,)
+    return tuple(shape), ctx, dtype_np(dtype)
+
+
+def _wrap(val, ctx):
+    import jax
+    return NDArray(jax.device_put(val, ctx.device), ctx=ctx)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None,
+            **kwargs):
+    import jax.random as jr
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    val = jr.uniform(_grandom.next_key(), shape, dt, low, high)
+    r = _wrap(val, ctx)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None,
+           **kwargs):
+    import jax.random as jr
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    val = jr.normal(_grandom.next_key(), shape, dt) * scale + loc
+    r = _wrap(val, ctx)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kwargs):
+    return normal(loc=loc, scale=scale, shape=shape or (1,), dtype=dtype,
+                  ctx=ctx)
+
+
+def randint(low, high, shape=None, dtype="int32", ctx=None, out=None,
+            **kwargs):
+    import jax.random as jr
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    val = jr.randint(_grandom.next_key(), shape, int(low), int(high), dt)
+    r = _wrap(val, ctx)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None,
+                **kwargs):
+    import jax.random as jr
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    val = jr.exponential(_grandom.next_key(), shape, dt) * scale
+    r = _wrap(val, ctx)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None,
+          **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    a = jnp.asarray(alpha, dt)
+    val = jr.gamma(_grandom.next_key(), a, shape, dt) * beta
+    r = _wrap(val, ctx)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
+    import jax.random as jr
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    val = jr.poisson(_grandom.next_key(), lam, shape).astype(dt)
+    r = _wrap(val, ctx)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None,
+                      out=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+    g = jr.gamma(_grandom.next_key(), jnp.asarray(float(k), jnp.float32),
+                 shape) * ((1.0 - p) / p)
+    val = jr.poisson(_grandom.next_key(), g, shape).astype(dt)
+    return _wrap(val, ctx)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  ctx=None, out=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    k = 1.0 / alpha
+    p = k / (k + mu)
+    g = jr.gamma(_grandom.next_key(), jnp.asarray(k, jnp.float32),
+                 shape) * ((1.0 - p) / p)
+    val = jr.poisson(_grandom.next_key(), g, shape).astype(dt)
+    return _wrap(val, ctx)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", **kwargs):
+    """Sample category indices from (batched) probability rows."""
+    import jax.random as jr
+    import jax.numpy as jnp
+    n = 1 if shape is None else (shape if isinstance(shape, int)
+                                 else int(_np.prod(shape)))
+    p = data._read()
+    logits = jnp.log(jnp.maximum(p, 1e-30))
+    if p.ndim == 1:
+        out_shape = (n,)
+        samples = jr.categorical(_grandom.next_key(), logits, shape=(n,))
+    else:
+        samples = jr.categorical(_grandom.next_key(), logits[:, None, :],
+                                 axis=-1, shape=(p.shape[0], n))
+        out_shape = (p.shape[0], n)
+    val = samples.reshape(out_shape).astype(dtype_np(dtype))
+    if shape is None:
+        val = val.reshape(val.shape[:-1] + ()) if p.ndim == 1 else \
+            val.reshape((p.shape[0],))
+        if p.ndim == 1:
+            val = val.reshape(())
+    r = _wrap(val, data.context)
+    if get_prob:
+        lp = jnp.take_along_axis(
+            jnp.log(jnp.maximum(p, 1e-30)).reshape(-1, p.shape[-1]),
+            val.reshape(-1, 1).astype(jnp.int32), axis=-1)
+        return r, _wrap(lp.reshape(val.shape), data.context)
+    return r
+
+
+def shuffle(data, **kwargs):
+    import jax.random as jr
+    val = data._read()
+    perm = jr.permutation(_grandom.next_key(), val.shape[0])
+    return _wrap(val[perm], data.context)
+
+
+def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, **kwargs):
+    import jax.random as jr
+    shape, ctx, dt = _prep(shape, ctx, dtype)
+    val = jr.bernoulli(_grandom.next_key(), prob, shape).astype(dt)
+    return _wrap(val, ctx)

@@ -1,0 +1,225 @@
+"""Op registry + imperative dispatch.
+
+Reference role: the NNVM op registry (`NNVM_REGISTER_OP` with FCompute/
+FGradient/FInferShape attrs — SURVEY.md §2.1) plus the generated Python
+wrappers (`python/mxnet/ndarray/register.py`) and the imperative invoke path
+(`MXImperativeInvokeEx → Imperative::Invoke → Engine::PushAsync`, §3.1).
+
+TPU-native design: one declarative registry drives everything.  Each op is a
+*maker*: ``maker(**params) -> fn(*jax_arrays) -> jax_array(s)``.  Dispatch
+jit-compiles the maker result per parameter signature (XLA compile cache keyed
+by shape/dtype replaces FInferShape/FInferType), executes asynchronously
+(PJRT replaces the threaded engine), and — when autograd is recording —
+captures ``jax.vjp`` residuals on the tape (replaces FGradient).  The same
+registry backs the Symbol graph composition (mxnet_tpu/symbol) so `mx.nd.*`
+and `mx.sym.*` stay in lockstep, mirroring how both reference frontends were
+generated from the single C-side registry.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from ..engine import engine
+from .. import autograd as _autograd
+
+__all__ = ["Operator", "register_op", "get_op", "list_ops", "invoke",
+           "invoke_by_name", "invoke_binary", "make_frontend"]
+
+_registry: Dict[str, "Operator"] = {}
+
+
+def _canon(v: Any) -> Any:
+    """Make a param value hashable/canonical for the compile cache."""
+    if isinstance(v, (list, tuple)):
+        return tuple(_canon(x) for x in v)
+    if isinstance(v, _np.dtype):
+        return str(v)
+    if isinstance(v, _np.generic):
+        return v.item()
+    return v
+
+
+class Operator:
+    """A registered operator (analog of ``nnvm::Op``)."""
+
+    __slots__ = ("name", "maker", "aliases", "differentiable", "use_jit",
+                 "doc", "ref")
+
+    def __init__(self, name: str, maker: Callable, aliases: Sequence[str] = (),
+                 differentiable: bool = True, use_jit: bool = True,
+                 doc: str = "", ref: str = ""):
+        self.name = name
+        self.maker = maker
+        self.aliases = tuple(aliases)
+        self.differentiable = differentiable
+        self.use_jit = use_jit
+        self.doc = doc
+        self.ref = ref              # reference file pointer for parity audits
+
+    @functools.lru_cache(maxsize=None)
+    def _fn_cached(self, kwkey: Tuple) -> Callable:
+        import jax
+        fn = self.maker(**dict(kwkey))
+        return jax.jit(fn) if self.use_jit else fn
+
+    def get_fn(self, kwargs: Dict[str, Any]) -> Callable:
+        kwkey = tuple(sorted((k, _canon(v)) for k, v in kwargs.items()))
+        try:
+            return self._fn_cached(kwkey)
+        except TypeError:
+            # unhashable param slipped through; build uncached
+            fn = self.maker(**kwargs)
+            import jax
+            return jax.jit(fn) if self.use_jit else fn
+
+
+def register_op(name: str, maker: Optional[Callable] = None, *,
+                aliases: Sequence[str] = (), differentiable: bool = True,
+                use_jit: bool = True, doc: str = "", ref: str = ""):
+    """Register an operator.  Usable directly or as a decorator on the maker."""
+    def do(mk):
+        op = Operator(name, mk, aliases=aliases, differentiable=differentiable,
+                      use_jit=use_jit, doc=doc or (mk.__doc__ or ""), ref=ref)
+        _registry[name] = op
+        for a in aliases:
+            _registry[a] = op
+        return mk
+    if maker is not None:
+        do(maker)
+        return maker
+    return do
+
+
+def simple_op(name: str, fn: Callable, **kw):
+    """Register an op whose fn has no parameters (pure elementwise etc.)."""
+    register_op(name, lambda: fn, **kw)
+
+
+def get_op(name: str) -> Operator:
+    op = _registry.get(name)
+    if op is None:
+        raise MXNetError(f"operator {name!r} is not registered")
+    return op
+
+
+def list_ops() -> List[str]:
+    return sorted(set(op.name for op in _registry.values()))
+
+
+# ---------------------------------------------------------------------------
+# dispatch
+# ---------------------------------------------------------------------------
+
+def _as_nd(x, ctx):
+    from .ndarray import NDArray, array
+    if isinstance(x, NDArray):
+        return x
+    return array(x, ctx=ctx)
+
+
+def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
+           out=None):
+    """Dispatch an op imperatively (reference stack §3.1).
+
+    Returns one NDArray, or a list for multi-output ops.  ``out=`` writes the
+    (first) result into an existing NDArray in place.
+    """
+    import jax
+    from .ndarray import NDArray
+
+    ctx = None
+    for x in inputs:
+        if isinstance(x, NDArray):
+            ctx = x.context
+            break
+    if ctx is None:
+        ctx = current_context()
+    nd_inputs = [_as_nd(x, ctx) for x in inputs]
+    in_vals = [x._read() for x in nd_inputs]
+
+    fn = op.get_fn(kwargs)
+
+    recording = (_autograd.is_recording() and op.differentiable
+                 and any(getattr(x, "_ag", None) is not None
+                         for x in nd_inputs))
+    if recording:
+        out_vals, vjp_fn = jax.vjp(fn, *in_vals)
+    else:
+        out_vals = fn(*in_vals)
+
+    multi = isinstance(out_vals, (tuple, list))
+    raw_outs = list(out_vals) if multi else [out_vals]
+    outs = [NDArray(v, ctx=ctx) for v in raw_outs]
+
+    if recording:
+        parents = [getattr(x, "_ag", None) for x in nd_inputs]
+        node = _autograd.TapeNode(op.name, vjp_fn, parents,
+                                  [(o.shape, o.dtype) for o in outs], multi)
+        for i, o in enumerate(outs):
+            o._ag = _autograd.AGInfo(node=node, index=i)
+
+    engine().on_push(op.name, raw_outs)
+
+    if out is not None:
+        outs_for_write = outs if multi else [outs[0]]
+        targets = out if isinstance(out, (list, tuple)) else [out]
+        for tgt, src in zip(targets, outs_for_write):
+            tgt._set_data(src._read())
+        return out
+    return outs if multi else outs[0]
+
+
+def invoke_by_name(name: str, inputs: Sequence, kwargs: Dict[str, Any],
+                   out=None):
+    return invoke(get_op(name), inputs, kwargs, out=out)
+
+
+# scalar fallbacks for the arithmetic dunders: (forward op, reflected op)
+_SCALAR_MAP = {
+    "broadcast_add": ("_plus_scalar", "_plus_scalar"),
+    "broadcast_sub": ("_minus_scalar", "_rminus_scalar"),
+    "broadcast_mul": ("_mul_scalar", "_mul_scalar"),
+    "broadcast_div": ("_div_scalar", "_rdiv_scalar"),
+    "broadcast_mod": ("_mod_scalar", "_rmod_scalar"),
+    "broadcast_power": ("_power_scalar", "_rpower_scalar"),
+    "broadcast_equal": ("_equal_scalar", "_equal_scalar"),
+    "broadcast_not_equal": ("_not_equal_scalar", "_not_equal_scalar"),
+    "broadcast_greater": ("_greater_scalar", "_lesser_scalar"),
+    "broadcast_greater_equal": ("_greater_equal_scalar", "_lesser_equal_scalar"),
+    "broadcast_lesser": ("_lesser_scalar", "_greater_scalar"),
+    "broadcast_lesser_equal": ("_lesser_equal_scalar", "_greater_equal_scalar"),
+}
+
+
+def invoke_binary(name: str, lhs, rhs, reverse: bool = False):
+    """Binary dunder dispatch: NDArray⊕NDArray uses the broadcast op;
+    NDArray⊕scalar uses the ``_*_scalar`` variant with the scalar passed as a
+    0-d array input (keeps one XLA compilation per shape, not per constant)."""
+    from .ndarray import NDArray
+    if isinstance(rhs, NDArray):
+        args = [rhs, lhs] if reverse else [lhs, rhs]
+        return invoke_by_name(name, args, {})
+    if isinstance(rhs, (_np.ndarray, list)):
+        args = [rhs, lhs] if reverse else [lhs, rhs]
+        return invoke_by_name(name, args, {})
+    fwd, rev = _SCALAR_MAP[name]
+    sop = rev if reverse else fwd
+    scal = _np.asarray(rhs)
+    return invoke_by_name(sop, [lhs, scal], {})
+
+
+def make_frontend(op: Operator) -> Callable:
+    """Build the user-facing ``mx.nd.<op>`` function."""
+    def frontend(*args, **kwargs):
+        out = kwargs.pop("out", None)
+        kwargs.pop("name", None)        # accepted for symbol-API symmetry
+        return invoke(op, list(args), kwargs, out=out)
+    frontend.__name__ = op.name
+    frontend.__qualname__ = op.name
+    frontend.__doc__ = op.doc
+    return frontend

@@ -1,0 +1,58 @@
+"""NDArray serialization: ``mx.nd.save`` / ``mx.nd.load``.
+
+Reference parity: NDArray::Save/Load over dmlc::Stream with a magic header
+(src/ndarray/ndarray.cc, SURVEY.md §5.4) — the `.params` dict-of-arrays
+format that checkpoints, Gluon save_parameters, and Module checkpoints all
+share.  TPU-native container: same magic-plus-payload idea, with the payload
+as an npz archive (portable, no C++ stream dependency); the *semantics*
+(name→array dict or positional list) match the reference exactly.
+"""
+from __future__ import annotations
+
+import io
+import os
+import struct
+from typing import Dict, List, Union
+
+import numpy as _np
+
+from ..base import MXNetError
+from .ndarray import NDArray, array
+
+_MAGIC = b"MXTPU001"
+_LIST_PREFIX = "__arr_"
+
+
+def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]):
+    """Save arrays to file (list or name→array dict, like mx.nd.save)."""
+    if isinstance(data, NDArray):
+        data = [data]
+    payload = {}
+    if isinstance(data, dict):
+        for k, v in data.items():
+            payload[k] = v.asnumpy()
+        is_dict = True
+    else:
+        for i, v in enumerate(data):
+            payload[f"{_LIST_PREFIX}{i}"] = v.asnumpy()
+        is_dict = False
+    buf = io.BytesIO()
+    _np.savez(buf, **payload)
+    with open(fname, "wb") as f:
+        f.write(_MAGIC)
+        f.write(struct.pack("<B", 1 if is_dict else 0))
+        f.write(buf.getvalue())
+
+
+def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
+    """Load arrays saved by :func:`save`."""
+    with open(fname, "rb") as f:
+        magic = f.read(len(_MAGIC))
+        if magic != _MAGIC:
+            raise MXNetError(f"{fname}: not an NDArray file (bad magic)")
+        is_dict = struct.unpack("<B", f.read(1))[0] == 1
+        npz = _np.load(io.BytesIO(f.read()))
+    if is_dict:
+        return {k: array(npz[k]) for k in npz.files}
+    items = sorted(npz.files, key=lambda k: int(k[len(_LIST_PREFIX):]))
+    return [array(npz[k]) for k in items]

@@ -1,0 +1,49 @@
+"""Global RNG state: ``mx.random.seed()`` and the key stream.
+
+Reference role: src/operator/random/ + src/resource.cc parallel RNG states —
+per-device counter-based generators seeded from a global seed (SURVEY.md
+§2.2).  TPU-native design: a process-global threefry key, split per draw
+(the jax.random discipline).  As SURVEY.md §7 notes, bit-exact streams vs the
+reference are explicitly out of scope — the *API* and distributional behavior
+are what's preserved.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Optional
+
+__all__ = ["seed", "next_key", "get_state"]
+
+_lock = threading.Lock()
+_key = None
+
+
+def _jrandom():
+    import jax.random as jr
+    return jr
+
+
+def seed(seed_state: Optional[int] = None, ctx="all") -> None:
+    """Seed the global generator (reference: mx.random.seed; the ctx argument
+    is accepted for API parity — with a functional key stream every device
+    draws from the same root key)."""
+    global _key
+    if seed_state is None:
+        seed_state = int(time.time() * 1e6) & 0x7FFFFFFF
+    with _lock:
+        _key = _jrandom().PRNGKey(int(seed_state))
+
+
+def next_key():
+    """Split a fresh subkey off the global stream."""
+    global _key
+    with _lock:
+        if _key is None:
+            _key = _jrandom().PRNGKey(0)
+        _key, sub = _jrandom().split(_key)
+        return sub
+
+
+def get_state():
+    return _key

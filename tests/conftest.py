@@ -1,0 +1,41 @@
+"""Test harness configuration.
+
+Tests run on a virtual 8-device CPU mesh (the TPU analog of the reference's
+CPU test suite; real-TPU runs use the same tests via the import-and-rerun
+trick — SURVEY.md §4.3).
+
+Gotcha: this image's sitecustomize registers the axon TPU backend at
+interpreter boot and forces the platform, so plain JAX_PLATFORMS=cpu in the
+environment is NOT enough — we must counter-override via jax.config before
+the first backend query.  XLA_FLAGS must also be set before backend init.
+"""
+import os
+import sys
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (
+        prev + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
+assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _seed_everything(request):
+    """Reference parity: tests/python/unittest/common.py @with_seed —
+    seed numpy + framework RNG per test; honor MXNET_TEST_SEED for replay."""
+    seed = os.environ.get("MXNET_TEST_SEED")
+    seed = int(seed) if seed else abs(hash(request.node.nodeid)) % (2 ** 31)
+    np.random.seed(seed)
+    import mxnet_tpu as mx
+    mx.random.seed(seed)
+    yield

@@ -1,0 +1,175 @@
+"""Autograd: record/backward semantics, grad_req, chained graphs.
+
+Reference analog: tests/python/unittest/test_autograd.py (SURVEY.md §4.2).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd
+
+
+def test_simple_backward():
+    x = nd.array([1.0, 2.0, 3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), 2 * x.asnumpy())
+
+
+def test_chain():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y * x  # x^3
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [12.0])
+
+
+def test_multi_variable():
+    a = nd.array([1.0, 2.0])
+    b = nd.array([3.0, 4.0])
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = a * b + a
+    c.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(), b.asnumpy() + 1)
+    np.testing.assert_allclose(b.grad.asnumpy(), a.asnumpy())
+
+
+def test_head_gradient():
+    x = nd.array([1.0, 2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = 3 * x
+    y.backward(nd.array([2.0, 4.0]))
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0, 12.0])
+
+
+def test_grad_req_add():
+    x = nd.array([1.0])
+    x.attach_grad(grad_req="add")
+    for _ in range(3):
+        with autograd.record():
+            y = 2 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [6.0])
+
+
+def test_grad_req_write_resets():
+    x = nd.array([1.0])
+    x.attach_grad()  # write
+    for _ in range(2):
+        x._ag.fresh = True
+        with autograd.record():
+            y = 5 * x
+        y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [5.0])
+
+
+def test_detach_blocks_grad():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+        z = y.detach() * x
+    z.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])  # only d(y_const*x)/dx
+
+
+def test_stop_gradient_op():
+    x = nd.array([2.0])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.stop_gradient(x * x) * x
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [4.0])
+
+
+def test_is_recording_is_training():
+    assert not autograd.is_recording()
+    with autograd.record():
+        assert autograd.is_recording()
+        assert autograd.is_training()
+        with autograd.pause():
+            assert not autograd.is_recording()
+    with autograd.predict_mode():
+        assert not autograd.is_training()
+
+
+def test_matmul_grad():
+    a_np = np.random.rand(3, 4).astype(np.float32)
+    b_np = np.random.rand(4, 2).astype(np.float32)
+    a, b = nd.array(a_np), nd.array(b_np)
+    a.attach_grad()
+    b.attach_grad()
+    with autograd.record():
+        c = nd.dot(a, b)
+        loss = nd.sum(c)
+    loss.backward()
+    np.testing.assert_allclose(a.grad.asnumpy(),
+                               np.ones((3, 2)) @ b_np.T, rtol=1e-5)
+    np.testing.assert_allclose(b.grad.asnumpy(),
+                               a_np.T @ np.ones((3, 2)), rtol=1e-5)
+
+
+def test_grad_function():
+    x = nd.array([3.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x * x
+    g = autograd.grad(y, [x])
+    np.testing.assert_allclose(g[0].asnumpy(), [6.0])
+    # original grad buffer untouched
+    np.testing.assert_allclose(x.grad.asnumpy(), [0.0])
+
+
+def test_softmax_grad():
+    x = nd.array(np.random.rand(2, 5).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        p = nd.softmax(x, axis=-1)
+        loss = nd.sum(p * p)
+    loss.backward()
+    assert x.grad.shape == (2, 5)
+    # softmax rows sum to 1 -> grads sum to ~0 along rows
+    np.testing.assert_allclose(x.grad.asnumpy().sum(axis=-1), 0.0, atol=1e-5)
+
+
+def test_slice_grad_under_record():
+    x = nd.array([1.0, 2.0, 3.0, 4.0])
+    x.attach_grad()
+    with autograd.record():
+        y = x[1:3] * 2
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [0, 2, 2, 0])
+
+
+def test_reshape_grad_under_record():
+    x = nd.array(np.arange(6.0, dtype=np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = x.reshape((2, 3)) * 3
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.full(6, 3.0))
+
+
+def test_custom_function():
+    class Square(autograd.Function):
+        def forward(self, x):
+            self.save_for_backward(x)
+            return x * x
+
+        def backward(self, dy):
+            (x,) = self.saved_tensors
+            return 2 * x * dy
+
+    x = nd.array([4.0])
+    x.attach_grad()
+    sq = Square()
+    with autograd.record():
+        y = sq(x)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [8.0])

@@ -1,0 +1,244 @@
+"""NDArray basics: creation, arithmetic, views, mutation, indexing.
+
+Reference analog: tests/python/unittest/test_ndarray.py (SURVEY.md §4.2).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_create_and_asnumpy():
+    x = nd.array([[1, 2], [3, 4]])
+    assert x.shape == (2, 2)
+    assert x.dtype == np.float32
+    np.testing.assert_allclose(x.asnumpy(), [[1, 2], [3, 4]])
+
+
+def test_zeros_ones_full_arange():
+    assert nd.zeros((2, 3)).asnumpy().sum() == 0
+    assert nd.ones((2, 3)).asnumpy().sum() == 6
+    np.testing.assert_allclose(nd.full((2,), 7).asnumpy(), [7, 7])
+    np.testing.assert_allclose(nd.arange(0, 5).asnumpy(), np.arange(0, 5.0))
+
+
+def test_arithmetic():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([4.0, 5.0, 6.0])
+    np.testing.assert_allclose((a + b).asnumpy(), [5, 7, 9])
+    np.testing.assert_allclose((a - b).asnumpy(), [-3, -3, -3])
+    np.testing.assert_allclose((a * b).asnumpy(), [4, 10, 18])
+    np.testing.assert_allclose((b / a).asnumpy(), [4, 2.5, 2])
+    np.testing.assert_allclose((a ** 2).asnumpy(), [1, 4, 9])
+    np.testing.assert_allclose((2 + a).asnumpy(), [3, 4, 5])
+    np.testing.assert_allclose((2 - a).asnumpy(), [1, 0, -1])
+    np.testing.assert_allclose((1 / a).asnumpy(), [1, 0.5, 1 / 3], rtol=1e-6)
+    np.testing.assert_allclose((-a).asnumpy(), [-1, -2, -3])
+
+
+def test_scalar_dtype_rule():
+    # MXNet rule: scalar is cast to array dtype
+    a = nd.array([1, 2, 3], dtype="int32")
+    r = a + 1.5
+    assert r.dtype == np.int32
+    np.testing.assert_array_equal(r.asnumpy(), [2, 3, 4])
+
+
+def test_comparison_returns_input_dtype():
+    a = nd.array([1.0, 2.0, 3.0])
+    b = nd.array([2.0, 2.0, 2.0])
+    r = a > b
+    assert r.dtype == np.float32
+    np.testing.assert_allclose(r.asnumpy(), [0, 0, 1])
+
+
+def test_inplace_ops():
+    a = nd.array([1.0, 2.0, 3.0])
+    a += 1
+    np.testing.assert_allclose(a.asnumpy(), [2, 3, 4])
+    a *= 2
+    np.testing.assert_allclose(a.asnumpy(), [4, 6, 8])
+
+
+def test_reshape_view_shares_memory():
+    a = nd.zeros((2, 3))
+    v = a.reshape((3, 2))
+    a[0, 0] = 5.0
+    assert v.asnumpy()[0, 0] == 5.0
+    v[2, 1] = 7.0
+    assert a.asnumpy()[1, 2] == 7.0
+
+
+def test_slice_view_write_through():
+    a = nd.zeros((4, 4))
+    s = a[1:3]
+    s[:] = 1.0
+    assert a.asnumpy()[1:3].sum() == 8.0
+    assert a.asnumpy()[0].sum() == 0.0
+
+
+def test_basic_indexing():
+    a = nd.array(np.arange(12).reshape(3, 4))
+    np.testing.assert_allclose(a[1].asnumpy(), np.arange(4, 8))
+    np.testing.assert_allclose(a[1:3, 2].asnumpy(), [6, 10])
+    np.testing.assert_allclose(a[:, ::2].asnumpy(),
+                               np.arange(12).reshape(3, 4)[:, ::2])
+
+
+def test_advanced_indexing():
+    a = nd.array(np.arange(10.0))
+    idx = nd.array([1, 3, 5], dtype="int32")
+    np.testing.assert_allclose(a[idx].asnumpy(), [1, 3, 5])
+
+
+def test_setitem():
+    a = nd.zeros((3, 3))
+    a[1, 1] = 9.0
+    assert a.asnumpy()[1, 1] == 9.0
+    a[0] = np.array([1.0, 2.0, 3.0])
+    np.testing.assert_allclose(a.asnumpy()[0], [1, 2, 3])
+
+
+def test_astype_copy_copyto():
+    a = nd.array([1.1, 2.9])
+    b = a.astype("int32")
+    assert b.dtype == np.int32
+    c = a.copy()
+    c[0] = 100.0
+    assert a.asnumpy()[0] != 100.0
+    d = nd.zeros((2,))
+    a.copyto(d)
+    np.testing.assert_allclose(d.asnumpy(), a.asnumpy())
+
+
+def test_reductions():
+    a = nd.array(np.arange(6.0).reshape(2, 3))
+    assert float(nd.sum(a).asnumpy()) == 15.0
+    np.testing.assert_allclose(nd.sum(a, axis=0).asnumpy(), [3, 5, 7])
+    np.testing.assert_allclose(nd.mean(a, axis=1).asnumpy(), [1, 4])
+    np.testing.assert_allclose(nd.max(a, axis=1).asnumpy(), [2, 5])
+    # exclude semantics
+    np.testing.assert_allclose(
+        nd.sum(a, axis=0, exclude=True).asnumpy(), [3, 12])
+
+
+def test_dot():
+    a = nd.array(np.random.rand(3, 4).astype(np.float32))
+    b = nd.array(np.random.rand(4, 5).astype(np.float32))
+    np.testing.assert_allclose(nd.dot(a, b).asnumpy(),
+                               a.asnumpy() @ b.asnumpy(), rtol=1e-5)
+
+
+def test_concat_split_stack():
+    a, b = nd.ones((2, 3)), nd.zeros((2, 3))
+    c = nd.concat(a, b, dim=0)
+    assert c.shape == (4, 3)
+    parts = nd.split(c, num_outputs=2, axis=0)
+    assert parts[0].shape == (2, 3)
+    np.testing.assert_allclose(parts[1].asnumpy(), 0)
+    s = nd.stack(a, b, axis=0)
+    assert s.shape == (2, 2, 3)
+
+
+def test_transpose_tile_repeat():
+    a = nd.array(np.arange(6.0).reshape(2, 3))
+    assert nd.transpose(a).shape == (3, 2)
+    assert a.T.shape == (3, 2)
+    assert nd.tile(a, reps=(2, 2)).shape == (4, 6)
+    assert nd.repeat(a, repeats=2, axis=0).shape == (4, 3)
+
+
+def test_take_embedding_onehot():
+    w = nd.array(np.arange(12.0).reshape(4, 3))
+    idx = nd.array([0, 3], dtype="int32")
+    np.testing.assert_allclose(nd.take(w, idx).asnumpy(),
+                               w.asnumpy()[[0, 3]])
+    e = nd.Embedding(idx, w, input_dim=4, output_dim=3)
+    np.testing.assert_allclose(e.asnumpy(), w.asnumpy()[[0, 3]])
+    oh = nd.one_hot(idx, depth=4)
+    np.testing.assert_allclose(oh.asnumpy(), np.eye(4)[[0, 3]])
+
+
+def test_slice_ops():
+    a = nd.array(np.arange(24.0).reshape(2, 3, 4))
+    s = nd.slice(a, begin=(0, 1), end=(2, 3))
+    np.testing.assert_allclose(s.asnumpy(), a.asnumpy()[0:2, 1:3])
+    s2 = nd.slice_axis(a, axis=2, begin=1, end=3)
+    np.testing.assert_allclose(s2.asnumpy(), a.asnumpy()[:, :, 1:3])
+
+
+def test_where_clip():
+    a = nd.array([-1.0, 0.5, 2.0])
+    np.testing.assert_allclose(nd.clip(a, a_min=0.0, a_max=1.0).asnumpy(),
+                               [0, 0.5, 1])
+    c = nd.array([1.0, 0.0, 1.0])
+    np.testing.assert_allclose(
+        nd.where(c, a, nd.zeros((3,))).asnumpy(), [-1, 0, 2])
+
+
+def test_topk_sort():
+    a = nd.array([[3.0, 1.0, 2.0]])
+    idx = nd.topk(a, k=2)
+    np.testing.assert_allclose(idx.asnumpy(), [[0, 2]])
+    both = nd.topk(a, k=2, ret_typ="both")
+    np.testing.assert_allclose(both[0].asnumpy(), [[3, 2]])
+    np.testing.assert_allclose(nd.sort(a).asnumpy(), [[1, 2, 3]])
+    np.testing.assert_allclose(nd.argsort(a).asnumpy(), [[1, 2, 0]])
+
+
+def test_random_ops():
+    mx.random.seed(42)
+    u = nd.random.uniform(0, 1, shape=(100,))
+    assert 0 <= float(u.min().asnumpy()) and float(u.max().asnumpy()) <= 1
+    n = nd.random.normal(0, 1, shape=(1000,))
+    assert abs(float(n.mean().asnumpy())) < 0.2
+    r = nd.random.randint(0, 10, shape=(50,))
+    assert r.dtype == np.int32
+    assert (r.asnumpy() >= 0).all() and (r.asnumpy() < 10).all()
+
+
+def test_save_load(tmp_path):
+    a = nd.array([1.0, 2.0])
+    b = nd.array([[3.0]])
+    f = str(tmp_path / "arrs")
+    nd.save(f, {"a": a, "b": b})
+    loaded = nd.load(f)
+    np.testing.assert_allclose(loaded["a"].asnumpy(), a.asnumpy())
+    nd.save(f, [a, b])
+    lst = nd.load(f)
+    np.testing.assert_allclose(lst[1].asnumpy(), b.asnumpy())
+
+
+def test_context_placement():
+    x = nd.ones((2,), ctx=mx.cpu(0))
+    assert x.context == mx.cpu(0)
+    y = x.as_in_context(mx.cpu(1))
+    assert y.context == mx.cpu(1)
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+
+
+def test_waitall_and_naive_engine():
+    x = nd.ones((8, 8))
+    y = nd.dot(x, x)
+    y.wait_to_read()
+    mx.waitall()
+    assert mx.engine.engine().num_ops_dispatched > 0
+
+
+def test_norm_argmax():
+    a = nd.array([[1.0, -2.0], [3.0, 4.0]])
+    np.testing.assert_allclose(float(nd.norm(a).asnumpy()),
+                               np.sqrt(1 + 4 + 9 + 16), rtol=1e-6)
+    am = nd.argmax(a, axis=1)
+    assert am.dtype == np.float32
+    np.testing.assert_allclose(am.asnumpy(), [0, 1])
+
+
+def test_broadcast_ops():
+    a = nd.ones((2, 1, 3))
+    b = nd.broadcast_to(a, shape=(2, 4, 3))
+    assert b.shape == (2, 4, 3)
+    np.testing.assert_allclose(
+        nd.broadcast_add(nd.ones((2, 1)), nd.ones((1, 3))).asnumpy(),
+        np.full((2, 3), 2.0))
