@@ -25,6 +25,16 @@ from . import ndarray as nd
 from .ndarray import NDArray
 from . import autograd
 from . import random
+from . import initializer
+from . import init
+from . import optimizer
+from .optimizer import Optimizer
+from . import lr_scheduler
+from . import metric
+from . import kvstore
+from . import kvstore as kv
+from . import recordio
+from . import gluon
 
 
 def waitall() -> None:

@@ -1,0 +1,12 @@
+"""Gluon: the imperative/hybrid frontend (reference: python/mxnet/gluon/)."""
+from .parameter import Parameter, Constant, ParameterDict, \
+    DeferredInitializationError
+from .block import Block, HybridBlock, SymbolBlock
+from .trainer import Trainer
+from . import nn
+from . import loss
+from . import utils
+from .utils import split_and_load, split_data
+from . import rnn
+from . import data
+from . import model_zoo

@@ -1,0 +1,509 @@
+"""Gluon Block / HybridBlock: composable imperative models with a jit path.
+
+Reference parity: python/mxnet/gluon/block.py (SURVEY.md §2.5, §3.3) —
+Block (eager), HybridBlock (`hybridize()` → CachedOp), prefix/name scoping,
+parameter collection, save/load.
+
+TPU-native design (the survey's designated XLA lowering point, §7):
+``hybridize()`` does NOT build an NNVM graph — it traces ``hybrid_forward``
+with tracer-backed NDArrays into ONE jitted XLA computation per input
+signature (shape/dtype tuple = the cache key, exactly the reference's
+CachedOp signature match).  During the trace every descendant Parameter's
+``data()`` is substituted by a function input (so weights are runtime
+arguments, not baked constants), RNG draws split from a traced key input
+(fresh dropout masks per call), and in-place writes to parameters (BatchNorm
+running stats) are captured as extra outputs and written back after the call
+— the functional translation of the reference's FMutateInputs.  Autograd
+records the whole cached call as a single tape node via ``jax.vjp``,
+mirroring CachedOp::Backward.
+"""
+from __future__ import annotations
+
+import re
+import threading
+from typing import Any, Dict, List, Optional, Tuple
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from .. import autograd as _autograd
+from .. import random as _grandom
+from ..ndarray import NDArray
+from .. import ndarray as nd_mod
+from .parameter import Parameter, ParameterDict, DeferredInitializationError
+
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "name_scope"]
+
+_naming_counter_lock = threading.Lock()
+_naming_counters: Dict[str, int] = {}
+
+
+def _gen_prefix(hint: str) -> str:
+    with _naming_counter_lock:
+        idx = _naming_counters.get(hint, 0)
+        _naming_counters[hint] = idx + 1
+    return f"{hint}{idx}_"
+
+
+class _BlockScope:
+    """Prefix scoping: blocks created inside ``with parent.name_scope():``
+    get the parent's prefix prepended (reference name manager)."""
+
+    _current = threading.local()
+
+    def __init__(self, block: "Block"):
+        self._block = block
+        self._counters: Dict[str, int] = {}
+
+    @staticmethod
+    def create(prefix: Optional[str], params, hint: str):
+        cur = getattr(_BlockScope._current, "value", None)
+        if cur is None:
+            if prefix is None:
+                prefix = _gen_prefix(hint)
+            pd = ParameterDict(prefix, params)
+            return prefix, pd
+        if prefix is None:
+            idx = cur._counters.get(hint, 0)
+            cur._counters[hint] = idx + 1
+            prefix = f"{hint}{idx}_"
+        full = cur._block.prefix + prefix
+        pd = ParameterDict(full, params if params is not None
+                           else cur._block._params._shared)
+        return full, pd
+
+    def __enter__(self):
+        self._old = getattr(_BlockScope._current, "value", None)
+        _BlockScope._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        _BlockScope._current.value = self._old
+
+
+class Block:
+    """Base building block (reference: gluon.Block)."""
+
+    def __init__(self, prefix: Optional[str] = None, params=None):
+        hint = _camel_to_snake(type(self).__name__)
+        self._prefix, self._params = _BlockScope.create(prefix, params, hint)
+        self._scope = _BlockScope(self)
+        self._children: Dict[str, Block] = {}
+        self._reg_params: Dict[str, Parameter] = {}
+        self._forward_hooks: List = []
+
+    # -- attribute registration -------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Block):
+            existing = self.__dict__.get("_children")
+            if existing is not None:
+                existing[name] = value
+        elif isinstance(value, Parameter):
+            reg = self.__dict__.get("_reg_params")
+            if reg is not None:
+                reg[name] = value
+        super().__setattr__(name, value)
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    @property
+    def name(self) -> str:
+        return self._prefix[:-1] if self._prefix.endswith("_") else self._prefix
+
+    @property
+    def params(self) -> ParameterDict:
+        return self._params
+
+    def name_scope(self) -> _BlockScope:
+        return self._scope
+
+    # -- params ------------------------------------------------------------
+    def collect_params(self, select: Optional[str] = None) -> ParameterDict:
+        ret = ParameterDict(self._params.prefix)
+        if select is None:
+            ret.update(self._params)
+        else:
+            pat = re.compile(select)
+            for name, p in self._params.items():
+                if pat.match(name):
+                    ret._params[name] = p
+        for child in self._children.values():
+            sub = child.collect_params(select)
+            for k, v in sub.items():
+                ret._params[k] = v
+        return ret
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        self.collect_params().initialize(init, ctx, verbose, force_reinit)
+
+    def register_child(self, block: "Block", name: Optional[str] = None) -> None:
+        self._children[name or str(len(self._children))] = block
+
+    def apply(self, fn) -> "Block":
+        for child in self._children.values():
+            child.apply(fn)
+        fn(self)
+        return self
+
+    def cast(self, dtype) -> None:
+        for child in self._children.values():
+            child.cast(dtype)
+        for p in self._params.values():
+            p.cast(dtype)
+
+    def hybridize(self, active: bool = True, **kwargs) -> None:
+        for child in self._children.values():
+            child.hybridize(active, **kwargs)
+
+    # -- persistence ---------------------------------------------------------
+    def _collect_params_with_prefix(self, prefix: str = "") -> Dict[str, Parameter]:
+        """Structural (attribute-path) parameter names, e.g. ``0.weight`` —
+        the reference's save_parameters naming, robust to prefix counters."""
+        if prefix:
+            prefix += "."
+        ret = {prefix + key: p for key, p in self._reg_params.items()}
+        for name, child in self._children.items():
+            ret.update(child._collect_params_with_prefix(prefix + name))
+        return ret
+
+    def save_parameters(self, filename: str, deduplicate: bool = False) -> None:
+        from ..ndarray import utils as nd_utils
+        params = self._collect_params_with_prefix()
+        arrs = {name: p.data() for name, p in params.items()}
+        nd_utils.save(filename, arrs)
+
+    def load_parameters(self, filename: str, ctx=None, allow_missing=False,
+                        ignore_extra=False, cast_dtype=False,
+                        dtype_source="current") -> None:
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(filename)
+        params = self._collect_params_with_prefix()
+        if loaded and params and not any(k in params for k in loaded):
+            # fall back: file saved with full prefixed names
+            full = self.collect_params()
+            loaded = {_strip(k, self.prefix): v for k, v in loaded.items()}
+            params = {_strip(k, self.prefix): p for k, p in full.items()}
+        for name, arr in loaded.items():
+            if name not in params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(f"{filename} contains unknown parameter "
+                                 f"{name!r}")
+            p = params[name]
+            if p._data is None and p._deferred_init is None and ctx is not None:
+                p.initialize(ctx=ctx)
+            p.set_data(arr)
+        if not allow_missing:
+            for name in params:
+                if name not in loaded:
+                    raise MXNetError(f"parameter {name!r} missing from "
+                                     f"{filename}")
+
+    save_params = save_parameters
+    load_params = load_parameters
+
+    # -- call ----------------------------------------------------------------
+    def __call__(self, *args):
+        out = self.forward(*args)
+        for hook in self._forward_hooks:
+            hook(self, args, out)
+        return out
+
+    def register_forward_hook(self, hook):
+        self._forward_hooks.append(hook)
+
+    def forward(self, *args):
+        raise NotImplementedError
+
+    def summary(self, *inputs):
+        out = self(*inputs)
+        total = sum(int(_prod(p.shape)) for p in self.collect_params().values())
+        print(f"{type(self).__name__}: {total} parameters")
+        return out
+
+    def __repr__(self):
+        lines = [f"{type(self).__name__}("]
+        for name, child in self._children.items():
+            c = repr(child).replace("\n", "\n  ")
+            lines.append(f"  ({name}): {c}")
+        lines.append(")")
+        return "\n".join(lines)
+
+
+def _prod(shape):
+    n = 1
+    for s in shape or ():
+        n *= s
+    return n
+
+
+def _strip(name: str, prefix: str) -> str:
+    return name[len(prefix):] if name.startswith(prefix) else name
+
+
+def _camel_to_snake(name: str) -> str:
+    return re.sub("([a-z0-9])([A-Z])", r"\1_\2", name).lower()
+
+
+# ---------------------------------------------------------------------------
+# Trace context: Parameter substitution + RNG threading during hybrid trace
+# ---------------------------------------------------------------------------
+
+class _TraceCtx:
+    _current = threading.local()
+
+    def __init__(self, substitutes: Dict[int, NDArray]):
+        self.substitutes = substitutes   # id(Parameter) -> wrapper NDArray
+
+    def __enter__(self):
+        self._old = getattr(_TraceCtx._current, "value", None)
+        _TraceCtx._current.value = self
+        return self
+
+    def __exit__(self, *a):
+        _TraceCtx._current.value = self._old
+
+    @staticmethod
+    def active() -> Optional["_TraceCtx"]:
+        return getattr(_TraceCtx._current, "value", None)
+
+
+def _param_data_maybe_traced(param: Parameter, ctx) -> NDArray:
+    tc = _TraceCtx.active()
+    if tc is not None:
+        sub = tc.substitutes.get(id(param))
+        if sub is not None:
+            return sub
+    return Parameter.data(param, ctx)
+
+
+class HybridBlock(Block):
+    """A Block whose forward can be lowered to one XLA computation."""
+
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._active = False
+        self._cached_graph: Dict[Tuple, Any] = {}
+        self._flags: Dict[str, Any] = {}
+
+    def hybridize(self, active: bool = True, static_alloc: bool = False,
+                  static_shape: bool = False, inline_limit: int = 2,
+                  **kwargs) -> None:
+        self._active = active
+        self._flags = dict(static_alloc=static_alloc,
+                           static_shape=static_shape, **kwargs)
+        self._cached_graph = {}
+        super().hybridize(False, **kwargs)  # children run inside our trace
+
+    def cast(self, dtype):
+        self._cached_graph = {}
+        super().cast(dtype)
+
+    def infer_shape(self, *args) -> None:
+        """Layer-specific deferred-shape resolution; subclasses with deferred
+        params override (Dense/Conv/BatchNorm/...)."""
+        raise MXNetError(
+            f"{type(self).__name__} has uninitialized parameters with "
+            f"unknown shape and no infer_shape; give explicit in_units/"
+            f"in_channels")
+
+    # -- forward dispatch --------------------------------------------------
+    def forward(self, x, *args):
+        from ..symbol import Symbol
+        if isinstance(x, Symbol):
+            kwargs = {k: p.var() for k, p in self._reg_params.items()}
+            from .. import symbol as sym_mod
+            return self.hybrid_forward(sym_mod, x, *args, **kwargs)
+        if not isinstance(x, NDArray):
+            raise MXNetError(f"forward expects NDArray/Symbol, got {type(x)}")
+        ctx = x.context
+        try:
+            params = {k: _param_data_maybe_traced(p, ctx)
+                      for k, p in self._reg_params.items()}
+        except DeferredInitializationError:
+            self._deferred_infer(x, *args)
+            params = {k: _param_data_maybe_traced(p, ctx)
+                      for k, p in self._reg_params.items()}
+        if self._active and _TraceCtx.active() is None:
+            try:
+                return self._call_cached(x, *args)
+            except DeferredInitializationError:
+                pass  # first call runs eagerly to settle child deferred shapes
+        return self.hybrid_forward(nd_mod, x, *args, **params)
+
+    def _deferred_infer(self, *args) -> None:
+        self.infer_shape(*args)
+        for p in self._reg_params.values():
+            p._finish_deferred_init()
+
+    def hybrid_forward(self, F, x, *args, **kwargs):
+        raise NotImplementedError
+
+    # -- the CachedOp analog ----------------------------------------------
+    def _ordered_params(self, ctx) -> List[Parameter]:
+        # warm all deferred inits by the eager path having run already
+        return list(self.collect_params().values())
+
+    def _call_cached(self, *inputs):
+        import jax
+        ctx = inputs[0].context
+        training = _autograd.is_training()
+        sig = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+               training, ctx)
+        entry = self._cached_graph.get(sig)
+        if entry is None:
+            entry = self._build_cached(inputs, training, ctx)
+            self._cached_graph[sig] = entry
+        jitted, params, meta = entry
+        n_outs_cell, write_idx_cell = meta
+
+        pvals = [p.data(ctx)._read() for p in params]
+        invals = [a._read() for a in inputs]
+        key = _grandom.next_key()
+
+        recording = _autograd.is_recording() and (
+            any(p.data(ctx)._ag is not None for p in params) or
+            any(getattr(a, "_ag", None) is not None for a in inputs))
+        if recording:
+            flat, vjp_fn = jax.vjp(jitted, key, *pvals, *invals)
+        else:
+            flat = jitted(key, *pvals, *invals)
+
+        n_outs = n_outs_cell[0]
+        write_idx = write_idx_cell[0]
+        outs = [NDArray(v, ctx=ctx) for v in flat[:n_outs]]
+
+        # write back captured aux mutations (running stats)
+        if write_idx:
+            with _autograd.pause():
+                for pos, pi in enumerate(write_idx):
+                    params[pi].data(ctx)._set_data(flat[n_outs + pos])
+
+        if recording:
+            parents = [None]  # rng key input
+            for p in params:
+                parents.append(p.data(ctx)._ag)
+            for a in inputs:
+                parents.append(getattr(a, "_ag", None))
+            node = _autograd.TapeNode(
+                f"CachedOp[{self.name}]", vjp_fn, parents,
+                [(o.shape, o.dtype) for o in outs] +
+                [(flat[n_outs + i].shape, flat[n_outs + i].dtype)
+                 for i in range(len(write_idx))],
+                True)
+            # tape sees the flat tuple; only real outs get user cotangents
+            for i, o in enumerate(outs):
+                o._ag = _autograd.AGInfo(node=node, index=i)
+        return outs[0] if n_outs == 1 else tuple(outs)
+
+    def _build_cached(self, inputs, training, ctx):
+        import jax
+        # ensure deferred params are resolved by one eager run if needed
+        params = self._ordered_params(ctx)
+        n_outs_cell = [None]
+        write_idx_cell = [None]
+        block = self
+        n_params = len(params)
+
+        def pure_fn(key, *vals):
+            pvals = vals[:n_params]
+            invals = vals[n_params:]
+            wrappers = [NDArray(v, ctx=ctx) for v in pvals]
+            win = [NDArray(v, ctx=ctx) for v in invals]
+            subs = {id(p): w for p, w in zip(params, wrappers)}
+            with _TraceCtx(subs), \
+                    _autograd._RecordingScope(False, training), \
+                    _KeyScope(key):
+                out = block.hybrid_forward_entry(*win)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            out_vals = [o._read() for o in outs]
+            writes = [(i, w._read()) for i, w in enumerate(wrappers)
+                      if w._version > 0]
+            n_outs_cell[0] = len(out_vals)
+            write_idx_cell[0] = [i for i, _ in writes]
+            return tuple(out_vals) + tuple(v for _, v in writes)
+
+        jitted = jax.jit(pure_fn)
+        return jitted, params, (n_outs_cell, write_idx_cell)
+
+    def hybrid_forward_entry(self, *inputs):
+        """Entry used during trace: routes through forward so nested blocks
+        participate (their params substitute via the trace context)."""
+        ctx = inputs[0].context
+        params = {k: _param_data_maybe_traced(p, ctx)
+                  for k, p in self._reg_params.items()}
+        return self.hybrid_forward(nd_mod, *inputs, **params)
+
+    def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
+        """Reference parity: save -symbol.json + -%04d.params for the
+        SymbolBlock / predict path."""
+        from ..symbol import Symbol
+        from .. import symbol as sym_mod
+        data = Symbol.var("data")
+        out = self(data)
+        sym_file = f"{path}-symbol.json"
+        out.save(sym_file)
+        params_file = f"{path}-{epoch:04d}.params"
+        from ..ndarray import utils as nd_utils
+        arrs = {}
+        for name, p in self.collect_params().items():
+            arrs[f"arg:{name}"] = p.data()
+        nd_utils.save(params_file, arrs)
+        return sym_file, params_file
+
+
+class _KeyScope:
+    """Push a traced RNG key for the duration of a hybrid trace so random
+    ops draw from a runtime input, not a baked constant."""
+
+    def __init__(self, key):
+        self._key = key
+
+    def __enter__(self):
+        _grandom.push_key(self._key)
+        return self
+
+    def __exit__(self, *a):
+        _grandom.pop_key()
+
+
+class SymbolBlock(Block):
+    """Construct a Block from a Symbol graph + params (reference:
+    gluon.SymbolBlock.imports for serving exported models)."""
+
+    def __init__(self, outputs, inputs, params=None):
+        super().__init__(prefix="symbolblock_", params=None)
+        self._outputs = outputs
+        self._inputs = inputs if isinstance(inputs, (list, tuple)) else [inputs]
+        self._arg_params = params or {}
+
+    @staticmethod
+    def imports(symbol_file: str, input_names, param_file: Optional[str] = None,
+                ctx=None):
+        from ..symbol import load as sym_load
+        sym = sym_load(symbol_file)
+        params = {}
+        if param_file:
+            from ..ndarray import utils as nd_utils
+            loaded = nd_utils.load(param_file)
+            for k, v in loaded.items():
+                name = k.split(":", 1)[1] if ":" in k else k
+                if ctx is not None:
+                    v = v.as_in_context(ctx)
+                params[name] = v
+        if isinstance(input_names, str):
+            input_names = [input_names]
+        from ..symbol import Symbol
+        inputs = [Symbol.var(n) for n in input_names]
+        return SymbolBlock(sym, inputs, params)
+
+    def forward(self, *args):
+        feed = {s.name: a for s, a in zip(self._inputs, args)}
+        feed.update(self._arg_params)
+        return self._outputs.eval_dict(feed)
+
+
+def name_scope():
+    raise MXNetError("use block.name_scope() on a Block instance")

@@ -1,0 +1,101 @@
+"""DataLoader: batched iteration with background prefetch.
+
+Reference parity: python/mxnet/gluon/data/dataloader.py (SURVEY.md §2.4) —
+multiprocessing workers passing batches through POSIX-shm NDArrays.
+TPU-native design: the consumer is one fat chip fed over PCIe, not 8 GPU
+queues, so the pipeline is a thread pool (numpy batching releases the GIL in
+decode/augment) + a bounded prefetch queue that overlaps host batching with
+device steps; batches land on device asynchronously via the NDArray layer.
+"""
+from __future__ import annotations
+
+import queue
+import threading
+from typing import Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray import NDArray, array as nd_array
+from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+__all__ = ["DataLoader", "default_batchify_fn"]
+
+
+def default_batchify_fn(data):
+    """Stack samples into a batch (NDArray or numpy leaves; tuples recurse)."""
+    if isinstance(data[0], NDArray):
+        import jax.numpy as jnp
+        return nd_array(_np.stack([d.asnumpy() for d in data]))
+    if isinstance(data[0], tuple):
+        transposed = zip(*data)
+        return tuple(default_batchify_fn(list(f)) for f in transposed)
+    arr = _np.asarray(data)
+    if arr.dtype == _np.float64:
+        arr = arr.astype(_np.float32)
+    return nd_array(arr)
+
+
+class DataLoader:
+    def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
+                 last_batch=None, batch_sampler=None, batchify_fn=None,
+                 num_workers=0, pin_memory=False, prefetch=None,
+                 thread_pool=False, timeout=120):
+        self._dataset = dataset
+        if batch_sampler is None:
+            if batch_size is None:
+                raise MXNetError("batch_size required when no batch_sampler")
+            if sampler is None:
+                sampler = RandomSampler(len(dataset)) if shuffle else \
+                    SequentialSampler(len(dataset))
+            elif shuffle:
+                raise MXNetError("shuffle and sampler are exclusive")
+            batch_sampler = BatchSampler(sampler, batch_size,
+                                         last_batch or "keep")
+        self._batch_sampler = batch_sampler
+        self._batchify_fn = batchify_fn or default_batchify_fn
+        self._num_workers = num_workers
+        self._prefetch = max(0, prefetch if prefetch is not None
+                             else 2 * num_workers)
+
+    def __len__(self):
+        return len(self._batch_sampler)
+
+    def _make_batch(self, indices):
+        samples = [self._dataset[i] for i in indices]
+        return self._batchify_fn(samples)
+
+    def __iter__(self):
+        if self._num_workers == 0:
+            for indices in self._batch_sampler:
+                yield self._make_batch(indices)
+            return
+        # threaded prefetch pipeline with a bounded in-flight window so a
+        # slow consumer never materializes more than window batches
+        import collections
+        from concurrent.futures import ThreadPoolExecutor
+        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch or 2)
+        sentinel = object()
+        window = self._num_workers + (self._prefetch or 2)
+
+        def producer():
+            try:
+                with ThreadPoolExecutor(self._num_workers) as pool:
+                    it = iter(self._batch_sampler)
+                    inflight = collections.deque()
+                    for idx in it:
+                        inflight.append(pool.submit(self._make_batch, idx))
+                        if len(inflight) >= window:
+                            q.put(inflight.popleft().result())
+                    while inflight:
+                        q.put(inflight.popleft().result())
+            finally:
+                q.put(sentinel)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is sentinel:
+                break
+            yield item

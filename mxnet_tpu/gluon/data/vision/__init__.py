@@ -1,0 +1,6 @@
+"""gluon.data.vision: datasets + transforms (reference:
+python/mxnet/gluon/data/vision/)."""
+from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, \
+    ImageRecordDataset
+from . import transforms
+from . import datasets

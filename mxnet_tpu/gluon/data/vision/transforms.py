@@ -1,0 +1,199 @@
+"""Image transforms (reference: python/mxnet/gluon/data/vision/transforms.py
+over src/operator/image/ — SURVEY.md §2.2, §2.4).
+
+Transforms operate on HWC uint8/float NDArrays on the host side of the
+pipeline (numpy; cheap, GIL-released) and only ToTensor moves to CHW float.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ....base import MXNetError
+from ....ndarray import NDArray, array as nd_array
+from ...block import Block, HybridBlock
+
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+           "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
+           "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
+           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+
+
+def _to_np(x):
+    return x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)
+
+
+class Compose:
+    def __init__(self, transforms):
+        self._transforms = transforms
+
+    def __call__(self, x, *args):
+        for t in self._transforms:
+            x = t(x)
+        return (x,) + args if args else x
+
+
+class Cast:
+    def __init__(self, dtype="float32"):
+        self._dtype = dtype
+
+    def __call__(self, x):
+        return x.astype(self._dtype) if isinstance(x, NDArray) else \
+            nd_array(_to_np(x).astype(self._dtype))
+
+
+class ToTensor:
+    """HWC uint8 [0,255] → CHW float32 [0,1] (reference semantics)."""
+
+    def __call__(self, x):
+        a = _to_np(x).astype(_np.float32) / 255.0
+        if a.ndim == 3:
+            a = a.transpose(2, 0, 1)
+        elif a.ndim == 4:
+            a = a.transpose(0, 3, 1, 2)
+        return nd_array(a)
+
+
+class Normalize:
+    def __init__(self, mean=0.0, std=1.0):
+        self._mean = _np.asarray(mean, _np.float32)
+        self._std = _np.asarray(std, _np.float32)
+
+    def __call__(self, x):
+        a = _to_np(x)
+        mean = self._mean.reshape(-1, 1, 1) if self._mean.ndim else self._mean
+        std = self._std.reshape(-1, 1, 1) if self._std.ndim else self._std
+        return nd_array((a - mean) / std)
+
+
+def _resize_np(a, size):
+    """Nearest-neighbor host-side resize (no OpenCV dependency)."""
+    h, w = a.shape[:2]
+    if isinstance(size, int):
+        ow, oh = size, size
+    else:
+        ow, oh = size
+    ys = (_np.arange(oh) * (h / oh)).astype(_np.int64).clip(0, h - 1)
+    xs = (_np.arange(ow) * (w / ow)).astype(_np.int64).clip(0, w - 1)
+    return a[ys][:, xs]
+
+
+class Resize:
+    def __init__(self, size, keep_ratio=False, interpolation=1):
+        self._size = size
+
+    def __call__(self, x):
+        return nd_array(_resize_np(_to_np(x), self._size))
+
+
+class CenterCrop:
+    def __init__(self, size, interpolation=1):
+        self._size = (size, size) if isinstance(size, int) else size
+
+    def __call__(self, x):
+        a = _to_np(x)
+        h, w = a.shape[:2]
+        cw, ch = self._size
+        y0 = max(0, (h - ch) // 2)
+        x0 = max(0, (w - cw) // 2)
+        return nd_array(a[y0:y0 + ch, x0:x0 + cw])
+
+
+class RandomResizedCrop:
+    def __init__(self, size, scale=(0.08, 1.0), ratio=(3 / 4, 4 / 3),
+                 interpolation=1):
+        self._size = size
+        self._scale = scale
+        self._ratio = ratio
+
+    def __call__(self, x):
+        a = _to_np(x)
+        h, w = a.shape[:2]
+        area = h * w
+        for _ in range(10):
+            target = area * _np.random.uniform(*self._scale)
+            aspect = _np.random.uniform(*self._ratio)
+            cw = int(round(_np.sqrt(target * aspect)))
+            ch = int(round(_np.sqrt(target / aspect)))
+            if cw <= w and ch <= h:
+                x0 = _np.random.randint(0, w - cw + 1)
+                y0 = _np.random.randint(0, h - ch + 1)
+                crop = a[y0:y0 + ch, x0:x0 + cw]
+                return nd_array(_resize_np(crop, self._size))
+        return nd_array(_resize_np(a, self._size))
+
+
+class RandomFlipLeftRight:
+    def __call__(self, x):
+        a = _to_np(x)
+        if _np.random.rand() < 0.5:
+            a = a[:, ::-1].copy()
+        return nd_array(a)
+
+
+class RandomFlipTopBottom:
+    def __call__(self, x):
+        a = _to_np(x)
+        if _np.random.rand() < 0.5:
+            a = a[::-1].copy()
+        return nd_array(a)
+
+
+class _RandomJitter:
+    def __init__(self, amount):
+        self._amount = amount
+
+    def _factor(self):
+        return 1.0 + _np.random.uniform(-self._amount, self._amount)
+
+
+class RandomBrightness(_RandomJitter):
+    def __call__(self, x):
+        a = _to_np(x).astype(_np.float32)
+        return nd_array(_np.clip(a * self._factor(), 0, 255))
+
+
+class RandomContrast(_RandomJitter):
+    def __call__(self, x):
+        a = _to_np(x).astype(_np.float32)
+        mean = a.mean()
+        return nd_array(_np.clip((a - mean) * self._factor() + mean, 0, 255))
+
+
+class RandomSaturation(_RandomJitter):
+    def __call__(self, x):
+        a = _to_np(x).astype(_np.float32)
+        gray = a.mean(axis=-1, keepdims=True)
+        f = self._factor()
+        return nd_array(_np.clip(a * f + gray * (1 - f), 0, 255))
+
+
+class RandomLighting:
+    def __init__(self, alpha):
+        self._alpha = alpha
+
+    def __call__(self, x):
+        a = _to_np(x).astype(_np.float32)
+        # PCA lighting noise (AlexNet-style) with fixed RGB eigenbasis
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        alpha = _np.random.normal(0, self._alpha, 3)
+        rgb = eigvec @ (alpha * eigval)
+        return nd_array(_np.clip(a + rgb, 0, 255))
+
+
+class RandomColorJitter:
+    def __init__(self, brightness=0, contrast=0, saturation=0, hue=0):
+        self._ts = []
+        if brightness:
+            self._ts.append(RandomBrightness(brightness))
+        if contrast:
+            self._ts.append(RandomContrast(contrast))
+        if saturation:
+            self._ts.append(RandomSaturation(saturation))
+
+    def __call__(self, x):
+        for t in self._ts:
+            x = t(x)
+        return x

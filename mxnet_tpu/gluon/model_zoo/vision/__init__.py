@@ -1,0 +1,28 @@
+"""model_zoo.vision with the reference's ``get_model`` registry."""
+import importlib as _importlib
+
+from ....base import MXNetError
+
+_models = {}
+for _modname in ("resnet", "alexnet", "vgg", "mobilenet"):
+    _mod = _importlib.import_module(f".{_modname}", __name__)
+    for _name in _mod.__all__:
+        _obj = getattr(_mod, _name)
+        if callable(_obj) and _name[0].islower() and not \
+                _name.startswith("get_"):
+            _models[_name] = _obj
+
+# flat exports (function names shadow same-named submodules, as upstream)
+from .resnet import *      # noqa: F401,F403,E402
+from .vgg import *         # noqa: F401,F403,E402
+from .mobilenet import *   # noqa: F401,F403,E402
+from .alexnet import *     # noqa: F401,F403,E402
+
+
+def get_model(name, **kwargs):
+    """Create a model by name (reference: mx.gluon.model_zoo.vision.get_model)."""
+    name = name.lower()
+    if name not in _models:
+        raise MXNetError(f"unknown model {name!r}; available: "
+                         f"{sorted(_models)}")
+    return _models[name](**kwargs)

@@ -1,0 +1,350 @@
+"""Gluon Parameter / ParameterDict.
+
+Reference parity: python/mxnet/gluon/parameter.py (SURVEY.md §2.5) —
+deferred initialization on first shape, per-context data replicas,
+grad_req/lr_mult/wd_mult, ParameterDict prefix namespacing and save/load.
+TPU-native notes: replicas are jax arrays per device; the gradient buffer is
+attached through the autograd variable mechanism so hybridized (jit) calls
+route cotangents into it.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+import numpy as _np
+
+from ..base import MXNetError, dtype_np
+from ..context import Context, current_context
+from .. import initializer as init_mod
+from .. import autograd as _autograd
+from ..ndarray import NDArray, zeros as nd_zeros, array as nd_array
+
+__all__ = ["Parameter", "Constant", "ParameterDict", "DeferredInitializationError"]
+
+
+class DeferredInitializationError(MXNetError):
+    """Raised when parameter data is requested before shape is known."""
+
+
+class Parameter:
+    """A trainable (or auxiliary) tensor with per-context replicas."""
+
+    def __init__(self, name: str, grad_req: str = "write", shape=None,
+                 dtype="float32", lr_mult: float = 1.0, wd_mult: float = 1.0,
+                 init=None, allow_deferred_init: bool = False,
+                 differentiable: bool = True, stype="default",
+                 grad_stype="default"):
+        self.name = name
+        self._grad_req = grad_req if differentiable else "null"
+        if isinstance(shape, int):
+            shape = (shape,)
+        self.shape = tuple(shape) if shape is not None else None
+        self.dtype = dtype_np(dtype)
+        self.lr_mult = lr_mult
+        self.wd_mult = wd_mult
+        self.init = init
+        self.allow_deferred_init = allow_deferred_init
+        self._data: Optional[Dict[Context, NDArray]] = None
+        self._deferred_init = None   # (initializer, ctx_list, default_init)
+
+    # ------------------------------------------------------------------
+    @property
+    def grad_req(self) -> str:
+        return self._grad_req
+
+    @grad_req.setter
+    def grad_req(self, req: str) -> None:
+        self._grad_req = req
+        if self._data is not None and req != "null":
+            for arr in self._data.values():
+                arr.attach_grad(grad_req=req)
+
+    def _shape_known(self) -> bool:
+        return self.shape is not None and all(s > 0 for s in self.shape)
+
+    def _finish_deferred_init(self, inferred_shape=None) -> None:
+        if inferred_shape is not None:
+            if self.shape is not None:
+                merged = tuple(s if s > 0 else i
+                               for s, i in zip(self.shape, inferred_shape))
+            else:
+                merged = tuple(inferred_shape)
+            self.shape = merged
+        if self._deferred_init is None:
+            return
+        initializer, ctxs, default_init = self._deferred_init
+        if not self._shape_known():
+            return
+        self._deferred_init = None
+        self._init_impl(initializer, ctxs, default_init)
+
+    def _init_impl(self, initializer, ctxs, default_init) -> None:
+        data0 = nd_zeros(self.shape, ctx=ctxs[0], dtype=self.dtype)
+        explicit = self.init if self.init is not None else None
+        chosen = init_mod.create(explicit if explicit is not None
+                                 else (initializer if initializer is not None
+                                       else default_init))
+        if explicit is not None:
+            # per-parameter initializer wins outright — bypass the
+            # name-suffix dispatch (else e.g. LSTMBias on '*_bias' params
+            # would be silently zeroed)
+            chosen.init_weight(self.name, data0)
+        else:
+            chosen(self.name, data0)
+        self._data = {}
+        for ctx in ctxs:
+            arr = data0 if ctx == ctxs[0] else data0.copyto(ctx)
+            if self._grad_req != "null":
+                arr.attach_grad(grad_req=self._grad_req)
+            self._data[ctx] = arr
+
+    def initialize(self, init=None, ctx=None, default_init="uniform",
+                   force_reinit: bool = False) -> None:
+        if self._data is not None and not force_reinit:
+            return
+        if ctx is None:
+            ctx = [current_context()]
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        ctx = list(ctx)
+        if not self._shape_known():
+            if self.allow_deferred_init:
+                self._deferred_init = (init, ctx, default_init)
+                return
+            raise MXNetError(
+                f"cannot initialize Parameter {self.name!r}: shape "
+                f"{self.shape} unknown; set in_units/in_channels or use "
+                f"deferred init")
+        self._init_impl(init, ctx, default_init)
+
+    # ------------------------------------------------------------------
+    def _check_initialized(self, ctx=None):
+        if self._data is None:
+            if self._deferred_init is not None:
+                raise DeferredInitializationError(
+                    f"Parameter {self.name!r} awaits shape inference; run a "
+                    f"forward pass first")
+            raise MXNetError(
+                f"Parameter {self.name!r} has not been initialized; call "
+                f".initialize()")
+        if ctx is not None and ctx not in self._data:
+            raise MXNetError(
+                f"Parameter {self.name!r} not initialized on {ctx}; it lives "
+                f"on {list(self._data)}")
+
+    def data(self, ctx: Optional[Context] = None) -> NDArray:
+        self._check_initialized()
+        if ctx is None:
+            ctx = next(iter(self._data))
+        self._check_initialized(ctx)
+        return self._data[ctx]
+
+    def list_data(self) -> List[NDArray]:
+        self._check_initialized()
+        return list(self._data.values())
+
+    def list_ctx(self) -> List[Context]:
+        self._check_initialized()
+        return list(self._data.keys())
+
+    def grad(self, ctx: Optional[Context] = None) -> NDArray:
+        d = self.data(ctx)
+        if d.grad is None:
+            raise MXNetError(
+                f"Parameter {self.name!r} has grad_req='null'; no gradient")
+        return d.grad
+
+    def list_grad(self) -> List[NDArray]:
+        self._check_initialized()
+        return [d.grad for d in self._data.values()]
+
+    def set_data(self, data) -> None:
+        if self._data is None and self._deferred_init is not None:
+            # setting data resolves deferred shape (reference behavior)
+            self.shape = tuple(data.shape)
+            initializer, ctxs, default_init = self._deferred_init
+            self._deferred_init = None
+            self._init_impl(initializer, ctxs, default_init)
+        self._check_initialized()
+        src = data if isinstance(data, NDArray) else nd_array(data)
+        if tuple(src.shape) != tuple(self.shape):
+            raise MXNetError(
+                f"cannot set Parameter {self.name!r} of shape {self.shape} "
+                f"with data of shape {tuple(src.shape)}")
+        for arr in self._data.values():
+            src.copyto(arr)
+
+    def zero_grad(self) -> None:
+        if self._grad_req == "null" or self._data is None:
+            return
+        for arr in self._data.values():
+            if arr.grad is not None:
+                arr.grad._set_data(arr.grad._read() * 0)
+                arr._ag.fresh = True
+
+    def reset_ctx(self, ctx) -> None:
+        if isinstance(ctx, Context):
+            ctx = [ctx]
+        self._check_initialized()
+        cur = self.data()
+        self._data = {}
+        for c in ctx:
+            arr = cur.copyto(c)
+            if self._grad_req != "null":
+                arr.attach_grad(grad_req=self._grad_req)
+            self._data[c] = arr
+
+    def cast(self, dtype) -> None:
+        self.dtype = dtype_np(dtype)
+        if self._data is None:
+            return
+        for ctx, arr in list(self._data.items()):
+            new = arr.astype(self.dtype)
+            if self._grad_req != "null":
+                new.attach_grad(grad_req=self._grad_req)
+            self._data[ctx] = new
+
+    def var(self):
+        from ..symbol import Symbol
+        return Symbol.var(self.name, shape=self.shape)
+
+    def __repr__(self):
+        return (f"Parameter {self.name} (shape={self.shape}, "
+                f"dtype={self.dtype})")
+
+
+class Constant(Parameter):
+    """Non-trainable constant parameter (reference: gluon.Constant)."""
+
+    def __init__(self, name, value):
+        if not isinstance(value, _np.ndarray):
+            value = _np.asarray(value, dtype=_np.float32)
+        self.value = value
+
+        class _CInit(init_mod.Initializer):
+            def __call__(self, _n, arr):
+                arr[:] = value
+
+        super().__init__(name, grad_req="null", shape=value.shape,
+                         dtype=value.dtype, init=_CInit())
+
+
+class ParameterDict:
+    """Ordered name→Parameter mapping with prefix namespacing."""
+
+    def __init__(self, prefix: str = "", shared: Optional["ParameterDict"] = None):
+        self._prefix = prefix
+        self._params: Dict[str, Parameter] = {}
+        self._shared = shared
+
+    @property
+    def prefix(self) -> str:
+        return self._prefix
+
+    def items(self):
+        return self._params.items()
+
+    def keys(self):
+        return self._params.keys()
+
+    def values(self):
+        return self._params.values()
+
+    def __iter__(self):
+        return iter(self._params)
+
+    def __len__(self):
+        return len(self._params)
+
+    def __contains__(self, name):
+        return name in self._params
+
+    def __getitem__(self, name) -> Parameter:
+        return self._params[name]
+
+    def __repr__(self):
+        lines = "\n".join(f"  {p}" for p in self._params.values())
+        return f"ParameterDict(prefix={self._prefix!r}\n{lines}\n)"
+
+    def get(self, name: str, **kwargs) -> Parameter:
+        """Fetch-or-create ``prefix+name`` (the Block param entry point)."""
+        full = self._prefix + name
+        if full in self._params:
+            param = self._params[full]
+            for k, v in kwargs.items():
+                if v is None:
+                    continue
+                if k == "shape" and param.shape is not None:
+                    v = tuple(v) if not isinstance(v, int) else (v,)
+                    merged = tuple(a if a > 0 else b
+                                   for a, b in zip(param.shape, v)) \
+                        if len(param.shape) == len(v) else None
+                    if merged is None:
+                        raise MXNetError(
+                            f"shape mismatch for {full}: {param.shape} vs {v}")
+                    param.shape = merged
+            return param
+        if self._shared is not None and full in self._shared:
+            param = self._shared[full]
+        else:
+            param = Parameter(full, **kwargs)
+        self._params[full] = param
+        return param
+
+    def get_constant(self, name: str, value=None) -> Constant:
+        full = self._prefix + name
+        if full in self._params:
+            return self._params[full]
+        if value is None:
+            raise MXNetError(f"constant {full!r} not found and no value given")
+        c = Constant(full, value)
+        self._params[full] = c
+        return c
+
+    def update(self, other: "ParameterDict") -> None:
+        for k, v in other.items():
+            if k in self._params and self._params[k] is not v:
+                raise MXNetError(f"duplicate parameter name {k!r}")
+            self._params[k] = v
+
+    def initialize(self, init=None, ctx=None, verbose=False,
+                   force_reinit=False) -> None:
+        for p in self._params.values():
+            p.initialize(init=init, ctx=ctx, force_reinit=force_reinit)
+
+    def zero_grad(self) -> None:
+        for p in self._params.values():
+            p.zero_grad()
+
+    def reset_ctx(self, ctx) -> None:
+        for p in self._params.values():
+            p.reset_ctx(ctx)
+
+    def setattr(self, name, value) -> None:
+        for p in self._params.values():
+            setattr(p, name, value)
+
+    def save(self, fname: str, strip_prefix: str = "") -> None:
+        from ..ndarray import utils as nd_utils
+        arg = {}
+        for name, p in self._params.items():
+            if strip_prefix and name.startswith(strip_prefix):
+                name = name[len(strip_prefix):]
+            arg[name] = p.data()
+        nd_utils.save(fname, arg)
+
+    def load(self, fname: str, ctx=None, allow_missing: bool = False,
+             ignore_extra: bool = False, restore_prefix: str = "") -> None:
+        from ..ndarray import utils as nd_utils
+        loaded = nd_utils.load(fname)
+        loaded = {restore_prefix + k: v for k, v in loaded.items()}
+        if not allow_missing:
+            for name in self._params:
+                if name not in loaded:
+                    raise MXNetError(f"parameter {name!r} missing from {fname}")
+        for name, val in loaded.items():
+            if name not in self._params:
+                if ignore_extra:
+                    continue
+                raise MXNetError(f"{fname} has unknown parameter {name!r}")
+            self._params[name].set_data(val)

@@ -1,0 +1,297 @@
+"""Explicit recurrent cells + unrolling.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_cell.py — per-step cells with
+``__call__(x_t, states)`` and ``unroll``; Sequential/Dropout/Residual/
+Bidirectional wrappers.  The fused layers (rnn_layer.py) are the fast path;
+cells exist for custom recurrences and bucketing-era code.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RecurrentCell", "RNNCell", "LSTMCell", "GRUCell",
+           "SequentialRNNCell", "DropoutCell", "ResidualCell",
+           "BidirectionalCell"]
+
+
+class RecurrentCell(HybridBlock):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._modified = False
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+        states = []
+        for info in self.state_info(batch_size):
+            shape = info["shape"]
+            states.append(nd.zeros(shape, ctx=ctx))
+        return states
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        """Static unroll over `length` steps (reference semantics)."""
+        from ... import ndarray as nd
+        axis = layout.find("T")
+        batch_axis = layout.find("N")
+        if isinstance(inputs, (list, tuple)):
+            seq = list(inputs)
+            batch = seq[0].shape[0]
+        else:
+            batch = inputs.shape[batch_axis]
+            seq = [x.squeeze(axis=axis) for x in
+                   nd.split(inputs, num_outputs=length, axis=axis)] \
+                if length > 1 else [inputs.squeeze(axis=axis)]
+        states = begin_state if begin_state is not None else \
+            self.begin_state(batch, ctx=seq[0].context)
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs or merge_outputs is None:
+            outputs = nd.stack(*outputs, axis=axis)
+        if valid_length is not None:
+            outputs = nd.SequenceMask(outputs, valid_length,
+                                      use_sequence_length=True,
+                                      axis=axis, value=0.0)
+        return outputs, states
+
+    def forward(self, x, states):
+        return super().forward(x, states)
+
+
+class RNNCell(RecurrentCell):
+    def __init__(self, hidden_size, activation="tanh", input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        self._activation = activation
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        h = states[0]
+        i2h = F.FullyConnected(x, i2h_weight, i2h_bias,
+                               num_hidden=self._hidden_size)
+        h2h = F.FullyConnected(h, h2h_weight, h2h_bias,
+                               num_hidden=self._hidden_size)
+        out = F.Activation(i2h + h2h, act_type=self._activation)
+        return out, [out]
+
+
+class LSTMCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(4 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(4 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(4 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(4 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"},
+                {"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (4 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        h, c = states
+        gates = F.FullyConnected(x, i2h_weight, i2h_bias,
+                                 num_hidden=4 * self._hidden_size) + \
+            F.FullyConnected(h, h2h_weight, h2h_bias,
+                             num_hidden=4 * self._hidden_size)
+        parts = F.split(gates, num_outputs=4, axis=1)
+        i = F.sigmoid(parts[0])
+        f = F.sigmoid(parts[1])
+        g = F.tanh(parts[2])
+        o = F.sigmoid(parts[3])
+        c_new = f * c + i * g
+        h_new = o * F.tanh(c_new)
+        return h_new, [h_new, c_new]
+
+
+class GRUCell(RecurrentCell):
+    def __init__(self, hidden_size, input_size=0,
+                 i2h_weight_initializer=None, h2h_weight_initializer=None,
+                 i2h_bias_initializer="zeros", h2h_bias_initializer="zeros",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._hidden_size = hidden_size
+        with self.name_scope():
+            self.i2h_weight = self.params.get(
+                "i2h_weight", shape=(3 * hidden_size, input_size),
+                init=i2h_weight_initializer, allow_deferred_init=True)
+            self.h2h_weight = self.params.get(
+                "h2h_weight", shape=(3 * hidden_size, hidden_size),
+                init=h2h_weight_initializer, allow_deferred_init=True)
+            self.i2h_bias = self.params.get(
+                "i2h_bias", shape=(3 * hidden_size,),
+                init=i2h_bias_initializer, allow_deferred_init=True)
+            self.h2h_bias = self.params.get(
+                "h2h_bias", shape=(3 * hidden_size,),
+                init=h2h_bias_initializer, allow_deferred_init=True)
+
+    def state_info(self, batch_size=0):
+        return [{"shape": (batch_size, self._hidden_size), "__layout__": "NC"}]
+
+    def infer_shape(self, x, *args):
+        self.i2h_weight.shape = (3 * self._hidden_size, x.shape[-1])
+
+    def hybrid_forward(self, F, x, states, i2h_weight, h2h_weight, i2h_bias,
+                       h2h_bias):
+        h = states[0]
+        gx = F.FullyConnected(x, i2h_weight, i2h_bias,
+                              num_hidden=3 * self._hidden_size)
+        gh = F.FullyConnected(h, h2h_weight, h2h_bias,
+                              num_hidden=3 * self._hidden_size)
+        xp = F.split(gx, num_outputs=3, axis=1)
+        hp = F.split(gh, num_outputs=3, axis=1)
+        r = F.sigmoid(xp[0] + hp[0])
+        z = F.sigmoid(xp[1] + hp[1])
+        n = F.tanh(xp[2] + r * hp[2])
+        out = (1 - z) * n + z * h
+        return out, [out]
+
+
+class SequentialRNNCell(RecurrentCell):
+    def __init__(self, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._cells = []
+
+    def add(self, cell):
+        self.register_child(cell)
+        self._cells.append(cell)
+
+    def state_info(self, batch_size=0):
+        infos = []
+        for c in self._cells:
+            infos.extend(c.state_info(batch_size))
+        return infos
+
+    def begin_state(self, batch_size=0, **kwargs):
+        states = []
+        for c in self._cells:
+            states.extend(c.begin_state(batch_size, **kwargs))
+        return states
+
+    def __call__(self, x, states):
+        next_states = []
+        pos = 0
+        for c in self._cells:
+            n = len(c.state_info())
+            x, s = c(x, states[pos:pos + n])
+            pos += n
+            next_states.extend(s)
+        return x, next_states
+
+    def forward(self, x, states):
+        return self.__call__(x, states)
+
+
+class DropoutCell(RecurrentCell):
+    def __init__(self, rate, axes=(), prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._rate = rate
+        self._axes = axes
+
+    def state_info(self, batch_size=0):
+        return []
+
+    def hybrid_forward(self, F, x, states):
+        if self._rate > 0:
+            x = F.Dropout(x, p=self._rate, axes=self._axes)
+        return x, states
+
+
+class ResidualCell(RecurrentCell):
+    def __init__(self, base_cell):
+        super().__init__()
+        self.base_cell = base_cell
+
+    def state_info(self, batch_size=0):
+        return self.base_cell.state_info(batch_size)
+
+    def begin_state(self, *a, **kw):
+        return self.base_cell.begin_state(*a, **kw)
+
+    def __call__(self, x, states):
+        out, states = self.base_cell(x, states)
+        return out + x, states
+
+    def forward(self, x, states):
+        return self.__call__(x, states)
+
+
+class BidirectionalCell(RecurrentCell):
+    def __init__(self, l_cell, r_cell):
+        super().__init__()
+        self.l_cell = l_cell
+        self.r_cell = r_cell
+
+    def state_info(self, batch_size=0):
+        return self.l_cell.state_info(batch_size) + \
+            self.r_cell.state_info(batch_size)
+
+    def begin_state(self, *a, **kw):
+        return self.l_cell.begin_state(*a, **kw) + \
+            self.r_cell.begin_state(*a, **kw)
+
+    def unroll(self, length, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None, valid_length=None):
+        from ... import ndarray as nd
+        nl = len(self.l_cell.state_info())
+        states = begin_state
+        l_states = states[:nl] if states else None
+        r_states = states[nl:] if states else None
+        l_out, l_states = self.l_cell.unroll(
+            length, inputs, l_states, layout, True, valid_length)
+        axis = layout.find("T")
+        if isinstance(inputs, (list, tuple)):
+            rev = list(reversed(inputs))
+        else:
+            rev = nd.reverse(inputs, axis=axis)
+        r_out, r_states = self.r_cell.unroll(
+            length, rev, r_states, layout, True, valid_length)
+        r_out = nd.reverse(r_out, axis=axis)
+        out = nd.concat(l_out, r_out, dim=2)
+        return out, l_states + r_states
+
+    def __call__(self, x, states):
+        raise MXNetError("BidirectionalCell supports unroll() only")

@@ -1,0 +1,136 @@
+"""Fused recurrent layers: RNN / LSTM / GRU.
+
+Reference parity: python/mxnet/gluon/rnn/rnn_layer.py over src/operator/rnn.cc
+(cuDNN-fused; SURVEY.md §2.2).  TPU-native: the fused op is a `lax.scan`
+whose per-step cell is a pair of MXU matmuls (see ops_nn.py RNN); parameter
+layout (per-layer i2h/h2h weight+bias, cuDNN packing order) matches the
+reference so checkpoints map 1:1.
+"""
+from __future__ import annotations
+
+from ...base import MXNetError
+from ..block import HybridBlock
+
+__all__ = ["RNN", "LSTM", "GRU"]
+
+_NGATES = {"rnn_relu": 1, "rnn_tanh": 1, "lstm": 4, "gru": 3}
+
+
+class _RNNLayer(HybridBlock):
+    def __init__(self, mode, hidden_size, num_layers, layout, dropout,
+                 bidirectional, input_size, i2h_weight_initializer=None,
+                 h2h_weight_initializer=None, i2h_bias_initializer="zeros",
+                 h2h_bias_initializer="zeros", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout not in ("TNC", "NTC"):
+            raise MXNetError(f"layout must be TNC or NTC, got {layout}")
+        self._mode = mode
+        self._hidden_size = hidden_size
+        self._num_layers = num_layers
+        self._layout = layout
+        self._dropout = dropout
+        self._dir = 2 if bidirectional else 1
+        self._input_size = input_size
+        self._gates = _NGATES[mode]
+        ng, ni, nh = self._gates, input_size, hidden_size
+        self._ordered_names = []
+        with self.name_scope():
+            for i in range(num_layers):
+                for j in (["l", "r"] if bidirectional else ["l"]):
+                    self._register_param(
+                        f"{j}{i}_i2h_weight", (ng * nh, ni),
+                        i2h_weight_initializer)
+                    self._register_param(
+                        f"{j}{i}_h2h_weight", (ng * nh, nh),
+                        h2h_weight_initializer)
+                    self._register_param(
+                        f"{j}{i}_i2h_bias", (ng * nh,),
+                        i2h_bias_initializer)
+                    self._register_param(
+                        f"{j}{i}_h2h_bias", (ng * nh,),
+                        h2h_bias_initializer)
+                ni = nh * self._dir
+
+    def _register_param(self, name, shape, init):
+        p = self.params.get(name, shape=shape, init=init,
+                            allow_deferred_init=True)
+        setattr(self, name, p)
+        self._ordered_names.append(name)
+
+    def infer_shape(self, x, *args):
+        ndim = x.ndim
+        if ndim != 3:
+            raise MXNetError(f"rnn input must be 3-d, got {ndim}-d")
+        isize = x.shape[2]
+        ng, nh = self._gates, self._hidden_size
+        ni = isize
+        for i in range(self._num_layers):
+            for j in (["l", "r"] if self._dir == 2 else ["l"]):
+                getattr(self, f"{j}{i}_i2h_weight").shape = (ng * nh, ni)
+            ni = nh * self._dir
+
+    def state_info(self, batch_size=0):
+        raise NotImplementedError
+
+    def begin_state(self, batch_size=0, func=None, ctx=None, **kwargs):
+        from ... import ndarray as nd
+        ctx = ctx
+        shape = (self._num_layers * self._dir, batch_size, self._hidden_size)
+        if self._mode == "lstm":
+            return [nd.zeros(shape, ctx=ctx), nd.zeros(shape, ctx=ctx)]
+        return [nd.zeros(shape, ctx=ctx)]
+
+    def hybrid_forward(self, F, inputs, states=None, **params):
+        if self._layout == "NTC":
+            inputs = F.swapaxes(inputs, dim1=0, dim2=1)
+        batch = inputs.shape[1]
+        skip_states = states is None
+        if skip_states:
+            states = self.begin_state(batch, ctx=getattr(inputs, "context",
+                                                         None))
+        if not isinstance(states, (list, tuple)):
+            states = [states]
+        flat = F.concat(*[params[n].reshape((-1,))
+                          for n in self._ordered_names], dim=0)
+        args = [inputs, flat, states[0]]
+        if self._mode == "lstm":
+            args.append(states[1])
+        outs = F.RNN(*args, state_size=self._hidden_size,
+                     num_layers=self._num_layers, mode=self._mode,
+                     bidirectional=self._dir == 2, p=self._dropout,
+                     state_outputs=True)
+        out = outs[0]
+        out_states = list(outs[1:])
+        if self._layout == "NTC":
+            out = F.swapaxes(out, dim1=0, dim2=1)
+        if skip_states:
+            return out
+        return out, out_states
+
+    def __call__(self, inputs, states=None):
+        return super().__call__(inputs, states) if states is not None \
+            else super().__call__(inputs)
+
+
+class RNN(_RNNLayer):
+    """Multi-layer Elman RNN (relu or tanh)."""
+
+    def __init__(self, hidden_size, num_layers=1, activation="relu",
+                 layout="TNC", dropout=0, bidirectional=False, input_size=0,
+                 **kwargs):
+        super().__init__(f"rnn_{activation}", hidden_size, num_layers,
+                         layout, dropout, bidirectional, input_size, **kwargs)
+
+
+class LSTM(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("lstm", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)
+
+
+class GRU(_RNNLayer):
+    def __init__(self, hidden_size, num_layers=1, layout="TNC", dropout=0,
+                 bidirectional=False, input_size=0, **kwargs):
+        super().__init__("gru", hidden_size, num_layers, layout, dropout,
+                         bidirectional, input_size, **kwargs)

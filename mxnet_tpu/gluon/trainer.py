@@ -1,0 +1,118 @@
+"""Gluon Trainer: applies an optimizer over Parameters with a KVStore seam.
+
+Reference parity: python/mxnet/gluon/trainer.py (SURVEY.md §2.5, §3.2) —
+step = allreduce_grads (kvstore push/pull) + update (fused optimizer op per
+param).  On a single chip the reduce is a no-op; across in-process devices it
+sums replica grads; on a real mesh the sharded path in mxnet_tpu.parallel
+(psum over ICI) replaces this loop, matching the north star
+(BASELINE.json: kvstore='device' → lax.psum).
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+from .. import kvstore as kv_mod
+from .parameter import Parameter, ParameterDict
+
+__all__ = ["Trainer"]
+
+
+class Trainer:
+    def __init__(self, params, optimizer, optimizer_params=None,
+                 kvstore="device", compression_params=None,
+                 update_on_kvstore=None):
+        if isinstance(params, (dict, ParameterDict)):
+            params = list(params.values())
+        if not isinstance(params, (list, tuple)):
+            raise MXNetError("params must be a ParameterDict or list")
+        self._all_params = list(params)
+        self._params: List[Parameter] = [
+            p for p in params if p.grad_req != "null"]
+        self._param2idx = {p.name: i for i, p in enumerate(self._params)}
+        optimizer_params = optimizer_params or {}
+        param_dict = {i: p for i, p in enumerate(self._params)}
+        self._optimizer = opt_mod.create(optimizer, param_dict=param_dict,
+                                         **optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._updaters: Dict = {}
+        self._kvstore = kv_mod.create(kvstore) if isinstance(kvstore, str) \
+            else kvstore
+        self._kv_initialized = False
+        self._states: Dict = {}
+
+    # -- properties --------------------------------------------------------
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._optimizer.set_learning_rate(lr)
+
+    # -- step --------------------------------------------------------------
+    def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        """Rescale by 1/batch_size, reduce grads across devices, update."""
+        self._optimizer.rescale_grad = self._scale / batch_size
+        self.allreduce_grads()
+        self.update(batch_size, ignore_stale_grad)
+
+    def allreduce_grads(self) -> None:
+        for i, param in enumerate(self._params):
+            grads = param.list_grad()
+            if len(grads) == 1:
+                continue
+            reduced = grads[0].copy()
+            for g in grads[1:]:
+                reduced += g.as_in_context(reduced.context)
+            for g in grads:
+                reduced.copyto(g)
+
+    def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
+        self._optimizer.rescale_grad = self._scale / batch_size
+        for i, param in enumerate(self._params):
+            for ctx, data in param._data.items():
+                key = (i, ctx)
+                if key not in self._states:
+                    self._states[key] = \
+                        self._optimizer.create_state_multi_precision(i, data)
+                self._optimizer.update_multi_precision(
+                    i, data, data.grad, self._states[key])
+                # reset write-mode gradient accumulation for the next batch
+                data._ag.fresh = True
+
+    def allreduce_and_update(self, batch_size):
+        self.step(batch_size)
+
+    # -- state persistence -------------------------------------------------
+    def save_states(self, fname: str) -> None:
+        import pickle
+        import numpy as _np
+        blob = {}
+        for (i, ctx), state in self._states.items():
+            blob[str(i)] = opt_mod._states_to_np(state)
+        with open(fname, "wb") as f:
+            pickle.dump({"states": blob,
+                         "num_update": self._optimizer.num_update,
+                         "index_update_count":
+                             dict(self._optimizer._index_update_count)}, f)
+
+    def load_states(self, fname: str) -> None:
+        import pickle
+        with open(fname, "rb") as f:
+            blob = pickle.load(f)
+        self._optimizer.num_update = blob.get("num_update", 0)
+        # restore per-index counts too, else Adam bias correction restarts
+        # at t=1 after resume
+        self._optimizer._index_update_count = dict(
+            blob.get("index_update_count", {}))
+        for i, param in enumerate(self._params):
+            if str(i) not in blob["states"]:
+                continue
+            for ctx in param._data:
+                self._states[(i, ctx)] = \
+                    opt_mod._states_from_np(blob["states"][str(i)])

@@ -1,0 +1,86 @@
+"""Gluon utilities: multi-device batch splitting, global-norm clipping.
+
+Reference parity: python/mxnet/gluon/utils.py (SURVEY.md §2.3 — the data-
+parallel entry point `split_and_load`).
+"""
+from __future__ import annotations
+
+from typing import List, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["split_data", "split_and_load", "clip_global_norm", "check_sha1",
+           "download"]
+
+
+def split_data(data: NDArray, num_slice: int, batch_axis: int = 0,
+               even_split: bool = True) -> List[NDArray]:
+    size = data.shape[batch_axis]
+    if even_split and size % num_slice != 0:
+        raise MXNetError(
+            f"data with shape {data.shape} cannot be evenly split into "
+            f"{num_slice} slices along axis {batch_axis}; set "
+            f"even_split=False")
+    step = size // num_slice
+    slices = []
+    for i in range(num_slice):
+        begin = i * step
+        end = (i + 1) * step if i < num_slice - 1 else size
+        idx = [slice(None)] * data.ndim
+        idx[batch_axis] = slice(begin, end)
+        slices.append(data[tuple(idx)])
+    return slices
+
+
+def split_and_load(data, ctx_list: Sequence[Context], batch_axis: int = 0,
+                   even_split: bool = True) -> List[NDArray]:
+    """Split a batch along batch_axis and load each slice onto one context —
+    the single-process data-parallel front door (reference §2.3)."""
+    if not isinstance(data, NDArray):
+        data = nd_array(data, ctx=ctx_list[0])
+    if len(ctx_list) == 1:
+        return [data.as_in_context(ctx_list[0])]
+    slices = split_data(data, len(ctx_list), batch_axis, even_split)
+    return [s.as_in_context(ctx) for s, ctx in zip(slices, ctx_list)]
+
+
+def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
+                     check_isfinite: bool = True) -> float:
+    """Rescale arrays in place so the joint L2 norm is at most max_norm."""
+    if not arrays:
+        raise MXNetError("no arrays to clip")
+    total = 0.0
+    for a in arrays:
+        n = float((a * a).sum().asnumpy())
+        total += n
+    total = float(_np.sqrt(total))
+    if check_isfinite and not _np.isfinite(total):
+        import warnings
+        warnings.warn("nan or inf in clip_global_norm")
+    scale = max_norm / (total + 1e-8)
+    if scale < 1.0:
+        for a in arrays:
+            a *= scale
+    return total
+
+
+def check_sha1(filename: str, sha1_hash: str) -> bool:
+    import hashlib
+    sha1 = hashlib.sha1()
+    with open(filename, "rb") as f:
+        while True:
+            chunk = f.read(1 << 20)
+            if not chunk:
+                break
+            sha1.update(chunk)
+    return sha1.hexdigest() == sha1_hash
+
+
+def download(url, path=None, overwrite=False, sha1_hash=None,
+             retries=5, verify_ssl=True):
+    raise MXNetError("this environment has no network egress; place files "
+                     "locally and load them directly")

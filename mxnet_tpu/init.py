@@ -1,0 +1,3 @@
+"""``mx.init`` alias for the initializer module (reference parity)."""
+from .initializer import *  # noqa: F401,F403
+from .initializer import create, register  # noqa: F401

@@ -1,0 +1,261 @@
+"""Weight initializers.
+
+Reference parity: python/mxnet/initializer.py — the registry (`mx.init.*`),
+Xavier/MSRAPrelu magnitude conventions, pattern-based dispatch by parameter
+name (arrays named ``*_bias`` get zeros, etc.) as used by ParameterDict.
+Draws come from the global key stream in mxnet_tpu.random.
+"""
+from __future__ import annotations
+
+import math
+import re
+from typing import Optional
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
+           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
+           "Mixed", "register", "create"]
+
+_registry = {}
+
+
+def register(klass):
+    _registry[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(init, **kwargs) -> "Initializer":
+    if init is None:
+        return Uniform(0.07)
+    if isinstance(init, Initializer):
+        return init
+    if isinstance(init, str):
+        name = init.lower()
+        if name not in _registry:
+            raise MXNetError(f"unknown initializer {init!r}")
+        return _registry[name](**kwargs)
+    raise MXNetError(f"cannot create initializer from {init!r}")
+
+
+class Initializer:
+    """Base initializer; dispatches by parameter name like the reference
+    (``_weight``/``_bias``/``_gamma``/``_beta``/``_mean``/``_var``)."""
+
+    def __init__(self, **kwargs):
+        self._kwargs = kwargs
+
+    def __call__(self, name, arr):
+        self.init_weight_by_name(name, arr)
+
+    def init_weight(self, name, arr):
+        """Direct application, bypassing name-suffix dispatch — used when a
+        parameter carries an explicit initializer (reference: InitDesc with
+        attrs['__init__'] skips the pattern rules)."""
+        try:
+            self._init_weight(name, arr)
+        except NotImplementedError:
+            self(name, arr)
+
+    def init_weight_by_name(self, name: str, arr) -> None:
+        name = name.lower()
+        if name.endswith("bias"):
+            self._init_zero(arr)
+        elif name.endswith("gamma"):
+            self._init_one(arr)
+        elif name.endswith("beta"):
+            self._init_zero(arr)
+        elif name.endswith("running_mean") or name.endswith("moving_mean"):
+            self._init_zero(arr)
+        elif name.endswith("running_var") or name.endswith("moving_var"):
+            self._init_one(arr)
+        else:
+            self._init_weight(name, arr)
+
+    # -- primitive fills ---------------------------------------------------
+    def _init_zero(self, arr):
+        arr[:] = _np.zeros(arr.shape, dtype=_np.float32)
+
+    def _init_one(self, arr):
+        arr[:] = _np.ones(arr.shape, dtype=_np.float32)
+
+    def _init_weight(self, name, arr):
+        raise NotImplementedError
+
+    def __repr__(self):
+        return f"{type(self).__name__}({self._kwargs})"
+
+
+def _rand_uniform(shape, scale):
+    from . import random as _grandom
+    import jax.random as jr
+    return jr.uniform(_grandom.next_key(), shape, _np.float32,
+                      -scale, scale)
+
+
+def _rand_normal(shape, sigma):
+    from . import random as _grandom
+    import jax.random as jr
+    return jr.normal(_grandom.next_key(), shape, _np.float32) * sigma
+
+
+@register
+class Uniform(Initializer):
+    def __init__(self, scale=0.07):
+        super().__init__(scale=scale)
+        self.scale = scale
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.asarray(_rand_uniform(arr.shape, self.scale))
+
+
+@register
+class Normal(Initializer):
+    def __init__(self, sigma=0.01):
+        super().__init__(sigma=sigma)
+        self.sigma = sigma
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.asarray(_rand_normal(arr.shape, self.sigma))
+
+
+@register
+class Zero(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_zero(arr)
+
+
+register(Zero)
+_registry["zeros"] = Zero
+
+
+@register
+class One(Initializer):
+    def _init_weight(self, name, arr):
+        self._init_one(arr)
+
+
+_registry["ones"] = One
+
+
+@register
+class Constant(Initializer):
+    def __init__(self, value=0.0):
+        super().__init__(value=value)
+        self.value = value
+
+    def _init_weight(self, name, arr):
+        arr[:] = _np.full(arr.shape, self.value, dtype=_np.float32)
+
+
+def _fan(shape):
+    """(fan_in, fan_out) with conv receptive-field scaling, as the
+    reference's Xavier computes them."""
+    hw = 1
+    for s in shape[2:]:
+        hw *= s
+    fan_out = shape[0] * hw
+    fan_in = (shape[1] if len(shape) > 1 else shape[0]) * hw
+    return fan_in, fan_out
+
+
+@register
+class Xavier(Initializer):
+    def __init__(self, rnd_type="uniform", factor_type="avg", magnitude=3):
+        super().__init__(rnd_type=rnd_type, factor_type=factor_type,
+                         magnitude=magnitude)
+        self.rnd_type = rnd_type
+        self.factor_type = factor_type
+        self.magnitude = float(magnitude)
+
+    def _init_weight(self, name, arr):
+        fan_in, fan_out = _fan(arr.shape)
+        if self.factor_type == "avg":
+            factor = (fan_in + fan_out) / 2.0
+        elif self.factor_type == "in":
+            factor = fan_in
+        elif self.factor_type == "out":
+            factor = fan_out
+        else:
+            raise MXNetError(f"bad factor_type {self.factor_type}")
+        scale = math.sqrt(self.magnitude / max(factor, 1.0))
+        if self.rnd_type == "uniform":
+            arr[:] = _np.asarray(_rand_uniform(arr.shape, scale))
+        else:
+            arr[:] = _np.asarray(_rand_normal(arr.shape, scale))
+
+
+@register
+class MSRAPrelu(Xavier):
+    def __init__(self, factor_type="avg", slope=0.25):
+        magnitude = 2.0 / (1 + slope ** 2)
+        super().__init__("gaussian", factor_type, magnitude)
+        self._kwargs = {"factor_type": factor_type, "slope": slope}
+
+
+@register
+class Orthogonal(Initializer):
+    def __init__(self, scale=1.414, rand_type="uniform"):
+        super().__init__(scale=scale, rand_type=rand_type)
+        self.scale = scale
+        self.rand_type = rand_type
+
+    def _init_weight(self, name, arr):
+        nout = arr.shape[0]
+        nin = int(_np.prod(arr.shape[1:]))
+        if self.rand_type == "uniform":
+            tmp = _np.random.uniform(-1.0, 1.0, (nout, nin))
+        else:
+            tmp = _np.random.normal(0.0, 1.0, (nout, nin))
+        u, _, v = _np.linalg.svd(tmp, full_matrices=False)
+        q = u if u.shape == tmp.shape else v
+        arr[:] = (self.scale * q).reshape(arr.shape).astype(_np.float32)
+
+
+@register
+class Bilinear(Initializer):
+    """Bilinear upsampling kernel (reference: used by UpSampling deconv)."""
+
+    def _init_weight(self, name, arr):
+        weight = _np.zeros(arr.shape, dtype=_np.float32)
+        shape = arr.shape
+        f = _np.ceil(shape[3] / 2.0)
+        c = (2 * f - 1 - f % 2) / (2.0 * f)
+        for i in range(int(_np.prod(shape))):
+            x = i % shape[3]
+            y = (i // shape[3]) % shape[2]
+            weight.flat[i] = (1 - abs(x / f - c)) * (1 - abs(y / f - c))
+        arr[:] = weight
+
+
+@register
+class LSTMBias(Initializer):
+    """Forget-gate bias = forget_bias, others 0 (reference convention)."""
+
+    def __init__(self, forget_bias=1.0):
+        super().__init__(forget_bias=forget_bias)
+        self.forget_bias = forget_bias
+
+    def _init_weight(self, name, arr):
+        b = _np.zeros(arr.shape, dtype=_np.float32)
+        n = b.shape[0] // 4
+        b[n:2 * n] = self.forget_bias
+        arr[:] = b
+
+
+class Mixed:
+    """Pattern→initializer dispatch (reference: mx.init.Mixed)."""
+
+    def __init__(self, patterns, initializers):
+        if len(patterns) != len(initializers):
+            raise MXNetError("patterns and initializers must pair up")
+        self.map = [(re.compile(p), i) for p, i in zip(patterns, initializers)]
+
+    def __call__(self, name, arr):
+        for prog, init in self.map:
+            if prog.match(name):
+                init(name, arr)
+                return
+        raise MXNetError(f"no initializer pattern matches {name!r}")

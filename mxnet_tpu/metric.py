@@ -1,0 +1,318 @@
+"""Evaluation metrics.
+
+Reference parity: python/mxnet/metric.py (SURVEY.md §2.5) — EvalMetric base
+(update/get/reset, name-value pairs), Accuracy, TopKAccuracy, F1, MAE/MSE/
+RMSE, CrossEntropy, Perplexity, Composite, custom via ``mx.metric.create``.
+``.get()`` syncs device values to host exactly like the reference.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from .base import MXNetError
+
+__all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
+           "RMSE", "CrossEntropy", "Perplexity", "Loss",
+           "CompositeEvalMetric", "CustomMetric", "create", "np"]
+
+_registry: Dict[str, type] = {}
+
+
+def register(klass):
+    _registry[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(metric, *args, **kwargs) -> "EvalMetric":
+    if isinstance(metric, EvalMetric):
+        return metric
+    if callable(metric):
+        return CustomMetric(metric, *args, **kwargs)
+    if isinstance(metric, (list, tuple)):
+        composite = CompositeEvalMetric()
+        for m in metric:
+            composite.add(create(m, *args, **kwargs))
+        return composite
+    name = str(metric).lower()
+    aliases = {"acc": "accuracy", "ce": "crossentropy",
+               "top_k_accuracy": "topkaccuracy",
+               "top_k_acc": "topkaccuracy"}
+    name = aliases.get(name, name)
+    if name not in _registry:
+        raise MXNetError(f"unknown metric {metric!r}")
+    return _registry[name](*args, **kwargs)
+
+
+def _to_np(x) -> _np.ndarray:
+    if hasattr(x, "asnumpy"):
+        return x.asnumpy()
+    return _np.asarray(x)
+
+
+def _as_list(x):
+    return list(x) if isinstance(x, (list, tuple)) else [x]
+
+
+class EvalMetric:
+    def __init__(self, name, output_names=None, label_names=None, **kwargs):
+        self.name = str(name)
+        self.output_names = output_names
+        self.label_names = label_names
+        self._kwargs = kwargs
+        self.reset()
+
+    def reset(self) -> None:
+        self.num_inst = 0
+        self.sum_metric = 0.0
+
+    def update(self, labels, preds) -> None:
+        raise NotImplementedError
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, self.sum_metric / self.num_inst)
+
+    def get_name_value(self):
+        name, value = self.get()
+        if not isinstance(name, list):
+            name = [name]
+        if not isinstance(value, list):
+            value = [value]
+        return list(zip(name, value))
+
+    def __str__(self):
+        return f"EvalMetric: {dict(self.get_name_value())}"
+
+
+@register
+class Accuracy(EvalMetric):
+    def __init__(self, axis=1, name="accuracy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.axis = axis
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            if pred.ndim > label.ndim:
+                pred = pred.argmax(axis=self.axis)
+            pred = pred.astype(_np.int32).flatten()
+            label = label.astype(_np.int32).flatten()
+            if pred.shape != label.shape:
+                raise MXNetError(f"shape mismatch {pred.shape} vs "
+                                 f"{label.shape}")
+            self.sum_metric += float((pred == label).sum())
+            self.num_inst += len(pred)
+
+
+@register
+class TopKAccuracy(EvalMetric):
+    def __init__(self, top_k=1, name="top_k_accuracy", **kwargs):
+        super().__init__(f"{name}_{top_k}", **kwargs)
+        self.top_k = top_k
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).astype(_np.int32)
+            pred = _to_np(pred)
+            topk = _np.argsort(-pred, axis=-1)[..., :self.top_k]
+            hit = (topk == label.reshape(label.shape + (1,))).any(axis=-1)
+            self.sum_metric += float(hit.sum())
+            self.num_inst += hit.size
+
+
+@register
+class F1(EvalMetric):
+    """Binary F1.  average='macro' means the mean of per-update F1 scores
+    (reference semantics); 'micro' pools global tp/fp/fn counts."""
+
+    def __init__(self, name="f1", average="macro", **kwargs):
+        super().__init__(name, **kwargs)
+        self.average = average
+        self.reset()
+
+    def reset(self):
+        super().reset()
+        self._tp = self._fp = self._fn = 0.0
+
+    @staticmethod
+    def _f1(tp, fp, fn):
+        prec = tp / max(tp + fp, 1e-12)
+        rec = tp / max(tp + fn, 1e-12)
+        return 2 * prec * rec / max(prec + rec, 1e-12)
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).astype(_np.int32).flatten()
+            pred = _to_np(pred)
+            if pred.ndim > 1:
+                pred = pred.argmax(axis=-1)
+            pred = pred.astype(_np.int32).flatten()
+            tp = float(((pred == 1) & (label == 1)).sum())
+            fp = float(((pred == 1) & (label == 0)).sum())
+            fn = float(((pred == 0) & (label == 1)).sum())
+            if self.average == "macro":
+                self.sum_metric += self._f1(tp, fp, fn)
+                self.num_inst += 1
+            else:
+                self._tp += tp
+                self._fp += fp
+                self._fn += fn
+                self.num_inst += 1
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        if self.average == "macro":
+            return (self.name, self.sum_metric / self.num_inst)
+        return (self.name, self._f1(self._tp, self._fp, self._fn))
+
+
+@register
+class MAE(EvalMetric):
+    def __init__(self, name="mae", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            self.sum_metric += float(_np.abs(label - pred).mean())
+            self.num_inst += 1
+
+
+@register
+class MSE(EvalMetric):
+    def __init__(self, name="mse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            self.sum_metric += float(((label - pred) ** 2).mean())
+            self.num_inst += 1
+
+
+@register
+class RMSE(EvalMetric):
+    def __init__(self, name="rmse", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label)
+            pred = _to_np(pred)
+            self.sum_metric += float(_np.sqrt(((label - pred) ** 2).mean()))
+            self.num_inst += 1
+
+
+@register
+class CrossEntropy(EvalMetric):
+    def __init__(self, eps=1e-12, name="cross-entropy", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).astype(_np.int32).flatten()
+            pred = _to_np(pred)
+            prob = pred[_np.arange(label.shape[0]), label]
+            self.sum_metric += float((-_np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class Perplexity(EvalMetric):
+    def __init__(self, ignore_label=None, axis=-1, name="perplexity",
+                 **kwargs):
+        super().__init__(name, **kwargs)
+        self.ignore_label = ignore_label
+        self.axis = axis
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).astype(_np.int32).flatten()
+            pred = _to_np(pred).reshape(-1, _to_np(pred).shape[-1])
+            prob = pred[_np.arange(label.shape[0]), label]
+            if self.ignore_label is not None:
+                ignore = label == self.ignore_label
+                prob = _np.where(ignore, 1.0, prob)
+                num = (~ignore).sum()
+            else:
+                num = label.shape[0]
+            self.sum_metric += float(-_np.log(_np.maximum(prob, 1e-12)).sum())
+            self.num_inst += int(num)
+
+    def get(self):
+        if self.num_inst == 0:
+            return (self.name, float("nan"))
+        return (self.name, math.exp(self.sum_metric / self.num_inst))
+
+
+@register
+class Loss(EvalMetric):
+    def __init__(self, name="loss", **kwargs):
+        super().__init__(name, **kwargs)
+
+    def update(self, _, preds) -> None:
+        for pred in _as_list(preds):
+            loss = _to_np(pred)
+            self.sum_metric += float(loss.sum())
+            self.num_inst += loss.size
+
+
+class CompositeEvalMetric(EvalMetric):
+    def __init__(self, metrics=None, name="composite", **kwargs):
+        super().__init__(name, **kwargs)
+        self.metrics = [create(m) for m in (metrics or [])]
+
+    def add(self, metric) -> None:
+        self.metrics.append(create(metric))
+
+    def update(self, labels, preds) -> None:
+        for m in self.metrics:
+            m.update(labels, preds)
+
+    def reset(self) -> None:
+        for m in getattr(self, "metrics", []):
+            m.reset()
+
+    def get(self):
+        names, values = [], []
+        for m in self.metrics:
+            n, v = m.get()
+            names.append(n)
+            values.append(v)
+        return (names, values)
+
+
+class CustomMetric(EvalMetric):
+    def __init__(self, feval, name="custom", allow_extra_outputs=False,
+                 **kwargs):
+        super().__init__(f"custom({name})", **kwargs)
+        self._feval = feval
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            v = self._feval(_to_np(label), _to_np(pred))
+            if isinstance(v, tuple):
+                s, n = v
+                self.sum_metric += s
+                self.num_inst += n
+            else:
+                self.sum_metric += v
+                self.num_inst += 1
+
+
+def np(numpy_feval, name="custom", allow_extra_outputs=False):
+    """Wrap a numpy feval into a metric (reference: mx.metric.np)."""
+    def feval(label, pred):
+        return numpy_feval(label, pred)
+    feval.__name__ = getattr(numpy_feval, "__name__", name)
+    return CustomMetric(feval, name=feval.__name__,
+                        allow_extra_outputs=allow_extra_outputs)

@@ -129,12 +129,15 @@ def _register():
             strides = (1, 1) + s
             padcfg = [(0, 0), (0, 0)] + pads
             if pool_type == "max":
+                # init must be a STATIC scalar: a traced init value defeats
+                # jax's reduce_window_max autodiff pattern-match
                 init = -jnp.inf if jnp.issubdtype(x.dtype, jnp.floating) \
-                    else jnp.iinfo(x.dtype).min
-                return lax.reduce_window(x, jnp.asarray(init, x.dtype),
-                                         lax.max, window, strides, padcfg)
-            ssum = lax.reduce_window(x, jnp.asarray(0, x.dtype), lax.add,
-                                     window, strides, padcfg)
+                    else int(jnp.iinfo(x.dtype).min)
+                return lax.reduce_window(x, init, lax.max, window, strides,
+                                         padcfg)
+            zero = 0.0 if jnp.issubdtype(x.dtype, jnp.floating) else 0
+            ssum = lax.reduce_window(x, zero, lax.add, window, strides,
+                                     padcfg)
             if pool_type == "sum":
                 return ssum
             if pool_type == "avg":
@@ -144,8 +147,8 @@ def _register():
                         denom *= ki
                     return ssum / jnp.asarray(denom, x.dtype)
                 ones = jnp.ones(x.shape, x.dtype)
-                cnt = lax.reduce_window(ones, jnp.asarray(0, x.dtype),
-                                        lax.add, window, strides, padcfg)
+                cnt = lax.reduce_window(ones, zero, lax.add, window,
+                                        strides, padcfg)
                 return ssum / cnt
             if pool_type == "lp":
                 pw = lax.reduce_window(jnp.abs(x) ** p_value,

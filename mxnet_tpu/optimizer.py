@@ -1,0 +1,408 @@
+"""Optimizers.
+
+Reference parity: python/mxnet/optimizer/optimizer.py (SURVEY.md §2.5) —
+registry (`mx.optimizer.create``), SGD with momentum + multi_precision
+(fp32 master weights), Adam/NAG/RMSProp/AdaGrad/Ftrl/Signum, per-param
+lr_mult/wd_mult, lr scheduling, and the ``Updater`` wrapper the KVStore uses
+server-side.  Each update step executes as one fused XLA computation via the
+registered ``*_update`` ops; the learning rate is a runtime input so
+schedules never recompile.
+"""
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional
+
+import numpy as _np
+
+from .base import MXNetError
+from .ndarray import NDArray, zeros as nd_zeros, array as nd_array
+from .ndarray.register import invoke_by_name
+
+__all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp", "Ftrl",
+           "Signum", "AdaDelta", "register", "create", "Updater",
+           "get_updater"]
+
+_registry: Dict[str, type] = {}
+
+
+def register(klass):
+    _registry[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(name, **kwargs) -> "Optimizer":
+    if isinstance(name, Optimizer):
+        return name
+    if name.lower() not in _registry:
+        raise MXNetError(f"unknown optimizer {name!r}")
+    return _registry[name.lower()](**kwargs)
+
+
+class Optimizer:
+    """Base optimizer with per-index lr/wd multipliers and update counting."""
+
+    def __init__(self, rescale_grad=1.0, param_idx2name=None, wd=0.0,
+                 clip_gradient=None, learning_rate=0.01, lr_scheduler=None,
+                 multi_precision=False, param_dict=None, begin_num_update=0,
+                 **kwargs):
+        self.rescale_grad = rescale_grad
+        self.lr = learning_rate
+        self.lr_scheduler = lr_scheduler
+        if lr_scheduler is not None:
+            self.lr_scheduler.base_lr = learning_rate
+        self.wd = wd
+        self.clip_gradient = clip_gradient
+        self.multi_precision = multi_precision
+        self.param_idx2name = param_idx2name or {}
+        self.param_dict = param_dict or {}
+        self.num_update = begin_num_update
+        self.begin_num_update = begin_num_update
+        self._index_update_count: Dict[int, int] = {}
+        self.idx2name = self.param_idx2name
+        self.lr_mult: Dict[Any, float] = {}
+        self.wd_mult: Dict[Any, float] = {}
+
+    # -- bookkeeping -------------------------------------------------------
+    def _update_count(self, index) -> None:
+        cnt = self._index_update_count.get(index, self.begin_num_update)
+        self._index_update_count[index] = cnt + 1
+        self.num_update = max(self.num_update, self._index_update_count[index])
+
+    def set_learning_rate(self, lr: float) -> None:
+        if self.lr_scheduler is not None:
+            raise MXNetError("cannot set lr directly when lr_scheduler is set")
+        self.lr = lr
+
+    @property
+    def learning_rate(self) -> float:
+        if self.lr_scheduler is not None:
+            return self.lr_scheduler(self.num_update)
+        return self.lr
+
+    def _get_lr(self, index) -> float:
+        lr = self.learning_rate
+        param = self.param_dict.get(index)
+        if param is not None:
+            lr *= param.lr_mult
+        elif index in self.lr_mult:
+            lr *= self.lr_mult[index]
+        elif index in self.param_idx2name:
+            lr *= self.lr_mult.get(self.param_idx2name[index], 1.0)
+        return lr
+
+    def _get_wd(self, index) -> float:
+        wd = self.wd
+        param = self.param_dict.get(index)
+        if param is not None:
+            wd *= param.wd_mult
+        elif index in self.wd_mult:
+            wd *= self.wd_mult[index]
+        elif index in self.param_idx2name:
+            wd *= self.wd_mult.get(self.param_idx2name[index], 1.0)
+        return wd
+
+    def set_lr_mult(self, args_lr_mult: Dict) -> None:
+        self.lr_mult = dict(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult: Dict) -> None:
+        self.wd_mult = dict(args_wd_mult)
+
+    # -- interface ---------------------------------------------------------
+    def create_state(self, index, weight):
+        return None
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and weight.dtype == _np.float16:
+            w32 = weight.astype("float32")
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def update(self, index, weight, grad, state):
+        raise NotImplementedError
+
+    def update_multi_precision(self, index, weight, grad, state):
+        """Generic multi-precision path: run the update on the fp32 master
+        weight, then downcast into the live weight (optimizers with a fused
+        mp kernel, like SGD, override this)."""
+        if self.multi_precision and isinstance(state, tuple) and \
+                len(state) == 2 and isinstance(state[1], NDArray) and \
+                state[1].dtype == _np.float32 and \
+                weight.dtype != _np.float32:
+            inner, w32 = state
+            self.update(index, w32, grad.astype("float32"), inner)
+            weight._set_data(w32._read().astype(weight.dtype))
+        else:
+            self.update(index, weight, grad, state)
+
+    def _common_kwargs(self, index) -> Dict[str, Any]:
+        kw = {"wd": self._get_wd(index), "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def _lr_nd(self, index, weight, scale: float = 1.0) -> NDArray:
+        # must live on the weight's device: mixed-device jit inputs are an
+        # error on real TPU (CPU test meshes mask this)
+        return nd_array(_np.float32(self._get_lr(index) * scale),
+                        ctx=weight.context)
+
+
+@register
+class SGD(Optimizer):
+    """SGD with momentum and multi-precision master weights."""
+
+    def __init__(self, momentum=0.0, lazy_update=True, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.lazy_update = lazy_update
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        lr = self._lr_nd(index, weight)
+        if self.momentum == 0.0:
+            invoke_by_name("sgd_update", [weight, grad, lr], kw, out=weight)
+        else:
+            kw["momentum"] = self.momentum
+            invoke_by_name("sgd_mom_update", [weight, grad, state, lr], kw,
+                           out=[weight, state])
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if self.multi_precision and isinstance(state, tuple) and \
+                len(state) == 2 and isinstance(state[1], NDArray):
+            mom, w32 = state
+            self._update_count(index)
+            kw = self._common_kwargs(index)
+            kw["momentum"] = self.momentum
+            if mom is None:
+                mom = nd_zeros(w32.shape, ctx=w32.context, dtype=w32.dtype)
+            lr = self._lr_nd(index, weight)
+            invoke_by_name("mp_sgd_mom_update",
+                           [weight, grad, mom, w32, lr], kw,
+                           out=[weight, mom, w32])
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class NAG(Optimizer):
+    def __init__(self, momentum=0.0, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw["momentum"] = self.momentum
+        lr = self._lr_nd(index, weight)
+        if state is None:
+            invoke_by_name("sgd_update", [weight, grad, lr],
+                           self._common_kwargs(index), out=weight)
+        else:
+            invoke_by_name("nag_mom_update", [weight, grad, state, lr], kw,
+                           out=[weight, state])
+
+
+@register
+class Adam(Optimizer):
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, lazy_update=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1 = beta1
+        self.beta2 = beta2
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        coef1 = 1.0 - self.beta1 ** t
+        coef2 = 1.0 - self.beta2 ** t
+        lr_t = self._get_lr(index) * math.sqrt(coef2) / coef1
+        mean, var = state
+        kw = self._common_kwargs(index)
+        kw.update(beta1=self.beta1, beta2=self.beta2, epsilon=self.epsilon)
+        lr = nd_array(_np.float32(lr_t), ctx=weight.context)
+        invoke_by_name("adam_update", [weight, grad, mean, var, lr], kw,
+                       out=[weight, mean, var])
+
+
+@register
+class AdaGrad(Optimizer):
+    def __init__(self, eps=1e-7, **kwargs):
+        super().__init__(**kwargs)
+        self.float_stable_eps = eps
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw["epsilon"] = self.float_stable_eps
+        lr = self._lr_nd(index, weight)
+        invoke_by_name("adagrad_update", [weight, grad, state, lr], kw,
+                       out=[weight, state])
+
+
+@register
+class RMSProp(Optimizer):
+    def __init__(self, learning_rate=0.001, gamma1=0.9, gamma2=0.9,
+                 epsilon=1e-8, centered=False, clip_weights=None, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.gamma1 = gamma1
+        self.gamma2 = gamma2
+        self.epsilon = epsilon
+        self.centered = centered
+        self.clip_weights = clip_weights
+
+    def create_state(self, index, weight):
+        return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        kw.update(gamma1=self.gamma1, epsilon=self.epsilon)
+        if self.clip_weights is not None:
+            kw["clip_weights"] = self.clip_weights
+        lr = self._lr_nd(index, weight)
+        invoke_by_name("rmsprop_update", [weight, grad, state, lr], kw,
+                       out=[weight, state])
+
+
+@register
+class Ftrl(Optimizer):
+    def __init__(self, lamda1=0.01, learning_rate=0.1, beta=1, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.lamda1 = lamda1
+        self.beta = beta
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        z, n = state
+        kw = self._common_kwargs(index)
+        kw.update(lamda1=self.lamda1, beta=self.beta)
+        lr = self._lr_nd(index, weight)
+        invoke_by_name("ftrl_update", [weight, grad, z, n, lr], kw,
+                       out=[weight, z, n])
+
+
+@register
+class Signum(Optimizer):
+    def __init__(self, learning_rate=0.01, momentum=0.9, wd_lh=0.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.wd_lh = wd_lh
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        lr = self._lr_nd(index, weight)
+        if state is None:
+            invoke_by_name("signsgd_update", [weight, grad, lr], kw,
+                           out=weight)
+        else:
+            kw.update(momentum=self.momentum, wd_lh=self.wd_lh)
+            invoke_by_name("signum_update", [weight, grad, state, lr], kw,
+                           out=[weight, state])
+
+
+@register
+class AdaDelta(Optimizer):
+    def __init__(self, rho=0.9, epsilon=1e-5, **kwargs):
+        super().__init__(**kwargs)
+        self.rho = rho
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
+                nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        # composed from primitive ops (no fused kernel in the reference either)
+        acc_g, acc_d = state
+        wd = self._get_wd(index)
+        g = grad * self.rescale_grad
+        if self.clip_gradient is not None:
+            from .ndarray import clip as nd_clip
+            g = nd_clip(g, a_min=-self.clip_gradient,
+                        a_max=self.clip_gradient)
+        from .ndarray import sqrt as nd_sqrt
+        acc_g_new = self.rho * acc_g + (1 - self.rho) * g * g
+        delta = nd_sqrt(acc_d + self.epsilon) / \
+            nd_sqrt(acc_g_new + self.epsilon) * g
+        acc_d_new = self.rho * acc_d + (1 - self.rho) * delta * delta
+        acc_g._set_data(acc_g_new._read())
+        acc_d._set_data(acc_d_new._read())
+        weight._set_data((weight - delta - wd * weight)._read())
+
+
+class Updater:
+    """Callable wrapper used by KVStore to run the optimizer server-side
+    (reference: mx.optimizer.get_updater)."""
+
+    def __init__(self, optimizer: Optimizer):
+        self.optimizer = optimizer
+        self.states: Dict[Any, Any] = {}
+        self.states_synced: Dict[Any, bool] = {}
+
+    def __call__(self, index, grad, weight):
+        if index not in self.states:
+            self.states[index] = \
+                self.optimizer.create_state_multi_precision(index, weight)
+        self.optimizer.update_multi_precision(index, weight, grad,
+                                              self.states[index])
+
+    def get_states(self, dump_optimizer=False):
+        import pickle
+        return pickle.dumps({k: _states_to_np(v)
+                             for k, v in self.states.items()})
+
+    def set_states(self, states) -> None:
+        import pickle
+        loaded = pickle.loads(states)
+        self.states = {k: _states_from_np(v) for k, v in loaded.items()}
+
+
+def _states_to_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_states_to_np(s) for s in state)
+    return state.asnumpy()
+
+
+def _states_from_np(state):
+    if state is None:
+        return None
+    if isinstance(state, tuple):
+        return tuple(_states_from_np(s) for s in state)
+    return nd_array(state)
+
+
+def get_updater(optimizer: Optimizer) -> Updater:
+    return Updater(optimizer)

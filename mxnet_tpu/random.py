@@ -35,9 +35,29 @@ def seed(seed_state: Optional[int] = None, ctx="all") -> None:
         _key = _jrandom().PRNGKey(int(seed_state))
 
 
+_tls = threading.local()
+
+
+def push_key(key) -> None:
+    """Enter a scoped key stream (used by hybrid traces so RNG draws come
+    from a traced input instead of the global python-side stream)."""
+    stack = getattr(_tls, "stack", None)
+    if stack is None:
+        stack = _tls.stack = []
+    stack.append(key)
+
+
+def pop_key() -> None:
+    _tls.stack.pop()
+
+
 def next_key():
-    """Split a fresh subkey off the global stream."""
+    """Split a fresh subkey off the innermost active stream."""
     global _key
+    stack = getattr(_tls, "stack", None)
+    if stack:
+        stack[-1], sub = _jrandom().split(stack[-1])
+        return sub
     with _lock:
         if _key is None:
             _key = _jrandom().PRNGKey(0)

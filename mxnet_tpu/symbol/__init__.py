@@ -1,0 +1,19 @@
+"""``mx.sym``: lazy graph composition over the shared op registry.
+
+Reference role: NNVM Symbol + python/mxnet/symbol/ (SURVEY.md §2.1 L4, §2.5)
+— compose a DAG of op nodes, auto-creating variables for unbound tensor
+inputs; infer shapes; bind into an Executor; save/load JSON.
+
+TPU-native design: the Symbol is a plain-Python DAG whose *lowering* is a
+pure JAX function composed from the same makers that power `mx.nd.*` — so
+`simple_bind` is a `jax.jit` (the reference's GraphExecutor memory planning
+is XLA buffer assignment), and shape inference is `jax.eval_shape` (the
+reference's InferShape pass).  JSON layout mirrors the reference's
+(nodes/arg_nodes/heads) so exported graphs are inspectable the same way.
+"""
+import sys as _sys
+
+from .symbol import Symbol, var, Variable, Group, load, load_json
+from .register import _attach_frontends
+
+_attach_frontends(_sys.modules[__name__])

@@ -1,0 +1,134 @@
+"""Executor: bound symbolic graph with forward/backward.
+
+Reference role: src/executor/graph_executor.cc + python/mxnet/executor.py
+(SURVEY.md §2.1 L6b, §3.4) — ahead-of-time bound computation with argument/
+gradient/aux arrays.  TPU-native: bind = jit the composed graph function
+(XLA does the memory planning the reference's PlanMemory pass did); backward
+holds the `jax.vjp` residuals from the last is_train forward.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from ..base import MXNetError
+from ..ndarray import NDArray
+
+__all__ = ["Executor"]
+
+
+class Executor:
+    def __init__(self, symbol, ctx, arg_arrays: List[NDArray],
+                 grad_arrays: Optional[List[NDArray]], grad_req: str,
+                 aux_arrays: List[NDArray]):
+        self._symbol = symbol
+        self._ctx = ctx
+        self.arg_arrays = arg_arrays
+        self.grad_arrays = grad_arrays or [None] * len(arg_arrays)
+        self.aux_arrays = aux_arrays
+        self._grad_req = grad_req
+        self._arg_names = symbol.list_arguments()
+        self._aux_names = symbol.list_auxiliary_states()
+        self.outputs: List[NDArray] = []
+        self._vjp_fn = None
+        self._run_cache: Dict[bool, object] = {}
+        self._n_args = len(arg_arrays)
+
+    # -- dict views --------------------------------------------------------
+    @property
+    def arg_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._arg_names, self.arg_arrays))
+
+    @property
+    def grad_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._arg_names, self.grad_arrays))
+
+    @property
+    def aux_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._aux_names, self.aux_arrays))
+
+    @property
+    def output_dict(self) -> Dict[str, NDArray]:
+        return dict(zip(self._symbol.list_outputs(), self.outputs))
+
+    # -- execution ---------------------------------------------------------
+    def _get_run(self, training: bool):
+        import jax
+        fn = self._run_cache.get(training)
+        if fn is None:
+            run = self._symbol.compile(training=training)
+            names = self._arg_names + self._aux_names
+
+            def flat(*vals):
+                return tuple(run(dict(zip(names, vals))))
+            fn = jax.jit(flat)
+            self._run_cache[training] = fn
+        return fn
+
+    def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
+        import jax
+        for k, v in kwargs.items():
+            if k not in self._arg_names:
+                raise MXNetError(f"unknown input {k!r}")
+            self.arg_dict[k]._set_data(
+                v._read() if isinstance(v, NDArray) else v)
+        vals = [a._read() for a in self.arg_arrays] + \
+            [a._read() for a in self.aux_arrays]
+        fn = self._get_run(is_train)
+        if is_train and self._grad_req != "null":
+            outs, self._vjp_fn = jax.vjp(fn, *vals)
+        else:
+            outs = fn(*vals)
+        self.outputs = [NDArray(v, ctx=self._ctx) for v in outs]
+        return self.outputs
+
+    def backward(self, out_grads=None, retain_graph=False) -> None:
+        import jax.numpy as jnp
+        if self._vjp_fn is None:
+            raise MXNetError("backward requires a prior forward(is_train=True)")
+        if out_grads is None:
+            cts = tuple(jnp.ones(o.shape, o.dtype) for o in self.outputs)
+        else:
+            if isinstance(out_grads, NDArray):
+                out_grads = [out_grads]
+            cts = tuple(g._read() if isinstance(g, NDArray) else g
+                        for g in out_grads)
+            if len(cts) < len(self.outputs):
+                cts = cts + tuple(jnp.zeros(o.shape, o.dtype)
+                                  for o in self.outputs[len(cts):])
+        in_cts = self._vjp_fn(cts)
+        if not retain_graph:
+            self._vjp_fn = None
+        for i, g in enumerate(in_cts[:self._n_args]):
+            tgt = self.grad_arrays[i]
+            if tgt is None or self._grad_req == "null":
+                continue
+            if self._grad_req == "add":
+                tgt._set_data(tgt._read() + g)
+            else:
+                tgt._set_data(g)
+
+    def copy_params_from(self, arg_params: Dict[str, NDArray],
+                         aux_params: Optional[Dict[str, NDArray]] = None,
+                         allow_extra_params: bool = False) -> None:
+        for name, arr in (arg_params or {}).items():
+            if name in self.arg_dict:
+                arr.copyto(self.arg_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown argument {name!r}")
+        for name, arr in (aux_params or {}).items():
+            if name in self.aux_dict:
+                arr.copyto(self.aux_dict[name])
+            elif not allow_extra_params:
+                raise MXNetError(f"unknown aux state {name!r}")
+
+    def reshape(self, partial_shaping=False, allow_up_sizing=False,
+                **kwargs):
+        # a new bind at the new shapes; jit handles the rest
+        from ..ndarray import zeros as nd_zeros
+        arg_shapes, _, aux_shapes = self._symbol.infer_shape(**kwargs)
+        args = [nd_zeros(s, ctx=self._ctx) for s in arg_shapes]
+        aux = [nd_zeros(s, ctx=self._ctx) for s in aux_shapes]
+        grads = [nd_zeros(s, ctx=self._ctx) for s in arg_shapes] \
+            if self._grad_req != "null" else None
+        return Executor(self._symbol, self._ctx, args, grads,
+                        self._grad_req, aux)

@@ -1,0 +1,117 @@
+"""Symbol frontends generated from the shared op registry.
+
+Reference role: python/mxnet/symbol/register.py — same generated-wrapper
+trick as the ndarray namespace, from the same registry, so ``mx.sym.X`` and
+``mx.nd.X`` stay in lockstep (SURVEY.md §2.5).  Includes the reference's
+auto-variable behavior: tensor inputs not supplied are created as variables
+named ``{op_name}_{input}`` (how ``mx.sym.Convolution(data=d, ...)`` grows
+its weight/bias).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional
+
+from ..base import MXNetError
+from .symbol import Symbol, _Node, _auto_name
+
+# tensor-input declarations for ops whose missing inputs auto-create
+# variables (name → (input names, aux flags))
+_OP_INPUTS: Dict[str, List[str]] = {
+    "FullyConnected": ["data", "weight", "bias"],
+    "Convolution": ["data", "weight", "bias"],
+    "Deconvolution": ["data", "weight", "bias"],
+    "BatchNorm": ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    "LayerNorm": ["data", "gamma", "beta"],
+    "InstanceNorm": ["data", "gamma", "beta"],
+    "Embedding": ["data", "weight"],
+    "LeakyReLU": ["data", "gamma"],
+    "RNN": ["data", "parameters", "state", "state_cell"],
+    "SoftmaxOutput": ["data", "label"],
+}
+_OP_AUX = {"BatchNorm": ("moving_mean", "moving_var")}
+
+# ops whose trailing inputs are optional depending on params
+def _needed_inputs(opname: str, kwargs: Dict[str, Any]) -> List[str]:
+    names = _OP_INPUTS[opname]
+    if opname in ("FullyConnected", "Convolution", "Deconvolution") and \
+            kwargs.get("no_bias"):
+        names = names[:2]
+    if opname == "LeakyReLU" and kwargs.get("act_type", "leaky") != "prelu":
+        names = names[:1]
+    if opname == "RNN" and kwargs.get("mode") != "lstm":
+        names = names[:3]
+    return names
+
+
+def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
+    if opname == "BatchNorm":
+        return 3
+    if opname in ("split", "SliceChannel"):
+        return int(kwargs.get("num_outputs", 1))
+    if opname == "RNN":
+        return 3 if kwargs.get("mode") == "lstm" else 2
+    if opname == "topk" and kwargs.get("ret_typ") == "both":
+        return 2
+    if opname == "LayerNorm" and kwargs.get("output_mean_var"):
+        return 3
+    return 1
+
+
+def apply_op(opname: str, args: List[Symbol], kwargs: Dict[str, Any],
+             name: Optional[str] = None) -> Symbol:
+    from ..ndarray.register import get_op
+    op = get_op(opname)          # validates registration
+    canonical = op.name
+    # split tensor kwargs from attribute kwargs
+    tensor_kwargs = {k: v for k, v in kwargs.items()
+                     if isinstance(v, Symbol)}
+    attrs = {k: v for k, v in kwargs.items()
+             if not isinstance(v, Symbol) and k not in ("name",)}
+    node_name = name or kwargs.get("name") or _auto_name(
+        canonical.lower().lstrip("_"))
+    attrs.pop("name", None)
+
+    inputs: List = []
+    if canonical in _OP_INPUTS:
+        needed = _needed_inputs(canonical, attrs)
+        pos = list(args)
+        for in_name in needed:
+            if pos:
+                sym = pos.pop(0)
+            elif in_name in tensor_kwargs:
+                sym = tensor_kwargs.pop(in_name)
+            else:
+                aux = canonical in _OP_AUX and in_name in _OP_AUX[canonical]
+                sym = Symbol.var(f"{node_name}_{in_name}",
+                                 **({"__aux__": True} if aux else {}))
+            inputs.append(sym)
+    else:
+        inputs = list(args) + list(tensor_kwargs.values())
+    head_refs = []
+    for s in inputs:
+        if not isinstance(s, Symbol):
+            raise MXNetError(f"symbol op {opname} got non-symbol input "
+                             f"{type(s)}")
+        if len(s._heads) != 1:
+            raise MXNetError("cannot feed a grouped symbol as one input")
+        head_refs.append(s._heads[0])
+
+    node = _Node(canonical, node_name, attrs, head_refs,
+                 _num_outputs(canonical, attrs))
+    return Symbol([(node, i) for i in range(node.num_outputs)]) \
+        if node.num_outputs > 1 else Symbol([(node, 0)])
+
+
+def _make_sym_frontend(opname: str):
+    def frontend(*args, **kwargs):
+        name = kwargs.pop("name", None)
+        return apply_op(opname, list(args), kwargs, name=name)
+    frontend.__name__ = opname
+    return frontend
+
+
+def _attach_frontends(module) -> None:
+    from ..ndarray.register import _registry
+    for name, op in list(_registry.items()):
+        if not hasattr(module, name):
+            setattr(module, name, _make_sym_frontend(name))

@@ -1,0 +1,479 @@
+"""Symbol graph core (see package docstring)."""
+from __future__ import annotations
+
+import json
+import threading
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+
+__all__ = ["Symbol", "var", "Variable", "Group", "load", "load_json"]
+
+_name_lock = threading.Lock()
+_name_counters: Dict[str, int] = {}
+
+
+def _auto_name(hint: str) -> str:
+    with _name_lock:
+        idx = _name_counters.get(hint, 0)
+        _name_counters[hint] = idx + 1
+    return f"{hint}{idx}"
+
+
+class _Node:
+    """One op application (or variable) in the graph."""
+
+    __slots__ = ("op", "name", "attrs", "inputs", "num_outputs")
+
+    def __init__(self, op: Optional[str], name: str, attrs: Dict[str, Any],
+                 inputs: List[Tuple["_Node", int]], num_outputs: int = 1):
+        self.op = op          # None for variables
+        self.name = name
+        self.attrs = attrs
+        self.inputs = inputs
+        self.num_outputs = num_outputs
+
+    @property
+    def is_var(self) -> bool:
+        return self.op is None
+
+
+class Symbol:
+    """An ordered list of (node, output_index) heads."""
+
+    def __init__(self, heads: List[Tuple[_Node, int]]):
+        self._heads = heads
+
+    # -- construction ------------------------------------------------------
+    @staticmethod
+    def var(name: str, shape=None, dtype=None, **kwargs) -> "Symbol":
+        attrs = {}
+        if shape is not None:
+            attrs["__shape__"] = tuple(shape)
+        if dtype is not None:
+            attrs["__dtype__"] = str(dtype)
+        attrs.update({k: v for k, v in kwargs.items() if v is not None})
+        return Symbol([(_Node(None, name, attrs, []), 0)])
+
+    # -- protocol ----------------------------------------------------------
+    @property
+    def name(self) -> str:
+        node, idx = self._heads[0]
+        if node.num_outputs > 1:
+            return f"{node.name}_output{idx}"
+        return node.name
+
+    def __len__(self):
+        return len(self._heads)
+
+    def __getitem__(self, idx):
+        if isinstance(idx, str):
+            names = self.list_outputs()
+            idx = names.index(idx)
+        return Symbol([self._heads[idx]])
+
+    def __iter__(self):
+        return (Symbol([h]) for h in self._heads)
+
+    def __repr__(self):
+        return f"<Symbol {self.name}>"
+
+    # arithmetic builds graph nodes through the sym frontends
+    def _binop(self, opname, other, reverse=False):
+        from .register import apply_op
+        if isinstance(other, Symbol):
+            args = (other, self) if reverse else (self, other)
+            return apply_op(opname, list(args), {})
+        scal = {"scalar": float(other)}
+        scalar_map = {
+            "broadcast_add": ("_plus_scalar", "_plus_scalar"),
+            "broadcast_sub": ("_minus_scalar", "_rminus_scalar"),
+            "broadcast_mul": ("_mul_scalar", "_mul_scalar"),
+            "broadcast_div": ("_div_scalar", "_rdiv_scalar"),
+            "broadcast_power": ("_power_scalar", "_rpower_scalar"),
+        }
+        fwd, rev = scalar_map[opname]
+        return apply_op(rev if reverse else fwd, [self], scal)
+
+    def __add__(self, o):
+        return self._binop("broadcast_add", o)
+
+    def __radd__(self, o):
+        return self._binop("broadcast_add", o, True)
+
+    def __sub__(self, o):
+        return self._binop("broadcast_sub", o)
+
+    def __rsub__(self, o):
+        return self._binop("broadcast_sub", o, True)
+
+    def __mul__(self, o):
+        return self._binop("broadcast_mul", o)
+
+    def __rmul__(self, o):
+        return self._binop("broadcast_mul", o, True)
+
+    def __truediv__(self, o):
+        return self._binop("broadcast_div", o)
+
+    def __rtruediv__(self, o):
+        return self._binop("broadcast_div", o, True)
+
+    def __pow__(self, o):
+        return self._binop("broadcast_power", o)
+
+    def __neg__(self):
+        from .register import apply_op
+        return apply_op("negative", [self], {})
+
+    # -- graph walks -------------------------------------------------------
+    def _topo(self) -> List[_Node]:
+        order, seen = [], set()
+
+        def visit(node: _Node):
+            if id(node) in seen:
+                return
+            seen.add(id(node))
+            for parent, _ in node.inputs:
+                visit(parent)
+            order.append(node)
+
+        for node, _ in self._heads:
+            visit(node)
+        return order
+
+    def list_arguments(self) -> List[str]:
+        return [n.name for n in self._topo()
+                if n.is_var and not n.attrs.get("__aux__")]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return [n.name for n in self._topo()
+                if n.is_var and n.attrs.get("__aux__")]
+
+    def list_inputs(self) -> List[str]:
+        return [n.name for n in self._topo() if n.is_var]
+
+    def list_outputs(self) -> List[str]:
+        outs = []
+        for node, idx in self._heads:
+            if node.num_outputs > 1:
+                outs.append(f"{node.name}_output{idx}")
+            else:
+                outs.append(f"{node.name}_output")
+        return outs
+
+    def get_internals(self) -> "Symbol":
+        heads = []
+        for node in self._topo():
+            for i in range(node.num_outputs):
+                heads.append((node, i))
+        return Symbol(heads)
+
+    def attr(self, key):
+        return self._heads[0][0].attrs.get(key)
+
+    # -- lowering to a JAX function ---------------------------------------
+    def compile(self, training: bool = False):
+        """Return fn(feed: dict name→jax value) → list of output values."""
+        from ..ndarray.register import get_op
+
+        order = self._topo()
+
+        def run(feed: Dict[str, Any]) -> List[Any]:
+            vals: Dict[int, Any] = {}
+            for node in order:
+                if node.is_var:
+                    if node.name not in feed:
+                        raise MXNetError(
+                            f"symbol input {node.name!r} missing from feed; "
+                            f"have {sorted(feed)}")
+                    vals[id(node)] = (feed[node.name],)
+                    continue
+                op = get_op(node.op)
+                kwargs = dict(node.attrs)
+                if node.op == "BatchNorm":
+                    kwargs.setdefault("_training", training)
+                fn = op.get_fn(kwargs)
+                ins = [vals[id(p)][i] for p, i in node.inputs]
+                out = fn(*ins)
+                vals[id(node)] = out if isinstance(out, tuple) else (out,)
+            return [vals[id(n)][i] for n, i in self._heads]
+
+        return run
+
+    def eval_dict(self, feed: Dict[str, Any]):
+        """Evaluate with a name→NDArray feed; returns NDArray(s)."""
+        from ..ndarray import NDArray
+        ctx = None
+        jfeed = {}
+        for k, v in feed.items():
+            if isinstance(v, NDArray):
+                jfeed[k] = v._read()
+                ctx = ctx or v.context
+            else:
+                jfeed[k] = v
+        run = self.compile()
+        outs = [NDArray(v, ctx=ctx or current_context())
+                for v in run(jfeed)]
+        return outs[0] if len(outs) == 1 else outs
+
+    def eval(self, ctx=None, **kwargs):
+        out = self.eval_dict(kwargs)
+        return out if isinstance(out, list) else [out]
+
+    # -- shape/type inference ---------------------------------------------
+    def infer_shape(self, *args, **kwargs):
+        import jax
+        arg_names = self.list_arguments()
+        aux_names = self.list_auxiliary_states()
+        shapes: Dict[str, Tuple[int, ...]] = {}
+        if args:
+            for n, s in zip(arg_names, args):
+                if s is not None:
+                    shapes[n] = tuple(s)
+        shapes.update({k: tuple(v) for k, v in kwargs.items()})
+        # iterative local inference via eval_shape with placeholder dtypes
+        known = dict(shapes)
+        for n in self._topo():
+            if n.is_var and n.name not in known:
+                declared = n.attrs.get("__shape__")
+                if declared:
+                    known[n.name] = tuple(declared)
+        missing = [n for n in self.list_inputs() if n not in known]
+        if missing:
+            inferred = _infer_missing(self, known, missing)
+            known.update(inferred)
+        try:
+            feed = {name: jax.ShapeDtypeStruct(tuple(known[name]),
+                                               _np.float32)
+                    for name in self.list_inputs()}
+            run = self.compile()
+            outs = jax.eval_shape(lambda f: run(f), feed)
+            out_shapes = [tuple(o.shape) for o in outs]
+        except KeyError as e:
+            raise MXNetError(f"cannot infer shapes; unknown input {e}")
+        arg_shapes = [tuple(known[n]) for n in arg_names]
+        aux_shapes = [tuple(known[n]) for n in aux_names]
+        return arg_shapes, out_shapes, aux_shapes
+
+    def infer_shape_partial(self, *args, **kwargs):
+        try:
+            return self.infer_shape(*args, **kwargs)
+        except MXNetError:
+            return None, None, None
+
+    def infer_type(self, *args, **kwargs):
+        arg_types = [_np.float32] * len(self.list_arguments())
+        out_types = [_np.float32] * len(self.list_outputs())
+        aux_types = [_np.float32] * len(self.list_auxiliary_states())
+        return arg_types, out_types, aux_types
+
+    # -- binding -----------------------------------------------------------
+    def simple_bind(self, ctx=None, grad_req="write", type_dict=None,
+                    **kwargs):
+        from .executor import Executor
+        from ..ndarray import zeros as nd_zeros
+        ctx = ctx or current_context()
+        arg_shapes, _, aux_shapes = self.infer_shape(**kwargs)
+        args = [nd_zeros(s, ctx=ctx) for s in arg_shapes]
+        aux = [nd_zeros(s, ctx=ctx) for s in aux_shapes]
+        grad_arrays = None
+        if grad_req != "null":
+            grad_arrays = [nd_zeros(s, ctx=ctx) for s in arg_shapes]
+        return Executor(self, ctx, args, grad_arrays, grad_req, aux)
+
+    def bind(self, ctx=None, args=None, args_grad=None, grad_req="write",
+             aux_states=None, group2ctx=None, shared_exec=None):
+        from .executor import Executor
+        ctx = ctx or current_context()
+        arg_names = self.list_arguments()
+        if isinstance(args, dict):
+            args = [args[n] for n in arg_names]
+        if isinstance(args_grad, dict):
+            args_grad = [args_grad.get(n) for n in arg_names]
+        if isinstance(aux_states, dict):
+            aux_states = [aux_states[n]
+                          for n in self.list_auxiliary_states()]
+        return Executor(self, ctx, list(args),
+                        list(args_grad) if args_grad else None, grad_req,
+                        list(aux_states) if aux_states else [])
+
+    # -- serialization -----------------------------------------------------
+    def tojson(self) -> str:
+        order = self._topo()
+        nid = {id(n): i for i, n in enumerate(order)}
+        nodes = []
+        for n in order:
+            nodes.append({
+                "op": n.op if n.op else "null",
+                "name": n.name,
+                "attrs": {k: _attr_str(v) for k, v in n.attrs.items()},
+                "inputs": [[nid[id(p)], i, 0] for p, i in n.inputs],
+            })
+        arg_nodes = [i for i, n in enumerate(order) if n.is_var]
+        heads = [[nid[id(n)], i, 0] for n, i in self._heads]
+        return json.dumps({"nodes": nodes, "arg_nodes": arg_nodes,
+                           "node_row_ptr": [], "heads": heads,
+                           "attrs": {"mxnet_version": ["int", 10500]}},
+                          indent=2)
+
+    def save(self, fname: str) -> None:
+        with open(fname, "w") as f:
+            f.write(self.tojson())
+
+    def __deepcopy__(self, memo):
+        return load_json(self.tojson())
+
+
+def _attr_str(v) -> str:
+    if isinstance(v, str):
+        return v
+    return json.dumps(v) if isinstance(v, (list, tuple, dict)) else str(v)
+
+
+def _parse_attr(s: str):
+    if not isinstance(s, str):
+        return s
+    low = s.strip()
+    if low in ("True", "true"):
+        return True
+    if low in ("False", "false"):
+        return False
+    try:
+        return json.loads(low)
+    except (ValueError, TypeError):
+        return s
+
+
+def _infer_missing(sym: Symbol, known: Dict[str, Tuple[int, ...]],
+                   missing: List[str]) -> Dict[str, Tuple[int, ...]]:
+    """Forward-walk inferring parameter shapes for common layer ops from the
+    data shapes (the role of the reference's fixed-point InferShape pass)."""
+    from ..ndarray.register import get_op
+    import jax
+    out: Dict[str, Tuple[int, ...]] = {}
+    shapes: Dict[Tuple[int, int], Tuple[int, ...]] = {}
+    for node in sym._topo():
+        if node.is_var:
+            name = node.name
+            if name in known:
+                shapes[(id(node), 0)] = tuple(known[name])
+            continue
+        in_shapes = []
+        unknown_inputs = []
+        for p, i in node.inputs:
+            s = shapes.get((id(p), i))
+            in_shapes.append(s)
+            if s is None and p.is_var:
+                unknown_inputs.append((p, len(in_shapes) - 1))
+        if unknown_inputs:
+            _infer_node_params(node, in_shapes, unknown_inputs, out)
+            for p, pos in unknown_inputs:
+                if p.name in out:
+                    shapes[(id(p), 0)] = out[p.name]
+                    in_shapes[pos] = out[p.name]
+        if any(s is None for s in in_shapes):
+            continue
+        op = get_op(node.op)
+        kwargs = dict(node.attrs)
+        if node.op == "BatchNorm":
+            kwargs.setdefault("_training", False)
+        try:
+            fn = op.get_fn(kwargs)
+            outs = jax.eval_shape(
+                fn, *[jax.ShapeDtypeStruct(s, _np.float32)
+                      for s in in_shapes])
+            outs = outs if isinstance(outs, tuple) else (outs,)
+            for i, o in enumerate(outs):
+                shapes[(id(node), i)] = tuple(o.shape)
+        except Exception:
+            continue
+    return out
+
+
+def _infer_node_params(node: _Node, in_shapes, unknown, out) -> None:
+    """Parameter-shape rules for the common layers (weight/bias/γ/β...)."""
+    a = node.attrs
+    data = in_shapes[0]
+    if data is None:
+        return
+    if node.op == "FullyConnected":
+        nh = int(a.get("num_hidden"))
+        flat = a.get("flatten", True)
+        in_units = 1
+        if flat:
+            for s in data[1:]:
+                in_units *= s
+        else:
+            in_units = data[-1]
+        for p, pos in unknown:
+            if pos == 1:
+                out[p.name] = (nh, in_units)
+            elif pos == 2:
+                out[p.name] = (nh,)
+    elif node.op in ("Convolution", "Deconvolution"):
+        nf = int(a.get("num_filter"))
+        k = tuple(a.get("kernel"))
+        ng = int(a.get("num_group", 1))
+        cin = data[1]
+        for p, pos in unknown:
+            if pos == 1:
+                if node.op == "Convolution":
+                    out[p.name] = (nf, cin // ng) + k
+                else:
+                    out[p.name] = (cin, nf // ng) + k
+            elif pos == 2:
+                out[p.name] = (nf,)
+    elif node.op in ("BatchNorm", "LayerNorm", "InstanceNorm"):
+        axis = int(a.get("axis", 1 if node.op == "BatchNorm" else -1))
+        c = data[axis % len(data)]
+        for p, pos in unknown:
+            out[p.name] = (c,)
+    elif node.op == "Embedding":
+        for p, pos in unknown:
+            if pos == 1:
+                out[p.name] = (int(a.get("input_dim")),
+                               int(a.get("output_dim")))
+
+
+def var(name: str, **kwargs) -> Symbol:
+    return Symbol.var(name, **kwargs)
+
+
+Variable = var
+
+
+def Group(symbols: Sequence[Symbol]) -> Symbol:
+    heads = []
+    for s in symbols:
+        heads.extend(s._heads)
+    return Symbol(heads)
+
+
+def load_json(json_str: str) -> Symbol:
+    data = json.loads(json_str)
+    nodes: List[_Node] = []
+    for nd_ in data["nodes"]:
+        attrs = {k: _parse_attr(v) for k, v in nd_.get("attrs", {}).items()}
+        inputs = [(nodes[i], oi) for i, oi, _ in nd_.get("inputs", [])]
+        op = None if nd_["op"] == "null" else nd_["op"]
+        num_out = 1
+        node = _Node(op, nd_["name"], attrs, inputs, num_out)
+        nodes.append(node)
+    # fix num_outputs from max referenced index
+    for nd_, node in zip(data["nodes"], nodes):
+        for i, oi, _ in nd_.get("inputs", []):
+            nodes[i].num_outputs = max(nodes[i].num_outputs, oi + 1)
+    for ref in data["heads"]:
+        nodes[ref[0]].num_outputs = max(nodes[ref[0]].num_outputs,
+                                        ref[1] + 1)
+    heads = [(nodes[i], oi) for i, oi, *_ in data["heads"]]
+    return Symbol(heads)
+
+
+def load(fname: str) -> Symbol:
+    with open(fname) as f:
+        return load_json(f.read())

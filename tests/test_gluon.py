@@ -1,0 +1,290 @@
+"""Gluon: blocks, params, hybridize, trainer, losses.
+
+Reference analog: tests/python/unittest/test_gluon.py (SURVEY.md §4.2).
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def test_dense_forward():
+    layer = nn.Dense(4, in_units=3)
+    layer.initialize()
+    x = nd.ones((2, 3))
+    out = layer(x)
+    assert out.shape == (2, 4)
+    w = layer.weight.data()
+    np.testing.assert_allclose(
+        out.asnumpy(), x.asnumpy() @ w.asnumpy().T + 0.0, rtol=1e-5)
+
+
+def test_dense_deferred_init():
+    layer = nn.Dense(8)
+    layer.initialize()
+    out = layer(nd.ones((4, 5)))
+    assert out.shape == (4, 8)
+    assert layer.weight.shape == (8, 5)
+
+
+def test_sequential_and_collect_params():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize()
+    out = net(nd.ones((2, 8)))
+    assert out.shape == (2, 4)
+    params = net.collect_params()
+    assert len(params) == 4  # two weights + two biases
+
+
+def test_hybridize_matches_eager():
+    np.random.seed(0)
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    x = nd.array(np.random.rand(3, 8).astype(np.float32))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid1 = net(x).asnumpy()   # first call: eager fallback or trace
+    hybrid2 = net(x).asnumpy()   # second call: cached jit
+    np.testing.assert_allclose(eager, hybrid1, rtol=1e-5)
+    np.testing.assert_allclose(eager, hybrid2, rtol=1e-5)
+
+
+def test_hybridize_trains():
+    net = nn.HybridSequential()
+    net.add(nn.Dense(16, activation="relu"), nn.Dense(2))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = nd.array(np.random.rand(8, 4).astype(np.float32))
+    y = nd.array(np.array([0, 1] * 4), dtype="int32")
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(8)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_batchnorm_running_stats_update():
+    layer = nn.BatchNorm(in_channels=3)
+    layer.initialize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) * 5 + 2)
+    before = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)
+    after = layer.running_mean.data().asnumpy()
+    assert not np.allclose(before, after)
+    # inference mode uses running stats, no update
+    before2 = layer.running_mean.data().asnumpy().copy()
+    layer(x)
+    np.testing.assert_allclose(layer.running_mean.data().asnumpy(), before2)
+
+
+def test_batchnorm_running_stats_update_hybridized():
+    layer = nn.BatchNorm(in_channels=3)
+    layer.initialize()
+    layer.hybridize()
+    x = nd.array(np.random.rand(4, 3, 2, 2).astype(np.float32) * 5 + 2)
+    with autograd.record():
+        layer(x)  # first call (trace)
+    m1 = layer.running_mean.data().asnumpy().copy()
+    with autograd.record():
+        layer(x)  # cached call
+    m2 = layer.running_mean.data().asnumpy()
+    assert not np.allclose(m1, m2)
+
+
+def test_conv2d():
+    layer = nn.Conv2D(8, kernel_size=3, padding=1, in_channels=3)
+    layer.initialize()
+    out = layer(nd.ones((2, 3, 8, 8)))
+    assert out.shape == (2, 8, 8, 8)
+    layer2 = nn.Conv2D(4, kernel_size=3, strides=2)
+    layer2.initialize()
+    out2 = layer2(nd.ones((2, 3, 9, 9)))
+    assert out2.shape == (2, 4, 4, 4)
+
+
+def test_pooling_layers():
+    x = nd.ones((1, 2, 8, 8))
+    assert nn.MaxPool2D()(x).shape == (1, 2, 4, 4)
+    assert nn.AvgPool2D(pool_size=4, strides=4)(x).shape == (1, 2, 2, 2)
+    assert nn.GlobalAvgPool2D()(x).shape == (1, 2, 1, 1)
+
+
+def test_dropout_train_vs_eval():
+    layer = nn.Dropout(0.5)
+    x = nd.ones((100, 100))
+    out_eval = layer(x)
+    np.testing.assert_allclose(out_eval.asnumpy(), 1.0)
+    with autograd.record():
+        out_train = layer(x)
+    frac_zero = (out_train.asnumpy() == 0).mean()
+    assert 0.3 < frac_zero < 0.7
+
+
+def test_embedding_layer():
+    layer = nn.Embedding(10, 4)
+    layer.initialize()
+    idx = nd.array([1, 5], dtype="int32")
+    out = layer(idx)
+    assert out.shape == (2, 4)
+
+
+def test_save_load_parameters(tmp_path):
+    net = nn.HybridSequential()
+    net.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net.initialize(mx.init.Xavier())
+    f = str(tmp_path / "net.params")
+    net.save_parameters(f)
+    x = nd.ones((1, 3))
+    expected = net(x).asnumpy()
+    net2 = nn.HybridSequential()
+    net2.add(nn.Dense(4, in_units=3), nn.Dense(2, in_units=4))
+    net2.initialize()
+    # fresh init differs
+    assert not np.allclose(net2(x).asnumpy(), expected)
+    net2.load_parameters(f)
+    np.testing.assert_allclose(net2(x).asnumpy(), expected, rtol=1e-6)
+
+
+def test_trainer_sgd_momentum():
+    p = gluon.Parameter("w", shape=(3,))
+    p.initialize(mx.init.One())
+    trainer = gluon.Trainer({"w": p}, "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    with autograd.record():
+        loss = (p.data() * p.data()).sum()
+    loss.backward()
+    trainer.step(1)
+    # grad = 2*w = 2; step = lr*2 = 0.2
+    np.testing.assert_allclose(p.data().asnumpy(), [0.8, 0.8, 0.8],
+                               rtol=1e-5)
+
+
+def test_losses():
+    pred = nd.array([[2.0, 1.0], [0.5, 2.5]])
+    label = nd.array([0, 1], dtype="int32")
+    l = gluon.loss.SoftmaxCrossEntropyLoss()(pred, label)
+    expect = -np.log([
+        np.exp(2) / (np.exp(2) + np.exp(1)),
+        np.exp(2.5) / (np.exp(0.5) + np.exp(2.5))])
+    np.testing.assert_allclose(l.asnumpy(), expect, rtol=1e-5)
+
+    l2 = gluon.loss.L2Loss()(nd.array([1.0, 2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l2.asnumpy(), [0.5, 2.0])
+
+    l1 = gluon.loss.L1Loss()(nd.array([1.0, -2.0]), nd.array([0.0, 0.0]))
+    np.testing.assert_allclose(l1.asnumpy(), [1.0, 2.0])
+
+
+def test_lstm_layer():
+    layer = gluon.rnn.LSTM(hidden_size=8, num_layers=2)
+    layer.initialize()
+    x = nd.ones((5, 3, 4))  # TNC
+    out = layer(x)
+    assert out.shape == (5, 3, 8)
+
+
+def test_gru_bidirectional():
+    layer = gluon.rnn.GRU(hidden_size=6, bidirectional=True)
+    layer.initialize()
+    out = layer(nd.ones((4, 2, 3)))
+    assert out.shape == (4, 2, 12)
+
+
+def test_lstm_cell_unroll():
+    cell = gluon.rnn.LSTMCell(hidden_size=8, input_size=4)
+    cell.initialize()
+    x = nd.ones((2, 5, 4))  # NTC
+    outs, states = cell.unroll(5, x, layout="NTC")
+    assert outs.shape == (2, 5, 8)
+    assert states[0].shape == (2, 8)
+
+
+def test_model_zoo_resnet18_thumbnail():
+    net = gluon.model_zoo.vision.resnet18_v1(classes=10, thumbnail=True)
+    net.initialize()
+    out = net(nd.ones((1, 3, 32, 32)))
+    assert out.shape == (1, 10)
+
+
+def test_split_and_load():
+    data = nd.arange(0, 16).reshape((8, 2))
+    ctxs = [mx.cpu(0), mx.cpu(1)]
+    parts = gluon.utils.split_and_load(data, ctxs)
+    assert parts[0].shape == (4, 2)
+    assert parts[1].context == mx.cpu(1)
+
+
+def test_clip_global_norm():
+    arrays = [nd.ones((2,)) * 3, nd.ones((2,)) * 4]
+    total = gluon.utils.clip_global_norm(arrays, 1.0)
+    norm = np.sqrt(sum((a.asnumpy() ** 2).sum() for a in arrays))
+    np.testing.assert_allclose(norm, 1.0, rtol=1e-4)
+
+
+def test_metric_accuracy():
+    acc = mx.metric.Accuracy()
+    pred = nd.array([[0.1, 0.9], [0.8, 0.2]])
+    label = nd.array([1, 1], dtype="int32")
+    acc.update([label], [pred])
+    assert acc.get()[1] == 0.5
+
+
+def test_metric_perplexity():
+    ppl = mx.metric.Perplexity(ignore_label=None)
+    pred = nd.array([[0.5, 0.5], [0.9, 0.1]])
+    label = nd.array([0, 0], dtype="int32")
+    ppl.update([label], [pred])
+    expect = np.exp(-(np.log(0.5) + np.log(0.9)) / 2)
+    np.testing.assert_allclose(ppl.get()[1], expect, rtol=1e-5)
+
+
+def test_kvstore_push_pull():
+    kv = mx.kv.create("local")
+    kv.init(3, nd.ones((2, 3)) * 2)
+    out = nd.zeros((2, 3))
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
+    kv.push(3, [nd.ones((2, 3)), nd.ones((2, 3)) * 3])
+    kv.pull(3, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 4.0)  # reduced sum
+
+
+def test_optimizer_lr_scheduler():
+    sched = mx.lr_scheduler.FactorScheduler(step=10, factor=0.5, base_lr=1.0)
+    opt = mx.optimizer.SGD(learning_rate=1.0, lr_scheduler=sched)
+    assert opt.learning_rate == 1.0
+
+
+def test_dataloader():
+    from mxnet_tpu.gluon.data import ArrayDataset, DataLoader
+    X = np.random.rand(10, 3).astype(np.float32)
+    Y = np.arange(10).astype(np.int32)
+    ds = ArrayDataset(X, Y)
+    loader = DataLoader(ds, batch_size=4, shuffle=False)
+    batches = list(loader)
+    assert len(batches) == 3
+    xb, yb = batches[0]
+    assert xb.shape == (4, 3)
+    np.testing.assert_allclose(yb.asnumpy(), [0, 1, 2, 3])
+
+
+def test_dataset_vision_synthetic():
+    import warnings
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        from mxnet_tpu.gluon.data.vision import MNIST
+        ds = MNIST(root="/nonexistent_dir", train=False)
+    x, y = ds[0]
+    assert x.shape == (28, 28, 1)
+    assert 0 <= int(y) < 10

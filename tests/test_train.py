@@ -1,0 +1,95 @@
+"""Convergence smoke tests (reference analog: tests/python/train/ —
+small real trainings reaching an accuracy threshold, SURVEY.md §4.4)."""
+import warnings
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, autograd, gluon
+from mxnet_tpu.gluon import nn
+
+
+def _mnist_batches(n_batches=25, batch=64, seed=7):
+    """Deterministic synthetic MNIST-shaped stream (no egress)."""
+    rng = np.random.RandomState(seed)
+    templates = rng.rand(10, 28 * 28).astype(np.float32)
+    for _ in range(n_batches):
+        y = rng.randint(0, 10, batch)
+        x = templates[y] + 0.1 * rng.randn(batch, 28 * 28).astype(np.float32)
+        yield x - 0.5, y  # centered, like ToTensor+Normalize in real runs
+
+
+def test_mlp_mnist_convergence():
+    """BASELINE config #1: imperative Gluon MLP — must fit the stream."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"),
+            nn.Dense(64, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(3):
+        metric.reset()
+        for x_np, y_np in _mnist_batches():
+            x, y = nd.array(x_np), nd.array(y_np, dtype="int32")
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+    assert metric.get()[1] > 0.95, f"accuracy too low: {metric.get()}"
+
+
+def test_mlp_mnist_convergence_hybridized():
+    """Same config hybridized → the whole step runs as cached XLA."""
+    net = nn.HybridSequential()
+    net.add(nn.Dense(128, activation="relu"), nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 0.01})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    metric = mx.metric.Accuracy()
+    for epoch in range(3):
+        metric.reset()
+        for x_np, y_np in _mnist_batches():
+            x, y = nd.array(x_np), nd.array(y_np, dtype="int32")
+            with autograd.record():
+                out = net(x)
+                loss = loss_fn(out, y)
+            loss.backward()
+            trainer.step(x.shape[0])
+            metric.update([y], [out])
+    assert metric.get()[1] > 0.95, f"accuracy too low: {metric.get()}"
+
+
+def test_small_cnn_trains():
+    """Tiny conv net end-to-end (BN + conv + pool + dense)."""
+    net = nn.HybridSequential()
+    net.add(nn.Conv2D(8, 3, padding=1, activation="relu"),
+            nn.BatchNorm(),
+            nn.MaxPool2D(),
+            nn.Flatten(),
+            nn.Dense(4))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "sgd",
+                            {"learning_rate": 0.1, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.RandomState(0)
+    templates = rng.rand(4, 1, 8, 8).astype(np.float32) * 2
+    losses = []
+    for step in range(30):
+        y_np = rng.randint(0, 4, 32)
+        x_np = templates[y_np] + 0.1 * rng.randn(32, 1, 8, 8).astype(
+            np.float32)
+        x, y = nd.array(x_np), nd.array(y_np, dtype="int32")
+        with autograd.record():
+            loss = loss_fn(net(x), y)
+        loss.backward()
+        trainer.step(32)
+        losses.append(float(loss.mean().asnumpy()))
+    assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
