@@ -35,6 +35,7 @@ from . import kvstore
 from . import kvstore as kv
 from . import recordio
 from . import gluon
+from . import parallel
 
 
 def waitall() -> None:

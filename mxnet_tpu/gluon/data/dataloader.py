@@ -22,6 +22,14 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 __all__ = ["DataLoader", "default_batchify_fn"]
 
 
+class _WorkerError:
+    """Carries a worker exception across the prefetch queue so it re-raises
+    in the consumer instead of silently truncating the epoch."""
+
+    def __init__(self, exc: BaseException):
+        self.exc = exc
+
+
 def default_batchify_fn(data):
     """Stack samples into a batch (NDArray or numpy leaves; tuples recurse)."""
     if isinstance(data[0], NDArray):
@@ -89,6 +97,8 @@ class DataLoader:
                             q.put(inflight.popleft().result())
                     while inflight:
                         q.put(inflight.popleft().result())
+            except BaseException as exc:   # surface worker failures
+                q.put(_WorkerError(exc))
             finally:
                 q.put(sentinel)
 
@@ -98,4 +108,6 @@ class DataLoader:
             item = q.get()
             if item is sentinel:
                 break
+            if isinstance(item, _WorkerError):
+                raise item.exc
             yield item

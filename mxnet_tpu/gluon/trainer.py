@@ -76,6 +76,18 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         for i, param in enumerate(self._params):
             for ctx, data in param._data.items():
+                # reference parity: a 'write'-mode grad untouched by backward
+                # since the last step is stale — error unless opted out, in
+                # which case the param is skipped (gluon/trainer.py behavior)
+                if data._ag is not None and data._ag.grad_req == "write" \
+                        and data._ag.fresh:
+                    if not ignore_stale_grad:
+                        raise MXNetError(
+                            f"gradient of Parameter {param.name!r} on {ctx} "
+                            f"has not been updated by backward since the "
+                            f"last step; set ignore_stale_grad=True to skip "
+                            f"such parameters")
+                    continue
                 key = (i, ctx)
                 if key not in self._states:
                     self._states[key] = \

@@ -112,7 +112,9 @@ def _register():
             lr = lr.astype(weight.dtype)
             g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
             mom_new = momentum * mom - (1 - momentum) * g
-            return (weight + lr * jnp.sign(mom_new), mom_new)
+            # wd_lh: decoupled weight decay (Signum paper / reference op)
+            return ((1 - lr * wd_lh) * weight + lr * jnp.sign(mom_new),
+                    mom_new)
         return fn
     register_op("signum_update", signum_update_maker, differentiable=False)
 
@@ -129,6 +131,25 @@ def _register():
             return (w, n_new)
         return fn
     register_op("rmsprop_update", rmsprop_update_maker, differentiable=False)
+
+    def rmspropalex_update_maker(gamma1=0.95, gamma2=0.9, epsilon=1e-8,
+                                 wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                                 clip_weights=-1.0):
+        # centered RMSProp (Graves 2013) — reference rmspropalex_update
+        def fn(weight, grad, n, g_avg, delta, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_gradient)
+            n_new = gamma1 * n + (1 - gamma1) * jnp.square(g)
+            g_new = gamma1 * g_avg + (1 - gamma1) * g
+            d_new = gamma2 * delta - \
+                lr * g / jnp.sqrt(n_new - jnp.square(g_new) + epsilon)
+            w = weight + d_new
+            if clip_weights > 0:
+                w = jnp.clip(w, -clip_weights, clip_weights)
+            return (w, n_new, g_new, d_new)
+        return fn
+    register_op("rmspropalex_update", rmspropalex_update_maker,
+                differentiable=False)
 
     def adagrad_update_maker(epsilon=1e-7, wd=0.0, rescale_grad=1.0,
                              clip_gradient=-1.0):

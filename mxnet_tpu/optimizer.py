@@ -271,7 +271,11 @@ class RMSProp(Optimizer):
         self.clip_weights = clip_weights
 
     def create_state(self, index, weight):
-        return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        mk = lambda: nd_zeros(weight.shape, ctx=weight.context,
+                              dtype=weight.dtype)
+        if self.centered:
+            return (mk(), mk(), mk())   # n, g_avg, delta (rmspropalex)
+        return mk()
 
     def update(self, index, weight, grad, state):
         self._update_count(index)
@@ -280,8 +284,15 @@ class RMSProp(Optimizer):
         if self.clip_weights is not None:
             kw["clip_weights"] = self.clip_weights
         lr = self._lr_nd(index, weight)
-        invoke_by_name("rmsprop_update", [weight, grad, state, lr], kw,
-                       out=[weight, state])
+        if self.centered:
+            n, g_avg, delta = state
+            kw["gamma2"] = self.gamma2
+            invoke_by_name("rmspropalex_update",
+                           [weight, grad, n, g_avg, delta, lr], kw,
+                           out=[weight, n, g_avg, delta])
+        else:
+            invoke_by_name("rmsprop_update", [weight, grad, state, lr], kw,
+                           out=[weight, state])
 
 
 @register

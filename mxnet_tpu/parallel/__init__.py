@@ -1,0 +1,15 @@
+"""mxnet_tpu.parallel — multi-chip scaling over `jax.sharding`.
+
+The TPU-native replacement for the reference's KVStore comm stack
+(device/NCCL/ps-lite — SURVEY.md §2.3, §5.8): one device Mesh with named
+axes (dp/tp/sp/pp/ep), sharding rules instead of manual device placement,
+and a whole-train-step jit in which XLA inserts the ICI/DCN collectives.
+"""
+from .mesh import (MESH_AXES, ShardingRules, default_mesh, make_mesh,
+                   replicated, shard)
+from .optim import FunctionalOptimizer, make_functional_optimizer
+from .trainer import ShardedTrainer
+
+__all__ = ["MESH_AXES", "ShardingRules", "default_mesh", "make_mesh",
+           "replicated", "shard", "FunctionalOptimizer",
+           "make_functional_optimizer", "ShardedTrainer"]

@@ -1,0 +1,118 @@
+"""Device-mesh management: the TPU-native replacement for MXNet's context
+lists and KVStore device topology.
+
+Reference parity: the reference scales by enumerating GPU Contexts and
+reducing gradients through KVStore comm trees / NCCL rings
+(src/kvstore/comm.h, kvstore_nccl.h — SURVEY.md §2.3).  TPU-native design:
+ONE `jax.sharding.Mesh` over the chips with named axes
+
+    dp  — data parallel (batch dim; grad reduce rides ICI psum)
+    tp  — tensor parallel (megatron-style weight sharding)
+    sp  — sequence/context parallel (long-context activations)
+    pp  — pipeline parallel (stage dim; reserved)
+    ep  — expert parallel (MoE; reserved)
+
+and `NamedSharding` annotations; XLA inserts the collectives (psum,
+all_gather, reduce_scatter) that NCCL calls performed by hand in the
+reference.
+"""
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Optional, Sequence, Tuple, Union
+
+import numpy as _np
+
+from ..base import MXNetError
+
+__all__ = ["make_mesh", "default_mesh", "ShardingRules", "replicated",
+           "shard", "MESH_AXES"]
+
+#: canonical axis order — dp outermost (DCN/ICI-friendly), then pipeline,
+#: then the intra-layer axes
+MESH_AXES = ("dp", "pp", "tp", "sp", "ep")
+
+
+def make_mesh(axes: Optional[Dict[str, int]] = None, devices=None):
+    """Build a `jax.sharding.Mesh`.
+
+    axes: ordered {axis_name: size}; the product must equal the number of
+    devices (pass an explicit `devices` subset to underfill deliberately).
+    Default: all devices on the 'dp' axis (pure data parallel — the
+    reference's kvstore='device' topology).
+    """
+    import jax
+    from jax.sharding import Mesh
+
+    if devices is None:
+        devices = jax.devices()
+    devices = list(devices)
+    if axes is None:
+        axes = {"dp": len(devices)}
+    names = tuple(axes.keys())
+    sizes = tuple(int(s) for s in axes.values())
+    n = 1
+    for s in sizes:
+        n *= s
+    if n != len(devices):
+        raise MXNetError(
+            f"mesh {dict(axes)} covers {n} devices but {len(devices)} were "
+            f"given — pass an explicit device subset if underfilling is "
+            f"intended")
+    grid = _np.array(devices, dtype=object).reshape(sizes)
+    return Mesh(grid, names)
+
+
+_default_mesh = None
+
+
+def default_mesh():
+    """Process-wide default mesh (all devices, data parallel)."""
+    global _default_mesh
+    if _default_mesh is None:
+        _default_mesh = make_mesh()
+    return _default_mesh
+
+
+def replicated(mesh):
+    """Fully-replicated NamedSharding on `mesh`."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec())
+
+
+def shard(mesh, *spec):
+    """NamedSharding from a PartitionSpec-like tuple, e.g.
+    shard(mesh, 'dp') for batch-dim sharding."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+class ShardingRules:
+    """Name-pattern → PartitionSpec table for parameters.
+
+    The TPU-native successor of the reference's `group2ctx` manual model
+    parallelism (nnvm PlaceDevice pass — SURVEY.md §2.3): instead of pinning
+    ops to devices, parameters matching a regex get a PartitionSpec; XLA
+    partitions the matmuls and inserts collectives.
+
+        rules = ShardingRules([
+            (r".*_qkv_weight$",  ("tp", None)),   # column parallel
+            (r".*_proj_weight$", (None, "tp")),   # row parallel
+        ])
+    First match wins; no match → replicated.
+    """
+
+    def __init__(self, rules: Optional[Sequence[Tuple[str, tuple]]] = None):
+        self._rules: List[Tuple[re.Pattern, tuple]] = [
+            (re.compile(pat), tuple(spec)) for pat, spec in (rules or [])]
+
+    def spec_for(self, name: str, shape=None):
+        from jax.sharding import PartitionSpec
+        for pat, spec in self._rules:
+            if pat.search(name):
+                return PartitionSpec(*spec)
+        return PartitionSpec()
+
+    def sharding_for(self, mesh, name: str, shape=None):
+        from jax.sharding import NamedSharding
+        return NamedSharding(mesh, self.spec_for(name, shape))

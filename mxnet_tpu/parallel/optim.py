@@ -1,0 +1,165 @@
+"""Functional optimizer layer for the sharded training step.
+
+Reference parity: the reference updates weights in-place via fused optimizer
+ops inside the engine (src/operator/optimizer_op.cc — SURVEY.md §2.2); the
+KVStore 'device'/'nccl' path reduces first, then each device updates its
+replica.  TPU-native design: the ENTIRE step — forward, backward, gradient
+psum (implicit from shardings), optimizer update — is one jitted XLA
+computation with donated buffers, so weights update in place at the HBM
+level.  This module lowers an imperative `mxnet_tpu.optimizer.Optimizer`
+(hyperparams + per-param lr/wd multipliers) into pure
+`update(params, grads, state, t, lr, rescale) -> (params, state)` functions
+over pytrees.  Formulas mirror ndarray/ops_optimizer.py exactly so the
+sharded path is numerically identical to the single-chip Trainer.
+"""
+from __future__ import annotations
+
+from typing import Any, List, Sequence
+
+from ..base import MXNetError
+from .. import optimizer as opt_mod
+
+__all__ = ["FunctionalOptimizer", "make_functional_optimizer"]
+
+
+class FunctionalOptimizer:
+    """Pure pytree optimizer: `init(params) -> state`,
+    `update(params, grads, state, t, base_lr, rescale) -> (params, state)`.
+
+    `t` (update count), `base_lr` and `rescale` (grad scale, 1/batch_size)
+    are traced inputs so LR schedules and batch-size changes never
+    recompile.  Per-param lr_mult/wd_mult/clip are trace-time constants.
+    """
+
+    def __init__(self, kind: str, hyper: dict,
+                 lr_mults: Sequence[float], wds: Sequence[float]):
+        self.kind = kind
+        self.hyper = hyper
+        self.lr_mults = list(lr_mults)
+        self.wds = list(wds)
+
+    # -- state -------------------------------------------------------------
+    def init(self, params: List[Any]) -> List[Any]:
+        import jax.numpy as jnp
+        k = self.kind
+        if k == "sgd":
+            if self.hyper.get("momentum", 0.0):
+                return [jnp.zeros_like(p) for p in params]
+            return [() for _ in params]
+        if k in ("nag", "signum"):
+            # momentum state even at mu=0 (formulas degrade gracefully)
+            return [jnp.zeros_like(p) for p in params]
+        if k == "adam":
+            return [(jnp.zeros_like(p), jnp.zeros_like(p)) for p in params]
+        if k == "adagrad":
+            return [jnp.zeros_like(p) for p in params]
+        if k == "rmsprop":
+            if self.hyper.get("centered", False):
+                return [(jnp.zeros_like(p), jnp.zeros_like(p),
+                         jnp.zeros_like(p)) for p in params]
+            return [jnp.zeros_like(p) for p in params]
+        raise MXNetError(f"no functional lowering for optimizer {k!r}")
+
+    # -- update ------------------------------------------------------------
+    def update(self, params, grads, state, t, base_lr, rescale):
+        import jax.numpy as jnp
+        h = self.hyper
+        clip = h.get("clip_gradient") or 0.0
+        new_p, new_s = [], []
+        for i, (w, g, s) in enumerate(zip(params, grads, state)):
+            lr = (base_lr * self.lr_mults[i]).astype(w.dtype)
+            wd = self.wds[i]
+            g = g * rescale.astype(g.dtype)
+            if clip and clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            k = self.kind
+            if k != "adagrad":   # adagrad: decoupled wd (fused-op parity)
+                g = g + wd * w
+            if k == "sgd":
+                mu = h.get("momentum", 0.0)
+                if mu:
+                    m = mu * s - lr * g
+                    w, s = w + m, m
+                else:
+                    w = w - lr * g
+            elif k == "nag":
+                mu = h.get("momentum", 0.0)
+                m = mu * s + g
+                w, s = w - lr * (g + mu * m), m
+            elif k == "signum":
+                mu = h.get("momentum", 0.0)
+                wd_lh = h.get("wd_lh", 0.0)
+                m = mu * s - (1 - mu) * g
+                w, s = (1 - lr * wd_lh) * w + lr * jnp.sign(m), m
+            elif k == "adam":
+                b1, b2 = h["beta1"], h["beta2"]
+                eps = h["epsilon"]
+                # bias-corrected lr, t is a traced count (reference Adam)
+                tt = t.astype(jnp.float32)
+                coef = jnp.sqrt(1.0 - b2 ** tt) / (1.0 - b1 ** tt)
+                m, v = s
+                m = b1 * m + (1 - b1) * g
+                v = b2 * v + (1 - b2) * jnp.square(g)
+                w = w - (lr * coef.astype(w.dtype)) * m / (jnp.sqrt(v) + eps)
+                s = (m, v)
+            elif k == "adagrad":
+                eps = h.get("eps", 1e-7)
+                s = s + jnp.square(g)
+                w = w - lr * (g / jnp.sqrt(s + eps) + wd * w)
+            elif k == "rmsprop":
+                g1 = h.get("gamma1", 0.95)
+                eps = h.get("epsilon", 1e-8)
+                if h.get("centered", False):
+                    # rmspropalex (centered) — mirrors the fused op exactly
+                    n, mg, d = s
+                    n = g1 * n + (1 - g1) * jnp.square(g)
+                    mg = g1 * mg + (1 - g1) * g
+                    d = h.get("gamma2", 0.9) * d - \
+                        lr * g / jnp.sqrt(n - jnp.square(mg) + eps)
+                    w = w + d
+                    s = (n, mg, d)
+                else:
+                    s = g1 * s + (1 - g1) * jnp.square(g)
+                    w = w - lr * g / jnp.sqrt(s + eps)
+                cw = h.get("clip_weights") or 0.0
+                if cw and cw > 0:
+                    w = jnp.clip(w, -cw, cw)
+            else:
+                raise MXNetError(f"no functional lowering for {k!r}")
+            new_p.append(w)
+            new_s.append(s)
+        return new_p, new_s
+
+
+def make_functional_optimizer(opt: "opt_mod.Optimizer",
+                              param_names: Sequence[str]) -> FunctionalOptimizer:
+    """Lower an imperative Optimizer instance (reference API) to the pure
+    pytree form, capturing per-param lr_mult/wd_mult by name/index."""
+    kind = type(opt).__name__.lower()
+    hyper = dict(
+        momentum=getattr(opt, "momentum", 0.0),
+        beta1=getattr(opt, "beta1", 0.9),
+        beta2=getattr(opt, "beta2", 0.999),
+        epsilon=getattr(opt, "epsilon", 1e-8),
+        eps=getattr(opt, "float_stable_eps", 1e-7),
+        gamma1=getattr(opt, "gamma1", 0.95),
+        gamma2=getattr(opt, "gamma2", 0.9),
+        centered=getattr(opt, "centered", False),
+        clip_weights=getattr(opt, "clip_weights", None),
+        wd_lh=getattr(opt, "wd_lh", 0.0),
+        clip_gradient=getattr(opt, "clip_gradient", None),
+    )
+    def _mult(table, i, name):
+        p = opt.param_dict.get(i)
+        attr = "lr_mult" if table is opt.lr_mult else "wd_mult"
+        if p is not None:
+            return getattr(p, attr, 1.0)
+        if i in table:
+            return table[i]
+        return table.get(name, 1.0)
+
+    lr_mults, wds = [], []
+    for i, name in enumerate(param_names):
+        lr_mults.append(float(_mult(opt.lr_mult, i, name)))
+        wds.append(float(opt.wd) * float(_mult(opt.wd_mult, i, name)))
+    return FunctionalOptimizer(kind, hyper, lr_mults, wds)

@@ -1,0 +1,243 @@
+"""ShardedTrainer: the whole training step as ONE jitted XLA computation
+over a device mesh.
+
+Reference parity: this subsumes the reference's data-parallel machinery —
+`split_and_load` + Trainer.step → KVStore push/pull → fused optimizer ops
+(python/mxnet/gluon/trainer.py, src/kvstore/comm.h — SURVEY.md §2.3, §3.2).
+TPU-native design (the BASELINE north star): instead of object-level
+push/pull loops, the step function
+
+    (params, aux, opt_state, key, t, lr, rescale, x, y)
+        -> (params', aux', opt_state', loss)
+
+is jitted with `NamedSharding`s: batch sharded over the 'dp' mesh axis,
+params replicated (or tensor-parallel via ShardingRules), so XLA emits the
+gradient psum over ICI that the reference performed through NCCL, fuses it
+with the optimizer update, and donates the param buffers (true in-place
+update at the HBM level).  Numerics match the imperative Trainer exactly
+(same formulas — parallel/optim.py).
+"""
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import current_context
+from .. import autograd as _autograd
+from .. import optimizer as opt_mod
+from .. import random as _grandom
+from ..ndarray import NDArray
+from ..gluon.block import _TraceCtx, _KeyScope
+from ..gluon.parameter import Parameter
+from .mesh import ShardingRules, default_mesh, replicated, shard
+from .optim import make_functional_optimizer
+
+__all__ = ["ShardedTrainer"]
+
+
+class ShardedTrainer:
+    """Data/tensor/sequence-parallel trainer over a jax Mesh.
+
+    Parameters
+    ----------
+    block : gluon.Block — the model (need not be hybridized; the step IS
+        the jit).
+    loss : callable — `loss(out, y) -> NDArray` (a gluon loss Block works).
+    optimizer : str or Optimizer — lowered to a pure update (optim.py).
+    mesh : jax.sharding.Mesh — default: all devices on 'dp'.
+    rules : ShardingRules — parameter PartitionSpecs (tensor parallelism).
+    data_spec / label_spec : PartitionSpec tuples for the batch, default
+        ('dp',) — add 'sp' on the sequence dim for context parallelism,
+        e.g. data_spec=('dp', 'sp').
+    """
+
+    def __init__(self, block, loss: Callable, optimizer,
+                 optimizer_params: Optional[dict] = None, mesh=None,
+                 rules: Optional[ShardingRules] = None,
+                 data_spec: Sequence = ("dp",),
+                 label_spec: Optional[Sequence] = None):
+        self._block = block
+        self._loss = loss
+        self._mesh = mesh if mesh is not None else default_mesh()
+        self._rules = rules if rules is not None else ShardingRules()
+        self._data_spec = tuple(data_spec)
+        self._label_spec = tuple(label_spec) if label_spec is not None \
+            else (self._data_spec[0],)
+        optimizer_params = optimizer_params or {}
+        self._optimizer = opt_mod.create(optimizer, **optimizer_params)
+        self._scale = self._optimizer.rescale_grad
+        self._built = False
+        self._t = 0
+        self._ctx = current_context()
+
+    # -- lazy build --------------------------------------------------------
+    def _ensure_built(self, x: _np.ndarray, y: _np.ndarray) -> None:
+        if self._built:
+            return
+        import jax
+        import jax.numpy as jnp
+
+        # one tiny eager forward to settle deferred param shapes
+        probe = NDArray(jnp.asarray(x[:1]), ctx=self._ctx)
+        self._block(probe)
+
+        all_params = list(self._block.collect_params().values())
+        self._train_params: List[Parameter] = \
+            [p for p in all_params if p.grad_req != "null"]
+        self._aux_params: List[Parameter] = \
+            [p for p in all_params if p.grad_req == "null"]
+        self._optimizer.param_dict = {
+            i: p for i, p in enumerate(self._train_params)}
+        names = [p.name for p in self._train_params]
+        self._fopt = make_functional_optimizer(self._optimizer, names)
+
+        mesh = self._mesh
+        self._p_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
+                      for p in self._train_params]
+        self._a_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
+                      for p in self._aux_params]
+        self._x_sh = shard(mesh, *self._data_spec)
+        self._y_sh = shard(mesh, *self._label_spec)
+        self._r_sh = replicated(mesh)
+
+        # move weights onto the mesh — the trainer owns them from here on
+        self._pvals = [jax.device_put(p.data(self._ctx)._read(), s)
+                       for p, s in zip(self._train_params, self._p_sh)]
+        self._avals = [jax.device_put(p.data(self._ctx)._read(), s)
+                       for p, s in zip(self._aux_params, self._a_sh)]
+        state = self._fopt.init(self._pvals)
+        self._s_sh = [jax.tree.map(lambda _, sh=sh: sh, st)
+                      for st, sh in zip(state, self._p_sh)]
+        self._state = jax.tree.map(
+            lambda v, s: jax.device_put(v, s), state, self._s_sh)
+
+        block, loss_blk = self._block, self._loss
+        tparams, aparams = self._train_params, self._aux_params
+        fopt, ctx = self._fopt, self._ctx
+
+        def apply_fn(pvals, avals, key, xv, training, yv=None):
+            """Shared traced forward (+ optional loss) for train and eval."""
+            tw = [NDArray(v, ctx=ctx) for v in pvals]
+            aw = [NDArray(v, ctx=ctx) for v in avals]
+            subs = {id(p): w for p, w in zip(tparams + aparams, tw + aw)}
+            with _TraceCtx(subs), \
+                    _autograd._RecordingScope(False, training), \
+                    _KeyScope(key):
+                out = block(NDArray(xv, ctx=ctx))
+                l_nd = loss_blk(out, NDArray(yv, ctx=ctx)) \
+                    if yv is not None else None
+            for w in tw:
+                if w._version > 0:
+                    raise MXNetError(
+                        "in-place write to a trainable parameter inside the "
+                        "sharded step is not supported")
+            new_avals = [w._read() if w._version > 0 else v
+                         for w, v in zip(aw, avals)]
+            return out, l_nd, new_avals
+
+        def step_fn(pvals, avals, state, key, t, lr, rescale, xv, yv):
+            def loss_of(pv):
+                _, l_nd, new_avals = apply_fn(pv, avals, key, xv, True, yv)
+                lraw = l_nd._read()
+                # reference semantics: loss.backward() seeds ones (sum), and
+                # Trainer.step(batch_size) folds the 1/batch rescale into the
+                # optimizer — so differentiate the SUM and apply `rescale`
+                # in the update; the MEAN is what we report
+                return jnp.sum(lraw), (jnp.mean(lraw), new_avals)
+
+            (_, (lval, new_avals)), grads = \
+                jax.value_and_grad(loss_of, has_aux=True)(pvals)
+            new_pvals, new_state = fopt.update(
+                pvals, grads, state, t, lr, rescale)
+            return new_pvals, new_avals, new_state, lval
+
+        self._jit_step = jax.jit(
+            step_fn,
+            in_shardings=(self._p_sh, self._a_sh, self._s_sh,
+                          self._r_sh, self._r_sh, self._r_sh, self._r_sh,
+                          self._x_sh, self._y_sh),
+            out_shardings=(self._p_sh, self._a_sh, self._s_sh, self._r_sh),
+            donate_argnums=(0, 1, 2))
+
+        def fwd_fn(pvals, avals, key, xv):
+            out, _, _ = apply_fn(pvals, avals, key, xv, False)
+            if isinstance(out, (list, tuple)):
+                return tuple(o._read() for o in out)
+            return out._read()
+
+        self._jit_fwd = jax.jit(
+            fwd_fn, in_shardings=(self._p_sh, self._a_sh,
+                                  self._r_sh, self._x_sh))
+        self._built = True
+
+    # -- public API --------------------------------------------------------
+    @property
+    def mesh(self):
+        return self._mesh
+
+    @property
+    def optimizer(self):
+        return self._optimizer
+
+    @property
+    def learning_rate(self) -> float:
+        return self._optimizer.learning_rate
+
+    def set_learning_rate(self, lr: float) -> None:
+        self._optimizer.set_learning_rate(lr)
+
+    def step(self, x, y, batch_size: Optional[int] = None):
+        """Run one sharded train step; returns the (device) mean loss."""
+        import jax
+        import jax.numpy as jnp
+        xv = x._read() if isinstance(x, NDArray) else _np.asarray(x)
+        yv = y._read() if isinstance(y, NDArray) else _np.asarray(y)
+        self._ensure_built(xv, yv)
+        if batch_size is None:
+            batch_size = int(xv.shape[0])
+        self._t += 1
+        self._optimizer.num_update = self._t
+        key = _grandom.next_key()
+        xv = jax.device_put(xv, self._x_sh)
+        yv = jax.device_put(yv, self._y_sh)
+        t = jnp.asarray(self._t, dtype=jnp.int32)
+        lr = jnp.asarray(self._optimizer.learning_rate, dtype=jnp.float32)
+        rescale = jnp.asarray(self._scale / batch_size, dtype=jnp.float32)
+        self._pvals, self._avals, self._state, lval = self._jit_step(
+            self._pvals, self._avals, self._state, key, t, lr, rescale,
+            xv, yv)
+        return NDArray(lval, ctx=self._ctx)
+
+    def forward(self, x):
+        """Sharded inference forward with the trainer-owned weights."""
+        import jax
+        xv = x._read() if isinstance(x, NDArray) else _np.asarray(x)
+        if not self._built:
+            raise MXNetError("run at least one step() before forward(), or "
+                             "use the block directly")
+        key = _grandom.next_key()
+        out = self._jit_fwd(self._pvals, self._avals, key,
+                            jax.device_put(xv, self._x_sh))
+        if isinstance(out, tuple):
+            return tuple(NDArray(o, ctx=self._ctx) for o in out)
+        return NDArray(out, ctx=self._ctx)
+
+    def sync_params(self) -> None:
+        """Copy trainer-owned (sharded) weights back into the block's
+        Parameters (gathered to the default device) — call before
+        save_parameters/export."""
+        import jax
+        with _autograd.pause():
+            for p, v in zip(self._train_params, self._pvals):
+                p.data(self._ctx)._set_data(
+                    _np_to_dev(jax.device_get(v), self._ctx))
+            for p, v in zip(self._aux_params, self._avals):
+                p.data(self._ctx)._set_data(
+                    _np_to_dev(jax.device_get(v), self._ctx))
+
+
+def _np_to_dev(val, ctx):
+    import jax.numpy as jnp
+    return jnp.asarray(val)
