@@ -1,3 +1,8 @@
-"""gluon.model_zoo (reference: python/mxnet/gluon/model_zoo/)."""
+"""gluon.model_zoo (reference: python/mxnet/gluon/model_zoo/; the
+transformer family covers the GluonNLP/Sockeye configs the BASELINE names —
+those are downstream repos in the reference ecosystem, SURVEY.md §1)."""
 from . import vision
+from . import transformer
 from .vision import get_model
+from .transformer import (BERTModel, TransformerNMT, bert_base, bert_small,
+                          transformer_nmt_base, TP_RULES)

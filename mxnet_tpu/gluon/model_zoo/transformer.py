@@ -1,0 +1,374 @@
+"""Transformer model family: BERT (GluonNLP-style) and seq2seq NMT
+(Sockeye-style).
+
+Reference parity: the reference framework itself ships no transformer — the
+BASELINE configs #3 (BERT-base pretrain, GluonNLP) and #4 (Sockeye
+transformer NMT) are downstream repos built on Gluon/Symbol APIs
+(SURVEY.md §1 tail).  This module provides the equivalent model family on
+our Gluon, written TPU-first:
+
+- one fused QKV projection per attention block (single MXU matmul),
+- parameter names chose so `TP_RULES` (megatron-style tensor parallelism)
+  applies by regex: `*qkv_weight` column-parallel, `*proj_weight`
+  row-parallel, `*ffn1*` column-, `*ffn2*` row-parallel,
+- static shapes throughout (mask arrives as a runtime tensor, never a
+  Python branch), so one XLA computation per (batch, seq) bucket —
+  the BucketingModule discipline of SURVEY.md §5.7.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
+
+__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+           "TransformerDecoderCell", "TransformerEncoder",
+           "TransformerDecoder", "TransformerNMT", "BERTEncoder",
+           "BERTModel", "bert_base", "bert_small", "transformer_nmt_base",
+           "TP_RULES"]
+
+#: megatron-style tensor-parallel PartitionSpecs for this family — pass to
+#: parallel.ShardingRules(TP_RULES)
+TP_RULES = [
+    (r".*qkv_weight$", ("tp", None)),
+    (r".*qkv_bias$", ("tp",)),
+    (r".*kv_weight$", ("tp", None)),
+    (r".*kv_bias$", ("tp",)),
+    (r".*proj_weight$", (None, "tp")),
+    (r".*ffn1_weight$", ("tp", None)),
+    (r".*ffn1_bias$", ("tp",)),
+    (r".*ffn2_weight$", (None, "tp")),
+    (r".*word_embed_weight$", ("tp", None)),
+]
+
+
+def _masked_softmax(F, scores, mask):
+    """scores (B*H, Sq, Sk); mask same shape, 1=keep, 0=drop (any dtype)."""
+    if mask is not None:
+        # additive -1e9 mask (pad-and-mask — the XLA-friendly form)
+        scores = scores + (F.cast(mask, dtype="float32") - 1.0) * 1e9
+    return F.softmax(scores, axis=-1)
+
+
+class MultiHeadAttention(HybridBlock):
+    """Scaled dot-product attention with fused QKV.
+
+    Self-attention: call with (x, mask).  Cross-attention: (x, mask, mem)
+    — queries from x, keys/values from mem (one q proj + one fused kv).
+    """
+
+    def __init__(self, units, num_heads, dropout=0.0, self_attention=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._self = self_attention
+        with self.name_scope():
+            if self_attention:
+                self.qkv = Dense(3 * units, flatten=False, in_units=units,
+                                 prefix="qkv_")
+            else:
+                self.q_proj = Dense(units, flatten=False, in_units=units,
+                                    prefix="q_")
+                self.kv = Dense(2 * units, flatten=False, in_units=units,
+                                prefix="kv_")
+            self.proj = Dense(units, flatten=False, in_units=units,
+                              prefix="proj_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def _split_heads(self, F, x, batch, seq):
+        # (B, S, U) -> (B*H, S, d)
+        x = F.reshape(x, shape=(batch, seq, self._heads,
+                                self._units // self._heads))
+        x = F.transpose(x, axes=(0, 2, 1, 3))
+        return F.reshape(x, shape=(batch * self._heads, seq,
+                                   self._units // self._heads))
+
+    def _merge_heads(self, F, x, batch, seq):
+        x = F.reshape(x, shape=(batch, self._heads, seq,
+                                self._units // self._heads))
+        x = F.transpose(x, axes=(0, 2, 1, 3))
+        return F.reshape(x, shape=(batch, seq, self._units))
+
+    def hybrid_forward(self, F, x, mask=None, mem=None):
+        b, sq = x.shape[0], x.shape[1]
+        if self._self:
+            qkv = self.qkv(x)
+            q, k, v = F.split(qkv, num_outputs=3, axis=-1)
+            sk = sq
+        else:
+            if mem is None:
+                raise MXNetError("cross-attention needs memory input")
+            q = self.q_proj(x)
+            kv = self.kv(mem)
+            k, v = F.split(kv, num_outputs=2, axis=-1)
+            sk = mem.shape[1]
+        q = self._split_heads(F, q, b, sq)
+        k = self._split_heads(F, k, b, sk)
+        v = self._split_heads(F, v, b, sk)
+        scale = 1.0 / math.sqrt(self._units // self._heads)
+        scores = F.batch_dot(q, k, transpose_b=True) * scale
+        att = _masked_softmax(F, scores, mask)
+        if self.drop is not None:
+            att = self.drop(att)
+        out = F.batch_dot(att, v)
+        return self.proj(self._merge_heads(F, out, b, sq))
+
+
+class PositionwiseFFN(HybridBlock):
+    def __init__(self, units, hidden_size, dropout=0.0, activation="gelu",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.ffn1 = Dense(hidden_size, flatten=False, in_units=units,
+                              prefix="ffn1_")
+            self.ffn2 = Dense(units, flatten=False, in_units=hidden_size,
+                              prefix="ffn2_")
+            self.drop = Dropout(dropout) if dropout else None
+        self._act = activation
+
+    def hybrid_forward(self, F, x):
+        h = self.ffn1(x)
+        if self._act == "gelu":
+            h = F.LeakyReLU(h, act_type="gelu")   # exact (erf) gelu op
+        else:
+            h = F.Activation(h, act_type=self._act)
+        if self.drop is not None:
+            h = self.drop(h)
+        return self.ffn2(h)
+
+
+class TransformerEncoderCell(HybridBlock):
+    """Post-LN encoder layer (BERT/Sockeye convention)."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="gelu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attn = MultiHeadAttention(units, num_heads, dropout,
+                                           prefix="attn_")
+            self.ln1 = LayerNorm(in_channels=units, prefix="ln1_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation, prefix="ffn_")
+            self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, mask=None):
+        a = self.attn(x, mask)
+        if self.drop is not None:
+            a = self.drop(a)
+        x = self.ln1(x + a)
+        f = self.ffn(x)
+        if self.drop is not None:
+            f = self.drop(f)
+        return self.ln2(x + f)
+
+
+class TransformerDecoderCell(HybridBlock):
+    """Decoder layer: causal self-attention + cross-attention + FFN."""
+
+    def __init__(self, units, hidden_size, num_heads, dropout=0.0,
+                 activation="relu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.self_attn = MultiHeadAttention(units, num_heads, dropout,
+                                                prefix="selfattn_")
+            self.ln1 = LayerNorm(in_channels=units, prefix="ln1_")
+            self.cross_attn = MultiHeadAttention(
+                units, num_heads, dropout, self_attention=False,
+                prefix="crossattn_")
+            self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation, prefix="ffn_")
+            self.ln3 = LayerNorm(in_channels=units, prefix="ln3_")
+
+    def hybrid_forward(self, F, x, causal_mask=None, mem=None,
+                       mem_mask=None):
+        x = self.ln1(x + self.self_attn(x, causal_mask))
+        x = self.ln2(x + self.cross_attn(x, mem_mask, mem))
+        return self.ln3(x + self.ffn(x))
+
+
+def _positions(F, batch, seq):
+    pos = F.arange(seq, dtype="int32")
+    return F.broadcast_to(F.reshape(pos, shape=(1, seq)), shape=(batch, seq))
+
+
+class TransformerEncoder(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048,
+                 num_heads=8, max_length=512, dropout=0.0,
+                 activation="gelu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.pos_embed = Embedding(max_length, units,
+                                       prefix="pos_embed_")
+            self.cells = HybridSequential(prefix="layers_")
+            with self.cells.name_scope():
+                for _ in range(num_layers):
+                    self.cells.add(TransformerEncoderCell(
+                        units, hidden_size, num_heads, dropout, activation))
+
+    def hybrid_forward(self, F, x, mask=None):
+        """x: (B, S, units) embedded input; mask: (B, S) 1=valid."""
+        b, s = x.shape[0], x.shape[1]
+        x = x + self.pos_embed(_positions(F, b, s))
+        att_mask = None
+        if mask is not None:
+            # (B,S) -> (B,1,1,S) -> (B*H, Sq, Sk)
+            att_mask = F.reshape(mask, shape=(b, 1, 1, s))
+            att_mask = F.broadcast_to(att_mask,
+                                      shape=(b, self._heads, s, s))
+            att_mask = F.reshape(att_mask, shape=(-1, s, s))
+        for cell in self.cells:
+            x = cell(x, att_mask)
+        return x
+
+
+class TransformerDecoder(HybridBlock):
+    def __init__(self, num_layers=6, units=512, hidden_size=2048,
+                 num_heads=8, max_length=512, dropout=0.0,
+                 activation="relu", prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.pos_embed = Embedding(max_length, units,
+                                       prefix="pos_embed_")
+            self.cells = HybridSequential(prefix="layers_")
+            with self.cells.name_scope():
+                for _ in range(num_layers):
+                    self.cells.add(TransformerDecoderCell(
+                        units, hidden_size, num_heads, dropout, activation))
+
+    def hybrid_forward(self, F, x, mem, mem_mask=None):
+        b, s = x.shape[0], x.shape[1]
+        sm = mem.shape[1]
+        x = x + self.pos_embed(_positions(F, b, s))
+        # causal mask (1,S,S) -> (B*H,S,S)
+        pos = F.arange(s, dtype="int32")
+        causal = F.broadcast_greater_equal(F.reshape(pos, shape=(s, 1)),
+                                           F.reshape(pos, shape=(1, s)))
+        causal = F.broadcast_to(F.reshape(causal, shape=(1, s, s)),
+                                shape=(b * self._heads, s, s))
+        mmask = None
+        if mem_mask is not None:
+            mmask = F.reshape(mem_mask, shape=(b, 1, 1, sm))
+            mmask = F.broadcast_to(mmask,
+                                   shape=(b, self._heads, s, sm))
+            mmask = F.reshape(mmask, shape=(-1, s, sm))
+        for cell in self.cells:
+            x = cell(x, causal, mem, mmask)
+        return x
+
+
+class TransformerNMT(HybridBlock):
+    """Sockeye-style seq2seq transformer (BASELINE config #4): shared
+    source/target vocab embedding, encoder-decoder, tied output proj."""
+
+    def __init__(self, vocab_size, num_layers=6, units=512,
+                 hidden_size=2048, num_heads=8, max_length=512,
+                 dropout=0.0, tie_weights=True, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units,
+                                        prefix="word_embed_")
+            self.encoder = TransformerEncoder(
+                num_layers, units, hidden_size, num_heads, max_length,
+                dropout, activation="relu", prefix="enc_")
+            self.decoder = TransformerDecoder(
+                num_layers, units, hidden_size, num_heads, max_length,
+                dropout, activation="relu", prefix="dec_")
+            self.out_proj = Dense(vocab_size, flatten=False,
+                                  in_units=units, use_bias=False,
+                                  prefix="out_")
+            if tie_weights:
+                # weight tying: Dense weight (V, U) shares the Embedding
+                # parameter (V, U) — drop the Dense's own weight entirely
+                del self.out_proj.params._params[self.out_proj.weight.name]
+                self.out_proj.weight = self.word_embed.weight
+                self.out_proj._reg_params["weight"] = self.word_embed.weight
+
+    def hybrid_forward(self, F, src, tgt, src_mask=None):
+        scale = math.sqrt(self._units)
+        mem = self.encoder(self.word_embed(src) * scale, src_mask)
+        dec = self.decoder(self.word_embed(tgt) * scale, mem, src_mask)
+        return self.out_proj(dec)
+
+
+class BERTEncoder(TransformerEncoder):
+    """BERT uses the (gelu, post-LN) encoder as-is."""
+
+
+class BERTModel(HybridBlock):
+    """BERT-base-style model (BASELINE config #3): token+segment+position
+    embeddings -> encoder -> (MLM decoder over all positions, NSP head
+    over [CLS])."""
+
+    def __init__(self, vocab_size=30522, num_layers=12, units=768,
+                 hidden_size=3072, num_heads=12, max_length=512,
+                 type_vocab_size=2, dropout=0.1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units,
+                                        prefix="word_embed_")
+            self.token_type_embed = Embedding(type_vocab_size, units,
+                                              prefix="type_embed_")
+            self.embed_ln = LayerNorm(in_channels=units, prefix="embed_ln_")
+            self.embed_drop = Dropout(dropout) if dropout else None
+            self.encoder = BERTEncoder(
+                num_layers, units, hidden_size, num_heads, max_length,
+                dropout, activation="gelu", prefix="enc_")
+            self.pooler = Dense(units, activation="tanh", flatten=False,
+                                in_units=units, prefix="pooler_")
+            # MLM: transform + decoder tied to the word embedding (BERT
+            # convention — decoder keeps its own bias)
+            self.mlm_dense = Dense(units, flatten=False, in_units=units,
+                                   prefix="mlm_dense_")
+            self.mlm_ln = LayerNorm(in_channels=units, prefix="mlm_ln_")
+            self.mlm_decoder = Dense(vocab_size, flatten=False,
+                                     in_units=units, prefix="mlm_out_")
+            del self.mlm_decoder.params._params[
+                self.mlm_decoder.weight.name]
+            self.mlm_decoder.weight = self.word_embed.weight
+            self.mlm_decoder._reg_params["weight"] = self.word_embed.weight
+            self.nsp = Dense(2, flatten=False, in_units=units,
+                             prefix="nsp_")
+
+    def hybrid_forward(self, F, tokens, token_types, valid_mask=None):
+        x = self.word_embed(tokens) + self.token_type_embed(token_types)
+        x = self.embed_ln(x)
+        if self.embed_drop is not None:
+            x = self.embed_drop(x)
+        seq = self.encoder(x, valid_mask)                 # (B, S, U)
+        h = F.LeakyReLU(self.mlm_dense(seq), act_type="gelu")
+        mlm = self.mlm_decoder(self.mlm_ln(h))            # (B, S, V)
+        cls = F.squeeze(F.slice_axis(seq, axis=1, begin=0, end=1), axis=1)
+        nsp = self.nsp(self.pooler(cls))                  # (B, 2)
+        return mlm, nsp
+
+
+def bert_base(vocab_size=30522, **kwargs):
+    return BERTModel(vocab_size=vocab_size, num_layers=12, units=768,
+                     hidden_size=3072, num_heads=12, **kwargs)
+
+
+def bert_small(vocab_size=1000, **kwargs):
+    """Tiny config for tests/dryruns."""
+    kwargs.setdefault("max_length", 128)
+    return BERTModel(vocab_size=vocab_size, num_layers=2, units=64,
+                     hidden_size=128, num_heads=4, **kwargs)
+
+
+def transformer_nmt_base(vocab_size=32000, **kwargs):
+    return TransformerNMT(vocab_size, num_layers=6, units=512,
+                          hidden_size=2048, num_heads=8, **kwargs)

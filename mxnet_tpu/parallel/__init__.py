@@ -8,8 +8,9 @@ and a whole-train-step jit in which XLA inserts the ICI/DCN collectives.
 from .mesh import (MESH_AXES, ShardingRules, default_mesh, make_mesh,
                    replicated, shard)
 from .optim import FunctionalOptimizer, make_functional_optimizer
+from .ring import ring_attention
 from .trainer import ShardedTrainer
 
 __all__ = ["MESH_AXES", "ShardingRules", "default_mesh", "make_mesh",
            "replicated", "shard", "FunctionalOptimizer",
-           "make_functional_optimizer", "ShardedTrainer"]
+           "make_functional_optimizer", "ring_attention", "ShardedTrainer"]

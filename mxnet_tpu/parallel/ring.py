@@ -1,0 +1,107 @@
+"""Ring attention: exact long-context attention over the 'sp' mesh axis.
+
+Reference parity: none — the reference (2018-era) predates sequence
+parallelism entirely; its long-sequence story is bucketing (SURVEY.md §5.7).
+The build mandate makes long-context first-class, so this module provides
+the TPU-native mechanism: keys/values are sharded along the sequence axis,
+and each step of a `lax.fori_loop` computes one block of scores while
+`lax.ppermute` rotates the K/V shards around the ICI ring — compute and
+collective overlap, memory stays O(S_local²·heads) instead of O(S²).
+
+Streaming-softmax accumulation (the flash-attention recurrence) keeps the
+result exact, not approximate.  Causal masking uses global block offsets so
+the rotated blocks mask correctly.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional
+
+__all__ = ["ring_attention"]
+
+
+def _ring_block_attention(q, k, v, axis_name: str, ring_size: int,
+                          causal: bool, scale: float):
+    """Per-shard body under shard_map.
+
+    q, k, v: (BH, S_local, D) — this device's shards.
+    Returns (BH, S_local, D) attention output for the local queries over
+    the GLOBAL key/value sequence.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    n = ring_size                           # static ring size
+    idx = jax.lax.axis_index(axis_name)     # my position on the ring
+    s_local = q.shape[1]
+    perm = [(i, (i + 1) % n) for i in range(n)]   # rotate K/V right
+
+    q_pos = idx * s_local + jnp.arange(s_local)           # global q rows
+    m0 = jnp.full(q.shape[:-1], -jnp.inf, dtype=jnp.float32)
+    l0 = jnp.zeros(q.shape[:-1], dtype=jnp.float32)
+    o0 = jnp.zeros(q.shape, dtype=jnp.float32)
+
+    def accumulate(i, o, l, m, k_blk, v_blk):
+        # after i rotations we hold the block originally on ring slot idx-i
+        blk = (idx - i) % n
+        s = jnp.einsum("bqd,bkd->bqk", q.astype(jnp.float32),
+                       k_blk.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = blk * s_local + jnp.arange(s_local)
+            s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, -jnp.inf)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        # guard the all-masked rows (exp(-inf - -inf))
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(s), p, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + p.sum(axis=-1)
+        o = o * corr[..., None] + jnp.einsum(
+            "bqk,bkd->bqd", p, v_blk.astype(jnp.float32))
+        return o, l, m_new
+
+    def body(i, carry):
+        o, l, m, k_blk, v_blk = carry
+        o, l, m = accumulate(i, o, l, m, k_blk, v_blk)
+        k_blk = jax.lax.ppermute(k_blk, axis_name, perm)
+        v_blk = jax.lax.ppermute(v_blk, axis_name, perm)
+        return o, l, m, k_blk, v_blk
+
+    # n-1 rotate-and-accumulate rounds, then the final block without the
+    # trailing (discarded) ppermute pair
+    o, l, m, k, v = jax.lax.fori_loop(0, n - 1, body, (o0, l0, m0, k, v))
+    o, l, m = accumulate(n - 1, o, l, m, k, v)
+    l = jnp.where(l == 0.0, 1.0, l)         # fully-masked rows -> zeros
+    return (o / l[..., None]).astype(q.dtype)
+
+
+def ring_attention(q, k, v, mesh, seq_axis: str = "sp",
+                   causal: bool = False, scale: Optional[float] = None,
+                   batch_axis: Optional[str] = "dp"):
+    """Exact attention with sequence-sharded K/V rotation over ICI.
+
+    q, k, v: (BH, S, D) jax arrays (global sequence length S); S must be
+    divisible by the 'seq_axis' mesh size.  Batch stays sharded over
+    `batch_axis` (set None if the batch dim is replicated).
+    """
+    import jax
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map
+    except ImportError:   # older jax
+        from jax.experimental.shard_map import shard_map
+
+    if scale is None:
+        scale = 1.0 / math.sqrt(q.shape[-1])
+    spec = P(batch_axis, seq_axis, None)
+    body = functools.partial(_ring_block_attention, axis_name=seq_axis,
+                             ring_size=mesh.shape[seq_axis],
+                             causal=causal, scale=scale)
+    try:
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_vma=False)
+    except TypeError:   # older jax spelling
+        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
+                       out_specs=spec, check_rep=False)
+    return fn(q, k, v)

@@ -73,15 +73,15 @@ class ShardedTrainer:
         self._ctx = current_context()
 
     # -- lazy build --------------------------------------------------------
-    def _ensure_built(self, x: _np.ndarray, y: _np.ndarray) -> None:
+    def _ensure_built(self, xs, y: _np.ndarray) -> None:
         if self._built:
             return
         import jax
         import jax.numpy as jnp
 
         # one tiny eager forward to settle deferred param shapes
-        probe = NDArray(jnp.asarray(x[:1]), ctx=self._ctx)
-        self._block(probe)
+        probes = [NDArray(jnp.asarray(v[:1]), ctx=self._ctx) for v in xs]
+        self._block(*probes)
 
         all_params = list(self._block.collect_params().values())
         self._train_params: List[Parameter] = \
@@ -98,8 +98,12 @@ class ShardedTrainer:
                       for p in self._train_params]
         self._a_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
                       for p in self._aux_params]
-        self._x_sh = shard(mesh, *self._data_spec)
-        self._y_sh = shard(mesh, *self._label_spec)
+        # per-input sharding: the data spec truncated to each input's rank
+        self._x_sh = tuple(
+            shard(mesh, *self._data_spec[:_np.asarray(v).ndim])
+            for v in xs)
+        self._y_sh = shard(mesh,
+                           *self._label_spec[:_np.asarray(y).ndim])
         self._r_sh = replicated(mesh)
 
         # move weights onto the mesh — the trainer owns them from here on
@@ -118,14 +122,16 @@ class ShardedTrainer:
         fopt, ctx = self._fopt, self._ctx
 
         def apply_fn(pvals, avals, key, xv, training, yv=None):
-            """Shared traced forward (+ optional loss) for train and eval."""
+            """Shared traced forward (+ optional loss) for train and eval.
+            xv is a tuple of input arrays (multi-input models: BERT takes
+            tokens/token_types/mask)."""
             tw = [NDArray(v, ctx=ctx) for v in pvals]
             aw = [NDArray(v, ctx=ctx) for v in avals]
             subs = {id(p): w for p, w in zip(tparams + aparams, tw + aw)}
             with _TraceCtx(subs), \
                     _autograd._RecordingScope(False, training), \
                     _KeyScope(key):
-                out = block(NDArray(xv, ctx=ctx))
+                out = block(*[NDArray(v, ctx=ctx) for v in xv])
                 l_nd = loss_blk(out, NDArray(yv, ctx=ctx)) \
                     if yv is not None else None
             for w in tw:
@@ -189,18 +195,19 @@ class ShardedTrainer:
         self._optimizer.set_learning_rate(lr)
 
     def step(self, x, y, batch_size: Optional[int] = None):
-        """Run one sharded train step; returns the (device) mean loss."""
+        """Run one sharded train step; returns the (device) mean loss.
+        `x` may be a single array or a tuple of inputs."""
         import jax
         import jax.numpy as jnp
-        xv = x._read() if isinstance(x, NDArray) else _np.asarray(x)
+        xv = _to_vals(x)
         yv = y._read() if isinstance(y, NDArray) else _np.asarray(y)
         self._ensure_built(xv, yv)
         if batch_size is None:
-            batch_size = int(xv.shape[0])
+            batch_size = int(xv[0].shape[0])
         self._t += 1
         self._optimizer.num_update = self._t
         key = _grandom.next_key()
-        xv = jax.device_put(xv, self._x_sh)
+        xv = tuple(jax.device_put(v, s) for v, s in zip(xv, self._x_sh))
         yv = jax.device_put(yv, self._y_sh)
         t = jnp.asarray(self._t, dtype=jnp.int32)
         lr = jnp.asarray(self._optimizer.learning_rate, dtype=jnp.float32)
@@ -213,13 +220,14 @@ class ShardedTrainer:
     def forward(self, x):
         """Sharded inference forward with the trainer-owned weights."""
         import jax
-        xv = x._read() if isinstance(x, NDArray) else _np.asarray(x)
+        xv = _to_vals(x)
         if not self._built:
             raise MXNetError("run at least one step() before forward(), or "
                              "use the block directly")
         key = _grandom.next_key()
         out = self._jit_fwd(self._pvals, self._avals, key,
-                            jax.device_put(xv, self._x_sh))
+                            tuple(jax.device_put(v, s)
+                                  for v, s in zip(xv, self._x_sh)))
         if isinstance(out, tuple):
             return tuple(NDArray(o, ctx=self._ctx) for o in out)
         return NDArray(out, ctx=self._ctx)
@@ -229,6 +237,8 @@ class ShardedTrainer:
         Parameters (gathered to the default device) — call before
         save_parameters/export."""
         import jax
+        if not self._built:
+            return   # pre-build, the block still owns the weights
         with _autograd.pause():
             for p, v in zip(self._train_params, self._pvals):
                 p.data(self._ctx)._set_data(
@@ -241,3 +251,11 @@ class ShardedTrainer:
 def _np_to_dev(val, ctx):
     import jax.numpy as jnp
     return jnp.asarray(val)
+
+
+def _to_vals(x):
+    """Normalize a single array / NDArray or a tuple of them to a tuple of
+    raw values."""
+    xs = x if isinstance(x, (tuple, list)) else (x,)
+    return tuple(v._read() if isinstance(v, NDArray) else _np.asarray(v)
+                 for v in xs)
