@@ -1,0 +1,76 @@
+"""BASELINE benchmark: ResNet-50 training throughput (images/sec/chip).
+
+One whole-step XLA computation (forward + backward + SGD-momentum update,
+gradient psum over the mesh when >1 device) on synthetic ImageNet-shaped
+data — the TPU-native analog of the reference's
+example/image-classification Speedometer number (SURVEY.md §6).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+vs_baseline is against 375 img/s/chip — the fp32 V100 planning envelope
+from SURVEY.md §6 (no published number survived in the reference mount).
+"""
+import argparse
+import json
+import time
+
+import numpy as np
+
+BASELINE_IMG_S_PER_CHIP = 375.0
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--batch", type=int, default=128,
+                    help="global batch size")
+    ap.add_argument("--iters", type=int, default=30)
+    ap.add_argument("--warmup", type=int, default=5)
+    ap.add_argument("--size", type=int, default=224)
+    args = ap.parse_args()
+
+    import jax
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import loss as gloss
+    from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1
+
+    n_dev = len(jax.devices())
+    batch = max(args.batch, n_dev) // n_dev * n_dev
+
+    net = resnet50_v1()
+    net.initialize()
+    tr = par.ShardedTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+        {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal(
+        (batch, 3, args.size, args.size), dtype=np.float32)
+    y = rng.integers(0, 1000, (batch,))
+
+    loss = tr.step(x, y)  # build + compile
+    # keep the batch resident in HBM: real input pipelines prefetch to
+    # device; re-uploading 38MB/step over PCIe/tunnel would bench the link
+    x = jax.device_put(x, tr._x_sh[0])
+    y = jax.device_put(np.asarray(y), tr._y_sh)
+    for _ in range(args.warmup):
+        loss = tr.step(x, y)
+    float(loss.asnumpy())  # sync
+
+    t0 = time.perf_counter()
+    for _ in range(args.iters):
+        loss = tr.step(x, y)
+    lval = float(loss.asnumpy())  # sync
+    dt = time.perf_counter() - t0
+
+    assert np.isfinite(lval), "non-finite loss in benchmark"
+    img_s = batch * args.iters / dt
+    per_chip = img_s / n_dev
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMG_S_PER_CHIP, 3),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -37,6 +37,8 @@ TP_RULES = [
     (r".*qkv_bias$", ("tp",)),
     (r".*kv_weight$", ("tp", None)),
     (r".*kv_bias$", ("tp",)),
+    (r".*q_weight$", ("tp", None)),
+    (r".*q_bias$", ("tp",)),
     (r".*proj_weight$", (None, "tp")),
     (r".*ffn1_weight$", ("tp", None)),
     (r".*ffn1_bias$", ("tp",)),
@@ -195,6 +197,14 @@ class TransformerDecoderCell(HybridBlock):
         return self.ln3(x + self.ffn(x))
 
 
+def _tie_weight(dense, embed):
+    """Share an Embedding's (V, U) weight with a Dense output projection —
+    the Dense's own weight parameter is dropped entirely."""
+    del dense.params._params[dense.weight.name]
+    dense.weight = embed.weight
+    dense._reg_params["weight"] = embed.weight
+
+
 def _positions(F, batch, seq):
     pos = F.arange(seq, dtype="int32")
     return F.broadcast_to(F.reshape(pos, shape=(1, seq)), shape=(batch, seq))
@@ -207,6 +217,7 @@ class TransformerEncoder(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         self._heads = num_heads
+        self._max_len = max_length
         with self.name_scope():
             self.pos_embed = Embedding(max_length, units,
                                        prefix="pos_embed_")
@@ -219,6 +230,9 @@ class TransformerEncoder(HybridBlock):
     def hybrid_forward(self, F, x, mask=None):
         """x: (B, S, units) embedded input; mask: (B, S) 1=valid."""
         b, s = x.shape[0], x.shape[1]
+        if s > self._max_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_length={self._max_len}")
         x = x + self.pos_embed(_positions(F, b, s))
         att_mask = None
         if mask is not None:
@@ -239,6 +253,7 @@ class TransformerDecoder(HybridBlock):
         super().__init__(prefix=prefix, params=params)
         self._units = units
         self._heads = num_heads
+        self._max_len = max_length
         with self.name_scope():
             self.pos_embed = Embedding(max_length, units,
                                        prefix="pos_embed_")
@@ -250,6 +265,9 @@ class TransformerDecoder(HybridBlock):
 
     def hybrid_forward(self, F, x, mem, mem_mask=None):
         b, s = x.shape[0], x.shape[1]
+        if s > self._max_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_length={self._max_len}")
         sm = mem.shape[1]
         x = x + self.pos_embed(_positions(F, b, s))
         # causal mask (1,S,S) -> (B*H,S,S)
@@ -291,11 +309,7 @@ class TransformerNMT(HybridBlock):
                                   in_units=units, use_bias=False,
                                   prefix="out_")
             if tie_weights:
-                # weight tying: Dense weight (V, U) shares the Embedding
-                # parameter (V, U) — drop the Dense's own weight entirely
-                del self.out_proj.params._params[self.out_proj.weight.name]
-                self.out_proj.weight = self.word_embed.weight
-                self.out_proj._reg_params["weight"] = self.word_embed.weight
+                _tie_weight(self.out_proj, self.word_embed)
 
     def hybrid_forward(self, F, src, tgt, src_mask=None):
         scale = math.sqrt(self._units)
@@ -337,10 +351,7 @@ class BERTModel(HybridBlock):
             self.mlm_ln = LayerNorm(in_channels=units, prefix="mlm_ln_")
             self.mlm_decoder = Dense(vocab_size, flatten=False,
                                      in_units=units, prefix="mlm_out_")
-            del self.mlm_decoder.params._params[
-                self.mlm_decoder.weight.name]
-            self.mlm_decoder.weight = self.word_embed.weight
-            self.mlm_decoder._reg_params["weight"] = self.word_embed.weight
+            _tie_weight(self.mlm_decoder, self.word_embed)
             self.nsp = Dense(2, flatten=False, in_units=units,
                              prefix="nsp_")
 

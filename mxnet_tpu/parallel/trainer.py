@@ -100,10 +100,8 @@ class ShardedTrainer:
                       for p in self._aux_params]
         # per-input sharding: the data spec truncated to each input's rank
         self._x_sh = tuple(
-            shard(mesh, *self._data_spec[:_np.asarray(v).ndim])
-            for v in xs)
-        self._y_sh = shard(mesh,
-                           *self._label_spec[:_np.asarray(y).ndim])
+            shard(mesh, *self._data_spec[:v.ndim]) for v in xs)
+        self._y_sh = shard(mesh, *self._label_spec[:y.ndim])
         self._r_sh = replicated(mesh)
 
         # move weights onto the mesh — the trainer owns them from here on
@@ -200,8 +198,13 @@ class ShardedTrainer:
         import jax
         import jax.numpy as jnp
         xv = _to_vals(x)
-        yv = y._read() if isinstance(y, NDArray) else _np.asarray(y)
+        (yv,) = _to_vals(y)
         self._ensure_built(xv, yv)
+        if len(xv) != len(self._x_sh):
+            raise MXNetError(
+                f"step() got {len(xv)} inputs but the trainer was built "
+                f"with {len(self._x_sh)} — optional inputs must be passed "
+                f"consistently from the first call")
         if batch_size is None:
             batch_size = int(xv[0].shape[0])
         self._t += 1
@@ -224,6 +227,10 @@ class ShardedTrainer:
         if not self._built:
             raise MXNetError("run at least one step() before forward(), or "
                              "use the block directly")
+        if len(xv) != len(self._x_sh):
+            raise MXNetError(
+                f"forward() got {len(xv)} inputs but the trainer was built "
+                f"with {len(self._x_sh)}")
         key = _grandom.next_key()
         out = self._jit_fwd(self._pvals, self._avals, key,
                             tuple(jax.device_put(v, s)
@@ -255,7 +262,12 @@ def _np_to_dev(val, ctx):
 
 def _to_vals(x):
     """Normalize a single array / NDArray or a tuple of them to a tuple of
-    raw values."""
+    raw values.  jax.Arrays pass through untouched so pre-device_put batches
+    skip the host round-trip (device_put on an already-correctly-sharded
+    array is a no-op)."""
+    import jax
     xs = x if isinstance(x, (tuple, list)) else (x,)
-    return tuple(v._read() if isinstance(v, NDArray) else _np.asarray(v)
-                 for v in xs)
+    return tuple(
+        v._read() if isinstance(v, NDArray)
+        else v if isinstance(v, jax.Array) else _np.asarray(v)
+        for v in xs)
