@@ -49,8 +49,7 @@ def main():
     loss = tr.step(x, y)  # build + compile
     # keep the batch resident in HBM: real input pipelines prefetch to
     # device; re-uploading 38MB/step over PCIe/tunnel would bench the link
-    x = jax.device_put(x, tr._x_sh[0])
-    y = jax.device_put(np.asarray(y), tr._y_sh)
+    x, y = tr.shard_batch(x, np.asarray(y))
     for _ in range(args.warmup):
         loss = tr.step(x, y)
     float(loss.asnumpy())  # sync

@@ -34,8 +34,13 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import recordio
+from . import io
+from . import model
+from . import callback
 from . import gluon
 from . import parallel
+from . import symbol
+from . import symbol as sym
 
 
 def waitall() -> None:

@@ -1,0 +1,102 @@
+"""Training callbacks (reference: python/mxnet/callback.py, SURVEY.md §5.5).
+
+``Speedometer`` prints the samples/sec number the BASELINE metric reads;
+``do_checkpoint`` is the epoch-level fault-tolerance story (SURVEY.md §5.3).
+"""
+from __future__ import annotations
+
+import logging
+import time
+
+__all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
+           "log_train_metric", "ProgressBar"]
+
+
+class Speedometer:
+    """Log throughput (samples/sec) and metrics every ``frequent`` batches."""
+
+    def __init__(self, batch_size: int, frequent: int = 50,
+                 auto_reset: bool = True):
+        self.batch_size = batch_size
+        self.frequent = frequent
+        self.auto_reset = auto_reset
+        self.init = False
+        self.tic = 0.0
+        self.last_count = 0
+
+    def __call__(self, param) -> None:
+        count = param.nbatch
+        if self.last_count > count:
+            self.init = False
+        self.last_count = count
+        if self.init:
+            if count % self.frequent == 0:
+                speed = self.frequent * self.batch_size / \
+                    (time.time() - self.tic)
+                if param.eval_metric is not None:
+                    name_value = param.eval_metric.get_name_value()
+                    if self.auto_reset:
+                        param.eval_metric.reset()
+                    msg = "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec" + \
+                        "".join(f"\t{n}={v:f}" for n, v in name_value)
+                    logging.info(msg, param.epoch, count, speed)
+                else:
+                    logging.info(
+                        "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
+                        param.epoch, count, speed)
+                self.tic = time.time()
+        else:
+            self.init = True
+            self.tic = time.time()
+
+
+def do_checkpoint(prefix: str, period: int = 1):
+    """Epoch-end callback saving ``prefix-symbol.json`` +
+    ``prefix-%04d.params`` (reference: callback.do_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym, arg, aux):
+        if (iter_no + 1) % period == 0:
+            from .model import save_checkpoint
+            save_checkpoint(prefix, iter_no + 1, sym, arg, aux)
+    return _callback
+
+
+def module_checkpoint(mod, prefix: str, period: int = 1,
+                      save_optimizer_states: bool = False):
+    """Epoch-end callback on a Module (reference: callback.module_checkpoint)."""
+    period = int(max(1, period))
+
+    def _callback(iter_no, sym=None, arg=None, aux=None):
+        if (iter_no + 1) % period == 0:
+            mod.save_checkpoint(prefix, iter_no + 1, save_optimizer_states)
+    return _callback
+
+
+def log_train_metric(period: int, auto_reset: bool = False):
+    """Batch-end callback logging the metric every ``period`` batches."""
+
+    def _callback(param):
+        if param.nbatch % period == 0 and param.eval_metric is not None:
+            name_value = param.eval_metric.get_name_value()
+            for name, value in name_value:
+                logging.info("Iter[%d] Batch[%d] Train-%s=%f",
+                             param.epoch, param.nbatch, name, value)
+            if auto_reset:
+                param.eval_metric.reset()
+    return _callback
+
+
+class ProgressBar:
+    """Text progress bar over total batches (reference: callback.ProgressBar)."""
+
+    def __init__(self, total: int, length: int = 80):
+        self.bar_len = length
+        self.total = total
+
+    def __call__(self, param) -> None:
+        count = param.nbatch
+        filled_len = int(round(self.bar_len * count / float(self.total)))
+        percents = int(round(100.0 * count / float(self.total)))
+        prog_bar = "=" * filled_len + "-" * (self.bar_len - filled_len)
+        logging.info("[%s] %s%s\r", prog_bar, percents, "%")

@@ -1,0 +1,55 @@
+"""Checkpoint helpers + BatchEndParam (reference: python/mxnet/model.py).
+
+The reference file also carries the legacy ``FeedForward`` API; its role was
+subsumed by ``mx.mod.Module`` years before the fork era, so here only the
+pieces the Module/callback paths need are kept: ``BatchEndParam``,
+``save_checkpoint``/``load_checkpoint`` with the reference's on-disk layout
+(``prefix-symbol.json`` + ``prefix-%04d.params``; ``arg:``/``aux:`` key
+prefixes inside the params dict — SURVEY.md §5.4).
+"""
+from __future__ import annotations
+
+import collections
+from typing import Dict, Tuple
+
+from .ndarray import NDArray
+from .ndarray.utils import save as nd_save, load as nd_load
+
+__all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
+           "load_params"]
+
+BatchEndParam = collections.namedtuple(
+    "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
+
+
+def save_checkpoint(prefix: str, epoch: int, symbol, arg_params: Dict,
+                    aux_params: Dict, remove_amp_cast: bool = True) -> None:
+    """Write ``prefix-symbol.json`` and ``prefix-%04d.params``."""
+    if symbol is not None:
+        symbol.save(f"{prefix}-symbol.json")
+    save_dict = {f"arg:{k}": v for k, v in (arg_params or {}).items()}
+    save_dict.update({f"aux:{k}": v for k, v in (aux_params or {}).items()})
+    nd_save(f"{prefix}-{epoch:04d}.params", save_dict)
+
+
+def load_params(fname: str) -> Tuple[Dict[str, NDArray], Dict[str, NDArray]]:
+    """Split a saved dict into (arg_params, aux_params) by key prefix."""
+    save_dict = nd_load(fname)
+    arg_params, aux_params = {}, {}
+    for k, v in save_dict.items():
+        tp, _, name = k.partition(":")
+        if tp == "arg":
+            arg_params[name] = v
+        elif tp == "aux":
+            aux_params[name] = v
+        else:                       # un-prefixed: Gluon-style params file
+            arg_params[k] = v
+    return arg_params, aux_params
+
+
+def load_checkpoint(prefix: str, epoch: int):
+    """Load (symbol, arg_params, aux_params) written by save_checkpoint."""
+    from . import symbol as sym_mod
+    symbol = sym_mod.load(f"{prefix}-symbol.json")
+    arg_params, aux_params = load_params(f"{prefix}-{epoch:04d}.params")
+    return symbol, arg_params, aux_params

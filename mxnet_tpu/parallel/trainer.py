@@ -192,13 +192,26 @@ class ShardedTrainer:
     def set_learning_rate(self, lr: float) -> None:
         self._optimizer.set_learning_rate(lr)
 
+    def shard_batch(self, x, y):
+        """Pre-place a batch onto the mesh with the trainer's input
+        shardings; feeding the returned arrays to step() skips the
+        host→device transfer (how a real input pipeline should feed)."""
+        import jax
+        xv = _to_vals(x)
+        yv = _to_val(y)
+        self._ensure_built(xv, yv)
+        xs = tuple(jax.device_put(v, s)
+                   for v, s in zip(xv, self._x_sh))
+        return (xs if len(xs) > 1 else xs[0],
+                jax.device_put(yv, self._y_sh))
+
     def step(self, x, y, batch_size: Optional[int] = None):
         """Run one sharded train step; returns the (device) mean loss.
         `x` may be a single array or a tuple of inputs."""
         import jax
         import jax.numpy as jnp
         xv = _to_vals(x)
-        (yv,) = _to_vals(y)
+        yv = _to_val(y)
         self._ensure_built(xv, yv)
         if len(xv) != len(self._x_sh):
             raise MXNetError(
@@ -258,6 +271,17 @@ class ShardedTrainer:
 def _np_to_dev(val, ctx):
     import jax.numpy as jnp
     return jnp.asarray(val)
+
+
+def _to_val(y):
+    """Normalize ONE label array: unlike inputs, a python list here is one
+    array of values, not a tuple of separate label streams."""
+    import jax
+    if isinstance(y, NDArray):
+        return y._read()
+    if isinstance(y, jax.Array):
+        return y
+    return _np.asarray(y)
 
 
 def _to_vals(x):

@@ -196,8 +196,9 @@ class Symbol:
                 kwargs = dict(node.attrs)
                 if node.op == "BatchNorm":
                     kwargs.setdefault("_training", training)
+                extra = _scalar_extra(node.op, kwargs)
                 fn = op.get_fn(kwargs)
-                ins = [vals[id(p)][i] for p, i in node.inputs]
+                ins = [vals[id(p)][i] for p, i in node.inputs] + extra
                 out = fn(*ins)
                 vals[id(node)] = out if isinstance(out, tuple) else (out,)
             return [vals[id(n)][i] for n, i in self._heads]
@@ -328,6 +329,16 @@ class Symbol:
         return load_json(self.tojson())
 
 
+def _scalar_extra(opname: str, kwargs: Dict[str, Any]) -> list:
+    """The *_scalar op family takes the scalar as a 0-d array input (one
+    compile per shape, not per constant — see ops_elemwise); in symbol
+    graphs it is stored as a node attr, so pop it into an input here."""
+    if opname.endswith("_scalar") and "scalar" in kwargs:
+        import jax.numpy as jnp
+        return [jnp.asarray(kwargs.pop("scalar"))]
+    return []
+
+
 def _attr_str(v) -> str:
     if isinstance(v, str):
         return v
@@ -382,10 +393,11 @@ def _infer_missing(sym: Symbol, known: Dict[str, Tuple[int, ...]],
         if node.op == "BatchNorm":
             kwargs.setdefault("_training", False)
         try:
+            extra = _scalar_extra(node.op, kwargs)
             fn = op.get_fn(kwargs)
             outs = jax.eval_shape(
                 fn, *[jax.ShapeDtypeStruct(s, _np.float32)
-                      for s in in_shapes])
+                      for s in in_shapes], *extra)
             outs = outs if isinstance(outs, tuple) else (outs,)
             for i, o in enumerate(outs):
                 shapes[(id(node), i)] = tuple(o.shape)

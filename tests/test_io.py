@@ -1,0 +1,206 @@
+"""Tests for mx.io iterators + callbacks + test_utils harness.
+
+Reference model: tests/python/unittest/test_io.py (SURVEY.md §4.2).
+"""
+import logging
+import struct
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+from mxnet_tpu import test_utils as tu
+
+
+def test_ndarray_iter_basic():
+    data = np.arange(100, dtype=np.float32).reshape(25, 4)
+    label = np.arange(25, dtype=np.float32)
+    it = mio.NDArrayIter(data, label, batch_size=8, last_batch_handle="pad")
+    batches = list(it)
+    assert len(batches) == 4
+    assert batches[0].data[0].shape == (8, 4)
+    assert batches[-1].pad == 7
+    # second epoch via reset
+    batches2 = list(it)
+    assert len(batches2) == 4
+    got = batches[0].data[0].asnumpy()
+    np.testing.assert_allclose(got, data[:8])
+
+
+def test_ndarray_iter_discard_and_shuffle():
+    data = np.arange(50, dtype=np.float32).reshape(25, 2)
+    it = mio.NDArrayIter(data, None, batch_size=8,
+                         last_batch_handle="discard", shuffle=True)
+    batches = list(it)
+    assert len(batches) == 3
+    seen = np.concatenate([b.data[0].asnumpy() for b in batches])
+    # shuffled but drawn from the data without replacement
+    assert len(np.unique(seen[:, 0])) == 24
+
+
+def test_ndarray_iter_dict_input():
+    it = mio.NDArrayIter({"a": np.zeros((10, 3)), "b": np.ones((10, 2))},
+                         batch_size=5)
+    assert sorted(d.name for d in it.provide_data) == ["a", "b"]
+    b = next(iter(it))
+    assert b.data[0].shape[0] == 5
+
+
+def test_csv_iter(tmp_path):
+    data = np.random.rand(20, 6).astype(np.float32)
+    label = np.arange(20, dtype=np.float32).reshape(20, 1)
+    dpath, lpath = tmp_path / "d.csv", tmp_path / "l.csv"
+    np.savetxt(dpath, data, delimiter=",")
+    np.savetxt(lpath, label, delimiter=",")
+    it = mio.CSVIter(data_csv=str(dpath), data_shape=(2, 3),
+                     label_csv=str(lpath), batch_size=4)
+    b = next(iter(it))
+    assert b.data[0].shape == (4, 2, 3)
+    np.testing.assert_allclose(b.data[0].asnumpy().reshape(4, 6),
+                               data[:4], rtol=1e-5)
+
+
+def test_libsvm_iter(tmp_path):
+    p = tmp_path / "d.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n1 2:3.0 3:4.0\n0 0:5.0\n")
+    it = mio.LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b = next(iter(it))
+    d = b.data[0].asnumpy() if hasattr(b.data[0], "asnumpy") else b.data[0]
+    np.testing.assert_allclose(np.asarray(d)[0], [1.5, 0, 0, 2.0])
+    np.testing.assert_allclose(b.label[0].asnumpy(), [1, 0])
+
+
+def _write_rec(tmp_path, n=24, h=32, w=32):
+    from mxnet_tpu.recordio import MXIndexedRecordIO, IRHeader, pack_img
+    rec = str(tmp_path / "data.rec")
+    idx = str(tmp_path / "data.idx")
+    w_ = MXIndexedRecordIO(idx, rec, "w")
+    rng = np.random.default_rng(0)
+    for i in range(n):
+        img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        w_.write_idx(i, pack_img(IRHeader(0, float(i % 10), i, 0), img))
+    w_.close()
+    return rec, idx
+
+
+def test_image_record_iter(tmp_path):
+    rec, idx = _write_rec(tmp_path)
+    it = mio.ImageRecordIter(
+        path_imgrec=rec, path_imgidx=idx, data_shape=(3, 28, 28),
+        batch_size=8, shuffle=True, rand_crop=True, rand_mirror=True,
+        mean_r=127.0, mean_g=127.0, mean_b=127.0, preprocess_threads=2)
+    epochs = []
+    for _ in range(2):
+        batches = list(it)
+        assert len(batches) == 3
+        assert batches[0].data[0].shape == (8, 3, 28, 28)
+        assert batches[0].label[0].shape == (8,)
+        epochs.append(batches)
+    vals = epochs[0][0].data[0].asnumpy()
+    assert np.isfinite(vals).all()
+    assert abs(vals.mean()) < 30  # mean-subtracted
+
+
+def test_image_record_iter_sharding(tmp_path):
+    rec, idx = _write_rec(tmp_path)
+    it0 = mio.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                              data_shape=(3, 28, 28), batch_size=4,
+                              part_index=0, num_parts=2)
+    it1 = mio.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                              data_shape=(3, 28, 28), batch_size=4,
+                              part_index=1, num_parts=2)
+    l0 = np.concatenate([b.label[0].asnumpy() for b in it0])
+    l1 = np.concatenate([b.label[0].asnumpy() for b in it1])
+    assert len(l0) == len(l1) == 12
+    assert not np.array_equal(l0, l1)
+
+
+def test_resize_and_prefetch_iter():
+    data = np.random.rand(20, 4).astype(np.float32)
+    base = mio.NDArrayIter(data, None, batch_size=5)
+    r = mio.ResizeIter(base, size=7)
+    assert len(list(r)) == 7
+    p = mio.PrefetchingIter(mio.NDArrayIter(data, None, batch_size=5))
+    assert len(list(p)) == 4
+    assert len(list(p)) == 4  # reset works
+
+
+def test_mnist_iter(tmp_path):
+    # write tiny idx-ubyte files
+    imgs = np.random.randint(0, 255, (10, 28, 28), dtype=np.uint8)
+    labs = np.arange(10, dtype=np.uint8)
+    with open(tmp_path / "img", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3))
+        f.write(struct.pack(">III", 10, 28, 28))
+        f.write(imgs.tobytes())
+    with open(tmp_path / "lab", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1))
+        f.write(struct.pack(">I", 10))
+        f.write(labs.tobytes())
+    it = mio.MNISTIter(image=str(tmp_path / "img"),
+                       label=str(tmp_path / "lab"), batch_size=5,
+                       shuffle=False, flat=True)
+    b = next(iter(it))
+    assert b.data[0].shape == (5, 784)
+    np.testing.assert_allclose(b.label[0].asnumpy(), np.arange(5))
+
+
+def test_speedometer_logs(caplog):
+    from mxnet_tpu.callback import Speedometer
+    from mxnet_tpu.model import BatchEndParam
+    sp = Speedometer(batch_size=32, frequent=2, auto_reset=False)
+    m = mx.metric.Accuracy()
+    m.update([mx.nd.array([0, 1])], [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    with caplog.at_level(logging.INFO):
+        for i in range(1, 5):
+            sp(BatchEndParam(epoch=0, nbatch=i, eval_metric=m, locals=None))
+    assert any("samples/sec" in r.message for r in caplog.records)
+
+
+def test_checkpoint_roundtrip(tmp_path):
+    from mxnet_tpu.model import save_checkpoint, load_checkpoint
+    x = mx.sym.var("data")
+    y = mx.sym.FullyConnected(x, num_hidden=3, name="fc1")
+    arg = {"fc1_weight": mx.nd.ones((3, 4)), "fc1_bias": mx.nd.zeros((3,))}
+    aux = {}
+    prefix = str(tmp_path / "model")
+    save_checkpoint(prefix, 3, y, arg, aux)
+    sym2, arg2, aux2 = load_checkpoint(prefix, 3)
+    assert sym2.list_arguments() == y.list_arguments()
+    np.testing.assert_allclose(arg2["fc1_weight"].asnumpy(),
+                               np.ones((3, 4)))
+
+
+def test_assert_almost_equal_reports_index():
+    a = np.zeros((3, 3))
+    b = np.zeros((3, 3))
+    b[1, 2] = 1.0
+    with pytest.raises(AssertionError) as e:
+        tu.assert_almost_equal(a, b)
+    assert "(1, 2)" in str(e.value)
+
+
+def test_check_numeric_gradient():
+    x = mx.sym.var("x")
+    y = mx.sym.tanh(x) * 2.0
+    tu.check_numeric_gradient(y, {"x": np.random.randn(3, 4)})
+
+
+def test_check_symbolic_forward_backward():
+    x = mx.sym.var("x")
+    y = mx.sym.square(x)
+    data = np.random.randn(4, 5)
+    tu.check_symbolic_forward(y, {"x": data}, [data ** 2])
+    tu.check_symbolic_backward(y, {"x": data}, [np.ones_like(data)],
+                               [2 * data])
+
+
+def test_check_consistency_dtype():
+    x = mx.sym.var("data")
+    y = mx.sym.FullyConnected(x, num_hidden=4)
+    ctx = tu.default_context()
+    tu.check_consistency(
+        y, [{"ctx": ctx, "data": (2, 8), "type_dict": {"data": np.float32}},
+            {"ctx": ctx, "data": (2, 8), "type_dict": {"data": np.float16}}],
+        rtol=1e-1, atol=1e-1)

@@ -179,3 +179,21 @@ def test_ring_attention_matches_dense():
         ref = dense(np.asarray(q), np.asarray(k), np.asarray(v), causal)
         np.testing.assert_allclose(out, ref, rtol=2e-5, atol=2e-5,
                                    err_msg=f"causal={causal}")
+
+
+def test_trainer_list_labels_and_shard_batch():
+    """Labels given as a python list are ONE label array (regression:
+    _to_vals unpacking rejected lists); shard_batch is the public way to
+    pre-place batches on the mesh."""
+    np.random.seed(5)
+    net = _mlp("lbl_")
+    net.initialize()
+    tr = par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                            {"learning_rate": 0.1})
+    x = np.random.randn(8, 16).astype(np.float32)
+    y = [int(i % 10) for i in range(8)]
+    l1 = float(tr.step(x, y).asnumpy())
+    assert np.isfinite(l1)
+    xs, ys = tr.shard_batch(x, y)
+    l2 = float(tr.step(xs, ys).asnumpy())
+    assert np.isfinite(l2)
