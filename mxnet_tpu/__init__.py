@@ -41,6 +41,9 @@ from . import gluon
 from . import parallel
 from . import symbol
 from . import symbol as sym
+from . import module
+from . import module as mod
+from . import test_utils
 
 
 def waitall() -> None:

@@ -148,7 +148,8 @@ class NDArrayIter(DataIter):
         self.idx = _np.arange(self.num_data)
         self._rng = _np.random.default_rng()
         self.cursor = -batch_size
-        self._roll_over_carry = 0
+        self._carry = _np.empty(0, dtype=_np.int64)
+        self._epoch_idx = self.idx
         self.reset()
 
     @property
@@ -164,24 +165,28 @@ class NDArrayIter(DataIter):
     def reset(self) -> None:
         if self.shuffle:
             self._rng.shuffle(self.idx)
-        if self.last_batch_handle == "roll_over" and \
-                0 < self._roll_over_carry < self.batch_size:
-            self.cursor = -self._roll_over_carry
+        # roll_over: the partial tail of the previous epoch is served first
+        # (reference NDArrayIter roll_over semantics — no sample skipped,
+        # no sample duplicated)
+        if self._carry.size:
+            self._epoch_idx = _np.concatenate([self._carry, self.idx])
+            self._carry = _np.empty(0, dtype=_np.int64)
         else:
-            self.cursor = -self.batch_size
-        self._roll_over_carry = 0
+            self._epoch_idx = self.idx
+        self.cursor = -self.batch_size
 
     def iter_next(self) -> bool:
         self.cursor += self.batch_size
-        if self.last_batch_handle == "discard":
-            return self.cursor + self.batch_size <= self.num_data
-        return self.cursor < self.num_data
+        n = len(self._epoch_idx)
+        if self.last_batch_handle in ("discard", "roll_over"):
+            return self.cursor + self.batch_size <= n
+        return self.cursor < n
 
     def next(self) -> DataBatch:
         if not self.iter_next():
             if self.last_batch_handle == "roll_over":
-                self._roll_over_carry = \
-                    (self.num_data - self.cursor) % self.batch_size
+                self._carry = self._epoch_idx[self.cursor:].astype(
+                    _np.int64)
             raise StopIteration
         data = [self._slice(arr) for _, arr in self.data]
         label = [self._slice(arr) for _, arr in self.label]
@@ -193,20 +198,21 @@ class NDArrayIter(DataIter):
                          provide_label=self.provide_label)
 
     def _slice(self, arr: _np.ndarray) -> _np.ndarray:
+        n = len(self._epoch_idx)
         start = max(self.cursor, 0)
         end = self.cursor + self.batch_size
-        sel = self.idx[start:min(end, self.num_data)]
+        sel = self._epoch_idx[start:min(end, n)]
         out = arr[sel]
         if out.shape[0] < self.batch_size:
             # pad by wrapping to the front (reference 'pad' semantics)
-            extra = arr[self.idx[:self.batch_size - out.shape[0]]]
+            extra = arr[self._epoch_idx[:self.batch_size - out.shape[0]]]
             out = _np.concatenate([out, extra], axis=0)
         return out
 
     def getpad(self) -> int:
         if self.last_batch_handle == "pad" and \
-                self.cursor + self.batch_size > self.num_data:
-            return self.cursor + self.batch_size - self.num_data
+                self.cursor + self.batch_size > len(self._epoch_idx):
+            return self.cursor + self.batch_size - len(self._epoch_idx)
         return 0
 
 
@@ -402,6 +408,7 @@ class ImageRecordIter(DataIter):
         self.std = _np.array([std_r, std_g, std_b],
                              dtype=_np.float32).reshape(3, 1, 1)
         self.label_width = label_width
+        self._round_batch = round_batch
         self.n_threads = max(1, preprocess_threads)
         self.prefetch = max(1, prefetch_buffer)
         self._rng = _np.random.default_rng(seed)
@@ -463,6 +470,9 @@ class ImageRecordIter(DataIter):
         self._stop = threading.Event()
         self._out = _queue.Queue(maxsize=self.prefetch)
         n_batches = len(self._order) // self.batch_size
+        tail = len(self._order) % self.batch_size
+        if self._round_batch and tail:
+            n_batches += 1          # final wrap-padded batch (pad set)
         self._n_batches = n_batches
         self._consumed = 0
         feeder = threading.Thread(target=self._run_pipeline,
@@ -486,6 +496,18 @@ class ImageRecordIter(DataIter):
 
     def _run_pipeline(self, stop: threading.Event, out: _queue.Queue,
                       n_batches: int) -> None:
+        try:
+            self._run_pipeline_inner(stop, out, n_batches)
+        except BaseException as e:          # surface in next(), don't hang
+            while not stop.is_set():
+                try:
+                    out.put(("__error__", e), timeout=0.1)
+                    return
+                except _queue.Full:
+                    continue
+
+    def _run_pipeline_inner(self, stop: threading.Event, out: _queue.Queue,
+                            n_batches: int) -> None:
         order = self._order
         bs = self.batch_size
         with open(self.path_imgrec, "rb") as f:
@@ -494,8 +516,12 @@ class ImageRecordIter(DataIter):
                 for b in range(n_batches):
                     if stop.is_set():
                         return
+                    sel = order[b * bs:(b + 1) * bs]
+                    pad = bs - len(sel)
+                    if pad:                  # round_batch: wrap to the front
+                        sel = _np.concatenate([sel, order[:pad]])
                     raws = []
-                    for i in order[b * bs:(b + 1) * bs]:
+                    for i in sel:
                         f.seek(self._offsets[i])
                         head = f.read(8)
                         _, lrec = struct.unpack("<II", head)
@@ -508,7 +534,7 @@ class ImageRecordIter(DataIter):
                         label = label.reshape(bs)
                     while not stop.is_set():
                         try:
-                            out.put((data, label), timeout=0.1)
+                            out.put((data, label, pad), timeout=0.1)
                             break
                         except _queue.Full:
                             continue
@@ -548,10 +574,15 @@ class ImageRecordIter(DataIter):
     def next(self) -> DataBatch:
         if self._consumed >= self._n_batches:
             raise StopIteration
-        data, label = self._out.get()
+        item = self._out.get()
+        if isinstance(item[0], str) and item[0] == "__error__":
+            raise MXNetError(
+                f"ImageRecordIter pipeline failed: {item[1]!r}") \
+                from item[1]
+        data, label, pad = item
         self._consumed += 1
         return DataBatch([nd_array(data, ctx=cpu())],
-                         [nd_array(label, ctx=cpu())], pad=0,
+                         [nd_array(label, ctx=cpu())], pad=pad,
                          provide_data=self.provide_data,
                          provide_label=self.provide_label)
 

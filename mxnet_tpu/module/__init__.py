@@ -1,0 +1,11 @@
+"""``mx.mod``: the symbolic training API (reference: python/mxnet/module/).
+
+Module = bound Symbol + params + optimizer; BucketingModule = one jitted
+executable per bucket shape sharing a single parameter set (SURVEY.md §3.4,
+§5.7).
+"""
+from .base_module import BaseModule
+from .module import Module
+from .bucketing_module import BucketingModule
+
+__all__ = ["BaseModule", "Module", "BucketingModule"]

@@ -1,0 +1,173 @@
+"""BucketingModule: per-sequence-length executors sharing one parameter set.
+
+Reference parity: python/mxnet/module/bucketing_module.py (SURVEY.md §5.7) —
+the long/variable-sequence story of the Symbol era and Sockeye's engine
+(BASELINE config #4).  TPU-native: each bucket is its own jitted executable
+(XLA compile cache keyed by shape — exactly the pad-to-bucket policy §5.7
+prescribes); parameters live in the master module and are shared by
+reference, so switching buckets never copies weights.
+"""
+from __future__ import annotations
+
+import logging
+from typing import Callable, Dict, Optional
+
+from ..base import MXNetError
+from .base_module import BaseModule
+from .module import Module
+
+__all__ = ["BucketingModule"]
+
+
+class BucketingModule(BaseModule):
+    def __init__(self, sym_gen: Callable, default_bucket_key=None,
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger)
+        if default_bucket_key is None:
+            raise MXNetError("default_bucket_key is required")
+        self._sym_gen = sym_gen
+        self._default_bucket_key = default_bucket_key
+        self._mod_kwargs = dict(context=context,
+                                fixed_param_names=fixed_param_names,
+                                logger=logger)
+        self._buckets: Dict = {}
+        self._curr_module: Optional[Module] = None
+        self._curr_bucket_key = None
+        self._opt_config = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def default_bucket_key(self):
+        return self._default_bucket_key
+
+    @property
+    def symbol(self):
+        return self._curr_module.symbol
+
+    @property
+    def data_names(self):
+        return self._curr_module.data_names
+
+    @property
+    def output_names(self):
+        return self._curr_module.output_names
+
+    @property
+    def data_shapes(self):
+        return self._curr_module.data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._curr_module.label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._curr_module.output_shapes
+
+    def _gen_module(self, bucket_key) -> Module:
+        sym, data_names, label_names = self._sym_gen(bucket_key)
+        return Module(sym, data_names=data_names, label_names=label_names,
+                      **self._mod_kwargs)
+
+    # -- bind --------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write") -> None:
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        module = self._gen_module(self._default_bucket_key)
+        module.bind(data_shapes, label_shapes, for_training,
+                    inputs_need_grad, force_rebind=False,
+                    shared_module=None, grad_req=grad_req)
+        self._buckets[self._default_bucket_key] = module
+        self._curr_module = module
+        self._curr_bucket_key = self._default_bucket_key
+        self.binded = True
+
+    def switch_bucket(self, bucket_key, data_shapes,
+                      label_shapes=None) -> None:
+        """Bind (or reuse) the executor for this bucket; parameters are
+        shared with the default-bucket master module."""
+        if not self.binded:
+            raise MXNetError("switch_bucket requires bind()")
+        if bucket_key not in self._buckets:
+            master = self._buckets[self._default_bucket_key]
+            module = self._gen_module(bucket_key)
+            module.bind(data_shapes, label_shapes, self.for_training,
+                        self.inputs_need_grad, shared_module=master)
+            if master.optimizer_initialized:
+                # ONE optimizer state set across buckets (momenta must see
+                # every step regardless of which bucket produced it)
+                module.borrow_optimizer(master)
+            self._buckets[bucket_key] = module
+        self._curr_module = self._buckets[bucket_key]
+        self._curr_bucket_key = bucket_key
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False) -> None:
+        if self.params_initialized and not force_init:
+            return
+        master = self._buckets[self._default_bucket_key]
+        master.init_params(initializer=initializer, arg_params=arg_params,
+                           aux_params=aux_params,
+                           allow_missing=allow_missing,
+                           force_init=force_init, allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        return self._buckets[self._default_bucket_key].get_params()
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False) -> None:
+        self._opt_config = dict(kvstore=kvstore, optimizer=optimizer,
+                                optimizer_params=optimizer_params,
+                                force_init=force_init)
+        master = self._buckets[self._default_bucket_key]
+        master.init_optimizer(**self._opt_config)
+        for module in self._buckets.values():
+            if module is not master:
+                module.borrow_optimizer(master)
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None) -> None:
+        key = getattr(data_batch, "bucket_key", None)
+        if key is None:
+            key = self._curr_bucket_key
+        self.switch_bucket(key, data_batch.provide_data,
+                           data_batch.provide_label)
+        self._curr_module.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None) -> None:
+        self._curr_module.backward(out_grads)
+
+    def update(self) -> None:
+        # grads live in the current bucket's executors; params are shared
+        self._curr_module.update()
+        # propagate refreshed params into every other bound bucket
+        arg, aux = self._curr_module._arg_params, \
+            self._curr_module._aux_params
+        for key, module in self._buckets.items():
+            if module is not self._curr_module:
+                module._exec_group.set_params(arg, aux)
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._curr_module.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._curr_module.get_input_grads(merge_multi_context)
+
+    def update_metric(self, eval_metric, labels) -> None:
+        self._curr_module.update_metric(eval_metric, labels)
+
+    def save_checkpoint(self, prefix: str, epoch: int,
+                        save_optimizer_states: bool = False) -> None:
+        self._buckets[self._default_bucket_key].save_checkpoint(
+            prefix, epoch, save_optimizer_states)

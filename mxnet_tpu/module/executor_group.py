@@ -1,0 +1,142 @@
+"""DataParallelExecutorGroup: one bound executor per context, batch sliced
+along the batch axis.
+
+Reference parity: python/mxnet/module/executor_group.py (SURVEY.md §2.3) —
+the Module-era data-parallel mechanism.  On TPU the *performant* data
+parallelism is the pjit/shard_map path (mxnet_tpu.parallel); this class
+keeps the Module API semantics (per-context executors, kvstore reduction
+above it) so Symbol-era scripts run unchanged, and degenerates to a single
+jitted executor in the common one-device case.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context
+from ..io import DataDesc
+from ..ndarray import NDArray, array as nd_array
+
+__all__ = ["DataParallelExecutorGroup"]
+
+
+def _split_desc(desc: DataDesc, k: int, n: int) -> tuple:
+    """Shape of the k-th of n slices along the batch axis."""
+    axis = DataDesc.get_batch_axis(desc.layout)
+    shape = list(desc.shape)
+    per = shape[axis] // n
+    lo = k * per
+    hi = shape[axis] if k == n - 1 else lo + per
+    shape[axis] = hi - lo
+    return tuple(shape), axis, lo, hi
+
+
+class DataParallelExecutorGroup:
+    def __init__(self, symbol, contexts: Sequence[Context],
+                 data_shapes: List[DataDesc],
+                 label_shapes: Optional[List[DataDesc]],
+                 param_names: List[str], for_training: bool,
+                 inputs_need_grad: bool = False, shared_group=None,
+                 grad_req: str = "write"):
+        self.symbol = symbol
+        self.contexts = list(contexts)
+        self.param_names = param_names
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        self.data_shapes = [DataDesc(*d) if not isinstance(d, DataDesc)
+                            else d for d in data_shapes]
+        self.label_shapes = [DataDesc(*d) if not isinstance(d, DataDesc)
+                             else d for d in (label_shapes or [])]
+        self.grad_req = grad_req if for_training else "null"
+        arg_names = symbol.list_arguments()
+        self._input_names = [d.name for d in self.data_shapes] + \
+            [l.name for l in self.label_shapes]
+        for name in self._input_names:
+            if name not in arg_names and name not in \
+                    symbol.list_auxiliary_states():
+                raise MXNetError(
+                    f"input {name!r} not an argument of the symbol "
+                    f"(arguments: {arg_names})")
+        n = len(self.contexts)
+        self.execs = []
+        for k, ctx in enumerate(self.contexts):
+            shapes = {}
+            for d in self.data_shapes + self.label_shapes:
+                shapes[d.name] = _split_desc(d, k, n)[0]
+            # params get the full (replicated) shape on every context
+            exe = symbol.simple_bind(ctx=ctx, grad_req=self.grad_req,
+                                     **shapes)
+            self.execs.append(exe)
+        self._outputs_per_exec = len(symbol.list_outputs())
+
+    # -- params ------------------------------------------------------------
+    def set_params(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray],
+                   allow_extra: bool = False) -> None:
+        for exe in self.execs:
+            exe.copy_params_from(arg_params, aux_params,
+                                 allow_extra_params=allow_extra)
+
+    def get_params(self, arg_params: Dict[str, NDArray],
+                   aux_params: Dict[str, NDArray]) -> None:
+        """Copy (first-replica) values out into the given dicts."""
+        exe = self.execs[0]
+        for name, arr in exe.arg_dict.items():
+            if name in arg_params:
+                arg_params[name]._set_data(arr._read())
+        for name, arr in exe.aux_dict.items():
+            if name in aux_params:
+                aux_params[name]._set_data(arr._read())
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train: Optional[bool] = None) -> None:
+        if is_train is None:
+            is_train = self.for_training
+        n = len(self.execs)
+        feeds = {d.name: v for d, v in zip(self.data_shapes,
+                                           data_batch.data)}
+        if self.label_shapes and data_batch.label:
+            feeds.update({l.name: v for l, v in zip(self.label_shapes,
+                                                    data_batch.label)})
+        descs = {d.name: d for d in self.data_shapes + self.label_shapes}
+        for k, exe in enumerate(self.execs):
+            kw = {}
+            for name, val in feeds.items():
+                _, axis, lo, hi = _split_desc(descs[name], k, n)
+                v = val
+                if n > 1:
+                    idx = [slice(None)] * len(descs[name].shape)
+                    idx[axis] = slice(lo, hi)
+                    v = val[tuple(idx)]
+                kw[name] = v if isinstance(v, NDArray) \
+                    else nd_array(_np.asarray(v), ctx=exe._ctx)
+            exe.forward(is_train=is_train, **kw)
+
+    def backward(self, out_grads=None) -> None:
+        for exe in self.execs:
+            exe.backward(out_grads)
+
+    def get_outputs(self, merge_multi_context: bool = True):
+        if len(self.execs) == 1:
+            return list(self.execs[0].outputs)
+        if not merge_multi_context:
+            return [list(e.outputs) for e in self.execs]
+        from ..ndarray import concat as nd_concat
+        merged = []
+        for i in range(self._outputs_per_exec):
+            merged.append(nd_concat(*[e.outputs[i] for e in self.execs],
+                                    dim=0))
+        return merged
+
+    def grad_arrays_of(self, name: str) -> List[NDArray]:
+        out = []
+        for exe in self.execs:
+            g = exe.grad_dict.get(name)
+            if g is not None:
+                out.append(g)
+        return out
+
+    def update_metric(self, eval_metric, labels) -> None:
+        eval_metric.update(labels, self.get_outputs())

@@ -1,0 +1,274 @@
+"""Module: bound Symbol + params + optimizer (reference:
+python/mxnet/module/module.py, SURVEY.md §3.4).
+"""
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Optional, Sequence
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..context import Context, current_context
+from ..initializer import Uniform, create as init_create
+from ..model import save_checkpoint as _save_ckpt, \
+    load_checkpoint as _load_ckpt
+from ..ndarray import NDArray, zeros as nd_zeros
+from .. import optimizer as opt_mod
+from .. import kvstore as kv_mod
+from .base_module import BaseModule
+from .executor_group import DataParallelExecutorGroup
+
+__all__ = ["Module"]
+
+
+class Module(BaseModule):
+    def __init__(self, symbol, data_names: Sequence[str] = ("data",),
+                 label_names: Sequence[str] = ("softmax_label",),
+                 logger=logging, context=None, work_load_list=None,
+                 fixed_param_names=None, state_names=None,
+                 group2ctxs=None, compression_params=None):
+        super().__init__(logger)
+        if context is None:
+            context = [current_context()]
+        if isinstance(context, Context):
+            context = [context]
+        self._context = list(context)
+        self._symbol = symbol
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._fixed_param_names = set(fixed_param_names or [])
+        arg_names = symbol.list_arguments()
+        input_names = set(self._data_names) | set(self._label_names)
+        self._param_names = [n for n in arg_names if n not in input_names]
+        self._aux_names = symbol.list_auxiliary_states()
+        self._arg_params: Dict[str, NDArray] = {}
+        self._aux_params: Dict[str, NDArray] = {}
+        self._exec_group: Optional[DataParallelExecutorGroup] = None
+        self._optimizer = None
+        self._updater = None
+        self._kvstore = None
+        self._data_shapes = None
+        self._label_shapes = None
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def symbol(self):
+        return self._symbol
+
+    @property
+    def data_names(self) -> List[str]:
+        return self._data_names
+
+    @property
+    def label_names(self) -> List[str]:
+        return self._label_names
+
+    @property
+    def output_names(self) -> List[str]:
+        return self._symbol.list_outputs()
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        outs = self.get_outputs()
+        return list(zip(self.output_names, [o.shape for o in outs]))
+
+    # -- bind --------------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, shared_module=None,
+             grad_req="write") -> None:
+        if self.binded and not force_rebind:
+            self.logger.warning("Already bound, ignoring bind()")
+            return
+        from ..io import DataDesc
+        norm = lambda lst: [d if isinstance(d, DataDesc) else DataDesc(*d)
+                            for d in (lst or [])]
+        self._data_shapes = norm(data_shapes)
+        self._label_shapes = norm(label_shapes)
+        self.for_training = for_training
+        self.inputs_need_grad = inputs_need_grad
+        shared_group = shared_module._exec_group if shared_module else None
+        self._exec_group = DataParallelExecutorGroup(
+            self._symbol, self._context, self._data_shapes,
+            self._label_shapes, self._param_names, for_training,
+            inputs_need_grad, shared_group, grad_req)
+        if shared_module is not None and shared_module.params_initialized:
+            # share parameter values with the bucketing master module
+            self._arg_params = shared_module._arg_params
+            self._aux_params = shared_module._aux_params
+            self.params_initialized = True
+            self._exec_group.set_params(self._arg_params, self._aux_params)
+        self.binded = True
+
+    # -- params ------------------------------------------------------------
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False, force_init=False,
+                    allow_extra=False) -> None:
+        if self.params_initialized and not force_init:
+            return
+        if not self.binded:
+            raise MXNetError("init_params requires bind()")
+        if initializer is None:
+            initializer = Uniform(0.01)
+        exe = self._exec_group.execs[0]
+        for name in self._param_names:
+            arr = exe.arg_dict[name]
+            if arg_params and name in arg_params:
+                val = arg_params[name]
+                self._arg_params[name] = val.copyto(arr.context) \
+                    if val.context != arr.context else val.copy()
+            else:
+                if arg_params is not None and not allow_missing and \
+                        arg_params != {}:
+                    raise MXNetError(f"missing parameter {name!r}")
+                dst = nd_zeros(arr.shape, ctx=arr.context)
+                initializer(name, dst)
+                self._arg_params[name] = dst
+        for name in self._aux_names:
+            arr = exe.aux_dict[name]
+            if aux_params and name in aux_params:
+                self._aux_params[name] = aux_params[name].copy()
+            else:
+                dst = nd_zeros(arr.shape, ctx=arr.context)
+                initializer(name, dst)
+                self._aux_params[name] = dst
+        self._exec_group.set_params(self._arg_params, self._aux_params,
+                                    allow_extra=allow_extra)
+        self.params_initialized = True
+
+    def get_params(self):
+        if self._exec_group is not None and self.params_initialized:
+            self._exec_group.get_params(self._arg_params, self._aux_params)
+        return self._arg_params, self._aux_params
+
+    # -- optimizer ---------------------------------------------------------
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False) -> None:
+        if not self.binded or not self.params_initialized:
+            raise MXNetError("init_optimizer requires bind + init_params")
+        if self.optimizer_initialized and not force_init:
+            return
+        optimizer_params = dict(optimizer_params or {})
+        if isinstance(optimizer, str):
+            # reference rescale convention: grads are summed over the whole
+            # (global) batch; normalize by batch size across all devices
+            optimizer_params.setdefault(
+                "rescale_grad", 1.0 / self._data_shapes[0].shape[0])
+            idx2name = dict(enumerate(self._param_names))
+            optimizer = opt_mod.create(optimizer,
+                                       param_idx2name=idx2name,
+                                       **optimizer_params)
+        self._optimizer = optimizer
+        self._updater = opt_mod.get_updater(optimizer)
+        self._param_index = {n: i for i, n in
+                             enumerate(self._param_names)}
+        if isinstance(kvstore, str):
+            kvstore = kv_mod.create(kvstore) if kvstore else None
+        self._kvstore = kvstore
+        if kvstore is not None:
+            for i, name in enumerate(self._param_names):
+                kvstore.init(i, self._arg_params[name])
+        states_file = getattr(self, "_preloaded_states", None)
+        if states_file is not None:
+            with open(states_file, "rb") as f:
+                self._updater.set_states(f.read())
+            self._preloaded_states = None
+        self.optimizer_initialized = True
+
+    def borrow_optimizer(self, shared_module: "Module") -> None:
+        """Share optimizer/updater state with another module — one set of
+        momenta across all buckets (reference: Module.borrow_optimizer,
+        required for BucketingModule correctness)."""
+        if not shared_module.optimizer_initialized:
+            raise MXNetError("shared module has no optimizer")
+        self._optimizer = shared_module._optimizer
+        self._updater = shared_module._updater
+        self._kvstore = shared_module._kvstore
+        self._param_index = shared_module._param_index
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None) -> None:
+        if not self.binded or not self.params_initialized:
+            raise MXNetError("forward requires bind + init_params")
+        self._exec_group.forward(data_batch, is_train)
+
+    def backward(self, out_grads=None) -> None:
+        self._exec_group.backward(out_grads)
+
+    def update(self) -> None:
+        """KVStore push/pull + optimizer step per param (reference §3.2)."""
+        if not self.optimizer_initialized:
+            raise MXNetError("update requires init_optimizer")
+        for name in self._param_names:
+            if name in self._fixed_param_names:
+                continue
+            i = self._param_index.get(name)
+            if i is None:       # param unknown to the shared optimizer
+                continue
+            grads = self._exec_group.grad_arrays_of(name)
+            if not grads:
+                continue
+            if self._kvstore is not None:
+                self._kvstore.push(i, grads)
+                agg = self._kvstore.pull(i)
+            else:
+                agg = grads[0]
+                for g in grads[1:]:
+                    agg = agg + g.as_in_context(agg.context)
+            weight = self._arg_params[name]
+            self._updater(i, agg.as_in_context(weight.context), weight)
+        self._exec_group.set_params(self._arg_params, self._aux_params)
+        # aux states (e.g. BN running stats) flow back from executor 0
+        exe = self._exec_group.execs[0]
+        for name in self._aux_names:
+            self._aux_params[name]._set_data(exe.aux_dict[name]._read())
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._exec_group.get_outputs(merge_multi_context)
+
+    def get_input_grads(self, merge_multi_context=True):
+        if not self.inputs_need_grad:
+            raise MXNetError("bind with inputs_need_grad=True first")
+        grads = []
+        for name in self._data_names:
+            gs = self._exec_group.grad_arrays_of(name)
+            grads.append(gs[0] if len(gs) == 1 else gs)
+        return grads
+
+    def update_metric(self, eval_metric, labels) -> None:
+        self._exec_group.update_metric(eval_metric, labels)
+
+    # -- checkpoint --------------------------------------------------------
+    def save_checkpoint(self, prefix: str, epoch: int,
+                        save_optimizer_states: bool = False) -> None:
+        arg, aux = self.get_params()
+        _save_ckpt(prefix, epoch, self._symbol, arg, aux)
+        if save_optimizer_states and self._updater is not None:
+            with open(f"{prefix}-{epoch:04d}.states", "wb") as f:
+                f.write(self._updater.get_states())
+
+    @staticmethod
+    def load(prefix: str, epoch: int, load_optimizer_states: bool = False,
+             **kwargs) -> "Module":
+        sym, arg, aux = _load_ckpt(prefix, epoch)
+        mod = Module(sym, **kwargs)
+        mod._preloaded = (arg, aux)
+        mod._preloaded_states = f"{prefix}-{epoch:04d}.states" \
+            if load_optimizer_states else None
+        return mod
+
+    def fit(self, train_data, **kwargs) -> None:
+        pre = getattr(self, "_preloaded", None)
+        if pre is not None and "arg_params" not in kwargs:
+            kwargs["arg_params"], kwargs["aux_params"] = pre
+            kwargs.setdefault("allow_missing", False)
+        super().fit(train_data, **kwargs)

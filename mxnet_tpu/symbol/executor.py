@@ -71,8 +71,11 @@ class Executor:
                 raise MXNetError(f"unknown input {k!r}")
             self.arg_dict[k]._set_data(
                 v._read() if isinstance(v, NDArray) else v)
-        vals = [a._read() for a in self.arg_arrays] + \
-            [a._read() for a in self.aux_arrays]
+        dev = self._ctx.device
+        # pin every operand to this executor's device: args may have been
+        # copied in from another context (multi-device executor groups)
+        vals = [jax.device_put(a._read(), dev) for a in self.arg_arrays] + \
+            [jax.device_put(a._read(), dev) for a in self.aux_arrays]
         fn = self._get_run(is_train)
         if is_train and self._grad_req != "null":
             outs, self._vjp_fn = jax.vjp(fn, *vals)

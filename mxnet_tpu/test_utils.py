@@ -34,12 +34,14 @@ default_rtols = {np.dtype(np.float16): 1e-2, np.dtype(np.float32): 1e-4,
 default_atols = {np.dtype(np.float16): 1e-1, np.dtype(np.float32): 1e-5,
                  np.dtype(np.float64): 1e-9, np.dtype(np.int32): 0,
                  np.dtype(np.int64): 0, np.dtype(np.uint8): 0}
-try:
-    import jax.numpy as _jnp
-    default_rtols[np.dtype(_jnp.bfloat16)] = 1e-1
-    default_atols[np.dtype(_jnp.bfloat16)] = 1e-1
-except Exception:
-    pass
+
+
+def _tol(table, dt, fallback):
+    """Tolerance lookup that treats bfloat16 like fp16 without importing
+    jax at module load (this file is imported from mxnet_tpu/__init__)."""
+    if getattr(dt, "name", "") == "bfloat16":
+        return 1e-1
+    return table.get(dt, fallback)
 
 
 def default_context() -> Context:
@@ -81,8 +83,8 @@ def same(a, b) -> bool:
 
 def almost_equal(a, b, rtol=None, atol=None, equal_nan=False) -> bool:
     a, b = _as_numpy(a), _as_numpy(b)
-    rtol = rtol if rtol is not None else default_rtols.get(a.dtype, 1e-5)
-    atol = atol if atol is not None else default_atols.get(a.dtype, 1e-8)
+    rtol = rtol if rtol is not None else _tol(default_rtols, a.dtype, 1e-5)
+    atol = atol if atol is not None else _tol(default_atols, a.dtype, 1e-8)
     return np.allclose(a, b, rtol=rtol, atol=atol, equal_nan=equal_nan)
 
 
@@ -90,8 +92,8 @@ def assert_almost_equal(a, b, rtol=None, atol=None, names=("a", "b"),
                         equal_nan=False) -> None:
     a, b = _as_numpy(a), _as_numpy(b)
     dt = a.dtype if a.dtype.kind == "f" else np.dtype(np.float32)
-    rtol = rtol if rtol is not None else default_rtols.get(dt, 1e-5)
-    atol = atol if atol is not None else default_atols.get(dt, 1e-8)
+    rtol = rtol if rtol is not None else _tol(default_rtols, dt, 1e-5)
+    atol = atol if atol is not None else _tol(default_atols, dt, 1e-8)
     if np.allclose(a.astype(np.float64, copy=False),
                    b.astype(np.float64, copy=False),
                    rtol=rtol, atol=atol, equal_nan=equal_nan):
@@ -320,8 +322,8 @@ def check_consistency(sym, ctx_list, scale: float = 1.0,
     ref_outs, ref_grads, _ = results[0]
     for (outs, grads, dts) in results[1:]:
         dt = np.dtype(dts[0]) if dts else np.dtype(np.float32)
-        rt = rtol if rtol is not None else default_rtols.get(dt, 1e-4)
-        at = atol if atol is not None else default_atols.get(dt, 1e-5)
+        rt = rtol if rtol is not None else _tol(default_rtols, dt, 1e-4)
+        at = atol if atol is not None else _tol(default_atols, dt, 1e-5)
         for o, r in zip(outs, ref_outs):
             assert_almost_equal(o.astype(np.float64), r.astype(np.float64),
                                 rt, at, ("ctx_out", "ref_out"))

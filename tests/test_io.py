@@ -204,3 +204,31 @@ def test_check_consistency_dtype():
         y, [{"ctx": ctx, "data": (2, 8), "type_dict": {"data": np.float32}},
             {"ctx": ctx, "data": (2, 8), "type_dict": {"data": np.float16}}],
         rtol=1e-1, atol=1e-1)
+
+
+def test_ndarray_iter_roll_over():
+    """roll_over: only full batches; the tail rolls into the next epoch —
+    no sample skipped, none duplicated (code-review regression)."""
+    data = np.arange(25, dtype=np.float32).reshape(25, 1)
+    it = mio.NDArrayIter(data, None, batch_size=8,
+                         last_batch_handle="roll_over", shuffle=False)
+    e1 = list(it)
+    assert len(e1) == 3 and all(b.pad == 0 for b in e1)
+    served1 = np.concatenate([b.data[0].asnumpy() for b in e1]).ravel()
+    np.testing.assert_array_equal(served1, np.arange(24))
+    e2 = list(it)
+    assert len(e2) == 3              # 1 carried + 25 new = 26 -> 3 batches
+    served2 = np.concatenate([b.data[0].asnumpy() for b in e2]).ravel()
+    assert served2[0] == 24.0        # the carried sample leads epoch 2
+    # across both epochs every sample appears, sample 24 twice at most once+carry
+    assert set(np.arange(25)) == set(served1) | set(served2)
+
+
+def test_image_record_iter_round_batch_tail(tmp_path):
+    """26 records, batch 8 -> 4 batches with the last one pad=6."""
+    rec, idx = _write_rec(tmp_path, n=26)
+    it = mio.ImageRecordIter(path_imgrec=rec, path_imgidx=idx,
+                             data_shape=(3, 28, 28), batch_size=8)
+    batches = list(it)
+    assert len(batches) == 4
+    assert [b.pad for b in batches] == [0, 0, 0, 6]

@@ -1,0 +1,163 @@
+"""Module API tests (reference model: tests/python/unittest/test_module.py).
+
+Covers bind/fit/score/predict, multi-context data parallelism, checkpoints,
+and BucketingModule bucket switching with shared parameters.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import io as mio
+
+
+def _toy_data(n=256, d=8, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    w = rng.standard_normal((d, k)).astype(np.float32)
+    y = (x @ w).argmax(axis=1).astype(np.float32)
+    return x, y
+
+
+def _mlp_sym(num_hidden=16, k=3):
+    data = mx.sym.var("data")
+    h = mx.sym.FullyConnected(data, num_hidden=num_hidden, name="fc1")
+    h = mx.sym.Activation(h, act_type="relu")
+    out = mx.sym.FullyConnected(h, num_hidden=k, name="fc2")
+    return mx.sym.SoftmaxOutput(out, mx.sym.var("softmax_label"),
+                                name="softmax")
+
+
+def test_module_fit_converges():
+    x, y = _toy_data()
+    it = mio.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.context.cpu())
+    mod.fit(it, num_epoch=8, optimizer="sgd",
+            optimizer_params={"learning_rate": 0.5})
+    score = mod.score(mio.NDArrayIter(x, y, batch_size=32), "acc")
+    assert dict(score)["accuracy"] > 0.9, score
+
+
+def test_module_predict_shapes_and_pad():
+    x, y = _toy_data(n=70)
+    it = mio.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.context.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             for_training=False)
+    mod.init_params()
+    out = mod.predict(it)
+    assert out.shape == (70, 3)     # padding stripped
+
+
+def test_module_multi_device_matches_single():
+    """Data-parallel over two cpu contexts must match a single-device run
+    (the reference's check_consistency idea at module level)."""
+    x, y = _toy_data(n=64)
+    sym = _mlp_sym()
+
+    def run(ctxs, seed=7):
+        mx.random.seed(seed)
+        np.random.seed(seed)
+        it = mio.NDArrayIter(x, y, batch_size=32)
+        mod = mx.mod.Module(sym, context=ctxs)
+        mod.bind(data_shapes=it.provide_data,
+                 label_shapes=it.provide_label)
+        mod.init_params(initializer=mx.initializer.Xavier(magnitude=2.0))
+        mod.init_optimizer(optimizer="sgd",
+                           optimizer_params={"learning_rate": 0.1})
+        for _ in range(3):
+            for batch in it:
+                mod.forward_backward(batch)
+                mod.update()
+            it.reset()
+        arg, _ = mod.get_params()
+        return {k: v.asnumpy() for k, v in arg.items()}
+
+    single = run(mx.context.cpu(0))
+    multi = run([mx.context.cpu(0), mx.context.cpu(1)])
+    for k in single:
+        np.testing.assert_allclose(single[k], multi[k], rtol=2e-3,
+                                   atol=2e-4, err_msg=k)
+
+
+def test_module_checkpoint_roundtrip(tmp_path):
+    x, y = _toy_data(n=64)
+    it = mio.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.context.cpu())
+    mod.fit(it, num_epoch=2, optimizer_params={"learning_rate": 0.1})
+    prefix = str(tmp_path / "toy")
+    mod.save_checkpoint(prefix, 2)
+    mod2 = mx.mod.Module.load(prefix, 2, context=mx.context.cpu())
+    it.reset()
+    mod2.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+              for_training=False)
+    arg, aux = mod.get_params()
+    mod2.init_params(arg_params=arg, aux_params=aux)
+    mod2.forward(next(iter(it)), is_train=False)
+    o2 = mod2.get_outputs()[0].asnumpy()
+    mod.forward(next(iter(mio.NDArrayIter(x, y, batch_size=32))),
+                is_train=False)
+    o1 = mod.get_outputs()[0].asnumpy()
+    np.testing.assert_allclose(o1, o2, rtol=1e-5, atol=1e-6)
+
+
+def test_bucketing_module_shares_params():
+    """Two buckets (seq lengths); training in one bucket must move the
+    predictions of the other (shared parameters) — the Sockeye contract."""
+    vocab, emb, k = 20, 8, 4
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        w = mx.sym.var("embed_weight")
+        x = mx.sym.Embedding(data, w, input_dim=vocab, output_dim=emb,
+                             name="embed")
+        x = mx.sym.mean(x, axis=1)     # params stay shape-invariant per bucket
+        out = mx.sym.FullyConnected(x, num_hidden=k, name="cls")
+        return (mx.sym.SoftmaxOutput(out, label, name="softmax"),
+                ["data"], ["softmax_label"])
+
+    rng = np.random.default_rng(1)
+    mod = mx.mod.BucketingModule(sym_gen, default_bucket_key=10,
+                                 context=mx.context.cpu())
+    from mxnet_tpu.io import DataDesc, DataBatch
+    mod.bind(data_shapes=[DataDesc("data", (8, 10), np.float32)],
+             label_shapes=[DataDesc("softmax_label", (8,), np.float32)])
+    mod.init_params()
+    mod.init_optimizer(optimizer="sgd",
+                       optimizer_params={"learning_rate": 0.5})
+
+    def batch(seq_len):
+        return DataBatch(
+            [mx.nd.array(rng.integers(0, vocab, (8, seq_len)))],
+            [mx.nd.array(rng.integers(0, k, (8,)))],
+            bucket_key=seq_len,
+            provide_data=[DataDesc("data", (8, seq_len), np.float32)],
+            provide_label=[DataDesc("softmax_label", (8,), np.float32)])
+
+    b5 = batch(5)
+    mod.forward(b5, is_train=False)
+    before = mod.get_outputs()[0].asnumpy()
+    assert mod._curr_bucket_key == 5
+
+    for _ in range(5):                      # train in the len-10 bucket
+        mod.forward(batch(10), is_train=True)
+        mod.backward()
+        mod.update()
+    mod.forward(b5, is_train=False)
+    after = mod.get_outputs()[0].asnumpy()
+    assert not np.allclose(before, after), \
+        "training bucket 10 must update shared params used by bucket 5"
+    assert set(mod._buckets) == {5, 10}
+
+
+def test_module_input_grads():
+    x, y = _toy_data(n=32)
+    it = mio.NDArrayIter(x, y, batch_size=32)
+    mod = mx.mod.Module(_mlp_sym(), context=mx.context.cpu())
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label,
+             inputs_need_grad=True)
+    mod.init_params()
+    mod.forward_backward(next(iter(it)))
+    g = mod.get_input_grads()[0]
+    assert g.shape == (32, 8)
+    assert np.abs(g.asnumpy()).sum() > 0
