@@ -25,9 +25,16 @@ def main():
     ap.add_argument("--iters", type=int, default=30)
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--size", type=int, default=224)
+    ap.add_argument("--dtype", choices=["float32", "bfloat16"],
+                    default="float32",
+                    help="bfloat16 enables AMP (MXU-native mode, ~1.4x; "
+                    "compare against the reference's fp16 numbers)")
     args = ap.parse_args()
 
     import jax
+    if args.dtype == "bfloat16":
+        from mxnet_tpu.contrib import amp
+        amp.init("bfloat16")
     from mxnet_tpu import parallel as par
     from mxnet_tpu.gluon import loss as gloss
     from mxnet_tpu.gluon.model_zoo.vision import resnet50_v1

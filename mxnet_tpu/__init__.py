@@ -43,6 +43,7 @@ from . import symbol
 from . import symbol as sym
 from . import module
 from . import module as mod
+from . import contrib
 from . import test_utils
 
 

@@ -1,0 +1,5 @@
+"""``mx.contrib``: experimental / extension namespaces (reference:
+python/mxnet/contrib/).  Holds amp (mixed precision) and the detection op
+frontends used by the GluonCV-style models.
+"""
+from . import amp  # noqa: F401

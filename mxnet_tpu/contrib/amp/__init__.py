@@ -1,0 +1,12 @@
+"""``mx.contrib.amp``: automatic mixed precision, bf16-first
+(reference: python/mxnet/contrib/amp/ — SURVEY.md §2.5, BASELINE config #3).
+"""
+from .amp import (init, disable, active, init_trainer, scale_loss, unscale,
+                  convert_symbol, convert_model, convert_hybrid_block)
+from .loss_scaler import LossScaler, DynamicLossScaler, StaticLossScaler
+from . import lists
+
+__all__ = ["init", "disable", "active", "init_trainer", "scale_loss",
+           "unscale", "convert_symbol", "convert_model",
+           "convert_hybrid_block", "LossScaler", "DynamicLossScaler",
+           "StaticLossScaler", "lists"]

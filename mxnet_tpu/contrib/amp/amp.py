@@ -1,0 +1,227 @@
+"""Automatic mixed precision (reference: python/mxnet/contrib/amp/amp.py).
+
+``amp.init()`` installs a pre-dispatch hook on the single op-dispatch funnel
+(ndarray.register.invoke) that inserts ``amp_cast`` around listed ops — the
+TPU-native equivalent of the reference's import-time monkey-patch of the
+generated op namespaces.  Because Gluon ``hybridize()`` traces through the
+same funnel, one hook covers the imperative, hybridized, and Symbol-executor
+paths; under jit the inserted casts are fused by XLA into the surrounding
+ops (a bf16 matmul with fused operand casts IS the MXU fast path, so AMP
+here costs zero extra kernels).
+
+Default low dtype is **bfloat16** — fp16's dynamic-range problems (and thus
+most of the reference's loss-scaling machinery) do not exist on TPU, but
+both the fp16 mode and the scaler are provided for parity.
+"""
+from __future__ import annotations
+
+import contextlib
+from typing import List, Optional
+
+import numpy as _np
+
+from ...base import MXNetError
+from ...ndarray import NDArray
+from ...ndarray.register import invoke_by_name, set_invoke_hook
+from .loss_scaler import DynamicLossScaler
+from . import lists
+
+__all__ = ["init", "init_trainer", "scale_loss", "unscale",
+           "convert_symbol", "convert_model", "convert_hybrid_block"]
+
+_state = {"active": False, "target_dtype": None}
+
+
+def _cast_nd(x, dtype_name: str):
+    if not isinstance(x, NDArray):
+        return x
+    kind = getattr(x.dtype, "kind", None)
+    name = getattr(x.dtype, "name", "")
+    if kind != "f" and name != "bfloat16":
+        return x                       # ints/bools pass through
+    if name == dtype_name or str(x.dtype) == dtype_name:
+        return x
+    return invoke_by_name("amp_cast", [x], {"dtype": dtype_name})
+
+
+def _make_hook(target: str):
+    lp16 = set(lists.LP16_OPS)
+    fp32 = set(lists.FP32_OPS)
+    widest = set(lists.WIDEST_TYPE_CASTS)
+
+    def hook(op_name: str, inputs):
+        if op_name in ("amp_cast", "cast", "Cast"):
+            return inputs
+        if op_name in lp16:
+            return [_cast_nd(x, target) for x in inputs]
+        if op_name in fp32:
+            return [_cast_nd(x, "float32") for x in inputs]
+        if op_name in widest:
+            names = {getattr(x.dtype, "name", str(x.dtype))
+                     for x in inputs if isinstance(x, NDArray)}
+            if "float32" in names and len(names) > 1:
+                return [_cast_nd(x, "float32") for x in inputs]
+        return inputs
+    return hook
+
+
+def init(target_dtype: str = "bfloat16") -> None:
+    """Turn on AMP process-wide (reference: amp.init()).  Call before
+    building the network, exactly like the reference requires."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be 'bfloat16' or 'float16'")
+    _state["active"] = True
+    _state["target_dtype"] = target_dtype
+    set_invoke_hook(_make_hook(target_dtype))
+
+
+def disable() -> None:
+    """Turn AMP back off (test hook; no reference analog)."""
+    _state["active"] = False
+    _state["target_dtype"] = None
+    set_invoke_hook(None)
+
+
+def active() -> bool:
+    return _state["active"]
+
+
+def init_trainer(trainer, loss_scaler: Optional[DynamicLossScaler] = None):
+    """Attach a dynamic loss scaler to a Gluon Trainer
+    (reference: amp.init_trainer)."""
+    scaler = loss_scaler or DynamicLossScaler()
+    trainer._amp_loss_scaler = scaler
+    trainer._amp_original_scale = trainer._scale
+    return trainer
+
+
+@contextlib.contextmanager
+def scale_loss(loss, trainer):
+    """``with amp.scale_loss(loss, trainer) as L: L.backward()`` —
+    multiplies the loss by the current scale and arranges for
+    ``trainer.step`` to unscale gradients (reference: amp.scale_loss)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    trainer._scale = trainer._amp_original_scale / scaler.loss_scale
+    from ... import autograd as _ag
+    # record the scaling multiply so backward reaches the original graph
+    # even when scale_loss is entered outside the record() block
+    with _ag.record():
+        if isinstance(loss, (list, tuple)):
+            scaled = [l * scaler.loss_scale for l in loss]
+        else:
+            scaled = loss * scaler.loss_scale
+    yield scaled
+
+
+def unscale(trainer) -> bool:
+    """Check grads for overflow and update the scaler; returns True if the
+    step must be SKIPPED.  Call between backward() and trainer.step() when
+    training fp16 (bf16 training normally never overflows)."""
+    scaler = getattr(trainer, "_amp_loss_scaler", None)
+    if scaler is None:
+        raise MXNetError("call amp.init_trainer(trainer) first")
+    overflow = scaler.has_overflow(trainer._params)
+    scaler.update_scale(overflow)
+    if overflow:
+        # consume the stale grads so the next step doesn't error
+        for p in trainer._params:
+            for d in p._data.values():
+                if d._ag is not None:
+                    d._ag.fresh = True
+    return overflow
+
+
+# ---------------------------------------------------------------------------
+# graph conversion (symbolic path)
+# ---------------------------------------------------------------------------
+
+def convert_symbol(sym, target_dtype: str = "bfloat16",
+                   target_dtype_ops: Optional[List[str]] = None,
+                   fp32_ops: Optional[List[str]] = None,
+                   widest_dtype_ops: Optional[List[str]] = None,
+                   excluded_sym_names: Optional[List[str]] = None):
+    """Insert amp_cast nodes into a Symbol graph
+    (reference: amp.convert_symbol)."""
+    from ...symbol.symbol import Symbol, _Node
+    lp16 = set(target_dtype_ops if target_dtype_ops is not None
+               else lists.LP16_OPS)
+    fp32 = set(fp32_ops if fp32_ops is not None else lists.FP32_OPS)
+    widest = set(widest_dtype_ops if widest_dtype_ops is not None
+                 else lists.WIDEST_TYPE_CASTS)
+    excluded = set(excluded_sym_names or [])
+
+    order = sym._topo()
+    mapping = {}
+
+    def casted(node_out, dtype_name, tag):
+        node, idx = node_out
+        cast = _Node("amp_cast", f"{node.name}_amp_{tag}",
+                     {"dtype": dtype_name}, [(node, idx)])
+        return (cast, 0)
+
+    for node in order:
+        if node.is_var:
+            mapping[id(node)] = node
+            continue
+        new_inputs = [(mapping[id(p)], i) for p, i in node.inputs]
+        if node.name not in excluded:
+            if node.op in lp16:
+                new_inputs = [casted(pi, target_dtype, "lp") for pi in
+                              new_inputs]
+            elif node.op in fp32:
+                new_inputs = [casted(pi, "float32", "f32") for pi in
+                              new_inputs]
+            elif node.op in widest and len(new_inputs) > 1:
+                # runtime widest-dtype resolution (reference amp_multicast)
+                mc = _Node("amp_multicast", f"{node.name}_amp_widest",
+                           {"num_outputs": len(new_inputs)}, new_inputs,
+                           num_outputs=len(new_inputs))
+                new_inputs = [(mc, i) for i in range(len(new_inputs))]
+        new_node = _Node(node.op, node.name, dict(node.attrs), new_inputs,
+                        node.num_outputs)
+        mapping[id(node)] = new_node
+    heads = [(mapping[id(n)], i) for n, i in sym._heads]
+    return Symbol(heads)
+
+
+def convert_model(sym, arg_params, aux_params,
+                  target_dtype: str = "bfloat16", **kwargs):
+    """Convert a Module-style checkpoint triple (reference:
+    amp.convert_model).  Params stay fp32 (master copies); low-precision
+    entry happens at the inserted casts."""
+    return convert_symbol(sym, target_dtype, **kwargs), arg_params, \
+        aux_params
+
+
+@contextlib.contextmanager
+def _scoped_hook(target: str):
+    """Enable the AMP cast hook only for the duration of a call — used by
+    per-block conversion so unrelated models keep full precision."""
+    from ...ndarray import register as _reg
+    prev = _reg._invoke_hook
+    set_invoke_hook(_make_hook(target))
+    try:
+        yield
+    finally:
+        set_invoke_hook(prev)
+
+
+def convert_hybrid_block(block, target_dtype: str = "bfloat16"):
+    """Mixed-precision ONE block (reference: amp.convert_hybrid_block) —
+    its forward (and the hybridize trace, which runs through the hooked
+    funnel) executes under the cast hook; other models are untouched."""
+    if target_dtype not in ("bfloat16", "float16"):
+        raise MXNetError("target_dtype must be 'bfloat16' or 'float16'")
+    inner = block.forward
+
+    def amp_forward(*args):
+        if _state["active"]:          # process-wide AMP already covers it
+            return inner(*args)
+        with _scoped_hook(target_dtype):
+            return inner(*args)
+
+    block.forward = amp_forward       # instance attr shadows class method
+    block.hybridize()
+    return block

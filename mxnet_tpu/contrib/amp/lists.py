@@ -1,0 +1,33 @@
+"""AMP op cast lists (reference: python/mxnet/contrib/amp/lists/symbol_fp16.py).
+
+Three classes, same policy as the reference:
+- LP16: compute-bound ops that are safe and fast in low precision — the MXU
+  ops (matmul/conv families).  On TPU the low-precision dtype is bfloat16
+  by default (fp16 supported for parity); bf16 matmuls are the MXU's native
+  mode, so this list is exactly "what should hit the MXU in bf16".
+- FP32: numerically-sensitive ops forced to fp32 (reductions through exp/
+  log, norms, losses).
+- WIDEST: multi-input elementwise ops run in the widest input dtype.
+Everything else runs in whatever dtype its inputs already have.
+"""
+
+LP16_OPS = [
+    "FullyConnected", "Convolution", "Deconvolution", "RNN",
+    "dot", "batch_dot", "linalg_gemm2",
+]
+
+FP32_OPS = [
+    "BatchNorm", "LayerNorm", "InstanceNorm", "L2Normalization",
+    "softmax", "log_softmax", "softmin", "SoftmaxOutput",
+    "exp", "expm1", "log", "log1p", "log2", "log10",
+    "sum", "nansum", "prod", "nanprod", "mean", "norm",
+    "gamma", "gammaln", "erf", "erfinv",
+    "square", "sqrt", "rsqrt", "cbrt", "rcbrt", "reciprocal",
+    "smooth_l1", "make_loss", "power", "broadcast_power",
+]
+
+WIDEST_TYPE_CASTS = [
+    "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
+    "broadcast_mod", "broadcast_hypot", "broadcast_maximum",
+    "broadcast_minimum", "concat", "stack", "where", "dot", "batch_dot",
+]

@@ -104,6 +104,15 @@ def _register():
         return fn
     register_op("amp_cast", amp_cast_maker)
 
+    def amp_multicast_maker(num_outputs=1):
+        def fn(*xs):
+            widest = jnp.result_type(*xs)
+            return tuple(x.astype(widest) for x in xs)
+        return fn
+    register_op("amp_multicast", amp_multicast_maker,
+                doc="cast all inputs to their widest dtype (reference: "
+                    "src/operator/tensor/amp_cast.cc amp_multicast)")
+
     simple_op("zeros_like", jnp.zeros_like, differentiable=False)
     simple_op("ones_like", jnp.ones_like, differentiable=False)
     simple_op("shape_array",

@@ -122,6 +122,19 @@ def _as_nd(x, ctx):
     return array(x, ctx=ctx)
 
 
+# pre-dispatch rewrite hook (installed by contrib.amp to insert casts):
+# fn(op_name, inputs) -> inputs
+_invoke_hook = None
+
+
+def set_invoke_hook(fn) -> None:
+    """Install (or clear, with None) the global pre-dispatch input-rewrite
+    hook — the seam contrib.amp uses for automatic mixed precision, the
+    analog of the reference's amp.init() op-namespace monkey-patch."""
+    global _invoke_hook
+    _invoke_hook = fn
+
+
 def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
            out=None):
     """Dispatch an op imperatively (reference stack §3.1).
@@ -131,6 +144,8 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
     """
     import jax
     from .ndarray import NDArray
+    if _invoke_hook is not None:
+        inputs = _invoke_hook(op.name, inputs)
 
     ctx = None
     for x in inputs:
@@ -169,7 +184,12 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
         outs_for_write = outs if multi else [outs[0]]
         targets = out if isinstance(out, (list, tuple)) else [out]
         for tgt, src in zip(targets, outs_for_write):
-            tgt._set_data(src._read())
+            val = src._read()
+            # out= keeps the target's dtype (an AMP cast hook may have
+            # changed the compute dtype; the write-back contract wins)
+            if val.dtype != tgt.dtype:
+                val = val.astype(tgt.dtype)
+            tgt._set_data(val)
         return out
     return outs if multi else outs[0]
 

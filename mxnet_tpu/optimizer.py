@@ -26,6 +26,13 @@ __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp", "Ftrl",
 _registry: Dict[str, type] = {}
 
 
+def _is_low_precision(dtype) -> bool:
+    """fp16 or bfloat16 — the dtypes multi_precision keeps fp32 masters for
+    (bf16 is the TPU-native low precision; fp16 kept for parity)."""
+    return dtype == _np.float16 or \
+        getattr(_np.dtype(dtype), "name", "") == "bfloat16"
+
+
 def register(klass):
     _registry[klass.__name__.lower()] = klass
     return klass
@@ -113,7 +120,7 @@ class Optimizer:
         return None
 
     def create_state_multi_precision(self, index, weight):
-        if self.multi_precision and weight.dtype == _np.float16:
+        if self.multi_precision and _is_low_precision(weight.dtype):
             w32 = weight.astype("float32")
             return (self.create_state(index, w32), w32)
         return self.create_state(index, weight)

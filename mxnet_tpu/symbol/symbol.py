@@ -378,7 +378,12 @@ def _infer_missing(sym: Symbol, known: Dict[str, Tuple[int, ...]],
         for p, i in node.inputs:
             s = shapes.get((id(p), i))
             in_shapes.append(s)
-            if s is None and p.is_var:
+            # see through dtype casts (AMP-converted graphs wrap params in
+            # amp_cast): the shape rule applies to the underlying variable
+            while p is not None and p.op in ("amp_cast", "cast", "Cast") \
+                    and len(p.inputs) == 1:
+                p = p.inputs[0][0]
+            if s is None and p is not None and p.is_var:
                 unknown_inputs.append((p, len(in_shapes) - 1))
         if unknown_inputs:
             _infer_node_params(node, in_shapes, unknown_inputs, out)

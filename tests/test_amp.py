@@ -1,0 +1,176 @@
+"""AMP mixed-precision tests (reference model: tests/python/ unittest
+amp coverage + BASELINE config #3 bf16 path)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.contrib import amp
+
+
+@pytest.fixture(autouse=True)
+def _amp_off_after():
+    yield
+    amp.disable()
+
+
+def _bf16_name(x):
+    return getattr(x.dtype, "name", str(x.dtype))
+
+
+def test_amp_casts_matmul_to_bf16():
+    amp.init("bfloat16")
+    x = mx.nd.ones((4, 8))
+    w = mx.nd.ones((16, 8))
+    y = mx.nd.FullyConnected(x, w, num_hidden=16, no_bias=True)
+    assert _bf16_name(y) == "bfloat16"
+    # fp32-list op forces float32 back
+    s = mx.nd.softmax(y)
+    assert _bf16_name(s) == "float32"
+
+
+def test_amp_training_converges_params_stay_fp32():
+    amp.init("bfloat16")
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((128, 16)).astype(np.float32)
+    y = (x[:, :4].sum(1) > 0).astype(np.float32)
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(32, activation="relu"), gluon.nn.Dense(2))
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.5})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(10):
+        with autograd.record():
+            L = lossfn(net(mx.nd.array(x)), mx.nd.array(y))
+        L.backward()
+        tr.step(128)
+        losses.append(float(L.mean().asnumpy()))
+    assert losses[-1] < losses[0]
+    for p in net.collect_params().values():
+        assert p.data().dtype == np.float32      # masters stay fp32
+
+
+def test_amp_hybridized_matches_eager():
+    amp.init("bfloat16")
+    net = gluon.nn.Dense(8)
+    net.initialize()
+    x = mx.nd.random.normal(shape=(4, 16))
+    eager = net(x).asnumpy()
+    net.hybridize()
+    hybrid = net(x).asnumpy()
+    np.testing.assert_allclose(eager.astype(np.float32),
+                               hybrid.astype(np.float32), rtol=2e-2,
+                               atol=2e-2)
+
+
+def test_loss_scaling_skips_overflow_step():
+    net = gluon.nn.Dense(4)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1})
+    amp.init_trainer(tr)
+    scaler = tr._amp_loss_scaler
+    s0 = scaler.loss_scale
+    x = mx.nd.ones((2, 8)) * 1e30           # guaranteed inf in fp32 loss
+    with autograd.record():
+        out = net(x)
+        L = (out * out).sum() * 1e30
+    with amp.scale_loss(L, tr) as scaled:
+        scaled.backward()
+    skipped = amp.unscale(tr)
+    assert skipped
+    assert scaler.loss_scale == s0 / 2
+    # trainer.step must not raise a stale-grad error on the next clean pass
+    with autograd.record():
+        L = (net(mx.nd.ones((2, 8))) ** 2.0).sum()
+    with amp.scale_loss(L, tr) as scaled:
+        scaled.backward()
+    assert not amp.unscale(tr)
+    tr.step(2)
+
+
+def test_convert_symbol_inserts_casts():
+    data = mx.sym.var("data")
+    y = mx.sym.FullyConnected(data, num_hidden=4, name="fc")
+    y = mx.sym.softmax(y)
+    conv = amp.convert_symbol(y, "bfloat16")
+    ops = [n.op for n in conv._topo() if not n.is_var]
+    assert "amp_cast" in ops
+    # numerics stay close to the fp32 graph
+    x = np.random.randn(2, 6).astype(np.float32)
+    w = np.random.randn(4, 6).astype(np.float32)
+    b = np.zeros(4, np.float32)
+    exe32 = y.simple_bind(ctx=mx.context.cpu(), data=(2, 6))
+    exe16 = conv.simple_bind(ctx=mx.context.cpu(), data=(2, 6))
+    for exe in (exe32, exe16):
+        exe.arg_dict["data"]._set_data(x)
+        exe.arg_dict["fc_weight"]._set_data(w)
+        exe.arg_dict["fc_bias"]._set_data(b)
+    o32 = exe32.forward()[0].asnumpy()
+    o16 = exe16.forward()[0].asnumpy()
+    np.testing.assert_allclose(o32, o16, rtol=3e-2, atol=3e-2)
+
+
+def test_multi_precision_bf16_masters():
+    import jax.numpy as jnp
+    opt = mx.optimizer.create("sgd", learning_rate=0.1, momentum=0.9,
+                              multi_precision=True)
+    w = mx.nd.ones((4, 4), dtype="bfloat16")
+    g = mx.nd.ones((4, 4), dtype="bfloat16") * 0.01
+    state = opt.create_state_multi_precision(0, w)
+    assert isinstance(state, tuple) and state[1].dtype == np.float32
+    for _ in range(3):
+        opt.update_multi_precision(0, w, g, state)
+    assert _bf16_name(w) == "bfloat16"
+    # master moved by ~lr*grad accumulation, weight tracks it
+    assert float(state[1].asnumpy().mean()) < 1.0
+    np.testing.assert_allclose(w.asnumpy().astype(np.float32),
+                               state[1].asnumpy(), rtol=1e-2)
+
+
+def test_has_overflow_elementwise_not_sum():
+    """Finite fp16 grads that SUM to inf must not count as overflow."""
+    from mxnet_tpu.contrib.amp.loss_scaler import LossScaler
+
+    class _P:
+        def list_grad(self):
+            return [mx.nd.array(np.full((10000,), 100.0, np.float16),
+                                dtype="float16")]
+    assert not LossScaler().has_overflow([_P()])
+
+
+def test_out_keeps_target_dtype_under_amp():
+    amp.init("bfloat16")
+    a = mx.nd.ones((4, 4))
+    b = mx.nd.ones((4, 4))
+    c = mx.nd.zeros((4, 4))
+    mx.nd.dot(a, b, out=c)
+    assert c.dtype == np.float32
+    assert _bf16_name(mx.nd.array(c._read())) == "float32"
+
+
+def test_convert_hybrid_block_is_scoped():
+    net_a = gluon.nn.Dense(8)
+    net_a.initialize()
+    net_b = gluon.nn.Dense(8)
+    net_b.initialize()
+    amp.convert_hybrid_block(net_a, "bfloat16")
+    x = mx.nd.random.normal(shape=(2, 4))
+    # untouched model stays fp32 end to end
+    assert _bf16_name(net_b(x)) == "float32"
+    assert _bf16_name(net_a(x)) == "bfloat16"
+    assert _bf16_name(net_b(x)) == "float32"
+
+
+def test_convert_symbol_widest_multicast():
+    a = mx.sym.var("a")
+    b = mx.sym.var("b")
+    y = mx.sym.broadcast_add(a, b)
+    conv = amp.convert_symbol(y, "bfloat16", widest_dtype_ops=["broadcast_add"])
+    ops = [n.op for n in conv._topo() if not n.is_var]
+    assert "amp_multicast" in ops
+    out = conv.eval_dict({"a": np.ones((2, 2), np.float32),
+                          "b": np.ones((2, 2), np.float16)})
+    assert out.asnumpy().dtype == np.float32  # widest wins
