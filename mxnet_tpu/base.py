@@ -148,3 +148,68 @@ def dtype_name(dtype: Any) -> str:
 
 def default_dtype() -> str:
     return os.environ.get("MXNET_DEFAULT_DTYPE", "float32")
+
+
+def resolve_reshape_spec(in_dims, spec, reverse=False):
+    """Resolve MXNet reshape specials (src/operator/tensor/matrix_op-inl.h):
+    0 = copy input dim, -1 = infer, -2 = copy all remaining dims,
+    -3 = merge next two dims, -4 d1 d2 = split one dim into (d1, d2).
+    ``reverse=True`` applies the rules right-to-left.  The single source of
+    truth for both the reshape op and the NDArray.reshape view path."""
+    in_dims = list(in_dims)
+    spec = [int(s) for s in spec]
+    # group multi-token units so reverse mode can't split a -4 triple
+    units = []
+    j = 0
+    while j < len(spec):
+        if spec[j] == -4:
+            units.append(spec[j:j + 3])
+            j += 3
+        else:
+            units.append([spec[j]])
+            j += 1
+    if reverse:
+        # mirror both sides; a -4's operands swap roles in the mirror
+        units = [([-4, u[2], u[1]] if u[0] == -4 else u)
+                 for u in units[::-1]]
+        in_dims = in_dims[::-1]
+    out = []
+    i = 0
+    for u in units:
+        s = u[0]
+        if s == 0:
+            out.append(in_dims[i])
+            i += 1
+        elif s == -2:
+            out.extend(in_dims[i:])
+            i = len(in_dims)
+        elif s == -3:
+            out.append(in_dims[i] * in_dims[i + 1])
+            i += 2
+        elif s == -4:
+            d1, d2 = u[1], u[2]
+            cur = in_dims[i]
+            if d1 == -1:
+                d1 = cur // d2
+            if d2 == -1:
+                d2 = cur // d1
+            out.extend([d1, d2])
+            i += 1
+        elif s == -1:
+            out.append(-1)
+            i += 1
+        else:
+            out.append(s)
+            i += 1
+    if reverse:
+        out = out[::-1]
+    if -1 in out:
+        known = 1
+        for s in out:
+            if s != -1:
+                known *= s
+        total = 1
+        for s in in_dims:
+            total *= s
+        out[out.index(-1)] = total // max(known, 1)
+    return tuple(out)

@@ -26,8 +26,10 @@ FP32_OPS = [
     "smooth_l1", "make_loss", "power", "broadcast_power",
 ]
 
+# note: LP16 takes precedence over WIDEST in both the hook and
+# convert_symbol, so LP16 ops must not be repeated here
 WIDEST_TYPE_CASTS = [
     "broadcast_add", "broadcast_sub", "broadcast_mul", "broadcast_div",
     "broadcast_mod", "broadcast_hypot", "broadcast_maximum",
-    "broadcast_minimum", "concat", "stack", "where", "dot", "batch_dot",
+    "broadcast_minimum", "concat", "stack", "where",
 ]

@@ -3,6 +3,8 @@ transformer family covers the GluonNLP/Sockeye configs the BASELINE names —
 those are downstream repos in the reference ecosystem, SURVEY.md §1)."""
 from . import vision
 from . import transformer
+from . import ssd
 from .vision import get_model
 from .transformer import (BERTModel, TransformerNMT, bert_base, bert_small,
                           transformer_nmt_base, TP_RULES)
+from .ssd import SSD, SSDMultiBoxLoss, ssd_512_resnet50_v1, ssd_toy

@@ -1,0 +1,185 @@
+"""SSD single-shot detector (BASELINE config #5).
+
+Reference parity: the GluonCV SSD family is *downstream* of the reference —
+built entirely on Gluon + the contrib detection ops (multibox_prior/
+target/detection, src/operator/contrib/ — SURVEY.md §2.2).  This module
+provides the same model shape on this framework: multi-scale feature
+stages, per-scale anchor generators and conv predictors, and the
+SSDMultiBoxLoss (cross-entropy with hard-negative mining via
+MultiBoxTarget + SmoothL1), all static-shaped for XLA.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence
+
+from ...base import MXNetError
+from ..block import HybridBlock
+from ..loss import Loss
+from .. import nn
+
+__all__ = ["SSDAnchorGenerator", "ConvPredictor", "SSD", "SSDMultiBoxLoss",
+           "ssd_512_resnet50_v1", "ssd_toy"]
+
+
+class SSDAnchorGenerator(HybridBlock):
+    """Per-scale anchors via MultiBoxPrior (reference: multibox_prior.cc)."""
+
+    def __init__(self, sizes, ratios, clip=True, **kwargs):
+        super().__init__(**kwargs)
+        self._sizes = tuple(sizes)
+        self._ratios = tuple(ratios)
+        self._clip = clip
+
+    @property
+    def num_anchors(self) -> int:
+        return len(self._sizes) + len(self._ratios) - 1
+
+    def hybrid_forward(self, F, x):
+        return F.contrib.MultiBoxPrior(x, sizes=self._sizes,
+                                       ratios=self._ratios,
+                                       clip=self._clip)
+
+
+class ConvPredictor(HybridBlock):
+    """3x3 conv head emitting num_outputs values per anchor position."""
+
+    def __init__(self, num_outputs, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.predictor = nn.Conv2D(num_outputs, 3, 1, 1)
+
+    def hybrid_forward(self, F, x):
+        return self.predictor(x)
+
+
+class SSD(HybridBlock):
+    """Multi-scale detector.
+
+    ``stages`` is a list of HybridBlocks applied sequentially; the output
+    of EACH stage is a prediction source.  Returns
+    (anchors (1,N,4), cls_preds (B,N,classes+1), box_preds (B,N*4)).
+    """
+
+    def __init__(self, stages: Sequence[HybridBlock], classes: int,
+                 sizes: Sequence[Sequence[float]],
+                 ratios: Sequence[Sequence[float]], **kwargs):
+        super().__init__(**kwargs)
+        if not (len(stages) == len(sizes) == len(ratios)):
+            raise MXNetError("stages, sizes, ratios must align per scale")
+        self._classes = classes
+        with self.name_scope():
+            self.stages = nn.HybridSequential(prefix="stages_")
+            for s in stages:
+                self.stages.add(s)
+            self.anchor_generators = []
+            self.class_predictors = nn.HybridSequential(prefix="cls_")
+            self.box_predictors = nn.HybridSequential(prefix="box_")
+            for i, (s, r) in enumerate(zip(sizes, ratios)):
+                gen = SSDAnchorGenerator(s, r, prefix=f"anchor{i}_")
+                self.anchor_generators.append(gen)
+                self.register_child(gen)
+                na = gen.num_anchors
+                self.class_predictors.add(
+                    ConvPredictor(na * (classes + 1)))
+                self.box_predictors.add(ConvPredictor(na * 4))
+
+    @property
+    def classes(self) -> int:
+        return self._classes
+
+    def hybrid_forward(self, F, x):
+        anchors, cls_preds, box_preds = [], [], []
+        for stage, gen, cp, bp in zip(self.stages,
+                                      self.anchor_generators,
+                                      self.class_predictors,
+                                      self.box_predictors):
+            x = stage(x)
+            anchors.append(gen(x))
+            # (B, A*(C+1), H, W) -> (B, H*W*A, C+1) flattened per anchor
+            c = cp(x)
+            c = F.transpose(c, axes=(0, 2, 3, 1))
+            cls_preds.append(F.reshape(c, shape=(0, -1,
+                                                 self._classes + 1)))
+            b = bp(x)
+            b = F.transpose(b, axes=(0, 2, 3, 1))
+            box_preds.append(F.reshape(b, shape=(0, -1)))
+        return (F.concat(*anchors, dim=1),
+                F.concat(*cls_preds, dim=1),
+                F.concat(*box_preds, dim=1))
+
+
+class SSDMultiBoxLoss(Loss):
+    """SmoothL1 loc loss + CE cls loss over MultiBoxTarget outputs
+    (the loss GluonCV's SSD trains with)."""
+
+    def __init__(self, negative_mining_ratio=3.0, overlap_threshold=0.5,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._ratio = negative_mining_ratio
+        self._thresh = overlap_threshold
+
+    def __call__(self, anchors, cls_preds, box_preds, labels):
+        from ... import ndarray as nd
+        # targets (no grad through matching, reference FGradient=None)
+        loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(
+            anchors, labels, nd.transpose(cls_preds, axes=(0, 2, 1)),
+            overlap_threshold=self._thresh,
+            negative_mining_ratio=self._ratio, ignore_label=-1.0)
+        loc_t = nd.stop_gradient(loc_t)
+        loc_m = nd.stop_gradient(loc_m)
+        cls_t = nd.stop_gradient(cls_t)
+        # classification: CE where target >= 0 (−1 = ignored by mining)
+        valid = cls_t >= 0.0
+        logp = nd.log_softmax(cls_preds, axis=-1)
+        cls_loss = -nd.pick(logp, nd.maximum(cls_t, 0.0 * cls_t), axis=-1)
+        cls_loss = nd.where(valid, cls_loss, nd.zeros_like(cls_loss))
+        # localization: smooth-L1 on matched anchors only
+        loc_loss = nd.smooth_l1(box_preds - loc_t, scalar=1.0) * loc_m
+        num_pos = nd.maximum(nd.sum(loc_m) / 4.0,
+                             nd.ones_like(nd.sum(loc_m)))
+        return (nd.sum(cls_loss) + nd.sum(loc_loss)) / num_pos
+
+
+def _down_block(channels: int) -> nn.HybridSequential:
+    """1x1 squeeze + 3x3 stride-2 expand (standard SSD extra layer)."""
+    blk = nn.HybridSequential()
+    blk.add(nn.Conv2D(channels // 2, 1, 1, 0, use_bias=False),
+            nn.BatchNorm(), nn.Activation("relu"),
+            nn.Conv2D(channels, 3, 2, 1, use_bias=False),
+            nn.BatchNorm(), nn.Activation("relu"))
+    return blk
+
+
+# per-scale anchor config for the 512 variant (GluonCV ssd_512 settings)
+_SIZES_512 = [[0.07, 0.1025], [0.15, 0.2121], [0.3, 0.3674],
+              [0.45, 0.5196], [0.6, 0.6708], [0.75, 0.8216]]
+_RATIOS_512 = [[1, 2, 0.5]] * 2 + [[1, 2, 0.5, 3, 1.0 / 3]] * 4
+
+
+def ssd_512_resnet50_v1(classes: int = 20, **kwargs) -> SSD:
+    """SSD-512 on a ResNet-50 v1 backbone (BASELINE config #5 shape)."""
+    from .vision.resnet import resnet50_v1
+    base = resnet50_v1()
+    feats = list(base.features)       # conv,bn,relu,pool,stage1..4,gap
+    # stage outputs: up to stage3 (stride 16) and stage4 (stride 32)
+    stage1 = nn.HybridSequential(prefix="base3_")
+    for f in feats[:7]:               # through stage3
+        stage1.add(f)
+    stage2 = nn.HybridSequential(prefix="base4_")
+    stage2.add(feats[7])              # stage4
+    stages: List[HybridBlock] = [stage1, stage2]
+    for _ in range(4):                # 4 extra downsampling scales
+        stages.append(_down_block(512))
+    return SSD(stages, classes, _SIZES_512, _RATIOS_512, **kwargs)
+
+
+def ssd_toy(classes: int = 3, **kwargs) -> SSD:
+    """Small 3-scale SSD for tests/CI (thumbnail inputs)."""
+    s1 = nn.HybridSequential()
+    s1.add(nn.Conv2D(16, 3, 2, 1), nn.Activation("relu"),
+           nn.Conv2D(32, 3, 2, 1), nn.Activation("relu"))
+    s2 = _down_block(64)
+    s3 = _down_block(64)
+    return SSD([s1, s2, s3], classes,
+               sizes=[[0.2, 0.272], [0.37, 0.447], [0.54, 0.619]],
+               ratios=[[1, 2, 0.5]] * 3, **kwargs)

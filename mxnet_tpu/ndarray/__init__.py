@@ -22,7 +22,9 @@ from . import ops_reduce as _ops_reduce          # noqa: F401
 from . import ops_matrix as _ops_matrix          # noqa: F401
 from . import ops_nn as _ops_nn                  # noqa: F401
 from . import ops_optimizer as _ops_optimizer    # noqa: F401
+from . import ops_contrib as _ops_contrib        # noqa: F401
 from . import random                              # noqa: F401
+from . import contrib                             # noqa: F401
 
 _mod = _sys.modules[__name__]
 

@@ -1,0 +1,16 @@
+"""``mx.nd.contrib``: frontends for the _contrib_* ops (reference:
+python/mxnet/ndarray/contrib.py — generated from the registry's contrib
+namespace).
+"""
+from __future__ import annotations
+
+import sys as _sys
+
+from .register import _registry, make_frontend
+
+_PREFIX = "_contrib_"
+_mod = _sys.modules[__name__]
+
+for _name, _op in list(_registry.items()):
+    if _name.startswith(_PREFIX):
+        setattr(_mod, _name[len(_PREFIX):], make_frontend(_op))

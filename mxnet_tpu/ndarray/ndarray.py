@@ -264,7 +264,8 @@ class NDArray:
         if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
             shape = tuple(shape[0])
         shape = kwargs.get("shape", shape)
-        shape = _infer_reshape(self._shape, tuple(int(s) for s in shape))
+        shape = _infer_reshape(self._shape, tuple(int(s) for s in shape),
+                               reverse=bool(kwargs.get("reverse", False)))
         if _autograd.is_recording():
             from .register import invoke_by_name
             return invoke_by_name("reshape", [self], {"shape": shape})
@@ -495,25 +496,12 @@ class NDArray:
 # helpers
 # ---------------------------------------------------------------------------
 
-def _infer_reshape(old: Tuple[int, ...], new: Tuple[int, ...]) -> Tuple[int, ...]:
-    """Resolve -1 / 0 placeholders (MXNet reshape conventions: 0 copies the
-    input dim at that position, -1 infers)."""
-    out = []
-    for i, s in enumerate(new):
-        if s == 0:
-            out.append(old[i])
-        else:
-            out.append(s)
-    if -1 in out:
-        known = 1
-        for s in out:
-            if s != -1:
-                known *= s
-        total = 1
-        for s in old:
-            total *= s
-        out[out.index(-1)] = total // max(known, 1)
-    return tuple(out)
+def _infer_reshape(old: Tuple[int, ...], new: Tuple[int, ...],
+                   reverse: bool = False) -> Tuple[int, ...]:
+    """Resolve MXNet reshape placeholders (0/-1/-2/-3/-4) — delegates to the
+    shared resolver in base so the op path and this view path agree."""
+    from ..base import resolve_reshape_spec
+    return resolve_reshape_spec(old, new, reverse)
 
 
 def _freeze_key(key):

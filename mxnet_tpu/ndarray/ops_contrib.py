@@ -1,0 +1,369 @@
+"""Detection operators (reference: src/operator/contrib/ — multibox_prior.cc,
+multibox_target.cc, multibox_detection.cc, bounding_box.cc, roi_align.cc;
+SURVEY.md §2.2).  These back the GluonCV-style SSD/Mask-RCNN models
+(BASELINE config #5).
+
+TPU-native design: every op is static-shaped pad-and-mask — suppressed/
+invalid entries are marked (score −1 / label −1) instead of shrinking the
+tensor, NMS is a fixed-iteration greedy scan over a topk-pruned candidate
+set (`lax.scan`), and ROIAlign is a vmapped gather+bilinear kernel.  No
+dynamic shapes ever reach XLA.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # ---- multibox_prior --------------------------------------------------
+    def multibox_prior_maker(sizes=(1.0,), ratios=(1.0,), clip=False,
+                             steps=(-1.0, -1.0), offsets=(0.5, 0.5)):
+        sizes = tuple(float(s) for s in _astuple(sizes))
+        ratios = tuple(float(r) for r in _astuple(ratios))
+        steps_ = tuple(float(s) for s in _astuple(steps))
+        offs = tuple(float(o) for o in _astuple(offsets))
+
+        def fn(data):
+            h, w = data.shape[2], data.shape[3]
+            step_y = steps_[0] if steps_[0] > 0 else 1.0 / h
+            step_x = steps_[1] if steps_[1] > 0 else 1.0 / w
+            cy = (jnp.arange(h, dtype=jnp.float32) + offs[0]) * step_y
+            cx = (jnp.arange(w, dtype=jnp.float32) + offs[1]) * step_x
+            cyg, cxg = jnp.meshgrid(cy, cx, indexing="ij")
+            # anchor set: (sizes[0], every ratio) + (sizes[1:], ratios[0]) —
+            # reference ordering: size-ratio pairs (s_i, r_0) first, then
+            # (s_0, r_j>0): multibox_prior.cc uses sizes-first enumeration
+            whs = []
+            for s in sizes:
+                r = ratios[0]
+                whs.append((s * _np.sqrt(r), s / _np.sqrt(r)))
+            for r in ratios[1:]:
+                s = sizes[0]
+                whs.append((s * _np.sqrt(r), s / _np.sqrt(r)))
+            boxes = []
+            for bw, bh in whs:
+                boxes.append(jnp.stack([cxg - bw / 2, cyg - bh / 2,
+                                        cxg + bw / 2, cyg + bh / 2],
+                                       axis=-1))
+            out = jnp.stack(boxes, axis=2).reshape(1, -1, 4)
+            if clip:
+                out = jnp.clip(out, 0.0, 1.0)
+            return out
+        return fn
+    register_op("_contrib_MultiBoxPrior", multibox_prior_maker,
+                aliases=("MultiBoxPrior", "multibox_prior"))
+
+    # ---- box_iou ---------------------------------------------------------
+    def _iou_corner(lhs, rhs):
+        """IoU of (..., 4) corner boxes broadcast over leading dims."""
+        tl = jnp.maximum(lhs[..., :2], rhs[..., :2])
+        br = jnp.minimum(lhs[..., 2:], rhs[..., 2:])
+        wh = jnp.clip(br - tl, 0.0)
+        inter = wh[..., 0] * wh[..., 1]
+        area_l = jnp.clip(lhs[..., 2] - lhs[..., 0], 0.0) * \
+            jnp.clip(lhs[..., 3] - lhs[..., 1], 0.0)
+        area_r = jnp.clip(rhs[..., 2] - rhs[..., 0], 0.0) * \
+            jnp.clip(rhs[..., 3] - rhs[..., 1], 0.0)
+        return inter / jnp.maximum(area_l + area_r - inter, 1e-12)
+
+    def box_iou_maker(format="corner"):
+        def fn(lhs, rhs):
+            if format == "center":
+                lhs = _center_to_corner(lhs)
+                rhs = _center_to_corner(rhs)
+            return _iou_corner(lhs[..., :, None, :], rhs[..., None, :, :])
+        return fn
+
+    def _center_to_corner(b):
+        x, y, w, h = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+        return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
+                         axis=-1)
+
+    register_op("_contrib_box_iou", box_iou_maker,
+                aliases=("box_iou",))
+
+    # ---- box_nms ---------------------------------------------------------
+    def box_nms_maker(overlap_thresh=0.5, valid_thresh=0.0, topk=-1,
+                      coord_start=2, score_index=1, id_index=-1,
+                      background_id=-1, force_suppress=False,
+                      in_format="corner", out_format="corner"):
+        def fn(data):
+            # data: (..., N, K); returns same shape, suppressed score = -1
+            shape = data.shape
+            flat = data.reshape((-1,) + shape[-2:])
+
+            def one(batch):
+                n = batch.shape[0]
+                scores = batch[:, score_index]
+                boxes = batch[:, coord_start:coord_start + 4]
+                if in_format == "center":
+                    boxes = _center_to_corner(boxes)
+                valid = scores > valid_thresh
+                if background_id >= 0 and id_index >= 0:
+                    valid &= batch[:, id_index] != background_id
+                k = n if topk <= 0 else min(int(topk), n)
+                order = jnp.argsort(
+                    jnp.where(valid, scores, -jnp.inf))[::-1][:k]
+                cand_boxes = boxes[order]
+                cand_valid = valid[order]
+                iou = _iou_corner(cand_boxes[:, None, :],
+                                  cand_boxes[None, :, :])
+                if not force_suppress and id_index >= 0:
+                    ids = batch[order, id_index]
+                    same = ids[:, None] == ids[None, :]
+                    iou = jnp.where(same, iou, 0.0)
+
+                # greedy: walk candidates best-first; each kept box kills
+                # its high-IoU successors (fixed k iterations — jit-safe)
+                def step(keep, i):
+                    keep_i = keep[i]
+                    kill = (iou[i] > overlap_thresh) & \
+                        (jnp.arange(k) > i) & keep_i
+                    return keep & ~kill, None
+                keep0 = cand_valid
+                keep, _ = lax.scan(step, keep0, jnp.arange(k))
+                # scatter the keep decision back to original positions
+                kept_full = jnp.zeros(n, dtype=bool).at[order].set(keep)
+                out = batch.at[:, score_index].set(
+                    jnp.where(kept_full, scores, -1.0))
+                return out
+            out = jax.vmap(one)(flat)
+            return out.reshape(shape)
+        return fn
+    register_op("_contrib_box_nms", box_nms_maker,
+                aliases=("box_nms",))
+
+    # ---- multibox_target -------------------------------------------------
+    def multibox_target_maker(overlap_threshold=0.5, ignore_label=-1.0,
+                              negative_mining_ratio=-1.0,
+                              negative_mining_thresh=0.5,
+                              minimum_negative_samples=0,
+                              variances=(0.1, 0.1, 0.2, 0.2)):
+        var = _np.asarray(_astuple(variances), dtype=_np.float32)
+
+        def fn(anchor, label, cls_pred):
+            # anchor (1,N,4) corner; label (B,M,5) [cls,x1,y1,x2,y2], pad=-1
+            # cls_pred (B, num_class+1, N) — used for hard negative mining
+            anchors = anchor.reshape(-1, 4)
+            n = anchors.shape[0]
+
+            def one(lab, cpred):
+                gt_valid = lab[:, 0] >= 0
+                gt_boxes = lab[:, 1:5]
+                iou = _iou_corner(anchors[:, None, :],
+                                  gt_boxes[None, :, :])         # (N, M)
+                iou = jnp.where(gt_valid[None, :], iou, 0.0)
+                best_gt = jnp.argmax(iou, axis=1)               # (N,)
+                best_iou = jnp.max(iou, axis=1)
+                matched = best_iou >= overlap_threshold
+                # force-match: every valid GT claims its best anchor
+                best_anchor = jnp.argmax(iou, axis=0)           # (M,)
+                m = gt_boxes.shape[0]
+                forced = jnp.zeros(n, dtype=bool).at[best_anchor].set(
+                    gt_valid)
+                forced_gt = jnp.zeros(n, dtype=jnp.int32).at[
+                    best_anchor].set(jnp.arange(m, dtype=jnp.int32))
+                use_forced = forced
+                gt_idx = jnp.where(use_forced, forced_gt, best_gt)
+                pos = matched | forced
+
+                g = gt_boxes[gt_idx]                            # (N,4)
+                acx = (anchors[:, 0] + anchors[:, 2]) / 2
+                acy = (anchors[:, 1] + anchors[:, 3]) / 2
+                aw = jnp.maximum(anchors[:, 2] - anchors[:, 0], 1e-8)
+                ah = jnp.maximum(anchors[:, 3] - anchors[:, 1], 1e-8)
+                gcx = (g[:, 0] + g[:, 2]) / 2
+                gcy = (g[:, 1] + g[:, 3]) / 2
+                gw = jnp.maximum(g[:, 2] - g[:, 0], 1e-8)
+                gh = jnp.maximum(g[:, 3] - g[:, 1], 1e-8)
+                loc = jnp.stack([(gcx - acx) / aw / var[0],
+                                 (gcy - acy) / ah / var[1],
+                                 jnp.log(gw / aw) / var[2],
+                                 jnp.log(gh / ah) / var[3]], axis=-1)
+                loc_target = jnp.where(pos[:, None], loc, 0.0).reshape(-1)
+                loc_mask = jnp.where(pos[:, None],
+                                     jnp.ones((n, 4)), 0.0).reshape(-1)
+                cls_target = jnp.where(
+                    pos, lab[gt_idx, 0] + 1.0, 0.0)   # 0 = background
+                if negative_mining_ratio > 0:
+                    # hard negatives: highest background-loss negatives up
+                    # to ratio×num_pos; everything else ignored
+                    bg_prob = jax.nn.softmax(cpred, axis=0)[0]
+                    neg_score = jnp.where(pos, -jnp.inf, -jnp.log(
+                        jnp.maximum(bg_prob, 1e-12)))
+                    num_pos = jnp.sum(pos)
+                    max_neg = jnp.maximum(
+                        (negative_mining_ratio * num_pos).astype(jnp.int32),
+                        minimum_negative_samples)
+                    rank = jnp.argsort(jnp.argsort(-neg_score))
+                    keep_neg = (~pos) & (rank < max_neg)
+                    cls_target = jnp.where(
+                        pos | keep_neg, cls_target, float(ignore_label))
+                return loc_target, loc_mask, cls_target
+            loc_t, loc_m, cls_t = jax.vmap(one)(label, cls_pred)
+            return loc_t, loc_m, cls_t
+        return fn
+    register_op("_contrib_MultiBoxTarget", multibox_target_maker,
+                aliases=("MultiBoxTarget", "multibox_target"),
+                differentiable=False)
+
+    # ---- multibox_detection ----------------------------------------------
+    def multibox_detection_maker(clip=True, threshold=0.01,
+                                 background_id=0, nms_threshold=0.5,
+                                 force_suppress=False,
+                                 variances=(0.1, 0.1, 0.2, 0.2),
+                                 nms_topk=-1):
+        var = _np.asarray(_astuple(variances), dtype=_np.float32)
+
+        def fn(cls_prob, loc_pred, anchor):
+            # cls_prob (B, num_classes+1, N); loc_pred (B, N*4);
+            # anchor (1, N, 4) -> out (B, N, 6) [id, score, x1,y1,x2,y2]
+            anchors = anchor.reshape(-1, 4)
+            n = anchors.shape[0]
+            acx = (anchors[:, 0] + anchors[:, 2]) / 2
+            acy = (anchors[:, 1] + anchors[:, 3]) / 2
+            aw = anchors[:, 2] - anchors[:, 0]
+            ah = anchors[:, 3] - anchors[:, 1]
+
+            def one(cp, lp):
+                loc = lp.reshape(n, 4)
+                cx = loc[:, 0] * var[0] * aw + acx
+                cy = loc[:, 1] * var[1] * ah + acy
+                w = jnp.exp(loc[:, 2] * var[2]) * aw
+                h = jnp.exp(loc[:, 3] * var[3]) * ah
+                boxes = jnp.stack([cx - w / 2, cy - h / 2,
+                                   cx + w / 2, cy + h / 2], axis=-1)
+                if clip:
+                    boxes = jnp.clip(boxes, 0.0, 1.0)
+                # best non-background class per anchor
+                fg = jnp.concatenate([cp[:background_id],
+                                      cp[background_id + 1:]], axis=0)
+                cls_id = jnp.argmax(fg, axis=0).astype(jnp.float32)
+                score = jnp.max(fg, axis=0)
+                keep = score > threshold
+                out = jnp.concatenate(
+                    [jnp.where(keep, cls_id, -1.0)[:, None],
+                     jnp.where(keep, score, -1.0)[:, None], boxes], axis=1)
+                return out
+            det = jax.vmap(one)(cls_prob, loc_pred)
+            nms = box_nms_maker(overlap_thresh=nms_threshold,
+                                valid_thresh=0.0, topk=nms_topk,
+                                coord_start=2, score_index=1, id_index=0,
+                                force_suppress=force_suppress)
+            return nms(det)
+        return fn
+    register_op("_contrib_MultiBoxDetection", multibox_detection_maker,
+                aliases=("MultiBoxDetection", "multibox_detection"),
+                differentiable=False)
+
+    # ---- ROIAlign --------------------------------------------------------
+    def roi_align_maker(pooled_size=(7, 7), spatial_scale=1.0,
+                        sample_ratio=2, position_sensitive=False,
+                        aligned=False):
+        ph, pw = _astuple(pooled_size)
+        sr = max(int(sample_ratio), 1)
+
+        def fn(data, rois):
+            # data (B,C,H,W); rois (R,5) [batch_idx, x1,y1,x2,y2]
+            _, c, h, w = data.shape
+
+            def one(roi):
+                bidx = roi[0].astype(jnp.int32)
+                img = data[bidx]                          # (C,H,W)
+                off = 0.5 if aligned else 0.0
+                x1 = roi[1] * spatial_scale - off
+                y1 = roi[2] * spatial_scale - off
+                x2 = roi[3] * spatial_scale - off
+                y2 = roi[4] * spatial_scale - off
+                rw = jnp.maximum(x2 - x1, 1.0 if not aligned else 1e-6)
+                rh = jnp.maximum(y2 - y1, 1.0 if not aligned else 1e-6)
+                bin_w = rw / pw
+                bin_h = rh / ph
+                # sr×sr bilinear samples per output bin, averaged
+                iy = jnp.arange(ph * sr, dtype=jnp.float32)
+                ix = jnp.arange(pw * sr, dtype=jnp.float32)
+                sy = y1 + (iy + 0.5) * bin_h / sr         # (ph*sr,)
+                sx = x1 + (ix + 0.5) * bin_w / sr         # (pw*sr,)
+
+                def bilinear(yy, xx):
+                    y0 = jnp.clip(jnp.floor(yy), 0, h - 1)
+                    x0 = jnp.clip(jnp.floor(xx), 0, w - 1)
+                    y1_ = jnp.clip(y0 + 1, 0, h - 1)
+                    x1_ = jnp.clip(x0 + 1, 0, w - 1)
+                    ly = jnp.clip(yy - y0, 0.0, 1.0)
+                    lx = jnp.clip(xx - x0, 0.0, 1.0)
+                    y0i, x0i = y0.astype(jnp.int32), x0.astype(jnp.int32)
+                    y1i, x1i = y1_.astype(jnp.int32), x1_.astype(jnp.int32)
+                    v00 = img[:, y0i, :][:, :, x0i]
+                    v01 = img[:, y0i, :][:, :, x1i]
+                    v10 = img[:, y1i, :][:, :, x0i]
+                    v11 = img[:, y1i, :][:, :, x1i]
+                    wy = ly[None, :, None]
+                    wx = lx[None, None, :]
+                    return (v00 * (1 - wy) * (1 - wx) +
+                            v01 * (1 - wy) * wx +
+                            v10 * wy * (1 - wx) + v11 * wy * wx)
+                samples = bilinear(sy, sx)                # (C,ph*sr,pw*sr)
+                pooled = samples.reshape(c, ph, sr, pw, sr).mean((2, 4))
+                return pooled
+            return jax.vmap(one)(rois)
+        return fn
+    register_op("_contrib_ROIAlign", roi_align_maker,
+                aliases=("ROIAlign", "roi_align"))
+
+    # ---- ROIPooling (legacy top-level op) --------------------------------
+    def roi_pooling_maker(pooled_size=(7, 7), spatial_scale=1.0):
+        ph, pw = _astuple(pooled_size)
+
+        def fn(data, rois):
+            _, c, h, w = data.shape
+
+            def one(roi):
+                bidx = roi[0].astype(jnp.int32)
+                img = data[bidx]
+                x1 = jnp.round(roi[1] * spatial_scale)
+                y1 = jnp.round(roi[2] * spatial_scale)
+                x2 = jnp.round(roi[3] * spatial_scale)
+                y2 = jnp.round(roi[4] * spatial_scale)
+                rw = jnp.maximum(x2 - x1 + 1, 1.0)
+                rh = jnp.maximum(y2 - y1 + 1, 1.0)
+                ys = jnp.arange(h, dtype=jnp.float32)
+                xs = jnp.arange(w, dtype=jnp.float32)
+
+                def bin_val(py, px):
+                    by0 = y1 + jnp.floor(py * rh / ph)
+                    by1 = y1 + jnp.ceil((py + 1) * rh / ph)
+                    bx0 = x1 + jnp.floor(px * rw / pw)
+                    bx1 = x1 + jnp.ceil((px + 1) * rw / pw)
+                    my = (ys >= by0) & (ys < jnp.maximum(by1, by0 + 1))
+                    mx = (xs >= bx0) & (xs < jnp.maximum(bx1, bx0 + 1))
+                    mask = my[:, None] & mx[None, :]
+                    neg = jnp.full((h, w), -jnp.inf)
+                    return jnp.max(jnp.where(mask[None], img, neg),
+                                   axis=(1, 2))
+                pys, pxs = jnp.meshgrid(jnp.arange(ph), jnp.arange(pw),
+                                        indexing="ij")
+                vals = jax.vmap(jax.vmap(bin_val))(
+                    pys.astype(jnp.float32), pxs.astype(jnp.float32))
+                return jnp.transpose(vals, (2, 0, 1))     # (C,ph,pw)
+            return jax.vmap(one)(rois)
+        return fn
+    register_op("ROIPooling", roi_pooling_maker)
+
+
+def _astuple(v):
+    if isinstance(v, (list, tuple)):
+        return tuple(v)
+    if isinstance(v, str):
+        return tuple(float(x) for x in
+                     v.strip("()[] ").split(",") if x.strip())
+    return (v,)
+
+
+_register()

@@ -53,8 +53,11 @@ def _register():
 
     # ---- shape ops -------------------------------------------------------
     def reshape_maker(shape=None, reverse=False):
+        from ..base import resolve_reshape_spec
+
         def fn(x):
-            return jnp.reshape(x, shape)
+            return jnp.reshape(x, resolve_reshape_spec(x.shape, shape,
+                                                       reverse))
         return fn
     register_op("reshape", reshape_maker, aliases=("Reshape",))
 

@@ -17,3 +17,5 @@ from .symbol import Symbol, var, Variable, Group, load, load_json
 from .register import _attach_frontends
 
 _attach_frontends(_sys.modules[__name__])
+
+from . import contrib  # noqa: E402,F401  (after frontends exist)

@@ -1,0 +1,15 @@
+"""``mx.sym.contrib``: symbol frontends for the _contrib_* ops
+(reference: python/mxnet/symbol/contrib.py)."""
+from __future__ import annotations
+
+import sys as _sys
+
+from ..ndarray.register import _registry
+from .register import _make_sym_frontend
+
+_PREFIX = "_contrib_"
+_mod = _sys.modules[__name__]
+
+for _name in list(_registry):
+    if _name.startswith(_PREFIX):
+        setattr(_mod, _name[len(_PREFIX):], _make_sym_frontend(_name))

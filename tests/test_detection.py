@@ -1,0 +1,177 @@
+"""Detection op + SSD tests (reference model: tests for
+src/operator/contrib/ multibox/bounding_box/roi_align + GluonCV SSD usage;
+BASELINE config #5)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu import ndarray as nd
+
+
+def test_multibox_prior_shapes_and_values():
+    x = mx.nd.zeros((1, 8, 4, 4))
+    anchors = nd.contrib.MultiBoxPrior(x, sizes=(0.5, 0.25),
+                                       ratios=(1, 2, 0.5))
+    # num anchors = len(sizes) + len(ratios) - 1 = 4 per position
+    assert anchors.shape == (1, 4 * 4 * 4, 4)
+    a = anchors.asnumpy().reshape(4, 4, 4, 4)
+    # first anchor at cell (0,0): size .5 ratio 1 centered at (.125,.125)
+    np.testing.assert_allclose(a[0, 0, 0], [0.125 - .25, 0.125 - .25,
+                                            0.125 + .25, 0.125 + .25],
+                               atol=1e-6)
+    # centers advance by 1/4 across the grid
+    np.testing.assert_allclose(a[0, 1, 0] - a[0, 0, 0],
+                               [0.25, 0, 0.25, 0], atol=1e-6)
+
+
+def test_box_iou():
+    a = mx.nd.array([[0, 0, 2, 2]])
+    b = mx.nd.array([[1, 1, 3, 3], [0, 0, 2, 2], [5, 5, 6, 6]])
+    iou = nd.contrib.box_iou(a, b).asnumpy()
+    np.testing.assert_allclose(iou[0], [1 / 7, 1.0, 0.0], atol=1e-6)
+
+
+def test_box_nms_suppresses_overlaps():
+    # [id, score, x1, y1, x2, y2]
+    boxes = mx.nd.array([[
+        [0, 0.9, 0.0, 0.0, 0.5, 0.5],
+        [0, 0.8, 0.01, 0.01, 0.51, 0.51],   # overlaps the first -> killed
+        [0, 0.7, 0.6, 0.6, 0.9, 0.9],       # separate -> kept
+        [1, 0.6, 0.02, 0.02, 0.52, 0.52],   # other class -> kept
+    ]])
+    out = nd.contrib.box_nms(boxes, overlap_thresh=0.5, coord_start=2,
+                             score_index=1, id_index=0).asnumpy()[0]
+    scores = out[:, 1]
+    assert scores[0] == pytest.approx(0.9)
+    assert scores[1] == -1.0
+    assert scores[2] == pytest.approx(0.7)
+    assert scores[3] == pytest.approx(0.6)
+    # force_suppress ignores class ids
+    out2 = nd.contrib.box_nms(boxes, overlap_thresh=0.5, coord_start=2,
+                              score_index=1, id_index=0,
+                              force_suppress=True).asnumpy()[0]
+    assert out2[3, 1] == -1.0
+
+
+def test_multibox_target_matches_gt():
+    anchors = mx.nd.array([[[0.0, 0.0, 0.5, 0.5],
+                            [0.5, 0.5, 1.0, 1.0],
+                            [0.0, 0.5, 0.5, 1.0]]])
+    # one GT box over anchor 0; one padded row
+    labels = mx.nd.array([[[1.0, 0.05, 0.05, 0.45, 0.45],
+                           [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    cls_preds = mx.nd.zeros((1, 3, 3))  # (B, num_cls+1, N)
+    loc_t, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, labels,
+                                                    cls_preds)
+    cls_t = cls_t.asnumpy()[0]
+    assert cls_t[0] == 2.0            # class 1 -> target 2 (0=background)
+    assert cls_t[1] == 0.0
+    assert cls_t[2] == 0.0
+    loc_m = loc_m.asnumpy().reshape(3, 4)
+    assert loc_m[0].sum() == 4 and loc_m[1].sum() == 0
+
+
+def test_multibox_detection_roundtrip():
+    """Encode a GT with MultiBoxTarget-style math, decode, NMS — the
+    decoded box must come back."""
+    anchors = mx.nd.array([[[0.1, 0.1, 0.4, 0.4],
+                            [0.5, 0.5, 0.9, 0.9]]])
+    # perfect prediction for anchor 1 holding class 2
+    cls_prob = mx.nd.array([[[0.9, 0.05], [0.05, 0.05], [0.05, 0.9]]])
+    loc_pred = mx.nd.zeros((1, 8))
+    out = nd.contrib.MultiBoxDetection(cls_prob, loc_pred, anchors,
+                                       threshold=0.3).asnumpy()[0]
+    kept = out[out[:, 1] > 0]
+    assert len(kept) == 1
+    assert kept[0, 0] == 1.0          # class id (0-based, bg removed)
+    np.testing.assert_allclose(kept[0, 2:], [0.5, 0.5, 0.9, 0.9],
+                               atol=1e-5)
+
+
+def test_roi_align_values():
+    data = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = mx.nd.array([[0, 0, 0, 3, 3]])
+    out = nd.contrib.ROIAlign(data, rois, pooled_size=(2, 2),
+                              spatial_scale=1.0, sample_ratio=2)
+    assert out.shape == (1, 1, 2, 2)
+    v = out.asnumpy()[0, 0]
+    assert v[0, 0] < v[0, 1] < v[1, 1]
+    # gradients flow to the feature map
+    d = mx.nd.array(np.random.rand(1, 1, 4, 4).astype(np.float32))
+    d.attach_grad()
+    with autograd.record():
+        y = nd.contrib.ROIAlign(d, rois, pooled_size=(2, 2),
+                                spatial_scale=1.0).sum()
+    y.backward()
+    assert np.abs(d.grad.asnumpy()).sum() > 0
+
+
+def test_roi_pooling():
+    data = mx.nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    rois = mx.nd.array([[0, 0, 0, 3, 3]])
+    out = mx.nd.ROIPooling(data, rois, pooled_size=(2, 2),
+                           spatial_scale=1.0)
+    np.testing.assert_allclose(out.asnumpy()[0, 0], [[5, 7], [13, 15]])
+
+
+def test_reshape_special_dims():
+    x = mx.nd.zeros((2, 3, 4, 5))
+    assert mx.nd.reshape(x, shape=(0, -1)).shape == (2, 60)
+    assert mx.nd.reshape(x, shape=(0, 0, -1)).shape == (2, 3, 20)
+    assert mx.nd.reshape(x, shape=(-2,)).shape == (2, 3, 4, 5)
+    assert mx.nd.reshape(x, shape=(-3, -2)).shape == (6, 4, 5)
+    assert mx.nd.reshape(x, shape=(-4, 1, 2, -2)).shape == (1, 2, 3, 4, 5)
+
+
+def test_ssd_toy_forward_and_loss_decreases():
+    from mxnet_tpu.gluon.model_zoo.ssd import ssd_toy, SSDMultiBoxLoss
+    np.random.seed(0)
+    net = ssd_toy(classes=3)
+    net.initialize()
+    x = mx.nd.random.normal(shape=(2, 3, 64, 64))
+    anchors, cls_preds, box_preds = net(x)
+    n = anchors.shape[1]
+    assert anchors.shape == (1, n, 4)
+    assert cls_preds.shape == (2, n, 4)
+    assert box_preds.shape == (2, n * 4)
+
+    labels = mx.nd.array(np.array([
+        [[0.0, 0.1, 0.1, 0.45, 0.45], [1.0, 0.5, 0.5, 0.9, 0.9]],
+        [[2.0, 0.2, 0.2, 0.7, 0.7], [-1.0, 0, 0, 0, 0]],
+    ], dtype=np.float32))
+    loss_fn = SSDMultiBoxLoss()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    losses = []
+    for _ in range(8):
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            L = loss_fn(anchors, cls_preds, box_preds, labels)
+        L.backward()
+        tr.step(2)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0], losses
+
+
+def test_ssd_512_resnet50_builds():
+    from mxnet_tpu.gluon.model_zoo.ssd import ssd_512_resnet50_v1
+    net = ssd_512_resnet50_v1(classes=20)
+    net.initialize()
+    x = mx.nd.zeros((1, 3, 128, 128))   # small spatial for CI speed
+    anchors, cls_preds, box_preds = net(x)
+    assert cls_preds.shape[-1] == 21
+    assert anchors.shape[1] == cls_preds.shape[1]
+    assert box_preds.shape[1] == anchors.shape[1] * 4
+
+
+def test_reshape_reverse_and_view_path():
+    x = mx.nd.zeros((2, 3, 20))
+    # reverse=True resolves specials right-to-left (reference semantics)
+    assert mx.nd.reshape(x, shape=(0, 0, -4, 4, 5),
+                         reverse=True).shape == (2, 3, 4, 5)
+    # the NDArray.reshape view path shares the same resolver
+    assert x.reshape(-3, -2).shape == (6, 20)
+    # reference docs example: (10,5,4) + shape=(-1,0) reverse -> (50,4)
+    y = mx.nd.zeros((10, 5, 4))
+    assert y.reshape((-1, 0), reverse=True).shape == (50, 4)
