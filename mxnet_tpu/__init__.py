@@ -34,6 +34,8 @@ from . import metric
 from . import kvstore
 from . import kvstore as kv
 from . import recordio
+from . import sparse
+ndarray.sparse = sparse          # reference surface: mx.nd.sparse
 from . import io
 from . import model
 from . import callback

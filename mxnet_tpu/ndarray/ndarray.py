@@ -141,6 +141,13 @@ class NDArray:
     def stype(self) -> str:
         return "default"
 
+    def tostype(self, stype: str):
+        """Convert storage type (reference: NDArray.tostype)."""
+        if stype == "default":
+            return self
+        from ..sparse import cast_storage
+        return cast_storage(self, stype)
+
     @property
     def grad(self) -> Optional["NDArray"]:
         info = self._ag
@@ -485,11 +492,6 @@ class NDArray:
     def broadcast_to(self, shape):
         from . import broadcast_to
         return broadcast_to(self, shape=shape)
-
-    def tostype(self, stype):
-        if stype != "default":
-            raise MXNetError("only dense ('default') storage is supported on TPU")
-        return self
 
 
 # ---------------------------------------------------------------------------

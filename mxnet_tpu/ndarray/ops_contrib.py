@@ -85,6 +85,11 @@ def _register():
         return jnp.stack([x - w / 2, y - h / 2, x + w / 2, y + h / 2],
                          axis=-1)
 
+    def _corner_to_center(b):
+        x1, y1, x2, y2 = b[..., 0], b[..., 1], b[..., 2], b[..., 3]
+        return jnp.stack([(x1 + x2) / 2, (y1 + y2) / 2, x2 - x1, y2 - y1],
+                         axis=-1)
+
     register_op("_contrib_box_iou", box_iou_maker,
                 aliases=("box_iou",))
 
@@ -132,6 +137,12 @@ def _register():
                 kept_full = jnp.zeros(n, dtype=bool).at[order].set(keep)
                 out = batch.at[:, score_index].set(
                     jnp.where(kept_full, scores, -1.0))
+                if out_format != in_format:
+                    conv = _center_to_corner if out_format == "corner" \
+                        else _corner_to_center
+                    cs = coord_start
+                    out = out.at[:, cs:cs + 4].set(
+                        conv(out[:, cs:cs + 4]))
                 return out
             out = jax.vmap(one)(flat)
             return out.reshape(shape)
@@ -162,15 +173,17 @@ def _register():
                 best_gt = jnp.argmax(iou, axis=1)               # (N,)
                 best_iou = jnp.max(iou, axis=1)
                 matched = best_iou >= overlap_threshold
-                # force-match: every valid GT claims its best anchor
+                # force-match: every valid GT claims its best anchor.
+                # Padded GTs are routed to a sacrificial slot n so their
+                # scatter can never clobber a real GT's claim on anchor 0
                 best_anchor = jnp.argmax(iou, axis=0)           # (M,)
                 m = gt_boxes.shape[0]
-                forced = jnp.zeros(n, dtype=bool).at[best_anchor].set(
-                    gt_valid)
-                forced_gt = jnp.zeros(n, dtype=jnp.int32).at[
-                    best_anchor].set(jnp.arange(m, dtype=jnp.int32))
-                use_forced = forced
-                gt_idx = jnp.where(use_forced, forced_gt, best_gt)
+                ba = jnp.where(gt_valid, best_anchor, n)
+                forced = jnp.zeros(n + 1, dtype=bool).at[ba].set(
+                    True)[:n]
+                forced_gt = jnp.zeros(n + 1, dtype=jnp.int32).at[ba].set(
+                    jnp.arange(m, dtype=jnp.int32))[:n]
+                gt_idx = jnp.where(forced, forced_gt, best_gt)
                 pos = matched | forced
 
                 g = gt_boxes[gt_idx]                            # (N,4)
@@ -202,7 +215,10 @@ def _register():
                         (negative_mining_ratio * num_pos).astype(jnp.int32),
                         minimum_negative_samples)
                     rank = jnp.argsort(jnp.argsort(-neg_score))
-                    keep_neg = (~pos) & (rank < max_neg)
+                    # near-misses (IoU above the mining threshold) are
+                    # ignored, not negatives (reference multibox_target.cc)
+                    keep_neg = (~pos) & (rank < max_neg) & \
+                        (best_iou < negative_mining_thresh)
                     cls_target = jnp.where(
                         pos | keep_neg, cls_target, float(ignore_label))
                 return loc_target, loc_mask, cls_target

@@ -170,6 +170,9 @@ class SGD(Optimizer):
         return nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
 
     def update(self, index, weight, grad, state):
+        from .sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
+            return self._update_row_sparse(index, weight, grad, state)
         self._update_count(index)
         kw = self._common_kwargs(index)
         lr = self._lr_nd(index, weight)
@@ -179,6 +182,29 @@ class SGD(Optimizer):
             kw["momentum"] = self.momentum
             invoke_by_name("sgd_mom_update", [weight, grad, state, lr], kw,
                            out=[weight, state])
+
+    def _update_row_sparse(self, index, weight, grad, state):
+        """Lazy update: touch only the rows present in the row_sparse grad
+        (reference: sgd_update/sgd_mom_update row_sparse kernels with
+        lazy_update=True — src/operator/optimizer_op.cc).  Pure scatter on
+        the dense weight: HBM traffic ∝ touched rows."""
+        import jax.numpy as jnp
+        self._update_count(index)
+        lr = self._get_lr(index)
+        wd = self._get_wd(index)
+        rows = jnp.asarray(grad.indices)
+        g = jnp.asarray(grad.data) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w = weight._read()
+        g = g + wd * w[rows]
+        if self.momentum == 0.0:
+            weight._set_data(w.at[rows].add(-lr * g))
+        else:
+            m = state._read()
+            m_rows = self.momentum * m[rows] - lr * g
+            state._set_data(m.at[rows].set(m_rows))
+            weight._set_data(w.at[rows].add(m_rows))
 
     def update_multi_precision(self, index, weight, grad, state):
         if self.multi_precision and isinstance(state, tuple) and \

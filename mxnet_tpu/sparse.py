@@ -1,0 +1,308 @@
+"""Sparse NDArray storage types (reference: python/mxnet/ndarray/sparse.py +
+the stype machinery in include/mxnet/ndarray.h — SURVEY.md §2.1).
+
+Two formats, as in the reference:
+- ``CSRNDArray`` — compressed sparse row (data/indices/indptr), the LibSVM
+  dataset format; used for sparse features and sparse dot.
+- ``RowSparseNDArray`` — a subset of rows present (data/indices), the
+  gradient format of large embeddings; powers lazy optimizer updates that
+  touch only the rows a batch used.
+
+TPU-native design: XLA has no first-class CSR kernels, so compute maps to
+what the hardware likes — ``dot(csr, dense)`` lowers through
+``jax.experimental.sparse.BCOO`` (which XLA turns into gather+segment-sum),
+row_sparse optimizer updates are pure scatter ops on the dense weight
+(HBM-bandwidth proportional to touched rows, the exact benefit the
+reference's row_sparse kernels deliver), and everything else densifies
+explicitly — never silently: ``tostype`` is the only densification door,
+matching the reference's storage-fallback warnings.
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple, Union
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import Context, current_context
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["CSRNDArray", "RowSparseNDArray", "BaseSparseNDArray",
+           "csr_matrix", "row_sparse_array", "zeros", "dot", "retain",
+           "cast_storage", "add", "elemwise_add"]
+
+
+class BaseSparseNDArray:
+    """Common surface of the sparse storage types."""
+
+    stype = "undefined"
+
+    def __init__(self, shape: Tuple[int, ...], dtype, ctx: Context):
+        self._shape = tuple(int(s) for s in shape)
+        self._dtype = _np.dtype(dtype)
+        self._ctx = ctx
+
+    @property
+    def shape(self) -> Tuple[int, ...]:
+        return self._shape
+
+    @property
+    def dtype(self):
+        return self._dtype
+
+    @property
+    def context(self) -> Context:
+        return self._ctx
+
+    @property
+    def ndim(self) -> int:
+        return len(self._shape)
+
+    def asnumpy(self) -> _np.ndarray:
+        return self.todense().asnumpy()
+
+    def todense(self) -> NDArray:
+        raise NotImplementedError
+
+    def tostype(self, stype: str):
+        if stype == "default":
+            return self.todense()
+        if stype == self.stype:
+            return self
+        return cast_storage(self, stype)
+
+    def copyto(self, other):
+        self.todense().copyto(other)
+
+    def __repr__(self):
+        return (f"<{type(self).__name__} {self._shape} "
+                f"{self._dtype.name} @{self._ctx}>")
+
+
+class CSRNDArray(BaseSparseNDArray):
+    stype = "csr"
+
+    def __init__(self, data, indices, indptr, shape, dtype=None, ctx=None):
+        data = _np.asarray(data)
+        dtype = dtype or data.dtype
+        super().__init__(shape, dtype, ctx or current_context())
+        if len(self._shape) != 2:
+            raise MXNetError("CSRNDArray must be 2-D")
+        self.data = _np.asarray(data, dtype=dtype)
+        self.indices = _np.asarray(indices, dtype=_np.int64)
+        self.indptr = _np.asarray(indptr, dtype=_np.int64)
+        if len(self.indptr) != self._shape[0] + 1:
+            raise MXNetError(
+                f"indptr length {len(self.indptr)} != rows+1 "
+                f"({self._shape[0] + 1})")
+
+    @property
+    def nnz(self) -> int:
+        return int(self.data.size)
+
+    @staticmethod
+    def from_dense(arr: NDArray) -> "CSRNDArray":
+        # single vectorized pass — this sits on the LibSVMIter hot path
+        a = arr.asnumpy()
+        rows, cols = a.shape
+        r_idx, c_idx = _np.nonzero(a)           # row-major order
+        indptr = _np.concatenate(
+            [[0], _np.cumsum(_np.bincount(r_idx, minlength=rows))])
+        return CSRNDArray(a[r_idx, c_idx], c_idx, indptr, a.shape,
+                          ctx=arr.context)
+
+    def todense(self) -> NDArray:
+        out = _np.zeros(self._shape, dtype=self._dtype)
+        row_ids = _np.repeat(_np.arange(self._shape[0]),
+                             _np.diff(self.indptr))
+        out[row_ids, self.indices] = self.data
+        return nd_array(out, ctx=self._ctx)
+
+    def _to_bcoo(self):
+        from jax.experimental import sparse as jsparse
+        import jax.numpy as jnp
+        row_ids = _np.repeat(_np.arange(self._shape[0]),
+                             _np.diff(self.indptr))
+        idx = _np.stack([row_ids, self.indices], axis=1)
+        return jsparse.BCOO((jnp.asarray(self.data), jnp.asarray(idx)),
+                            shape=self._shape)
+
+    def asscipy(self):
+        from scipy.sparse import csr_matrix as sp_csr
+        return sp_csr((self.data, self.indices, self.indptr),
+                      shape=self._shape)
+
+    def __getitem__(self, key) -> "CSRNDArray":
+        if isinstance(key, slice):
+            start, stop, step = key.indices(self._shape[0])
+            if step != 1:
+                raise MXNetError("CSRNDArray slicing requires step 1")
+            stop = max(stop, start)
+            lo, hi = self.indptr[start], self.indptr[stop]
+            return CSRNDArray(self.data[lo:hi], self.indices[lo:hi],
+                              self.indptr[start:stop + 1] - lo,
+                              (stop - start, self._shape[1]),
+                              ctx=self._ctx)
+        raise MXNetError("CSRNDArray supports row-slice indexing only")
+
+
+class RowSparseNDArray(BaseSparseNDArray):
+    stype = "row_sparse"
+
+    def __init__(self, data, indices, shape, dtype=None, ctx=None):
+        data = _np.asarray(data)
+        dtype = dtype or data.dtype
+        super().__init__(shape, dtype, ctx or current_context())
+        self.data = _np.asarray(data, dtype=dtype)
+        self.indices = _np.asarray(indices, dtype=_np.int64)
+        if self.data.shape[0] != self.indices.shape[0]:
+            raise MXNetError("data rows must match indices length")
+
+    @staticmethod
+    def from_dense(arr: NDArray) -> "RowSparseNDArray":
+        a = arr.asnumpy()
+        nz_rows = _np.nonzero(_np.any(
+            a.reshape(a.shape[0], -1) != 0, axis=1))[0]
+        return RowSparseNDArray(a[nz_rows], nz_rows, a.shape,
+                                ctx=arr.context)
+
+    def todense(self) -> NDArray:
+        out = _np.zeros(self._shape, dtype=self._dtype)
+        out[self.indices] = self.data
+        return nd_array(out, ctx=self._ctx)
+
+    def retain(self, indices) -> "RowSparseNDArray":
+        keep = _np.asarray(indices, dtype=_np.int64)
+        mask = _np.isin(self.indices, keep)
+        return RowSparseNDArray(self.data[mask], self.indices[mask],
+                                self._shape, ctx=self._ctx)
+
+
+# ---------------------------------------------------------------------------
+# constructors (reference: mx.nd.sparse.csr_matrix / row_sparse_array)
+# ---------------------------------------------------------------------------
+
+def csr_matrix(arg1, shape=None, ctx=None, dtype=None) -> CSRNDArray:
+    if isinstance(arg1, CSRNDArray):
+        return arg1
+    if isinstance(arg1, NDArray):
+        return CSRNDArray.from_dense(arg1)
+    if isinstance(arg1, _np.ndarray):
+        return CSRNDArray.from_dense(nd_array(arg1, ctx=ctx))
+    if isinstance(arg1, tuple) and len(arg1) == 3:
+        data, indices, indptr = arg1
+        if shape is None:
+            raise MXNetError("shape required for (data, indices, indptr)")
+        return CSRNDArray(data, indices, indptr, shape, dtype=dtype,
+                          ctx=ctx)
+    raise MXNetError("unsupported csr_matrix argument")
+
+
+def row_sparse_array(arg1, shape=None, ctx=None,
+                     dtype=None) -> RowSparseNDArray:
+    if isinstance(arg1, RowSparseNDArray):
+        return arg1
+    if isinstance(arg1, NDArray):
+        return RowSparseNDArray.from_dense(arg1)
+    if isinstance(arg1, _np.ndarray):
+        return RowSparseNDArray.from_dense(nd_array(arg1, ctx=ctx))
+    if isinstance(arg1, tuple) and len(arg1) == 2:
+        data, indices = arg1
+        if shape is None:
+            raise MXNetError("shape required for (data, indices)")
+        return RowSparseNDArray(data, indices, shape, dtype=dtype, ctx=ctx)
+    raise MXNetError("unsupported row_sparse_array argument")
+
+
+def zeros(stype: str, shape, ctx=None, dtype=_np.float32):
+    shape = tuple(shape)
+    if stype == "csr":
+        return CSRNDArray(_np.zeros(0, dtype), _np.zeros(0, _np.int64),
+                          _np.zeros(shape[0] + 1, _np.int64), shape,
+                          ctx=ctx)
+    if stype == "row_sparse":
+        return RowSparseNDArray(_np.zeros((0,) + shape[1:], dtype),
+                                _np.zeros(0, _np.int64), shape, ctx=ctx)
+    if stype == "default":
+        from .ndarray import zeros as nd_zeros
+        return nd_zeros(shape, ctx=ctx, dtype=dtype)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+# ---------------------------------------------------------------------------
+# ops
+# ---------------------------------------------------------------------------
+
+def cast_storage(arr, stype: str):
+    """reference: cast_storage op (src/operator/tensor/cast_storage.cc)."""
+    if stype == "default":
+        return arr.todense() if isinstance(arr, BaseSparseNDArray) else arr
+    dense = arr if isinstance(arr, NDArray) else arr.todense()
+    if stype == "csr":
+        return CSRNDArray.from_dense(dense)
+    if stype == "row_sparse":
+        return RowSparseNDArray.from_dense(dense)
+    raise MXNetError(f"unknown stype {stype!r}")
+
+
+def dot(lhs, rhs, transpose_a: bool = False,
+        transpose_b: bool = False):
+    """Sparse-aware dot.  csr×dense runs through BCOO (XLA gather+segsum);
+    csr^T×dense produces the row_sparse result shape the reference's
+    sparse-embedding training relies on."""
+    import jax.numpy as jnp
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        mat = lhs._to_bcoo()
+        if transpose_a:
+            mat = mat.T
+        rv = rhs._read()
+        if transpose_b:
+            rv = rv.T
+        return NDArray(mat @ rv, ctx=rhs.context)
+    if isinstance(lhs, NDArray) and isinstance(rhs, CSRNDArray):
+        lv = lhs._read()
+        if transpose_a:
+            lv = lv.T
+        # dense @ csr == (csr^T @ dense^T)^T, keeping the sparse operand
+        # on the left of the BCOO matmul
+        mat = rhs._to_bcoo()
+        mat = mat if transpose_b else mat.T
+        return NDArray((mat @ lv.T).T, ctx=lhs.context)
+    if isinstance(lhs, NDArray) and isinstance(rhs, NDArray):
+        from .ndarray import dot as nd_dot
+        return nd_dot(lhs, rhs, transpose_a=transpose_a,
+                      transpose_b=transpose_b)
+    raise MXNetError(
+        f"unsupported dot storage types {type(lhs)}/{type(rhs)}")
+
+
+def retain(arr: RowSparseNDArray, indices) -> RowSparseNDArray:
+    """reference: _sparse_retain."""
+    if not isinstance(arr, RowSparseNDArray):
+        raise MXNetError("retain expects a RowSparseNDArray")
+    if isinstance(indices, NDArray):
+        indices = indices.asnumpy()
+    return arr.retain(indices)
+
+
+def elemwise_add(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        out = rhs.asnumpy().copy()
+        _np.add.at(out, lhs.indices, lhs.data)
+        return nd_array(out, ctx=rhs.context)
+    if isinstance(rhs, RowSparseNDArray) and isinstance(lhs, NDArray):
+        return elemwise_add(rhs, lhs)
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        idx = _np.union1d(lhs.indices, rhs.indices)
+        data = _np.zeros((len(idx),) + lhs.data.shape[1:], lhs.data.dtype)
+        pos = {int(v): i for i, v in enumerate(idx)}
+        for src in (lhs, rhs):
+            for d, i in zip(src.data, src.indices):
+                data[pos[int(i)]] += d
+        return RowSparseNDArray(data, idx, lhs.shape, ctx=lhs.context)
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name("broadcast_add", [lhs, rhs], {})
+
+
+add = elemwise_add

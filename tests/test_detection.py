@@ -175,3 +175,25 @@ def test_reshape_reverse_and_view_path():
     # reference docs example: (10,5,4) + shape=(-1,0) reverse -> (50,4)
     y = mx.nd.zeros((10, 5, 4))
     assert y.reshape((-1, 0), reverse=True).shape == (50, 4)
+
+
+def test_multibox_target_pad_row_cannot_clobber_forced_match():
+    """A padded GT row must not steal anchor 0's forced match
+    (code-review regression)."""
+    anchors = mx.nd.array([[[0.0, 0.0, 0.3, 0.3],
+                            [0.6, 0.6, 1.0, 1.0]]])
+    # GT overlaps anchor 0 only weakly (IoU < 0.5) -> relies on force-match
+    labels = mx.nd.array([[[2.0, 0.0, 0.0, 0.2, 0.2],
+                           [-1.0, 0.0, 0.0, 0.0, 0.0]]])
+    cls_preds = mx.nd.zeros((1, 4, 2))
+    _, loc_m, cls_t = nd.contrib.MultiBoxTarget(anchors, labels, cls_preds)
+    assert cls_t.asnumpy()[0, 0] == 3.0   # class 2 -> target 3
+    assert loc_m.asnumpy().reshape(2, 4)[0].sum() == 4
+
+
+def test_box_nms_out_format_conversion():
+    boxes = mx.nd.array([[[0, 0.9, 0.5, 0.5, 0.4, 0.4]]])  # center format
+    out = nd.contrib.box_nms(boxes, in_format="center",
+                             out_format="corner", coord_start=2,
+                             score_index=1, id_index=0).asnumpy()[0, 0]
+    np.testing.assert_allclose(out[2:], [0.3, 0.3, 0.7, 0.7], atol=1e-6)

@@ -1,0 +1,138 @@
+"""Sparse NDArray tests (reference model: tests/python/unittest/
+test_sparse_ndarray.py + test_sparse_operator.py)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd, sparse
+from mxnet_tpu.test_utils import rand_ndarray
+
+
+def test_csr_roundtrip():
+    dense = np.array([[0, 1.5, 0], [2.0, 0, 0], [0, 0, 0],
+                      [0, 3.0, 4.0]], np.float32)
+    csr = sparse.csr_matrix(dense)
+    assert csr.stype == "csr"
+    assert csr.nnz == 4
+    np.testing.assert_allclose(csr.asnumpy(), dense)
+    # (data, indices, indptr) constructor matches
+    csr2 = sparse.csr_matrix((csr.data, csr.indices, csr.indptr),
+                             shape=dense.shape)
+    np.testing.assert_allclose(csr2.asnumpy(), dense)
+    # row slice
+    np.testing.assert_allclose(csr[1:3].asnumpy(), dense[1:3])
+
+
+def test_row_sparse_roundtrip_and_retain():
+    dense = np.zeros((6, 3), np.float32)
+    dense[1] = 1.0
+    dense[4] = 2.0
+    rsp = sparse.row_sparse_array(dense)
+    assert rsp.stype == "row_sparse"
+    np.testing.assert_array_equal(rsp.indices, [1, 4])
+    np.testing.assert_allclose(rsp.asnumpy(), dense)
+    kept = sparse.retain(rsp, [0, 4])
+    np.testing.assert_array_equal(kept.indices, [4])
+    assert kept.asnumpy()[1].sum() == 0
+
+
+def test_tostype_and_cast_storage():
+    x = nd.array(np.diag([1.0, 2.0, 3.0]))
+    assert x.stype == "default"
+    csr = x.tostype("csr")
+    assert csr.stype == "csr"
+    rsp = sparse.cast_storage(csr, "row_sparse")
+    assert rsp.stype == "row_sparse"
+    back = rsp.tostype("default")
+    np.testing.assert_allclose(back.asnumpy(), np.diag([1, 2, 3]))
+
+
+def test_sparse_dot_matches_dense():
+    rng = np.random.default_rng(0)
+    dense_l = (rng.random((8, 16)) * (rng.random((8, 16)) < 0.2)) \
+        .astype(np.float32)
+    rhs = rng.standard_normal((16, 4)).astype(np.float32)
+    csr = sparse.csr_matrix(dense_l)
+    out = sparse.dot(csr, nd.array(rhs))
+    np.testing.assert_allclose(out.asnumpy(), dense_l @ rhs, rtol=1e-5,
+                               atol=1e-5)
+    # transpose_a: csr^T x dense — the sparse-embedding-grad shape
+    out_t = sparse.dot(csr, nd.array(rng.standard_normal(
+        (8, 4)).astype(np.float32)), transpose_a=True)
+    assert out_t.shape == (16, 4)
+
+
+def test_rand_ndarray_sparse_stypes():
+    csr = rand_ndarray((6, 6), stype="csr", density=0.3)
+    assert csr.stype == "csr"
+    rsp = rand_ndarray((6, 4), stype="row_sparse", density=0.5)
+    assert rsp.stype == "row_sparse"
+    assert rsp.asnumpy().shape == (6, 4)
+
+
+def test_sgd_lazy_row_sparse_update():
+    """Only rows present in the grad move (reference lazy_update=True)."""
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, momentum=0.9)
+    w = nd.array(np.ones((6, 3), np.float32))
+    state = opt.create_state(0, w)
+    grad = sparse.row_sparse_array(
+        (np.full((2, 3), 0.1, np.float32), [1, 4]), shape=(6, 3))
+    before = w.asnumpy().copy()
+    opt.update(0, w, grad, state)
+    after = w.asnumpy()
+    changed = np.where(np.any(after != before, axis=1))[0]
+    np.testing.assert_array_equal(changed, [1, 4])
+    np.testing.assert_allclose(after[1], 1.0 - 0.1, rtol=1e-6)
+    # momentum state is row-sparse too: untouched rows remain zero
+    st = state.asnumpy()
+    assert np.all(st[0] == 0) and np.any(st[1] != 0)
+    # second update accumulates momentum on the same rows
+    opt.update(0, w, grad, state)
+    np.testing.assert_allclose(w.asnumpy()[1], 1.0 - 0.1 - 0.19,
+                               rtol=1e-5)
+
+
+def test_sparse_elemwise_add():
+    rsp = sparse.row_sparse_array(
+        (np.ones((1, 3), np.float32), [2]), shape=(4, 3))
+    dense = nd.array(np.zeros((4, 3), np.float32))
+    out = sparse.add(rsp, dense)
+    assert out.asnumpy()[2].sum() == 3.0
+    both = sparse.add(rsp, rsp)
+    assert both.stype == "row_sparse"
+    assert both.asnumpy()[2].sum() == 6.0
+
+
+def test_libsvm_iter_yields_csr(tmp_path):
+    p = tmp_path / "d.libsvm"
+    p.write_text("1 0:1.5 3:2.0\n0 1:1.0\n")
+    from mxnet_tpu.io import LibSVMIter
+    it = LibSVMIter(data_libsvm=str(p), data_shape=(4,), batch_size=2)
+    b = next(iter(it))
+    assert b.data[0].stype == "csr"
+    np.testing.assert_allclose(b.data[0].asnumpy()[0],
+                               [1.5, 0, 0, 2.0])
+
+
+def test_sparse_dot_transposes():
+    rng = np.random.default_rng(1)
+    a = (rng.random((5, 7)) * (rng.random((5, 7)) < 0.4)).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    b = rng.standard_normal((4, 7)).astype(np.float32)
+    np.testing.assert_allclose(
+        sparse.dot(csr, nd.array(b), transpose_b=True).asnumpy(),
+        a @ b.T, rtol=1e-5, atol=1e-5)
+    c = rng.standard_normal((7, 5)).astype(np.float32)
+    np.testing.assert_allclose(
+        sparse.dot(nd.array(c), csr, transpose_a=True,
+                   transpose_b=True).asnumpy(),
+        c.T @ a.T, rtol=1e-5, atol=1e-5)
+
+
+def test_csr_negative_slice_and_step_rejected():
+    a = np.diag(np.arange(1.0, 5.0)).astype(np.float32)
+    csr = sparse.csr_matrix(a)
+    np.testing.assert_allclose(csr[-2:].asnumpy(), a[-2:])
+    import pytest as _pytest
+    with _pytest.raises(mx.MXNetError):
+        csr[::2]
