@@ -46,6 +46,11 @@ from . import symbol as sym
 from . import module
 from . import module as mod
 from . import contrib
+from . import profiler
+from . import runtime
+from . import operator
+ndarray.Custom = operator.Custom     # reference surface: mx.nd.Custom
+from . import rtc
 from . import test_utils
 
 

@@ -61,15 +61,19 @@ class Engine:
         return self._type == "NaiveEngine"
 
     # -- dispatch hooks ----------------------------------------------------
-    def on_push(self, op_name: str, outputs: Any) -> None:
-        """Called by the invoke path after dispatching an op.
+    def on_push(self, op_name: str, outputs: Any,
+                dispatch_us: float = 0.0) -> None:
+        """Called by the invoke path after dispatching an op; dispatch_us
+        is the measured host-side dispatch latency (async — device time is
+        the XLA trace's job, as it was the CUDA profiler's in the
+        reference).
 
         In NaiveEngine mode, block until the results are ready — the direct
         analog of the reference's synchronous debug engine.
         """
         self._num_ops += 1
         for fn in self._listeners:
-            fn(op_name, outputs)
+            fn(op_name, outputs, dispatch_us)
         if self.is_naive:
             import jax
             jax.block_until_ready(outputs)

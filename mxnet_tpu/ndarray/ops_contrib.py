@@ -208,17 +208,20 @@ def _register():
                     # hard negatives: highest background-loss negatives up
                     # to ratio×num_pos; everything else ignored
                     bg_prob = jax.nn.softmax(cpred, axis=0)[0]
-                    neg_score = jnp.where(pos, -jnp.inf, -jnp.log(
+                    # exclude positives AND near-misses (IoU above the
+                    # mining threshold) BEFORE ranking, so ignored anchors
+                    # never consume negative slots (reference
+                    # multibox_target.cc candidate filtering)
+                    ineligible = pos | \
+                        (best_iou >= negative_mining_thresh)
+                    neg_score = jnp.where(ineligible, -jnp.inf, -jnp.log(
                         jnp.maximum(bg_prob, 1e-12)))
                     num_pos = jnp.sum(pos)
                     max_neg = jnp.maximum(
                         (negative_mining_ratio * num_pos).astype(jnp.int32),
                         minimum_negative_samples)
                     rank = jnp.argsort(jnp.argsort(-neg_score))
-                    # near-misses (IoU above the mining threshold) are
-                    # ignored, not negatives (reference multibox_target.cc)
-                    keep_neg = (~pos) & (rank < max_neg) & \
-                        (best_iou < negative_mining_thresh)
+                    keep_neg = (~ineligible) & (rank < max_neg)
                     cls_target = jnp.where(
                         pos | keep_neg, cls_target, float(ignore_label))
                 return loc_target, loc_mask, cls_target

@@ -162,10 +162,13 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
     recording = (_autograd.is_recording() and op.differentiable
                  and any(getattr(x, "_ag", None) is not None
                          for x in nd_inputs))
+    import time as _time
+    _t0 = _time.perf_counter()
     if recording:
         out_vals, vjp_fn = jax.vjp(fn, *in_vals)
     else:
         out_vals = fn(*in_vals)
+    _dispatch_us = (_time.perf_counter() - _t0) * 1e6
 
     multi = isinstance(out_vals, (tuple, list))
     raw_outs = list(out_vals) if multi else [out_vals]
@@ -178,7 +181,7 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
         for i, o in enumerate(outs):
             o._ag = _autograd.AGInfo(node=node, index=i)
 
-    engine().on_push(op.name, raw_outs)
+    engine().on_push(op.name, raw_outs, _dispatch_us)
 
     if out is not None:
         outs_for_write = outs if multi else [outs[0]]

@@ -1,0 +1,122 @@
+"""Custom operators (reference: python/mxnet/operator.py +
+src/operator/custom/custom.cc — SURVEY.md §2.2).
+
+``CustomOp``/``CustomOpProp`` + ``register`` reproduce the reference's
+Python-callback custom-op surface; ``mx.nd.Custom(..., op_type=name)``
+invokes them.  The reference ran these callbacks on a dedicated engine
+thread to keep the async engine flowing; here imperative execution is
+already eager-with-async-buffers, so the callback runs inline under
+``autograd.pause()`` and registers a tape node whose vjp calls the user's
+``backward`` — identical autograd semantics.  (For a jit-compatible custom
+op use ``jax.pure_callback`` or a Pallas kernel via mx.rtc instead.)
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+from .base import MXNetError
+from . import autograd as _ag
+
+__all__ = ["CustomOp", "CustomOpProp", "register", "get_all_registered",
+           "Custom"]
+
+_custom_registry: Dict[str, type] = {}
+
+
+class CustomOp:
+    """User kernel: implement forward/backward with self.assign."""
+
+    def assign(self, dst, req: str, src) -> None:
+        """Write src into dst honoring the grad_req (reference helper)."""
+        if req in ("null", None):
+            return
+        if req == "add":
+            dst += src
+        else:                      # 'write' / 'inplace'
+            dst._set_data(src._read() if hasattr(src, "_read") else src)
+
+    def forward(self, is_train, req, in_data, out_data, aux):
+        raise NotImplementedError
+
+    def backward(self, req, out_grad, in_data, out_data, in_grad, aux):
+        raise NotImplementedError
+
+
+class CustomOpProp:
+    """Shape/type metadata + operator factory (reference: CustomOpProp)."""
+
+    def __init__(self, need_top_grad: bool = True):
+        self.need_top_grad_ = need_top_grad
+
+    def list_arguments(self) -> List[str]:
+        return ["data"]
+
+    def list_outputs(self) -> List[str]:
+        return ["output"]
+
+    def list_auxiliary_states(self) -> List[str]:
+        return []
+
+    def infer_shape(self, in_shape):
+        return in_shape, [in_shape[0]], []
+
+    def infer_type(self, in_type):
+        return in_type, [in_type[0]], []
+
+    def create_operator(self, ctx, in_shapes, in_dtypes) -> CustomOp:
+        raise NotImplementedError
+
+
+def register(reg_name: str) -> Callable[[type], type]:
+    """Decorator registering a CustomOpProp under op_type=reg_name."""
+    def do(prop_cls: type) -> type:
+        if not issubclass(prop_cls, CustomOpProp):
+            raise MXNetError("register() expects a CustomOpProp subclass")
+        _custom_registry[reg_name] = prop_cls
+        return prop_cls
+    return do
+
+
+def get_all_registered() -> List[str]:
+    return sorted(_custom_registry)
+
+
+def Custom(*inputs, op_type: Optional[str] = None, **kwargs):
+    """Invoke a registered custom op (reference: mx.nd.Custom)."""
+    from .ndarray import NDArray, zeros as nd_zeros
+    from .context import current_context
+    if op_type is None or op_type not in _custom_registry:
+        raise MXNetError(f"unknown custom op_type {op_type!r}; "
+                         f"registered: {get_all_registered()}")
+    prop = _custom_registry[op_type](**kwargs)
+    ctx = inputs[0].context if inputs and isinstance(inputs[0], NDArray) \
+        else current_context()
+    in_shapes = [x.shape for x in inputs]
+    arg_shapes, out_shapes, _ = prop.infer_shape(in_shapes)
+    op = prop.create_operator(ctx, arg_shapes,
+                              [x.dtype for x in inputs])
+
+    class _Bridge(_ag.Function):
+        def forward(self, *ins):
+            outs = [nd_zeros(s, ctx=ctx) for s in out_shapes]
+            op.forward(is_train=_ag.is_training(),
+                       req=["write"] * len(outs), in_data=list(ins),
+                       out_data=outs, aux=[])
+            self.save_for_backward(*ins, *outs)
+            self._n_in = len(ins)
+            return outs[0] if len(outs) == 1 else tuple(outs)
+
+        def backward(self, *ograds):
+            saved = self.saved_tensors
+            ins = list(saved[:self._n_in])
+            outs = list(saved[self._n_in:])
+            igrads = [nd_zeros(s, ctx=ctx) for s in
+                      [x.shape for x in ins]]
+            op.backward(req=["write"] * len(igrads),
+                        out_grad=list(ograds), in_data=ins, out_data=outs,
+                        in_grad=igrads, aux=[])
+            return igrads[0] if len(igrads) == 1 else tuple(igrads)
+
+    bridge = _Bridge()
+    bridge.__class__.__name__ = f"Custom[{op_type}]"
+    return bridge(*inputs)

@@ -132,6 +132,9 @@ class Optimizer:
         """Generic multi-precision path: run the update on the fp32 master
         weight, then downcast into the live weight (optimizers with a fused
         mp kernel, like SGD, override this)."""
+        from .sparse import BaseSparseNDArray
+        if isinstance(grad, BaseSparseNDArray) and self.multi_precision:
+            grad = grad.todense()
         if self.multi_precision and isinstance(state, tuple) and \
                 len(state) == 2 and isinstance(state[1], NDArray) and \
                 state[1].dtype == _np.float32 and \
@@ -171,8 +174,10 @@ class SGD(Optimizer):
 
     def update(self, index, weight, grad, state):
         from .sparse import RowSparseNDArray
-        if isinstance(grad, RowSparseNDArray) and self.lazy_update:
-            return self._update_row_sparse(index, weight, grad, state)
+        if isinstance(grad, RowSparseNDArray):
+            if self.lazy_update:
+                return self._update_row_sparse(index, weight, grad, state)
+            grad = grad.todense()      # reference: lazy_update=False path
         self._update_count(index)
         kw = self._common_kwargs(index)
         lr = self._lr_nd(index, weight)

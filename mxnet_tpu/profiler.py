@@ -1,0 +1,162 @@
+"""Profiler (reference: src/profiler/ + python/mxnet/profiler.py,
+SURVEY.md §5.1).
+
+Two levels, mirroring the reference:
+- **Op events** from the engine's dispatch listener → chrome://tracing JSON
+  (``dump()``) and an aggregate table (``dumps()``), the analog of the
+  reference's OprBlock begin/end events.  Dispatch wall-time is recorded;
+  because XLA dispatch is async, per-op *device* time lives in the XLA
+  trace below (the reference had the same split: engine events vs CUDA
+  kernels).
+- **Device/XLA traces** via ``jax.profiler`` (XPlane/perfetto) when
+  ``set_config(profile_all=True, aggregate_stats=...)`` is given a
+  ``filename`` directory — the analog of nvprof/NVTX.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Dict, List, Optional
+
+from .base import MXNetError
+from .engine import engine
+
+__all__ = ["set_config", "set_state", "state", "pause", "resume", "dump",
+           "dumps", "Profiler"]
+
+
+class Profiler:
+    _inst: Optional["Profiler"] = None
+
+    def __init__(self):
+        self.filename = "profile_output.json"
+        self.profile_all = False
+        self.aggregate_stats = True
+        self.trace_dir: Optional[str] = None
+        self._running = False
+        self._paused = False
+        self._events: List[dict] = []
+        self._agg: Dict[str, List[float]] = {}
+        self._lock = threading.Lock()
+        self._listener_installed = False
+        self._t0 = time.perf_counter()
+
+    @classmethod
+    def get(cls) -> "Profiler":
+        if cls._inst is None:
+            cls._inst = Profiler()
+        return cls._inst
+
+    # -- engine listener ---------------------------------------------------
+    def _on_op(self, op_name: str, outputs, dispatch_us: float = 0.0) -> None:
+        if not self._running or self._paused:
+            return
+        now = (time.perf_counter() - self._t0) * 1e6   # µs
+        dur = max(dispatch_us, 0.1)                    # measured, not gap
+        with self._lock:
+            self._events.append({
+                "name": op_name, "ph": "X", "pid": 0, "tid": 0,
+                "ts": now - dur, "dur": dur, "cat": "operator"})
+            self._agg.setdefault(op_name, []).append(dur)
+
+    def start(self) -> None:
+        if not self._listener_installed:
+            engine().add_listener(self._on_op)
+            self._listener_installed = True
+        self._running = True
+        if self.profile_all and self.trace_dir:
+            import jax
+            jax.profiler.start_trace(self.trace_dir)
+
+    def stop(self) -> None:
+        if self.profile_all and self.trace_dir:
+            import jax
+            try:
+                jax.profiler.stop_trace()
+            except RuntimeError:
+                pass
+        self._running = False
+
+    # -- output ------------------------------------------------------------
+    def dump(self, finished: bool = True) -> None:
+        with self._lock:
+            payload = {"traceEvents": list(self._events),
+                       "displayTimeUnit": "ms"}
+        with open(self.filename, "w") as f:
+            json.dump(payload, f)
+
+    def dumps(self, reset: bool = False) -> str:
+        with self._lock:
+            rows = []
+            for name, durs in sorted(self._agg.items()):
+                total = sum(durs)
+                rows.append((name, len(durs), total, total / len(durs),
+                             min(durs), max(durs)))
+            if reset:
+                self._agg.clear()
+        head = (f"{'Name':<32}{'Calls':>8}{'Total(us)':>14}"
+                f"{'Avg(us)':>12}{'Min(us)':>12}{'Max(us)':>12}\n")
+        lines = [head, "-" * len(head) + "\n"]
+        for name, calls, total, avg, mn, mx in rows:
+            lines.append(f"{name:<32}{calls:>8}{total:>14.1f}"
+                         f"{avg:>12.1f}{mn:>12.1f}{mx:>12.1f}\n")
+        return "".join(lines)
+
+    def reset(self) -> None:
+        with self._lock:
+            self._events.clear()
+            self._agg.clear()
+
+
+def set_config(**kwargs) -> None:
+    """reference: mx.profiler.set_config(profile_all=..., filename=...)"""
+    p = Profiler.get()
+    if "filename" in kwargs:
+        p.filename = kwargs.pop("filename")
+    if "profile_all" in kwargs:
+        p.profile_all = bool(kwargs.pop("profile_all"))
+    if "aggregate_stats" in kwargs:
+        p.aggregate_stats = bool(kwargs.pop("aggregate_stats"))
+    if "trace_dir" in kwargs:
+        p.trace_dir = kwargs.pop("trace_dir")
+    # reference accepts (and we ignore) profile_symbolic/imperative/memory/
+    # api — one dispatch funnel means one event stream here
+    kwargs.pop("profile_symbolic", None)
+    kwargs.pop("profile_imperative", None)
+    kwargs.pop("profile_memory", None)
+    kwargs.pop("profile_api", None)
+    if kwargs:
+        raise MXNetError(f"unknown profiler config keys {sorted(kwargs)}")
+
+
+def set_state(state_: str = "stop") -> None:
+    """'run' or 'stop' (reference: mx.profiler.set_state)."""
+    p = Profiler.get()
+    if state_ == "run":
+        p.start()
+    elif state_ == "stop":
+        p.stop()
+    else:
+        raise MXNetError("state must be 'run' or 'stop'")
+
+
+def state() -> str:
+    return "run" if Profiler.get()._running else "stop"
+
+
+def pause() -> None:
+    Profiler.get()._paused = True
+
+
+def resume() -> None:
+    Profiler.get()._paused = False
+
+
+def dump(finished: bool = True) -> None:
+    Profiler.get().dump(finished)
+
+
+def dumps(reset: bool = False) -> str:
+    return Profiler.get().dumps(reset)

@@ -294,12 +294,11 @@ def elemwise_add(lhs, rhs):
         return elemwise_add(rhs, lhs)
     if isinstance(lhs, RowSparseNDArray) and \
             isinstance(rhs, RowSparseNDArray):
+        # vectorized: union1d is sorted, so positions come from searchsorted
         idx = _np.union1d(lhs.indices, rhs.indices)
         data = _np.zeros((len(idx),) + lhs.data.shape[1:], lhs.data.dtype)
-        pos = {int(v): i for i, v in enumerate(idx)}
         for src in (lhs, rhs):
-            for d, i in zip(src.data, src.indices):
-                data[pos[int(i)]] += d
+            _np.add.at(data, _np.searchsorted(idx, src.indices), src.data)
         return RowSparseNDArray(data, idx, lhs.shape, ctx=lhs.context)
     from .ndarray.register import invoke_by_name
     return invoke_by_name("broadcast_add", [lhs, rhs], {})

@@ -1,0 +1,136 @@
+"""Aux subsystem tests: profiler, runtime features, CustomOp, rtc/Pallas.
+(reference models: tests/python/unittest/test_profiler.py, test_operator.py
+custom-op coverage — SURVEY.md §5.1, §2.2)."""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_profiler_records_ops_and_dumps(tmp_path):
+    from mxnet_tpu import profiler
+    f = str(tmp_path / "trace.json")
+    profiler.set_config(filename=f, aggregate_stats=True)
+    profiler.set_state("run")
+    a = nd.ones((8, 8))
+    b = nd.ones((8, 8))
+    (a + b).asnumpy()
+    nd.dot(a, b).asnumpy()
+    profiler.set_state("stop")
+    profiler.dump()
+    data = json.load(open(f))
+    names = {e["name"] for e in data["traceEvents"]}
+    assert "dot" in names
+    table = profiler.dumps()
+    assert "dot" in table and "Calls" in table
+    # pause/resume gate collection
+    profiler.Profiler.get().reset()
+    profiler.set_state("run")
+    profiler.pause()
+    nd.dot(a, b).asnumpy()
+    profiler.resume()
+    profiler.set_state("stop")
+    assert "dot" not in profiler.dumps()
+
+
+def test_runtime_features():
+    from mxnet_tpu import runtime
+    feats = runtime.Features()
+    assert feats.is_enabled("XLA")
+    assert feats.is_enabled("PALLAS")
+    assert feats.is_enabled("IMAGE_DECODE")
+    assert any(f.name == "TPU" for f in runtime.feature_list())
+
+
+def test_custom_op_forward_backward():
+    @mx.operator.register("scaled_square")
+    class ScaledSquareProp(mx.operator.CustomOpProp):
+        def __init__(self, scale=2.0):
+            super().__init__(need_top_grad=True)
+            self.scale = float(scale)
+
+        def create_operator(self, ctx, in_shapes, in_dtypes):
+            scale = self.scale
+
+            class ScaledSquare(mx.operator.CustomOp):
+                def forward(self, is_train, req, in_data, out_data, aux):
+                    self.assign(out_data[0], req[0],
+                                in_data[0] * in_data[0] * scale)
+
+                def backward(self, req, out_grad, in_data, out_data,
+                             in_grad, aux):
+                    self.assign(in_grad[0], req[0],
+                                out_grad[0] * 2.0 * scale * in_data[0])
+            return ScaledSquare()
+
+    assert "scaled_square" in mx.operator.get_all_registered()
+    x = nd.array(np.array([1.0, 2.0, 3.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.Custom(x, op_type="scaled_square", scale=3.0)
+        L = y.sum()
+    L.backward()
+    np.testing.assert_allclose(y.asnumpy(), [3, 12, 27])
+    np.testing.assert_allclose(x.grad.asnumpy(), [6, 12, 18])
+
+
+def test_rtc_pallas_kernel():
+    from mxnet_tpu import rtc
+
+    def add_one_kernel(x_ref, o_ref):
+        o_ref[...] = x_ref[...] + 1.0
+
+    mod = rtc.PallasModule()
+    mod.add_kernel("add_one", add_one_kernel)
+    k = mod.get_kernel("add_one")
+    x = nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    out = k.launch([x])
+    np.testing.assert_allclose(out.asnumpy(), x.asnumpy() + 1.0)
+    # unknown kernel errors; CudaModule refuses with guidance
+    with pytest.raises(mx.MXNetError):
+        mod.get_kernel("nope")
+    with pytest.raises(mx.MXNetError):
+        rtc.CudaModule("__global__ void k(){}")
+
+
+def test_sgd_nonlazy_densifies_row_sparse():
+    """lazy_update=False does a full dense update (review regression)."""
+    from mxnet_tpu import sparse
+    opt = mx.optimizer.create("sgd", learning_rate=1.0, lazy_update=False)
+    w = nd.array(np.ones((4, 2), np.float32))
+    grad = sparse.row_sparse_array(
+        (np.full((1, 2), 0.5, np.float32), [2]), shape=(4, 2))
+    opt.update(0, w, grad, opt.create_state(0, w))
+    np.testing.assert_allclose(w.asnumpy()[2], [0.5, 0.5])
+    np.testing.assert_allclose(w.asnumpy()[0], [1.0, 1.0])
+
+
+def test_rtc_int32_kernel_inherits_dtype():
+    from mxnet_tpu import rtc
+
+    def twice(x_ref, o_ref):
+        o_ref[...] = x_ref[...] * 2
+
+    k = rtc.PallasModule().add_kernel("t", twice)
+    x = nd.array(np.arange(6, dtype=np.int32).reshape(2, 3), dtype="int32")
+    out = k.launch([x])
+    assert out.asnumpy().dtype == np.int32
+    np.testing.assert_array_equal(out.asnumpy(), x.asnumpy() * 2)
+
+
+def test_profiler_durations_not_gap_based():
+    """Idle host time must not be attributed to the next op."""
+    import time as _t
+    from mxnet_tpu import profiler
+    profiler.Profiler.get().reset()
+    profiler.set_state("run")
+    nd.dot(nd.ones((4, 4)), nd.ones((4, 4))).asnumpy()
+    _t.sleep(0.3)
+    nd.dot(nd.ones((4, 4)), nd.ones((4, 4))).asnumpy()
+    profiler.set_state("stop")
+    durs = profiler.Profiler.get()._agg["dot"]
+    assert max(durs) < 2.5e5, durs   # no 300ms gap absorbed
