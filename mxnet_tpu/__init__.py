@@ -15,6 +15,16 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
+import os as _os
+
+# numerics-parity escape hatch: TPU matmuls default to bf16-precision
+# accumulation (the MXU fast path); set MXNET_MATMUL_PRECISION=highest to
+# force full fp32 (reference-exact numerics, ~3x slower matmuls)
+_prec = _os.environ.get("MXNET_MATMUL_PRECISION")
+if _prec:
+    import jax as _jax
+    _jax.config.update("jax_default_matmul_precision", _prec)
+
 from .base import MXNetError, register_env, get_env, list_env
 from .context import Context, cpu, gpu, tpu, cpu_pinned, num_gpus, num_tpus, \
     current_context
@@ -37,6 +47,7 @@ from . import recordio
 from . import sparse
 ndarray.sparse = sparse          # reference surface: mx.nd.sparse
 from . import io
+from . import image
 from . import model
 from . import callback
 from . import gluon

@@ -39,7 +39,8 @@ class Engine:
         self._type = os.environ.get("MXNET_ENGINE_TYPE",
                                     "ThreadedEnginePerDevice")
         self._num_ops = 0
-        self._listeners = []  # profiler hooks: fn(op_name, metadata)
+        # profiler hooks: fn(op_name, outputs, dispatch_us)
+        self._listeners = []
 
     @classmethod
     def get(cls) -> "Engine":

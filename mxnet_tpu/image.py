@@ -1,0 +1,300 @@
+"""``mx.image``: python-side image loading/augmentation (reference:
+python/mxnet/image/image.py — SURVEY.md §2.4 "Async image API").
+
+The reference built this on OpenCV handles; this build decodes via PIL
+(the image in this environment has no OpenCV) into HWC uint8/float numpy,
+with the same augmenter-class composition surface (``CreateAugmenter``,
+``ImageIter``).  Heavy batch pipelines should prefer io.ImageRecordIter
+(threaded) — as in the reference.
+"""
+from __future__ import annotations
+
+import io as _io
+import os
+import random as _pyrandom
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from .base import MXNetError
+from .context import cpu
+from .io import DataBatch, DataDesc, DataIter
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
+           "center_crop", "random_crop", "color_normalize", "HorizontalFlipAug",
+           "ResizeAug", "ForceResizeAug", "CenterCropAug", "RandomCropAug",
+           "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
+           "ContrastJitterAug", "SaturationJitterAug", "CreateAugmenter",
+           "Augmenter", "ImageIter"]
+
+
+def _pil():
+    try:
+        from PIL import Image
+        return Image
+    except ImportError as e:
+        raise MXNetError("image ops need PIL (not installed)") from e
+
+
+def imdecode(buf: bytes, to_rgb: bool = True, flag: int = 1) -> NDArray:
+    """Decode an encoded image buffer to an HWC NDArray
+    (reference: mx.image.imdecode over cv2.imdecode)."""
+    img = _np.asarray(_pil().open(_io.BytesIO(buf)).convert(
+        "RGB" if flag else "L"))
+    if img.ndim == 2:
+        img = img[:, :, None]
+    if not to_rgb and img.shape[2] == 3:
+        img = img[:, :, ::-1]
+    return nd_array(img, ctx=cpu())
+
+
+def imread(filename: str, to_rgb: bool = True, flag: int = 1) -> NDArray:
+    with open(filename, "rb") as f:
+        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+
+
+def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+    Image = _pil()
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    mode = arr.astype(_np.uint8) if arr.dtype != _np.uint8 else arr
+    resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
+                3: Image.LANCZOS}.get(interp, Image.BILINEAR)
+    out = _np.asarray(Image.fromarray(mode.squeeze() if mode.shape[-1] == 1
+                                      else mode).resize((w, h), resample))
+    if out.ndim == 2:
+        out = out[:, :, None]
+    return nd_array(out.astype(arr.dtype), ctx=cpu())
+
+
+def resize_short(src, size: int, interp: int = 1) -> NDArray:
+    h, w = src.shape[:2]
+    if h > w:
+        nw, nh = size, int(h * size / w)
+    else:
+        nw, nh = int(w * size / h), size
+    return imresize(src, nw, nh, interp)
+
+
+def fixed_crop(src, x0: int, y0: int, w: int, h: int,
+               size: Optional[Tuple[int, int]] = None,
+               interp: int = 1) -> NDArray:
+    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return imresize(out, size[0], size[1], interp)
+    return nd_array(out, ctx=cpu())
+
+
+def center_crop(src, size: Tuple[int, int], interp: int = 1):
+    h, w = src.shape[:2]
+    cw, ch = size
+    x0 = max((w - cw) // 2, 0)
+    y0 = max((h - ch) // 2, 0)
+    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
+    return out, (x0, y0, cw, ch)
+
+
+def random_crop(src, size: Tuple[int, int], interp: int = 1):
+    h, w = src.shape[:2]
+    cw, ch = size
+    x0 = _pyrandom.randint(0, max(w - cw, 0))
+    y0 = _pyrandom.randint(0, max(h - ch, 0))
+    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
+    return out, (x0, y0, cw, ch)
+
+
+def color_normalize(src, mean, std=None) -> NDArray:
+    arr = src.asnumpy().astype(_np.float32) if isinstance(src, NDArray) \
+        else _np.asarray(src, dtype=_np.float32)
+    arr = arr - _np.asarray(mean, dtype=_np.float32)
+    if std is not None:
+        arr = arr / _np.asarray(std, dtype=_np.float32)
+    return nd_array(arr, ctx=cpu())
+
+
+# ---------------------------------------------------------------------------
+# augmenter classes (reference: mx.image.Augmenter family)
+# ---------------------------------------------------------------------------
+
+class Augmenter:
+    def __call__(self, src: NDArray) -> NDArray:
+        raise NotImplementedError
+
+
+class ResizeAug(Augmenter):
+    def __init__(self, size: int, interp: int = 1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return resize_short(src, self.size, self.interp)
+
+
+class ForceResizeAug(Augmenter):
+    def __init__(self, size: Tuple[int, int], interp: int = 1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return imresize(src, self.size[0], self.size[1], self.interp)
+
+
+class CenterCropAug(Augmenter):
+    def __init__(self, size: Tuple[int, int], interp: int = 1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return center_crop(src, self.size, self.interp)[0]
+
+
+class RandomCropAug(Augmenter):
+    def __init__(self, size: Tuple[int, int], interp: int = 1):
+        self.size, self.interp = size, interp
+
+    def __call__(self, src):
+        return random_crop(src, self.size, self.interp)[0]
+
+
+class HorizontalFlipAug(Augmenter):
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            return nd_array(src.asnumpy()[:, ::-1].copy(), ctx=cpu())
+        return src
+
+
+class CastAug(Augmenter):
+    def __init__(self, dtype="float32"):
+        self.dtype = dtype
+
+    def __call__(self, src):
+        return src.astype(self.dtype)
+
+
+class ColorNormalizeAug(Augmenter):
+    def __init__(self, mean, std):
+        self.mean, self.std = mean, std
+
+    def __call__(self, src):
+        return color_normalize(src, self.mean, self.std)
+
+
+class _JitterAug(Augmenter):
+    def __init__(self, jitter: float):
+        self.jitter = jitter
+
+    def _coef(self) -> float:
+        return 1.0 + _pyrandom.uniform(-self.jitter, self.jitter)
+
+
+class BrightnessJitterAug(_JitterAug):
+    def __call__(self, src):
+        return nd_array(src.asnumpy().astype(_np.float32) * self._coef(),
+                        ctx=cpu())
+
+
+class ContrastJitterAug(_JitterAug):
+    def __call__(self, src):
+        arr = src.asnumpy().astype(_np.float32)
+        mean = arr.mean()
+        return nd_array((arr - mean) * self._coef() + mean, ctx=cpu())
+
+
+class SaturationJitterAug(_JitterAug):
+    def __call__(self, src):
+        arr = src.asnumpy().astype(_np.float32)
+        gray = arr.mean(axis=2, keepdims=True)
+        c = self._coef()
+        return nd_array(arr * c + gray * (1.0 - c), ctx=cpu())
+
+
+def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
+                    rand_mirror=False, mean=None, std=None,
+                    brightness=0, contrast=0, saturation=0,
+                    inter_method=1, **kwargs) -> List[Augmenter]:
+    """Standard augmenter pipeline factory (reference: CreateAugmenter)."""
+    auglist: List[Augmenter] = []
+    if resize > 0:
+        auglist.append(ResizeAug(resize, inter_method))
+    crop = (data_shape[2], data_shape[1])
+    auglist.append(RandomCropAug(crop, inter_method) if rand_crop
+                   else CenterCropAug(crop, inter_method))
+    if rand_mirror:
+        auglist.append(HorizontalFlipAug(0.5))
+    auglist.append(CastAug())
+    if brightness:
+        auglist.append(BrightnessJitterAug(brightness))
+    if contrast:
+        auglist.append(ContrastJitterAug(contrast))
+    if saturation:
+        auglist.append(SaturationJitterAug(saturation))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(ColorNormalizeAug(mean, std))
+    return auglist
+
+
+class ImageIter(DataIter):
+    """Python-side image iterator over (label, path) lists or .lst files
+    (reference: mx.image.ImageIter)."""
+
+    def __init__(self, batch_size: int, data_shape: Sequence[int],
+                 path_root: str = "", imglist=None, path_imglist: str = "",
+                 shuffle: bool = False, aug_list=None,
+                 label_width: int = 1, **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.path_root = path_root
+        self.label_width = label_width
+        if imglist is None and path_imglist:
+            imglist = []
+            with open(path_imglist) as f:
+                for line in f:
+                    parts = line.strip().split("\t")
+                    imglist.append([float(x) for x in
+                                    parts[1:1 + label_width]] + [parts[-1]])
+        if not imglist:
+            raise MXNetError("ImageIter needs imglist or path_imglist")
+        self.imglist = list(imglist)
+        self.shuffle = shuffle
+        self.aug_list = aug_list if aug_list is not None else \
+            CreateAugmenter(data_shape)
+        self.cur = 0
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc("data", (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        shape = (self.batch_size,) if self.label_width == 1 else \
+            (self.batch_size, self.label_width)
+        return [DataDesc("softmax_label", shape)]
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.imglist)
+        self.cur = 0
+
+    def next(self) -> DataBatch:
+        if self.cur + self.batch_size > len(self.imglist):
+            raise StopIteration
+        datas, labels = [], []
+        for entry in self.imglist[self.cur:self.cur + self.batch_size]:
+            *label, path = entry
+            img = imread(os.path.join(self.path_root, path))
+            for aug in self.aug_list:
+                img = aug(img)
+            datas.append(img.asnumpy().transpose(2, 0, 1))
+            labels.append(label if self.label_width > 1 else label[0])
+        self.cur += self.batch_size
+        return DataBatch(
+            [nd_array(_np.stack(datas).astype(_np.float32), ctx=cpu())],
+            [nd_array(_np.asarray(labels, dtype=_np.float32), ctx=cpu())],
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)

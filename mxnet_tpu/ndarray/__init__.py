@@ -23,6 +23,8 @@ from . import ops_matrix as _ops_matrix          # noqa: F401
 from . import ops_nn as _ops_nn                  # noqa: F401
 from . import ops_optimizer as _ops_optimizer    # noqa: F401
 from . import ops_contrib as _ops_contrib        # noqa: F401
+from . import ops_linalg as _ops_linalg          # noqa: F401
+from . import ops_spatial as _ops_spatial        # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401
 

@@ -1,6 +1,12 @@
-"""``mx.nd.contrib``: frontends for the _contrib_* ops (reference:
-python/mxnet/ndarray/contrib.py — generated from the registry's contrib
-namespace).
+"""``mx.nd.contrib``: frontends for the _contrib_* ops plus the control-flow
+operators (reference: python/mxnet/ndarray/contrib.py — generated wrappers +
+foreach/while_loop/cond, src/operator/control_flow.cc).
+
+Control flow is where the reference and XLA agree most deeply: the
+reference added foreach/while_loop/cond precisely so RNNs could run inside
+one graph; here they ARE ``lax.scan`` / ``lax.while_loop`` / ``lax.cond``,
+the structured-control-flow primitives jit requires (SURVEY.md build rules:
+no data-dependent Python control flow under jit).
 """
 from __future__ import annotations
 
@@ -14,3 +20,118 @@ _mod = _sys.modules[__name__]
 for _name, _op in list(_registry.items()):
     if _name.startswith(_PREFIX):
         setattr(_mod, _name[len(_PREFIX):], make_frontend(_op))
+
+
+def _to_vals(x):
+    from .ndarray import NDArray
+    if isinstance(x, NDArray):
+        return x._read()
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_vals(v) for v in x)
+    return x
+
+
+def _to_nds(x, ctx):
+    import jax
+    from .ndarray import NDArray
+    if isinstance(x, jax.Array):
+        return NDArray(x, ctx=ctx)
+    if isinstance(x, (list, tuple)):
+        return type(x)(_to_nds(v, ctx) for v in x)
+    return x
+
+
+def _ctx_of(*xs):
+    from .ndarray import NDArray
+    from ..context import current_context
+    for x in xs:
+        if isinstance(x, NDArray):
+            return x.context
+        if isinstance(x, (list, tuple)):
+            c = _ctx_of(*x)
+            if c is not None:
+                return c
+    return current_context()
+
+
+def foreach(body, data, init_states):
+    """Run ``body(x_t, states) -> (out_t, states)`` over axis 0 of data —
+    the reference's foreach (≡ lax.scan).  Returns (stacked_outs, states).
+    """
+    import jax
+    from .ndarray import NDArray
+    ctx = _ctx_of(data, init_states)
+
+    def step(carry, x):
+        outs, new_states = body(_to_nds(x, ctx), _to_nds(carry, ctx))
+        return _to_vals(new_states), _to_vals(outs)
+
+    carry, ys = jax.lax.scan(step, _to_vals(init_states), _to_vals(data))
+    return _to_nds(ys, ctx), _to_nds(carry, ctx)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None):
+    """reference: contrib.while_loop.  ``cond(*loop_vars) -> bool``,
+    ``func(*loop_vars) -> (step_output, new_loop_vars)``.  To keep shapes
+    static (XLA requirement), step outputs are buffered to
+    ``max_iterations`` rows; returns (outputs, final_loop_vars)."""
+    import jax
+    import jax.numpy as jnp
+    from ..base import MXNetError
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations on TPU "
+                         "(static shapes)")
+    ctx = _ctx_of(loop_vars)
+    lv0 = tuple(_to_vals(v) for v in loop_vars)
+
+    # abstract shape probe: trace func without executing it (the body must
+    # not run — or run twice — when cond is initially false)
+    _single = [True]
+
+    def _probe(*vals):
+        outs, _ = func(*[_to_nds(v, ctx) for v in vals])
+        ovals = _to_vals(outs)
+        _single[0] = not isinstance(ovals, (list, tuple))
+        return [ovals] if _single[0] else list(ovals)
+
+    probe_avals = jax.eval_shape(_probe, *lv0)
+    single = _single[0]
+    bufs0 = tuple(jnp.zeros((max_iterations,) + v.shape, v.dtype)
+                  for v in probe_avals)
+
+    def cond_fn(state):
+        i, lv, bufs = state
+        c = cond(*[_to_nds(v, ctx) for v in lv])
+        cval = c._read() if hasattr(c, "_read") else c
+        return jnp.logical_and(i < max_iterations,
+                               jnp.asarray(cval).reshape(()))
+
+    def body_fn(state):
+        i, lv, bufs = state
+        outs, new_lv = func(*[_to_nds(v, ctx) for v in lv])
+        ovals = _to_vals(outs)
+        olist = [ovals] if single else list(ovals)
+        bufs = tuple(b.at[i].set(o) for b, o in zip(bufs, olist))
+        return (i + 1, tuple(_to_vals(v) for v in new_lv), bufs)
+
+    i, lv, bufs = jax.lax.while_loop(cond_fn, body_fn,
+                                     (jnp.asarray(0), lv0, bufs0))
+    outs = _to_nds(bufs[0] if single else list(bufs), ctx)
+    return outs, [_to_nds(v, ctx) for v in lv]
+
+
+def cond(pred, then_func, else_func):
+    """reference: contrib.cond ≡ lax.cond (both branches traced once)."""
+    import jax
+    import jax.numpy as jnp
+    p = pred._read() if hasattr(pred, "_read") else pred
+    ctx = _ctx_of(pred)
+
+    def mk(fn):
+        def wrapped(_):
+            return _to_vals(fn())
+        return wrapped
+
+    out = jax.lax.cond(jnp.asarray(p).reshape(()).astype(bool),
+                       mk(then_func), mk(else_func), operand=None)
+    return _to_nds(out, ctx)

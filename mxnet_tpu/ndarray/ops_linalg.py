@@ -1,0 +1,147 @@
+"""Linear-algebra operators (reference: src/operator/tensor/la_op.cc —
+``mx.nd.linalg_*``, SURVEY.md §2.2).
+
+All map 1:1 onto jax.numpy.linalg / lax.linalg, which XLA lowers to the
+TPU's native QR/Cholesky/triangular-solve paths; batch dims broadcast the
+way the reference's batched LAPACK wrappers did.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op, simple_op
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def gemm_maker(transpose_a=False, transpose_b=False, alpha=1.0,
+                   beta=1.0, axis=-2):
+        def fn(a, b, c):
+            av = jnp.swapaxes(a, -1, -2) if transpose_a else a
+            bv = jnp.swapaxes(b, -1, -2) if transpose_b else b
+            return alpha * jnp.matmul(av, bv) + beta * c
+        return fn
+    register_op("linalg_gemm", gemm_maker)
+
+    def potrf_maker(lower=True):
+        def fn(a):
+            l = jnp.linalg.cholesky(a)
+            return l if lower else jnp.swapaxes(l, -1, -2)
+        return fn
+    register_op("linalg_potrf", potrf_maker)
+
+    def potri_maker(lower=True):
+        # inverse from the Cholesky factor: A^-1 where A = L L^T
+        def fn(l):
+            lv = l if lower else jnp.swapaxes(l, -1, -2)
+            eye = jnp.broadcast_to(jnp.eye(lv.shape[-1], dtype=lv.dtype),
+                                   lv.shape)
+            linv = lax.linalg.triangular_solve(
+                lv, eye, left_side=True, lower=True)
+            return jnp.matmul(jnp.swapaxes(linv, -1, -2), linv)
+        return fn
+    register_op("linalg_potri", potri_maker)
+
+    def trsm_maker(transpose=False, rightside=False, lower=True,
+                   alpha=1.0):
+        def fn(a, b):
+            out = lax.linalg.triangular_solve(
+                a, alpha * b, left_side=not rightside, lower=lower,
+                transpose_a=transpose)
+            return out
+        return fn
+    register_op("linalg_trsm", trsm_maker)
+
+    def trmm_maker(transpose=False, rightside=False, lower=True,
+                   alpha=1.0):
+        def fn(a, b):
+            tri = jnp.tril(a) if lower else jnp.triu(a)
+            if transpose:
+                tri = jnp.swapaxes(tri, -1, -2)
+            return alpha * (jnp.matmul(b, tri) if rightside
+                            else jnp.matmul(tri, b))
+        return fn
+    register_op("linalg_trmm", trmm_maker)
+
+    def syrk_maker(transpose=False, alpha=1.0):
+        def fn(a):
+            at = jnp.swapaxes(a, -1, -2)
+            return alpha * (jnp.matmul(at, a) if transpose
+                            else jnp.matmul(a, at))
+        return fn
+    register_op("linalg_syrk", syrk_maker)
+
+    def gelqf_maker():
+        # LQ decomposition: A = L Q (reference returns (Q, L))
+        def fn(a):
+            q, r = jnp.linalg.qr(jnp.swapaxes(a, -1, -2))
+            return jnp.swapaxes(q, -1, -2), jnp.swapaxes(r, -1, -2)
+        return fn
+    register_op("linalg_gelqf", gelqf_maker)
+
+    simple_op("linalg_sumlogdiag",
+              lambda a: jnp.sum(jnp.log(jnp.diagonal(a, axis1=-2,
+                                                     axis2=-1)), axis=-1))
+
+    def extractdiag_maker(offset=0):
+        def fn(a):
+            return jnp.diagonal(a, offset=offset, axis1=-2, axis2=-1)
+        return fn
+    register_op("linalg_extractdiag", extractdiag_maker)
+
+    def makediag_maker(offset=0):
+        def fn(a):
+            base = jnp.zeros(a.shape[:-1] + (a.shape[-1] + abs(offset),) * 2,
+                             dtype=a.dtype)
+            idx = jnp.arange(a.shape[-1])
+            r = idx + max(-offset, 0)
+            c = idx + max(offset, 0)
+            return base.at[..., r, c].set(a)
+        return fn
+    register_op("linalg_makediag", makediag_maker)
+
+    def extracttrian_maker(offset=0, lower=True):
+        def fn(a):
+            n = a.shape[-1]
+            rows, cols = _np.tril_indices(n, k=offset) if lower else \
+                _np.triu_indices(n, k=offset)
+            return a[..., rows, cols]
+        return fn
+    register_op("linalg_extracttrian", extracttrian_maker)
+
+    def maketrian_maker(offset=0, lower=True):
+        def fn(a):
+            # invert extracttrian: k elements -> n x n triangle
+            k = a.shape[-1]
+            n = int(round((_np.sqrt(8 * k + 1) - 1) / 2))
+            if lower and offset < 0 or not lower and offset > 0:
+                n += abs(offset)
+            rows, cols = _np.tril_indices(n, k=offset) if lower else \
+                _np.triu_indices(n, k=offset)
+            base = jnp.zeros(a.shape[:-1] + (n, n), dtype=a.dtype)
+            return base.at[..., rows, cols].set(a)
+        return fn
+    register_op("linalg_maketrian", maketrian_maker)
+
+    simple_op("linalg_inverse", jnp.linalg.inv)
+    simple_op("linalg_det", jnp.linalg.det)
+
+    def slogdet_fn(a):
+        sign, logdet = jnp.linalg.slogdet(a)
+        return sign, logdet
+    simple_op("linalg_slogdet", slogdet_fn)
+
+    def khatri_rao_fn(*mats):
+        # column-wise Kronecker product (reference: khatri_rao op)
+        out = mats[0]
+        for m in mats[1:]:
+            out = (out[:, None, :] * m[None, :, :]).reshape(
+                -1, out.shape[-1])
+        return out
+    simple_op("khatri_rao", khatri_rao_fn)
+
+
+_register()

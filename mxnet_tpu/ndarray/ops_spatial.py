@@ -1,0 +1,129 @@
+"""Spatial warping operators (reference: src/operator/
+{grid_generator,bilinear_sampler,spatial_transformer}.cc — SURVEY.md §2.2).
+
+GridGenerator/BilinearSampler/SpatialTransformer back spatial-transformer
+networks; on TPU the sampler is a gather+lerp that XLA fuses cleanly.
+Grid convention follows the reference: normalized coords in [-1, 1],
+grid layout (B, 2, H, W) with (x, y) channels.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+
+    def _affine_grid(theta, h, w):
+        # theta (B, 6) -> sampling grid (B, 2, h, w) in [-1, 1]
+        ys = jnp.linspace(-1.0, 1.0, h)
+        xs = jnp.linspace(-1.0, 1.0, w)
+        gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+        ones = jnp.ones_like(gx)
+        base = jnp.stack([gx, gy, ones], axis=0).reshape(3, -1)  # (3, hw)
+        th = theta.reshape(-1, 2, 3)
+        out = jnp.einsum("bij,jk->bik", th, base)                # (B,2,hw)
+        return out.reshape(-1, 2, h, w)
+
+    def grid_generator_maker(transform_type="affine", target_shape=(0, 0)):
+        th, tw = int(target_shape[0]), int(target_shape[1])
+
+        def fn(data):
+            if transform_type == "affine":
+                return _affine_grid(data, th, tw)
+            # 'warp': data is (B, 2, H, W) flow field added to identity
+            b, _, h, w = data.shape
+            ys = jnp.linspace(-1.0, 1.0, h)
+            xs = jnp.linspace(-1.0, 1.0, w)
+            gy, gx = jnp.meshgrid(ys, xs, indexing="ij")
+            ident = jnp.stack([gx, gy], axis=0)
+            # flow is in pixels; normalize like the reference warp mode
+            norm = jnp.stack([data[:, 0] * 2.0 / max(w - 1, 1),
+                              data[:, 1] * 2.0 / max(h - 1, 1)], axis=1)
+            return ident[None] + norm
+        return fn
+    register_op("GridGenerator", grid_generator_maker,
+                aliases=("grid_generator",))
+
+    def _bilinear_sample(img, grid):
+        # img (C, H, W); grid (2, HO, WO) in [-1, 1] -> (C, HO, WO)
+        c, h, w = img.shape
+        gx = (grid[0] + 1.0) * (w - 1) / 2.0
+        gy = (grid[1] + 1.0) * (h - 1) / 2.0
+        x0 = jnp.floor(gx)
+        y0 = jnp.floor(gy)
+        lx = gx - x0
+        ly = gy - y0
+
+        def at(yy, xx):
+            inb = (yy >= 0) & (yy < h) & (xx >= 0) & (xx < w)
+            yi = jnp.clip(yy, 0, h - 1).astype(jnp.int32)
+            xi = jnp.clip(xx, 0, w - 1).astype(jnp.int32)
+            v = img[:, yi, xi]              # (C, HO, WO)
+            return jnp.where(inb[None], v, 0.0)   # zero-pad outside
+
+        v00 = at(y0, x0)
+        v01 = at(y0, x0 + 1)
+        v10 = at(y0 + 1, x0)
+        v11 = at(y0 + 1, x0 + 1)
+        return (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                v10 * ly * (1 - lx) + v11 * ly * lx)[None][0]
+
+    def bilinear_sampler_maker(cudnn_off=None):
+        def fn(data, grid):
+            return jax.vmap(_bilinear_sample)(data, grid)
+        return fn
+    register_op("BilinearSampler", bilinear_sampler_maker,
+                aliases=("bilinear_sampler",))
+
+    def spatial_transformer_maker(target_shape=(0, 0),
+                                  transform_type="affine",
+                                  sampler_type="bilinear",
+                                  cudnn_off=None):
+        th, tw = int(target_shape[0]), int(target_shape[1])
+
+        def fn(data, loc):
+            grid = _affine_grid(loc, th, tw)
+            return jax.vmap(_bilinear_sample)(data, grid)
+        return fn
+    register_op("SpatialTransformer", spatial_transformer_maker,
+                aliases=("spatial_transformer",))
+
+    def batch_take_fn(a, indices):
+        flat = a.reshape(a.shape[0], -1)
+        return jnp.take_along_axis(
+            flat, indices.reshape(-1, 1).astype(jnp.int32), axis=1)[:, 0]
+    from .register import simple_op
+    simple_op("batch_take", batch_take_fn)
+
+    def ravel_multi_index_maker(shape=None):
+        dims = tuple(int(s) for s in shape)
+
+        def fn(idx):
+            strides = _np.cumprod((1,) + dims[::-1][:-1])[::-1]
+            return jnp.sum(idx * jnp.asarray(strides.copy())[:, None],
+                           axis=0).astype(idx.dtype)
+        return fn
+    register_op("_ravel_multi_index", ravel_multi_index_maker,
+                aliases=("ravel_multi_index",), differentiable=False)
+
+    def unravel_index_maker(shape=None):
+        dims = tuple(int(s) for s in shape)
+
+        def fn(idx):
+            outs = []
+            rem = idx
+            strides = _np.cumprod((1,) + dims[::-1][:-1])[::-1]
+            for s in strides:
+                outs.append(rem // s)
+                rem = rem % s
+            return jnp.stack(outs, axis=0).astype(idx.dtype)
+        return fn
+    register_op("_unravel_index", unravel_index_maker,
+                aliases=("unravel_index",), differentiable=False)
+
+
+_register()

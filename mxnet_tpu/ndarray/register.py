@@ -18,6 +18,7 @@ generated from the single C-side registry.
 from __future__ import annotations
 
 import functools
+from time import perf_counter as _perf_counter
 from typing import Any, Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as _np
@@ -162,13 +163,16 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
     recording = (_autograd.is_recording() and op.differentiable
                  and any(getattr(x, "_ag", None) is not None
                          for x in nd_inputs))
-    import time as _time
-    _t0 = _time.perf_counter()
+    eng = engine()
+    # timing only when someone is listening (profiler) — invoke is the
+    # hottest path in the library
+    _timed = bool(eng._listeners)
+    _t0 = _perf_counter() if _timed else 0.0
     if recording:
         out_vals, vjp_fn = jax.vjp(fn, *in_vals)
     else:
         out_vals = fn(*in_vals)
-    _dispatch_us = (_time.perf_counter() - _t0) * 1e6
+    _dispatch_us = (_perf_counter() - _t0) * 1e6 if _timed else 0.0
 
     multi = isinstance(out_vals, (tuple, list))
     raw_outs = list(out_vals) if multi else [out_vals]
@@ -181,7 +185,7 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
         for i, o in enumerate(outs):
             o._ag = _autograd.AGInfo(node=node, index=i)
 
-    engine().on_push(op.name, raw_outs, _dispatch_us)
+    eng.on_push(op.name, raw_outs, _dispatch_us)
 
     if out is not None:
         outs_for_write = outs if multi else [outs[0]]

@@ -9,8 +9,8 @@ Two levels, mirroring the reference:
   trace below (the reference had the same split: engine events vs CUDA
   kernels).
 - **Device/XLA traces** via ``jax.profiler`` (XPlane/perfetto) when
-  ``set_config(profile_all=True, aggregate_stats=...)`` is given a
-  ``filename`` directory — the analog of nvprof/NVTX.
+  ``profile_all=True``: written to ``trace_dir`` if configured, else to
+  ``<filename>_xla/`` next to the chrome trace — the analog of nvprof/NVTX.
 """
 from __future__ import annotations
 
@@ -66,6 +66,10 @@ class Profiler:
             engine().add_listener(self._on_op)
             self._listener_installed = True
         self._running = True
+        if self.profile_all and not self.trace_dir:
+            # profile_all without an explicit trace_dir: put the XLA trace
+            # next to the chrome-trace file (documented behavior)
+            self.trace_dir = self.filename + "_xla"
         if self.profile_all and self.trace_dir:
             import jax
             jax.profiler.start_trace(self.trace_dir)

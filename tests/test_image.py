@@ -1,0 +1,70 @@
+"""mx.image tests (reference model: tests/python/unittest/test_image.py)."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import image as mimg
+
+
+def _save_img(path, h=40, w=60, seed=0):
+    from PIL import Image
+    rng = np.random.default_rng(seed)
+    arr = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+    Image.fromarray(arr).save(path)
+    return arr
+
+
+def test_imread_imdecode_resize(tmp_path):
+    p = str(tmp_path / "a.png")
+    arr = _save_img(p)
+    img = mimg.imread(p)
+    np.testing.assert_array_equal(img.asnumpy(), arr)
+    small = mimg.imresize(img, 30, 20)
+    assert small.shape == (20, 30, 3)
+    short = mimg.resize_short(img, 20)
+    assert min(short.shape[:2]) == 20
+
+
+def test_crops_and_normalize(tmp_path):
+    p = str(tmp_path / "a.png")
+    arr = _save_img(p)
+    img = mimg.imread(p)
+    out, (x0, y0, w, h) = mimg.center_crop(img, (32, 24))
+    assert out.shape == (24, 32, 3)
+    out2, _ = mimg.random_crop(img, (16, 16))
+    assert out2.shape == (16, 16, 3)
+    normed = mimg.color_normalize(img, mean=[123.0, 116.0, 103.0],
+                                  std=[58.0, 57.0, 57.0])
+    assert abs(float(normed.asnumpy().mean())) < 2.0
+
+
+def test_augmenter_pipeline():
+    rng = np.random.default_rng(0)
+    img = mx.nd.array(rng.integers(0, 255, (50, 50, 3)).astype(np.uint8),
+                      dtype="uint8")
+    augs = mimg.CreateAugmenter((3, 32, 32), rand_crop=True,
+                                rand_mirror=True, mean=True, std=True,
+                                brightness=0.1)
+    out = img
+    for a in augs:
+        out = a(out)
+    assert out.shape == (32, 32, 3)
+    assert out.dtype == np.float32
+
+
+def test_image_iter(tmp_path):
+    paths = []
+    for i in range(6):
+        p = str(tmp_path / f"img{i}.png")
+        _save_img(p, seed=i)
+        paths.append([float(i % 3), f"img{i}.png"])
+    it = mimg.ImageIter(batch_size=2, data_shape=(3, 24, 24),
+                        path_root=str(tmp_path), imglist=paths,
+                        aug_list=mimg.CreateAugmenter((3, 24, 24)))
+    batches = list(it)
+    assert len(batches) == 3
+    assert batches[0].data[0].shape == (2, 3, 24, 24)
+    assert batches[0].label[0].shape == (2,)
+    assert len(list(it)) == 3   # reset works

@@ -1,0 +1,165 @@
+"""Long-tail op tests: linalg family, spatial warping, control flow.
+(reference models: tests/python/unittest/test_operator.py la_op/
+spatial coverage + control-flow op tests)."""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+
+
+def test_linalg_gemm_and_syrk():
+    rng = np.random.default_rng(0)
+    a = rng.standard_normal((3, 4)).astype(np.float32)
+    b = rng.standard_normal((4, 5)).astype(np.float32)
+    c = rng.standard_normal((3, 5)).astype(np.float32)
+    out = nd.linalg_gemm(nd.array(a), nd.array(b), nd.array(c),
+                         alpha=2.0, beta=0.5)
+    np.testing.assert_allclose(out.asnumpy(), 2 * a @ b + 0.5 * c,
+                               rtol=1e-5)
+    s = nd.linalg_syrk(nd.array(a), alpha=1.0).asnumpy()
+    np.testing.assert_allclose(s, a @ a.T, rtol=1e-5)
+
+
+def test_linalg_potrf_trsm_potri_roundtrip():
+    rng = np.random.default_rng(1)
+    m = rng.standard_normal((4, 4)).astype(np.float32)
+    a = m @ m.T + 4 * np.eye(4, dtype=np.float32)
+    l = nd.linalg_potrf(nd.array(a))
+    np.testing.assert_allclose((l.asnumpy() @ l.asnumpy().T), a,
+                               rtol=1e-4, atol=1e-4)
+    # trsm: solve L x = b
+    b = rng.standard_normal((4, 2)).astype(np.float32)
+    x = nd.linalg_trsm(l, nd.array(b))
+    np.testing.assert_allclose(l.asnumpy() @ x.asnumpy(), b, rtol=1e-4,
+                               atol=1e-4)
+    ainv = nd.linalg_potri(l).asnumpy()
+    np.testing.assert_allclose(ainv @ a, np.eye(4), atol=1e-3)
+    # sumlogdiag consistency with slogdet
+    sld = nd.linalg_sumlogdiag(l).asnumpy()
+    _, logdet = np.linalg.slogdet(a)
+    np.testing.assert_allclose(2 * sld, logdet, rtol=1e-4)
+
+
+def test_linalg_gelqf_det_inverse():
+    rng = np.random.default_rng(2)
+    a = rng.standard_normal((3, 5)).astype(np.float32)
+    q, l = nd.linalg_gelqf(nd.array(a))
+    np.testing.assert_allclose(l.asnumpy() @ q.asnumpy(), a, rtol=1e-4,
+                               atol=1e-4)
+    np.testing.assert_allclose((q.asnumpy() @ q.asnumpy().T), np.eye(3),
+                               atol=1e-4)
+    sq = rng.standard_normal((3, 3)).astype(np.float32) + 2 * np.eye(3)
+    np.testing.assert_allclose(nd.linalg_det(nd.array(sq)).asnumpy(),
+                               np.linalg.det(sq), rtol=1e-4)
+    np.testing.assert_allclose(nd.linalg_inverse(nd.array(sq)).asnumpy(),
+                               np.linalg.inv(sq), rtol=1e-3, atol=1e-3)
+
+
+def test_linalg_diag_trian_roundtrip():
+    a = np.arange(9, dtype=np.float32).reshape(3, 3)
+    d = nd.linalg_extractdiag(nd.array(a))
+    np.testing.assert_allclose(d.asnumpy(), [0, 4, 8])
+    back = nd.linalg_makediag(d).asnumpy()
+    np.testing.assert_allclose(back, np.diag([0.0, 4.0, 8.0]))
+    tri = nd.linalg_extracttrian(nd.array(a)).asnumpy()
+    np.testing.assert_allclose(tri, [0, 3, 4, 6, 7, 8])
+    np.testing.assert_allclose(nd.linalg_maketrian(
+        nd.array(tri)).asnumpy(), np.tril(a))
+
+
+def test_khatri_rao():
+    a = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+    b = np.array([[1.0, 0.0], [0.0, 1.0], [1.0, 1.0]], np.float32)
+    out = nd.khatri_rao(nd.array(a), nd.array(b)).asnumpy()
+    assert out.shape == (6, 2)
+    np.testing.assert_allclose(out[:, 0], np.kron(a[:, 0], b[:, 0]))
+
+
+def test_grid_generator_and_bilinear_sampler_identity():
+    # identity affine: theta = [1,0,0, 0,1,0] must reproduce the input
+    img = np.random.rand(2, 3, 5, 7).astype(np.float32)
+    theta = np.tile(np.array([1, 0, 0, 0, 1, 0], np.float32), (2, 1))
+    grid = nd.GridGenerator(nd.array(theta), transform_type="affine",
+                            target_shape=(5, 7))
+    assert grid.shape == (2, 2, 5, 7)
+    out = nd.BilinearSampler(nd.array(img), grid)
+    np.testing.assert_allclose(out.asnumpy(), img, rtol=1e-4, atol=1e-4)
+
+
+def test_spatial_transformer_shift():
+    # x-shift by one pixel: out[..., j] == img[..., j+1]
+    img = np.random.rand(1, 1, 4, 6).astype(np.float32)
+    shift = 2.0 / (6 - 1)   # one pixel in normalized coords
+    theta = np.array([[1, 0, shift, 0, 1, 0]], np.float32)
+    out = nd.SpatialTransformer(nd.array(img), nd.array(theta),
+                                target_shape=(4, 6),
+                                transform_type="affine",
+                                sampler_type="bilinear").asnumpy()
+    np.testing.assert_allclose(out[0, 0, :, :-1], img[0, 0, :, 1:],
+                               rtol=1e-4, atol=1e-4)
+
+
+def test_contrib_foreach_scan():
+    def step(x, states):
+        s = states[0]
+        new_s = s + x
+        return new_s * 2.0, [new_s]
+
+    data = nd.array(np.arange(4, dtype=np.float32))
+    outs, final = nd.contrib.foreach(step, data, [nd.zeros(())])
+    np.testing.assert_allclose(final[0].asnumpy(), 6.0)   # 0+1+2+3
+    np.testing.assert_allclose(outs.asnumpy(), [0, 2, 6, 12])
+
+
+def test_contrib_while_loop():
+    # sum integers until total >= 10
+    def cond_fn(i, total):
+        return total < 10.0
+
+    def body_fn(i, total):
+        new_total = total + i
+        return (new_total, (i + 1.0, new_total))
+
+    outs, (i, total) = nd.contrib.while_loop(
+        cond_fn, body_fn, (nd.ones(()), nd.zeros(())), max_iterations=16)
+    assert float(total.asnumpy()) == 10.0   # 1+2+3+4
+    assert float(i.asnumpy()) == 5.0
+
+def test_contrib_cond():
+    x = nd.array([3.0])
+    out = nd.contrib.cond((x.sum() > 2.0),
+                          lambda: x * 10.0, lambda: x - 1.0)
+    np.testing.assert_allclose(out.asnumpy(), [30.0])
+    out2 = nd.contrib.cond((x.sum() > 5.0),
+                           lambda: x * 10.0, lambda: x - 1.0)
+    np.testing.assert_allclose(out2.asnumpy(), [2.0])
+
+
+def test_batch_take_and_ravel():
+    a = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    idx = nd.array(np.array([0, 2, 3], np.float32))
+    out = nd.batch_take(a, idx).asnumpy()
+    np.testing.assert_allclose(out, [0, 6, 11])
+    flat = nd.ravel_multi_index(
+        nd.array(np.array([[1, 2], [2, 3]], np.float32)), shape=(3, 4))
+    np.testing.assert_allclose(flat.asnumpy(), [6, 11])
+    unr = nd.unravel_index(nd.array(np.array([6, 11], np.float32)),
+                           shape=(3, 4)).asnumpy()
+    np.testing.assert_allclose(unr, [[1, 2], [2, 3]])
+
+
+def test_while_loop_body_not_run_when_cond_false():
+    """The shape probe must not execute the body eagerly (review
+    regression): an initially-false cond runs func zero times."""
+    calls = {"n": 0}
+
+    def body_fn(i):
+        calls["n"] += 1          # traced once for shapes, never executed
+        return (i * 2.0, (i + 1.0,))
+
+    outs, (i,) = nd.contrib.while_loop(
+        lambda i: i < 0.0, body_fn, (nd.ones(()),), max_iterations=4)
+    assert float(i.asnumpy()) == 1.0      # unchanged
+    # tracing may call the python fn, but no iteration output is produced
+    np.testing.assert_allclose(outs.asnumpy(), np.zeros(4))
