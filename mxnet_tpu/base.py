@@ -40,6 +40,47 @@ class MXNetError(RuntimeError):
     """
 
 
+def force_cpu_mesh(n_devices: int) -> None:
+    """Force jax onto a virtual ``n_devices``-device CPU mesh.
+
+    Must run before the first jax backend query.  Two steps are required
+    (this image's sitecustomize registers the axon TPU backend at
+    interpreter boot and forces the platform, so ``JAX_PLATFORMS=cpu`` in
+    the shell environment is ignored):
+
+    1. ``XLA_FLAGS --xla_force_host_platform_device_count=n`` — rewritten
+       in place if a different count is already present and the backend is
+       not yet initialized.
+    2. ``jax.config.update("jax_platforms", "cpu")`` — the counter-override
+       that beats sitecustomize.
+
+    Used by ``tests/conftest.py`` and ``__graft_entry__.dryrun_multichip``.
+    """
+    import re
+
+    flag = f"--xla_force_host_platform_device_count={n_devices}"
+    flags = os.environ.get("XLA_FLAGS", "")
+    flags, n_sub = re.subn(
+        r"--xla_force_host_platform_device_count[= ]\S+", flag, flags)
+    if not n_sub:
+        flags = (flags + " " + flag).strip()
+    os.environ["XLA_FLAGS"] = flags
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    devs = jax.devices()
+    if devs[0].platform != "cpu":
+        raise MXNetError(
+            f"force_cpu_mesh: platform is {devs[0].platform!r}, not cpu — "
+            "a jax backend was already initialized before this call")
+    if len(devs) < n_devices:
+        raise MXNetError(
+            f"force_cpu_mesh: requested {n_devices} devices but only "
+            f"{len(devs)} are visible — XLA_FLAGS was read before it could "
+            "be rewritten (jax backend initialized too early)")
+
+
 # ---------------------------------------------------------------------------
 # Environment-variable config registry (reference: ~100 MXNET_* vars read via
 # dmlc::GetEnv, documented in docs/faq/env_var.md — SURVEY.md §5.6).

@@ -12,18 +12,11 @@ the first backend query.  XLA_FLAGS must also be set before backend init.
 import os
 import sys
 
-prev = os.environ.get("XLA_FLAGS", "")
-if "host_platform_device_count" not in prev:
-    os.environ["XLA_FLAGS"] = (
-        prev + " --xla_force_host_platform_device_count=8").strip()
-
-import jax  # noqa: E402
-
-jax.config.update("jax_platforms", "cpu")
-assert jax.devices()[0].platform == "cpu", "tests must run on the CPU mesh"
-assert len(jax.devices()) == 8, "tests expect 8 virtual CPU devices"
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from mxnet_tpu.base import force_cpu_mesh  # noqa: E402
+
+force_cpu_mesh(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
