@@ -40,7 +40,7 @@ class MXNetError(RuntimeError):
     """
 
 
-def force_cpu_mesh(n_devices: int) -> None:
+def force_cpu_mesh(n_devices: int, verify: bool = True) -> None:
     """Force jax onto a virtual ``n_devices``-device CPU mesh.
 
     Must run before the first jax backend query.  Two steps are required
@@ -69,6 +69,10 @@ def force_cpu_mesh(n_devices: int) -> None:
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    if not verify:
+        # caller must do something that must precede the first backend
+        # query (e.g. jax.distributed.initialize) — skip the device check
+        return
     devs = jax.devices()
     if devs[0].platform != "cpu":
         raise MXNetError(

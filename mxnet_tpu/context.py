@@ -87,10 +87,13 @@ _ACCEL_PLATFORMS = ("tpu", "axon", "gpu", "cuda", "rocm")
 
 
 def _platform_devices(kinds) -> List:
+    # process-LOCAL devices: under multi-process JAX (dist_sync), a Context
+    # must never resolve to another process's device — an array placed
+    # there would be non-addressable here
     jax = _jax()
     for kind in kinds:
         try:
-            devs = jax.devices(kind)
+            devs = jax.local_devices(backend=kind)
             if devs:
                 return devs
         except RuntimeError:
@@ -103,12 +106,12 @@ def _resolve_device(device_type: str, device_id: int):
     if device_type in ("cpu", "cpu_pinned", "cpu_shared"):
         devs = _platform_devices(("cpu",))
         if not devs:
-            devs = jax.devices()  # single-platform accelerator build: CPU ctx
+            devs = jax.local_devices()  # accelerator build: CPU ctx
             # falls through to the default platform; XLA handles host staging.
     elif device_type == "tpu":
-        devs = _platform_devices(("tpu", "axon")) or jax.devices()
+        devs = _platform_devices(("tpu", "axon")) or jax.local_devices()
     else:  # gpu == "the accelerator" so reference scripts run unchanged
-        devs = _platform_devices(_ACCEL_PLATFORMS) or jax.devices()
+        devs = _platform_devices(_ACCEL_PLATFORMS) or jax.local_devices()
     if not devs:
         raise MXNetError(f"no devices for context {device_type}({device_id})")
     return devs[device_id % len(devs)]

@@ -98,16 +98,22 @@ class Engine:
 
     def wait_all(self) -> None:
         """Block until all outstanding computation completes
-        (reference: Engine::WaitForAll / MXNDArrayWaitAll)."""
+        (reference: Engine::WaitForAll / MXNDArrayWaitAll).
+
+        Runtime errors raised by async computation surface HERE, exactly
+        as in the reference engine.  Only errors that mean "this buffer no
+        longer exists" (deleted/donated while we iterate the live list —
+        an expected race) are suppressed.
+        """
         import jax
-        try:
-            for arr in jax.live_arrays():
-                try:
-                    arr.block_until_ready()
-                except Exception:  # deleted/donated buffers
-                    pass
-        except Exception:
-            pass
+        for arr in jax.live_arrays():
+            try:
+                arr.block_until_ready()
+            except (RuntimeError, ValueError) as e:
+                msg = str(e).lower()
+                if "deleted" in msg or "donated" in msg:
+                    continue  # buffer went away mid-iteration: not an error
+                raise
 
 
 def engine() -> Engine:

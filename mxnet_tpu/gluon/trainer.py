@@ -39,7 +39,13 @@ class Trainer:
         self._updaters: Dict = {}
         self._kvstore = kv_mod.create(kvstore) if isinstance(kvstore, str) \
             else kvstore
+        if compression_params is not None and self._kvstore is not None:
+            self._kvstore.set_gradient_compression(compression_params)
         self._kv_initialized = False
+        # server-side updates are the dist default (reference behavior);
+        # in-process reduction keeps the fused local update path
+        self._update_on_kvstore = update_on_kvstore
+        self._dist_kv = False
         self._states: Dict = {}
 
     # -- properties --------------------------------------------------------
@@ -55,22 +61,85 @@ class Trainer:
         self._optimizer.set_learning_rate(lr)
 
     # -- step --------------------------------------------------------------
+    def _init_kvstore(self) -> None:
+        """Decide the update path and register params with a dist kvstore.
+
+        Reference parity: Trainer._init_kvstore — with a dist kvstore the
+        optimizer runs server-side (update_on_kvstore default True); the
+        in-process case keeps the local fused-update path (the real
+        multi-device reduce rides mxnet_tpu.parallel's in-graph psum).
+        """
+        if self._kv_initialized:
+            return
+        kv = self._kvstore
+        self._dist_kv = kv is not None and getattr(kv, "_dist", False)
+        if self._update_on_kvstore is None:
+            self._update_on_kvstore = self._dist_kv
+        if self._update_on_kvstore and kv is None:
+            raise MXNetError("update_on_kvstore=True requires a kvstore")
+        if self._update_on_kvstore:
+            kv.set_optimizer(self._optimizer)
+            kv.init(list(range(len(self._params))),
+                    [p.list_data()[0] for p in self._params])
+        elif self._dist_kv:
+            # grads-only reduction through the store: no server optimizer,
+            # push/pull sums gradients, the update stays local
+            from .. import ndarray as _nd
+            kv.init(list(range(len(self._params))),
+                    [_nd.zeros_like(p.list_data()[0])
+                     for p in self._params])
+        self._kv_initialized = True
+
+    def _stale(self, param) -> bool:
+        """True if param's write-mode grad was untouched since last step."""
+        return any(d._ag is not None and d._ag.grad_req == "write"
+                   and d._ag.fresh for d in param._data.values())
+
     def step(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         """Rescale by 1/batch_size, reduce grads across devices, update."""
         self._optimizer.rescale_grad = self._scale / batch_size
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            for i, param in enumerate(self._params):
+                if self._stale(param):
+                    if not ignore_stale_grad:
+                        raise MXNetError(
+                            f"gradient of Parameter {param.name!r} has not "
+                            f"been updated by backward since the last step; "
+                            f"set ignore_stale_grad=True to skip such "
+                            f"parameters")
+                    continue
+                grads = param.list_grad()
+                self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
+                self._kvstore.pull(i, out=param.list_data())
+                for data in param._data.values():
+                    if data._ag is not None:
+                        data._ag.fresh = True  # reset staleness tracking
+            return
         self.allreduce_grads()
         self.update(batch_size, ignore_stale_grad)
 
     def allreduce_grads(self) -> None:
+        """Sum gradients across device replicas and (dist) across workers."""
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            raise MXNetError(
+                "allreduce_grads is not applicable when the optimizer runs "
+                "on the kvstore (update_on_kvstore=True)")
         for i, param in enumerate(self._params):
             grads = param.list_grad()
-            if len(grads) == 1:
-                continue
-            reduced = grads[0].copy()
-            for g in grads[1:]:
-                reduced += g.as_in_context(reduced.context)
-            for g in grads:
-                reduced.copyto(g)
+            if len(grads) > 1:
+                reduced = grads[0].copy()
+                for g in grads[1:]:
+                    reduced += g.as_in_context(reduced.context)
+                for g in grads:
+                    reduced.copyto(g)
+            if self._dist_kv:
+                # cross-worker gradient sum through the store (no server
+                # optimizer in this mode; the local fused update applies it)
+                self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
+                self._kvstore.pull(i, out=grads if len(grads) > 1
+                                   else grads[0])
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -102,6 +171,10 @@ class Trainer:
 
     # -- state persistence -------------------------------------------------
     def save_states(self, fname: str) -> None:
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.save_optimizer_states(fname, dump_optimizer=True)
+            return
         import pickle
         import numpy as _np
         blob = {}
@@ -114,6 +187,10 @@ class Trainer:
                              dict(self._optimizer._index_update_count)}, f)
 
     def load_states(self, fname: str) -> None:
+        self._init_kvstore()
+        if self._update_on_kvstore:
+            self._kvstore.load_optimizer_states(fname)
+            return
         import pickle
         with open(fname, "rb") as f:
             blob = pickle.load(f)

@@ -40,6 +40,13 @@ class KVStore:
         self._store: Dict[Any, NDArray] = {}
         self._updater = None
         self._optimizer = None
+        self._compression = None
+        self._compression_residual: Dict[Any, Any] = {}
+
+    @property
+    def _dist(self) -> bool:
+        """True when push/pull must cross process boundaries."""
+        return self._type.startswith("dist") and self.num_workers > 1
 
     # -- identity ----------------------------------------------------------
     @property
@@ -61,13 +68,24 @@ class KVStore:
         keys, values = _pair(key, value)
         for k, v in zip(keys, values):
             vv = v[0] if isinstance(v, (list, tuple)) else v
-            self._store[k] = vv.copy()
+            if self._dist:
+                # reference: only rank 0's init value counts; broadcast it
+                # so every process starts from identical weights
+                from .parallel import dist as _dist
+                from . import ndarray as _nd
+                self._store[k] = _nd.array(
+                    _dist.broadcast_host(vv.asnumpy()), ctx=vv.context,
+                    dtype=vv.dtype)
+            else:
+                self._store[k] = vv.copy()
 
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = _pair(key, value)
         for k, v in zip(keys, values):
             vlist = list(v) if isinstance(v, (list, tuple)) else [v]
             reduced = _reduce(vlist)
+            if self._dist:
+                reduced = self._allreduce_across_workers(k, reduced)
             if k not in self._store:
                 self._store[k] = reduced.copy()
                 continue
@@ -77,6 +95,35 @@ class KVStore:
             else:
                 # default updater is assign (reference KVStoreLocal behavior)
                 self._store[k] = reduced
+
+    def _allreduce_across_workers(self, k, reduced: NDArray) -> NDArray:
+        """Sum this process's reduced gradient across all workers (DCN
+        path; reference: ps-lite push to sharded servers)."""
+        import numpy as np
+        from . import ndarray as _nd
+        from .parallel import dist as _dist
+        g = reduced.asnumpy()
+        if self._compression is not None:
+            # 2-bit stochastic-sign compression with error feedback
+            # (reference: src/kvstore/gradient_compression.cc semantics:
+            # each worker quantizes grad+residual to {-thr, 0, +thr},
+            # residual keeps the quantization error, servers sum the
+            # quantized values). Codes really cross the wire 2-bit packed.
+            thr = float(self._compression["threshold"])
+            resid = self._compression_residual.get(k)
+            acc = g if resid is None else g + resid
+            codes = np.zeros(acc.shape, np.uint8)
+            codes[acc >= thr] = 1
+            codes[acc <= -thr] = 2
+            q = np.where(codes == 1, thr,
+                         np.where(codes == 2, -thr, 0)).astype(g.dtype)
+            self._compression_residual[k] = acc - q
+            all_codes = _dist.allgather_host(_pack2bit(codes.ravel()))
+            signed = sum(_unpack2bit(c, g.size) for c in all_codes)
+            g = (signed.astype(acc.dtype) * thr).reshape(acc.shape)
+        else:
+            g = _dist.allreduce_host(g)
+        return _nd.array(g, ctx=reduced.context, dtype=reduced.dtype)
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True):
@@ -102,9 +149,42 @@ class KVStore:
         self.push(key, value, priority)
         return self.pull(key, out if out is not None else value, priority)
 
-    def row_sparse_pull(self, *a, **kw):
-        raise MXNetError("sparse storage is not supported on TPU (dense "
-                         "embeddings ride the MXU instead)")
+    def row_sparse_pull(self, key, out=None, priority: int = 0,
+                        row_ids=None):
+        """Pull only the rows named by ``row_ids`` as RowSparseNDArrays.
+
+        Reference parity: KVStoreLocal::PullRowSparse — the dense stored
+        weight is sliced to the requested rows (sparse.retain semantics)
+        so embedding-style pulls move only live rows.
+        """
+        from .sparse import RowSparseNDArray
+        if out is None or row_ids is None:
+            raise MXNetError("row_sparse_pull requires out= and row_ids=")
+        keys, outs = _pair(key, out)
+        per_key = (isinstance(key, (list, tuple)) and
+                   isinstance(row_ids, (list, tuple)))
+        rids = list(row_ids) if per_key else [row_ids] * len(keys)
+        import numpy as np
+        from . import ndarray as _nd
+        for k, o, rid in zip(keys, outs, rids):
+            if k not in self._store:
+                raise MXNetError(f"key {k!r} not initialized in kvstore")
+            dense = self._store[k]
+            ids = np.unique(np.asarray(
+                rid.asnumpy() if hasattr(rid, "asnumpy") else rid,
+                np.int64))
+            # gather the live rows ON DEVICE; only the slice crosses to host
+            rows = _nd.take(dense, _nd.array(ids, ctx=dense.context,
+                                             dtype="int64"), axis=0)
+            rs = RowSparseNDArray(rows.asnumpy(), ids, dense.shape,
+                                  ctx=dense.context)
+            olist = list(o) if isinstance(o, (list, tuple)) else [o]
+            for tgt in olist:
+                if isinstance(tgt, RowSparseNDArray):
+                    tgt.data, tgt.indices = rs.data, rs.indices
+                else:
+                    rs.todense().copyto(tgt)
+        return out
 
     # -- optimizer plane ---------------------------------------------------
     def set_optimizer(self, optimizer) -> None:
@@ -116,9 +196,27 @@ class KVStore:
         self._updater = updater
 
     def set_gradient_compression(self, compression_params) -> None:
-        # reference: 2-bit compression for the DCN-bound PS path; XLA
-        # collectives over ICI make this a no-op here (documented gap)
-        pass
+        """Enable 2-bit gradient compression with error feedback on the
+        cross-process push path (reference:
+        src/kvstore/gradient_compression.cc; SURVEY.md §2.3).
+
+        Only meaningful for dist types — in-process reduction rides XLA
+        collectives over ICI where compression would cost more than it
+        saves, so it raises there (never a silent no-op).
+        """
+        params = dict(compression_params or {})
+        ctype = params.get("type", "2bit")
+        if ctype != "2bit":
+            raise MXNetError(f"unsupported compression type {ctype!r}; "
+                             "only '2bit' exists (reference parity)")
+        if not self._type.startswith("dist"):
+            raise MXNetError(
+                "gradient compression applies to the DCN-bound dist_* "
+                "kvstores only; in-process reduction is uncompressed over "
+                "ICI by design")
+        self._compression = {"type": "2bit",
+                             "threshold": float(params.get("threshold", .5))}
+        self._compression_residual.clear()
 
     def save_optimizer_states(self, fname: str, dump_optimizer=False) -> None:
         if self._updater is None:
@@ -135,6 +233,9 @@ class KVStore:
     def barrier(self) -> None:
         from .engine import wait_all
         wait_all()
+        if self._dist:
+            from .parallel import dist as _dist
+            _dist.barrier()
 
     def __repr__(self):
         return f"KVStore(type={self._type}, keys={len(self._store)})"
@@ -167,4 +268,33 @@ def create(name: str = "local") -> KVStore:
         raise MXNetError(
             "dist_async (stale parameter-server updates) is unsupported by "
             "design on TPU; use dist_sync (synchronous SPMD over the mesh)")
+    if name.startswith("dist"):
+        # join the multi-process runtime now (reference: ps-lite bootstrap
+        # from DMLC_* env at kvstore creation); raises with guidance when
+        # neither env nor an explicit init_process_group() happened, so
+        # dist_sync can never silently run process-local
+        from .parallel import dist as _dist
+        _dist.init_process_group()
     return KVStore(name)
+
+
+def _pack2bit(codes):
+    """Pack an array of 2-bit codes {0,1,2} into bytes, 4 per byte."""
+    import numpy as np
+    codes = np.asarray(codes, np.uint8)
+    pad = (-codes.size) % 4
+    if pad:
+        codes = np.concatenate([codes, np.zeros(pad, np.uint8)])
+    c = codes.reshape(-1, 4)
+    return (c[:, 0] | (c[:, 1] << 2) | (c[:, 2] << 4) |
+            (c[:, 3] << 6)).astype(np.uint8)
+
+
+def _unpack2bit(packed, n):
+    """Unpack to a signed {-1,0,+1} int32 array of length n
+    (code 1 → +1, code 2 → -1)."""
+    import numpy as np
+    p = np.asarray(packed, np.uint8)
+    c = np.stack([p & 3, (p >> 2) & 3, (p >> 4) & 3, (p >> 6) & 3],
+                 axis=1).ravel()[:n]
+    return np.where(c == 1, 1, np.where(c == 2, -1, 0)).astype(np.int32)

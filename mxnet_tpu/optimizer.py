@@ -427,14 +427,29 @@ class Updater:
                                               self.states[index])
 
     def get_states(self, dump_optimizer=False):
+        """Serialize updater state; with dump_optimizer also the update
+        counters (num_update / per-index counts) so time-dependent
+        optimizers (Adam bias correction, lr schedules) resume correctly."""
         import pickle
-        return pickle.dumps({k: _states_to_np(v)
-                             for k, v in self.states.items()})
+        blob = {"states": {k: _states_to_np(v)
+                           for k, v in self.states.items()}}
+        if dump_optimizer:
+            blob["num_update"] = self.optimizer.num_update
+            blob["index_update_count"] = \
+                dict(self.optimizer._index_update_count)
+        return pickle.dumps(blob)
 
     def set_states(self, states) -> None:
         import pickle
         loaded = pickle.loads(states)
-        self.states = {k: _states_from_np(v) for k, v in loaded.items()}
+        if "states" not in loaded:  # legacy flat format
+            loaded = {"states": loaded}
+        self.states = {k: _states_from_np(v)
+                       for k, v in loaded["states"].items()}
+        if "num_update" in loaded:
+            self.optimizer.num_update = loaded["num_update"]
+            self.optimizer._index_update_count = dict(
+                loaded["index_update_count"])
 
 
 def _states_to_np(state):

@@ -10,7 +10,9 @@ from .mesh import (MESH_AXES, ShardingRules, default_mesh, make_mesh,
 from .optim import FunctionalOptimizer, make_functional_optimizer
 from .ring import ring_attention
 from .trainer import ShardedTrainer
+from . import dist
 
 __all__ = ["MESH_AXES", "ShardingRules", "default_mesh", "make_mesh",
            "replicated", "shard", "FunctionalOptimizer",
-           "make_functional_optimizer", "ring_attention", "ShardedTrainer"]
+           "make_functional_optimizer", "ring_attention", "ShardedTrainer",
+           "dist"]

@@ -1,0 +1,120 @@
+"""Multi-process distributed runtime (the DCN story).
+
+Reference parity: ps-lite's process bootstrap — workers/servers wired up
+from ``DMLC_*`` environment variables set by the launcher (SURVEY.md §2.3
+ps-lite row, §5.8).  TPU-native replacement: no parameter server; all
+processes join one JAX coordination service (`jax.distributed.initialize`)
+and gradient reduction rides XLA collectives / host allgather over DCN.
+
+The same launcher env-var names are honored so reference launch scripts
+carry over:
+
+- ``DMLC_PS_ROOT_URI`` / ``DMLC_PS_ROOT_PORT`` — coordinator address
+  (reference: the ps-lite scheduler address).
+- ``DMLC_NUM_WORKER`` — total number of worker processes.
+- ``DMLC_WORKER_ID`` — this process's rank (assigned by the launcher).
+
+``dist_async`` has no analog here by design: synchronous SPMD replaces
+stale parameter-server updates (SURVEY.md §5.8).
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional
+
+from ..base import MXNetError
+
+__all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
+           "allreduce_host", "allgather_host", "broadcast_host", "barrier"]
+
+
+def is_initialized() -> bool:
+    """True if this process has joined a multi-process JAX runtime."""
+    try:
+        from jax._src import distributed
+        return distributed.global_state.client is not None
+    except Exception:
+        # no backend-initializing fallback here: this runs before
+        # jax.distributed.initialize, which must precede the first backend
+        # query — assume uninitialized
+        return False
+
+
+def init_process_group(coordinator: Optional[str] = None,
+                       num_processes: Optional[int] = None,
+                       process_id: Optional[int] = None) -> None:
+    """Join the multi-process runtime (idempotent).
+
+    Arguments default to the reference's launcher env vars
+    (``DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``,
+    ``DMLC_WORKER_ID``).  Raises if neither arguments nor env are present.
+    """
+    if is_initialized():
+        return
+    if coordinator is None:
+        uri = os.environ.get("DMLC_PS_ROOT_URI")
+        port = os.environ.get("DMLC_PS_ROOT_PORT", "9099")
+        coordinator = f"{uri}:{port}" if uri else None
+    if num_processes is None:
+        nw = os.environ.get("DMLC_NUM_WORKER")
+        num_processes = int(nw) if nw else None
+    if process_id is None:
+        wid = os.environ.get("DMLC_WORKER_ID")
+        process_id = int(wid) if wid else None
+    if num_processes == 1:
+        return  # single worker: nothing to join
+    if coordinator is None or num_processes is None or process_id is None:
+        raise MXNetError(
+            "multi-process kvstore requires the process group to be "
+            "initialized: set DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/"
+            "DMLC_NUM_WORKER/DMLC_WORKER_ID (reference launcher env vars) "
+            "or call mxnet_tpu.parallel.dist.init_process_group("
+            "coordinator, num_processes, process_id) before "
+            "kv.create('dist_sync')")
+    import jax
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=num_processes,
+        process_id=process_id)
+
+
+def rank() -> int:
+    import jax
+    return jax.process_index()
+
+
+def num_workers() -> int:
+    import jax
+    return jax.process_count()
+
+
+def allreduce_host(x):
+    """Sum a host-local numpy array across all processes.
+
+    DCN-path reduction for the kvstore object plane (the compiled trainer
+    path uses in-graph psum over the device mesh instead).
+    """
+    import numpy as np
+    from jax.experimental import multihost_utils
+    gathered = multihost_utils.process_allgather(np.asarray(x))
+    return np.sum(gathered, axis=0)
+
+
+def allgather_host(x):
+    """Gather each process's host-local numpy array; returns an array with
+    a leading num_workers axis (this process's slot included)."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+
+
+def broadcast_host(x):
+    """Broadcast rank 0's host-local numpy array to all processes."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    return np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x)))
+
+
+def barrier(name: str = "mxnet_tpu_barrier") -> None:
+    from jax.experimental import multihost_utils
+    multihost_utils.sync_global_devices(name)
