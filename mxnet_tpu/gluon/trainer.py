@@ -40,7 +40,13 @@ class Trainer:
         self._kvstore = kv_mod.create(kvstore) if isinstance(kvstore, str) \
             else kvstore
         if compression_params is not None and self._kvstore is not None:
-            self._kvstore.set_gradient_compression(compression_params)
+            if getattr(self._kvstore, "_dist", False):
+                self._kvstore.set_gradient_compression(compression_params)
+            else:
+                import warnings
+                warnings.warn(
+                    "gradient compression applies to dist_* kvstores only; "
+                    "ignored for in-process reduction (ICI collectives)")
         self._kv_initialized = False
         # server-side updates are the dist default (reference behavior);
         # in-process reduction keeps the fused local update path
@@ -83,11 +89,13 @@ class Trainer:
                     [p.list_data()[0] for p in self._params])
         elif self._dist_kv:
             # grads-only reduction through the store: no server optimizer,
-            # push/pull sums gradients, the update stays local
-            from .. import ndarray as _nd
+            # push/pull sums gradients, the update stays local.  init
+            # broadcasts rank 0's weights; pull them back so every worker
+            # starts from identical parameters (reference behavior)
             kv.init(list(range(len(self._params))),
-                    [_nd.zeros_like(p.list_data()[0])
-                     for p in self._params])
+                    [p.list_data()[0] for p in self._params])
+            for i, p in enumerate(self._params):
+                kv.pull(i, out=p.list_data())
         self._kv_initialized = True
 
     def _stale(self, param) -> bool:
@@ -136,13 +144,27 @@ class Trainer:
                     reduced.copyto(g)
             if self._dist_kv:
                 # cross-worker gradient sum through the store (no server
-                # optimizer in this mode; the local fused update applies it)
-                self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
+                # optimizer in this mode; the local fused update applies
+                # it).  Local replicas were already reduced above — push
+                # ONE copy, pull the global sum back into every replica.
+                self._kvstore.push(i, grads[0])
                 self._kvstore.pull(i, out=grads if len(grads) > 1
                                    else grads[0])
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         self._optimizer.rescale_grad = self._scale / batch_size
+        agg = getattr(self._optimizer, "aggregate_num", 0)
+        use_multi = agg > 1 and hasattr(self._optimizer, "update_multi")
+        group: List = []   # pending (index, data, grad, state) tuples
+
+        def flush():
+            if not group:
+                return
+            idx, datas, grads, sts = zip(*group)
+            self._optimizer.update_multi(list(idx), list(datas),
+                                         list(grads), list(sts))
+            group.clear()
+
         for i, param in enumerate(self._params):
             for ctx, data in param._data.items():
                 # reference parity: a 'write'-mode grad untouched by backward
@@ -161,10 +183,16 @@ class Trainer:
                 if key not in self._states:
                     self._states[key] = \
                         self._optimizer.create_state_multi_precision(i, data)
-                self._optimizer.update_multi_precision(
-                    i, data, data.grad, self._states[key])
+                if use_multi and len(param._data) == 1:
+                    group.append((i, data, data.grad, self._states[key]))
+                    if len(group) >= agg:
+                        flush()
+                else:
+                    self._optimizer.update_multi_precision(
+                        i, data, data.grad, self._states[key])
                 # reset write-mode gradient accumulation for the next batch
                 data._ag.fresh = True
+        flush()
 
     def allreduce_and_update(self, batch_size):
         self.step(batch_size)

@@ -1,0 +1,12 @@
+"""In-tree Pallas TPU kernels (the ◆ native-hardening mandate, SURVEY.md
+§7 M9).
+
+Where the reference ships hand-written CUDA kernels (src/operator/*.cu),
+the hot paths here that XLA fusion does not already win get hand-written
+Pallas kernels compiled by Mosaic for the TPU's VPU/MXU.  Every kernel
+also runs under the Pallas interpreter so the CPU test mesh exercises the
+same code path.
+"""
+from .multi_sgd import fused_multi_sgd, fused_multi_sgd_mom
+
+__all__ = ["fused_multi_sgd", "fused_multi_sgd_mom"]

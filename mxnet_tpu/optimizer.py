@@ -69,6 +69,10 @@ class Optimizer:
         self.idx2name = self.param_idx2name
         self.lr_mult: Dict[Any, float] = {}
         self.wd_mult: Dict[Any, float] = {}
+        # >1 enables multi-tensor apply in Trainer (reference:
+        # MXNET_OPTIMIZER_AGGREGATION_SIZE); only optimizers that
+        # implement update_multi (SGD) honor it
+        self.aggregate_num = 0
 
     # -- bookkeeping -------------------------------------------------------
     def _update_count(self, index) -> None:
@@ -166,6 +170,9 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
+        import os
+        self.aggregate_num = int(os.environ.get(
+            "MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:
@@ -226,6 +233,64 @@ class SGD(Optimizer):
                            out=[weight, mom, w32])
         else:
             self.update(index, weight, grad, state)
+
+    def update_multi(self, indices, weights, grads, states):
+        """Fused multi-tensor apply: ONE Pallas launch updates the whole
+        group (reference multi_sgd_update family; kernels/multi_sgd.py).
+
+        Falls back per-tensor for sparse grads, mixed dtypes, or shapes
+        the fused path cannot batch.
+        """
+        from .sparse import BaseSparseNDArray
+        dt = weights[0].dtype
+        mp = (self.multi_precision and isinstance(states[0], tuple) and
+              len(states[0]) == 2 and isinstance(states[0][1], NDArray))
+        fallback = (any(isinstance(g, BaseSparseNDArray) for g in grads)
+                    or any(w.dtype != dt for w in weights)
+                    or (mp and self.momentum == 0.0)
+                    or (mp and any(not isinstance(s, tuple)
+                                   for s in states)))
+        if fallback:
+            for i, w, g, s in zip(indices, weights, grads, states):
+                self.update_multi_precision(i, w, g, s)
+            return
+        for i in indices:
+            self._update_count(i)
+        ctx = weights[0].context
+        lrs = nd_array(_np.array([self._get_lr(i) for i in indices],
+                                 _np.float32), ctx=ctx)
+        wds = nd_array(_np.array([self._get_wd(i) for i in indices],
+                                 _np.float32), ctx=ctx)
+        kw: Dict[str, Any] = {"rescale_grad": self.rescale_grad,
+                              "num_weights": len(indices)}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        data: list = []
+        out: list = []
+        if mp:
+            kw["momentum"] = self.momentum
+            for w, g, s in zip(weights, grads, states):
+                mom, w32 = s
+                if mom is None:
+                    mom = nd_zeros(w32.shape, ctx=w32.context,
+                                   dtype=w32.dtype)
+                data.extend((w, g, mom, w32))
+                out.extend((w, mom, w32))
+            invoke_by_name("multi_mp_sgd_mom_update", data + [lrs, wds],
+                           kw, out=out)
+        elif self.momentum != 0.0:
+            kw["momentum"] = self.momentum
+            for w, g, s in zip(weights, grads, states):
+                data.extend((w, g, s))
+                out.extend((w, s))
+            invoke_by_name("multi_sgd_mom_update", data + [lrs, wds], kw,
+                           out=out)
+        else:
+            for w, g in zip(weights, grads):
+                data.extend((w, g))
+                out.append(w)
+            invoke_by_name("multi_sgd_update", data + [lrs, wds], kw,
+                           out=out)
 
 
 @register

@@ -1,0 +1,142 @@
+"""In-tree Pallas kernel tests (multi-tensor optimizer apply).
+
+Reference parity: src/operator/optimizer_op.cc multi_sgd_update family
+(SURVEY.md §2.2 optimizer_op row; §7 M9 native hardening).  On the CPU
+test mesh the kernels run under the Pallas interpreter — the same code
+Mosaic compiles on TPU.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon, nd
+
+
+SHAPES = [(3, 5), (1000,), (17, 9, 2), (1,), (128, 128)]
+
+
+def _rand_set(seed=0):
+    rng = np.random.default_rng(seed)
+    ws = [rng.standard_normal(s, dtype=np.float32) for s in SHAPES]
+    gs = [rng.standard_normal(s, dtype=np.float32) for s in SHAPES]
+    ms = [rng.standard_normal(s, dtype=np.float32) for s in SHAPES]
+    lrs = [0.1, 0.2, 0.3, 0.4, 0.05]
+    wds = [0.0, 0.01, 0.1, 0.0, 0.001]
+    return ws, gs, ms, lrs, wds
+
+
+def test_fused_multi_sgd_matches_formula():
+    from mxnet_tpu.kernels import fused_multi_sgd
+    ws, gs, _, lrs, wds = _rand_set()
+    outs = fused_multi_sgd(ws, gs, lrs, wds, rescale_grad=0.5,
+                           clip_gradient=1.0)
+    for w, g, lr, wd, o in zip(ws, gs, lrs, wds, outs):
+        expect = w - lr * (np.clip(g * 0.5, -1, 1) + wd * w)
+        assert o.shape == w.shape
+        assert np.allclose(np.asarray(o), expect, atol=1e-6)
+
+
+def test_fused_multi_sgd_mom_matches_formula():
+    from mxnet_tpu.kernels import fused_multi_sgd_mom
+    ws, gs, ms, lrs, wds = _rand_set(1)
+    wo, mo = fused_multi_sgd_mom(ws, gs, ms, lrs, wds, momentum=0.9)
+    for w, g, m, lr, wd, ow, om in zip(ws, gs, ms, lrs, wds, wo, mo):
+        mn = 0.9 * m - lr * (g + wd * w)
+        assert np.allclose(np.asarray(om), mn, atol=1e-6)
+        assert np.allclose(np.asarray(ow), w + mn, atol=1e-6)
+
+
+def test_multi_sgd_op_registry_dispatch():
+    """multi_sgd_update through the op registry with out= write-back."""
+    ws = [nd.array(np.full((4, 3), 2.0, np.float32)),
+          nd.array(np.full((7,), 3.0, np.float32))]
+    gs = [nd.array(np.ones((4, 3), np.float32)),
+          nd.array(np.ones((7,), np.float32))]
+    lrs = nd.array(np.array([0.5, 0.1], np.float32))
+    wds = nd.array(np.zeros(2, np.float32))
+    outs = nd.multi_sgd_update(ws[0], gs[0], ws[1], gs[1], lrs, wds,
+                               num_weights=2)
+    assert np.allclose(outs[0].asnumpy(), 1.5)
+    assert np.allclose(outs[1].asnumpy(), 2.9)
+
+
+def test_trainer_aggregated_matches_per_tensor():
+    """The fused Pallas path must be bit-for-bit interchangeable with the
+    per-tensor update loop."""
+    np.random.seed(0)
+    X = nd.array(np.random.randn(16, 6).astype(np.float32))
+    Y = nd.array(np.random.randint(0, 4, 16), dtype="int32")
+    mx.random.seed(3)
+
+    def mknet():
+        net = gluon.nn.Sequential()
+        net.add(gluon.nn.Dense(9, activation="relu"), gluon.nn.Dense(4))
+        net.initialize()
+        net(X)
+        return net
+
+    net_a, net_b = mknet(), mknet()
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        pb.set_data(pa.data())
+    tr_a = gluon.Trainer(net_a.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-3})
+    assert tr_a._optimizer.aggregate_num > 1  # fused path active
+    tr_b = gluon.Trainer(net_b.collect_params(), "sgd",
+                         {"learning_rate": 0.1, "momentum": 0.9,
+                          "wd": 1e-3})
+    tr_b._optimizer.aggregate_num = 0          # per-tensor path
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    for _ in range(5):
+        for net, tr in ((net_a, tr_a), (net_b, tr_b)):
+            with autograd.record():
+                L = lossfn(net(X), Y).mean()
+            L.backward()
+            tr.step(1)
+    for pa, pb in zip(net_a.collect_params().values(),
+                      net_b.collect_params().values()):
+        assert np.allclose(pa.data().asnumpy(), pb.data().asnumpy(),
+                           atol=1e-6), pa.name
+
+
+def test_trainer_aggregated_multi_precision():
+    """multi_mp path: bf16 weights, fp32 masters, fused apply."""
+    np.random.seed(0)
+    X = nd.array(np.random.randn(8, 5).astype(np.float32)).astype("bfloat16")
+    Y = nd.array(np.random.randint(0, 3, 8), dtype="int32")
+    mx.random.seed(5)
+    net = gluon.nn.Dense(3, dtype="bfloat16")
+    net.initialize()
+    net(X)
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.1, "momentum": 0.9,
+                        "multi_precision": True})
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    losses = []
+    for _ in range(20):
+        with autograd.record():
+            L = lossfn(net(X), Y).mean()
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0]
+    # fp32 master exists and tracks the bf16 weight
+    state = tr._states[(0, list(net.weight._data)[0])]
+    assert isinstance(state, tuple) and state[1].dtype == np.float32
+
+
+def test_lr_schedule_does_not_retrace():
+    """lrs ride as array inputs: changing lr must hit the same compiled
+    fn (VERDICT hard-part #6: imperative dispatch fast path)."""
+    from mxnet_tpu.ndarray.register import get_op
+    op = get_op("multi_sgd_update")
+    before = op._fn_cached.cache_info().misses
+    w = nd.array(np.ones((8,), np.float32))
+    g = nd.array(np.ones((8,), np.float32))
+    for lr in (0.1, 0.2, 0.3):
+        lrs = nd.array(np.array([lr], np.float32))
+        wds = nd.array(np.zeros(1, np.float32))
+        nd.multi_sgd_update(w, g, lrs, wds, num_weights=1)
+    after = op._fn_cached.cache_info().misses
+    assert after - before <= 1
