@@ -4,7 +4,10 @@ those are downstream repos in the reference ecosystem, SURVEY.md §1)."""
 from . import vision
 from . import transformer
 from . import ssd
+from . import rcnn
 from .vision import get_model
 from .transformer import (BERTModel, TransformerNMT, bert_base, bert_small,
                           transformer_nmt_base, TP_RULES)
 from .ssd import SSD, SSDMultiBoxLoss, ssd_512_resnet50_v1, ssd_toy
+from .rcnn import (FasterRCNN, MaskRCNN, RCNNLoss, faster_rcnn_resnet18_v1,
+                   mask_rcnn_resnet18_v1, faster_rcnn_toy, mask_rcnn_toy)

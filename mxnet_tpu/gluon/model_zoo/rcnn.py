@@ -1,0 +1,325 @@
+"""Faster-RCNN / Mask-RCNN family (BASELINE config #5, second half).
+
+Reference parity: the GluonCV RCNN models are *downstream* of the
+reference, built on Gluon + contrib ops — `Proposal`
+(src/operator/contrib/proposal.cc), `ROIAlign` (roi_align.cc), and
+`box_encode/decode` (bounding_box.cc); SURVEY.md §2.2 contrib row.  This
+module provides the same two-stage shape on this framework, static-shaped
+end to end for XLA:
+
+  backbone features → RPN head → Proposal (pad-and-mask NMS, fixed
+  rpn_post_nms_top_n rois) → ROIAlign → box head (cls + bbox) and, for
+  Mask-RCNN, a conv mask head on the same pooled features.
+
+Training uses fixed-size sampled roi batches so every step compiles to
+one XLA program; padding rois carry weight 0 in the losses.
+"""
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from ..block import HybridBlock
+from ..loss import (Loss, SigmoidBinaryCrossEntropyLoss,
+                    SoftmaxCrossEntropyLoss)
+from .. import nn
+
+__all__ = ["RPNHead", "FasterRCNN", "MaskRCNN", "RCNNLoss",
+           "faster_rcnn_resnet18_v1", "mask_rcnn_resnet18_v1",
+           "faster_rcnn_toy", "mask_rcnn_toy"]
+
+
+class RPNHead(HybridBlock):
+    """3x3 conv trunk + 1x1 objectness/bbox heads (per-anchor)."""
+
+    def __init__(self, channels: int, num_anchors: int, **kwargs):
+        super().__init__(**kwargs)
+        self._na = num_anchors
+        with self.name_scope():
+            self.conv = nn.Conv2D(channels, 3, 1, 1, activation="relu")
+            self.score = nn.Conv2D(num_anchors * 2, 1)
+            self.loc = nn.Conv2D(num_anchors * 4, 1)
+
+    def hybrid_forward(self, F, x):
+        t = self.conv(x)
+        raw = self.score(t)                   # (B, 2A, H, W)
+        # softmax over {bg, fg} per anchor so Proposal sees probabilities
+        b, _, h, w = raw.shape
+        pairs = raw.reshape((b, 2, self._na, h, w))
+        prob = F.softmax(pairs, axis=1).reshape((b, 2 * self._na, h, w))
+        return prob, self.loc(t)
+
+
+class FasterRCNN(HybridBlock):
+    """Two-stage detector: RPN proposals + ROIAlign + box head.
+
+    Forward returns (cls_pred (B,R,C+1), box_pred (B,R,4), rois (B*R,5),
+    rpn_score (B,2A,H,W), rpn_loc (B,4A,H,W)) — everything the training
+    loss needs, all static shapes.
+    """
+
+    def __init__(self, features: HybridBlock, classes: int,
+                 rpn_channels: int = 256, roi_size: int = 7,
+                 stride: int = 16, scales=(4.0, 8.0, 16.0),
+                 ratios=(0.5, 1.0, 2.0), rpn_post_nms: int = 64,
+                 rpn_pre_nms: int = 256, head_hidden: int = 256,
+                 img_size: int = 256, **kwargs):
+        super().__init__(**kwargs)
+        self._classes = classes
+        self._stride = stride
+        self._scales = tuple(scales)
+        self._ratios = tuple(ratios)
+        self._post = rpn_post_nms
+        self._pre = rpn_pre_nms
+        self._roi = roi_size
+        self._img = img_size
+        na = len(self._scales) * len(self._ratios)
+        with self.name_scope():
+            self.features = features
+            self.rpn = RPNHead(rpn_channels, na)
+            self.head = nn.HybridSequential()
+            self.head.add(nn.Dense(head_hidden, activation="relu"),
+                          nn.Dense(head_hidden, activation="relu"))
+            self.cls_pred = nn.Dense(classes + 1)
+            self.box_pred = nn.Dense(4)
+
+    @property
+    def classes(self) -> int:
+        return self._classes
+
+    def _trunk(self, F, x):
+        """Shared two-stage trunk; returns (cls, box, rois, rpn_score,
+        rpn_loc, pooled)."""
+        b = x.shape[0]
+        feat = self.features(x)
+        rpn_score, rpn_loc = self.rpn(feat)
+        im_info = F.full((b, 3), float(self._img)) * \
+            F.array([[1.0, 1.0, 1.0 / self._img]])
+        rois = F.contrib.Proposal(
+            rpn_score, rpn_loc, im_info,
+            rpn_pre_nms_top_n=self._pre, rpn_post_nms_top_n=self._post,
+            feature_stride=self._stride, scales=self._scales,
+            ratios=self._ratios, rpn_min_size=1)
+        pooled = F.contrib.ROIAlign(
+            feat, rois, pooled_size=(self._roi, self._roi),
+            spatial_scale=1.0 / self._stride, sample_ratio=2)
+        flat = pooled.reshape((b * self._post, -1))
+        h = self.head(flat)
+        cls = self.cls_pred(h).reshape((b, self._post, self._classes + 1))
+        box = self.box_pred(h).reshape((b, self._post, 4))
+        return cls, box, rois, rpn_score, rpn_loc, pooled
+
+    def hybrid_forward(self, F, x):
+        return self._trunk(F, x)[:5]
+
+
+class MaskRCNN(FasterRCNN):
+    """Faster-RCNN + per-roi conv mask head (reference downstream:
+    GluonCV mask_rcnn; mask head = conv3x3 stack + deconv upsample + 1x1).
+
+    Mask channels are indexed by 0-based FOREGROUND class."""
+
+    def __init__(self, features: HybridBlock, classes: int,
+                 mask_channels: int = 64, **kwargs):
+        super().__init__(features, classes, **kwargs)
+        with self.name_scope():
+            self.mask_head = nn.HybridSequential()
+            for _ in range(2):
+                self.mask_head.add(
+                    nn.Conv2D(mask_channels, 3, 1, 1, activation="relu"))
+            self.mask_head.add(
+                nn.Conv2DTranspose(mask_channels, 2, 2, 0,
+                                   activation="relu"),
+                nn.Conv2D(classes, 1))
+
+    def hybrid_forward(self, F, x):
+        cls, box, rois, rpn_score, rpn_loc, pooled = self._trunk(F, x)
+        b = cls.shape[0]
+        masks = self.mask_head(pooled)        # (B*R, C, 2*roi, 2*roi)
+        masks = masks.reshape((b, self._post, self._classes,
+                               2 * self._roi, 2 * self._roi))
+        return cls, box, rois, rpn_score, rpn_loc, masks
+
+
+class RCNNLoss(Loss):
+    """Multi-task training loss for the fixed-size roi batch.
+
+    Two stages, matching the reference training recipe:
+
+    - RPN: anchors (recomputed with the exact Proposal-op enumeration,
+      ``rpn_anchors``) are matched to ground truth by IoU; objectness BCE
+      on positives/negatives, smooth-L1 on positive anchor deltas.
+    - RCNN head: each roi is matched to the best gt box (box_iou); rois
+      above ``fg_thresh`` become positives with box_encode regression
+      targets; padding rois (all-zero) get weight 0.  Adds sigmoid mask
+      loss (0-based foreground class channel) when mask logits are
+      present.
+
+    ``stride``/``scales``/``ratios`` must match the network's RPN config
+    (defaults mirror FasterRCNN's defaults).
+    """
+
+    def __init__(self, fg_thresh: float = 0.5, stride: int = 16,
+                 scales=(4.0, 8.0, 16.0), ratios=(0.5, 1.0, 2.0),
+                 rpn_pos_iou: float = 0.7, rpn_neg_iou: float = 0.3,
+                 weight=None, batch_axis=0, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._fg = fg_thresh
+        self._stride = stride
+        self._scales = tuple(scales)
+        self._ratios = tuple(ratios)
+        self._rpn_pos = rpn_pos_iou
+        self._rpn_neg = rpn_neg_iou
+        self._sce = SoftmaxCrossEntropyLoss()
+        self._bce = SigmoidBinaryCrossEntropyLoss(from_sigmoid=True)
+
+    @classmethod
+    def for_net(cls, net: "FasterRCNN", **kwargs):
+        """Build a loss whose anchor config matches ``net``'s RPN."""
+        return cls(stride=net._stride, scales=net._scales,
+                   ratios=net._ratios, **kwargs)
+
+    def _rpn_losses(self, F, rpn_score, rpn_loc, gt_boxes):
+        """Anchor-level objectness BCE + positive-anchor smooth-L1."""
+        from ...ndarray.ops_contrib import rpn_anchors
+        b = rpn_score.shape[0]
+        a2, h, w = rpn_score.shape[1], rpn_score.shape[2], rpn_score.shape[3]
+        na = a2 // 2
+        n = h * w * na
+        anchors = F.array(rpn_anchors(h, w, self._stride, self._scales,
+                                      self._ratios), ctx=rpn_score.context)
+        # (H,W,A) enumeration — identical to the Proposal op
+        fg = F.slice_axis(rpn_score, axis=1, begin=na, end=2 * na)
+        fg = fg.transpose((0, 2, 3, 1)).reshape((b, n))
+        loc = rpn_loc.reshape((b, na, 4, h, w))
+        loc = loc.transpose((0, 3, 4, 1, 2)).reshape((b, n, 4))
+
+        iou = F.contrib.box_iou(
+            anchors.reshape((1, n, 4)).broadcast_to((b, n, 4)),
+            gt_boxes, format="corner")                   # (B,N,M)
+        best_iou = F.max(iou, axis=-1)
+        best_gt = F.argmax(iou, axis=-1)
+        pos = best_iou > self._rpn_pos
+        neg = best_iou < self._rpn_neg
+        care = pos | neg
+        tgt = F.where(pos, F.ones_like(best_iou), F.zeros_like(best_iou))
+        wobj = F.where(care, F.ones_like(best_iou), F.zeros_like(best_iou))
+        cls_l = F.mean(self._bce(fg, tgt, wobj))
+
+        samples = F.where(pos, F.ones_like(best_iou),
+                          -F.ones_like(best_iou))
+        means = F.zeros((4,), ctx=rpn_score.context)
+        stds = F.ones((4,), ctx=rpn_score.context)
+        abox = anchors.reshape((1, n, 4)).broadcast_to((b, n, 4))
+        targets, tmask = F.contrib.box_encode(
+            samples, best_gt.astype("float32"), abox, gt_boxes,
+            means, stds)
+        box_l = F.mean(F.smooth_l1((loc - targets) * tmask, scalar=1.0))
+        return cls_l, box_l
+
+    def __call__(self, outs, gt_boxes, gt_classes, gt_masks=None):
+        from ... import ndarray as F
+
+        cls, box, rois, rpn_score, rpn_loc = outs[:5]
+        masks = outs[5] if len(outs) > 5 else None
+        b, r = cls.shape[0], cls.shape[1]
+        roi_boxes = rois.reshape((b, r, 5))[:, :, 1:]   # corners
+
+        rpn_cls_l, rpn_box_l = self._rpn_losses(F, rpn_score, rpn_loc,
+                                                gt_boxes)
+
+        iou = F.contrib.box_iou(roi_boxes, gt_boxes, format="corner")
+        best_iou = F.max(iou, axis=-1)                  # (B,R)
+        best_gt = F.argmax(iou, axis=-1)                # (B,R)
+        pos = best_iou > self._fg
+        live = F.sum(roi_boxes, axis=-1) > 0            # padding rois out
+
+        m = gt_boxes.shape[1]
+        sel = F.one_hot(best_gt.astype("int32"), depth=m)  # (B,R,M)
+
+        # class target: matched gt class + 1 for positives, 0 = background
+        gtc = F.sum(sel * gt_classes.reshape((b, 1, m)), axis=-1)
+        cls_target = F.where(pos, gtc + 1.0,
+                             F.zeros_like(gtc)).astype("int32")
+        cls_l = self._sce(cls.reshape((-1, cls.shape[-1])),
+                          cls_target.reshape((-1,)),
+                          F.where(live, F.ones_like(best_iou),
+                                  F.zeros_like(best_iou)).reshape((-1, 1)))
+
+        # box regression target (standard RCNN encode, unit std)
+        samples = F.where(pos & live, F.ones_like(best_iou),
+                          -F.ones_like(best_iou))
+        means = F.zeros((4,), ctx=cls.context)
+        stds = F.ones((4,), ctx=cls.context)
+        targets, tmask = F.contrib.box_encode(
+            samples, best_gt.astype("float32"), roi_boxes, gt_boxes,
+            means, stds)
+        diff = (box - targets) * tmask
+        box_l = F.mean(F.smooth_l1(diff, scalar=1.0))
+
+        total = F.mean(cls_l) + box_l + rpn_cls_l + rpn_box_l
+        if masks is not None and gt_masks is not None:
+            # pooled-resolution mask supervision for positive rois: the
+            # 0-based FOREGROUND class channel of the matched class,
+            # against the matched gt mask (one-hot contraction keeps
+            # shapes static); background rois carry weight 0
+            ms = masks.shape
+            fg_cls = F.maximum(cls_target.astype("float32") - 1.0,
+                               F.zeros_like(best_iou)).astype("int32")
+            midx = F.one_hot(fg_cls, depth=ms[2])       # (B,R,C)
+            pred = F.sum(masks * midx.reshape((b, r, ms[2], 1, 1)),
+                         axis=2)                        # (B,R,h,w)
+            gm = F.sum(sel.reshape((b, r, m, 1, 1)) *
+                       gt_masks.reshape((b, 1, m) + gt_masks.shape[2:]),
+                       axis=2)                          # (B,R,h,w)
+            wmask = F.where(pos & live, F.ones_like(best_iou),
+                            F.zeros_like(best_iou))
+            mask_bce = SigmoidBinaryCrossEntropyLoss()(
+                pred.reshape((b * r,) + pred.shape[2:]),
+                gm.reshape((b * r,) + gm.shape[2:]),
+                wmask.reshape((b * r, 1, 1)))
+            total = total + F.mean(mask_bce)
+        return total
+
+
+def _resnet18_features():
+    from .vision import resnet18_v1
+    net = resnet18_v1()
+    feats = nn.HybridSequential()
+    # all stages except the global-pool/classifier tail; stride 16 at exit
+    for layer in list(net.features._children.values())[:-3]:
+        feats.add(layer)
+    return feats
+
+
+def faster_rcnn_resnet18_v1(classes: int = 20, **kwargs) -> FasterRCNN:
+    return FasterRCNN(_resnet18_features(), classes, **kwargs)
+
+
+def mask_rcnn_resnet18_v1(classes: int = 20, **kwargs) -> MaskRCNN:
+    return MaskRCNN(_resnet18_features(), classes, **kwargs)
+
+
+def _toy_features() -> nn.HybridSequential:
+    f = nn.HybridSequential()
+    for ch in (16, 32, 32, 64):                 # stride 16 at exit
+        f.add(nn.Conv2D(ch, 3, 2, 1, activation="relu"))
+    return f
+
+
+def faster_rcnn_toy(classes: int = 3, **kwargs) -> FasterRCNN:
+    kwargs.setdefault("rpn_post_nms", 16)
+    kwargs.setdefault("rpn_pre_nms", 64)
+    kwargs.setdefault("img_size", 64)
+    kwargs.setdefault("rpn_channels", 32)
+    kwargs.setdefault("head_hidden", 64)
+    return FasterRCNN(_toy_features(), classes, **kwargs)
+
+
+def mask_rcnn_toy(classes: int = 3, **kwargs) -> MaskRCNN:
+    kwargs.setdefault("rpn_post_nms", 16)
+    kwargs.setdefault("rpn_pre_nms", 64)
+    kwargs.setdefault("img_size", 64)
+    kwargs.setdefault("rpn_channels", 32)
+    kwargs.setdefault("head_hidden", 64)
+    kwargs.setdefault("mask_channels", 32)
+    return MaskRCNN(_toy_features(), classes, **kwargs)

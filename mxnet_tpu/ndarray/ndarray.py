@@ -414,6 +414,28 @@ class NDArray:
     def __ge__(self, o):
         return self._binop("broadcast_greater_equal", o)
 
+    def __and__(self, o):
+        return self._binop("broadcast_logical_and", o)
+
+    def __rand__(self, o):
+        return self._binop("broadcast_logical_and", o, reverse=True)
+
+    def __or__(self, o):
+        return self._binop("broadcast_logical_or", o)
+
+    def __ror__(self, o):
+        return self._binop("broadcast_logical_or", o, reverse=True)
+
+    def __xor__(self, o):
+        return self._binop("broadcast_logical_xor", o)
+
+    def __rxor__(self, o):
+        return self._binop("broadcast_logical_xor", o, reverse=True)
+
+    def __invert__(self):
+        from .register import invoke_by_name
+        return invoke_by_name("logical_not", [self], {})
+
     __hash__ = None  # mutable
 
     # reductions / convenience mirrors of mx.nd methods

@@ -375,6 +375,207 @@ def _register():
         return fn
     register_op("ROIPooling", roi_pooling_maker)
 
+    # ---- RPN proposal (reference: src/operator/contrib/proposal.cc) ------
+    def _decode_deltas(anchors, deltas):  # noqa: F811 (module fn below)
+        """Standard RCNN box transform: anchors+(dx,dy,dw,dh) -> corners."""
+        aw = anchors[:, 2] - anchors[:, 0] + 1.0
+        ah = anchors[:, 3] - anchors[:, 1] + 1.0
+        ax = anchors[:, 0] + 0.5 * (aw - 1.0)
+        ay = anchors[:, 1] + 0.5 * (ah - 1.0)
+        dx, dy, dw, dh = (deltas[:, 0], deltas[:, 1], deltas[:, 2],
+                          deltas[:, 3])
+        cx = dx * aw + ax
+        cy = dy * ah + ay
+        w = jnp.exp(dw) * aw
+        h = jnp.exp(dh) * ah
+        return jnp.stack([cx - 0.5 * (w - 1.0), cy - 0.5 * (h - 1.0),
+                          cx + 0.5 * (w - 1.0), cy + 0.5 * (h - 1.0)],
+                         axis=1)
+
+    _base_anchors = base_anchors  # module-level helper (shared with rcnn)
+
+    def proposal_maker(rpn_pre_nms_top_n=6000, rpn_post_nms_top_n=300,
+                       threshold=0.7, rpn_min_size=16,
+                       scales=(4.0, 8.0, 16.0, 32.0), ratios=(0.5, 1.0, 2.0),
+                       feature_stride=16, output_score=False,
+                       iou_loss=False):
+        scales = _astuple(scales)
+        ratios = _astuple(ratios)
+
+        def fn(cls_prob, bbox_pred, im_info):
+            # cls_prob (B, 2A, H, W) — [A:] are foreground scores;
+            # bbox_pred (B, 4A, H, W); im_info (B, 3) = (h, w, scale)
+            B, _, H, W = cls_prob.shape
+            base = jnp.asarray(_base_anchors(scales, ratios))  # (A,4)
+            A = base.shape[0]
+            sx = jnp.arange(W, dtype=jnp.float32) * feature_stride
+            sy = jnp.arange(H, dtype=jnp.float32) * feature_stride
+            shift = jnp.stack(
+                jnp.meshgrid(sx, sy, indexing="xy"), axis=-1)   # (H,W,2)
+            shift = jnp.tile(shift, (1, 1, 2))                  # (H,W,4)
+            anchors = (shift[:, :, None, :] + base).reshape(-1, 4)
+
+            def one(cls, deltas, info):
+                scores = jnp.transpose(cls[A:], (1, 2, 0)).reshape(-1)
+                d = deltas.reshape(A, 4, H, W)
+                d = jnp.transpose(d, (2, 3, 0, 1)).reshape(-1, 4)
+                boxes = _decode_deltas(anchors, d)
+                boxes = jnp.stack([
+                    jnp.clip(boxes[:, 0], 0, info[1] - 1.0),
+                    jnp.clip(boxes[:, 1], 0, info[0] - 1.0),
+                    jnp.clip(boxes[:, 2], 0, info[1] - 1.0),
+                    jnp.clip(boxes[:, 3], 0, info[0] - 1.0)], axis=1)
+                ws = boxes[:, 2] - boxes[:, 0] + 1.0
+                hs = boxes[:, 3] - boxes[:, 1] + 1.0
+                min_sz = rpn_min_size * info[2]
+                valid = (ws >= min_sz) & (hs >= min_sz)
+                scores = jnp.where(valid, scores, -jnp.inf)
+
+                k = min(int(rpn_pre_nms_top_n), H * W * A)
+                order = jnp.argsort(scores)[::-1][:k]
+                cboxes = boxes[order]
+                cscores = scores[order]
+                iou = _iou_corner(cboxes[:, None, :], cboxes[None, :, :])
+
+                def step(keep, i):
+                    kill = (iou[i] > threshold) & \
+                        (jnp.arange(k) > i) & keep[i]
+                    return keep & ~kill, None
+                keep, _ = lax.scan(step, cscores > -jnp.inf,
+                                   jnp.arange(k))
+                fscores = jnp.where(keep, cscores, -jnp.inf)
+                p = min(int(rpn_post_nms_top_n), k)
+                sel = jnp.argsort(fscores)[::-1][:p]
+                out_boxes = cboxes[sel]
+                out_scores = jnp.where(jnp.isfinite(fscores[sel]),
+                                       fscores[sel], 0.0)
+                live = jnp.isfinite(fscores[sel])[:, None]
+                return jnp.where(live, out_boxes, 0.0), \
+                    out_scores[:, None]
+            boxes, scores = jax.vmap(one)(cls_prob, bbox_pred, im_info)
+            p = boxes.shape[1]
+            bidx = jnp.repeat(jnp.arange(B, dtype=boxes.dtype), p)
+            rois = jnp.concatenate(
+                [bidx[:, None], boxes.reshape(-1, 4)], axis=1)
+            if output_score:
+                return (rois, scores.reshape(-1, 1))
+            return rois
+        return fn
+    register_op("_contrib_Proposal", proposal_maker,
+                aliases=("_contrib_MultiProposal", "Proposal"))
+
+    # ---- bounding_box.cc long tail: encode/decode/matching ---------------
+    def box_decode_maker(std0=1.0, std1=1.0, std2=1.0, std3=1.0, clip=-1.0,
+                         format="corner"):
+        def fn(data, anchors):
+            # data (B,N,4) deltas; anchors (1,N,4) in `format`
+            a = anchors
+            if format == "corner":
+                wh = a[..., 2:] - a[..., :2]
+                ctr = a[..., :2] + 0.5 * wh
+            else:
+                ctr, wh = a[..., :2], a[..., 2:]
+            std = jnp.asarray([std0, std1, std2, std3], data.dtype)
+            d = data * std
+            xy = d[..., :2] * wh + ctr
+            dwh = d[..., 2:]
+            if clip > 0:
+                # reference clips the dw/dh DELTA pre-exp (bounding_box.cc)
+                dwh = jnp.minimum(dwh, clip)
+            new_wh = jnp.exp(dwh) * wh
+            half = 0.5 * new_wh
+            return jnp.concatenate([xy - half, xy + half], axis=-1)
+        return fn
+    register_op("_contrib_box_decode", box_decode_maker,
+                aliases=("box_decode",))
+
+    def box_encode_maker(**_ignored):
+        def fn(samples, matches, anchors, refs, means, stds):
+            # samples (B,N) in {+1 pos, -1 neg/ignore}; matches (B,N) gt
+            # index; anchors (B,N,4) corner; refs (B,M,4) corner gt;
+            # means/stds (4,) — returns (targets (B,N,4), masks (B,N,4))
+            gt = jnp.take_along_axis(
+                refs, matches.astype(jnp.int32)[..., None]
+                .clip(0, refs.shape[1] - 1).repeat(4, axis=-1), axis=1)
+            awh = anchors[..., 2:] - anchors[..., :2]
+            actr = anchors[..., :2] + 0.5 * awh
+            gwh = gt[..., 2:] - gt[..., :2]
+            gctr = gt[..., :2] + 0.5 * gwh
+            eps = 1e-8
+            t_xy = (gctr - actr) / (awh + eps)
+            t_wh = jnp.log((gwh + eps) / (awh + eps))
+            t = jnp.concatenate([t_xy, t_wh], axis=-1)
+            t = (t - means.reshape(1, 1, 4)) / stds.reshape(1, 1, 4)
+            mask = (samples > 0.5)[..., None].astype(t.dtype)
+            return (t * mask, jnp.broadcast_to(mask, t.shape))
+        return fn
+    register_op("_contrib_box_encode", box_encode_maker,
+                aliases=("box_encode",))
+
+    def bipartite_matching_maker(threshold=0.5, is_ascend=False, topk=-1):
+        def fn(data):
+            # data (B,N,M) pairwise scores; greedy bipartite matching.
+            # Returns (row_match (B,N) col idx or -1, col_match (B,M)).
+            B, N, M = data.shape
+            steps = min(N, M) if topk <= 0 else min(topk, min(N, M))
+            sgn = -1.0 if is_ascend else 1.0
+
+            def one(s):
+                s = s * sgn  # maximize
+                thr = threshold * sgn
+
+                def step(carry, _):
+                    s_cur, rows, cols = carry
+                    flat = jnp.argmax(s_cur)
+                    i, j = flat // M, flat % M
+                    ok = s_cur[i, j] >= thr
+                    rows = lax.cond(
+                        ok, lambda r: r.at[i].set(j.astype(r.dtype)),
+                        lambda r: r, rows)
+                    cols = lax.cond(
+                        ok, lambda c: c.at[j].set(i.astype(c.dtype)),
+                        lambda c: c, cols)
+                    s_cur = s_cur.at[i, :].set(-jnp.inf)
+                    s_cur = s_cur.at[:, j].set(-jnp.inf)
+                    return (s_cur, rows, cols), None
+                init = (s, jnp.full((N,), -1.0, data.dtype),
+                        jnp.full((M,), -1.0, data.dtype))
+                (_, rows, cols), _ = lax.scan(step, init,
+                                              jnp.arange(steps))
+                return rows, cols
+            rows, cols = jax.vmap(one)(data)
+            return (rows, cols)
+        return fn
+    register_op("_contrib_bipartite_matching", bipartite_matching_maker,
+                aliases=("bipartite_matching",))
+
+
+def base_anchors(scales, ratios, base_size=16.0):
+    """(A,4) corner anchors centered on a base_size cell (numpy,
+    trace-time constant; reference: proposal.cc GenerateAnchors)."""
+    out = []
+    cx = cy = (base_size - 1.0) / 2.0
+    area = base_size * base_size
+    for r in ratios:
+        w = _np.round(_np.sqrt(area / r))
+        h = _np.round(w * r)
+        for s in scales:
+            ws, hs = w * s, h * s
+            out.append([cx - 0.5 * (ws - 1), cy - 0.5 * (hs - 1),
+                        cx + 0.5 * (ws - 1), cy + 0.5 * (hs - 1)])
+    return _np.array(out, _np.float32)
+
+
+def rpn_anchors(height, width, stride, scales, ratios):
+    """All (H*W*A, 4) corner anchors of a feature map, (H, W, A)-ordered —
+    the exact enumeration the Proposal op uses."""
+    base = base_anchors(tuple(scales), tuple(ratios))
+    sx = _np.arange(width, dtype=_np.float32) * stride
+    sy = _np.arange(height, dtype=_np.float32) * stride
+    gx, gy = _np.meshgrid(sx, sy)                   # (H,W)
+    shift = _np.stack([gx, gy, gx, gy], axis=-1)    # (H,W,4)
+    return (shift[:, :, None, :] + base).reshape(-1, 4)
+
 
 def _astuple(v):
     if isinstance(v, (list, tuple)):
