@@ -1,0 +1,173 @@
+"""INT8 post-training quantization frontend.
+
+Reference parity: python/mxnet/contrib/quantization.py `quantize_model` +
+the calibration machinery (src/operator/quantization/calibrate.cc minmax
+mode; SURVEY.md §2.2 quantization row).  The reference rewrites a Symbol
+graph; the Gluon-era analog here rewrites a Block tree in place:
+
+    net = ...               # trained fp32 HybridBlock
+    qnet = quantize_net(net, calib_data=[batch1, batch2])
+    y = qnet(x)             # Dense/Conv2D now run int8 on the MXU
+
+Per-tensor symmetric int8 everywhere (the reference's int8 flow).
+Calibration is minmax over the provided batches; layers without
+calibration quantize activations dynamically per batch.
+"""
+from __future__ import annotations
+
+from typing import Dict, Iterable, Optional, Sequence
+
+from ..base import MXNetError
+from ..gluon import nn
+from ..gluon.block import HybridBlock
+
+__all__ = ["QuantizedDense", "QuantizedConv2D", "quantize_net"]
+
+
+def _quantize_weight(w):
+    """fp32 NDArray -> (int8 NDArray, min, max NDArrays), symmetric."""
+    import numpy as np
+    from .. import ndarray as F
+    a = w.asnumpy()
+    mx = float(np.max(np.abs(a))) or 1e-8
+    q = np.clip(np.round(a / (mx / 127.0)), -127, 127).astype(np.int8)
+    ctx = w.context
+    return (F.array(q, ctx=ctx, dtype="int8"),
+            F.array(np.float32(-mx), ctx=ctx),
+            F.array(np.float32(mx), ctx=ctx))
+
+
+class _QuantizedBase(HybridBlock):
+    """Shared int8 wrapper state: quantized weight + ranges + float bias."""
+
+    def __init__(self, weight, bias, act, calib_range, **kwargs):
+        super().__init__(**kwargs)
+        self._qw, self._wmin, self._wmax = _quantize_weight(weight)
+        self._fbias = bias.data() if bias is not None else None
+        self._act = act
+        self._calib = calib_range        # (min, max) floats or None
+
+    def _quantize_input(self, F, x):
+        if self._calib is not None:
+            return F.quantize_v2(x, min_calib_range=float(self._calib[0]),
+                                 max_calib_range=float(self._calib[1]))
+        return F.quantize_v2(x)
+
+
+class QuantizedDense(_QuantizedBase):
+    """int8 Dense: quantize input -> int8 matmul on the MXU (int32
+    accumulate) -> dequantize -> float bias/activation."""
+
+    def __init__(self, dense: nn.Dense, calib_range=None, **kwargs):
+        super().__init__(dense.weight.data(),
+                         getattr(dense, "bias", None),
+                         dense.act, calib_range, **kwargs)
+        self._units = dense._units
+        self._flatten = dense._flatten
+
+    def hybrid_forward(self, F, x):
+        q, mn, mx = self._quantize_input(F, x)
+        out32, omn, omx = F.quantized_fully_connected(
+            q, self._qw, mn, mx, self._wmin, self._wmax,
+            num_hidden=self._units, no_bias=True, flatten=self._flatten)
+        y = F.dequantize(out32, omn, omx)
+        if self._fbias is not None:
+            y = y + self._fbias
+        if self._act is not None:
+            y = self._act(y)
+        return y
+
+
+class QuantizedConv2D(_QuantizedBase):
+    """int8 Conv2D via the MXU integer conv path."""
+
+    def __init__(self, conv: nn.Conv2D, calib_range=None, **kwargs):
+        super().__init__(conv.weight.data(),
+                         getattr(conv, "bias", None),
+                         conv.act, calib_range, **kwargs)
+        self._kernel = conv._kwargs["kernel"]
+        self._stride = conv._kwargs["stride"]
+        self._pad = conv._kwargs["pad"]
+        self._dilate = conv._kwargs.get("dilate", (1, 1))
+        self._channels = conv._channels
+
+    def hybrid_forward(self, F, x):
+        q, mn, mx = self._quantize_input(F, x)
+        out32, omn, omx = F.quantized_conv(
+            q, self._qw, mn, mx, self._wmin, self._wmax,
+            kernel=self._kernel, stride=self._stride, pad=self._pad,
+            dilate=self._dilate, num_filter=self._channels, no_bias=True)
+        y = F.dequantize(out32, omn, omx)
+        if self._fbias is not None:
+            y = y + self._fbias.reshape((1, -1, 1, 1))
+        if self._act is not None:
+            y = self._act(y)
+        return y
+
+
+def _collect_ranges(net: HybridBlock, calib_data: Iterable,
+                    targets) -> Dict[int, tuple]:
+    """minmax calibration: run the fp32 net over the batches, recording
+    each target layer's input range (reference calib_mode='naive')."""
+    ranges: Dict[int, list] = {}
+    hooks = []
+
+    def make_hook(block):
+        def hook(blk, args, out):
+            import numpy as np
+            x = args[0].asnumpy()
+            lo, hi = float(np.min(x)), float(np.max(x))
+            cur = ranges.get(id(blk))
+            if cur is None:
+                ranges[id(blk)] = [lo, hi]
+            else:
+                cur[0] = min(cur[0], lo)
+                cur[1] = max(cur[1], hi)
+        return hook
+
+    def attach(block):
+        for child in block._children.values():
+            if isinstance(child, targets):
+                child.register_forward_hook(make_hook(child))
+                hooks.append(child)
+            else:
+                attach(child)
+    attach(net)
+    for batch in calib_data:
+        net(batch)
+    for blk in hooks:
+        blk._forward_hooks.clear()
+    return {k: tuple(v) for k, v in ranges.items()}
+
+
+def quantize_net(net: HybridBlock, calib_data: Optional[Iterable] = None,
+                 exclude_layers: Sequence[str] = (),
+                 quantize_conv: bool = True) -> HybridBlock:
+    """Rewrite ``net`` in place: Dense (and optionally Conv2D) layers
+    become int8 blocks.  Returns ``net``.
+
+    With ``calib_data`` (an iterable of input batches), activation ranges
+    are calibrated minmax-style and frozen; without it, activations are
+    quantized dynamically per batch (slower, range-exact).
+    """
+    targets = (nn.Dense, nn.Conv2D) if quantize_conv else (nn.Dense,)
+    ranges: Dict[int, tuple] = {}
+    if calib_data is not None:
+        ranges = _collect_ranges(net, calib_data, targets)
+
+    def swap(block):
+        for name, child in list(block._children.items()):
+            if name in exclude_layers:
+                continue
+            if isinstance(child, nn.Dense):
+                q = QuantizedDense(child, ranges.get(id(child)))
+            elif quantize_conv and isinstance(child, nn.Conv2D):
+                q = QuantizedConv2D(child, ranges.get(id(child)))
+            else:
+                swap(child)
+                continue
+            block._children[name] = q
+            if getattr(block, name, None) is child:
+                object.__setattr__(block, name, q)
+        return block
+    return swap(net)

@@ -1,0 +1,191 @@
+"""INT8 quantization operators.
+
+Reference parity: src/operator/quantization/ — quantize.cc,
+quantize_v2.cc, dequantize.cc, requantize.cc,
+quantized_fully_connected.cc, quantized_conv.cc (SURVEY.md §2.2
+quantization row).
+
+TPU-native design: symmetric signed-int8 per-tensor quantization (the
+reference's int8 flow), with the quantized matmul/conv lowered through
+``lax.dot_general`` / ``lax.conv_general_dilated`` with
+``preferred_element_type=int32`` — the MXU's native int8×int8→int32 path.
+Ranges travel with the data as (min, max) scalar arrays, exactly like the
+reference's three-tensor convention.
+"""
+from __future__ import annotations
+
+from .register import register_op
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    def _scale_of(mn, mx):
+        # symmetric int8: scale maps max(|min|,|max|) -> 127
+        return jnp.maximum(jnp.abs(mn), jnp.abs(mx)) / 127.0
+
+    # ---- quantize / quantize_v2 -----------------------------------------
+    def quantize_maker(out_type="int8"):
+        if out_type != "int8":
+            from ..base import MXNetError
+            raise MXNetError("only int8 quantization is supported (the "
+                             "MXU's native integer path)")
+
+        def fn(data, min_range, max_range):
+            s = _scale_of(min_range, max_range)
+            q = jnp.clip(jnp.round(data / s), -127, 127).astype(jnp.int8)
+            return (q, min_range.reshape(()), max_range.reshape(()))
+        return fn
+    register_op("_contrib_quantize", quantize_maker,
+                aliases=("quantize",), differentiable=False)
+
+    def quantize_v2_maker(out_type="int8", min_calib_range=None,
+                          max_calib_range=None):
+        if out_type != "int8":
+            from ..base import MXNetError
+            raise MXNetError("only int8 quantization is supported")
+
+        def fn(data):
+            if min_calib_range is not None and max_calib_range is not None:
+                mn = jnp.asarray(min_calib_range, data.dtype)
+                mx = jnp.asarray(max_calib_range, data.dtype)
+            else:
+                mn = jnp.min(data)
+                mx = jnp.max(data)
+            s = _scale_of(mn, mx)
+            q = jnp.clip(jnp.round(data / s), -127, 127).astype(jnp.int8)
+            return (q, mn.reshape(()), mx.reshape(()))
+        return fn
+    register_op("_contrib_quantize_v2", quantize_v2_maker,
+                aliases=("quantize_v2",), differentiable=False)
+
+    # ---- dequantize ------------------------------------------------------
+    def dequantize_maker(out_type="float32"):
+        def fn(data, min_range, max_range):
+            # the stored range is the REAL-value range; the divisor is the
+            # integer type's own max (int8 -> 127, int32 accumulators ->
+            # 2^31-1), as in the reference dequantize
+            t = 127.0 if data.dtype == jnp.int8 else float(2 ** 31 - 1)
+            s = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / t
+            return data.astype(jnp.float32) * s
+        return fn
+    register_op("_contrib_dequantize", dequantize_maker,
+                aliases=("dequantize",), differentiable=False)
+
+    # ---- requantize (int32 accumulators -> int8) -------------------------
+    def requantize_maker(min_calib_range=None, max_calib_range=None,
+                         out_type="int8"):
+        def fn(data, min_range, max_range):
+            # data int32 with real-value range [min_range, max_range]
+            s_in = jnp.maximum(jnp.abs(min_range), jnp.abs(max_range)) / \
+                float(2 ** 31 - 1)
+            if min_calib_range is not None and max_calib_range is not None:
+                mn = jnp.asarray(min_calib_range, jnp.float32)
+                mx = jnp.asarray(max_calib_range, jnp.float32)
+            else:
+                real = data.astype(jnp.float32) * s_in
+                mn = jnp.min(real)
+                mx = jnp.max(real)
+            s_out = _scale_of(mn, mx)
+            q = jnp.clip(jnp.round(data.astype(jnp.float32) * s_in / s_out),
+                         -127, 127).astype(jnp.int8)
+            return (q, mn.reshape(()), mx.reshape(()))
+        return fn
+    register_op("_contrib_requantize", requantize_maker,
+                aliases=("requantize",), differentiable=False)
+
+    # ---- quantized fully connected (int8 x int8 -> int32 on the MXU) -----
+    def quantized_fc_maker(num_hidden=None, no_bias=False, flatten=True):
+        def fn(data, weight, *rest):
+            # rest: [bias,] min_data, max_data, min_w, max_w [, min_b,
+            # max_b] — reference input convention
+            if no_bias:
+                bias = None
+                mnd, mxd, mnw, mxw = rest[:4]
+            else:
+                bias, mnd, mxd, mnw, mxw = rest[:5]
+            x = data.reshape((data.shape[0], -1)) if flatten else data
+            out32 = lax.dot_general(
+                x, weight,
+                (((x.ndim - 1,), (1,)), ((), ())),
+                preferred_element_type=jnp.int32)
+            s_d = _scale_of(mnd, mxd)
+            s_w = _scale_of(mnw, mxw)
+            if bias is not None:
+                # bias arrives int8 with its own scale; fold into the
+                # int32 accumulator domain
+                mnb, mxb = rest[5], rest[6]
+                s_b = _scale_of(mnb, mxb)
+                b32 = jnp.round(
+                    bias.astype(jnp.float32) * s_b / (s_d * s_w)
+                ).astype(jnp.int32)
+                out32 = out32 + b32
+            # real-value range of the int32 accumulator
+            s_out = s_d * s_w
+            bound = s_out * float(2 ** 31 - 1)
+            return (out32, -bound.reshape(()), bound.reshape(()))
+        return fn
+    register_op("_contrib_quantized_fully_connected", quantized_fc_maker,
+                aliases=("quantized_fully_connected",),
+                differentiable=False)
+
+    # ---- quantized 2d convolution ---------------------------------------
+    def quantized_conv_maker(kernel=None, stride=(1, 1), pad=(0, 0),
+                             dilate=(1, 1), num_filter=None, no_bias=True,
+                             layout="NCHW"):
+        def fn(data, weight, *rest):
+            if no_bias:
+                mnd, mxd, mnw, mxw = rest[:4]
+                bias = None
+            else:
+                bias, mnd, mxd, mnw, mxw = rest[:5]
+            out32 = lax.conv_general_dilated(
+                data.astype(jnp.int8), weight.astype(jnp.int8),
+                window_strides=tuple(stride),
+                padding=[(pad[0], pad[0]), (pad[1], pad[1])],
+                rhs_dilation=tuple(dilate),
+                dimension_numbers=("NCHW", "OIHW", "NCHW"),
+                preferred_element_type=jnp.int32)
+            s_d = _scale_of(mnd, mxd)
+            s_w = _scale_of(mnw, mxw)
+            if bias is not None:
+                mnb, mxb = rest[5], rest[6]
+                s_b = _scale_of(mnb, mxb)
+                b32 = jnp.round(bias.astype(jnp.float32) * s_b /
+                                (s_d * s_w)).astype(jnp.int32)
+                out32 = out32 + b32.reshape(1, -1, 1, 1)
+            s_out = s_d * s_w
+            bound = s_out * float(2 ** 31 - 1)
+            return (out32, -bound.reshape(()), bound.reshape(()))
+        return fn
+    register_op("_contrib_quantized_conv", quantized_conv_maker,
+                aliases=("quantized_conv",), differentiable=False)
+
+    # ---- quantized pooling (int8 in, int8 out, range unchanged) ----------
+    def quantized_pooling_maker(kernel=(2, 2), stride=None, pad=(0, 0),
+                                pool_type="max"):
+        st = tuple(stride) if stride else tuple(kernel)
+
+        def fn(data, min_range, max_range):
+            if pool_type == "max":
+                out = lax.reduce_window(
+                    data, jnp.int8(-128), lax.max,
+                    (1, 1) + tuple(kernel), (1, 1) + st,
+                    [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+            else:  # avg — accumulate in int32, divide, round back
+                acc = lax.reduce_window(
+                    data.astype(jnp.int32), jnp.int32(0), lax.add,
+                    (1, 1) + tuple(kernel), (1, 1) + st,
+                    [(0, 0), (0, 0), (pad[0], pad[0]), (pad[1], pad[1])])
+                n = kernel[0] * kernel[1]
+                out = jnp.clip(jnp.round(acc / n), -128, 127) \
+                    .astype(jnp.int8)
+            return (out, min_range.reshape(()), max_range.reshape(()))
+        return fn
+    register_op("_contrib_quantized_pooling", quantized_pooling_maker,
+                aliases=("quantized_pooling",), differentiable=False)
+
+
+_register()
