@@ -40,11 +40,11 @@ def _quantize_weight(w):
 class _QuantizedBase(HybridBlock):
     """Shared int8 wrapper state: quantized weight + ranges + float bias."""
 
-    def __init__(self, weight, bias, act, calib_range, **kwargs):
+    def __init__(self, weight, bias, act_type, calib_range, **kwargs):
         super().__init__(**kwargs)
         self._qw, self._wmin, self._wmax = _quantize_weight(weight)
         self._fbias = bias.data() if bias is not None else None
-        self._act = act
+        self._act_type = act_type        # activation name string or None
         self._calib = calib_range        # (min, max) floats or None
 
     def _quantize_input(self, F, x):
@@ -61,7 +61,7 @@ class QuantizedDense(_QuantizedBase):
     def __init__(self, dense: nn.Dense, calib_range=None, **kwargs):
         super().__init__(dense.weight.data(),
                          getattr(dense, "bias", None),
-                         dense.act, calib_range, **kwargs)
+                         dense._act_type, calib_range, **kwargs)
         self._units = dense._units
         self._flatten = dense._flatten
 
@@ -73,8 +73,8 @@ class QuantizedDense(_QuantizedBase):
         y = F.dequantize(out32, omn, omx)
         if self._fbias is not None:
             y = y + self._fbias
-        if self._act is not None:
-            y = self._act(y)
+        if self._act_type:
+            y = F.Activation(y, act_type=self._act_type)
         return y
 
 
@@ -84,11 +84,12 @@ class QuantizedConv2D(_QuantizedBase):
     def __init__(self, conv: nn.Conv2D, calib_range=None, **kwargs):
         super().__init__(conv.weight.data(),
                          getattr(conv, "bias", None),
-                         conv.act, calib_range, **kwargs)
+                         conv._act_type, calib_range, **kwargs)
         self._kernel = conv._kwargs["kernel"]
         self._stride = conv._kwargs["stride"]
         self._pad = conv._kwargs["pad"]
         self._dilate = conv._kwargs.get("dilate", (1, 1))
+        self._groups = conv._kwargs.get("num_group", 1)
         self._channels = conv._channels
 
     def hybrid_forward(self, F, x):
@@ -96,12 +97,13 @@ class QuantizedConv2D(_QuantizedBase):
         out32, omn, omx = F.quantized_conv(
             q, self._qw, mn, mx, self._wmin, self._wmax,
             kernel=self._kernel, stride=self._stride, pad=self._pad,
-            dilate=self._dilate, num_filter=self._channels, no_bias=True)
+            dilate=self._dilate, num_filter=self._channels,
+            num_group=self._groups, no_bias=True)
         y = F.dequantize(out32, omn, omx)
         if self._fbias is not None:
             y = y + self._fbias.reshape((1, -1, 1, 1))
-        if self._act is not None:
-            y = self._act(y)
+        if self._act_type:
+            y = F.Activation(y, act_type=self._act_type)
         return y
 
 

@@ -133,8 +133,8 @@ def _register():
 
     # ---- quantized 2d convolution ---------------------------------------
     def quantized_conv_maker(kernel=None, stride=(1, 1), pad=(0, 0),
-                             dilate=(1, 1), num_filter=None, no_bias=True,
-                             layout="NCHW"):
+                             dilate=(1, 1), num_filter=None, num_group=1,
+                             no_bias=True, layout="NCHW"):
         def fn(data, weight, *rest):
             if no_bias:
                 mnd, mxd, mnw, mxw = rest[:4]
@@ -146,6 +146,7 @@ def _register():
                 window_strides=tuple(stride),
                 padding=[(pad[0], pad[0]), (pad[1], pad[1])],
                 rhs_dilation=tuple(dilate),
+                feature_group_count=num_group,
                 dimension_numbers=("NCHW", "OIHW", "NCHW"),
                 preferred_element_type=jnp.int32)
             s_d = _scale_of(mnd, mxd)
