@@ -16,8 +16,14 @@ LP16_OPS = [
     "dot", "batch_dot", "linalg_gemm2",
 ]
 
+# BatchNorm is deliberately NOT in FP32_OPS: the op computes batch
+# statistics in fp32 internally (ops_nn.py batchnorm) while keeping its
+# input/output in the activation dtype — casting its INPUT to fp32 (as the
+# fp16-era reference list does) forces every conv→BN edge in a ResNet to
+# materialize fp32 activations, doubling HBM traffic on the elementwise
+# chain.  bf16 activations + fp32 stats is the TPU-native policy.
 FP32_OPS = [
-    "BatchNorm", "LayerNorm", "InstanceNorm", "L2Normalization",
+    "LayerNorm", "InstanceNorm", "L2Normalization",
     "softmax", "log_softmax", "softmin", "SoftmaxOutput",
     "exp", "expm1", "log", "log1p", "log2", "log10",
     "sum", "nansum", "prod", "nanprod", "mean", "norm",
