@@ -12,7 +12,7 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss"]
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss", "CTCLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -227,3 +227,42 @@ class CosineEmbeddingLoss(Loss):
         loss = F.where(label == 1, pos, neg)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class CTCLoss(Loss):
+    """Connectionist Temporal Classification loss (reference:
+    python/mxnet/gluon/loss.py CTCLoss over src/operator/nn/ctc_loss.cc).
+
+    ``pred``: ``(N, T, C)`` for layout 'NTC' (default) or ``(T, N, C)``
+    for 'TNC'; the LAST class index ``C-1`` is blank (the reference gluon
+    wrapper's ``blank_label='last'`` convention).  ``label``: ``(N, L)``
+    padded with ``-1`` unless ``label_lengths`` is given.
+    """
+
+    def __init__(self, layout="NTC", label_layout="NT", weight=None,
+                 **kwargs):
+        if layout not in ("NTC", "TNC"):
+            raise MXNetError(f"unsupported CTCLoss layout {layout}")
+        if label_layout not in ("NT", "TN"):
+            raise MXNetError(f"unsupported label layout {label_layout}")
+        batch_axis = label_layout.find("N")
+        super().__init__(weight, batch_axis, **kwargs)
+        self._layout = layout
+        self._label_layout = label_layout
+
+    def hybrid_forward(self, F, pred, label, pred_lengths=None,
+                       label_lengths=None, sample_weight=None):
+        if self._layout == "NTC":
+            pred = F.swapaxes(pred, dim1=0, dim2=1)
+        if self._label_layout == "TN":
+            label = F.swapaxes(label, dim1=0, dim2=1)
+        args = []
+        if pred_lengths is not None:
+            args.append(pred_lengths)
+        if label_lengths is not None:
+            args.append(label_lengths)
+        loss = F.CTCLoss(pred, label, *args,
+                         use_data_lengths=pred_lengths is not None,
+                         use_label_lengths=label_lengths is not None,
+                         blank_label="last")
+        return _apply_weighting(F, loss, self._weight, sample_weight)

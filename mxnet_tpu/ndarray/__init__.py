@@ -26,6 +26,7 @@ from . import ops_contrib as _ops_contrib        # noqa: F401
 from . import ops_linalg as _ops_linalg          # noqa: F401
 from . import ops_spatial as _ops_spatial        # noqa: F401
 from . import ops_quantization as _ops_quant     # noqa: F401
+from . import ops_ctc as _ops_ctc                # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401
 

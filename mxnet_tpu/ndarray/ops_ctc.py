@@ -1,0 +1,117 @@
+"""CTC loss operator.
+
+Reference parity: src/operator/nn/ctc_loss.cc (the `CTCLoss` /
+`_contrib_CTCLoss` op; SURVEY.md §2.5 gluon loss row) — data in TNC
+layout ``(max_seq_len, batch, alphabet)``, labels ``(batch, max_label)``
+padded with negative values (or explicit ``label_lengths``), optional
+``data_lengths``, ``blank_label`` ∈ {'first','last'}.
+
+TPU-native design: the standard log-domain alpha recursion over the
+extended label sequence (blanks interleaved), run as ONE ``lax.scan``
+over time for the whole batch — static shapes, no host sync — and
+differentiated by JAX autodiff straight through the scan (exact CTC
+gradients; the reference hand-codes the beta recursion instead because
+it has no autodiff at op granularity).
+"""
+from __future__ import annotations
+
+from .register import register_op
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    NEG = -1e30          # -inf stand-in that survives arithmetic
+
+    def ctc_maker(use_data_lengths=False, use_label_lengths=False,
+                  blank_label="first"):
+        def fn(data, label, *lengths):
+            # data (T, N, C); label (N, L) class indices
+            T, N, C = data.shape
+            L = label.shape[1]
+            S = 2 * L + 1
+            li = 0
+            data_len = None
+            label_len = None
+            if use_data_lengths:
+                data_len = lengths[li].astype(jnp.int32)
+                li += 1
+            if use_label_lengths:
+                label_len = lengths[li].astype(jnp.int32)
+            lab = label.astype(jnp.int32)
+            if label_len is None:
+                # negative (or, for blank_label='first', zero) entries pad
+                valid = (lab >= 0) if blank_label == "last" else (lab > 0)
+                label_len = jnp.sum(valid.astype(jnp.int32), axis=1)
+            if data_len is None:
+                data_len = jnp.full((N,), T, jnp.int32)
+
+            blank = 0 if blank_label == "first" else C - 1
+            if blank_label == "first":
+                # labels are 1-based with 0 = blank/padding
+                lab_idx = lab
+            else:
+                lab_idx = lab
+            lab_safe = jnp.clip(lab_idx, 0, C - 1)
+
+            # extended sequence z: (N, S) = blank, l0, blank, l1, ... blank
+            z = jnp.full((N, S), blank, jnp.int32)
+            z = z.at[:, 1::2].set(lab_safe)
+            pos = jnp.arange(S)[None, :]                     # (1, S)
+            in_seq = pos < (2 * label_len[:, None] + 1)      # (N, S)
+
+            # allow skip (s-2 -> s) where z_s is a real label differing
+            # from z_{s-2}
+            z_m2 = jnp.concatenate(
+                [jnp.full((N, 2), -1, jnp.int32), z[:, :-2]], axis=1)
+            can_skip = (pos % 2 == 1) & (z != z_m2)
+
+            logp = jax.nn.log_softmax(data.astype(jnp.float32), axis=2)
+            # per-step label log-probs: (T, N, S)
+            lp_z = jnp.take_along_axis(
+                logp, jnp.broadcast_to(z[None], (T, N, S)), axis=2)
+
+            alpha0 = jnp.full((N, S), NEG, jnp.float32)
+            alpha0 = alpha0.at[:, 0].set(lp_z[0, :, 0])
+            alpha0 = alpha0.at[:, 1].set(
+                jnp.where(label_len > 0, lp_z[0, :, 1], NEG))
+
+            def step(alpha, inp):
+                lp_t, t = inp
+                a_m1 = jnp.concatenate(
+                    [jnp.full((N, 1), NEG), alpha[:, :-1]], axis=1)
+                a_m2 = jnp.concatenate(
+                    [jnp.full((N, 2), NEG), alpha[:, :-2]], axis=1)
+                a_m2 = jnp.where(can_skip, a_m2, NEG)
+                m = jnp.maximum(jnp.maximum(alpha, a_m1), a_m2)
+                new = m + jnp.log(
+                    jnp.exp(alpha - m) + jnp.exp(a_m1 - m) +
+                    jnp.exp(a_m2 - m)) + lp_t
+                new = jnp.where(in_seq, new, NEG)
+                # freeze past each sample's sequence end
+                new = jnp.where((t < data_len)[:, None], new, alpha)
+                return new, None
+
+            ts = jnp.arange(1, T)
+            alphaT, _ = lax.scan(step, alpha0, (lp_z[1:], ts))
+
+            # loss = -log(alpha_T[2L] + alpha_T[2L-1])
+            endb = jnp.take_along_axis(
+                alphaT, (2 * label_len)[:, None], axis=1)[:, 0]
+            endl = jnp.take_along_axis(
+                alphaT, jnp.maximum(2 * label_len - 1, 0)[:, None],
+                axis=1)[:, 0]
+            endl = jnp.where(label_len > 0, endl, NEG)
+            m = jnp.maximum(endb, endl)
+            ll = m + jnp.log(jnp.exp(endb - m) + jnp.exp(endl - m))
+            return -ll
+        return fn
+
+    register_op("CTCLoss", ctc_maker,
+                aliases=("ctc_loss", "_contrib_CTCLoss",
+                         "_contrib_ctc_loss"))
+
+
+_register()

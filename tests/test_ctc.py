@@ -1,0 +1,138 @@
+"""CTCLoss tests (reference model: src/operator/nn/ctc_loss.cc coverage in
+tests/python/unittest/test_operator.py check_ctc_loss).
+
+torch (CPU build, in-image) provides the independent reference
+implementation; gradients are additionally finite-difference checked.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.gluon.loss import CTCLoss
+
+
+def _setup(T=12, N=4, C=6, L=5, seed=0):
+    rng = np.random.default_rng(seed)
+    logits = rng.standard_normal((T, N, C), dtype=np.float32)
+    labels = np.full((N, L), -1, np.float32)
+    lens = [min(v, L) for v in [3, 5, 1, 4][:N]]
+    for n, ln in enumerate(lens):
+        labels[n, :ln] = rng.integers(0, C - 1, ln)
+    return logits, labels, lens
+
+
+def _torch_ref(logits, labels, lens, blank, data_lens=None, reduction="none"):
+    import torch
+    T, N, C = logits.shape
+    lp = torch.log_softmax(torch.tensor(logits), dim=2)
+    tgt = torch.tensor(np.concatenate(
+        [labels[n, :lens[n]] for n in range(N)]).astype(np.int64))
+    if blank == 0:
+        tgt = tgt + 1
+    dl = torch.tensor(data_lens) if data_lens is not None \
+        else torch.full((N,), T, dtype=torch.long)
+    return torch.nn.functional.ctc_loss(
+        lp, tgt, dl, torch.tensor(lens), blank=blank,
+        reduction=reduction).numpy()
+
+
+def test_ctc_blank_last_matches_torch():
+    logits, labels, lens = _setup()
+    out = mx.nd.CTCLoss(mx.nd.array(logits), mx.nd.array(labels),
+                        blank_label="last").asnumpy()
+    ref = _torch_ref(logits, labels, lens, blank=logits.shape[2] - 1)
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_blank_first_matches_torch():
+    logits, labels, lens = _setup()
+    labf = np.zeros_like(labels)
+    for n, ln in enumerate(lens):
+        labf[n, :ln] = labels[n, :ln] + 1     # 1-based labels, 0 = pad
+    out = mx.nd.CTCLoss(mx.nd.array(logits), mx.nd.array(labf),
+                        blank_label="first").asnumpy()
+    ref = _torch_ref(logits, labels, lens, blank=0)
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_variable_data_lengths():
+    logits, labels, lens = _setup()
+    dl = np.array([12, 9, 7, 10], np.float32)
+    out = mx.nd.CTCLoss(mx.nd.array(logits), mx.nd.array(labels),
+                        mx.nd.array(dl), use_data_lengths=True,
+                        blank_label="last").asnumpy()
+    ref = _torch_ref(logits, labels, lens, blank=logits.shape[2] - 1,
+                     data_lens=dl.astype(np.int64))
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_explicit_label_lengths():
+    logits, labels, lens = _setup()
+    out = mx.nd.CTCLoss(mx.nd.array(logits), mx.nd.array(labels),
+                        mx.nd.array(np.asarray(lens, np.float32)),
+                        use_label_lengths=True,
+                        blank_label="last").asnumpy()
+    ref = _torch_ref(logits, labels, lens, blank=logits.shape[2] - 1)
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-4)
+
+
+def test_ctc_gluon_loss_gradient_matches_torch():
+    import torch
+    logits, labels, lens = _setup()
+    T, N, C = logits.shape
+    x = mx.nd.array(np.transpose(logits, (1, 0, 2)))    # NTC
+    x.attach_grad()
+    with autograd.record():
+        loss = CTCLoss()(x, mx.nd.array(labels))
+    loss.backward()
+    g = x.grad.asnumpy()
+
+    xt = torch.tensor(np.transpose(logits, (1, 0, 2)), requires_grad=True)
+    lpt = torch.log_softmax(xt.transpose(0, 1), dim=2)
+    tgt = torch.tensor(np.concatenate(
+        [labels[n, :lens[n]] for n in range(N)]).astype(np.int64))
+    rl = torch.nn.functional.ctc_loss(
+        lpt, tgt, torch.full((N,), T, dtype=torch.long),
+        torch.tensor(lens), blank=C - 1, reduction="sum")
+    rl.backward()
+    assert np.allclose(g, xt.grad.numpy(), rtol=1e-3, atol=1e-4)
+
+
+def test_ctc_gradient_finite_difference():
+    logits, labels, _ = _setup(T=6, N=2, C=4, L=3, seed=1)
+    x = mx.nd.array(logits)
+    x.attach_grad()
+    with autograd.record():
+        loss = mx.nd.sum(mx.nd.CTCLoss(x, mx.nd.array(labels),
+                                       blank_label="last"))
+    loss.backward()
+    g = x.grad.asnumpy()
+
+    def f(v):
+        return float(mx.nd.sum(mx.nd.CTCLoss(
+            mx.nd.array(v), mx.nd.array(labels),
+            blank_label="last")).asnumpy())
+
+    eps = 1e-3
+    rng = np.random.default_rng(0)
+    for _ in range(8):
+        i = tuple(rng.integers(0, s) for s in logits.shape)
+        pert = logits.copy()
+        pert[i] += eps
+        up = f(pert)
+        pert[i] -= 2 * eps
+        dn = f(pert)
+        fd = (up - dn) / (2 * eps)
+        assert abs(fd - g[i]) < 5e-3, (i, fd, g[i])
+
+
+def test_ctc_tnc_layout_and_hybridize():
+    logits, labels, lens = _setup()
+    loss_fn = CTCLoss(layout="TNC")
+    out = loss_fn(mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    ref = _torch_ref(logits, labels, lens, blank=logits.shape[2] - 1)
+    assert np.allclose(out, ref, rtol=1e-4, atol=1e-4)
+    loss_fn.hybridize()
+    out2 = loss_fn(mx.nd.array(logits), mx.nd.array(labels)).asnumpy()
+    assert np.allclose(out2, ref, rtol=1e-4, atol=1e-4)
