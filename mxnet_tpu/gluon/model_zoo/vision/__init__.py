@@ -4,7 +4,8 @@ import importlib as _importlib
 from ....base import MXNetError
 
 _models = {}
-for _modname in ("resnet", "alexnet", "vgg", "mobilenet"):
+for _modname in ("resnet", "alexnet", "vgg", "mobilenet", "densenet",
+                 "squeezenet", "inception"):
     _mod = _importlib.import_module(f".{_modname}", __name__)
     for _name in _mod.__all__:
         _obj = getattr(_mod, _name)
@@ -17,6 +18,9 @@ from .resnet import *      # noqa: F401,F403,E402
 from .vgg import *         # noqa: F401,F403,E402
 from .mobilenet import *   # noqa: F401,F403,E402
 from .alexnet import *     # noqa: F401,F403,E402
+from .densenet import *    # noqa: F401,F403,E402
+from .squeezenet import *  # noqa: F401,F403,E402
+from .inception import *   # noqa: F401,F403,E402
 
 
 def get_model(name, **kwargs):

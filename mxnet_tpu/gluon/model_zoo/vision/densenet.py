@@ -1,0 +1,117 @@
+"""DenseNet (reference: python/mxnet/gluon/model_zoo/vision/densenet.py).
+
+Densely-connected conv nets (Huang et al. 2017): each layer's input is the
+channel-concat of all previous feature maps in its block.  On TPU the
+concat chain is pure layout bookkeeping for XLA — the BN→relu→1x1→3x3
+bottlenecks all land on the MXU.
+"""
+from __future__ import annotations
+
+from ...block import HybridBlock
+from ... import nn
+
+__all__ = ["DenseNet", "densenet121", "densenet161", "densenet169",
+           "densenet201"]
+
+# num_init_features, growth_rate, block_config
+_SPECS = {
+    121: (64, 32, [6, 12, 24, 16]),
+    161: (96, 48, [6, 12, 36, 24]),
+    169: (64, 32, [6, 12, 32, 32]),
+    201: (64, 32, [6, 12, 48, 32]),
+}
+
+
+def _make_dense_layer(growth_rate, bn_size, dropout):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(bn_size * growth_rate, kernel_size=1, use_bias=False))
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(growth_rate, kernel_size=3, padding=1, use_bias=False))
+    if dropout:
+        out.add(nn.Dropout(dropout))
+    return out
+
+
+class _DenseLayer(HybridBlock):
+    """One dense layer: new features concatenated onto the running stack."""
+
+    def __init__(self, growth_rate, bn_size, dropout, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.body = _make_dense_layer(growth_rate, bn_size, dropout)
+
+    def hybrid_forward(self, F, x):
+        return F.concat(x, self.body(x), dim=1)
+
+
+def _make_dense_block(num_layers, bn_size, growth_rate, dropout, stage_index):
+    out = nn.HybridSequential(prefix=f"stage{stage_index}_")
+    with out.name_scope():
+        for _ in range(num_layers):
+            out.add(_DenseLayer(growth_rate, bn_size, dropout))
+    return out
+
+
+def _make_transition(num_output_features):
+    out = nn.HybridSequential(prefix="")
+    out.add(nn.BatchNorm())
+    out.add(nn.Activation("relu"))
+    out.add(nn.Conv2D(num_output_features, kernel_size=1, use_bias=False))
+    out.add(nn.AvgPool2D(pool_size=2, strides=2))
+    return out
+
+
+class DenseNet(HybridBlock):
+    def __init__(self, num_init_features, growth_rate, block_config,
+                 bn_size=4, dropout=0, classes=1000, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.features = nn.HybridSequential(prefix="")
+            self.features.add(nn.Conv2D(num_init_features, kernel_size=7,
+                                        strides=2, padding=3, use_bias=False))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            self.features.add(nn.MaxPool2D(pool_size=3, strides=2, padding=1))
+            num_features = num_init_features
+            for i, num_layers in enumerate(block_config):
+                self.features.add(_make_dense_block(
+                    num_layers, bn_size, growth_rate, dropout, i + 1))
+                num_features += num_layers * growth_rate
+                if i != len(block_config) - 1:
+                    num_features //= 2
+                    self.features.add(_make_transition(num_features))
+            self.features.add(nn.BatchNorm())
+            self.features.add(nn.Activation("relu"))
+            # upstream uses AvgPool2D(7) (224-input specific); global avg
+            # is identical there and input-size robust
+            self.features.add(nn.GlobalAvgPool2D())
+            self.features.add(nn.Flatten())
+            self.output = nn.Dense(classes)
+
+    def hybrid_forward(self, F, x):
+        x = self.features(x)
+        return self.output(x)
+
+
+def _densenet(num_layers, **kwargs):
+    num_init_features, growth_rate, block_config = _SPECS[num_layers]
+    return DenseNet(num_init_features, growth_rate, block_config, **kwargs)
+
+
+def densenet121(**kwargs):
+    return _densenet(121, **kwargs)
+
+
+def densenet161(**kwargs):
+    return _densenet(161, **kwargs)
+
+
+def densenet169(**kwargs):
+    return _densenet(169, **kwargs)
+
+
+def densenet201(**kwargs):
+    return _densenet(201, **kwargs)

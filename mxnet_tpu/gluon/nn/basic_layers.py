@@ -17,7 +17,7 @@ from ..parameter import Parameter
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "InstanceNorm", "LayerNorm", "Embedding", "Flatten", "Lambda",
            "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU", "SELU",
-           "GELU", "Swish"]
+           "GELU", "Swish", "HybridConcurrent", "Identity"]
 
 
 def _prod(it):
@@ -75,6 +75,31 @@ class HybridSequential(HybridBlock):
 
     def __iter__(self):
         return iter(self._children.values())
+
+
+class HybridConcurrent(HybridBlock):
+    """Run children on the same input, concatenate outputs along ``axis``
+    (reference: python/mxnet/gluon/contrib/nn/basic_layers.py
+    HybridConcurrent — the Inception/DenseNet branch container)."""
+
+    def __init__(self, axis=-1, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self.axis = axis
+
+    def add(self, *blocks):
+        for b in blocks:
+            self.register_child(b)
+
+    def hybrid_forward(self, F, x):
+        outs = [child(x) for child in self._children.values()]
+        return F.concat(*outs, dim=self.axis)
+
+
+class Identity(HybridBlock):
+    """Pass-through block (reference: gluon.contrib.nn.Identity)."""
+
+    def hybrid_forward(self, F, x):
+        return x
 
 
 class Dense(HybridBlock):
