@@ -222,5 +222,56 @@ def _register():
     register_op("multi_mp_sgd_mom_update", multi_mp_sgd_mom_update_maker,
                 differentiable=False)
 
+    # ---- AdamW (decoupled weight decay; reference:
+    # src/operator/contrib/adamw.cc _contrib_adamw_update) ----------------
+    def adamw_update_maker(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                           eta=1.0, rescale_grad=1.0, clip_gradient=-1.0):
+        def fn(weight, grad, mean, var, lr):
+            lr = lr.astype(weight.dtype)
+            g = grad * rescale_grad
+            if clip_gradient is not None and clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            m = beta1 * mean + (1 - beta1) * g
+            v = beta2 * var + (1 - beta2) * jnp.square(g)
+            # decoupled decay: wd applies to the weight directly, NOT
+            # through the adaptive preconditioner
+            w = weight - eta * (lr * m / (jnp.sqrt(v) + epsilon) +
+                                wd * weight)
+            return (w, m, v)
+        return fn
+    register_op("_contrib_adamw_update", adamw_update_maker,
+                aliases=("adamw_update",), differentiable=False)
+
+    def mp_adamw_update_maker(beta1=0.9, beta2=0.999, epsilon=1e-8, wd=0.0,
+                              eta=1.0, rescale_grad=1.0, clip_gradient=-1.0):
+        def fn(weight, grad, mean, var, w32, lr):
+            lr32 = lr.astype(jnp.float32)
+            g = grad.astype(jnp.float32) * rescale_grad
+            if clip_gradient is not None and clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            m = beta1 * mean + (1 - beta1) * g
+            v = beta2 * var + (1 - beta2) * jnp.square(g)
+            new32 = w32 - eta * (lr32 * m / (jnp.sqrt(v) + epsilon) +
+                                 wd * w32)
+            return (new32.astype(weight.dtype), m, v, new32)
+        return fn
+    register_op("_contrib_mp_adamw_update", mp_adamw_update_maker,
+                aliases=("mp_adamw_update",), differentiable=False)
+
+    # ---- LARS ingredients (reference: src/operator/contrib/
+    # multi_lars-inl.h lars_update path) ----------------------------------
+    def lars_trust_maker(eta=0.001, epsilon=1e-8, rescale_grad=1.0):
+        def fn(weight, grad, wd):
+            w_norm = jnp.sqrt(jnp.sum(
+                jnp.square(weight.astype(jnp.float32))))
+            g_norm = jnp.sqrt(jnp.sum(jnp.square(
+                grad.astype(jnp.float32) * rescale_grad)))
+            trust = eta * w_norm / (g_norm + wd * w_norm + epsilon)
+            # layers with zero/degenerate norms fall back to trust=1
+            return jnp.where((w_norm > 0) & (g_norm > 0), trust,
+                             jnp.float32(1.0))
+        return fn
+    register_op("lars_trust", lars_trust_maker, differentiable=False)
+
 
 _register()

@@ -20,8 +20,8 @@ from .ndarray import NDArray, zeros as nd_zeros, array as nd_array
 from .ndarray.register import invoke_by_name
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp", "Ftrl",
-           "Signum", "AdaDelta", "register", "create", "Updater",
-           "get_updater"]
+           "Signum", "AdaDelta", "AdamW", "LARS", "LBSGD", "register",
+           "create", "Updater", "get_updater"]
 
 _registry: Dict[str, type] = {}
 
@@ -473,6 +473,185 @@ class AdaDelta(Optimizer):
         acc_g._set_data(acc_g_new._read())
         acc_d._set_data(acc_d_new._read())
         weight._set_data((weight - delta - wd * weight)._read())
+
+
+@register
+class AdamW(Optimizer):
+    """Adam with decoupled weight decay (reference:
+    src/operator/contrib/adamw.cc + python contrib.optimizer.AdamW).
+
+    ``wd`` is applied to the weight directly (scaled by ``eta``), outside
+    the adaptive preconditioner; bias correction is folded into the lr
+    passed to the fused op, as the reference python wrapper does.
+    """
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, eta=1.0, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon, self.eta = \
+            beta1, beta2, epsilon, eta
+
+    def create_state(self, index, weight):
+        import numpy as np
+        return (nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=np.float32),
+                nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=np.float32))
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            w32 = weight.astype(_np.float32)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def _corrected_lr(self, index):
+        t = self._index_update_count[index]
+        return self._get_lr(index) * \
+            math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+
+    def _kw(self, index):
+        # decoupled decay is lr-scaled (w -= lr*wd*w, the torch/Loshchilov
+        # convention); the op applies eta*wd_in*w, so fold the PLAIN lr
+        # into wd_in while the op's lr input carries bias correction
+        kw = {"beta1": self.beta1, "beta2": self.beta2,
+              "epsilon": self.epsilon,
+              "wd": self._get_wd(index) * self._get_lr(index),
+              "eta": self.eta, "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._kw(index)
+        lr = nd_array(_np.float32(self._corrected_lr(index)),
+                      ctx=weight.context)
+        mean, var = state
+        invoke_by_name("adamw_update", [weight, grad, mean, var, lr], kw,
+                       out=[weight, mean, var])
+
+    def update_multi_precision(self, index, weight, grad, state):
+        # mp state is ((mean, var), w32); plain fp32 state is (mean, var)
+        # — the inner-tuple check keeps them apart
+        if self.multi_precision and isinstance(state, tuple) and \
+                len(state) == 2 and isinstance(state[0], tuple) and \
+                isinstance(state[1], NDArray):
+            (mean, var), w32 = state
+            self._update_count(index)
+            kw = self._kw(index)
+            lr = nd_array(_np.float32(self._corrected_lr(index)),
+                          ctx=weight.context)
+            invoke_by_name("mp_adamw_update",
+                           [weight, grad, mean, var, w32, lr], kw,
+                           out=[weight, mean, var, w32])
+        else:
+            self.update(index, weight, grad, state)
+
+
+@register
+class LARS(Optimizer):
+    """Layer-wise Adaptive Rate Scaling (reference: the LARS optimizer +
+    multi_lars contrib kernels that landed for large-batch ResNet;
+    You et al. 2017).
+
+    Per layer: ``local_lr = eta * ||w|| / (||g*rescale|| + wd*||w|| + eps)``
+    computed ON DEVICE by the ``lars_trust`` op (no host sync), folded into
+    the lr input of the fused sgd(_mom) update.
+    """
+
+    def __init__(self, momentum=0.9, eta=0.001, epsilon=1e-8, **kwargs):
+        super().__init__(**kwargs)
+        self.momentum = momentum
+        self.eta = eta
+        self.epsilon = epsilon
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, ctx=weight.context,
+                        dtype=weight.dtype)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        trust = invoke_by_name(
+            "lars_trust", [weight, grad,
+                           nd_array(_np.float32(self._get_wd(index)),
+                                    ctx=weight.context)],
+            {"eta": self.eta, "epsilon": self.epsilon,
+             "rescale_grad": self.rescale_grad})
+        lr = self._lr_nd(index, weight) * trust
+        if self.momentum == 0.0:
+            invoke_by_name("sgd_update", [weight, grad, lr], kw, out=weight)
+        else:
+            kw["momentum"] = self.momentum
+            invoke_by_name("sgd_mom_update", [weight, grad, state, lr], kw,
+                           out=[weight, state])
+
+
+@register
+class LBSGD(Optimizer):
+    """Large-Batch SGD with warmup + LARS trust scaling (reference:
+    python/mxnet/optimizer/optimizer.py LBSGD).
+
+    warmup_strategy: 'linear'/'power2'/'sqrt' ramp the lr over
+    ``warmup_epochs``; 'lars' applies the layer-wise trust ratio every
+    step (the reference's default large-batch recipe).
+    """
+
+    def __init__(self, momentum=0.0, multi_precision=False,
+                 warmup_strategy="linear", warmup_epochs=5,
+                 batch_scale=1, updates_per_epoch=32, begin_epoch=0,
+                 num_epochs=60, eta=0.001, **kwargs):
+        super().__init__(multi_precision=multi_precision, **kwargs)
+        self.momentum = momentum
+        self.warmup_strategy = warmup_strategy
+        self.warmup_epochs = warmup_epochs
+        self.batch_scale = batch_scale
+        self.updates_per_epoch = max(1, updates_per_epoch)
+        self.begin_epoch = begin_epoch
+        self.num_epochs = num_epochs
+        self.eta = eta
+        self.epsilon = 1e-8
+
+    def create_state(self, index, weight):
+        if self.momentum == 0.0:
+            return None
+        return nd_zeros(weight.shape, ctx=weight.context,
+                        dtype=weight.dtype)
+
+    def _warmup_scale(self, index) -> float:
+        t = self._index_update_count[index]
+        warm_T = self.warmup_epochs * self.updates_per_epoch
+        if self.warmup_strategy not in ("linear", "power2", "sqrt") or \
+                t >= warm_T:
+            return 1.0
+        frac = t / warm_T
+        if self.warmup_strategy == "linear":
+            return frac
+        if self.warmup_strategy == "power2":
+            return frac * frac
+        return math.sqrt(frac)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        kw = self._common_kwargs(index)
+        scale = self._warmup_scale(index)
+        lr = self._lr_nd(index, weight, scale=scale)
+        if self.warmup_strategy == "lars":
+            trust = invoke_by_name(
+                "lars_trust", [weight, grad,
+                               nd_array(_np.float32(self._get_wd(index)),
+                                        ctx=weight.context)],
+                {"eta": self.eta, "epsilon": self.epsilon,
+                 "rescale_grad": self.rescale_grad})
+            lr = lr * trust
+        if self.momentum == 0.0:
+            invoke_by_name("sgd_update", [weight, grad, lr], kw, out=weight)
+        else:
+            kw["momentum"] = self.momentum
+            invoke_by_name("sgd_mom_update", [weight, grad, state, lr], kw,
+                           out=[weight, state])
 
 
 class Updater:

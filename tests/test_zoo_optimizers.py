@@ -1,0 +1,127 @@
+"""New model-zoo families + large-batch optimizers (round-3 additions).
+
+Reference models: python/mxnet/gluon/model_zoo/vision/{densenet,
+squeezenet,inception}.py; optimizer.py LBSGD/LARS; contrib adamw.
+torch (in-image) is the AdamW numerical reference.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, gluon
+from mxnet_tpu.gluon.model_zoo.vision import get_model
+
+
+@pytest.mark.parametrize("name,size", [
+    ("densenet121", 64), ("squeezenet1_0", 96), ("squeezenet1_1", 64),
+])
+def test_zoo_forward_shapes(name, size):
+    net = get_model(name, classes=10)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.uniform(-1, 1, (2, 3, size, size))
+                    .astype(np.float32))
+    y = net(x)
+    assert y.shape == (2, 10)
+
+
+def test_inception_v3_forward():
+    net = get_model("inception_v3", classes=7)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.uniform(-1, 1, (1, 3, 299, 299))
+                    .astype(np.float32))
+    assert net(x).shape == (1, 7)
+
+
+def test_densenet_trains():
+    net = get_model("densenet121", classes=4)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.05, "momentum": 0.9})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    x = mx.nd.array(np.random.uniform(-1, 1, (4, 3, 64, 64))
+                    .astype(np.float32))
+    y = mx.nd.array(np.array([0, 1, 2, 3]))
+    losses = []
+    for _ in range(4):
+        with autograd.record():
+            L = mx.nd.mean(loss_fn(net(x), y))
+        L.backward()
+        tr.step(4)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0]
+
+
+def test_adamw_matches_torch():
+    import torch
+    w0 = np.random.randn(5, 4).astype(np.float32)
+    grads = [np.random.randn(5, 4).astype(np.float32) for _ in range(5)]
+    w = mx.nd.array(w0)
+    opt = mx.optimizer.create("adamw", learning_rate=0.01, wd=0.1)
+    state = opt.create_state(0, w)
+    for g in grads:
+        opt.update(0, w, mx.nd.array(g), state)
+    wt = torch.tensor(w0.copy())
+    topt = torch.optim.AdamW([wt], lr=0.01, weight_decay=0.1, eps=1e-8)
+    for g in grads:
+        wt.grad = torch.tensor(g)
+        topt.step()
+    np.testing.assert_allclose(w.asnumpy(), wt.detach().numpy(),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_lars_trust_ratio_scales_update():
+    """LARS step size follows eta*||w||/||g||, not the raw gradient
+    scale — a 100x larger gradient must produce the SAME step size."""
+    w1 = mx.nd.array(np.ones((4, 4), np.float32))
+    w2 = mx.nd.array(np.ones((4, 4), np.float32))
+    g = np.ones((4, 4), np.float32) * 0.1
+    opt = mx.optimizer.create("lars", learning_rate=1.0, eta=0.1,
+                              momentum=0.0)
+    opt.update(0, w1, mx.nd.array(g), opt.create_state(0, w1))
+    opt2 = mx.optimizer.create("lars", learning_rate=1.0, eta=0.1,
+                               momentum=0.0)
+    opt2.update(0, w2, mx.nd.array(g * 100), opt2.create_state(0, w2))
+    step1 = 1.0 - w1.asnumpy()
+    step2 = 1.0 - w2.asnumpy()
+    np.testing.assert_allclose(step1, step2, rtol=1e-5)
+
+
+@pytest.mark.parametrize("strategy", ["linear", "power2", "sqrt"])
+def test_lbsgd_warmup_ramps(strategy):
+    opt = mx.optimizer.create("lbsgd", learning_rate=1.0,
+                              warmup_strategy=strategy, warmup_epochs=2,
+                              updates_per_epoch=5)
+    w = mx.nd.array(np.ones((2, 2), np.float32) * 10)
+    st = opt.create_state(0, w)
+    steps = []
+    prev = w.asnumpy().copy()
+    for _ in range(10):
+        opt.update(0, w, mx.nd.array(np.ones((2, 2), np.float32)), st)
+        cur = w.asnumpy().copy()
+        steps.append(np.abs(prev - cur).mean())
+        prev = cur
+    # warmup: early steps strictly smaller than late steps
+    assert steps[0] < steps[-1]
+
+
+def test_lars_and_lbsgd_converge():
+    np.random.seed(0)
+    for name, kw in [("lars", {"learning_rate": 1.0, "momentum": 0.9,
+                               "eta": 0.1}),
+                     ("lbsgd", {"learning_rate": 1.0, "momentum": 0.9,
+                                "warmup_strategy": "lars", "eta": 0.1})]:
+        net = gluon.nn.Dense(1, in_units=8)
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), name, kw)
+        X = np.random.randn(64, 8).astype(np.float32)
+        yt = X @ np.arange(8, dtype=np.float32)[:, None]
+        l0 = None
+        for _ in range(80):
+            with autograd.record():
+                L = mx.nd.mean(mx.nd.square(
+                    net(mx.nd.array(X)) - mx.nd.array(yt)))
+            L.backward()
+            tr.step(64)
+            if l0 is None:
+                l0 = float(L.asnumpy())
+        assert float(L.asnumpy()) < l0 * 0.5, name
