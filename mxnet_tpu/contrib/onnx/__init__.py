@@ -1,0 +1,18 @@
+"""ONNX interop (reference: python/mxnet/contrib/onnx — mx2onnx
+export_model + onnx2mx import_model; SURVEY.md §2.5 misc row).
+
+Covers the conv-net op set the model zoo emits (Convolution, BatchNorm,
+Activation, Pooling incl. global, FullyConnected, Flatten, Concat,
+Dropout, softmax, elemwise/broadcast add-mul, Reshape).  Serialization is
+the in-tree wire codec (_proto.py) — no onnx package needed; emitted
+files follow the public ONNX schema (opset 12).
+"""
+from .export import export_model
+from .import_ import import_model
+
+__all__ = ["export_model", "import_model"]
+
+
+class onnx:          # namespace parity: mx.contrib.onnx.onnx2mx style
+    export_model = staticmethod(export_model)
+    import_model = staticmethod(import_model)

@@ -1,0 +1,109 @@
+"""Minimal protobuf wire-format codec for the ONNX subset we emit/read.
+
+This image carries no `onnx` package, so the ModelProto/GraphProto/
+NodeProto/TensorProto subset is serialized by hand against the public
+ONNX schema (onnx/onnx.proto — field numbers below are that schema's).
+Files written here load in stock onnx/onnxruntime; files produced by
+other exporters load here as long as they stick to this op/field subset.
+
+Wire format: each field is a varint key ``(field_number << 3) | wire_type``
+followed by a varint (type 0), fixed32 (type 5), or length-delimited
+payload (type 2).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict, List, Tuple
+
+# -- encoding ---------------------------------------------------------------
+
+
+def varint(n: int) -> bytes:
+    if n < 0:
+        n += 1 << 64            # protobuf int64 negatives: 10-byte varint
+    out = bytearray()
+    while True:
+        b = n & 0x7F
+        n >>= 7
+        if n:
+            out.append(b | 0x80)
+        else:
+            out.append(b)
+            return bytes(out)
+
+
+def tag(field: int, wire: int) -> bytes:
+    return varint((field << 3) | wire)
+
+
+def f_varint(field: int, v: int) -> bytes:
+    return tag(field, 0) + varint(int(v))
+
+
+def f_float(field: int, v: float) -> bytes:
+    return tag(field, 5) + struct.pack("<f", float(v))
+
+
+def f_bytes(field: int, payload: bytes) -> bytes:
+    return tag(field, 2) + varint(len(payload)) + payload
+
+
+def f_str(field: int, s: str) -> bytes:
+    return f_bytes(field, s.encode("utf-8"))
+
+
+# -- decoding ---------------------------------------------------------------
+
+
+def parse(buf: bytes) -> Dict[int, List[Tuple[int, object]]]:
+    """Parse one message into {field_number: [(wire_type, value), ...]}.
+    Length-delimited values are returned as raw bytes (callers recurse)."""
+    fields: Dict[int, List[Tuple[int, object]]] = {}
+    i, n = 0, len(buf)
+    while i < n:
+        key, i = _read_varint(buf, i)
+        field, wire = key >> 3, key & 7
+        if wire == 0:
+            v, i = _read_varint(buf, i)
+        elif wire == 5:
+            v = struct.unpack_from("<f", buf, i)[0]
+            i += 4
+        elif wire == 1:
+            v = struct.unpack_from("<d", buf, i)[0]
+            i += 8
+        elif wire == 2:
+            ln, i = _read_varint(buf, i)
+            v = buf[i:i + ln]
+            i += ln
+        else:
+            raise ValueError(f"unsupported wire type {wire}")
+        fields.setdefault(field, []).append((wire, v))
+    return fields
+
+
+def _read_varint(buf: bytes, i: int) -> Tuple[int, int]:
+    shift, result = 0, 0
+    while True:
+        b = buf[i]
+        i += 1
+        result |= (b & 0x7F) << shift
+        if not b & 0x80:
+            if result >= 1 << 63:
+                result -= 1 << 64      # signed int64
+            return result, i
+        shift += 7
+
+
+def get1(fields, num, default=None):
+    vals = fields.get(num)
+    return vals[0][1] if vals else default
+
+
+def get_all(fields, num):
+    return [v for _, v in fields.get(num, [])]
+
+
+def get_str(fields, num, default=""):
+    v = get1(fields, num)
+    return v.decode("utf-8") if isinstance(v, (bytes, bytearray)) else \
+        (v if v is not None else default)

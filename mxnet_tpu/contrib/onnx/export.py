@@ -1,0 +1,241 @@
+"""Symbol graph → ONNX ModelProto (reference:
+python/mxnet/contrib/onnx/mx2onnx/export_model.py + _op_translations.py).
+
+Walks the Symbol topo order, translating each node to ONNX ops via the
+table below; parameters become initializers (raw little-endian), the
+remaining free variable becomes the graph input.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_OPSET = 12
+
+# TensorProto.DataType
+_F32, _I64 = 1, 7
+
+_ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
+        "softrelu": "Softplus", "softsign": "Softsign"}
+
+
+def _attr(node_attrs, key, default=None):
+    v = node_attrs.get(key, default)
+    if isinstance(v, str):
+        try:
+            v = ast.literal_eval(v)
+        except (ValueError, SyntaxError):
+            pass
+    return v
+
+
+def _a_int(name, v):
+    return P.f_bytes(5, P.f_str(1, name) + P.f_varint(20, 2) +
+                     P.f_varint(3, int(v)))
+
+
+def _a_float(name, v):
+    return P.f_bytes(5, P.f_str(1, name) + P.f_varint(20, 1) +
+                     P.f_float(2, float(v)))
+
+
+def _a_ints(name, vals):
+    body = P.f_str(1, name) + P.f_varint(20, 7)
+    for v in vals:
+        body += P.f_varint(8, int(v))
+    return P.f_bytes(5, body)
+
+
+def _node(op_type, inputs, outputs, name, attrs=b""):
+    body = b""
+    for i in inputs:
+        body += P.f_str(1, i)
+    for o in outputs:
+        body += P.f_str(2, o)
+    body += P.f_str(3, name) + P.f_str(4, op_type) + attrs
+    return P.f_bytes(1, body)       # GraphProto.node = 1
+
+
+def _tensor(name, arr):
+    arr = _np.ascontiguousarray(arr)
+    if arr.dtype == _np.int64:
+        dt = _I64
+    else:
+        arr = arr.astype(_np.float32)
+        dt = _F32
+    body = b""
+    for d in arr.shape:
+        body += P.f_varint(1, d)
+    body += P.f_varint(2, dt) + P.f_str(8, name) + \
+        P.f_bytes(9, arr.tobytes())
+    return body
+
+
+def _value_info(name, shape, field=11):
+    dims = b""
+    for d in shape:
+        dims += P.f_bytes(1, P.f_varint(1, int(d)))    # Dimension.dim_value
+    tshape = P.f_bytes(2, dims)                        # Tensor.shape
+    ttype = P.f_bytes(1, P.f_varint(1, _F32) + tshape)  # TypeProto.tensor
+    return P.f_bytes(field, P.f_str(1, name) + P.f_bytes(2, ttype))
+
+
+def export_model(sym, params, input_shape=None, input_type=_np.float32,
+                 onnx_file_path="model.onnx"):
+    """Export (symbol, params) to an ONNX file; returns the path.
+    ``sym``/``params`` may be in-memory objects or file paths, as in the
+    reference API."""
+    from ...symbol import load as sym_load
+    from ...model import load_params
+    if isinstance(sym, str):
+        sym = sym_load(sym)
+    if isinstance(params, str):
+        arg, aux = load_params(params)
+        params = {**arg, **aux}
+    params = {k.split(":", 1)[-1]: v for k, v in params.items()}
+    np_params = {k: v.asnumpy() if hasattr(v, "asnumpy") else _np.asarray(v)
+                 for k, v in params.items()}
+
+    nodes_pb: List[bytes] = []
+    inits_pb: List[bytes] = []
+    inputs_pb: List[bytes] = []
+    outputs_pb: List[bytes] = []
+    name_of: Dict[int, List[str]] = {}     # id(node) -> output names
+    extra = [0]                            # uniquifier for helper nodes
+
+    def out_names(node):
+        if node.num_outputs == 1:
+            return [node.name]
+        return [f"{node.name}_output{i}" for i in range(node.num_outputs)]
+
+    def add_init(name, arr):
+        inits_pb.append(P.f_bytes(5, _tensor(name, arr)))
+
+    order = sym._topo()
+    data_inputs = []
+    for node in order:
+        if node.is_var:
+            name_of[id(node)] = [node.name]
+            if node.name in np_params:
+                add_init(node.name, np_params[node.name])
+            else:
+                data_inputs.append(node.name)
+            continue
+        ins = [name_of[id(p)][idx] for p, idx in node.inputs]
+        outs = out_names(node)
+        name_of[id(node)] = outs
+        a = node.attrs
+        op = node.op
+
+        if op == "Convolution":
+            kernel = _attr(a, "kernel")
+            pads = list(_attr(a, "pad", (0,) * len(kernel)))
+            attrs = _a_ints("kernel_shape", kernel) + \
+                _a_ints("strides", _attr(a, "stride", (1,) * len(kernel))) +\
+                _a_ints("dilations", _attr(a, "dilate",
+                                           (1,) * len(kernel))) + \
+                _a_ints("pads", pads + pads) + \
+                _a_int("group", _attr(a, "num_group", 1))
+            nodes_pb.append(_node("Conv", ins, outs, node.name, attrs))
+        elif op == "BatchNorm":
+            eps = _attr(a, "eps", 1e-3)
+            mom = _attr(a, "momentum", 0.9)
+            if _attr(a, "fix_gamma", True):
+                # reference semantics: gamma pinned to 1 — bake it in
+                gname = node.inputs[1][0].name
+                if gname in np_params:
+                    add_init(gname + "_fixed",
+                             _np.ones_like(np_params[gname]))
+                    ins = [ins[0], gname + "_fixed"] + ins[2:]
+            attrs = _a_float("epsilon", eps) + _a_float("momentum", mom)
+            nodes_pb.append(_node("BatchNormalization", ins, [outs[0]],
+                                  node.name, attrs))
+        elif op == "Activation":
+            act = _attr(a, "act_type", "relu")
+            if act not in _ACT:
+                raise MXNetError(f"ONNX export: unsupported activation "
+                                 f"{act!r}")
+            nodes_pb.append(_node(_ACT[act], ins, outs, node.name))
+        elif op == "Pooling":
+            ptype = _attr(a, "pool_type", "max")
+            if _attr(a, "global_pool", False):
+                onnx_op = "GlobalMaxPool" if ptype == "max" else \
+                    "GlobalAveragePool"
+                nodes_pb.append(_node(onnx_op, ins, outs, node.name))
+            else:
+                kernel = _attr(a, "kernel")
+                pads = list(_attr(a, "pad", (0,) * len(kernel)))
+                attrs = _a_ints("kernel_shape", kernel) + \
+                    _a_ints("strides",
+                            _attr(a, "stride", (1,) * len(kernel))) + \
+                    _a_ints("pads", pads + pads)
+                if _attr(a, "pooling_convention", "valid") == "full":
+                    attrs += _a_int("ceil_mode", 1)
+                if ptype == "avg":
+                    attrs += _a_int(
+                        "count_include_pad",
+                        1 if _attr(a, "count_include_pad", True) else 0)
+                onnx_op = "MaxPool" if ptype == "max" else "AveragePool"
+                nodes_pb.append(_node(onnx_op, ins, outs, node.name,
+                                      attrs))
+        elif op == "FullyConnected":
+            src = ins[0]
+            if _attr(a, "flatten", True):
+                fname = f"{node.name}_flatten{extra[0]}"
+                extra[0] += 1
+                nodes_pb.append(_node("Flatten", [src], [fname], fname,
+                                      _a_int("axis", 1)))
+                src = fname
+            attrs = _a_int("transB", 1) + _a_float("alpha", 1.0) + \
+                _a_float("beta", 1.0)
+            nodes_pb.append(_node("Gemm", [src] + ins[1:], outs,
+                                  node.name, attrs))
+        elif op in ("Flatten", "flatten"):
+            nodes_pb.append(_node("Flatten", ins, outs, node.name,
+                                  _a_int("axis", 1)))
+        elif op in ("elemwise_add", "broadcast_add", "_plus", "_add"):
+            nodes_pb.append(_node("Add", ins, outs, node.name))
+        elif op in ("elemwise_mul", "broadcast_mul", "_mul"):
+            nodes_pb.append(_node("Mul", ins, outs, node.name))
+        elif op in ("elemwise_sub", "broadcast_sub", "_sub"):
+            nodes_pb.append(_node("Sub", ins, outs, node.name))
+        elif op in ("Concat", "concat"):
+            nodes_pb.append(_node("Concat", ins, outs, node.name,
+                                  _a_int("axis", _attr(a, "dim", 1))))
+        elif op == "Dropout":
+            nodes_pb.append(_node("Dropout", ins, [outs[0]], node.name,
+                                  _a_float("ratio", _attr(a, "p", 0.5))))
+        elif op in ("softmax", "Softmax"):
+            nodes_pb.append(_node("Softmax", ins, outs, node.name,
+                                  _a_int("axis", _attr(a, "axis", -1))))
+        elif op in ("Reshape", "reshape"):
+            shp = _np.asarray(_attr(a, "shape"), _np.int64)
+            sname = f"{node.name}_shape{extra[0]}"
+            extra[0] += 1
+            add_init(sname, shp)
+            nodes_pb.append(_node("Reshape", [ins[0], sname], outs,
+                                  node.name))
+        else:
+            raise MXNetError(f"ONNX export: op {op!r} has no translation")
+
+    if input_shape is not None and len(data_inputs) == 1:
+        inputs_pb.append(_value_info(data_inputs[0], input_shape, 11))
+    else:
+        for n in data_inputs:
+            inputs_pb.append(_value_info(n, (), 11))
+    for node, idx in sym._heads:
+        outputs_pb.append(_value_info(name_of[id(node)][idx], (), 12))
+
+    graph = b"".join(nodes_pb) + P.f_str(2, "mxnet_tpu") + \
+        b"".join(inits_pb) + b"".join(inputs_pb) + b"".join(outputs_pb)
+    opset = P.f_bytes(8, P.f_str(1, "") + P.f_varint(2, _OPSET))
+    model = P.f_varint(1, 7) + P.f_str(2, "mxnet_tpu") + opset + \
+        P.f_bytes(7, graph)
+    with open(onnx_file_path, "wb") as f:
+        f.write(model)
+    return onnx_file_path

@@ -1,0 +1,220 @@
+"""ONNX ModelProto → (Symbol, arg_params, aux_params) (reference:
+python/mxnet/contrib/onnx/onnx2mx/import_model.py + _op_translations.py).
+"""
+from __future__ import annotations
+
+import struct
+from typing import Dict
+
+import numpy as _np
+
+from ...base import MXNetError
+from . import _proto as P
+
+_ACT_REV = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
+            "Softplus": "softrelu", "Softsign": "softsign"}
+
+
+def _parse_tensor(buf):
+    f = P.parse(buf)
+    dims = tuple(int(d) for d in P.get_all(f, 1))
+    dtype = P.get1(f, 2, 1)
+    name = P.get_str(f, 8)
+    raw = P.get1(f, 9)
+    if raw is not None:
+        np_dt = {1: _np.float32, 6: _np.int32, 7: _np.int64}.get(dtype)
+        if np_dt is None:
+            raise MXNetError(f"ONNX import: tensor dtype {dtype} "
+                             f"unsupported")
+        arr = _np.frombuffer(bytes(raw), np_dt).reshape(dims)
+    elif 4 in f:        # float_data (packed or repeated)
+        vals = []
+        for wire, v in f[4]:
+            if wire == 5:
+                vals.append(v)
+            else:       # packed floats in one LEN payload
+                vals.extend(struct.unpack(f"<{len(v)//4}f", v))
+        arr = _np.asarray(vals, _np.float32).reshape(dims)
+    elif 7 in f:        # int64_data
+        arr = _np.asarray([v for _, v in f[7]], _np.int64).reshape(dims)
+    else:
+        arr = _np.zeros(dims, _np.float32)
+    return name, arr
+
+
+def _parse_attrs(node_fields) -> Dict[str, object]:
+    attrs = {}
+    for buf in P.get_all(node_fields, 5):
+        f = P.parse(buf)
+        name = P.get_str(f, 1)
+        atype = P.get1(f, 20, 0)
+        if atype == 1:
+            attrs[name] = P.get1(f, 2)
+        elif atype == 2:
+            attrs[name] = int(P.get1(f, 3))
+        elif atype == 3:
+            attrs[name] = P.get_str(f, 4)
+        elif atype == 4:
+            attrs[name] = _parse_tensor(P.get1(f, 5))
+        elif atype == 7:
+            attrs[name] = tuple(int(v) for v in P.get_all(f, 8))
+        elif atype == 6:
+            attrs[name] = tuple(P.get_all(f, 2))
+        else:
+            attrs[name] = None
+    return attrs
+
+
+def import_model(model_file: str):
+    """Load an ONNX file → (sym, arg_params, aux_params), the reference
+    API contract."""
+    from ...symbol.symbol import Symbol, _Node
+    from ... import ndarray as F
+
+    with open(model_file, "rb") as fh:
+        model = P.parse(fh.read())
+    graph = P.parse(P.get1(model, 7, b""))
+
+    inits: Dict[str, _np.ndarray] = {}
+    for buf in P.get_all(graph, 5):
+        name, arr = _parse_tensor(buf)
+        inits[name] = arr
+
+    # producers: name -> (node, out_idx)
+    prod: Dict[str, tuple] = {}
+    aux_names = set()
+
+    def var(name, aux=False):
+        if name not in prod:
+            attrs = {"__aux__": True} if aux else {}
+            if name in inits:
+                attrs["__shape__"] = tuple(inits[name].shape)
+            prod[name] = (_Node(None, name, attrs, []), 0)
+            if aux:
+                aux_names.add(name)
+        return prod[name]
+
+    for buf in P.get_all(graph, 11):        # graph inputs
+        f = P.parse(buf)
+        nm = P.get_str(f, 1)
+        if nm not in inits:
+            var(nm)
+
+    def emit(op, name, attrs, in_names, num_outputs=1, aux_idx=()):
+        ins = [prod[nm] if nm in prod else var(nm, aux=i in aux_idx)
+               for i, nm in enumerate(in_names)]
+        return _Node(op, name, attrs, ins, num_outputs)
+
+    counter = [0]
+
+    def uniq(base):
+        counter[0] += 1
+        return f"{base.lower()}_onnx{counter[0]}"
+
+    for buf in P.get_all(graph, 1):          # nodes, topological in ONNX
+        f = P.parse(buf)
+        in_names = [v.decode() for _, v in f.get(1, [])]
+        out_names = [v.decode() for _, v in f.get(2, [])]
+        name = P.get_str(f, 3) or uniq(P.get_str(f, 4))
+        op_type = P.get_str(f, 4)
+        a = _parse_attrs(f)
+
+        if op_type == "Conv":
+            k = a.get("kernel_shape")
+            pads = a.get("pads", (0,) * (2 * len(k)))
+            if tuple(pads[:len(k)]) != tuple(pads[len(k):]):
+                raise MXNetError("ONNX import: asymmetric Conv pads "
+                                 "unsupported")
+            attrs = {"kernel": tuple(k),
+                     "stride": tuple(a.get("strides", (1,) * len(k))),
+                     "dilate": tuple(a.get("dilations", (1,) * len(k))),
+                     "pad": tuple(pads[:len(k)]),
+                     "num_filter": int(inits[in_names[1]].shape[0])
+                     if in_names[1] in inits else 0,
+                     "num_group": a.get("group", 1),
+                     "no_bias": len(in_names) == 2}
+            node = emit("Convolution", name, attrs, in_names)
+        elif op_type == "BatchNormalization":
+            attrs = {"eps": a.get("epsilon", 1e-5),
+                     "momentum": a.get("momentum", 0.9),
+                     "fix_gamma": False, "use_global_stats": False}
+            node = emit("BatchNorm", name, attrs, in_names,
+                        aux_idx=(3, 4))
+        elif op_type in _ACT_REV:
+            node = emit("Activation", name,
+                        {"act_type": _ACT_REV[op_type]}, in_names)
+        elif op_type in ("MaxPool", "AveragePool"):
+            k = a.get("kernel_shape")
+            pads = a.get("pads", (0,) * (2 * len(k)))
+            attrs = {"kernel": tuple(k),
+                     "stride": tuple(a.get("strides", (1,) * len(k))),
+                     "pad": tuple(pads[:len(k)]),
+                     "pool_type": "max" if op_type == "MaxPool" else "avg",
+                     "pooling_convention":
+                         "full" if a.get("ceil_mode", 0) else "valid"}
+            if op_type == "AveragePool":
+                attrs["count_include_pad"] = \
+                    bool(a.get("count_include_pad", 0))
+            node = emit("Pooling", name, attrs, in_names)
+        elif op_type in ("GlobalMaxPool", "GlobalAveragePool"):
+            attrs = {"kernel": (1, 1), "global_pool": True,
+                     "pool_type": "max" if "Max" in op_type else "avg"}
+            node = emit("Pooling", name, attrs, in_names)
+        elif op_type == "Gemm":
+            if a.get("transB", 0) != 1 or a.get("transA", 0) != 0:
+                raise MXNetError("ONNX import: only transB=1 Gemm "
+                                 "supported")
+            w = inits.get(in_names[1])
+            attrs = {"num_hidden": int(w.shape[0]) if w is not None else 0,
+                     "no_bias": len(in_names) == 2, "flatten": False}
+            node = emit("FullyConnected", name, attrs, in_names)
+        elif op_type == "Flatten":
+            node = emit("Flatten", name, {}, in_names)
+        elif op_type == "Add":
+            node = emit("broadcast_add", name, {}, in_names)
+        elif op_type == "Mul":
+            node = emit("broadcast_mul", name, {}, in_names)
+        elif op_type == "Sub":
+            node = emit("broadcast_sub", name, {}, in_names)
+        elif op_type == "Concat":
+            node = emit("Concat", name, {"dim": a.get("axis", 1),
+                                         "num_args": len(in_names)},
+                        in_names)
+        elif op_type == "Dropout":
+            # inference graphs only: ONNX Dropout is identity at
+            # inference, and our Dropout op wants an RNG key input —
+            # alias the output straight to the input
+            prod[out_names[0]] = prod[in_names[0]] if in_names[0] in prod \
+                else var(in_names[0])
+            continue
+        elif op_type == "Softmax":
+            node = emit("softmax", name, {"axis": a.get("axis", -1)},
+                        in_names)
+        elif op_type == "Reshape":
+            shp = inits.get(in_names[1])
+            if shp is None:
+                raise MXNetError("ONNX import: dynamic Reshape shape "
+                                 "unsupported")
+            node = emit("Reshape", name,
+                        {"shape": tuple(int(v) for v in shp)},
+                        in_names[:1])
+        else:
+            raise MXNetError(f"ONNX import: op {op_type!r} has no "
+                             f"translation")
+        for i, nm in enumerate(out_names):
+            prod[nm] = (node, i)
+
+    heads = []
+    for buf in P.get_all(graph, 12):
+        f = P.parse(buf)
+        heads.append(prod[P.get_str(f, 1)])
+    sym = Symbol(heads)
+
+    arg_params, aux_params = {}, {}
+    used = {n.name for n in sym._topo() if n.is_var}
+    for name, arr in inits.items():
+        if name not in used:
+            continue
+        nd = F.array(arr)
+        (aux_params if name in aux_names else arg_params)[name] = nd
+    return sym, arg_params, aux_params
