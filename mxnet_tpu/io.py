@@ -6,12 +6,14 @@ src/io/ — MNISTIter (iter_mnist.cc), CSVIter (iter_csv.cc), and
 ImageRecordIter (iter_image_recordio_2.cc) — see SURVEY.md §2.4.
 
 TPU-native design: the reference's C++ pipeline exists to keep JPEG
-decode + augmentation off the training thread; here the same structure is a
-pool of decode worker threads (PIL releases the GIL during JPEG decode)
-feeding a bounded prefetch queue, with the option of the native C++
-recordio/prefetch core (mxnet_tpu/native) when built.  Batches surface as
-host numpy first and move to device in one transfer, which is the right
-shape for TPU feeding (few large H2D copies, never per-sample).
+decode + augmentation off the training thread; ImageRecordIter uses the
+in-tree native C++ core (mxnet_tpu/native/io_core.cc — mmap'd RecordIO +
+libjpeg decode + augment on a worker pool, built on demand with g++ and
+driven through ctypes, which releases the GIL for the whole batch fill),
+falling back to a pool of Python decode threads (PIL releases the GIL in
+JPEG decode) when the toolchain is unavailable.  Batches surface as host
+numpy first and move to device in one transfer, which is the right shape
+for TPU feeding (few large H2D copies, never per-sample).
 """
 from __future__ import annotations
 
@@ -393,7 +395,8 @@ class ImageRecordIter(DataIter):
                  part_index: int = 0, num_parts: int = 1,
                  preprocess_threads: int = 4, prefetch_buffer: int = 4,
                  label_width: int = 1, round_batch: bool = True,
-                 seed: int = 0, **kwargs):
+                 seed: int = 0, use_native: Optional[bool] = None,
+                 **kwargs):
         super().__init__(batch_size)
         self.data_shape = tuple(data_shape)
         if len(self.data_shape) != 3:
@@ -412,19 +415,50 @@ class ImageRecordIter(DataIter):
         self.n_threads = max(1, preprocess_threads)
         self.prefetch = max(1, prefetch_buffer)
         self._rng = _np.random.default_rng(seed)
-        # index the record file once: offsets of every record
-        self._offsets = self._scan_offsets(path_imgrec, path_imgidx)
-        # distributed shard (reference: part_index/num_parts)
-        shard = len(self._offsets) // num_parts
-        lo = part_index * shard
-        hi = len(self._offsets) if part_index == num_parts - 1 \
-            else lo + shard
-        self._offsets = self._offsets[lo:hi]
-        self._order = _np.arange(len(self._offsets))
+        self._native = None
+        self._native_lib = None
+        if use_native is not False:
+            try:
+                self._init_native(path_imgrec, path_imgidx, seed,
+                                  part_index, num_parts)
+            except MXNetError:
+                if use_native:           # explicitly requested: surface it
+                    raise
+        if self._native is None:
+            # pure-Python path: index the record file once
+            self._offsets = self._scan_offsets(path_imgrec, path_imgidx)
+            # distributed shard (reference: part_index/num_parts)
+            shard = len(self._offsets) // num_parts
+            lo = part_index * shard
+            hi = len(self._offsets) if part_index == num_parts - 1 \
+                else lo + shard
+            self._offsets = self._offsets[lo:hi]
+            self._order = _np.arange(len(self._offsets))
         self._stop = threading.Event()
         self._pool: List[threading.Thread] = []
         self._out: Optional[_queue.Queue] = None
         self.reset()
+
+    def _init_native(self, path_imgrec, path_imgidx, seed,
+                     part_index, num_parts) -> None:
+        import ctypes
+        from . import native
+        lib = native.load_io()
+        c, h, w = self.data_shape
+        mean = (ctypes.c_float * 3)(*self.mean.ravel())
+        std = (ctypes.c_float * 3)(*self.std.ravel())
+        err = ctypes.create_string_buffer(512)
+        handle = lib.MXTPUIOCreate(
+            path_imgrec.encode(), (path_imgidx or "").encode(),
+            self.batch_size, c, h, w, self.resize,
+            int(self.rand_crop), int(self.rand_mirror), int(self.shuffle),
+            int(self._round_batch), seed, mean, std, self.label_width,
+            part_index, num_parts, self.n_threads, err, len(err))
+        if not handle:
+            raise MXNetError(
+                f"native ImageRecordIter: {err.value.decode()}")
+        self._native_lib = lib
+        self._native = handle
 
     @staticmethod
     def _scan_offsets(path: str, idx_path: Optional[str]) -> List[int]:
@@ -465,21 +499,67 @@ class ImageRecordIter(DataIter):
     # -- pipeline ----------------------------------------------------------
     def reset(self) -> None:
         self._shutdown()
-        if self.shuffle:
-            self._rng.shuffle(self._order)
         self._stop = threading.Event()
         self._out = _queue.Queue(maxsize=self.prefetch)
-        n_batches = len(self._order) // self.batch_size
-        tail = len(self._order) % self.batch_size
-        if self._round_batch and tail:
-            n_batches += 1          # final wrap-padded batch (pad set)
+        if self._native is not None:
+            self._native_lib.MXTPUIOReset(self._native)
+            n_batches = int(
+                self._native_lib.MXTPUIONumBatches(self._native))
+            target = self._run_native
+        else:
+            if self.shuffle:
+                self._rng.shuffle(self._order)
+            n_batches = len(self._order) // self.batch_size
+            tail = len(self._order) % self.batch_size
+            if self._round_batch and tail:
+                n_batches += 1      # final wrap-padded batch (pad set)
+            target = self._run_pipeline
         self._n_batches = n_batches
         self._consumed = 0
-        feeder = threading.Thread(target=self._run_pipeline,
+        feeder = threading.Thread(target=target,
                                   args=(self._stop, self._out, n_batches),
                                   daemon=True)
         feeder.start()
         self._pool = [feeder]
+
+    def _run_native(self, stop: threading.Event, out: _queue.Queue,
+                    n_batches: int) -> None:
+        """Feeder loop over the C++ core: the ctypes call releases the GIL
+        for the whole batch fill, so decode overlaps training fully."""
+        import ctypes
+        try:
+            lib, handle = self._native_lib, self._native
+            c, h, w = self.data_shape
+            fp = ctypes.POINTER(ctypes.c_float)
+            for _ in range(n_batches):
+                if stop.is_set():
+                    return
+                data = _np.empty((self.batch_size, c, h, w),
+                                 dtype=_np.float32)
+                label = _np.empty((self.batch_size, self.label_width),
+                                  dtype=_np.float32)
+                pad = lib.MXTPUIONext(
+                    handle, data.ctypes.data_as(fp),
+                    label.ctypes.data_as(fp))
+                if pad < 0:
+                    msg = lib.MXTPUIOLastError(handle).decode() \
+                        if pad == -2 else "early epoch end"
+                    raise MXNetError(f"native iter: {msg}")
+                if self.label_width == 1:
+                    label = label.reshape(self.batch_size)
+                while not stop.is_set():
+                    try:
+                        out.put((data, label, pad), timeout=0.1)
+                        break
+                    except _queue.Full:
+                        continue
+        except BaseException as e:          # surface in next(), don't hang
+            while not stop.is_set():
+                try:
+                    out.put(("__error__", e), timeout=0.1)
+                    return
+                except _queue.Full:
+                    continue
 
     def _shutdown(self) -> None:
         if self._pool:
@@ -491,7 +571,14 @@ class ImageRecordIter(DataIter):
             except (_queue.Empty, AttributeError):
                 pass
             for t in self._pool:
-                t.join(timeout=5)
+                if self._native is not None:
+                    # the feeder may be inside MXTPUIONext with the GIL
+                    # released; Reset/Destroy on a handle another thread
+                    # is mutating is a use-after-free — join for real
+                    while t.is_alive():
+                        t.join(timeout=5)
+                else:
+                    t.join(timeout=5)
             self._pool = []
 
     def _run_pipeline(self, stop: threading.Event, out: _queue.Queue,
@@ -589,6 +676,9 @@ class ImageRecordIter(DataIter):
     def __del__(self):
         try:
             self._shutdown()
+            if self._native is not None:
+                self._native_lib.MXTPUIODestroy(self._native)
+                self._native = None
         except Exception:
             pass
 

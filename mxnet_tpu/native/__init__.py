@@ -1,0 +1,144 @@
+"""Native (C++) runtime components, built on demand with the system
+toolchain and loaded through ctypes — the L9 discipline of the reference
+(flat C ABI, opaque handles; SURVEY.md §2.1): no Python dependency inside
+the native code, no binding generator.
+
+Components:
+- io_core.cc — RecordIO + JPEG decode + augment batch pipeline
+  (reference: src/io/iter_image_recordio_2.cc).
+- predict_core.cc — the MXPred* C predict ABI for embedding
+  (reference: src/c_api/c_predict_api.cc).
+
+``load_io()`` / ``load_predict()`` return the ctypes library (building it
+the first time) or raise MXNetError with the toolchain failure; callers
+degrade gracefully to the pure-Python path.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from ..base import MXNetError
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_LOCK = threading.Lock()
+_LIB = None
+_LOAD_ERR = None
+
+
+def _build(src: str, so: str, extra: list) -> None:
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+           "-o", so, src] + extra
+    r = subprocess.run(cmd, capture_output=True, text=True, timeout=300)
+    if r.returncode != 0:
+        raise MXNetError(
+            f"native build failed: {' '.join(cmd)}\n{r.stderr[-2000:]}")
+
+
+def _stale(src: str, so: str) -> bool:
+    return (not os.path.isfile(so)
+            or os.path.getmtime(so) < os.path.getmtime(src))
+
+
+def load_io():
+    """Build (if needed) + load the io core; cached process-wide."""
+    global _LIB, _LOAD_ERR
+    with _LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LOAD_ERR is not None:
+            raise _LOAD_ERR
+        src = os.path.join(_DIR, "io_core.cc")
+        so = os.path.join(_DIR, "libmxtpu_io.so")
+        try:
+            if _stale(src, so):
+                _build(src, so, ["-ljpeg", "-lpthread"])
+            lib = ctypes.CDLL(so)
+        except (MXNetError, OSError, subprocess.SubprocessError) as e:
+            _LOAD_ERR = e if isinstance(e, MXNetError) else \
+                MXNetError(f"cannot load native io core: {e}")
+            raise _LOAD_ERR
+        c_float_p = ctypes.POINTER(ctypes.c_float)
+        lib.MXTPUIOCreate.restype = ctypes.c_void_p
+        lib.MXTPUIOCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_char_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_uint64, c_float_p, c_float_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_char_p, ctypes.c_int]
+        lib.MXTPUIONext.restype = ctypes.c_int
+        lib.MXTPUIONext.argtypes = [ctypes.c_void_p, c_float_p, c_float_p]
+        lib.MXTPUIONumSamples.restype = ctypes.c_int64
+        lib.MXTPUIONumSamples.argtypes = [ctypes.c_void_p]
+        lib.MXTPUIONumBatches.restype = ctypes.c_int64
+        lib.MXTPUIONumBatches.argtypes = [ctypes.c_void_p]
+        lib.MXTPUIOLastError.restype = ctypes.c_char_p
+        lib.MXTPUIOLastError.argtypes = [ctypes.c_void_p]
+        lib.MXTPUIOReset.argtypes = [ctypes.c_void_p]
+        lib.MXTPUIODestroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return _LIB
+
+
+def io_available() -> bool:
+    try:
+        load_io()
+        return True
+    except MXNetError:
+        return False
+
+
+_PRED = {"lib": None, "err": None}
+
+
+def load_predict():
+    """Build (if needed) + load the predict C ABI; cached process-wide."""
+    import sysconfig
+    with _LOCK:
+        if _PRED["lib"] is not None:
+            return _PRED["lib"]
+        if _PRED["err"] is not None:
+            raise _PRED["err"]
+        src = os.path.join(_DIR, "predict_core.cc")
+        so = os.path.join(_DIR, "libmxtpu_predict.so")
+        try:
+            if _stale(src, so):
+                inc = sysconfig.get_paths()["include"]
+                libdir = sysconfig.get_config_var("LIBDIR") or "/usr/lib"
+                ver = sysconfig.get_config_var("LDVERSION") or \
+                    sysconfig.get_config_var("VERSION")
+                _build(src, so, [f"-I{inc}", f"-L{libdir}",
+                                 f"-lpython{ver}", "-ldl"])
+            lib = ctypes.CDLL(so, mode=ctypes.RTLD_GLOBAL)
+        except (MXNetError, OSError, subprocess.SubprocessError) as e:
+            _PRED["err"] = e if isinstance(e, MXNetError) else \
+                MXNetError(f"cannot load predict core: {e}")
+            raise _PRED["err"]
+        u32 = ctypes.c_uint32
+        lib.MXPredCreate.restype = ctypes.c_int
+        lib.MXPredCreate.argtypes = [
+            ctypes.c_char_p, ctypes.c_void_p, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, u32, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(u32), ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.c_void_p)]
+        lib.MXPredSetInput.restype = ctypes.c_int
+        lib.MXPredSetInput.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_float), u32]
+        lib.MXPredForward.restype = ctypes.c_int
+        lib.MXPredForward.argtypes = [ctypes.c_void_p]
+        lib.MXPredGetOutputShape.restype = ctypes.c_int
+        lib.MXPredGetOutputShape.argtypes = [
+            ctypes.c_void_p, u32, ctypes.POINTER(ctypes.POINTER(u32)),
+            ctypes.POINTER(u32)]
+        lib.MXPredGetOutput.restype = ctypes.c_int
+        lib.MXPredGetOutput.argtypes = [
+            ctypes.c_void_p, u32, ctypes.POINTER(ctypes.c_float), u32]
+        lib.MXPredFree.restype = ctypes.c_int
+        lib.MXPredFree.argtypes = [ctypes.c_void_p]
+        lib.MXGetLastError.restype = ctypes.c_char_p
+        _PRED["lib"] = lib
+        return lib

@@ -1,0 +1,299 @@
+// C predict ABI: the embedding seam for serving from C/C++ hosts.
+//
+// Reference parity: src/c_api/c_predict_api.cc + include/mxnet/
+// c_predict_api.h (SURVEY.md §2.1 L9, §3.5) — same function names and
+// call contract: MXPredCreate(json, params, dev, shapes) →
+// MXPredSetInput → MXPredForward → MXPredGetOutputShape/MXPredGetOutput,
+// errors via MXGetLastError.
+//
+// TPU-native design: the reference backs this ABI with its own C++
+// executor; here the executor IS the XLA-compiled graph, reached by
+// embedding CPython (libpython is the runtime the XLA client lives in)
+// — the ABI boundary stays pure C (opaque handles, POD types), so a
+// C host needs no Python headers, only this .so.  When loaded INSIDE a
+// Python process (ctypes), the embedded interpreter is the host's own.
+
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_last_error;
+
+void set_err(const std::string& m) { g_last_error = m; }
+
+// format + clear the live Python exception into g_last_error
+void set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      msg = PyUnicode_AsUTF8(s);
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  set_err(msg);
+}
+
+struct Predictor {
+  PyObject* obj = nullptr;                  // _Predictor instance
+  std::vector<std::vector<uint32_t>> out_shape_cache;
+};
+
+const char kBootstrap[] = R"PY(
+import io as _io
+import sys as _sys
+if _MXTPU_ROOT not in _sys.path:
+    _sys.path.insert(0, _MXTPU_ROOT)
+import numpy as _np
+import mxnet_tpu as _mx
+from mxnet_tpu.ndarray import utils as _mxu
+
+
+class _Predictor:
+    def __init__(self, sym_json, param_bytes, dev_type, dev_id, shapes):
+        from mxnet_tpu.symbol import load_json
+        sym = load_json(sym_json)
+        params = _mxu.load_buffer(param_bytes) if param_bytes else {}
+        arg, aux = {}, {}
+        for k, v in params.items():
+            if k.startswith("arg:"):
+                arg[k[4:]] = v
+            elif k.startswith("aux:"):
+                aux[k[4:]] = v
+            else:
+                arg[k] = v
+        ctx = _mx.cpu(dev_id) if dev_type == 1 else _mx.tpu(dev_id)
+        self._shapes = dict(shapes)
+        self._exe = sym.simple_bind(ctx=ctx, grad_req="null",
+                                    **self._shapes)
+        for k, v in {**arg, **aux}.items():
+            if k in self._exe.arg_dict:
+                v.copyto(self._exe.arg_dict[k])
+            elif k in self._exe.aux_dict:
+                v.copyto(self._exe.aux_dict[k])
+        self._outs = None
+
+    def set_input(self, key, raw):
+        if key not in self._shapes:
+            raise KeyError(f"unknown input {key!r}")
+        arr = _np.frombuffer(raw, _np.float32).reshape(self._shapes[key])
+        self._exe.arg_dict[key]._set_data(
+            _mx.nd.array(arr, ctx=self._exe.arg_dict[key].context)._read())
+
+    def forward(self):
+        self._outs = self._exe.forward(is_train=False)
+
+    def num_outputs(self):
+        return len(self._outs) if self._outs is not None else 0
+
+    def output_shape(self, i):
+        return tuple(self._outs[i].shape)
+
+    def output_bytes(self, i):
+        return self._outs[i].asnumpy().astype(_np.float32).tobytes()
+)PY";
+
+PyObject* g_predictor_cls = nullptr;
+
+bool ensure_python() {
+  if (!Py_IsInitialized()) {
+    Py_InitializeEx(0);
+    // embedding host: release the GIL we now hold so PyGILState_Ensure
+    // works uniformly below
+    PyEval_SaveThread();
+  }
+  return true;
+}
+
+bool ensure_bootstrap() {
+  if (g_predictor_cls) return true;
+  // locate repo root: this .so lives at <root>/mxnet_tpu/native/
+  Dl_info info;
+  std::string root = ".";
+  if (dladdr(reinterpret_cast<void*>(&ensure_bootstrap), &info) &&
+      info.dli_fname) {
+    std::string p = info.dli_fname;
+    for (int up = 0; up < 3; ++up) {
+      auto pos = p.find_last_of('/');
+      if (pos == std::string::npos) break;
+      p = p.substr(0, pos);
+    }
+    if (!p.empty()) root = p;
+  }
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* rootstr = PyUnicode_FromString(root.c_str());
+  PyDict_SetItemString(globals, "_MXTPU_ROOT", rootstr);
+  Py_DECREF(rootstr);
+  PyObject* res = PyRun_String(kBootstrap, Py_file_input, globals, globals);
+  if (!res) {
+    set_err_from_python();
+    Py_DECREF(globals);
+    return false;
+  }
+  Py_DECREF(res);
+  g_predictor_cls = PyDict_GetItemString(globals, "_Predictor");
+  Py_XINCREF(g_predictor_cls);
+  Py_DECREF(globals);
+  if (!g_predictor_cls) {
+    set_err("bootstrap did not define _Predictor");
+    return false;
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXGetLastError() { return g_last_error.c_str(); }
+
+// Reference signature (c_predict_api.h): shapes arrive CSR-style —
+// input_shape_indptr[i]..indptr[i+1] indexes into input_shape_data.
+int MXPredCreate(const char* symbol_json_str, const void* param_bytes,
+                 int param_size, int dev_type, int dev_id,
+                 uint32_t num_input_nodes, const char** input_keys,
+                 const uint32_t* input_shape_indptr,
+                 const uint32_t* input_shape_data, void** out) {
+  ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject *shapes = nullptr, *params = nullptr, *obj = nullptr;
+  do {
+    if (!ensure_bootstrap()) break;
+    shapes = PyDict_New();
+    for (uint32_t i = 0; i < num_input_nodes; ++i) {
+      uint32_t lo = input_shape_indptr[i], hi = input_shape_indptr[i + 1];
+      PyObject* tup = PyTuple_New(hi - lo);
+      for (uint32_t j = lo; j < hi; ++j)
+        PyTuple_SET_ITEM(tup, j - lo,
+                         PyLong_FromUnsignedLong(input_shape_data[j]));
+      PyDict_SetItemString(shapes, input_keys[i], tup);
+      Py_DECREF(tup);
+    }
+    params = PyBytes_FromStringAndSize(
+        static_cast<const char*>(param_bytes), param_size);
+    obj = PyObject_CallFunction(g_predictor_cls, "sOiiO",
+                                symbol_json_str, params, dev_type,
+                                dev_id, shapes);
+    if (!obj) {
+      set_err_from_python();
+      break;
+    }
+    auto* p = new Predictor();
+    p->obj = obj;
+    obj = nullptr;
+    *out = p;
+    rc = 0;
+  } while (false);
+  Py_XDECREF(shapes);
+  Py_XDECREF(params);
+  Py_XDECREF(obj);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredSetInput(void* handle, const char* key, const float* data,
+                   uint32_t size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* raw = PyBytes_FromStringAndSize(
+      reinterpret_cast<const char*>(data), size * sizeof(float));
+  PyObject* r = PyObject_CallMethod(p->obj, "set_input", "sO", key, raw);
+  Py_DECREF(raw);
+  int rc = 0;
+  if (!r) {
+    set_err_from_python();
+    rc = -1;
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredForward(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = PyObject_CallMethod(p->obj, "forward", nullptr);
+  int rc = 0;
+  if (!r) {
+    set_err_from_python();
+    rc = -1;
+  }
+  Py_XDECREF(r);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutputShape(void* handle, uint32_t index,
+                         uint32_t** shape_data, uint32_t* shape_ndim) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "output_shape", "I", index);
+  if (r) {
+    Py_ssize_t n = PyTuple_Size(r);
+    if (p->out_shape_cache.size() <= index)
+      p->out_shape_cache.resize(index + 1);
+    auto& v = p->out_shape_cache[index];
+    v.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      v[i] = static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+    *shape_data = v.data();
+    *shape_ndim = static_cast<uint32_t>(n);
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredGetOutput(void* handle, uint32_t index, float* data,
+                    uint32_t size) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(p->obj, "output_bytes", "I", index);
+  if (r) {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &buf, &n) == 0 &&
+        n == static_cast<Py_ssize_t>(size * sizeof(float))) {
+      std::memcpy(data, buf, n);
+      rc = 0;
+    } else {
+      set_err("output size mismatch");
+    }
+    Py_DECREF(r);
+  } else {
+    set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXPredFree(void* handle) {
+  auto* p = static_cast<Predictor*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(p->obj);
+  PyGILState_Release(gil);
+  delete p;
+  return 0;
+}
+
+}  // extern "C"

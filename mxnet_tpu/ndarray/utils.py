@@ -47,11 +47,18 @@ def save(fname: str, data: Union[NDArray, List[NDArray], Dict[str, NDArray]]):
 def load(fname: str) -> Union[List[NDArray], Dict[str, NDArray]]:
     """Load arrays saved by :func:`save`."""
     with open(fname, "rb") as f:
-        magic = f.read(len(_MAGIC))
-        if magic != _MAGIC:
-            raise MXNetError(f"{fname}: not an NDArray file (bad magic)")
-        is_dict = struct.unpack("<B", f.read(1))[0] == 1
-        npz = _np.load(io.BytesIO(f.read()))
+        return load_buffer(f.read(), what=fname)
+
+
+def load_buffer(buf: bytes, what: str = "<buffer>") \
+        -> Union[List[NDArray], Dict[str, NDArray]]:
+    """Load arrays from in-memory bytes (reference:
+    MXNDArrayLoadFromBuffer — the predict C API hands params this way)."""
+    if buf[:len(_MAGIC)] != _MAGIC:
+        raise MXNetError(f"{what}: not an NDArray file (bad magic)")
+    off = len(_MAGIC)
+    is_dict = struct.unpack("<B", buf[off:off + 1])[0] == 1
+    npz = _np.load(io.BytesIO(buf[off + 1:]))
     if is_dict:
         return {k: array(npz[k]) for k in npz.files}
     items = sorted(npz.files, key=lambda k: int(k[len(_LIST_PREFIX):]))

@@ -1,0 +1,235 @@
+"""Native-core tests: C++ io pipeline + C predict ABI.
+
+Reference models: src/io/iter_image_recordio_2.cc coverage in
+tests/python/unittest/test_io.py, and src/c_api/c_predict_api.cc's
+predict contract (SURVEY.md §2.1 L9, §3.5).
+"""
+import ctypes
+import os
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import native
+from mxnet_tpu.gluon import nn
+from mxnet_tpu.io import ImageRecordIter
+from mxnet_tpu.recordio import IRHeader, MXRecordIO, pack_img
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None, reason="no C++ toolchain")
+
+
+def _make_rec(path, n=48, h=240, w=260, label_width=1, seed=0):
+    rng = np.random.default_rng(seed)
+    rec = MXRecordIO(path, "w")
+    for i in range(n):
+        img = rng.integers(0, 255, (h, w, 3), dtype=np.uint8)
+        if label_width == 1:
+            hdr = IRHeader(0, float(i % 10), i, 0)
+        else:
+            hdr = IRHeader(0, np.arange(label_width, dtype=np.float32) + i,
+                           i, 0)
+        rec.write(pack_img(hdr, img, quality=90))
+    rec.close()
+
+
+def test_native_matches_python_path(tmp_path):
+    path = str(tmp_path / "a.rec")
+    _make_rec(path)
+    kw = dict(data_shape=(3, 224, 224), batch_size=16,
+              preprocess_threads=4)
+    bn = next(iter(ImageRecordIter(path, use_native=True, **kw)))
+    bp = next(iter(ImageRecordIter(path, use_native=False, **kw)))
+    # same libjpeg underneath → identical decode, identical center crop
+    np.testing.assert_array_equal(bn.label[0].asnumpy(),
+                                  bp.label[0].asnumpy())
+    np.testing.assert_allclose(bn.data[0].asnumpy(),
+                               bp.data[0].asnumpy(), atol=1.0)
+
+
+def test_native_epochs_shuffle_and_augment(tmp_path):
+    path = str(tmp_path / "b.rec")
+    _make_rec(path, n=32)
+    it = ImageRecordIter(path, (3, 128, 128), 8, use_native=True,
+                         shuffle=True, rand_crop=True, rand_mirror=True,
+                         resize=160, mean_r=123.0, mean_g=117.0,
+                         mean_b=104.0, std_r=58.0, std_g=57.0, std_b=57.0,
+                         seed=7)
+    e1 = [b.label[0].asnumpy().copy() for b in it]
+    it.reset()
+    e2 = [b.label[0].asnumpy().copy() for b in it]
+    assert len(e1) == len(e2) == 4
+    flat1 = np.concatenate(e1)
+    flat2 = np.concatenate(e2)
+    # every sample seen exactly once per epoch, different order per epoch
+    assert sorted(flat1 % 10) == sorted(flat2 % 10)
+    assert not np.array_equal(flat1, flat2)
+
+
+def test_native_round_batch_pad(tmp_path):
+    path = str(tmp_path / "c.rec")
+    _make_rec(path, n=20)
+    it = ImageRecordIter(path, (3, 96, 96), 8, use_native=True)
+    pads = [b.pad for b in it]
+    assert pads == [0, 0, 4]          # 20 = 8+8+4 → last batch wraps 4
+
+
+def test_native_part_index_sharding(tmp_path):
+    path = str(tmp_path / "d.rec")
+    _make_rec(path, n=40)
+    seen = []
+    for part in range(2):
+        it = ImageRecordIter(path, (3, 64, 64), 10, use_native=True,
+                             part_index=part, num_parts=2)
+        for b in it:
+            seen.append(b.label[0].asnumpy())
+    labels = np.concatenate(seen)
+    assert len(labels) == 40          # both shards together cover all
+
+
+def test_native_multi_label(tmp_path):
+    path = str(tmp_path / "e.rec")
+    _make_rec(path, n=12, label_width=3)
+    it = ImageRecordIter(path, (3, 64, 64), 4, use_native=True,
+                         label_width=3)
+    b = next(iter(it))
+    lab = b.label[0].asnumpy()
+    assert lab.shape == (4, 3)
+    np.testing.assert_allclose(lab[0], [0, 1, 2])
+
+
+# ---------------------------------------------------------------------------
+# C predict ABI
+# ---------------------------------------------------------------------------
+
+def _export_small_net(prefix):
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Conv2D(4, 3, padding=1, activation="relu"))
+        net.add(nn.Flatten())
+        net.add(nn.Dense(5))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    x = np.random.randn(2, 3, 8, 8).astype(np.float32)
+    ref = net(mx.nd.array(x)).asnumpy()
+    net.export(prefix)
+    return x, ref
+
+
+def test_predict_abi_in_process(tmp_path):
+    prefix = str(tmp_path / "m")
+    x, ref = _export_small_net(prefix)
+    lib = native.load_predict()
+    sym_json = open(f"{prefix}-symbol.json").read().encode()
+    params = open(f"{prefix}-0000.params", "rb").read()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 4)
+    shape = (ctypes.c_uint32 * 4)(2, 3, 8, 8)
+    h = ctypes.c_void_p()
+    rc = lib.MXPredCreate(sym_json, params, len(params), 1, 0, 1,
+                          keys, indptr, shape, ctypes.byref(h))
+    assert rc == 0, lib.MXGetLastError().decode()
+    xf = np.ascontiguousarray(x)
+    fp = ctypes.POINTER(ctypes.c_float)
+    assert lib.MXPredSetInput(h, b"data", xf.ctypes.data_as(fp),
+                              xf.size) == 0
+    assert lib.MXPredForward(h) == 0
+    sd = ctypes.POINTER(ctypes.c_uint32)()
+    ndim = ctypes.c_uint32()
+    assert lib.MXPredGetOutputShape(h, 0, ctypes.byref(sd),
+                                    ctypes.byref(ndim)) == 0
+    oshape = [sd[i] for i in range(ndim.value)]
+    out = np.empty(oshape, np.float32)
+    assert lib.MXPredGetOutput(h, 0, out.ctypes.data_as(fp),
+                               out.size) == 0
+    lib.MXPredFree(h)
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_predict_abi_reports_errors(tmp_path):
+    prefix = str(tmp_path / "m2")
+    _export_small_net(prefix)
+    lib = native.load_predict()
+    h = ctypes.c_void_p()
+    keys = (ctypes.c_char_p * 1)(b"data")
+    indptr = (ctypes.c_uint32 * 2)(0, 1)
+    shape = (ctypes.c_uint32 * 1)(3)
+    rc = lib.MXPredCreate(b"{not json", b"", 0, 1, 0, 1, keys, indptr,
+                          shape, ctypes.byref(h))
+    assert rc != 0
+    assert len(lib.MXGetLastError()) > 0
+
+
+C_HOST = r"""
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+typedef int (*create_fn)(const char*, const void*, int, int, int,
+                         uint32_t, const char**, const uint32_t*,
+                         const uint32_t*, void**);
+typedef int (*setin_fn)(void*, const char*, const float*, uint32_t);
+typedef int (*fwd_fn)(void*);
+typedef int (*out_fn)(void*, uint32_t, float*, uint32_t);
+typedef const char* (*err_fn)(void);
+static char* slurp(const char* p, long* n) {
+  FILE* f = fopen(p, "rb"); fseek(f, 0, SEEK_END); *n = ftell(f);
+  fseek(f, 0, SEEK_SET); char* b = malloc(*n + 1);
+  fread(b, 1, *n, f); b[*n] = 0; fclose(f); return b;
+}
+int main(int argc, char** argv) {
+  void* so = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!so) { fprintf(stderr, "%s\n", dlerror()); return 2; }
+  create_fn create = (create_fn)dlsym(so, "MXPredCreate");
+  setin_fn setin = (setin_fn)dlsym(so, "MXPredSetInput");
+  fwd_fn fwd = (fwd_fn)dlsym(so, "MXPredForward");
+  out_fn getout = (out_fn)dlsym(so, "MXPredGetOutput");
+  err_fn lasterr = (err_fn)dlsym(so, "MXGetLastError");
+  long jn, pn;
+  char* json = slurp(argv[2], &jn);
+  char* params = slurp(argv[3], &pn);
+  const char* keys[1] = {"data"};
+  uint32_t indptr[2] = {0, 4};
+  uint32_t shape[4] = {2, 3, 8, 8};
+  void* h = NULL;
+  if (create(json, params, (int)pn, 1, 0, 1, keys, indptr, shape, &h)) {
+    fprintf(stderr, "create: %s\n", lasterr()); return 1; }
+  float x[2 * 3 * 8 * 8];
+  for (int i = 0; i < 2 * 3 * 8 * 8; i++) x[i] = (float)(i % 7) * 0.1f;
+  if (setin(h, "data", x, 2 * 3 * 8 * 8)) return 1;
+  if (fwd(h)) { fprintf(stderr, "fwd: %s\n", lasterr()); return 1; }
+  float out[10];
+  if (getout(h, 0, out, 10)) return 1;
+  printf("C-HOST-OK\n");
+  return 0;
+}
+"""
+
+
+def test_predict_abi_from_pure_c_host(tmp_path):
+    """A C binary with no Python linkage dlopens the .so and predicts —
+    the reference's embedding story (amalgamation/c_predict_api users)."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    prefix = str(tmp_path / "m3")
+    _export_small_net(prefix)
+    native.load_predict()            # ensure the .so is built
+    so = os.path.join(os.path.dirname(native.__file__),
+                      "libmxtpu_predict.so")
+    csrc = tmp_path / "host.c"
+    csrc.write_text(C_HOST)
+    exe = str(tmp_path / "host")
+    subprocess.run(["gcc", "-O2", "-o", exe, str(csrc), "-ldl"],
+                   check=True)
+    env = dict(os.environ,
+               PALLAS_AXON_POOL_IPS="",   # standalone host: force CPU jax
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [exe, so, f"{prefix}-symbol.json", f"{prefix}-0000.params"],
+        capture_output=True, text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "C-HOST-OK" in r.stdout
