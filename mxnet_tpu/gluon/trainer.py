@@ -108,6 +108,7 @@ class Trainer:
         self._optimizer.rescale_grad = self._scale / batch_size
         self._init_kvstore()
         if self._update_on_kvstore:
+            live = []
             for i, param in enumerate(self._params):
                 if self._stale(param):
                     if not ignore_stale_grad:
@@ -117,8 +118,18 @@ class Trainer:
                             f"set ignore_stale_grad=True to skip such "
                             f"parameters")
                     continue
+                live.append((i, param))
+            # ONE push call for every live key: the dist kvstore coalesces
+            # the whole list into a single DCN sync (kvstore.py
+            # _allreduce_batched)
+            keys = [i for i, _ in live]
+            vals = []
+            for _, param in live:
                 grads = param.list_grad()
-                self._kvstore.push(i, grads if len(grads) > 1 else grads[0])
+                vals.append(grads if len(grads) > 1 else grads[0])
+            if keys:
+                self._kvstore.push(keys, vals)
+            for i, param in live:
                 self._kvstore.pull(i, out=param.list_data())
                 for data in param._data.values():
                     if data._ag is not None:
@@ -134,6 +145,7 @@ class Trainer:
             raise MXNetError(
                 "allreduce_grads is not applicable when the optimizer runs "
                 "on the kvstore (update_on_kvstore=True)")
+        push_keys, push_vals = [], []
         for i, param in enumerate(self._params):
             grads = param.list_grad()
             if len(grads) > 1:
@@ -145,9 +157,15 @@ class Trainer:
             if self._dist_kv:
                 # cross-worker gradient sum through the store (no server
                 # optimizer in this mode; the local fused update applies
-                # it).  Local replicas were already reduced above — push
-                # ONE copy, pull the global sum back into every replica.
-                self._kvstore.push(i, grads[0])
+                # it).  Local replicas were already reduced above — queue
+                # ONE copy per param and push them all as one batched call
+                # (one DCN sync), then pull the global sums back.
+                push_keys.append(i)
+                push_vals.append(grads[0])
+        if push_keys:
+            self._kvstore.push(push_keys, push_vals)
+            for i in push_keys:
+                grads = self._params[i].list_grad()
                 self._kvstore.pull(i, out=grads if len(grads) > 1
                                    else grads[0])
 
