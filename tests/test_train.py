@@ -93,3 +93,45 @@ def test_small_cnn_trains():
         trainer.step(32)
         losses.append(float(loss.mean().asnumpy()))
     assert losses[-1] < 0.3 * losses[0], (losses[0], losses[-1])
+
+
+def test_real_digits_convergence_gate():
+    """§4 convergence gate on REAL data: sklearn's handwritten-digits set
+    (1,797 genuine 8x8 scans, the classic 'small MNIST') — train an MLP
+    and hold it to the documented ≥97% train-accuracy bar.  This replaces
+    the synthetic class-template stream as the gate evidence (round-2
+    weak #7)."""
+    from sklearn.datasets import load_digits
+    X, y = load_digits(return_X_y=True)
+    X = (X.astype(np.float32) / 16.0) - 0.5
+    y = y.astype(np.int64)
+    rng = np.random.RandomState(0)
+    order = rng.permutation(len(X))
+    X, y = X[order], y[order]
+    n_train = 1500
+    Xtr, ytr, Xte, yte = X[:n_train], y[:n_train], X[n_train:], y[n_train:]
+
+    net = nn.HybridSequential()
+    net.add(nn.Dense(64, activation="relu"),
+            nn.Dense(32, activation="relu"),
+            nn.Dense(10))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    trainer = gluon.Trainer(net.collect_params(), "adam",
+                            {"learning_rate": 2e-3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    batch = 100
+    for epoch in range(15):
+        for i in range(0, n_train, batch):
+            xb = nd.array(Xtr[i:i + batch])
+            yb = nd.array(ytr[i:i + batch])
+            with autograd.record():
+                L = loss_fn(net(xb), yb)
+            L.backward()
+            trainer.step(xb.shape[0])
+    train_acc = float(np.mean(
+        np.argmax(net(nd.array(Xtr)).asnumpy(), 1) == ytr))
+    test_acc = float(np.mean(
+        np.argmax(net(nd.array(Xte)).asnumpy(), 1) == yte))
+    assert train_acc >= 0.97, f"train acc {train_acc:.3f} below the gate"
+    assert test_acc >= 0.90, f"held-out acc {test_acc:.3f} implausibly low"
