@@ -586,4 +586,89 @@ def _astuple(v):
     return (v,)
 
 
+def _register_misc():
+    """Long-tail contrib ops (reference: src/operator/correlation.cc,
+    src/operator/contrib/index_copy.cc, src/operator/contrib/
+    count_sketch.cc — SURVEY.md §2.2 long-tail row)."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    # ---- Correlation (FlowNet cost volume) -------------------------------
+    def correlation_maker(kernel_size=1, max_displacement=1, stride1=1,
+                          stride2=1, pad_size=0, is_multiply=True):
+        k = int(kernel_size)
+        md = int(max_displacement)
+        s1, s2, pad = int(stride1), int(stride2), int(pad_size)
+        rad = (k - 1) // 2
+        border = md + rad
+        grid_rad = md // s2           # displacements per side
+        D = 2 * grid_rad + 1
+
+        def fn(data1, data2):
+            # out[d][n,y,x] = mean over kxk window and channels of
+            # p1 * shifted(p2) — ONE lax.scan over the D*D displacement
+            # grid (graph size independent of D; FlowNet's D=21 would
+            # otherwise unroll 441 ways), with the window sum as a
+            # reduce_window per scan step.
+            n, c, h, w = data1.shape
+            p1 = jnp.pad(data1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+            p2 = jnp.pad(data2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+            ph, pw = h + 2 * pad, w + 2 * pad
+            out_h = int(_np.ceil((ph - 2 * border) / float(s1)))
+            out_w = int(_np.ceil((pw - 2 * border) / float(s1)))
+            # static patch of data1 covering every window position
+            eh = (out_h - 1) * s1 + k
+            ew = (out_w - 1) * s1 + k
+            lo = border - rad
+            a = lax.slice(p1, (0, 0, lo, lo), (n, c, lo + eh, lo + ew))
+
+            offs = jnp.asarray(
+                [(dy * s2, dx * s2)
+                 for dy in range(-grid_rad, grid_rad + 1)
+                 for dx in range(-grid_rad, grid_rad + 1)], jnp.int32)
+
+            def step(_, off):
+                b = lax.dynamic_slice(
+                    p2, (0, 0, lo + off[0], lo + off[1]), (n, c, eh, ew))
+                q = a * b if is_multiply else jnp.abs(a - b)
+                summed = lax.reduce_window(
+                    q, jnp.asarray(0, q.dtype), lax.add,
+                    (1, 1, k, k), (1, 1, s1, s1), "valid")
+                return None, jnp.sum(summed, axis=1) / float(k * k * c)
+
+            _, maps = lax.scan(step, None, offs)   # (D*D, n, oh, ow)
+            return jnp.transpose(maps, (1, 0, 2, 3))
+        return fn
+    register_op("Correlation", correlation_maker,
+                aliases=("correlation",))
+
+    # ---- index_copy ------------------------------------------------------
+    def index_copy_maker():
+        def fn(old, idx, new):
+            return old.at[idx.astype(jnp.int32)].set(new)
+        return fn
+    register_op("_contrib_index_copy", index_copy_maker,
+                aliases=("index_copy",))
+
+    # ---- count_sketch ----------------------------------------------------
+    def count_sketch_maker(out_dim=None, processing_batch_size=32):
+        if out_dim is None:
+            from ..base import MXNetError
+            raise MXNetError("count_sketch requires out_dim")
+        od = int(out_dim)
+
+        def fn(data, h, s):
+            # h: target bucket per input dim; s: +-1 signs
+            hh = h.reshape(-1).astype(jnp.int32)
+            ss = s.reshape(-1).astype(data.dtype)
+            signed = data * ss[None, :]
+            out = jnp.zeros((data.shape[0], od), data.dtype)
+            return out.at[:, hh].add(signed)
+        return fn
+    register_op("_contrib_count_sketch", count_sketch_maker,
+                aliases=("count_sketch",), differentiable=False)
+
+
 _register()
+_register_misc()

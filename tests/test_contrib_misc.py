@@ -1,0 +1,80 @@
+"""Long-tail contrib ops (reference: src/operator/correlation.cc,
+src/operator/contrib/index_copy.cc, count_sketch.cc — SURVEY.md §2.2
+long-tail row). Each checked against a direct numpy reimplementation."""
+import numpy as np
+
+import mxnet_tpu as mx
+
+
+def _naive_correlation(d1, d2, k, md, s1, s2, pad, multiply=True):
+    n, c, h, w = d1.shape
+    rad = (k - 1) // 2
+    border = md + rad
+    p1 = np.pad(d1, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    p2 = np.pad(d2, ((0, 0), (0, 0), (pad, pad), (pad, pad)))
+    ph, pw = h + 2 * pad, w + 2 * pad
+    oh = int(np.ceil((ph - 2 * border) / s1))
+    ow = int(np.ceil((pw - 2 * border) / s1))
+    g = md // s2
+    D = 2 * g + 1
+    out = np.zeros((n, D * D, oh, ow), np.float32)
+    di = 0
+    for dy in range(-g, g + 1):
+        for dx in range(-g, g + 1):
+            for y in range(oh):
+                for x in range(ow):
+                    cy, cx = border + y * s1, border + x * s1
+                    a = p1[:, :, cy - rad:cy + rad + 1,
+                           cx - rad:cx + rad + 1]
+                    b = p2[:, :, cy + dy * s2 - rad:cy + dy * s2 + rad + 1,
+                           cx + dx * s2 - rad:cx + dx * s2 + rad + 1]
+                    v = a * b if multiply else np.abs(a - b)
+                    out[:, di, y, x] = v.sum((1, 2, 3)) / (k * k * c)
+            di += 1
+    return out
+
+
+def test_correlation_pointwise():
+    rng = np.random.default_rng(0)
+    d1 = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    d2 = rng.standard_normal((2, 3, 8, 8)).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=1, max_displacement=2, stride1=1,
+                            stride2=1, pad_size=2).asnumpy()
+    ref = _naive_correlation(d1, d2, 1, 2, 1, 1, 2)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_correlation_kernel3_stride2_subtract():
+    rng = np.random.default_rng(1)
+    d1 = rng.standard_normal((1, 2, 12, 12)).astype(np.float32)
+    d2 = rng.standard_normal((1, 2, 12, 12)).astype(np.float32)
+    out = mx.nd.Correlation(mx.nd.array(d1), mx.nd.array(d2),
+                            kernel_size=3, max_displacement=2, stride1=2,
+                            stride2=2, pad_size=3,
+                            is_multiply=False).asnumpy()
+    ref = _naive_correlation(d1, d2, 3, 2, 2, 2, 3, multiply=False)
+    np.testing.assert_allclose(out, ref, atol=1e-5)
+
+
+def test_index_copy():
+    old = mx.nd.array(np.arange(15, dtype=np.float32).reshape(5, 3))
+    new = mx.nd.array(np.full((2, 3), -1, np.float32))
+    idx = mx.nd.array(np.array([0, 4], np.float32))
+    r = mx.nd.index_copy(old, idx, new).asnumpy()
+    assert (r[0] == -1).all() and (r[4] == -1).all()
+    np.testing.assert_array_equal(r[1:4],
+                                  np.arange(3, 12).reshape(3, 3))
+
+
+def test_count_sketch():
+    rng = np.random.default_rng(2)
+    data = rng.standard_normal((4, 6)).astype(np.float32)
+    h = np.array([0, 2, 1, 2, 0, 1], np.float32)
+    s = np.array([1, -1, 1, 1, -1, 1], np.float32)
+    out = mx.nd.count_sketch(mx.nd.array(data), mx.nd.array(h),
+                             mx.nd.array(s), out_dim=3).asnumpy()
+    ref = np.zeros((4, 3), np.float32)
+    for i in range(6):
+        ref[:, int(h[i])] += data[:, i] * s[i]
+    np.testing.assert_allclose(out, ref, atol=1e-6)
