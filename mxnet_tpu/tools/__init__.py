@@ -1,0 +1,2 @@
+"""Command-line tools (reference: tools/ — im2rec, launch.py; SURVEY.md
+L12)."""

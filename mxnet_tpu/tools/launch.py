@@ -1,0 +1,78 @@
+"""launch: run N distributed worker processes on this machine.
+
+Reference parity: tools/launch.py + the dmlc local tracker (SURVEY.md
+§4.5) — forks the training command once per worker with the ``DMLC_*``
+environment the kvstore's dist backend reads (parallel/dist.py), waits,
+and propagates the first failure.  The reference also forked parameter
+servers; servers do not exist here (sync SPMD — SURVEY.md §5.8), so -s
+is accepted and ignored with a note.
+
+Usage:
+    python -m mxnet_tpu.tools.launch -n 4 python train.py --args...
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import socket
+import subprocess
+import sys
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def launch(n_workers: int, cmd, env_extra=None) -> int:
+    import time
+    port = _free_port()
+    procs = []
+    for rank in range(n_workers):
+        env = dict(os.environ,
+                   DMLC_ROLE="worker",
+                   DMLC_PS_ROOT_URI="127.0.0.1",
+                   DMLC_PS_ROOT_PORT=str(port),
+                   DMLC_NUM_WORKER=str(n_workers),
+                   DMLC_WORKER_ID=str(rank),
+                   **(env_extra or {}))
+        procs.append(subprocess.Popen(cmd, env=env))
+    # poll ALL workers: one crashing while its peers block in a
+    # collective must tear the group down, not hang the launcher
+    rc = 0
+    live = list(procs)
+    while live:
+        for p in list(live):
+            r = p.poll()
+            if r is not None:
+                live.remove(p)
+                rc = rc or r
+        if rc:
+            for p in live:
+                p.kill()
+            for p in live:
+                p.wait()
+            break
+        time.sleep(0.1)
+    return rc
+
+
+def main(argv=None):
+    ap = argparse.ArgumentParser(prog="launch")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-s", "--num-servers", type=int, default=0,
+                    help="accepted for reference-CLI parity; ignored "
+                    "(no parameter servers in synchronous SPMD)")
+    ap.add_argument("command", nargs=argparse.REMAINDER)
+    args = ap.parse_args(argv)
+    if args.num_servers:
+        print("note: -s ignored — dist_sync is synchronous SPMD, "
+              "no server processes", file=sys.stderr)
+    if not args.command:
+        ap.error("no command given")
+    return launch(args.num_workers, args.command)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

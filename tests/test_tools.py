@@ -1,0 +1,72 @@
+"""tools/ tests: im2rec packing round-trip and the local launcher
+(reference: tools/im2rec.py, tools/launch.py + dmlc local tracker —
+SURVEY.md L12, §4.5)."""
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu.io import ImageRecordIter
+from mxnet_tpu.tools import im2rec, launch
+
+
+def _make_image_tree(root, n_per_class=4):
+    from PIL import Image
+    rng = np.random.default_rng(0)
+    for cls in ("cat", "dog"):
+        d = os.path.join(root, cls)
+        os.makedirs(d)
+        for i in range(n_per_class):
+            arr = rng.integers(0, 255, (60, 70, 3), dtype=np.uint8)
+            Image.fromarray(arr).save(os.path.join(d, f"{i}.jpg"),
+                                      quality=92)
+
+
+def test_im2rec_roundtrip(tmp_path):
+    root = str(tmp_path / "imgs")
+    os.makedirs(root)
+    _make_image_tree(root)
+    prefix = str(tmp_path / "data")
+    lst = im2rec.make_list(prefix, root, shuffle=False)
+    lines = open(lst).read().strip().splitlines()
+    assert len(lines) == 8
+    assert lines[0].split("\t")[2].startswith("cat/")
+    im2rec.pack(prefix, root)
+    assert os.path.isfile(f"{prefix}.rec")
+    assert os.path.isfile(f"{prefix}.idx")
+    # consumable by the (native) iterator, labels = class indices
+    it = ImageRecordIter(f"{prefix}.rec", (3, 48, 48), 4,
+                         path_imgidx=f"{prefix}.idx")
+    labels = np.concatenate([b.label[0].asnumpy() for b in it])
+    assert sorted(labels.tolist()) == [0.0] * 4 + [1.0] * 4
+
+
+def test_launch_forks_workers_with_dmlc_env(tmp_path):
+    """The launcher must fork N processes with consistent DMLC_* env;
+    use a trivial command so no TPU/distributed init is involved."""
+    script = tmp_path / "probe.py"
+    script.write_text(
+        "import os\n"
+        "rank = os.environ['DMLC_WORKER_ID']\n"
+        "with open(os.path.join(os.environ['PROBE_DIR'],\n"
+        "          f'r{rank}'), 'w') as f:\n"
+        "    f.write(f\"{rank} {os.environ['DMLC_NUM_WORKER']}\")\n")
+    rc = launch.launch(3, [sys.executable, str(script)],
+                       env_extra={"PROBE_DIR": str(tmp_path)})
+    assert rc == 0
+    seen = set()
+    for r in range(3):
+        rank, n = (tmp_path / f"r{r}").read_text().split()
+        seen.add(rank)
+        assert n == "3"
+    assert seen == {"0", "1", "2"}
+
+
+def test_launch_propagates_failure(tmp_path):
+    script = tmp_path / "bad.py"
+    script.write_text("import sys; sys.exit(3)\n")
+    rc = launch.launch(2, [sys.executable, str(script)])
+    assert rc != 0
