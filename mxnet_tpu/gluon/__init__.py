@@ -10,3 +10,4 @@ from .utils import split_and_load, split_data
 from . import rnn
 from . import data
 from . import model_zoo
+from . import contrib  # noqa: F401

@@ -1,0 +1,4 @@
+"""gluon.contrib (reference: python/mxnet/gluon/contrib) — the
+experimental-layer namespace; HybridConcurrent/Identity live in core nn
+here but are re-exported under the reference's import path."""
+from . import nn  # noqa: F401

@@ -1,0 +1,8 @@
+"""gluon.contrib.nn (reference: python/mxnet/gluon/contrib/nn/
+basic_layers.py) — re-exports for reference-parity imports:
+
+    from mxnet_tpu.gluon.contrib.nn import HybridConcurrent, Identity
+"""
+from ..nn import HybridConcurrent, Identity  # noqa: F401
+
+__all__ = ["HybridConcurrent", "Identity"]

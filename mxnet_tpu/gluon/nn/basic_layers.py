@@ -15,9 +15,9 @@ from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
-           "InstanceNorm", "LayerNorm", "Embedding", "Flatten", "Lambda",
-           "HybridLambda", "Activation", "LeakyReLU", "PReLU", "ELU", "SELU",
-           "GELU", "Swish", "HybridConcurrent", "Identity"]
+           "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding", "Flatten",
+           "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU",
+           "ELU", "SELU", "GELU", "Swish", "HybridConcurrent", "Identity"]
 
 
 def _prod(it):
@@ -221,6 +221,29 @@ class InstanceNorm(HybridBlock):
 
     def hybrid_forward(self, F, x, gamma, beta):
         return F.InstanceNorm(x, gamma, beta, eps=self._epsilon)
+
+
+class GroupNorm(HybridBlock):
+    """Group normalization (reference: gluon nn.GroupNorm over
+    src/operator/nn/group_norm.cc).  gamma/beta are per-GROUP, shape
+    (num_groups,) — the reference convention (torch's GroupNorm is
+    per-channel instead; checkpoints are not interchangeable)."""
+
+    def __init__(self, num_groups=1, epsilon=1e-5, center=True, scale=True,
+                 beta_initializer="zeros", gamma_initializer="ones",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_groups = num_groups
+        self._epsilon = epsilon
+        with self.name_scope():
+            self.gamma = self.params.get("gamma", shape=(num_groups,),
+                                         init=gamma_initializer)
+            self.beta = self.params.get("beta", shape=(num_groups,),
+                                        init=beta_initializer)
+
+    def hybrid_forward(self, F, x, gamma, beta):
+        return F.GroupNorm(x, gamma, beta, num_groups=self._num_groups,
+                           eps=self._epsilon)
 
 
 class LayerNorm(HybridBlock):

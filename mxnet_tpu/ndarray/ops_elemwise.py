@@ -65,6 +65,7 @@ def _register_unary():
         "erfinv": jax.lax.erf_inv,
         "gamma": lambda x: jnp.exp(jsp.gammaln(x)),
         "gammaln": jsp.gammaln,
+        "digamma": jsp.digamma,
         "sin": jnp.sin,
         "cos": jnp.cos,
         "tan": jnp.tan,

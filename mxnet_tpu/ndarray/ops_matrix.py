@@ -359,6 +359,24 @@ def _register():
         return fn
     register_op("diag", diag_maker)
 
+    def trace_maker(offset=0, axis1=0, axis2=1):
+        def fn(x):
+            return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)
+        return fn
+    register_op("trace", trace_maker)
+
+    def tril_maker(k=0):
+        def fn(x):
+            return jnp.tril(x, k)
+        return fn
+    register_op("tril", tril_maker)
+
+    def triu_maker(k=0):
+        def fn(x):
+            return jnp.triu(x, k)
+        return fn
+    register_op("triu", triu_maker)
+
     def depth_to_space_maker(block_size=1):
         def fn(x):
             b, c, h, w = x.shape

@@ -316,6 +316,52 @@ def _register():
         return fn
     register_op("LayerNorm", layernorm_maker, aliases=("layer_norm",))
 
+    def groupnorm_maker(num_groups=1, eps=1e-5, output_mean_var=False):
+        def fn(x, gamma, beta):
+            # (N, C, ...) -> stats per (N, group); gamma/beta are
+            # PER-GROUP, shape (num_groups,) — the reference convention
+            # (src/operator/nn/group_norm.cc), unlike torch's
+            # per-channel affine
+            n, c = x.shape[0], x.shape[1]
+            g = int(num_groups)
+            rest = x.shape[2:]
+            xg = x.reshape((n, g, c // g) + rest)
+            axes = tuple(range(2, xg.ndim))
+            mean = jnp.mean(xg, axis=axes, keepdims=True)
+            var = jnp.mean(jnp.square(xg - mean), axis=axes,
+                           keepdims=True)
+            out = (xg - mean) * lax.rsqrt(var + jnp.asarray(eps, x.dtype))
+            bshape = (1, g, 1) + (1,) * len(rest)
+            out = out * gamma.reshape(bshape) + beta.reshape(bshape)
+            out = out.reshape(x.shape)
+            if output_mean_var:
+                return (out, mean.reshape(n, g), var.reshape(n, g))
+            return out
+        return fn
+    register_op("GroupNorm", groupnorm_maker, aliases=("group_norm",))
+
+    def lrn_maker(alpha=1e-4, beta=0.75, knorm=2.0, nsize=5):
+        half = int(nsize) // 2
+
+        def fn(x):
+            # cross-channel local response normalization (reference:
+            # src/operator/nn/lrn.cc): square, box-sum over the channel
+            # window, scale.  Asymmetric pad keeps the channel dim for
+            # even nsize too.
+            sq = jnp.square(x)
+            pad = [(0, 0)] * x.ndim
+            pad[1] = (half, int(nsize) - 1 - half)
+            acc = lax.reduce_window(
+                sq, jnp.asarray(0, x.dtype), lax.add,
+                (1, int(nsize)) + (1,) * (x.ndim - 2),
+                (1,) * x.ndim,
+                pad)
+            # reference normalizes alpha by the window size (cuDNN
+            # convention, same as torch LocalResponseNorm)
+            return x / jnp.power(knorm + (alpha / nsize) * acc, beta)
+        return fn
+    register_op("LRN", lrn_maker, aliases=("lrn",))
+
     def instancenorm_maker(eps=1e-3):
         def fn(x, gamma, beta):
             axes = tuple(range(2, x.ndim))

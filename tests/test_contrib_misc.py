@@ -78,3 +78,76 @@ def test_count_sketch():
     for i in range(6):
         ref[:, int(h[i])] += data[:, i] * s[i]
     np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_lrn_matches_torch():
+    import torch
+    rng = np.random.default_rng(3)
+    x = (np.abs(rng.standard_normal((2, 6, 5, 5))) + 0.5) \
+        .astype(np.float32)
+    out = mx.nd.LRN(mx.nd.array(x), nsize=5, alpha=1e-4, beta=0.75,
+                    knorm=2.0).asnumpy()
+    ref = torch.nn.functional.local_response_norm(
+        torch.tensor(x), 5, alpha=1e-4, beta=0.75, k=2.0).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_groupnorm_matches_torch_normalization_with_group_affine():
+    """Normalization matches torch group_norm; the affine is PER-GROUP
+    (the MXNet reference convention), so expand gamma/beta to channels
+    for the torch comparison."""
+    import torch
+    rng = np.random.default_rng(4)
+    x = rng.standard_normal((2, 6, 5, 5)).astype(np.float32)
+    g = rng.standard_normal(3).astype(np.float32)     # per group
+    b = rng.standard_normal(3).astype(np.float32)
+    out = mx.nd.GroupNorm(mx.nd.array(x), mx.nd.array(g), mx.nd.array(b),
+                          num_groups=3, eps=1e-5).asnumpy()
+    ref = torch.nn.functional.group_norm(
+        torch.tensor(x), 3,
+        torch.tensor(np.repeat(g, 2)), torch.tensor(np.repeat(b, 2)),
+        1e-5).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_lrn_even_window():
+    rng = np.random.default_rng(6)
+    x = (np.abs(rng.standard_normal((2, 6, 4, 4))) + 0.5) \
+        .astype(np.float32)
+    out = mx.nd.LRN(mx.nd.array(x), nsize=4).asnumpy()
+    assert out.shape == x.shape
+    assert np.isfinite(out).all()
+
+
+def test_gluon_groupnorm_layer():
+    from mxnet_tpu.gluon import nn
+    gn = nn.GroupNorm(num_groups=2)
+    gn.initialize()
+    x = mx.nd.array(np.random.randn(2, 4, 3, 3).astype(np.float32))
+    out = gn(x).asnumpy()
+    assert out.shape == (2, 4, 3, 3)
+    assert gn.gamma.shape == (2,)          # per-group, reference shape
+
+
+def test_digamma_trace_tril_triu():
+    import scipy.special as sp
+    rng = np.random.default_rng(5)
+    a = rng.random((3, 4)).astype(np.float32) + 1
+    np.testing.assert_allclose(
+        mx.nd.digamma(mx.nd.array(a)).asnumpy(), sp.digamma(a),
+        rtol=1e-4, atol=1e-5)
+    m = rng.standard_normal((4, 4)).astype(np.float32)
+    assert np.isclose(float(mx.nd.trace(mx.nd.array(m)).asnumpy()),
+                      np.trace(m), rtol=1e-5)
+    np.testing.assert_array_equal(mx.nd.tril(mx.nd.array(m)).asnumpy(),
+                                  np.tril(m))
+    np.testing.assert_array_equal(
+        mx.nd.triu(mx.nd.array(m), k=1).asnumpy(), np.triu(m, 1))
+
+
+def test_gluon_contrib_nn_namespace():
+    from mxnet_tpu.gluon.contrib.nn import HybridConcurrent, Identity
+    blk = HybridConcurrent(axis=1)
+    blk.add(Identity(), Identity())
+    x = mx.nd.array(np.ones((2, 3), np.float32))
+    assert blk(x).shape == (2, 6)
