@@ -54,7 +54,8 @@ class Symbol:
         if shape is not None:
             attrs["__shape__"] = tuple(shape)
         if dtype is not None:
-            attrs["__dtype__"] = str(dtype)
+            # canonical name ("float16"), not str(np.float16)'s repr
+            attrs["__dtype__"] = _np.dtype(dtype).name
         attrs.update({k: v for k, v in kwargs.items() if v is not None})
         return Symbol([(_Node(None, name, attrs, []), 0)])
 
@@ -267,9 +268,75 @@ class Symbol:
             return None, None, None
 
     def infer_type(self, *args, **kwargs):
-        arg_types = [_np.float32] * len(self.list_arguments())
-        out_types = [_np.float32] * len(self.list_outputs())
-        aux_types = [_np.float32] * len(self.list_auxiliary_states())
+        """Forward dtype-propagation pass (the reference's FInferType
+        fixed-point in miniature — nnvm infer_shape_type pass): seed
+        variable dtypes from positional/keyword hints or ``__dtype__``
+        attrs (default fp32), then walk the topo order with per-op
+        rules (Cast-family fixes the dtype, comparisons/indices follow
+        MXNet's fp32-out convention, everything else promotes)."""
+        order = self._topo()
+        var_nodes = [n for n in order if n.is_var]
+        arg_names = [n.name for n in var_nodes
+                     if not n.attrs.get("__aux__")]
+        aux_names = [n.name for n in var_nodes if n.attrs.get("__aux__")]
+        seeded: Dict[str, _np.dtype] = {}
+        for n, t in zip(arg_names, args):
+            if t is not None:
+                seeded[n] = _np.dtype(t)
+        all_inputs = {n.name for n in var_nodes}
+        for k, v in kwargs.items():
+            if k not in all_inputs:
+                raise MXNetError(
+                    f"infer_type got unknown argument {k!r}; inputs are "
+                    f"{sorted(all_inputs)}")
+            if v is not None:
+                seeded[k] = _np.dtype(v)
+
+        def parse_dt(v, default="float32"):
+            try:
+                return _np.dtype(v)
+            except TypeError:
+                return _np.dtype(default)
+
+        # MXNet conventions: arg-index ops emit fp32; shape/size arrays
+        # are int32 (matching the registered jnp.int32 lowerings)
+        FIXED = {"argmax": _np.dtype(_np.float32),
+                 "argmin": _np.dtype(_np.float32),
+                 "one_hot": _np.dtype(_np.float32),
+                 "shape_array": _np.dtype(_np.int32),
+                 "size_array": _np.dtype(_np.int32)}
+        dtypes: Dict[Tuple[int, int], _np.dtype] = {}
+        name_to_dt: Dict[str, _np.dtype] = {}
+        for node in order:
+            if node.is_var:
+                dt = seeded.get(node.name)
+                if dt is None:
+                    declared = node.attrs.get("__dtype__")
+                    dt = parse_dt(declared) if declared \
+                        else _np.dtype(_np.float32)
+                dtypes[(id(node), 0)] = dt
+                name_to_dt[node.name] = dt
+                continue
+            in_dts = [dtypes[(id(p), i)] for p, i in node.inputs]
+            if node.op in ("Cast", "cast", "amp_cast") or \
+                    (node.op in ("one_hot", "argsort") and
+                     node.attrs.get("dtype")):
+                out_dt = parse_dt(node.attrs.get("dtype", "float32"))
+            elif node.op in FIXED:
+                out_dt = FIXED[node.op]
+            elif node.op == "argsort":
+                out_dt = _np.dtype(_np.float32)
+            elif in_dts:
+                out_dt = _np.result_type(*in_dts) if len(in_dts) > 1 \
+                    else in_dts[0]
+            else:
+                out_dt = _np.dtype(_np.float32)
+            for i in range(node.num_outputs):
+                dtypes[(id(node), i)] = out_dt
+
+        arg_types = [name_to_dt[n] for n in arg_names]
+        aux_types = [name_to_dt[n] for n in aux_names]
+        out_types = [dtypes[(id(n), i)] for n, i in self._heads]
         return arg_types, out_types, aux_types
 
     # -- binding -----------------------------------------------------------

@@ -161,3 +161,40 @@ def test_module_input_grads():
     g = mod.get_input_grads()[0]
     assert g.shape == (32, 8)
     assert np.abs(g.asnumpy()).sum() > 0
+
+
+def test_symbol_infer_type_propagation():
+    """FInferType-style dtype pass: Cast fixes, mixed inputs promote,
+    argmax follows MXNet's fp32-out convention."""
+    import numpy as np
+    from mxnet_tpu import symbol as S
+    x = S.var("data")
+    w = S.var("w")
+    y = S.FullyConnected(x, w, num_hidden=4, no_bias=True)
+    z = S.cast(y, dtype="float16")
+    _, out_t, _ = z.infer_type(data=np.float32)
+    assert np.dtype(out_t[0]) == np.float16
+    _, out_t, _ = y.infer_type(data=np.float16, w=np.float32)
+    assert np.dtype(out_t[0]) == np.float32
+    _, out_t, _ = S.argmax(S.var("p"), axis=1).infer_type(p=np.float16)
+    assert np.dtype(out_t[0]) == np.float32
+
+
+def test_symbol_infer_type_edge_cases():
+    import numpy as np
+    import pytest
+    from mxnet_tpu import symbol as S
+    from mxnet_tpu.base import MXNetError
+    # declared var dtype (stored canonically even from a numpy class)
+    v = S.var("x", dtype=np.float16)
+    _, out_t, _ = (v + v).infer_type()
+    assert np.dtype(out_t[0]) == np.float16
+    # one_hot honors its dtype attr; defaults to fp32
+    oh = S.one_hot(S.var("i"), depth=3, dtype="int32")
+    _, out_t, _ = oh.infer_type()
+    assert np.dtype(out_t[0]) == np.int32
+    _, out_t, _ = S.one_hot(S.var("i"), depth=3).infer_type()
+    assert np.dtype(out_t[0]) == np.float32
+    # unknown argument names raise instead of silently defaulting
+    with pytest.raises(MXNetError, match="unknown argument"):
+        v.infer_type(nope=np.float32)
