@@ -10,8 +10,10 @@ graph; the Gluon-era analog here rewrites a Block tree in place:
     y = qnet(x)             # Dense/Conv2D now run int8 on the MXU
 
 Per-tensor symmetric int8 everywhere (the reference's int8 flow).
-Calibration is minmax over the provided batches; layers without
-calibration quantize activations dynamically per batch.
+Calibration over the provided batches is minmax (reference 'naive') or
+KL-optimal entropy thresholding (reference 'entropy',
+calibrate.cc-style); layers without calibration quantize activations
+dynamically per batch.
 """
 from __future__ import annotations
 
@@ -107,55 +109,146 @@ class QuantizedConv2D(_QuantizedBase):
         return y
 
 
+def _entropy_threshold(hist, bin_width, num_quantized_bins=255):
+    """Pick the |x| clip threshold minimizing KL(P||Q) between the
+    observed activation distribution and its int8-quantized rendition
+    (reference: src/operator/quantization/calibrate.cc
+    GetOptimalThreshold — the TensorRT-style algorithm)."""
+    import numpy as np
+    nbins = len(hist)
+    if nbins <= num_quantized_bins:
+        return nbins * bin_width
+    best_kl, best_i = np.inf, nbins
+    total = hist.sum()
+    if total == 0:
+        return nbins * bin_width
+    for i in range(num_quantized_bins, nbins + 1,
+                   max(1, (nbins - num_quantized_bins) // 64)):
+        # reference dist: first i bins, outliers folded into the edge
+        p = hist[:i].astype(np.float64).copy()
+        p[i - 1] += hist[i:].sum()
+        # quantized dist: the FOLDED p grouped into num_quantized_bins
+        # levels and expanded back (building q from the raw hist would
+        # zero the folded edge bin and wrongly veto every clipping
+        # candidate via the q==0 guard)
+        factor = i / num_quantized_bins
+        q = np.zeros(i, np.float64)
+        for j in range(num_quantized_bins):
+            lo = int(np.floor(j * factor))
+            hi = int(np.ceil((j + 1) * factor))
+            chunk = p[lo:hi]
+            live = chunk > 0
+            if live.any():
+                q[lo:hi][live] = chunk[live].sum() / live.sum()
+        pm = p > 0
+        ps = p[pm] / p.sum()
+        qs = q[pm]
+        if (qs == 0).any():
+            continue
+        qs = qs / q.sum()
+        kl = float(np.sum(ps * np.log(ps / qs)))
+        if kl < best_kl:
+            best_kl, best_i = kl, i
+    return best_i * bin_width
+
+
 def _collect_ranges(net: HybridBlock, calib_data: Iterable,
-                    targets) -> Dict[int, tuple]:
-    """minmax calibration: run the fp32 net over the batches, recording
-    each target layer's input range (reference calib_mode='naive')."""
-    ranges: Dict[int, list] = {}
+                    targets, calib_mode: str = "minmax",
+                    num_bins: int = 8001) -> Dict[int, tuple]:
+    """Run the fp32 net over the batches recording each target layer's
+    input range.  calib_mode='minmax' (reference 'naive') takes the raw
+    extrema; 'entropy' collects |x| histograms and picks the
+    KL-optimal clip threshold (reference calib_mode='entropy')."""
+    import numpy as np
+    if calib_mode == "entropy":
+        calib_data = list(calib_data)   # two passes need replay
+    stats: Dict[int, list] = {}       # id -> [lo, hi] or histogram state
     hooks = []
 
-    def make_hook(block):
+    def make_minmax_hook(block):
         def hook(blk, args, out):
-            import numpy as np
             x = args[0].asnumpy()
             lo, hi = float(np.min(x)), float(np.max(x))
-            cur = ranges.get(id(blk))
+            cur = stats.get(id(blk))
             if cur is None:
-                ranges[id(blk)] = [lo, hi]
+                stats[id(blk)] = [lo, hi]
             else:
                 cur[0] = min(cur[0], lo)
                 cur[1] = max(cur[1], hi)
         return hook
 
-    def attach(block):
+    def make_hist_hook(block, max_abs):
+        def hook(blk, args, out):
+            x = np.abs(args[0].asnumpy()).ravel()
+            h, _ = np.histogram(x, bins=num_bins,
+                                range=(0.0, max_abs[id(blk)]))
+            cur = stats.get(id(blk))
+            if cur is None:
+                stats[id(blk)] = h.astype(np.int64)
+            else:
+                stats[id(blk)] = cur + h
+        return hook
+
+    def attach(block, mk):
         for child in block._children.values():
             if isinstance(child, targets):
-                child.register_forward_hook(make_hook(child))
+                child.register_forward_hook(mk(child))
                 hooks.append(child)
             else:
-                attach(child)
-    attach(net)
+                attach(child, mk)
+
+    if calib_mode == "entropy":
+        # pass 1: per-layer max |x| fixes the histogram range
+        attach(net, make_minmax_hook)
+        for batch in calib_data:
+            net(batch)
+        for blk in hooks:
+            blk._forward_hooks.clear()
+        max_abs = {k: max(abs(v[0]), abs(v[1])) or 1e-8
+                   for k, v in stats.items()}
+        stats.clear()
+        hooks.clear()
+        # pass 2: histograms → KL-optimal thresholds
+        attach(net, lambda b: make_hist_hook(b, max_abs))
+        for batch in calib_data:
+            net(batch)
+        for blk in hooks:
+            blk._forward_hooks.clear()
+        out = {}
+        for k, hist in stats.items():
+            thr = _entropy_threshold(hist, max_abs[k] / num_bins)
+            out[k] = (-thr, thr)
+        return out
+
+    attach(net, make_minmax_hook)
     for batch in calib_data:
         net(batch)
     for blk in hooks:
         blk._forward_hooks.clear()
-    return {k: tuple(v) for k, v in ranges.items()}
+    return {k: tuple(v) for k, v in stats.items()}
 
 
 def quantize_net(net: HybridBlock, calib_data: Optional[Iterable] = None,
                  exclude_layers: Sequence[str] = (),
-                 quantize_conv: bool = True) -> HybridBlock:
+                 quantize_conv: bool = True,
+                 calib_mode: str = "minmax") -> HybridBlock:
     """Rewrite ``net`` in place: Dense (and optionally Conv2D) layers
     become int8 blocks.  Returns ``net``.
 
-    With ``calib_data`` (an iterable of input batches), activation ranges
-    are calibrated minmax-style and frozen; without it, activations are
-    quantized dynamically per batch (slower, range-exact).
+    With ``calib_data`` (an iterable of input batches), activation
+    ranges are calibrated and frozen — ``calib_mode='minmax'`` takes raw
+    extrema (reference 'naive'); ``'entropy'`` picks KL-optimal clip
+    thresholds, robust to outliers (reference 'entropy').  Without
+    calib_data, activations are quantized dynamically per batch.
     """
+    if calib_mode not in ("minmax", "naive", "entropy"):
+        raise MXNetError(f"unknown calib_mode {calib_mode!r}")
     targets = (nn.Dense, nn.Conv2D) if quantize_conv else (nn.Dense,)
     ranges: Dict[int, tuple] = {}
     if calib_data is not None:
-        ranges = _collect_ranges(net, calib_data, targets)
+        ranges = _collect_ranges(
+            net, calib_data, targets,
+            "entropy" if calib_mode == "entropy" else "minmax")
 
     def swap(block):
         for name, child in list(block._children.items()):

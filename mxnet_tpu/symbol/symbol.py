@@ -327,8 +327,14 @@ class Symbol:
             elif node.op == "argsort":
                 out_dt = _np.dtype(_np.float32)
             elif in_dts:
-                out_dt = _np.result_type(*in_dts) if len(in_dts) > 1 \
-                    else in_dts[0]
+                if len(in_dts) == 1:
+                    out_dt = in_dts[0]
+                else:
+                    # MXNet (and the jax backend under x64-disabled)
+                    # never widens int+float to fp64: the FLOAT
+                    # operands decide; mixed floats take the widest
+                    floats = [d for d in in_dts if d.kind == "f"]
+                    out_dt = _np.result_type(*(floats or in_dts))
             else:
                 out_dt = _np.dtype(_np.float32)
             for i in range(node.num_outputs):

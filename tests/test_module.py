@@ -198,3 +198,15 @@ def test_symbol_infer_type_edge_cases():
     # unknown argument names raise instead of silently defaulting
     with pytest.raises(MXNetError, match="unknown argument"):
         v.infer_type(nope=np.float32)
+
+
+def test_symbol_infer_type_no_fp64_promotion():
+    import numpy as np
+    from mxnet_tpu import symbol as S
+    emb = S.Embedding(S.var("data"), S.var("w"), input_dim=10,
+                      output_dim=4)
+    _, out_t, _ = emb.infer_type(data=np.int32, w=np.float32)
+    assert np.dtype(out_t[0]) == np.float32
+    s = S.var("a") + S.var("b")
+    _, out_t, _ = s.infer_type(a=np.float16, b=np.int32)
+    assert np.dtype(out_t[0]) == np.float16

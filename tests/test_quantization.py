@@ -158,3 +158,60 @@ def test_quantize_net_exclude_and_dense_only():
     qnet = quantize_net(net, quantize_conv=False)
     kinds = [type(c).__name__ for c in qnet._children.values()]
     assert kinds[0] == "Conv2D" and kinds[-1] == "QuantizedDense"
+
+
+def test_entropy_calibration_clips_outliers():
+    """calib_mode='entropy' (reference: calibrate.cc KL threshold) must
+    pick a clip near the bulk of the distribution, not the outlier."""
+    from mxnet_tpu.contrib.quantization import _collect_ranges
+    rng = np.random.default_rng(0)
+    X = rng.standard_normal((64, 32)).astype(np.float32)
+    X[0, 0] = 80.0
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16))
+    net.initialize(mx.init.Xavier())
+    calib = [mx.nd.array(X[i * 16:(i + 1) * 16]) for i in range(4)]
+    r_mm = _collect_ranges(net, calib, (nn.Dense,), "minmax")
+    r_en = _collect_ranges(net, calib, (nn.Dense,), "entropy")
+    (mm,) = r_mm.values()
+    (en,) = r_en.values()
+    assert max(abs(mm[0]), abs(mm[1])) == pytest.approx(80.0)
+    assert max(abs(en[0]), abs(en[1])) < 10.0      # outlier clipped
+
+
+def test_entropy_beats_minmax_on_heavy_tails():
+    from mxnet_tpu.contrib.quantization import quantize_net
+    import mxnet_tpu.ndarray as F
+    rng = np.random.default_rng(1)
+    X = rng.standard_normal((64, 32)).astype(np.float32)
+    X[0, 0] = 500.0      # 500x the data scale: minmax resolution dies
+    # fixed weights from the same rng — deterministic across processes
+    W1 = (rng.standard_normal((64, 32)) * 0.2).astype(np.float32)
+    b1 = np.zeros(64, np.float32)
+    W2 = (rng.standard_normal((10, 64)) * 0.2).astype(np.float32)
+    b2 = np.zeros(10, np.float32)
+
+    def build():
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(64, activation="relu"))
+            net.add(nn.Dense(10))
+        net.initialize(mx.init.Xavier())
+        for p, v in zip(net.collect_params().values(),
+                        (W1, b1, W2, b2)):
+            p.set_data(F.array(v))
+        return net
+
+    net = build()
+    ref = net(mx.nd.array(X)).asnumpy()
+    calib = [mx.nd.array(X[i * 16:(i + 1) * 16]) for i in range(4)]
+
+    qm = quantize_net(build(), calib_data=calib, calib_mode="minmax")
+    qe = quantize_net(build(), calib_data=calib, calib_mode="entropy")
+    normal = slice(1, None)              # exclude the outlier row
+    em = np.abs(qm(mx.nd.array(X)).asnumpy()[normal] -
+                ref[normal]).mean()
+    ee = np.abs(qe(mx.nd.array(X)).asnumpy()[normal] -
+                ref[normal]).mean()
+    assert ee < em
