@@ -31,7 +31,8 @@ def _sync(x):
     jax.block_until_ready(x)
 
 
-def bench_resnet50(dtype, batch, iters, warmup, size=224):
+def bench_resnet50(dtype, batch, iters, warmup, size=224,
+                   layout="NCHW"):
     """Whole-step jitted train throughput (the round-1/2 bench)."""
     import jax
     from mxnet_tpu.contrib import amp
@@ -44,13 +45,15 @@ def bench_resnet50(dtype, batch, iters, warmup, size=224):
 
         n_dev = len(jax.devices())
         batch = max(batch, n_dev) // n_dev * n_dev
-        net = resnet50_v1()
+        net = resnet50_v1(layout=layout)
         net.initialize()
         tr = par.ShardedTrainer(
             net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
             {"learning_rate": 0.1, "momentum": 0.9, "wd": 1e-4})
         rng = np.random.default_rng(0)
-        x = rng.standard_normal((batch, 3, size, size), dtype=np.float32)
+        shape = (batch, 3, size, size) if layout == "NCHW" else \
+            (batch, size, size, 3)
+        x = rng.standard_normal(shape, dtype=np.float32)
         y = rng.integers(0, 1000, (batch,))
         loss = tr.step(x, y)          # build + compile
         # keep the batch resident in HBM: real input pipelines prefetch to
@@ -207,6 +210,9 @@ def main():
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
                     help="kept for compat: forces the single resnet row")
+    ap.add_argument("--layout", choices=["NCHW", "NHWC"], default="NCHW",
+                    help="resnet rows' data layout (NHWC = channels-last "
+                    "experiment)")
     ap.add_argument("--profile", metavar="DIR",
                     help="capture a jax.profiler trace of the bf16 "
                     "resnet row into DIR")
@@ -233,14 +239,16 @@ def main():
         key = f"resnet50_{'bf16' if dt == 'bfloat16' else 'fp32'}"
         with profiled():
             rows[key] = bench_resnet50(dt, args.batch, args.iters,
-                                       args.warmup, args.size)
+                                       args.warmup, args.size,
+                                       args.layout)
     else:
         with profiled():
             rows["resnet50_bf16"] = bench_resnet50(
                 "bfloat16", args.batch, args.iters, args.warmup,
-                args.size)
+                args.size, args.layout)
         rows["resnet50_fp32"] = bench_resnet50(
-            "float32", args.batch, args.iters, args.warmup, args.size)
+            "float32", args.batch, args.iters, args.warmup, args.size,
+            args.layout)
         rows["mnist_mlp_imperative"] = bench_mnist_mlp()
         rows["bert_base"] = bench_bert_base()
         rows["input_pipeline"] = bench_pipeline()

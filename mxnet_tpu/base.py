@@ -16,6 +16,7 @@ import numpy as _np
 
 __all__ = [
     "MXNetError",
+    "is_channels_last",
     "register_env",
     "get_env",
     "list_env",
@@ -38,6 +39,22 @@ class MXNetError(RuntimeError):
     Mirrors the reference's ``mxnet.base.MXNetError`` which surfaces C-side
     ``dmlc::Error``; here errors originate in Python/JAX directly.
     """
+
+
+_CHANNELS_LAST = {"NWC": 1, "NHWC": 2, "NDHWC": 3}
+
+
+def is_channels_last(layout, ndim=None):
+    """True for the channels-last conv/pool layouts (NWC/NHWC/NDHWC).
+    With ``ndim`` given, a rank-mismatched layout string raises instead
+    of being silently remapped."""
+    if layout not in _CHANNELS_LAST:
+        return False
+    if ndim is not None and _CHANNELS_LAST[layout] != ndim:
+        raise MXNetError(
+            f"layout {layout!r} is for {_CHANNELS_LAST[layout]}d "
+            f"convolution/pooling, got {ndim}d")
+    return True
 
 
 def force_cpu_mesh(n_devices: int, verify: bool = True) -> None:

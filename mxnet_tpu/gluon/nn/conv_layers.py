@@ -5,7 +5,7 @@ NCHW layouts, OIHW weight shapes, deferred in_channels, pooling defaults.
 """
 from __future__ import annotations
 
-from ...base import MXNetError
+from ...base import MXNetError, is_channels_last
 from ..block import HybridBlock
 
 __all__ = ["Conv1D", "Conv2D", "Conv3D", "Conv1DTranspose", "Conv2DTranspose",
@@ -31,6 +31,7 @@ class _Conv(HybridBlock):
         ndim = len(kernel_size)
         self._channels = channels
         self._in_channels = in_channels
+        self._channels_last = is_channels_last(layout, ndim)
         self._kwargs = {
             "kernel": kernel_size,
             "stride": strides,
@@ -40,14 +41,22 @@ class _Conv(HybridBlock):
             "num_group": groups,
             "no_bias": not use_bias,
         }
+        if self._channels_last:
+            if op_name != "Convolution":
+                raise ValueError(
+                    "channels-last layout is supported for Convolution "
+                    "only")
+            self._kwargs["layout"] = layout
         if adj is not None:
             self._kwargs["adj"] = adj
         self._op_name = op_name
         self._act_type = activation
-        # weight layout: Convolution OIHW (O=channels); Deconvolution IOHW
+        # weight layout: Convolution OIHW in EVERY data layout (the op's
+        # dimension_numbers map it; keeps initializer fan math and
+        # checkpoints layout-portable); Deconvolution IOHW
+        cin_g = in_channels // groups if in_channels else 0
         if op_name == "Convolution":
-            wshape = (channels, in_channels // groups if in_channels else 0) \
-                + tuple(kernel_size)
+            wshape = (channels, cin_g) + tuple(kernel_size)
         else:
             wshape = (in_channels, channels // groups if channels else 0) \
                 + tuple(kernel_size) if in_channels else \
@@ -62,7 +71,7 @@ class _Conv(HybridBlock):
                     allow_deferred_init=True)
 
     def infer_shape(self, x, *args):
-        cin = x.shape[1]
+        cin = x.shape[-1] if self._channels_last else x.shape[1]
         groups = self._kwargs["num_group"]
         k = tuple(self._kwargs["kernel"])
         if self._op_name == "Convolution":
@@ -162,7 +171,8 @@ class Conv3DTranspose(_ConvTranspose):
 
 class _Pooling(HybridBlock):
     def __init__(self, pool_size, strides, padding, ceil_mode, global_pool,
-                 pool_type, count_include_pad=None, prefix=None, params=None):
+                 pool_type, count_include_pad=None, layout=None,
+                 prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         if strides is None:
             strides = pool_size
@@ -174,6 +184,8 @@ class _Pooling(HybridBlock):
             "global_pool": global_pool,
             "pooling_convention": "full" if ceil_mode else "valid",
         }
+        if is_channels_last(layout, len(pool_size)):
+            self._kwargs["layout"] = layout
         if count_include_pad is not None:
             self._kwargs["count_include_pad"] = count_include_pad
 
@@ -187,7 +199,7 @@ class MaxPool1D(_Pooling):
         super().__init__(_tuplize(pool_size, 1),
                          _tuplize(strides, 1) if strides is not None else None,
                          _tuplize(padding, 1), ceil_mode, False, "max",
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class MaxPool2D(_Pooling):
@@ -196,7 +208,7 @@ class MaxPool2D(_Pooling):
         super().__init__(_tuplize(pool_size, 2),
                          _tuplize(strides, 2) if strides is not None else None,
                          _tuplize(padding, 2), ceil_mode, False, "max",
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class MaxPool3D(_Pooling):
@@ -205,7 +217,7 @@ class MaxPool3D(_Pooling):
         super().__init__(_tuplize(pool_size, 3),
                          _tuplize(strides, 3) if strides is not None else None,
                          _tuplize(padding, 3), ceil_mode, False, "max",
-                         **kwargs)
+                         layout=layout, **kwargs)
 
 
 class AvgPool1D(_Pooling):
@@ -214,7 +226,7 @@ class AvgPool1D(_Pooling):
         super().__init__(_tuplize(pool_size, 1),
                          _tuplize(strides, 1) if strides is not None else None,
                          _tuplize(padding, 1), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool2D(_Pooling):
@@ -224,7 +236,7 @@ class AvgPool2D(_Pooling):
         super().__init__(_tuplize(pool_size, 2),
                          _tuplize(strides, 2) if strides is not None else None,
                          _tuplize(padding, 2), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class AvgPool3D(_Pooling):
@@ -234,13 +246,13 @@ class AvgPool3D(_Pooling):
         super().__init__(_tuplize(pool_size, 3),
                          _tuplize(strides, 3) if strides is not None else None,
                          _tuplize(padding, 3), ceil_mode, False, "avg",
-                         count_include_pad, **kwargs)
+                         count_include_pad, layout=layout, **kwargs)
 
 
 class _GlobalPool(_Pooling):
     def __init__(self, ndim, pool_type, layout, **kwargs):
         super().__init__((1,) * ndim, (1,) * ndim, (0,) * ndim, False, True,
-                         pool_type, **kwargs)
+                         pool_type, layout=layout, **kwargs)
 
 
 class GlobalMaxPool1D(_GlobalPool):

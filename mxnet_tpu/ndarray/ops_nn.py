@@ -36,7 +36,21 @@ def _register():
     def _spatial_dims(kernel):
         return len(kernel)
 
-    def _conv_dn(nd):
+    from ..base import is_channels_last
+
+    def _conv_dn(nd, layout=None):
+        # channels-last data layouts (reference conv layout param:
+        # NWC/NHWC/NDHWC) — the TPU-native tiling.  Weights stay OIHW in
+        # EVERY layout (lax dimension_numbers maps them; XLA's layout
+        # assignment makes it free), so initializer fan math and
+        # checkpoints are layout-portable — a deliberate deviation from
+        # the reference's weight-follows-layout convention.
+        if is_channels_last(layout, nd):
+            if nd == 1:
+                return ("NWC", "OIW", "NWC")
+            if nd == 2:
+                return ("NHWC", "OIHW", "NHWC")
+            return ("NDHWC", "OIDHW", "NDHWC")
         if nd == 1:
             return ("NCH", "OIH", "NCH")
         if nd == 2:
@@ -51,6 +65,9 @@ def _register():
         stride_ = tuple(stride) if stride else (1,) * nd
         dilate_ = tuple(dilate) if dilate else (1,) * nd
         pad_ = tuple(pad) if pad else (0,) * nd
+        channels_last = is_channels_last(layout, nd)
+        bshape = ((1,) + (1,) * nd + (-1,)) if channels_last \
+            else ((1, -1) + (1,) * nd)
 
         def fn(x, w, *maybe_b):
             y = lax.conv_general_dilated(
@@ -58,10 +75,10 @@ def _register():
                 padding=[(p, p) for p in pad_],
                 rhs_dilation=dilate_,
                 feature_group_count=num_group,
-                dimension_numbers=_conv_dn(nd))
+                dimension_numbers=_conv_dn(nd, layout))
             if not no_bias:
                 b = maybe_b[0]
-                y = y + b.reshape((1, -1) + (1,) * nd)
+                y = y + b.reshape(bshape)
             return y
         return fn
     register_op("Convolution", conv_maker, aliases=("convolution",))
@@ -100,11 +117,13 @@ def _register():
                    count_include_pad=True, cudnn_off=None, p_value=2,
                    layout=None):
         nd = len(kernel) if kernel else 2
+        channels_last = is_channels_last(layout, nd if kernel else None)
 
         def fn(x):
             sdims = x.ndim - 2
+            sp0 = 1 if channels_last else 2   # first spatial dim index
             if global_pool:
-                axes = tuple(range(2, x.ndim))
+                axes = tuple(range(sp0, sp0 + sdims))
                 if pool_type == "max":
                     r = jnp.max(x, axis=axes, keepdims=True)
                 elif pool_type == "sum":
@@ -121,13 +140,18 @@ def _register():
                 if pooling_convention == "full":
                     # ceil convention: pad extra on the high side so the last
                     # partial window is included (reference 'full' pooling)
-                    in_sz = x.shape[2 + i] + 2 * p[i]
+                    in_sz = x.shape[sp0 + i] + 2 * p[i]
                     out_full = -(-(in_sz - k[i]) // s[i]) + 1
                     hi += max(0, (out_full - 1) * s[i] + k[i] - in_sz)
                 pads.append((lo, hi))
-            window = (1, 1) + k
-            strides = (1, 1) + s
-            padcfg = [(0, 0), (0, 0)] + pads
+            if channels_last:
+                window = (1,) + k + (1,)
+                strides = (1,) + s + (1,)
+                padcfg = [(0, 0)] + pads + [(0, 0)]
+            else:
+                window = (1, 1) + k
+                strides = (1, 1) + s
+                padcfg = [(0, 0), (0, 0)] + pads
             if pool_type == "max":
                 # init must be a STATIC scalar: a traced init value defeats
                 # jax's reduce_window_max autodiff pattern-match

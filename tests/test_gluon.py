@@ -288,3 +288,26 @@ def test_dataset_vision_synthetic():
     x, y = ds[0]
     assert x.shape == (28, 28, 1)
     assert 0 <= int(y) < 10
+
+
+def test_resnet_nhwc_layout_parity():
+    """layout='NHWC' resnet must equal the NCHW net with transposed
+    weights/inputs (channels-last is the TPU-native tiling)."""
+    import numpy as np
+    import mxnet_tpu.ndarray as F
+    from mxnet_tpu.gluon.model_zoo.vision import resnet18_v1
+    x = np.random.randn(2, 3, 64, 64).astype(np.float32)
+    n1 = resnet18_v1(classes=10)
+    n1.initialize(mx.init.Xavier())
+    y1 = n1(mx.nd.array(x)).asnumpy()
+    n2 = resnet18_v1(classes=10, layout="NHWC")
+    n2.initialize(mx.init.Xavier())
+    xt = np.ascontiguousarray(np.transpose(x, (0, 2, 3, 1)))
+    n2(mx.nd.array(xt))                       # settle deferred shapes
+    # weights are OIHW in BOTH layouts (layout-portable checkpoints) —
+    # copy verbatim
+    for p1, p2 in zip(n1.collect_params().values(),
+                      n2.collect_params().values()):
+        p2.set_data(F.array(p1.data().asnumpy()))
+    y2 = n2(mx.nd.array(xt)).asnumpy()
+    np.testing.assert_allclose(y2, y1, rtol=1e-4, atol=1e-4)
