@@ -327,3 +327,37 @@ def test_rcnn_rpn_heads_receive_gradient():
     loc_g = net.rpn.loc.weight.grad().asnumpy()
     assert np.abs(score_g).sum() > 0
     assert np.abs(loc_g).sum() > 0
+
+
+def test_faster_rcnn_resnet_backbone_trains():
+    """The resnet18-backed variant (not just *_toy): forward shapes and a
+    supervised train step through the full backbone (round-2 weak #8)."""
+    from mxnet_tpu.gluon import Trainer
+    from mxnet_tpu.gluon.model_zoo import RCNNLoss
+    from mxnet_tpu.gluon.model_zoo.rcnn import faster_rcnn_resnet18_v1
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = faster_rcnn_resnet18_v1(classes=4, rpn_post_nms=8,
+                                  rpn_pre_nms=32, img_size=128)
+    net.initialize(mx.init.Xavier())
+    x = mx.nd.array(np.random.randn(1, 3, 128, 128).astype(np.float32))
+    cls, box, rois, rpn_s, rpn_l = net(x)
+    assert cls.shape == (1, 8, 5)        # classes+1 scores per roi
+    assert box.shape == (1, 8, 4)
+    assert rois.shape == (8, 5)
+    gt_boxes = mx.nd.array(np.array([[[10, 10, 60, 60]]], np.float32))
+    gt_cls = mx.nd.array(np.array([[2]], np.float32))
+    loss = RCNNLoss.for_net(net)
+    tr = Trainer(net.collect_params(), "sgd",
+                 {"learning_rate": 1e-3, "momentum": 0.9})
+    losses = []
+    for _ in range(4):
+        with mx.autograd.record():
+            L = loss(net(x), gt_boxes, gt_cls)
+        L.backward()
+        tr.step(1)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < losses[0], losses
+    # the BACKBONE itself must receive gradient, not just the heads
+    first_conv_w = list(net.features._children.values())[0].weight
+    assert np.abs(first_conv_w.grad().asnumpy()).sum() > 0
