@@ -154,6 +154,100 @@ def bench_bert_base(iters=10, warmup=3, batch=8, seq=128):
             "sequences_per_sec": round(batch * iters / dt, 1)}
 
 
+def bench_nmt(iters=8, warmup=2, batch=16, buckets=(32, 48, 64)):
+    """Config #4 (Sockeye-style NMT): transformer-base seq2seq with
+    BUCKETED sequence lengths — one jit cache entry per bucket shape
+    (the reference's BucketingModule economics, SURVEY §5.7)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.transformer import transformer_nmt_base
+
+    net = transformer_nmt_base(vocab_size=32000, max_length=128)
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 1e-4})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    rng = np.random.default_rng(0)
+
+    def batch_for(seq):
+        src = mx.nd.array(rng.integers(1, 32000, (batch, seq)))
+        tgt = mx.nd.array(rng.integers(1, 32000, (batch, seq)))
+        lab = mx.nd.array(rng.integers(1, 32000, (batch, seq)))
+        return src, tgt, lab
+
+    def step(src, tgt, lab):
+        with autograd.record():
+            out = net(src, tgt)
+            L = mx.nd.mean(loss_fn(out, lab))
+        L.backward()
+        tr.step(batch)
+        return L
+
+    data = {s: batch_for(s) for s in buckets}
+    for s in buckets:                      # compile one exec per bucket
+        L = step(*data[s])
+    for _ in range(warmup):
+        for s in buckets:
+            L = step(*data[s])
+    float(L.asnumpy())
+    t0 = time.perf_counter()
+    tokens = 0
+    for _ in range(iters):
+        for s in buckets:
+            L = step(*data[s])
+            tokens += batch * s
+    float(L.asnumpy())
+    dt = time.perf_counter() - t0
+    return {"tokens_per_sec": round(tokens / dt, 1), "batch": batch,
+            "buckets": list(buckets)}
+
+
+def bench_ssd(iters=10, warmup=2, batch=8, size=512):
+    """Config #5 (SSD detection): train-step throughput of the
+    resnet50-backed SSD with the multibox loss (pad-and-mask static
+    shapes throughout — SURVEY §2.2 contrib row)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+    from mxnet_tpu.gluon.model_zoo.ssd import (SSDMultiBoxLoss,
+                                               ssd_512_resnet50_v1)
+
+    net = ssd_512_resnet50_v1(classes=20)
+    net.initialize()
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 1e-3, "momentum": 0.9})
+    loss_fn = SSDMultiBoxLoss()
+    rng = np.random.default_rng(0)
+    x = mx.nd.array(rng.standard_normal((batch, 3, size, size),
+                                        dtype=np.float32))
+    labels = np.full((batch, 4, 5), -1, np.float32)
+    for i in range(batch):
+        labels[i, 0] = [i % 20, 0.1, 0.1, 0.6, 0.6]
+        labels[i, 1] = [(i + 3) % 20, 0.5, 0.5, 0.9, 0.9]
+    y = mx.nd.array(labels)
+
+    def step():
+        with autograd.record():
+            anchors, cls_preds, box_preds = net(x)
+            L = loss_fn(anchors, cls_preds, box_preds, y)
+        L.backward()
+        tr.step(batch)
+        return L
+
+    L = step()
+    for _ in range(warmup):
+        L = step()
+    float(L.asnumpy())
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        L = step()
+    float(L.asnumpy())
+    dt = time.perf_counter() - t0
+    return {"images_per_sec": round(batch * iters / dt, 2),
+            "batch": batch, "size": size}
+
+
 def bench_pipeline(n_images=1024, batch=128, threads=None):
     """SURVEY hard-part #4: RecordIO+JPEG decode/augment throughput
     through the native C++ core (mxnet_tpu/native/io_core.cc).  Scales
@@ -205,7 +299,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--only", choices=["resnet_bf16", "resnet_fp32",
-                                       "mnist_mlp", "bert", "pipeline"],
+                                       "mnist_mlp", "bert", "nmt", "ssd",
+                                       "pipeline"],
                     help="run a single row (default: the full suite)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
@@ -231,6 +326,10 @@ def main():
         rows["mnist_mlp_imperative"] = bench_mnist_mlp()
     elif args.only == "bert":
         rows["bert_base"] = bench_bert_base()
+    elif args.only == "nmt":
+        rows["nmt_transformer"] = bench_nmt()
+    elif args.only == "ssd":
+        rows["ssd_detection"] = bench_ssd()
     elif args.only == "pipeline":
         rows["input_pipeline"] = bench_pipeline()
     elif args.only in ("resnet_bf16", "resnet_fp32") or args.dtype:
@@ -251,6 +350,14 @@ def main():
             args.layout)
         rows["mnist_mlp_imperative"] = bench_mnist_mlp()
         rows["bert_base"] = bench_bert_base()
+        # CPU CI host (1 core) gets reduced step counts; the TPU run
+        # keeps the real ones
+        import jax as _jax
+        cpu_ci = _jax.default_backend() == "cpu"
+        rows["nmt_transformer"] = bench_nmt(iters=2, warmup=1) if cpu_ci \
+            else bench_nmt()
+        rows["ssd_detection"] = bench_ssd(iters=2, warmup=1, batch=2) \
+            if cpu_ci else bench_ssd()
         rows["input_pipeline"] = bench_pipeline()
 
     # per-row headline field + unit, so --only rows are labeled honestly
@@ -259,6 +366,8 @@ def main():
         "resnet50_fp32": ("images_per_sec_per_chip", "images/sec/chip"),
         "mnist_mlp_imperative": ("images_per_sec", "images/sec"),
         "bert_base": ("step_ms", "ms/step"),
+        "nmt_transformer": ("tokens_per_sec", "tokens/sec"),
+        "ssd_detection": ("images_per_sec", "images/sec"),
         "input_pipeline": ("images_per_sec", "images/sec"),
     }
     if "resnet50_bf16" in rows:

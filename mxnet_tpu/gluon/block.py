@@ -347,7 +347,6 @@ class HybridBlock(Block):
         return list(self.collect_params().values())
 
     def _call_cached(self, *inputs):
-        import jax
         ctx = inputs[0].context
         training = _autograd.is_training()
         sig = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
@@ -356,7 +355,7 @@ class HybridBlock(Block):
         if entry is None:
             entry = self._build_cached(inputs, training, ctx)
             self._cached_graph[sig] = entry
-        jitted, params, meta = entry
+        jitted, jitted_vjp, params, meta = entry
         n_outs_cell, write_idx_cell = meta
 
         pvals = [p.data(ctx)._read() for p in params]
@@ -367,7 +366,7 @@ class HybridBlock(Block):
             any(p.data(ctx)._ag is not None for p in params) or
             any(getattr(a, "_ag", None) is not None for a in inputs))
         if recording:
-            flat, vjp_fn = jax.vjp(jitted, key, *pvals, *invals)
+            flat, vjp_fn = jitted_vjp(key, *pvals, *invals)
         else:
             flat = jitted(key, *pvals, *invals)
 
@@ -426,7 +425,12 @@ class HybridBlock(Block):
             return tuple(out_vals) + tuple(v for _, v in writes)
 
         jitted = jax.jit(pure_fn)
-        return jitted, params, (n_outs_cell, write_idx_cell)
+        # cached vjp wrapper for the training path: a bare jax.vjp would
+        # re-linearize the whole graph in Python EVERY step; jitting the
+        # (primals -> (outs, vjp_fn)) wrapper traces once per signature
+        # (same mechanism as ndarray.register.Operator.get_vjp_fn)
+        jitted_vjp = jax.jit(lambda *a: jax.vjp(pure_fn, *a))
+        return jitted, jitted_vjp, params, (n_outs_cell, write_idx_cell)
 
     def hybrid_forward_entry(self, *inputs):
         """Entry used during trace: routes through forward so nested blocks

@@ -95,9 +95,12 @@ class Operator:
         try:
             return self._vjp_cached(kwkey)
         except TypeError:
+            # unhashable kwargs: uncached, but still vjp through jit so
+            # the forward stays one fused XLA call (mirrors get_fn)
             import jax
             fn = self.maker(**kwargs)
-            return lambda *p: jax.vjp(fn, *p)
+            jfn = jax.jit(fn) if self.use_jit else fn
+            return lambda *p: jax.vjp(jfn, *p)
 
 
 def register_op(name: str, maker: Optional[Callable] = None, *,
