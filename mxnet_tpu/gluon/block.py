@@ -426,10 +426,11 @@ class HybridBlock(Block):
 
         jitted = jax.jit(pure_fn)
         # cached vjp wrapper for the training path: a bare jax.vjp would
-        # re-linearize the whole graph in Python EVERY step; jitting the
-        # (primals -> (outs, vjp_fn)) wrapper traces once per signature
-        # (same mechanism as ndarray.register.Operator.get_vjp_fn)
-        jitted_vjp = jax.jit(lambda *a: jax.vjp(pure_fn, *a))
+        # re-linearize the whole graph in Python EVERY step.  vjp of the
+        # JITTED fn (not raw pure_fn) keeps the linearized jaxpr a single
+        # pjit eqn, so the returned vjp_fn's transpose also runs as ONE
+        # compiled call rather than eager per-primitive dispatch.
+        jitted_vjp = jax.jit(lambda *a: jax.vjp(jitted, *a))
         return jitted, jitted_vjp, params, (n_outs_cell, write_idx_cell)
 
     def hybrid_forward_entry(self, *inputs):

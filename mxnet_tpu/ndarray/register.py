@@ -95,12 +95,13 @@ class Operator:
         try:
             return self._vjp_cached(kwkey)
         except TypeError:
-            # unhashable kwargs: uncached, but still vjp through jit so
-            # the forward stays one fused XLA call (mirrors get_fn)
+            # unhashable kwargs: uncached — a fresh jax.jit here would be
+            # a guaranteed cache miss (keyed on callable identity), i.e.
+            # a full XLA compile EVERY invoke; eager vjp through the
+            # per-primitive caches is the cheaper fallback
             import jax
             fn = self.maker(**kwargs)
-            jfn = jax.jit(fn) if self.use_jit else fn
-            return lambda *p: jax.vjp(jfn, *p)
+            return lambda *p: jax.vjp(fn, *p)
 
 
 def register_op(name: str, maker: Optional[Callable] = None, *,
