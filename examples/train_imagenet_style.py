@@ -63,11 +63,10 @@ def main():
         net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
         {"learning_rate": args.lr, "momentum": 0.9, "wd": 1e-4})
 
-    speed = mx.callback.Speedometer(args.batch_size, frequent=10)
     for epoch in range(args.epochs):
         it.reset()
         t0, n = time.perf_counter(), 0
-        for i, batch in enumerate(it):
+        for batch in it:
             loss = tr.step(batch.data[0], batch.label[0])
             n += batch.data[0].shape[0]
         print(f"epoch {epoch}: loss {float(loss.asnumpy()):.4f} "

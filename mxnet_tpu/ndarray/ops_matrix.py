@@ -359,6 +359,13 @@ def _register():
         return fn
     register_op("diag", diag_maker)
 
+    def cumsum_maker(axis=None, dtype=None):
+        def fn(x):
+            out = jnp.cumsum(x, axis=axis)
+            return out.astype(dtype) if dtype else out
+        return fn
+    register_op("cumsum", cumsum_maker, aliases=("_np_cumsum",))
+
     def trace_maker(offset=0, axis1=0, axis2=1):
         def fn(x):
             return jnp.trace(x, offset=offset, axis1=axis1, axis2=axis2)

@@ -1,0 +1,98 @@
+"""Mixture-of-Experts with expert parallelism (the 'ep' mesh axis).
+
+BEYOND reference parity: the 2018-era reference has no MoE (SURVEY.md
+§2.3 lists EP as absent), but the build mandate makes distributed
+first-class, so the framework ships a TPU-native MoE layer whose experts
+shard over an ``ep`` mesh axis.
+
+TPU-native design (the Switch/GShard dense-dispatch formulation): top-1
+routing with a capacity limit, expressed entirely as one-hot matmuls and
+batched matmuls — static shapes, everything lands on the MXU, and under
+``pjit`` with the expert-stacked weights sharded ``P('ep', ...)`` XLA
+inserts the dispatch/combine all-to-alls over ICI itself.
+
+    rules = ShardingRules(EP_RULES() + TP_RULES)
+"""
+from __future__ import annotations
+
+import math
+
+from ..gluon.block import HybridBlock
+
+__all__ = ["MoEFFN", "EP_RULES"]
+
+
+def EP_RULES():
+    """ShardingRules entries placing stacked expert weights on 'ep'."""
+    from jax.sharding import PartitionSpec as P
+    return [(r".*expert_w[12]$", P("ep", None, None))]
+
+
+class MoEFFN(HybridBlock):
+    """Switch-style MoE feed-forward: router → top-1 dispatch (capacity
+    limited) → per-expert FFN → weighted combine.
+
+    Parameters
+    ----------
+    units : model dim D (input and output).
+    hidden_size : per-expert FFN hidden dim H.
+    num_experts : E — shard this axis over the 'ep' mesh axis.
+    capacity_factor : per-expert slots = ceil(tokens/E * factor); tokens
+        over capacity pass through the residual (standard Switch drop).
+    """
+
+    def __init__(self, units, hidden_size, num_experts,
+                 capacity_factor=1.25, activation="relu", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._hidden = hidden_size
+        self._E = num_experts
+        self._cap_factor = capacity_factor
+        self._act = activation
+        with self.name_scope():
+            self.router = self.params.get(
+                "router", shape=(units, num_experts), init="xavier")
+            self.expert_w1 = self.params.get(
+                "expert_w1", shape=(num_experts, units, hidden_size),
+                init="xavier")
+            self.expert_w2 = self.params.get(
+                "expert_w2", shape=(num_experts, hidden_size, units),
+                init="xavier")
+
+    def hybrid_forward(self, F, x, router, expert_w1, expert_w2):
+        # x: (B, S, D) -> tokens (N, D)
+        B, S, D = x.shape
+        E = self._E
+        N = B * S
+        C = max(1, math.ceil(N / max(E, 1) * self._cap_factor))
+        tok = F.reshape(x, shape=(N, D))
+
+        logits = F.dot(tok, router)                     # (N, E)
+        probs = F.softmax(logits, axis=-1)
+        eidx = F.argmax(probs, axis=-1)                 # (N,)
+        gate = F.max(probs, axis=-1)                    # (N,) top-1 prob
+        onehot = F.one_hot(eidx, depth=E)                     # (N, E)
+
+        # position of each token within its expert's queue
+        pos = F.cumsum(onehot, axis=0) * onehot         # 1-based ranks
+        keep = (pos <= C) * onehot                      # capacity mask
+        posC = F.one_hot(
+            F.where(keep > 0, pos - 1, F.ones_like(pos) * C),
+            depth=C)                                    # (N, E, C)
+        dispatch = posC * F.reshape(keep, shape=(N, E, 1))    # (N, E, C)
+
+        # dispatch: (E*C, N) @ (N, D) -> (E, C, D); MXU matmuls only
+        disp2 = F.transpose(F.reshape(dispatch, shape=(N, E * C)))
+        expert_in = F.reshape(F.dot(disp2, tok), shape=(E, C, D))
+        h = F.batch_dot(expert_in, expert_w1)           # (E, C, H)
+        h = F.Activation(h, act_type=self._act)
+        expert_out = F.batch_dot(h, expert_w2)          # (E, C, D)
+
+        # combine, weighted by the gate prob of kept tokens
+        combine = dispatch * F.reshape(gate, shape=(N, 1, 1))
+        out = F.dot(F.reshape(combine, shape=(N, E * C)),
+                    F.reshape(expert_out, shape=(E * C, D)))  # (N, D)
+        # dropped (over-capacity) tokens pass through as residual zeros;
+        # standard Switch keeps the residual connection outside this block
+        return F.reshape(out, shape=(B, S, D))
