@@ -1,0 +1,96 @@
+"""Pipeline parallelism over the 'pp' mesh axis (GPipe microbatching).
+
+BEYOND reference parity (the reference has no in-tree pipeline schedule —
+SURVEY.md §2.3 lists PP as absent); built because distributed is
+first-class in this framework and 'pp' completes the dp/tp/sp/ep/pp set.
+
+TPU-native design: single-program SPMD under ``shard_map`` — every device
+runs the SAME scan; stage weights are STACKED on a leading axis sharded
+``P('pp', ...)`` so each device holds exactly its stage; activations flow
+between neighbouring stages with ``lax.ppermute`` over ICI each step.
+The schedule is classic GPipe: M microbatches drain through S stages in
+M + S - 1 ticks; JAX autodiff reverses the permutes for the backward, so
+``jax.grad`` of a pipelined loss just works.
+"""
+from __future__ import annotations
+
+__all__ = ["pipeline_apply"]
+
+
+def pipeline_apply(stage_fn, stacked_params, microbatches, mesh,
+                   axis: str = "pp"):
+    """Run homogeneous pipeline stages over microbatches.
+
+    Parameters
+    ----------
+    stage_fn : callable ``(params_i, x) -> y`` — one stage's compute;
+        inputs and outputs must share shape/dtype (homogeneous pipeline,
+        the stacked-weights TPU idiom).
+    stacked_params : pytree whose leaves have leading axis S (= mesh
+        size along ``axis``); shard them ``P('pp', ...)``.
+    microbatches : array ``(M, mb, ...)`` — M microbatches.
+    mesh : jax Mesh containing ``axis``.
+
+    Returns ``(M, mb, ...)`` outputs, as if ``stage_{S-1}(...stage_0(x))``
+    ran per microbatch.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+    try:
+        from jax import shard_map          # modern spelling
+    except ImportError:                    # older jax
+        from jax.experimental.shard_map import shard_map
+
+    S = mesh.shape[axis]
+    M = microbatches.shape[0]
+    T = M + S - 1                    # total pipeline ticks
+
+    p_params = jax.tree.map(lambda _: P(axis), stacked_params)
+    # microbatches replicated over 'pp' (the dp axis may shard dim 1+)
+    p_x = P()
+    perm = [(i, i + 1) for i in range(S - 1)]
+
+    def per_device(params, xs):
+        # params: leaves (1, ...) — this device's stage; xs: (M, mb, ...)
+        params = jax.tree.map(lambda v: v[0], params)
+        rank = lax.axis_index(axis)
+        zero = jnp.zeros_like(xs[0])
+
+        def tick(carry, t):
+            buf, outs = carry
+            # receive the previous stage's output (stage 0 receives junk)
+            recv = lax.ppermute(buf, axis, perm)
+            feed = lax.dynamic_index_in_dim(
+                xs, jnp.clip(t, 0, M - 1), keepdims=False)
+            x_in = jnp.where(rank == 0,
+                             jnp.where(t < M, feed, zero),
+                             recv)
+            y = stage_fn(params, x_in)
+            # last stage commits microbatch t-S+1 on ticks t >= S-1
+            oidx = jnp.clip(t - (S - 1), 0, M - 1)
+            commit = (rank == S - 1) & (t >= S - 1)
+            outs = lax.cond(
+                commit,
+                lambda o: lax.dynamic_update_index_in_dim(
+                    o, y, oidx, axis=0),
+                lambda o: o, outs)
+            return (y, outs), None
+
+        outs0 = jnp.zeros_like(xs)
+        (_, outs), _ = lax.scan(tick, (zero, outs0), jnp.arange(T))
+        # every device returns outs; only the last stage's is real —
+        # mask + psum broadcasts it so the result replicates over 'pp'
+        masked = jnp.where(rank == S - 1, outs, jnp.zeros_like(outs))
+        return lax.psum(masked, axis)
+
+    try:
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(p_params, p_x), out_specs=p_x,
+                       check_vma=False)
+    except TypeError:                      # older jax spelling
+        fn = shard_map(per_device, mesh=mesh,
+                       in_specs=(p_params, p_x), out_specs=p_x,
+                       check_rep=False)
+    return fn(stacked_params, microbatches)
