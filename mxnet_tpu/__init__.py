@@ -59,6 +59,8 @@ from . import module as mod
 from . import contrib
 from . import profiler
 from . import runtime
+from . import visualization
+from . import visualization as viz
 from . import operator
 ndarray.Custom = operator.Custom     # reference surface: mx.nd.Custom
 from . import rtc

@@ -116,3 +116,20 @@ class ShardingRules:
     def sharding_for(self, mesh, name: str, shape=None):
         from jax.sharding import NamedSharding
         return NamedSharding(mesh, self.spec_for(name, shape))
+
+
+def shard_map_compat(fn, mesh, in_specs, out_specs):
+    """shard_map across jax versions: modern ``jax.shard_map`` with
+    ``check_vma`` vs older ``jax.experimental.shard_map`` with
+    ``check_rep`` — the one shim for every per-device kernel in this
+    package (ring attention, pipeline schedule)."""
+    try:
+        from jax import shard_map
+    except ImportError:                      # older jax
+        from jax.experimental.shard_map import shard_map
+    try:
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_vma=False)
+    except TypeError:                        # older spelling
+        return shard_map(fn, mesh=mesh, in_specs=in_specs,
+                         out_specs=out_specs, check_rep=False)

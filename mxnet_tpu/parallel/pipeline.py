@@ -38,10 +38,8 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh,
     import jax.numpy as jnp
     from jax import lax
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map          # modern spelling
-    except ImportError:                    # older jax
-        from jax.experimental.shard_map import shard_map
+
+    from .mesh import shard_map_compat
 
     S = mesh.shape[axis]
     M = microbatches.shape[0]
@@ -85,12 +83,5 @@ def pipeline_apply(stage_fn, stacked_params, microbatches, mesh,
         masked = jnp.where(rank == S - 1, outs, jnp.zeros_like(outs))
         return lax.psum(masked, axis)
 
-    try:
-        fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(p_params, p_x), out_specs=p_x,
-                       check_vma=False)
-    except TypeError:                      # older jax spelling
-        fn = shard_map(per_device, mesh=mesh,
-                       in_specs=(p_params, p_x), out_specs=p_x,
-                       check_rep=False)
+    fn = shard_map_compat(per_device, mesh, (p_params, p_x), p_x)
     return fn(stacked_params, microbatches)

@@ -87,10 +87,8 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "sp",
     """
     import jax
     from jax.sharding import PartitionSpec as P
-    try:
-        from jax import shard_map
-    except ImportError:   # older jax
-        from jax.experimental.shard_map import shard_map
+
+    from .mesh import shard_map_compat
 
     if scale is None:
         scale = 1.0 / math.sqrt(q.shape[-1])
@@ -98,10 +96,5 @@ def ring_attention(q, k, v, mesh, seq_axis: str = "sp",
     body = functools.partial(_ring_block_attention, axis_name=seq_axis,
                              ring_size=mesh.shape[seq_axis],
                              causal=causal, scale=scale)
-    try:
-        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_vma=False)
-    except TypeError:   # older jax spelling
-        fn = shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
-                       out_specs=spec, check_rep=False)
+    fn = shard_map_compat(body, mesh, (spec, spec, spec), spec)
     return fn(q, k, v)

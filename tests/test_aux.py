@@ -134,3 +134,26 @@ def test_profiler_durations_not_gap_based():
     profiler.set_state("stop")
     durs = profiler.Profiler.get()._agg["dot"]
     assert max(durs) < 2.5e5, durs   # no 300ms gap absorbed
+
+
+def test_viz_print_summary_and_dot():
+    """mx.viz print_summary/plot_network (reference:
+    python/mxnet/visualization.py)."""
+    import io
+    from contextlib import redirect_stdout
+    import mxnet_tpu as mx
+    from mxnet_tpu import symbol as S
+    x = S.var("data")
+    w1, b1 = S.var("fc1_weight"), S.var("fc1_bias")
+    h = S.Activation(S.FullyConnected(x, w1, b1, num_hidden=64),
+                     act_type="relu")
+    w2, b2 = S.var("fc2_weight"), S.var("fc2_bias")
+    out = S.softmax(S.FullyConnected(h, w2, b2, num_hidden=10))
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        total = mx.viz.print_summary(out, shape={"data": (32, 128)})
+    assert total == 128 * 64 + 64 + 64 * 10 + 10
+    text = buf.getvalue()
+    assert "FullyConnected" in text and "(32, 64)" in text
+    dot = mx.viz.plot_network(out, shape={"data": (32, 128)})
+    assert dot.startswith("digraph") and "->" in dot
