@@ -4,5 +4,45 @@ basic_layers.py) — re-exports for reference-parity imports:
     from mxnet_tpu.gluon.contrib.nn import HybridConcurrent, Identity
 """
 from ..nn import HybridConcurrent, Identity  # noqa: F401
+from ..nn import BatchNorm as _BatchNorm
 
-__all__ = ["HybridConcurrent", "Identity"]
+__all__ = ["HybridConcurrent", "Identity", "SyncBatchNorm"]
+
+
+class SyncBatchNorm(_BatchNorm):
+    """Cross-device synchronized BatchNorm (reference:
+    python/mxnet/gluon/contrib/nn/basic_layers.py SyncBatchNorm over
+    src/operator/contrib/sync_batch_norm.cc).
+
+    TPU-native design: under the whole-step jit with the batch sharded
+    over 'dp' (ShardedTrainer), ``jnp.mean`` over the batch axis of a
+    sharded tensor IS the global mean — XLA GSPMD inserts the cross-chip
+    reduction automatically.  So the plain BatchNorm lowering already has
+    SyncBatchNorm semantics there; this subclass exists for API parity
+    and accepts (and ignores) the reference's ``num_devices``/``key``
+    knobs, which configured the hand-rolled NCCL reduction the compiler
+    now owns.
+    """
+
+    def __init__(self, in_channels=0, num_devices=None, momentum=0.9,
+                 epsilon=1e-5, center=True, scale=True,
+                 use_global_stats=False, beta_initializer="zeros",
+                 gamma_initializer="ones",
+                 running_mean_initializer="zeros",
+                 running_variance_initializer="ones", key=None, **kwargs):
+        if num_devices is not None:
+            import warnings
+            warnings.warn(
+                "SyncBatchNorm: cross-device stat sync holds under the "
+                "sharded whole-step jit (ShardedTrainer); on the "
+                "imperative multi-process path stats stay process-local "
+                "— num_devices is ignored", stacklevel=2)
+        super().__init__(axis=1, momentum=momentum, epsilon=epsilon,
+                         center=center, scale=scale,
+                         use_global_stats=use_global_stats,
+                         beta_initializer=beta_initializer,
+                         gamma_initializer=gamma_initializer,
+                         running_mean_initializer=running_mean_initializer,
+                         running_variance_initializer=(
+                             running_variance_initializer),
+                         in_channels=in_channels, **kwargs)
