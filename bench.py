@@ -341,24 +341,37 @@ def main():
                                        args.warmup, args.size,
                                        args.layout)
     else:
-        with profiled():
-            rows["resnet50_bf16"] = bench_resnet50(
-                "bfloat16", args.batch, args.iters, args.warmup,
-                args.size, args.layout)
-        rows["resnet50_fp32"] = bench_resnet50(
+        # one failing row must not zero the whole suite: record the
+        # error string in its row and keep going
+        def guarded(key, fn):
+            try:
+                rows[key] = fn()
+            except Exception as e:      # noqa: BLE001
+                rows[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+
+        def headline_resnet():
+            with profiled():
+                return bench_resnet50(
+                    "bfloat16", args.batch, args.iters, args.warmup,
+                    args.size, args.layout)
+
+        guarded("resnet50_bf16", headline_resnet)
+        guarded("resnet50_fp32", lambda: bench_resnet50(
             "float32", args.batch, args.iters, args.warmup, args.size,
-            args.layout)
-        rows["mnist_mlp_imperative"] = bench_mnist_mlp()
-        rows["bert_base"] = bench_bert_base()
+            args.layout))
+        guarded("mnist_mlp_imperative", bench_mnist_mlp)
+        guarded("bert_base", bench_bert_base)
         # CPU CI host (1 core) gets reduced step counts; the TPU run
         # keeps the real ones
         import jax as _jax
         cpu_ci = _jax.default_backend() == "cpu"
-        rows["nmt_transformer"] = bench_nmt(iters=2, warmup=1) if cpu_ci \
-            else bench_nmt()
-        rows["ssd_detection"] = bench_ssd(iters=2, warmup=1, batch=2) \
-            if cpu_ci else bench_ssd()
-        rows["input_pipeline"] = bench_pipeline()
+        guarded("nmt_transformer",
+                (lambda: bench_nmt(iters=2, warmup=1)) if cpu_ci
+                else bench_nmt)
+        guarded("ssd_detection",
+                (lambda: bench_ssd(iters=2, warmup=1, batch=2)) if cpu_ci
+                else bench_ssd)
+        guarded("input_pipeline", bench_pipeline)
 
     # per-row headline field + unit, so --only rows are labeled honestly
     HEADLINE = {
@@ -370,21 +383,28 @@ def main():
         "ssd_detection": ("images_per_sec", "images/sec"),
         "input_pipeline": ("images_per_sec", "images/sec"),
     }
-    if "resnet50_bf16" in rows:
+    ok = {k: v for k, v in rows.items() if "error" not in v}
+    if "resnet50_bf16" in ok:
         value = rows["resnet50_bf16"]["images_per_sec_per_chip"]
         metric = "resnet50_bf16_train_images_per_sec_per_chip"
         unit = "images/sec/chip"
         vs = value / BASELINE_IMG_S_FP16
-    elif "resnet50_fp32" in rows:
+    elif "resnet50_fp32" in ok:
         value = rows["resnet50_fp32"]["images_per_sec_per_chip"]
         metric = "resnet50_fp32_train_images_per_sec_per_chip"
         unit = "images/sec/chip"
         vs = value / BASELINE_IMG_S_FP32
-    else:
-        key, r = next(iter(rows.items()))
+    elif ok:
+        key, r = next(iter(ok.items()))
         field, unit = HEADLINE[key]
         metric, value = f"{key}_{field}", r[field]
         vs = 0.0
+    else:
+        metric, value, unit, vs = "bench_failed", 0.0, "n/a", 0.0
+        import sys
+        print(json.dumps({"metric": metric, "value": value, "unit": unit,
+                          "vs_baseline": vs, "rows": rows}))
+        sys.exit(1)        # total failure must be visible to the driver
     print(json.dumps({
         "metric": metric,
         "value": value,
