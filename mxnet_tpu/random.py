@@ -13,7 +13,7 @@ import threading
 import time
 from typing import Optional
 
-__all__ = ["seed", "next_key", "get_state"]
+__all__ = ["seed", "next_key", "get_state", "set_state"]
 
 _lock = threading.Lock()
 _key = None
@@ -67,3 +67,10 @@ def next_key():
 
 def get_state():
     return _key
+
+
+def set_state(key) -> None:
+    """Restore the global key stream (checkpoint resume)."""
+    global _key
+    with _lock:
+        _key = key
