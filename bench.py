@@ -119,9 +119,25 @@ def bench_mnist_mlp(iters=200, warmup=30, batch=64):
             "batch": batch}
 
 
-def bench_bert_base(iters=10, warmup=3, batch=8, seq=128):
+def bench_bert_base(iters=10, warmup=3, batch=8, seq=128,
+                    dtype="float32"):
     """Config #3: BERT-base whole-step time on the dp mesh (dp×tp×sp on
-    multi-chip — tested in tests/test_parallel.py; one real chip here)."""
+    multi-chip — tested in tests/test_parallel.py; one real chip here).
+    dtype='bfloat16' enables the AMP hook (the MXU-native mode)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.contrib import amp
+    from mxnet_tpu.gluon.model_zoo.transformer import bert_base
+
+    if dtype == "bfloat16":
+        amp.init("bfloat16")
+    try:
+        return _bench_bert_inner(iters, warmup, batch, seq)
+    finally:
+        amp.disable()
+
+
+def _bench_bert_inner(iters, warmup, batch, seq):
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.gluon.model_zoo.transformer import bert_base
@@ -365,6 +381,9 @@ def main():
         # keeps the real ones
         import jax as _jax
         cpu_ci = _jax.default_backend() == "cpu"
+        if not cpu_ci:                  # MXU-native BERT row (TPU only)
+            guarded("bert_base_bf16",
+                    lambda: bench_bert_base(dtype="bfloat16"))
         guarded("nmt_transformer",
                 (lambda: bench_nmt(iters=2, warmup=1)) if cpu_ci
                 else bench_nmt)
@@ -379,6 +398,7 @@ def main():
         "resnet50_fp32": ("images_per_sec_per_chip", "images/sec/chip"),
         "mnist_mlp_imperative": ("images_per_sec", "images/sec"),
         "bert_base": ("step_ms", "ms/step"),
+        "bert_base_bf16": ("step_ms", "ms/step"),
         "nmt_transformer": ("tokens_per_sec", "tokens/sec"),
         "ssd_detection": ("images_per_sec", "images/sec"),
         "input_pipeline": ("images_per_sec", "images/sec"),
