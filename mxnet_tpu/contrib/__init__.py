@@ -1,5 +1,7 @@
 """``mx.contrib``: experimental / extension namespaces (reference:
-python/mxnet/contrib/).  Holds amp (mixed precision) and the detection op
-frontends used by the GluonCV-style models.
+python/mxnet/contrib/) — amp (mixed precision), quantization (int8
+post-training), onnx (import/export).
 """
 from . import amp  # noqa: F401
+from . import quantization  # noqa: F401
+from . import onnx  # noqa: F401
