@@ -624,6 +624,16 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArra
     return NDArray(val, ctx=ctx)
 
 
+def linspace(start, stop, num, endpoint=True, ctx=None,
+             dtype=None) -> NDArray:
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with jax.default_device(ctx.device):
+        val = _jnp().linspace(start, stop, int(num), endpoint=endpoint,
+                              dtype=dtype_np(dtype))
+    return NDArray(val, ctx=ctx)
+
+
 def zeros_like(other: NDArray) -> NDArray:
     return zeros(other.shape, ctx=other.context, dtype=other.dtype)
 

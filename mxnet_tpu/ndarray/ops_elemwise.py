@@ -137,8 +137,14 @@ def _register_binary():
         "broadcast_logical_or": lambda x, y: jnp.logical_or(x != 0, y != 0),
         "broadcast_logical_xor": lambda x, y: jnp.logical_xor(x != 0, y != 0),
     }
+    cmp_alias = {
+        "broadcast_logical_and": ("logical_and",),
+        "broadcast_logical_or": ("logical_or",),
+        "broadcast_logical_xor": ("logical_xor",),
+    }
     for name, fn in cmps.items():
-        simple_op(name, _cmp(fn), differentiable=False)
+        simple_op(name, _cmp(fn), differentiable=False,
+                  aliases=cmp_alias.get(name, ()))
 
 
 # --------------------------------------------------------------------------

@@ -359,6 +359,33 @@ def _register():
         return fn
     register_op("diag", diag_maker)
 
+    def reshape_like_maker(lhs_begin=None, lhs_end=None, rhs_begin=None,
+                           rhs_end=None):
+        def fn(lhs, rhs):
+            # partial-range semantics (reference matrix_op reshape_like):
+            # lhs dims [lhs_begin, lhs_end) are replaced by rhs dims
+            # [rhs_begin, rhs_end); full-shape copy when no range given
+            lb = 0 if lhs_begin is None else lhs_begin % (lhs.ndim + 1)
+            le = lhs.ndim if lhs_end is None else lhs_end % (lhs.ndim + 1)
+            rb = 0 if rhs_begin is None else rhs_begin % (rhs.ndim + 1)
+            re = rhs.ndim if rhs_end is None else rhs_end % (rhs.ndim + 1)
+            shape = lhs.shape[:lb] + rhs.shape[rb:re] + lhs.shape[le:]
+            return jnp.reshape(lhs, shape)
+        return fn
+    register_op("reshape_like", reshape_like_maker)
+
+    def moments_maker(axes=None, keepdims=False):
+        ax = tuple(axes) if axes is not None else None
+
+        def fn(x):
+            mean = jnp.mean(x, axis=ax, keepdims=keepdims)
+            var = jnp.mean(
+                jnp.square(x - jnp.mean(x, axis=ax, keepdims=True)),
+                axis=ax, keepdims=keepdims)
+            return (mean, var)
+        return fn
+    register_op("moments", moments_maker)
+
     def cumsum_maker(axis=None, dtype=None):
         def fn(x):
             out = jnp.cumsum(x, axis=axis)

@@ -242,3 +242,25 @@ def test_broadcast_ops():
     np.testing.assert_allclose(
         nd.broadcast_add(nd.ones((2, 1)), nd.ones((1, 3))).asnumpy(),
         np.full((2, 3), 2.0))
+
+
+def test_logical_moments_reshape_like_linspace():
+    """Round-3 API fill-ins (reference: elemwise logical ops, moments,
+    reshape_like, linspace ctor)."""
+    a = nd.array(np.array([[1., 0.], [2., 3.]], np.float32))
+    b = nd.array(np.array([[0., 0.], [1., 5.]], np.float32))
+    assert np.array_equal(nd.logical_and(a, b).asnumpy(),
+                          [[0, 0], [1, 1]])
+    assert np.array_equal(nd.logical_or(a, b).asnumpy(),
+                          [[1, 0], [1, 1]])
+    assert np.array_equal(nd.logical_xor(a, b).asnumpy(),
+                          [[1, 0], [0, 0]])
+    x = nd.array(np.random.randn(3, 4).astype(np.float32))
+    m, v = nd.moments(x, axes=(0, 1))
+    assert abs(float(m.asnumpy()) - x.asnumpy().mean()) < 1e-6
+    assert abs(float(v.asnumpy()) - x.asnumpy().var()) < 1e-6
+    r = nd.reshape_like(nd.array(np.arange(6, dtype=np.float32)),
+                        nd.array(np.zeros((2, 3), np.float32)))
+    assert r.shape == (2, 3)
+    assert np.allclose(nd.linspace(0, 1, 5).asnumpy(),
+                       np.linspace(0, 1, 5))
