@@ -1,0 +1,33 @@
+"""The examples/ scripts must stay runnable (reference model: the
+example/ tree is part of the user-facing surface; CI runs smoke
+configs)."""
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _run(args, timeout=900):
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    return subprocess.run([sys.executable] + args, cwd=ROOT,
+                          capture_output=True, text=True,
+                          timeout=timeout, env=env)
+
+
+def test_example_mnist_mlp_runs():
+    r = _run(["examples/train_mnist_mlp.py", "--epochs", "2",
+              "--synthetic"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "epoch 1:" in r.stdout
+
+
+def test_example_imagenet_style_runs(tmp_path):
+    rec = str(tmp_path / "t.rec")
+    r = _run(["examples/train_imagenet_style.py", "--epochs", "1",
+              "--batch-size", "8", "--image-size", "64",
+              "--rec", rec])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "exported" in r.stdout
