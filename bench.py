@@ -308,6 +308,24 @@ def bench_pipeline(n_images=1024, batch=128, threads=None):
             "decode_threads": threads}
 
 
+def _backend_reachable(timeout=300):
+    """Probe the accelerator in a SUBPROCESS: a wedged TPU claim hangs
+    inside the PJRT client where no Python timeout can interrupt it, so
+    the only safe watchdog is process isolation.  (Observed this round:
+    a killed remote compile left every jax.devices() call hanging
+    indefinitely — PERF.md outage log.)"""
+    import subprocess
+    import sys
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; jax.devices(); print('ok')"],
+            capture_output=True, text=True, timeout=timeout)
+        return r.returncode == 0 and "ok" in r.stdout
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     ap = argparse.ArgumentParser()
     ap.add_argument("--batch", type=int, default=128)
@@ -328,6 +346,16 @@ def main():
                     help="capture a jax.profiler trace of the bf16 "
                     "resnet row into DIR")
     args = ap.parse_args()
+
+    import sys
+    if not _backend_reachable():
+        print(json.dumps({
+            "metric": "bench_failed", "value": 0.0, "unit": "n/a",
+            "vs_baseline": 0.0,
+            "rows": {"error": "accelerator backend unreachable "
+                              "(claim hang or init failure) after 300s "
+                              "subprocess probe"}}))
+        sys.exit(1)
 
     import contextlib
 
