@@ -1,0 +1,95 @@
+"""gluon.contrib.cnn (reference: python/mxnet/gluon/contrib/cnn/
+conv_layers.py) — DeformableConvolution block.
+
+Two parameter sets, as in the reference: a regular convolution computes
+the sampling offsets from the input, then the deformable convolution op
+(src/operator/contrib/deformable_convolution.cc analog in
+ndarray/ops_contrib.py — bilinear-gather im2col + one MXU matmul) applies
+the main weights at the offset positions.
+"""
+from __future__ import annotations
+
+from ..block import HybridBlock
+from ..nn.conv_layers import _tuplize
+
+__all__ = ["DeformableConvolution"]
+
+
+class DeformableConvolution(HybridBlock):
+    def __init__(self, channels, kernel_size=(1, 1), strides=(1, 1),
+                 padding=(0, 0), dilation=(1, 1), groups=1,
+                 num_deformable_group=1, layout="NCHW", use_bias=True,
+                 in_channels=0, activation=None, weight_initializer=None,
+                 bias_initializer="zeros",
+                 offset_weight_initializer="zeros",
+                 offset_bias_initializer="zeros", offset_use_bias=True,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if layout != "NCHW":
+            raise ValueError("DeformableConvolution supports NCHW only")
+        kernel_size = _tuplize(kernel_size, 2)
+        strides = _tuplize(strides, 2)
+        padding = _tuplize(padding, 2)
+        dilation = _tuplize(dilation, 2)
+        self._channels = channels
+        self._in_channels = in_channels
+        self._act_type = activation
+        offset_channels = 2 * kernel_size[0] * kernel_size[1] * \
+            num_deformable_group
+        self._offset_channels = offset_channels
+        self._kwargs = {
+            "kernel": kernel_size, "stride": strides, "pad": padding,
+            "dilate": dilation, "num_filter": channels,
+            "num_group": groups,
+            "num_deformable_group": num_deformable_group,
+            "no_bias": not use_bias,
+        }
+        self._offset_kwargs = {
+            "kernel": kernel_size, "stride": strides, "pad": padding,
+            "dilate": dilation, "num_filter": offset_channels,
+            "num_group": 1, "no_bias": not offset_use_bias,
+        }
+        cin_g = in_channels // groups if in_channels else 0
+        with self.name_scope():
+            self.weight = self.params.get(
+                "weight", shape=(channels, cin_g) + kernel_size,
+                init=weight_initializer, allow_deferred_init=True)
+            self.offset_weight = self.params.get(
+                "offset_weight",
+                shape=(offset_channels, in_channels) + kernel_size,
+                init=offset_weight_initializer, allow_deferred_init=True)
+            if use_bias:
+                self.bias = self.params.get(
+                    "bias", shape=(channels,), init=bias_initializer,
+                    allow_deferred_init=True)
+            else:
+                self.bias = None
+            if offset_use_bias:
+                self.offset_bias = self.params.get(
+                    "offset_bias", shape=(offset_channels,),
+                    init=offset_bias_initializer, allow_deferred_init=True)
+            else:
+                self.offset_bias = None
+
+    def infer_shape(self, x, *args):
+        cin = x.shape[1]
+        groups = self._kwargs["num_group"]
+        k = tuple(self._kwargs["kernel"])
+        self.weight.shape = (self._channels, cin // groups) + k
+        self.offset_weight.shape = (self._offset_channels, cin) + k
+
+    def hybrid_forward(self, F, x, weight, offset_weight, bias=None,
+                       offset_bias=None):
+        if offset_bias is None:
+            offset = F.Convolution(x, offset_weight, **self._offset_kwargs)
+        else:
+            offset = F.Convolution(x, offset_weight, offset_bias,
+                                   **self._offset_kwargs)
+        if bias is None:
+            out = F.DeformableConvolution(x, offset, weight, **self._kwargs)
+        else:
+            out = F.DeformableConvolution(x, offset, weight, bias,
+                                          **self._kwargs)
+        if self._act_type:
+            out = F.Activation(out, act_type=self._act_type)
+        return out

@@ -27,6 +27,7 @@ from . import ops_linalg as _ops_linalg          # noqa: F401
 from . import ops_spatial as _ops_spatial        # noqa: F401
 from . import ops_quantization as _ops_quant     # noqa: F401
 from . import ops_ctc as _ops_ctc                # noqa: F401
+from . import ops_misc as _ops_misc              # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401
 
@@ -70,6 +71,15 @@ random_poisson = random.poisson
 random_negative_binomial = random.negative_binomial
 sample_multinomial = random.multinomial
 shuffle = random.shuffle
+# sample_* per-parameter-element draws (multisample_op.cc frontends)
+sample_uniform = random.sample_uniform
+sample_normal = random.sample_normal
+sample_gamma = random.sample_gamma
+sample_exponential = random.sample_exponential
+sample_poisson = random.sample_poisson
+sample_negative_binomial = random.sample_negative_binomial
+sample_generalized_negative_binomial = \
+    random.sample_generalized_negative_binomial
 
 
 def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None,

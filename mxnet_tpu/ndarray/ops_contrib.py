@@ -670,5 +670,191 @@ def _register_misc():
                 aliases=("count_sketch",), differentiable=False)
 
 
+def _register_round3b():
+    """Late round-3 contrib additions: adaptive pooling, position-sensitive
+    ROI pooling (R-FCN, src/operator/contrib/psroi_pooling.cc), deformable
+    convolution (src/operator/contrib/deformable_convolution.cc), index_array,
+    allclose.  TPU-first: deformable conv is a bilinear-gather im2col followed
+    by one MXU matmul; PSROIPooling is a vmapped static-shape gather."""
+    import jax
+    import jax.numpy as jnp
+
+    # ---- AdaptiveAvgPooling2D -------------------------------------------
+    def adaptive_avg_pool_maker(output_size=1):
+        if isinstance(output_size, int):
+            oh = ow = int(output_size)
+        else:
+            oh, ow = (int(s) for s in output_size)
+
+        def fn(data):
+            n, c, h, w = data.shape
+            # static per-output-cell ranges (numpy loop unrolls at trace
+            # time; output sizes are small by construction)
+            rows = []
+            for i in range(oh):
+                y0, y1 = (i * h) // oh, -(-((i + 1) * h) // oh)
+                cols = []
+                for j in range(ow):
+                    x0, x1 = (j * w) // ow, -(-((j + 1) * w) // ow)
+                    cols.append(jnp.mean(data[:, :, y0:y1, x0:x1],
+                                         axis=(2, 3)))
+                rows.append(jnp.stack(cols, axis=-1))
+            return jnp.stack(rows, axis=-2)
+        return fn
+    register_op("_contrib_AdaptiveAvgPooling2D", adaptive_avg_pool_maker,
+                aliases=("AdaptiveAvgPooling2D",))
+
+    # ---- PSROIPooling (R-FCN) -------------------------------------------
+    # data channels laid out (output_dim, group_size, group_size); each
+    # output bin (i,j) reads its own score-map channel.
+    def psroi_pooling_maker(spatial_scale=1.0, output_dim=1, pooled_size=7,
+                            group_size=0):
+        ps = int(pooled_size)
+        gs = int(group_size) if group_size else ps
+        sr = 2   # fixed sample grid per bin (static shapes for XLA)
+
+        def fn(data, rois):
+            _, c, h, w = data.shape
+
+            def one(roi):
+                bidx = roi[0].astype(jnp.int32)
+                img = data[bidx]
+                x1 = roi[1] * spatial_scale
+                y1 = roi[2] * spatial_scale
+                x2 = roi[3] * spatial_scale
+                y2 = roi[4] * spatial_scale
+                rw = jnp.maximum(x2 - x1, 0.1)
+                rh = jnp.maximum(y2 - y1, 0.1)
+                iy = jnp.arange(ps * sr, dtype=jnp.float32)
+                ix = jnp.arange(ps * sr, dtype=jnp.float32)
+                sy = y1 + (iy + 0.5) * rh / (ps * sr)
+                sx = x1 + (ix + 0.5) * rw / (ps * sr)
+                yi = jnp.clip(jnp.floor(sy), 0, h - 1).astype(jnp.int32)
+                xi = jnp.clip(jnp.floor(sx), 0, w - 1).astype(jnp.int32)
+                # grid of sampled values for every channel: (C, ps*sr, ps*sr)
+                sampled = img[:, yi, :][:, :, xi]
+                pooled = sampled.reshape(c, ps, sr, ps, sr).mean((2, 4))
+                # position-sensitive channel selection
+                pooled = pooled.reshape(output_dim, gs, gs, ps, ps)
+                gi = (jnp.arange(ps) * gs) // ps
+                sel = pooled[:, gi[:, None], gi[None, :],
+                             jnp.arange(ps)[:, None],
+                             jnp.arange(ps)[None, :]]
+                return sel                                 # (output_dim,ps,ps)
+            return jax.vmap(one)(rois)
+        return fn
+    register_op("_contrib_PSROIPooling", psroi_pooling_maker,
+                aliases=("PSROIPooling",))
+
+    # ---- DeformableConvolution ------------------------------------------
+    # Bilinear-gather im2col with learned offsets, then one matmul (the
+    # FLOPs ride the MXU; the gather is the only scatter/gather stage).
+    def deformable_conv_maker(kernel=(3, 3), stride=(1, 1), dilate=(1, 1),
+                              pad=(0, 0), num_filter=1, num_group=1,
+                              num_deformable_group=1, no_bias=False,
+                              workspace=0, layout=None):
+        kh, kw = _astuple(kernel)
+        sh, sw = _astuple(stride)
+        dh, dw = _astuple(dilate)
+        ph, pw = _astuple(pad)
+        dg = int(num_deformable_group)
+
+        def fn(data, offset, weight, *maybe_bias):
+            n, c, h, w = data.shape
+            oh = (h + 2 * ph - (dh * (kh - 1) + 1)) // sh + 1
+            ow = (w + 2 * pw - (dw * (kw - 1) + 1)) // sw + 1
+            K = kh * kw
+
+            # base sampling grid: (K, OH, OW)
+            ky, kx = jnp.meshgrid(jnp.arange(kh), jnp.arange(kw),
+                                  indexing="ij")
+            base_y = (jnp.arange(oh)[None, :, None] * sh - ph
+                      + (ky.reshape(-1) * dh)[:, None, None])
+            base_x = (jnp.arange(ow)[None, None, :] * sw - pw
+                      + (kx.reshape(-1) * dw)[:, None, None])
+            base_y = jnp.broadcast_to(base_y, (K, oh, ow)).astype(jnp.float32)
+            base_x = jnp.broadcast_to(base_x, (K, oh, ow)).astype(jnp.float32)
+
+            def one(img, off):
+                # img (C,H,W); off (2*dg*K, OH, OW) ordered
+                # (dg, K, [y,x], OH, OW) as in the reference layout
+                off = off.reshape(dg, K, 2, oh, ow)
+
+                def sample_group(off_g, img_g):
+                    # off_g (K,2,OH,OW); img_g (Cg,H,W)
+                    yy = base_y + off_g[:, 0]
+                    xx = base_x + off_g[:, 1]
+                    y0 = jnp.floor(yy)
+                    x0 = jnp.floor(xx)
+                    ly = yy - y0
+                    lx = xx - x0
+                    # zero-pad out-of-range samples via validity masks
+                    def gather(yi, xi):
+                        valid = ((yi >= 0) & (yi < h) &
+                                 (xi >= 0) & (xi < w))
+                        yc = jnp.clip(yi, 0, h - 1).astype(jnp.int32)
+                        xc = jnp.clip(xi, 0, w - 1).astype(jnp.int32)
+                        vals = img_g[:, yc, xc]        # (Cg,K,OH,OW)
+                        return vals * valid[None].astype(img_g.dtype)
+                    v00 = gather(y0, x0)
+                    v01 = gather(y0, x0 + 1)
+                    v10 = gather(y0 + 1, x0)
+                    v11 = gather(y0 + 1, x0 + 1)
+                    wy = ly[None]
+                    wx = lx[None]
+                    return (v00 * (1 - wy) * (1 - wx) + v01 * (1 - wy) * wx
+                            + v10 * wy * (1 - wx) + v11 * wy * wx)
+
+                cg = c // dg
+                cols = [sample_group(off[g_], img[g_ * cg:(g_ + 1) * cg])
+                        for g_ in range(dg)]
+                return jnp.concatenate(cols, axis=0)   # (C,K,OH,OW)
+
+            col = jax.vmap(one)(data, offset)          # (N,C,K,OH,OW)
+            wmat = weight.reshape(num_filter, -1)      # (O, C/g*K)
+            g = int(num_group)
+            if g == 1:
+                out = jnp.einsum("ok,nkhw->nohw", wmat,
+                                 col.reshape(n, c * K, oh, ow))
+            else:
+                cpg, opg = c // g, num_filter // g
+                colg = col.reshape(n, g, cpg * K, oh, ow)
+                wg = wmat.reshape(g, opg, cpg * K)
+                out = jnp.einsum("gok,ngkhw->ngohw", wg, colg).reshape(
+                    n, num_filter, oh, ow)
+            if maybe_bias and not no_bias:
+                out = out + maybe_bias[0][None, :, None, None]
+            return out
+        return fn
+    register_op("_contrib_DeformableConvolution", deformable_conv_maker,
+                aliases=("DeformableConvolution",))
+
+    # ---- index_array -----------------------------------------------------
+    def index_array_maker(axes=None):
+        def fn(data):
+            sel = tuple(axes) if axes is not None else \
+                tuple(range(data.ndim))
+            grids = jnp.meshgrid(*[jnp.arange(s) for s in data.shape],
+                                 indexing="ij")
+            # int32 (reference returns int64; jax truncates int64 to int32
+            # under the default config, warning on every call)
+            return jnp.stack([grids[a] for a in sel],
+                             axis=-1).astype(jnp.int32)
+        return fn
+    register_op("_contrib_index_array", index_array_maker,
+                aliases=("index_array",), differentiable=False)
+
+    # ---- allclose --------------------------------------------------------
+    def allclose_maker(rtol=1e-5, atol=1e-8, equal_nan=False):
+        def fn(a, b):
+            return jnp.allclose(a, b, rtol=rtol, atol=atol,
+                                equal_nan=equal_nan).astype(
+                jnp.float32).reshape(1)
+        return fn
+    register_op("_contrib_allclose", allclose_maker,
+                aliases=("allclose",), differentiable=False)
+
+
 _register()
 _register_misc()
+_register_round3b()

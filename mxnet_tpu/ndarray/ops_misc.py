@@ -1,0 +1,435 @@
+"""Long-tail operators: fused loss layers, AMP finiteness checks, pdf ops,
+and contrib extras.
+
+Reference parity (SURVEY.md §2.2 top-level/contrib long tail):
+  - ElementWiseSum / add_n           src/operator/tensor/elemwise_sum.cc
+  - all_finite / multi_all_finite    src/operator/contrib/all_finite.cc
+    (the loss-scaler's overflow probe)
+  - softmax_cross_entropy            src/operator/loss_binary_op.cc
+  - *RegressionOutput / SVMOutput    src/operator/regression_output.cc,
+    svm_output.cc — fused loss layers whose data-gradient ignores the head
+    gradient, like SoftmaxOutput
+  - _random_pdf_*                    src/operator/random/pdf_op.cc
+  - contrib fft/ifft                 src/operator/contrib/fft.cc (cuFFT
+    there; jnp.fft lowers to XLA FFT here, same unnormalized-inverse
+    convention)
+  - boolean_mask                     src/operator/contrib/boolean_mask.cc —
+    data-dependent output shape, so it runs eagerly (use_jit=False) rather
+    than under trace
+  - arange_like, quadratic, gradientmultiplier   src/operator/contrib/
+  - Crop                             src/operator/crop.cc
+
+TPU-first notes: every fixed-shape op here is an ordinary jitted XLA
+computation; the one dynamic-shape op (boolean_mask) is kept off the jit
+path by design instead of faking it with padding.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+    import jax.scipy.special as jsp
+
+    # ---- ElementWiseSum --------------------------------------------------
+    def add_n_maker(num_args=None):
+        def fn(*xs):
+            out = xs[0]
+            for x in xs[1:]:
+                out = out + x
+            return out
+        return fn
+    register_op("add_n", add_n_maker,
+                aliases=("ElementWiseSum", "elemwise_sum"))
+
+    # ---- all_finite / multi_all_finite (AMP overflow probe) --------------
+    def all_finite_maker(init_output=True):
+        def fn(data):
+            return jnp.all(jnp.isfinite(data.astype(jnp.float32))).astype(
+                jnp.float32).reshape(1)
+        return fn
+    register_op("all_finite", all_finite_maker, differentiable=False)
+
+    def multi_all_finite_maker(num_arrays=1, init_output=True):
+        def fn(*arrays):
+            ok = jnp.array(True)
+            for a in arrays:
+                ok = jnp.logical_and(
+                    ok, jnp.all(jnp.isfinite(a.astype(jnp.float32))))
+            return ok.astype(jnp.float32).reshape(1)
+        return fn
+    register_op("multi_all_finite", multi_all_finite_maker,
+                differentiable=False)
+
+    # ---- softmax_cross_entropy ------------------------------------------
+    def softmax_cross_entropy_maker():
+        def fn(data, label):
+            logp = jax.nn.log_softmax(data, axis=1)
+            lab = label.astype(jnp.int32)
+            picked = jnp.take_along_axis(logp, lab[:, None], axis=1)
+            return -jnp.sum(picked).reshape(1)
+        return fn
+    register_op("softmax_cross_entropy", softmax_cross_entropy_maker)
+
+    # ---- fused regression loss layers -----------------------------------
+    # Forward is the prediction; the gradient of data is the loss gradient
+    # scaled by grad_scale, ignoring the head gradient (reference contract).
+    def _loss_layer(fwd_fn, grad_fn, grad_scale):
+        @jax.custom_vjp
+        def op(x, label):
+            return fwd_fn(x)
+
+        def op_fwd(x, label):
+            y = fwd_fn(x)
+            return y, (y, label)
+
+        def op_bwd(res, g):
+            y, label = res
+            grad = grad_fn(y, label) * jnp.asarray(grad_scale, y.dtype)
+            return (grad, jnp.zeros_like(label))
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+
+    def linear_regression_maker(grad_scale=1.0):
+        return _loss_layer(lambda x: x, lambda y, t: y - t, grad_scale)
+    register_op("LinearRegressionOutput", linear_regression_maker,
+                aliases=("linear_regression_output",))
+
+    def mae_regression_maker(grad_scale=1.0):
+        return _loss_layer(lambda x: x, lambda y, t: jnp.sign(y - t),
+                           grad_scale)
+    register_op("MAERegressionOutput", mae_regression_maker,
+                aliases=("mae_regression_output",))
+
+    def logistic_regression_maker(grad_scale=1.0):
+        import jax.nn as jnn
+        return _loss_layer(jnn.sigmoid, lambda y, t: y - t, grad_scale)
+    register_op("LogisticRegressionOutput", logistic_regression_maker,
+                aliases=("logistic_regression_output",))
+
+    def svm_output_maker(margin=1.0, regularization_coefficient=1.0,
+                         use_linear=False):
+        # L2-SVM by default, L1 (hinge) with use_linear — svm_output-inl.h.
+        # t = ±1 one-vs-rest encoding of the integer label.
+        def grad_fn(y, label):
+            lab = label.astype(jnp.int32)
+            oh = jax.nn.one_hot(lab, y.shape[1], dtype=y.dtype)
+            t = 2.0 * oh - 1.0
+            viol = margin - t * y          # >0 where the margin is violated
+            active = (viol > 0).astype(y.dtype)
+            if use_linear:
+                return -regularization_coefficient * t * active
+            return -2.0 * regularization_coefficient * t * viol * active
+
+        return _loss_layer(lambda x: x, grad_fn, 1.0)
+    register_op("SVMOutput", svm_output_maker, aliases=("svm_output",))
+
+    # ---- IdentityAttachKLSparseReg --------------------------------------
+    # Identity forward; backward adds the KL sparsity-penalty gradient
+    # (reference: src/operator/identity_attach_KL_sparse_reg.cc).
+    def kl_sparse_reg_maker(sparseness_target=0.1, penalty=0.001,
+                            momentum=0.9):
+        rho = float(sparseness_target)
+
+        @jax.custom_vjp
+        def op(x):
+            return x
+
+        def op_fwd(x):
+            return x, x
+
+        def op_bwd(x, g):
+            rho_hat = jnp.mean(jax.nn.sigmoid(x), axis=0, keepdims=True)
+            kl_grad = penalty * (-rho / rho_hat + (1 - rho) / (1 - rho_hat))
+            return (g + kl_grad * jnp.ones_like(x),)
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+    register_op("IdentityAttachKLSparseReg", kl_sparse_reg_maker)
+
+    # ---- pdf ops (src/operator/random/pdf_op.cc) -------------------------
+    # Params have shape s; samples have shape s + (m,).  Broadcast params
+    # over the trailing sample axis.
+    def _bcast(p, sample):
+        p = jnp.asarray(p)
+        while p.ndim < sample.ndim:
+            p = p[..., None]
+        return p
+
+    def _pdf_op(name, logpdf, n_params):
+        def maker(is_log=False):
+            def fn(sample, *params):
+                ps = [_bcast(p, sample) for p in params]
+                lp = logpdf(sample, *ps)
+                return lp if is_log else jnp.exp(lp)
+            return fn
+        register_op(f"_random_pdf_{name}", maker,
+                    aliases=(f"pdf_{name}",))
+
+    _pdf_op("uniform",
+            lambda x, low, high: jnp.where(
+                (x >= low) & (x <= high),
+                -jnp.log(high - low), -jnp.inf), 2)
+    _pdf_op("normal",
+            lambda x, mu, sigma: (-0.5 * ((x - mu) / sigma) ** 2
+                                  - jnp.log(sigma)
+                                  - 0.5 * _np.log(2 * _np.pi)), 2)
+    # gamma: alpha = shape, beta = scale (matches sample_gamma's params)
+    _pdf_op("gamma",
+            lambda x, alpha, beta: ((alpha - 1) * jnp.log(x) - x / beta
+                                    - jsp.gammaln(alpha)
+                                    - alpha * jnp.log(beta)), 2)
+    _pdf_op("exponential",
+            lambda x, lam: jnp.log(lam) - lam * x, 1)
+    _pdf_op("poisson",
+            lambda x, lam: (x * jnp.log(lam) - lam
+                            - jsp.gammaln(x + 1.0)), 1)
+    _pdf_op("negative_binomial",
+            lambda x, k, p: (jsp.gammaln(x + k) - jsp.gammaln(x + 1.0)
+                             - jsp.gammaln(k) + k * jnp.log(p)
+                             + x * jnp.log1p(-p)), 2)
+    _pdf_op("generalized_negative_binomial",
+            lambda x, mu, alpha: (
+                jsp.gammaln(x + 1.0 / alpha) - jsp.gammaln(x + 1.0)
+                - jsp.gammaln(1.0 / alpha)
+                - (1.0 / alpha) * jnp.log1p(alpha * mu)
+                + x * (jnp.log(alpha) + jnp.log(mu)
+                       - jnp.log1p(alpha * mu))), 2)
+
+    def dirichlet_maker(is_log=False):
+        # sample (..., m, k) on the simplex; alpha (..., k) concentration
+        def fn(sample, alpha):
+            a = jnp.asarray(alpha)
+            # insert the draw axis: alpha (..., k) -> (..., 1, k)
+            a = a[..., None, :]
+            lp = (jnp.sum((a - 1) * jnp.log(sample), axis=-1)
+                  + jsp.gammaln(jnp.sum(a, axis=-1))
+                  - jnp.sum(jsp.gammaln(a), axis=-1))
+            return lp if is_log else jnp.exp(lp)
+        return fn
+    register_op("_random_pdf_dirichlet", dirichlet_maker,
+                aliases=("pdf_dirichlet",))
+
+    # ---- contrib fft / ifft ---------------------------------------------
+    # MXNet packs complex output as interleaved (re, im) pairs on the last
+    # axis: fft of (..., d) real -> (..., 2d).  The inverse is unnormalized
+    # (cuFFT convention): ifft(fft(x)) == d * x.
+    def fft_maker(compute_size=128):
+        def fn(x):
+            c = jnp.fft.fft(x.astype(jnp.float32), axis=-1)
+            out = jnp.stack([c.real, c.imag], axis=-1)
+            return out.reshape(x.shape[:-1] + (2 * x.shape[-1],))
+        return fn
+    register_op("_contrib_fft", fft_maker, aliases=("fft",))
+
+    def ifft_maker(compute_size=128):
+        def fn(x):
+            d = x.shape[-1] // 2
+            pairs = x.astype(jnp.float32).reshape(x.shape[:-1] + (d, 2))
+            c = jax.lax.complex(pairs[..., 0], pairs[..., 1])
+            return jnp.fft.ifft(c, axis=-1).real * d
+        return fn
+    register_op("_contrib_ifft", ifft_maker, aliases=("ifft",))
+
+    # ---- boolean_mask (dynamic output shape => eager) --------------------
+    # The reference op HAS a backward (scatter the cotangent rows back to
+    # the kept positions); jax.vjp cannot trace a value-dependent output
+    # shape, so the gradient is hand-built via the registry's vjp_maker
+    # escape hatch.
+    def _boolean_mask_apply(data, index, axis):
+        keep = _np.asarray(index).astype(bool)
+        idxs = jnp.asarray(_np.nonzero(keep)[0])
+        return idxs, jnp.take(data, idxs, axis=axis)
+
+    def boolean_mask_maker(axis=0):
+        def fn(data, index):
+            return _boolean_mask_apply(data, index, axis)[1]
+        return fn
+
+    def boolean_mask_vjp_maker(axis=0):
+        def wrapper(data, index):
+            idxs, out = _boolean_mask_apply(data, index, axis)
+
+            def vjp_fn(g):
+                at = (slice(None),) * axis + (idxs,)
+                grad = jnp.zeros_like(data).at[at].set(g)
+                return (grad, jnp.zeros_like(index))
+            return out, vjp_fn
+        return wrapper
+    register_op("_contrib_boolean_mask", boolean_mask_maker,
+                aliases=("boolean_mask",), use_jit=False,
+                vjp_maker=boolean_mask_vjp_maker)
+
+    # ---- arange_like -----------------------------------------------------
+    def arange_like_maker(start=0.0, step=1.0, repeat=1, axis=None):
+        def fn(data):
+            if axis is None:
+                n = int(_np.prod(data.shape))
+                vals = start + step * (jnp.arange(n) // repeat)
+                return vals.reshape(data.shape).astype(data.dtype)
+            n = data.shape[axis]
+            vals = (start + step * (jnp.arange(n) // repeat)).astype(
+                data.dtype)
+            shape = [1] * data.ndim
+            shape[axis] = n
+            return jnp.broadcast_to(vals.reshape(shape), data.shape)
+        return fn
+    register_op("_contrib_arange_like", arange_like_maker,
+                aliases=("arange_like",), differentiable=False)
+
+    # ---- quadratic -------------------------------------------------------
+    def quadratic_maker(a=0.0, b=0.0, c=0.0):
+        def fn(x):
+            return a * x * x + b * x + c
+        return fn
+    register_op("_contrib_quadratic", quadratic_maker,
+                aliases=("quadratic",))
+
+    # ---- gradientmultiplier ---------------------------------------------
+    def gradmult_maker(scalar=1.0):
+        @jax.custom_vjp
+        def op(x):
+            return x
+
+        def op_fwd(x):
+            return x, None
+
+        def op_bwd(_, g):
+            return (g * scalar,)
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+    register_op("_contrib_gradientmultiplier", gradmult_maker,
+                aliases=("gradientmultiplier",))
+
+    # ---- Crop (legacy src/operator/crop.cc) ------------------------------
+    def crop_maker(offset=(0, 0), h_w=(0, 0), center_crop=False,
+                   num_args=1):
+        offset = tuple(offset)
+        h_w = tuple(h_w)
+
+        def fn(data, *crop_like):
+            th, tw = h_w
+            if crop_like:
+                th, tw = crop_like[0].shape[2], crop_like[0].shape[3]
+            H, W = data.shape[2], data.shape[3]
+            if center_crop:
+                y0, x0 = (H - th) // 2, (W - tw) // 2
+            else:
+                y0, x0 = offset
+            return data[:, :, y0:y0 + th, x0:x0 + tw]
+        return fn
+    register_op("Crop", crop_maker, aliases=("crop_2d",))
+
+    # ---- im2col / col2im (src/operator/nn/im2col.h frontends) ------------
+    # im2col unfolds conv patches to (N, C*prod(kernel), L); col2im is its
+    # exact adjoint, obtained from XLA's transpose of the patch gather —
+    # no hand-written scatter kernel needed.
+    def _conv_geom(shape, kernel, stride, dilate, pad):
+        outs = []
+        for i, k in enumerate(kernel):
+            eff = dilate[i] * (k - 1) + 1
+            outs.append((shape[2 + i] + 2 * pad[i] - eff) // stride[i] + 1)
+        return tuple(outs)
+
+    def _im2col(data, kernel, stride, dilate, pad):
+        from jax import lax
+        n, c = data.shape[:2]
+        patches = lax.conv_general_dilated_patches(
+            data, filter_shape=tuple(kernel),
+            window_strides=tuple(stride),
+            padding=[(p, p) for p in pad],
+            rhs_dilation=tuple(dilate))
+        outs = _conv_geom(data.shape, kernel, stride, dilate, pad)
+        L = 1
+        for o in outs:
+            L *= o
+        k = 1
+        for kk in kernel:
+            k *= kk
+        return patches.reshape(n, c * k, L)
+
+    def im2col_maker(kernel=(3, 3), stride=None, dilate=None, pad=None):
+        kernel = tuple(kernel)
+        nd_ = len(kernel)
+        stride = tuple(stride) if stride else (1,) * nd_
+        dilate = tuple(dilate) if dilate else (1,) * nd_
+        pad = tuple(pad) if pad else (0,) * nd_
+
+        def fn(data):
+            return _im2col(data, kernel, stride, dilate, pad)
+        return fn
+    register_op("im2col", im2col_maker)
+
+    def col2im_maker(output_size=None, kernel=(3, 3), stride=None,
+                     dilate=None, pad=None):
+        kernel = tuple(kernel)
+        nd_ = len(kernel)
+        stride = tuple(stride) if stride else (1,) * nd_
+        dilate = tuple(dilate) if dilate else (1,) * nd_
+        pad = tuple(pad) if pad else (0,) * nd_
+        out_sz = tuple(output_size)
+
+        def fn(col):
+            k = 1
+            for kk in kernel:
+                k *= kk
+            n = col.shape[0]
+            c = col.shape[1] // k
+            x_shape = (n, c) + out_sz
+            zero = jnp.zeros(x_shape, col.dtype)
+            _, vjp = jax.vjp(
+                lambda d: _im2col(d, kernel, stride, dilate, pad), zero)
+            return vjp(col)[0]
+        return fn
+    register_op("col2im", col2im_maker)
+
+    # ---- histogram -------------------------------------------------------
+    def histogram_maker(bin_cnt=None, range=None):
+        def fn(data, *maybe_bins):
+            if maybe_bins:
+                edges = maybe_bins[0]
+                hist, e = jnp.histogram(data.reshape(-1), bins=edges)
+            else:
+                lo, hi = range if range is not None else (None, None)
+                hist, e = jnp.histogram(
+                    data.reshape(-1), bins=bin_cnt or 10,
+                    range=(lo, hi) if lo is not None else None)
+            # int32 counts: int64 is truncated (with a warning) unless
+            # jax_enable_x64 is on; the reference's int64 contract is a
+            # documented deviation
+            return (hist.astype(jnp.int32), e)
+        return fn
+    register_op("histogram", histogram_maker, differentiable=False,
+                use_jit=False)
+
+    # ---- multi_sum_sq (contrib, feeds multi_lars) ------------------------
+    def multi_sum_sq_maker(num_arrays=1):
+        def fn(*arrays):
+            return jnp.stack([jnp.sum(jnp.square(a.astype(jnp.float32)))
+                              for a in arrays])
+        return fn
+    register_op("multi_sum_sq", multi_sum_sq_maker, differentiable=False)
+
+    # ---- choose/fill_element_0index (legacy RL-era ops) ------------------
+    def choose_element_0index_maker():
+        def fn(lhs, rhs):
+            idx = rhs.astype(jnp.int32)
+            return jnp.take_along_axis(lhs, idx[:, None], axis=1)[:, 0]
+        return fn
+    register_op("choose_element_0index", choose_element_0index_maker)
+
+    def fill_element_0index_maker():
+        def fn(lhs, mhs, rhs):
+            idx = rhs.astype(jnp.int32)
+            return lhs.at[jnp.arange(lhs.shape[0]), idx].set(mhs)
+        return fn
+    register_op("fill_element_0index", fill_element_0index_maker)
+
+
+_register()

@@ -273,5 +273,124 @@ def _register():
         return fn
     register_op("lars_trust", lars_trust_maker, differentiable=False)
 
+    # ---- mp_sgd_update (no momentum; fp32 master) -----------------------
+    def mp_sgd_update_maker(wd=0.0, rescale_grad=1.0, clip_gradient=-1.0,
+                            lazy_update=True):
+        def fn(weight, grad, weight32, lr):
+            lr = lr.astype(jnp.float32)
+            g = _prep_grad(grad.astype(jnp.float32), wd, weight32,
+                           rescale_grad, clip_gradient)
+            w32 = weight32 - lr * g
+            return (w32.astype(weight.dtype), w32)
+        return fn
+    register_op("mp_sgd_update", mp_sgd_update_maker, differentiable=False)
+
+    def mp_nag_mom_update_maker(momentum=0.0, wd=0.0, rescale_grad=1.0,
+                                clip_gradient=-1.0):
+        def fn(weight, grad, mom, weight32, lr):
+            lr = lr.astype(jnp.float32)
+            g = _prep_grad(grad.astype(jnp.float32), wd, weight32,
+                           rescale_grad, clip_gradient)
+            mom_new = momentum * mom + g
+            w32 = weight32 - lr * (g + momentum * mom_new)
+            return (w32.astype(weight.dtype), mom_new, w32)
+        return fn
+    register_op("mp_nag_mom_update", mp_nag_mom_update_maker,
+                differentiable=False)
+
+    # ---- FTML (reference: src/operator/optimizer_op.cc ftml_update) -----
+    def ftml_update_maker(beta1=0.6, beta2=0.999, epsilon=1e-8, t=1,
+                          wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
+        def fn(weight, grad, d, v, z, lr):
+            lr = lr.astype(weight.dtype)
+            g = grad * rescale_grad + wd * weight
+            if clip_grad is not None and clip_grad > 0:
+                g = jnp.clip(g, -clip_grad, clip_grad)
+            v_new = beta2 * v + (1 - beta2) * g * g
+            d_new = (1 - beta1 ** t) / lr * (
+                jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)
+            sigma = d_new - beta1 * d
+            z_new = beta1 * z + (1 - beta1) * g - sigma * weight
+            w_new = -z_new / d_new
+            return (w_new, d_new, v_new, z_new)
+        return fn
+    register_op("ftml_update", ftml_update_maker, differentiable=False)
+
+    # ---- LAMB (reference: src/operator/optimizer_op.cc
+    # lamb_update_phase1/phase2) — phase1 emits the adam-style direction,
+    # phase2 applies it with the layerwise trust ratio ----------------------
+    def lamb_phase1_maker(beta1=0.9, beta2=0.999, epsilon=1e-6, t=1,
+                          bias_correction=True, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0):
+        def fn(weight, grad, mean, var):
+            g = grad.astype(jnp.float32) * rescale_grad
+            if clip_gradient is not None and clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            w32 = weight.astype(jnp.float32)
+            m_new = beta1 * mean + (1 - beta1) * g
+            v_new = beta2 * var + (1 - beta2) * g * g
+            if bias_correction:
+                m_hat = m_new / (1 - beta1 ** t)
+                v_hat = v_new / (1 - beta2 ** t)
+            else:
+                m_hat, v_hat = m_new, v_new
+            direction = m_hat / (jnp.sqrt(v_hat) + epsilon) + wd * w32
+            return (direction.astype(weight.dtype), m_new, v_new)
+        return fn
+    register_op("lamb_update_phase1", lamb_phase1_maker,
+                differentiable=False)
+
+    def lamb_phase2_maker(lower_bound=-1.0, upper_bound=-1.0):
+        def fn(weight, g, r1, r2, lr):
+            # r1 = ||w||, r2 = ||direction|| (0-d inputs from the frontend)
+            r1c = r1
+            if lower_bound > 0:
+                r1c = jnp.maximum(r1c, lower_bound)
+            if upper_bound > 0:
+                r1c = jnp.minimum(r1c, upper_bound)
+            ratio = jnp.where((r1c > 0) & (r2 > 0), r1c / r2,
+                              jnp.ones_like(r1c))
+            lr = lr.astype(weight.dtype)
+            return weight - lr * ratio.astype(weight.dtype) * g
+        return fn
+    register_op("lamb_update_phase2", lamb_phase2_maker,
+                differentiable=False)
+
+    def mp_lamb_phase1_maker(beta1=0.9, beta2=0.999, epsilon=1e-6, t=1,
+                             bias_correction=True, wd=0.0, rescale_grad=1.0,
+                             clip_gradient=-1.0):
+        inner = lamb_phase1_maker(beta1, beta2, epsilon, t, bias_correction,
+                                  wd, rescale_grad, clip_gradient)
+
+        def fn(weight, grad, mean, var, weight32):
+            d, m, v = inner(weight32, grad, mean, var)
+            return (d.astype(jnp.float32), m, v)
+        return fn
+    register_op("mp_lamb_update_phase1", mp_lamb_phase1_maker,
+                differentiable=False)
+
+    def mp_lamb_phase2_maker(lower_bound=-1.0, upper_bound=-1.0):
+        inner = lamb_phase2_maker(lower_bound, upper_bound)
+
+        def fn(weight, g, r1, r2, weight32, lr):
+            w32 = inner(weight32, g, r1, r2, lr)
+            return (w32.astype(weight.dtype), w32)
+        return fn
+    register_op("mp_lamb_update_phase2", mp_lamb_phase2_maker,
+                differentiable=False)
+
+    # ---- multi_lars (reference: src/operator/contrib/multi_lars.cc) -----
+    # Batched trust-ratio computation over stacked per-layer norms.
+    def multi_lars_maker(eta=0.001, eps=1e-8, rescale_grad=1.0):
+        def fn(lrs, weights_sum_sq, grads_sum_sq, wds):
+            w_norm = jnp.sqrt(weights_sum_sq)
+            g_norm = jnp.sqrt(grads_sum_sq) * rescale_grad
+            trust = eta * w_norm / (g_norm + wds * w_norm + eps)
+            trust = jnp.where((w_norm > 0) & (g_norm > 0), trust,
+                              jnp.ones_like(trust))
+            return lrs * trust
+        return fn
+    register_op("multi_lars", multi_lars_maker, differentiable=False)
+
 
 _register()

@@ -180,3 +180,135 @@ def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, **kwargs):
     shape, ctx, dt = _prep(shape, ctx, dtype)
     val = jr.bernoulli(_grandom.next_key(), prob, shape).astype(dt)
     return _wrap(val, ctx)
+
+
+# ---------------------------------------------------------------------------
+# sample_* frontends: per-element distribution parameters
+# (reference: src/operator/random/multisample_op.cc — params shape s,
+# output s + shape, one draw block per parameter element)
+# ---------------------------------------------------------------------------
+
+def _sample_params(params, shape):
+    """Common prep: read param arrays, broadcast them to a common shape
+    (so scalar/array parameter mixes work and every parameter row gets
+    its own independent draw block), normalize the draw shape."""
+    vals = [p._read() if isinstance(p, NDArray) else _np.asarray(
+        p, dtype=_np.float32) for p in params]
+    if len(vals) > 1:
+        vals = list(_np.broadcast_arrays(*[_np.asarray(v) for v in vals]))
+    else:
+        vals = [_np.asarray(vals[0])]
+    if shape is None:
+        shape = ()
+    if isinstance(shape, int):
+        shape = (shape,)
+    ctx = next((p.context for p in params if isinstance(p, NDArray)),
+               current_context())
+    return vals, tuple(shape), ctx
+
+
+def _sample_out_shape(pshape, shape):
+    return tuple(pshape) + tuple(shape)
+
+
+def sample_uniform(low, high, shape=None, dtype=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    (lo, hi), shape, ctx = _sample_params([low, high], shape)
+    dt = dtype_np(dtype)
+    out_shape = _sample_out_shape(lo.shape, shape)
+    u = jr.uniform(_grandom.next_key(), out_shape, dt or _np.float32)
+    lo_b = jnp.reshape(lo, lo.shape + (1,) * len(shape))
+    hi_b = jnp.reshape(hi, hi.shape + (1,) * len(shape))
+    return _wrap((lo_b + u * (hi_b - lo_b)).astype(dt or lo.dtype), ctx)
+
+
+def sample_normal(mu, sigma, shape=None, dtype=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    (mu_v, sg), shape, ctx = _sample_params([mu, sigma], shape)
+    dt = dtype_np(dtype)
+    out_shape = _sample_out_shape(mu_v.shape, shape)
+    z = jr.normal(_grandom.next_key(), out_shape, dt or _np.float32)
+    mu_b = jnp.reshape(mu_v, mu_v.shape + (1,) * len(shape))
+    sg_b = jnp.reshape(sg, sg.shape + (1,) * len(shape))
+    return _wrap((mu_b + z * sg_b).astype(dt or mu_v.dtype), ctx)
+
+
+def sample_gamma(alpha, beta, shape=None, dtype=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    (al, be), shape, ctx = _sample_params([alpha, beta], shape)
+    dt = dtype_np(dtype) or _np.float32
+    out_shape = _sample_out_shape(al.shape, shape)
+    al_b = jnp.broadcast_to(
+        jnp.reshape(al, al.shape + (1,) * len(shape)), out_shape)
+    g = jr.gamma(_grandom.next_key(), al_b.astype(dt), out_shape, dt)
+    be_b = jnp.reshape(be, be.shape + (1,) * len(shape))
+    return _wrap((g * be_b).astype(dt), ctx)   # beta is the scale
+
+
+def sample_exponential(lam, shape=None, dtype=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    (lv,), shape, ctx = _sample_params([lam], shape)
+    dt = dtype_np(dtype) or _np.float32
+    out_shape = _sample_out_shape(lv.shape, shape)
+    e = jr.exponential(_grandom.next_key(), out_shape, dt)
+    lam_b = jnp.reshape(lv, lv.shape + (1,) * len(shape))
+    return _wrap((e / lam_b).astype(dt), ctx)
+
+
+def sample_poisson(lam, shape=None, dtype=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    (lv,), shape, ctx = _sample_params([lam], shape)
+    dt = dtype_np(dtype) or _np.float32
+    out_shape = _sample_out_shape(lv.shape, shape)
+    lam_b = jnp.broadcast_to(
+        jnp.reshape(lv, lv.shape + (1,) * len(shape)), out_shape)
+    p = jr.poisson(_grandom.next_key(), lam_b.astype(_np.float32),
+                   out_shape)
+    return _wrap(p.astype(dt), ctx)
+
+
+def sample_negative_binomial(k, p, shape=None, dtype=None, **kwargs):
+    import jax.random as jr
+    import jax.numpy as jnp
+    (kv, pv), shape, ctx = _sample_params([k, p], shape)
+    dt = dtype_np(dtype) or _np.float32
+    out_shape = _sample_out_shape(kv.shape, shape)
+    # NB(k,p) = Poisson(lambda), lambda ~ Gamma(k, (1-p)/p)
+    k_b = jnp.broadcast_to(
+        jnp.reshape(kv, kv.shape + (1,) * len(shape)), out_shape)
+    p_b = jnp.broadcast_to(
+        jnp.reshape(pv, pv.shape + (1,) * len(shape)), out_shape)
+    g = jr.gamma(_grandom.next_key(), k_b.astype(_np.float32), out_shape)
+    lam = g * (1.0 - p_b) / p_b
+    draw = jr.poisson(_grandom.next_key(), lam, out_shape)
+    return _wrap(draw.astype(dt), ctx)
+
+
+def sample_generalized_negative_binomial(mu, alpha, shape=None, dtype=None,
+                                         **kwargs):
+    import jax.numpy as jnp
+    (mv, av), shape, ctx = _sample_params([mu, alpha], shape)
+    # gnb(mu, alpha) == NB(k=1/alpha, p=1/(1+alpha*mu))
+    k = 1.0 / _np.maximum(av, 1e-12)
+    p = 1.0 / (1.0 + av * mv)
+    return sample_negative_binomial(
+        _wrap(jnp.asarray(k), ctx), _wrap(jnp.asarray(p), ctx),
+        shape=shape, dtype=dtype)
+
+
+def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
+                       **kwargs):
+    """Batched multinomial: data (..., k) probability rows."""
+    return multinomial(data, shape=shape, get_prob=get_prob, dtype=dtype,
+                       **kwargs)
+
+
+__all__ += ["sample_uniform", "sample_normal", "sample_gamma",
+            "sample_exponential", "sample_poisson",
+            "sample_negative_binomial",
+            "sample_generalized_negative_binomial", "sample_multinomial"]

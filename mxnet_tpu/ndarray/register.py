@@ -49,14 +49,15 @@ class Operator:
     """A registered operator (analog of ``nnvm::Op``)."""
 
     __slots__ = ("name", "maker", "aliases", "differentiable", "use_jit",
-                 "doc", "ref")
+                 "doc", "ref", "vjp_maker")
 
     def __init__(self, name: str, maker: Callable, aliases: Sequence[str] = (),
                  differentiable: bool = True, use_jit: bool = True,
-                 doc: str = "", ref: str = ""):
+                 doc: str = "", ref: str = "", vjp_maker: Callable = None):
         self.name = name
         self.maker = maker
         self.aliases = tuple(aliases)
+        self.vjp_maker = vjp_maker
         self.differentiable = differentiable
         self.use_jit = use_jit
         self.doc = doc
@@ -91,6 +92,12 @@ class Operator:
         return jax.jit(wrapper) if self.use_jit else wrapper
 
     def get_vjp_fn(self, kwargs: Dict[str, Any]) -> Callable:
+        if self.vjp_maker is not None:
+            # hand-built (primals -> (outs, vjp_fn)) wrapper — the escape
+            # hatch for ops whose output shape depends on input VALUES
+            # (jax.vjp cannot trace those); they run eagerly by
+            # construction, so no jit cache applies
+            return self.vjp_maker(**kwargs)
         kwkey = tuple(sorted((k, _canon(v)) for k, v in kwargs.items()))
         try:
             return self._vjp_cached(kwkey)
@@ -106,11 +113,13 @@ class Operator:
 
 def register_op(name: str, maker: Optional[Callable] = None, *,
                 aliases: Sequence[str] = (), differentiable: bool = True,
-                use_jit: bool = True, doc: str = "", ref: str = ""):
+                use_jit: bool = True, doc: str = "", ref: str = "",
+                vjp_maker: Optional[Callable] = None):
     """Register an operator.  Usable directly or as a decorator on the maker."""
     def do(mk):
         op = Operator(name, mk, aliases=aliases, differentiable=differentiable,
-                      use_jit=use_jit, doc=doc or (mk.__doc__ or ""), ref=ref)
+                      use_jit=use_jit, doc=doc or (mk.__doc__ or ""), ref=ref,
+                      vjp_maker=vjp_maker)
         _registry[name] = op
         for a in aliases:
             _registry[a] = op

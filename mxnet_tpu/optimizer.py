@@ -654,6 +654,121 @@ class LBSGD(Optimizer):
                            out=[weight, state])
 
 
+@register
+class FTML(Optimizer):
+    """Follow The Moving Leader (reference: src/operator/optimizer_op.cc
+    ftml_update; python/mxnet/optimizer FTML).  One fused XLA update per
+    parameter via the ``ftml_update`` op."""
+
+    def __init__(self, learning_rate=0.0025, beta1=0.6, beta2=0.999,
+                 epsilon=1e-8, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+
+    def create_state(self, index, weight):
+        z = nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        d = nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        v = nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (d, v, z)
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        t = self._index_update_count[index]
+        d, v, z = state
+        kw = {"beta1": self.beta1, "beta2": self.beta2,
+              "epsilon": self.epsilon, "t": t,
+              "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_grad"] = self.clip_gradient
+        lr = self._lr_nd(index, weight)
+        invoke_by_name("ftml_update", [weight, grad, d, v, z, lr], kw,
+                       out=[weight, d, v, z])
+
+
+@register
+class LAMB(Optimizer):
+    """Layer-wise Adaptive Moments for Batch training (reference:
+    src/operator/optimizer_op.cc lamb_update_phase1/phase2; python
+    optimizer LAMB).  Phase 1 computes the adam-style direction, phase 2
+    applies it scaled by the layerwise trust ratio ||w||/||direction||."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-6, lower_bound=None, upper_bound=None,
+                 bias_correction=True, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2, self.epsilon = beta1, beta2, epsilon
+        self.lower_bound = lower_bound
+        self.upper_bound = upper_bound
+        self.bias_correction = bias_correction
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=_np.float32),
+                nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=_np.float32))
+
+    def create_state_multi_precision(self, index, weight):
+        if self.multi_precision and _is_low_precision(weight.dtype):
+            w32 = weight.astype(_np.float32)
+            return (self.create_state(index, w32), w32)
+        return self.create_state(index, weight)
+
+    def _phase_kwargs(self, index):
+        kw = {"beta1": self.beta1, "beta2": self.beta2,
+              "epsilon": self.epsilon,
+              "t": self._index_update_count[index],
+              "bias_correction": self.bias_correction,
+              "wd": self._get_wd(index),
+              "rescale_grad": self.rescale_grad}
+        if self.clip_gradient is not None:
+            kw["clip_gradient"] = self.clip_gradient
+        return kw
+
+    def _phase2_kwargs(self):
+        kw = {}
+        if self.lower_bound is not None:
+            kw["lower_bound"] = self.lower_bound
+        if self.upper_bound is not None:
+            kw["upper_bound"] = self.upper_bound
+        return kw
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        mean, var = state
+        d = invoke_by_name("lamb_update_phase1", [weight, grad, mean, var],
+                           self._phase_kwargs(index))
+        direction, m_new, v_new = d
+        mean._set_data(m_new._read())
+        var._set_data(v_new._read())
+        from .ndarray import norm as _nd_norm
+        r1 = _nd_norm(weight)
+        r2 = _nd_norm(direction)
+        lr = self._lr_nd(index, weight)
+        invoke_by_name("lamb_update_phase2",
+                       [weight, direction, r1, r2, lr],
+                       self._phase2_kwargs(), out=weight)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        if not (self.multi_precision and _is_low_precision(weight.dtype)):
+            return self.update(index, weight, grad, state)
+        self._update_count(index)
+        (mean, var), w32 = state
+        d = invoke_by_name("mp_lamb_update_phase1",
+                           [weight, grad, mean, var, w32],
+                           self._phase_kwargs(index))
+        direction, m_new, v_new = d
+        mean._set_data(m_new._read())
+        var._set_data(v_new._read())
+        from .ndarray import norm as _nd_norm
+        r1 = _nd_norm(w32)
+        r2 = _nd_norm(direction)
+        lr = self._lr_nd(index, w32)
+        invoke_by_name("mp_lamb_update_phase2",
+                       [weight, direction, r1, r2, w32, lr],
+                       self._phase2_kwargs(), out=[weight, w32])
+
+
 class Updater:
     """Callable wrapper used by KVStore to run the optimizer server-side
     (reference: mx.optimizer.get_updater)."""

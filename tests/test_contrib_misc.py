@@ -151,3 +151,34 @@ def test_gluon_contrib_nn_namespace():
     blk.add(Identity(), Identity())
     x = mx.nd.array(np.ones((2, 3), np.float32))
     assert blk(x).shape == (2, 6)
+
+
+def test_gluon_deformable_convolution_block():
+    """gluon.contrib.cnn.DeformableConvolution (reference:
+    python/mxnet/gluon/contrib/cnn/conv_layers.py): zero-init offset conv
+    makes it equal a plain conv at init; offsets receive gradients."""
+    import numpy as np
+    from mxnet_tpu import autograd, gluon, nd
+
+    net = gluon.contrib.cnn.DeformableConvolution(
+        8, kernel_size=3, padding=1, activation="relu")
+    net.initialize()
+    x = nd.array(np.random.RandomState(0).randn(2, 4, 10, 10)
+                 .astype(np.float32))
+    y = net(x)
+    assert y.shape == (2, 8, 10, 10)
+    conv_ref = nd.Convolution(x, net.weight.data(), net.bias.data(),
+                              kernel=(3, 3), pad=(1, 1), num_filter=8)
+    ref = np.maximum(conv_ref.asnumpy(), 0)
+    np.testing.assert_allclose(y.asnumpy(), ref, atol=1e-5)
+
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.01})
+    with autograd.record():
+        L = nd.mean(nd.square(net(x)))
+    L.backward()
+    tr.step(2)
+    assert float(nd.sum(nd.abs(net.offset_weight.grad())).asnumpy()) > 0
+
+    net.hybridize()
+    assert net(x).shape == (2, 8, 10, 10)

@@ -1,0 +1,501 @@
+"""Long-tail op tests: fused loss layers, finiteness probes, pdf ops,
+contrib extras, and the LAMB/FTML optimizer-op family.
+
+Reference parity: elemwise_sum.cc, all_finite.cc, loss_binary_op.cc,
+regression_output.cc, svm_output.cc, pdf_op.cc, contrib fft.cc /
+boolean_mask.cc / quadratic_op.cc, optimizer_op.cc (ftml/lamb),
+multi_lars.cc (SURVEY.md §2.2).
+"""
+import numpy as np
+import pytest
+from scipy import stats
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def test_add_n():
+    a, b, c = nd.array([1., 2.]), nd.array([3., 4.]), nd.array([5., 6.])
+    np.testing.assert_allclose(nd.add_n(a, b, c).asnumpy(), [9., 12.])
+    np.testing.assert_allclose(nd.ElementWiseSum(a, b, c).asnumpy(),
+                               [9., 12.])
+
+
+def test_all_finite():
+    assert nd.all_finite(nd.array([1., 2.])).asnumpy()[0] == 1.0
+    assert nd.all_finite(nd.array([1., np.inf])).asnumpy()[0] == 0.0
+    assert nd.all_finite(nd.array([np.nan])).asnumpy()[0] == 0.0
+    ok = nd.multi_all_finite(nd.array([1.]), nd.array([2.]), num_arrays=2)
+    assert ok.asnumpy()[0] == 1.0
+    bad = nd.multi_all_finite(nd.array([1.]), nd.array([np.nan]),
+                              num_arrays=2)
+    assert bad.asnumpy()[0] == 0.0
+
+
+def test_softmax_cross_entropy():
+    import torch
+    rs = np.random.RandomState(0)
+    x = rs.randn(6, 5).astype(np.float32)
+    lab = np.array([0, 1, 2, 3, 4, 0])
+    out = nd.softmax_cross_entropy(nd.array(x), nd.array(lab)).asnumpy()
+    ref = torch.nn.functional.cross_entropy(
+        torch.tensor(x), torch.tensor(lab), reduction="sum").item()
+    np.testing.assert_allclose(out, [ref], rtol=1e-5)
+
+
+def test_regression_outputs():
+    # LinearRegressionOutput: identity forward, (pred-label)*scale gradient
+    x = nd.array([[1., 2.], [3., 4.]])
+    lab = nd.array([[0., 1.], [2., 2.]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.LinearRegressionOutput(x, lab, grad_scale=2.0)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               2.0 * (x.asnumpy() - lab.asnumpy()))
+
+    # MAE: sign gradient
+    x = nd.array([[1., -2.]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.MAERegressionOutput(x, nd.array([[0., 0.]]))
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [[1., -1.]])
+
+    # Logistic: sigmoid forward, (p-label) gradient
+    x = nd.array([[0.0]])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.LogisticRegressionOutput(x, nd.array([[1.0]]))
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), [[0.5]])
+    np.testing.assert_allclose(x.grad.asnumpy(), [[-0.5]])
+
+
+def test_svm_output():
+    # L2-SVM: grad = -2*t*viol where viol = margin - t*y > 0
+    x = nd.array([[2.0, -2.0], [0.1, 0.2]])
+    lab = nd.array([0., 1.])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.SVMOutput(x, lab)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               [[0., 0.], [2.2, -1.6]], rtol=1e-5)
+    # L1 (hinge): grad = -t on violated entries
+    x.grad[:] = 0
+    with autograd.record():
+        y = nd.SVMOutput(x, lab, use_linear=True)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               [[0., 0.], [1., -1.]], rtol=1e-5)
+
+
+def test_pdf_ops():
+    rs = np.random.RandomState(1)
+    s = np.abs(rs.rand(2, 4)).astype(np.float32) + 0.1
+    mu = np.array([0.0, 1.0], np.float32)
+    sig = np.array([1.0, 2.0], np.float32)
+    out = nd.pdf_normal(nd.array(s), nd.array(mu), nd.array(sig)).asnumpy()
+    ref = stats.norm.pdf(s, loc=mu[:, None], scale=sig[:, None])
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # is_log
+    out = nd.pdf_normal(nd.array(s), nd.array(mu), nd.array(sig),
+                        is_log=True).asnumpy()
+    np.testing.assert_allclose(out, np.log(ref), rtol=1e-4)
+
+    lam = np.array([1.5, 2.5], np.float32)
+    out = nd.pdf_exponential(nd.array(s), nd.array(lam)).asnumpy()
+    np.testing.assert_allclose(
+        out, stats.expon.pdf(s, scale=1.0 / lam[:, None]), rtol=1e-5)
+
+    k = np.floor(s * 4)
+    out = nd.pdf_poisson(nd.array(k), nd.array(lam)).asnumpy()
+    np.testing.assert_allclose(out, stats.poisson.pmf(k, lam[:, None]),
+                               rtol=1e-5)
+
+    alpha = np.array([2.0, 3.0], np.float32)
+    beta = np.array([1.5, 0.5], np.float32)  # scale
+    out = nd.pdf_gamma(nd.array(s), nd.array(alpha), nd.array(beta)).asnumpy()
+    np.testing.assert_allclose(
+        out, stats.gamma.pdf(s, alpha[:, None], scale=beta[:, None]),
+        rtol=1e-5)
+
+    kk = np.array([3.0, 5.0], np.float32)
+    p = np.array([0.4, 0.7], np.float32)
+    out = nd.pdf_negative_binomial(nd.array(k), nd.array(kk),
+                                   nd.array(p)).asnumpy()
+    np.testing.assert_allclose(out, stats.nbinom.pmf(k, kk[:, None],
+                                                     p[:, None]), rtol=1e-5)
+
+    low = np.array([0.0, 0.0], np.float32)
+    high = np.array([2.0, 5.0], np.float32)
+    out = nd.pdf_uniform(nd.array(s), nd.array(low), nd.array(high)).asnumpy()
+    np.testing.assert_allclose(
+        out, stats.uniform.pdf(s, low[:, None],
+                               (high - low)[:, None]), rtol=1e-5)
+
+    # dirichlet: sample (1, m, k), alpha (1, k)
+    al = np.array([1.0, 2.0, 3.0], np.float32)
+    samp = rs.dirichlet(al, size=3).astype(np.float32)[None]
+    out = nd.pdf_dirichlet(nd.array(samp), nd.array(al[None])).asnumpy()
+    ref = stats.dirichlet.pdf(samp[0].T, al)
+    np.testing.assert_allclose(out[0], ref, rtol=1e-4)
+
+
+def test_generalized_negative_binomial_pdf():
+    # gnb(mu, alpha) == nbinom(k=1/alpha, p=1/(1+alpha*mu))
+    x = np.array([[0.0, 1.0, 2.0, 5.0]], np.float32)
+    mu, alpha = 2.0, 0.5
+    out = nd.pdf_generalized_negative_binomial(
+        nd.array(x), nd.array([mu]), nd.array([alpha])).asnumpy()
+    ref = stats.nbinom.pmf(x, 1.0 / alpha, 1.0 / (1.0 + alpha * mu))
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+
+
+def test_contrib_fft_ifft():
+    rs = np.random.RandomState(3)
+    x = rs.rand(3, 8).astype(np.float32)
+    f = nd.fft(nd.array(x))
+    assert f.shape == (3, 16)
+    ref = np.fft.fft(x, axis=-1)
+    got = f.asnumpy().reshape(3, 8, 2)
+    np.testing.assert_allclose(got[..., 0], ref.real, rtol=1e-4, atol=1e-4)
+    np.testing.assert_allclose(got[..., 1], ref.imag, rtol=1e-4, atol=1e-4)
+    # unnormalized inverse: ifft(fft(x)) == d * x (cuFFT convention)
+    r = nd.ifft(f).asnumpy()
+    np.testing.assert_allclose(r, 8 * x, rtol=1e-4, atol=1e-4)
+
+
+def test_boolean_mask():
+    d = nd.array([[1., 2.], [3., 4.], [5., 6.]])
+    idx = nd.array([0., 1., 1.])
+    out = nd.boolean_mask(d, idx)
+    np.testing.assert_allclose(out.asnumpy(), [[3., 4.], [5., 6.]])
+    out = nd.boolean_mask(d, nd.array([1., 1., 1.]))
+    assert out.shape == (3, 2)
+
+
+def test_arange_like_quadratic_crop():
+    z = nd.zeros((2, 3))
+    np.testing.assert_allclose(nd.arange_like(z).asnumpy(),
+                               [[0., 1., 2.], [3., 4., 5.]])
+    np.testing.assert_allclose(nd.arange_like(z, axis=1).asnumpy(),
+                               [[0., 1., 2.], [0., 1., 2.]])
+    np.testing.assert_allclose(
+        nd.arange_like(z, start=1.0, step=0.5, axis=1).asnumpy(),
+        [[1., 1.5, 2.], [1., 1.5, 2.]])
+
+    np.testing.assert_allclose(
+        nd.quadratic(nd.array([1., 2.]), a=1.0, b=2.0, c=3.0).asnumpy(),
+        [6., 11.])
+
+    img = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    np.testing.assert_allclose(
+        nd.Crop(img, h_w=(2, 2), center_crop=True).asnumpy(),
+        [[[[5., 6.], [9., 10.]]]])
+    np.testing.assert_allclose(
+        nd.Crop(img, h_w=(2, 2), offset=(1, 2)).asnumpy(),
+        [[[[6., 7.], [10., 11.]]]])
+    like = nd.zeros((1, 1, 3, 3))
+    assert nd.Crop(img, like, num_args=2).shape == (1, 1, 3, 3)
+
+
+def test_gradientmultiplier_and_kl_reg():
+    x = nd.array([1., 2.])
+    x.attach_grad()
+    with autograd.record():
+        y = nd.gradientmultiplier(x, scalar=3.0)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    np.testing.assert_allclose(x.grad.asnumpy(), [3., 3.])
+
+    x = nd.array(np.random.RandomState(2).randn(8, 4).astype(np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.IdentityAttachKLSparseReg(x, sparseness_target=0.2,
+                                         penalty=0.01)
+    y.backward()
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    # gradient = head-grad (ones) + KL penalty term; must differ from ones
+    assert not np.allclose(x.grad.asnumpy(), 1.0)
+
+
+def test_mp_sgd_and_nag_updates():
+    lr = nd.array(0.1)
+    w = nd.array([1., 2.], dtype="float16")
+    g = nd.array([0.5, 0.5], dtype="float16")
+    w32 = nd.array([1., 2.])
+    w_new, w32_new = nd.mp_sgd_update(w, g, w32, lr)
+    np.testing.assert_allclose(w32_new.asnumpy(), [0.95, 1.95], rtol=1e-6)
+    assert w_new.dtype == np.float16
+
+    mom = nd.zeros((2,))
+    w_new, mom_new, w32_new = nd.mp_nag_mom_update(
+        w, g, mom, w32, lr, momentum=0.9)
+    # first step: mom = g; w = w - lr*(g + 0.9*g) = w - lr*1.9*g
+    np.testing.assert_allclose(mom_new.asnumpy(), [0.5, 0.5])
+    np.testing.assert_allclose(w32_new.asnumpy(),
+                               [1 - 0.1 * 1.9 * 0.5, 2 - 0.1 * 1.9 * 0.5],
+                               rtol=1e-6)
+
+
+def test_ftml_update():
+    # step 1 from zero state, closed form:
+    # v = (1-b2) g²; d = (1-b1)/lr (sqrt(v/(1-b2)) + eps);
+    # z = (1-b1) g - (d - 0) w... with d_prev=0: sigma = d
+    beta1, beta2, eps = 0.6, 0.999, 1e-8
+    g, w, lr = 0.1, 1.0, 0.1
+    v = (1 - beta2) * g * g
+    d = (1 - beta1) / lr * (np.sqrt(v / (1 - beta2)) + eps)
+    z = (1 - beta1) * g - d * w
+    w_new = -z / d
+    o = nd.ftml_update(nd.array([w]), nd.array([g]), nd.zeros((1,)),
+                       nd.zeros((1,)), nd.zeros((1,)), nd.array(lr), t=1,
+                       beta1=beta1, beta2=beta2, epsilon=eps)
+    np.testing.assert_allclose(o[0].asnumpy(), [w_new], rtol=1e-5)
+    np.testing.assert_allclose(o[2].asnumpy(), [v], rtol=1e-5)
+
+
+def test_lamb_update_phases():
+    w = np.array([0.5, -0.3, 0.8], np.float32)
+    g = np.array([0.1, -0.2, 0.05], np.float32)
+    beta1, beta2, eps, wd = 0.9, 0.999, 1e-6, 0.01
+    d, m, v = nd.lamb_update_phase1(
+        nd.array(w), nd.array(g), nd.zeros((3,)), nd.zeros((3,)),
+        t=1, beta1=beta1, beta2=beta2, epsilon=eps, wd=wd)
+    m_ref = (1 - beta1) * g
+    v_ref = (1 - beta2) * g * g
+    d_ref = (m_ref / (1 - beta1)) / (np.sqrt(v_ref / (1 - beta2)) + eps) \
+        + wd * w
+    np.testing.assert_allclose(d.asnumpy(), d_ref, rtol=1e-5)
+
+    r1 = nd.norm(nd.array(w))
+    r2 = nd.norm(d)
+    out = nd.lamb_update_phase2(nd.array(w), d, r1, r2, nd.array(0.01))
+    ratio = np.linalg.norm(w) / np.linalg.norm(d_ref)
+    np.testing.assert_allclose(out.asnumpy(), w - 0.01 * ratio * d_ref,
+                               rtol=1e-5)
+
+    # multi-precision wrapper keeps an fp32 master
+    w16 = nd.array(w, dtype="float16")
+    d2, m2, v2 = nd.mp_lamb_update_phase1(
+        w16, nd.array(g, dtype="float16"), nd.zeros((3,)), nd.zeros((3,)),
+        nd.array(w), t=1, beta1=beta1, beta2=beta2, epsilon=eps, wd=wd)
+    np.testing.assert_allclose(d2.asnumpy(), d_ref, rtol=1e-2)
+    w_new, w32_new = nd.mp_lamb_update_phase2(
+        w16, d2, nd.norm(nd.array(w)), nd.norm(d2), nd.array(w),
+        nd.array(0.01))
+    assert w_new.dtype == np.float16
+    assert w32_new.dtype == np.float32
+
+
+def test_multi_lars():
+    lrs = nd.array([0.1, 0.1, 0.1])
+    wss = nd.array([4.0, 0.0, 1.0])     # ||w||² per layer
+    gss = nd.array([1.0, 1.0, 4.0])     # ||g||² per layer
+    wds = nd.array([0.0, 0.0, 0.0])
+    out = nd.multi_lars(lrs, wss, gss, wds, eta=1.0, eps=0.0).asnumpy()
+    np.testing.assert_allclose(out, [0.2, 0.1, 0.05], rtol=1e-5)
+
+
+def test_sample_distributions():
+    """Per-parameter-element draws (multisample_op.cc frontends):
+    params shape s -> output s + shape; verify moments per row."""
+    mx.random.seed(7)
+    s = nd.sample_normal(nd.array([0.0, 10.0]), nd.array([1.0, 0.1]),
+                         shape=4000)
+    assert s.shape == (2, 4000)
+    a = s.asnumpy()
+    np.testing.assert_allclose(a.mean(axis=1), [0.0, 10.0], atol=0.1)
+    np.testing.assert_allclose(a.std(axis=1), [1.0, 0.1], atol=0.05)
+
+    g = nd.sample_gamma(nd.array([2.0, 9.0]), nd.array([1.0, 0.5]),
+                        shape=4000).asnumpy()
+    np.testing.assert_allclose(g.mean(axis=1), [2.0, 4.5], rtol=0.1)
+
+    e = nd.sample_exponential(nd.array([2.0, 0.5]), shape=4000).asnumpy()
+    np.testing.assert_allclose(e.mean(axis=1), [0.5, 2.0], rtol=0.1)
+
+    p = nd.sample_poisson(nd.array([3.0, 8.0]), shape=4000).asnumpy()
+    np.testing.assert_allclose(p.mean(axis=1), [3.0, 8.0], rtol=0.1)
+
+    nb = nd.sample_negative_binomial(nd.array([3.0]), nd.array([0.4]),
+                                     shape=6000).asnumpy()
+    np.testing.assert_allclose(nb.mean(), 4.5, rtol=0.15)
+
+    gn = nd.sample_generalized_negative_binomial(
+        nd.array([2.0]), nd.array([0.5]), shape=6000).asnumpy()
+    np.testing.assert_allclose(gn.mean(), 2.0, rtol=0.15)
+
+    u = nd.sample_uniform(nd.array([0.0, 5.0]), nd.array([1.0, 6.0]),
+                          shape=4000).asnumpy()
+    assert (u[0] >= 0).all() and (u[0] <= 1).all()
+    assert (u[1] >= 5).all() and (u[1] <= 6).all()
+
+
+def test_im2col_col2im_vs_torch():
+    import torch
+    x = np.random.RandomState(0).randn(2, 3, 8, 8).astype(np.float32)
+    col = nd.im2col(nd.array(x), kernel=(3, 3), stride=(2, 2),
+                    pad=(1, 1)).asnumpy()
+    ref = torch.nn.functional.unfold(torch.tensor(x), 3, stride=2,
+                                     padding=1).numpy()
+    np.testing.assert_allclose(col, ref, atol=1e-5)
+    # col2im is the exact adjoint (== torch fold)
+    y = np.random.RandomState(1).randn(*col.shape).astype(np.float32)
+    img = nd.col2im(nd.array(y), output_size=(8, 8), kernel=(3, 3),
+                    stride=(2, 2), pad=(1, 1)).asnumpy()
+    ref2 = torch.nn.functional.fold(torch.tensor(y), (8, 8), 3, stride=2,
+                                    padding=1).numpy()
+    np.testing.assert_allclose(img, ref2, atol=1e-4)
+
+
+def test_histogram_and_multi_sum_sq():
+    d = nd.array(np.random.RandomState(2).rand(100).astype(np.float32))
+    h, e = nd.histogram(d, bin_cnt=5, range=(0.0, 1.0))
+    assert h.asnumpy().sum() == 100
+    assert e.shape == (6,)
+    np.testing.assert_allclose(e.asnumpy(), np.linspace(0, 1, 6), atol=1e-6)
+
+    o = nd.multi_sum_sq(nd.array([1., 2.]), nd.array([3.]),
+                        num_arrays=2).asnumpy()
+    np.testing.assert_allclose(o, [5., 9.])
+
+
+def test_choose_fill_element_0index():
+    l = nd.array([[1., 2.], [3., 4.]])
+    np.testing.assert_allclose(
+        nd.choose_element_0index(l, nd.array([1., 0.])).asnumpy(), [2., 3.])
+    np.testing.assert_allclose(
+        nd.fill_element_0index(l, nd.array([9., 8.]),
+                               nd.array([0., 1.])).asnumpy(),
+        [[9., 2.], [3., 8.]])
+
+
+def test_adaptive_avg_pooling_vs_torch():
+    import torch
+    x = np.arange(2 * 3 * 6 * 6, dtype=np.float32).reshape(2, 3, 6, 6)
+    o = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=2).asnumpy()
+    ref = torch.nn.functional.adaptive_avg_pool2d(torch.tensor(x), 2).numpy()
+    np.testing.assert_allclose(o, ref, atol=1e-5)
+    o = nd.AdaptiveAvgPooling2D(nd.array(x), output_size=(3, 2)).asnumpy()
+    ref = torch.nn.functional.adaptive_avg_pool2d(
+        torch.tensor(x), (3, 2)).numpy()
+    np.testing.assert_allclose(o, ref, atol=1e-5)
+
+
+def test_index_array_allclose():
+    z = nd.zeros((2, 3))
+    full = nd.index_array(z).asnumpy()
+    assert full.shape == (2, 3, 2)
+    np.testing.assert_allclose(full[1, 2], [1, 2])
+    ax1 = nd.index_array(z, axes=(1,)).asnumpy()
+    np.testing.assert_allclose(ax1[:, :, 0], [[0, 1, 2], [0, 1, 2]])
+
+    assert nd.allclose(nd.array([1.0]),
+                       nd.array([1.0 + 1e-7])).asnumpy()[0] == 1.0
+    assert nd.allclose(nd.array([1.0]), nd.array([2.0])).asnumpy()[0] == 0.0
+
+
+def test_deformable_convolution():
+    """Deformable conv (deformable_convolution.cc): zero offsets must equal
+    plain conv; integer offsets equal conv over the shifted image."""
+    import torch
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 4, 9, 9).astype(np.float32)
+    w = rs.randn(6, 4, 3, 3).astype(np.float32)
+    b = rs.randn(6).astype(np.float32)
+
+    off = np.zeros((2, 2 * 9, 7, 7), np.float32)
+    out = nd.DeformableConvolution(nd.array(x), nd.array(off), nd.array(w),
+                                   nd.array(b), kernel=(3, 3),
+                                   num_filter=6).asnumpy()
+    ref = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                     torch.tensor(b)).numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-4)
+
+    # all-ones offset == conv over image shifted by (-1,-1) with zero pad
+    off1 = np.ones((2, 2 * 9, 7, 7), np.float32)
+    out1 = nd.DeformableConvolution(nd.array(x), nd.array(off1), nd.array(w),
+                                    nd.array(b), kernel=(3, 3),
+                                    num_filter=6).asnumpy()
+    xs = np.zeros_like(x)
+    xs[:, :, :-1, :-1] = x[:, :, 1:, 1:]
+    ref1 = torch.nn.functional.conv2d(torch.tensor(xs), torch.tensor(w),
+                                      torch.tensor(b)).numpy()
+    np.testing.assert_allclose(out1, ref1, atol=1e-4)
+
+    # stride/pad geometry
+    off2 = np.zeros((2, 2 * 9, 5, 5), np.float32)
+    out2 = nd.DeformableConvolution(nd.array(x), nd.array(off2), nd.array(w),
+                                    nd.array(b), kernel=(3, 3), num_filter=6,
+                                    stride=(2, 2), pad=(1, 1)).asnumpy()
+    ref2 = torch.nn.functional.conv2d(torch.tensor(x), torch.tensor(w),
+                                      torch.tensor(b), stride=2,
+                                      padding=1).numpy()
+    np.testing.assert_allclose(out2, ref2, atol=1e-4)
+
+    # fractional offsets: differentiable w.r.t. data
+    from mxnet_tpu import autograd as ag
+    xd = nd.array(x)
+    xd.attach_grad()
+    offr = nd.array((rs.rand(2, 2 * 9, 7, 7) - 0.5).astype(np.float32))
+    with ag.record():
+        y = nd.DeformableConvolution(xd, offr, nd.array(w), nd.array(b),
+                                     kernel=(3, 3), num_filter=6)
+        L = nd.sum(y)
+    L.backward()
+    assert np.isfinite(xd.grad.asnumpy()).all()
+    assert np.abs(xd.grad.asnumpy()).sum() > 0
+
+
+def test_psroi_pooling_position_sensitive():
+    """PSROIPooling (psroi_pooling.cc): output bin (i,j) of channel c reads
+    only its own score map c*gs²+i*gs+j."""
+    rs = np.random.RandomState(0)
+    data = rs.randn(1, 2 * 3 * 3, 12, 12).astype(np.float32)
+    rois = np.array([[0, 0, 0, 11, 11], [0, 2, 2, 8, 8]], np.float32)
+    o = nd.PSROIPooling(nd.array(data), nd.array(rois), spatial_scale=1.0,
+                        output_dim=2, pooled_size=3)
+    assert o.shape == (2, 2, 3, 3)
+    # perturb score map (c=0, i=0, j=0): only out[:, 0, 0, 0] may change
+    d2 = data.copy()
+    d2[0, 0] += 100.0
+    o2 = nd.PSROIPooling(nd.array(d2), nd.array(rois), spatial_scale=1.0,
+                         output_dim=2, pooled_size=3)
+    diff = (o2.asnumpy() - o.asnumpy()) != 0
+    assert diff[:, 0, 0, 0].all()
+    diff[:, 0, 0, 0] = False
+    assert not diff.any()
+
+
+def test_boolean_mask_gradient():
+    # the reference op has a backward: cotangent rows scatter back to the
+    # kept positions; verified through the tape despite the
+    # value-dependent output shape
+    x = nd.array([[1., 2.], [3., 4.], [5., 6.]])
+    x.attach_grad()
+    idx = nd.array([0., 1., 1.])
+    with autograd.record():
+        L = nd.sum(nd.boolean_mask(x, idx) * nd.array([[1., 2.], [3., 4.]]))
+    L.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(),
+                               [[0., 0.], [1., 2.], [3., 4.]])
+
+
+def test_sample_mixed_scalar_array_params():
+    # scalar/array parameter mixes broadcast; each parameter row draws its
+    # own independent block
+    out = nd.sample_generalized_negative_binomial(nd.array([2., 3.]), 0.5,
+                                                  shape=4)
+    assert out.shape == (2, 4)
+    u = nd.sample_uniform(0.0, nd.array([1., 2., 3.]), shape=200)
+    assert u.shape == (3, 200)
+    a = u.asnumpy()
+    # normalized rows must NOT be identical (independent quantiles per row)
+    assert not np.allclose(a[0] / 1.0, a[2] / 3.0)
+    for i, hi in enumerate([1., 2., 3.]):
+        assert (a[i] >= 0).all() and (a[i] <= hi).all()

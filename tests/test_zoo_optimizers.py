@@ -125,3 +125,56 @@ def test_lars_and_lbsgd_converge():
             if l0 is None:
                 l0 = float(L.asnumpy())
         assert float(L.asnumpy()) < l0 * 0.5, name
+
+
+def test_lamb_optimizer_steps_and_trust():
+    # LAMB direction = adam-hat + wd*w; step scaled by ||w||/||dir||
+    np.random.seed(1)
+    w0 = np.random.randn(6, 3).astype(np.float32)
+    g = np.random.randn(6, 3).astype(np.float32)
+    w = mx.nd.array(w0)
+    opt = mx.optimizer.create("lamb", learning_rate=0.01, wd=0.01)
+    state = opt.create_state(0, w)
+    opt.update(0, w, mx.nd.array(g), state)
+    beta1, beta2, eps, wd, lr = 0.9, 0.999, 1e-6, 0.01, 0.01
+    m = (1 - beta1) * g
+    v = (1 - beta2) * g * g
+    d = (m / (1 - beta1)) / (np.sqrt(v / (1 - beta2)) + eps) + wd * w0
+    ratio = np.linalg.norm(w0) / np.linalg.norm(d)
+    np.testing.assert_allclose(w.asnumpy(), w0 - lr * ratio * d, rtol=1e-5)
+
+
+def test_lamb_multi_precision():
+    w0 = np.random.RandomState(2).randn(4, 4).astype(np.float32)
+    w = mx.nd.array(w0, dtype="float16")
+    g = mx.nd.array(np.ones((4, 4)), dtype="float16")
+    opt = mx.optimizer.create("lamb", learning_rate=0.01,
+                              multi_precision=True)
+    st = opt.create_state_multi_precision(0, w)
+    for _ in range(3):
+        opt.update_multi_precision(0, w, g, st)
+    (mean, var), w32 = st
+    assert w32.dtype == np.float32
+    assert w.dtype == np.float16
+    np.testing.assert_allclose(w.asnumpy(), w32.asnumpy().astype(np.float16),
+                               rtol=1e-3)
+
+
+def test_ftml_converges():
+    np.random.seed(3)
+    net = gluon.nn.Dense(1, in_units=6)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), "ftml",
+                       {"learning_rate": 0.1})
+    X = np.random.randn(32, 6).astype(np.float32)
+    yt = X @ np.ones((6, 1), np.float32)
+    l0 = None
+    for _ in range(60):
+        with autograd.record():
+            L = mx.nd.mean(mx.nd.square(
+                net(mx.nd.array(X)) - mx.nd.array(yt)))
+        L.backward()
+        tr.step(32)
+        if l0 is None:
+            l0 = float(L.asnumpy())
+    assert float(L.asnumpy()) < l0 * 0.3
