@@ -50,6 +50,9 @@ from . import io
 from . import image
 from . import model
 from . import callback
+from . import monitor
+from .monitor import Monitor
+from . import rnn
 from . import gluon
 from . import parallel
 from . import symbol

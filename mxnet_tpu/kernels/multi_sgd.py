@@ -131,14 +131,32 @@ def _per_chunk(values, chunks, dtype):
                       total_repeat_length=sum(chunks))
 
 
-def _interpret() -> bool:
+def _interpret(example=None) -> bool:
+    """Interpret mode off-TPU.  Decided by where the DATA lives, not the
+    default backend: a live TPU backend with CPU-resident arrays would
+    otherwise hand Mosaic a CPU lowering (which pallas rejects).
+
+    Only meaningful on EAGER calls — under jit ``example`` is a tracer
+    with no device and this falls back to the default backend; traced
+    callers (the registered multi_sgd ops) must pass the decision in as
+    the explicit static ``interpret`` kwarg instead."""
     import jax
+    if example is not None:
+        try:
+            dev = getattr(example, "device", None)
+            dev = dev() if callable(dev) else dev
+            if dev is None:
+                devs = example.devices()
+                dev = next(iter(devs))
+            return dev.platform not in ("tpu", "axon")
+        except Exception:
+            pass
     return jax.default_backend() == "cpu"
 
 
 def fused_multi_sgd(weights: Sequence, grads: Sequence,
                     lrs, wds, rescale_grad: float = 1.0,
-                    clip_gradient: float = -1.0):
+                    clip_gradient: float = -1.0, interpret=None):
     """One Pallas launch updating every (weight, grad) pair.
 
     ``lrs``/``wds`` are per-tensor sequences OR traced arrays (LR
@@ -149,8 +167,10 @@ def fused_multi_sgd(weights: Sequence, grads: Sequence,
     shapes = tuple(tuple(w.shape) for w in weights)
     chunks, n_chunks = _plan(shapes)
     dtype = jnp.result_type(weights[0])
+    if interpret is None:
+        interpret = _interpret(weights[0])
     call = _build_call(n_chunks, float(clip_gradient),
-                       dtype.name, None, _interpret())
+                       dtype.name, None, bool(interpret))
     lr_c = _per_chunk(lrs, chunks, dtype)
     wd_c = _per_chunk(wds, chunks, dtype)
     w_buf = _pack(weights, chunks)
@@ -162,14 +182,16 @@ def fused_multi_sgd(weights: Sequence, grads: Sequence,
 def fused_multi_sgd_mom(weights: Sequence, grads: Sequence, moms: Sequence,
                         lrs, wds, momentum: float = 0.9,
                         rescale_grad: float = 1.0,
-                        clip_gradient: float = -1.0):
+                        clip_gradient: float = -1.0, interpret=None):
     """Momentum variant; returns (updated_weights, updated_moms)."""
     import jax.numpy as jnp
     shapes = tuple(tuple(w.shape) for w in weights)
     chunks, n_chunks = _plan(shapes)
     dtype = jnp.result_type(weights[0])
+    if interpret is None:
+        interpret = _interpret(weights[0])
     call = _build_call(n_chunks, float(clip_gradient),
-                       dtype.name, float(momentum), _interpret())
+                       dtype.name, float(momentum), bool(interpret))
     lr_c = _per_chunk(lrs, chunks, dtype)
     wd_c = _per_chunk(wds, chunks, dtype)
     w_buf = _pack(weights, chunks)

@@ -187,35 +187,50 @@ class BaseModule:
         validation_metric = _as_metric(validation_metric) \
             if validation_metric is not None else eval_metric
 
-        for epoch in range(begin_epoch, num_epoch):
-            tic = time.time()
-            eval_metric.reset()
-            for nbatch, data_batch in enumerate(train_data):
-                self.forward_backward(data_batch)
-                self.update()
-                self.update_metric(eval_metric, data_batch.label)
-                if batch_end_callback is not None:
-                    param = BatchEndParam(epoch=epoch, nbatch=nbatch,
-                                          eval_metric=eval_metric,
-                                          locals=None)
-                    for cb in _as_list(batch_end_callback):
-                        cb(param)
-            for name, val in eval_metric.get_name_value():
-                self.logger.info("Epoch[%d] Train-%s=%f", epoch, name, val)
-            self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
-                             time.time() - tic)
-            if epoch_end_callback is not None:
-                arg, aux = self.get_params()
-                for cb in _as_list(epoch_end_callback):
-                    cb(epoch, self.symbol, arg, aux)
-            if eval_data is not None:
-                res = self.score(eval_data, validation_metric,
-                                 batch_end_callback=eval_batch_end_callback,
-                                 epoch=epoch)
-                for name, val in res:
-                    self.logger.info("Epoch[%d] Validation-%s=%f", epoch,
-                                     name, val)
-            train_data.reset()
+        if monitor is not None:
+            monitor.install()
+
+        try:
+            for epoch in range(begin_epoch, num_epoch):
+                tic = time.time()
+                eval_metric.reset()
+                for nbatch, data_batch in enumerate(train_data):
+                    if monitor is not None:
+                        monitor.tic()
+                    self.forward_backward(data_batch)
+                    self.update()
+                    if monitor is not None:
+                        monitor.toc_print()
+                    self.update_metric(eval_metric, data_batch.label)
+                    if batch_end_callback is not None:
+                        param = BatchEndParam(epoch=epoch, nbatch=nbatch,
+                                              eval_metric=eval_metric,
+                                              locals=None)
+                        for cb in _as_list(batch_end_callback):
+                            cb(param)
+                for name, val in eval_metric.get_name_value():
+                    self.logger.info("Epoch[%d] Train-%s=%f", epoch, name,
+                                     val)
+                self.logger.info("Epoch[%d] Time cost=%.3f", epoch,
+                                 time.time() - tic)
+                if epoch_end_callback is not None:
+                    arg, aux = self.get_params()
+                    for cb in _as_list(epoch_end_callback):
+                        cb(epoch, self.symbol, arg, aux)
+                if eval_data is not None:
+                    res = self.score(
+                        eval_data, validation_metric,
+                        batch_end_callback=eval_batch_end_callback,
+                        epoch=epoch)
+                    for name, val in res:
+                        self.logger.info("Epoch[%d] Validation-%s=%f",
+                                         epoch, name, val)
+                train_data.reset()
+        finally:
+            # the monitor taps the process-global engine; leaving it
+            # installed would keep per-dispatch timing on forever
+            if monitor is not None:
+                monitor.uninstall()
 
 
 def _as_list(x):

@@ -1,0 +1,112 @@
+"""mx.monitor.Monitor — layer output/weight statistics during training.
+
+Reference parity: python/mxnet/monitor.py (SURVEY.md §2.5 frontend).  The
+reference installs a callback on every executor so each op's outputs get a
+stat computed when the monitor is active.  TPU-native design: the engine's
+listener hook (engine.py on_push) is the analog seam — every imperative /
+Module op dispatch passes through it, so the monitor taps the same stream
+the profiler does, with zero cost while uninstalled.  Stats stay as 0-d
+device arrays until toc() (no host sync in the hot loop).
+"""
+from __future__ import annotations
+
+import logging
+import re
+from typing import Callable, List, Optional, Tuple
+
+from .engine import engine
+
+__all__ = ["Monitor"]
+
+
+def _default_stat(x):
+    import jax.numpy as jnp
+    return jnp.linalg.norm(x.astype(jnp.float32)) / jnp.sqrt(
+        jnp.asarray(x.size, jnp.float32))
+
+
+class Monitor:
+    """Collect per-op output statistics every ``interval`` batches.
+
+    Parameters mirror the reference: ``interval`` (batches between
+    collections), ``stat_func`` (array -> 0-d stat; default mean |norm|),
+    ``pattern`` (regex over op/param names), ``sort`` (sort toc output by
+    name).  Usage::
+
+        mon = Monitor(interval=10, pattern=".*")
+        mon.install()             # or pass monitor=mon to Module.fit
+        ... training ...
+        mon.tic()                 # start collecting this batch
+        ... forward/backward ...
+        for name, batch, stat in mon.toc():
+            print(name, stat)
+    """
+
+    def __init__(self, interval: int = 1,
+                 stat_func: Optional[Callable] = None,
+                 pattern: str = ".*", sort: bool = False):
+        self.interval = max(1, int(interval))
+        self.stat_func = stat_func or _default_stat
+        self.re = re.compile(pattern)
+        self.sort = sort
+        self.activated = False
+        self.step = 0
+        self.queue: List[Tuple[int, str, object]] = []
+        self._installed = False
+
+    # -- engine tap --------------------------------------------------------
+    def _listener(self, op_name: str, outputs, dispatch_us: float) -> None:
+        if not self.activated or not self.re.match(op_name):
+            return
+        outs = outputs if isinstance(outputs, (list, tuple)) else [outputs]
+        for i, o in enumerate(outs):
+            name = op_name if len(outs) == 1 else f"{op_name}_output{i}"
+            try:
+                self.queue.append((self.step, name, self.stat_func(o)))
+            except Exception:          # non-numeric outputs (edges, tuples)
+                pass
+
+    def install(self) -> None:
+        if not self._installed:
+            engine().add_listener(self._listener)
+            self._installed = True
+
+    def uninstall(self) -> None:
+        if self._installed:
+            engine().remove_listener(self._listener)
+            self._installed = False
+
+    # reference API: install on an executor — the engine tap already sees
+    # every dispatch, so this just ensures the listener is live
+    def install_to_executor(self, executor=None) -> None:
+        self.install()
+
+    # -- batch protocol ----------------------------------------------------
+    def tic(self) -> None:
+        if self.step % self.interval == 0:
+            self.activated = True
+            self.queue = []
+
+    def toc(self) -> List[Tuple[int, str, str]]:
+        if not self.activated:
+            self.step += 1
+            return []
+        self.activated = False
+        import numpy as np
+        res = []
+        for step, name, arr in self.queue:
+            try:
+                val = np.asarray(arr)
+                s = str(float(val)) if val.size == 1 else str(val)
+            except Exception:
+                s = str(arr)
+            res.append((step, name, s))
+        if self.sort:
+            res.sort(key=lambda t: t[1])
+        self.queue = []
+        self.step += 1
+        return res
+
+    def toc_print(self) -> None:
+        for step, name, stat in self.toc():
+            logging.getLogger().info("Batch: %7d %30s %s", step, name, stat)

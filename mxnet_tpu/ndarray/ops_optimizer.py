@@ -173,27 +173,33 @@ def _register():
     # attrs, so LR schedules never retrigger compilation.
 
     def multi_sgd_update_maker(rescale_grad=1.0, clip_gradient=-1.0,
-                               num_weights=None):
+                               num_weights=None, interpret=None):
+        # interpret is a STATIC attr (jit-cache-keyed): the Mosaic-vs-
+        # interpret choice cannot be made inside the trace (tracers have
+        # no device), so the frontend passes it from the NDArray context
         from ..kernels import fused_multi_sgd
 
         def fn(*data):  # w0, g0, w1, g1, ..., lrs, wds
             arrs, lrs, wds = data[:-2], data[-2], data[-1]
             ws, gs = arrs[0::2], arrs[1::2]
             return tuple(fused_multi_sgd(
-                ws, gs, lrs, wds, rescale_grad, clip_gradient))
+                ws, gs, lrs, wds, rescale_grad, clip_gradient,
+                interpret=interpret))
         return fn
     register_op("multi_sgd_update", multi_sgd_update_maker,
                 differentiable=False)
 
     def multi_sgd_mom_update_maker(momentum=0.0, rescale_grad=1.0,
-                                   clip_gradient=-1.0, num_weights=None):
+                                   clip_gradient=-1.0, num_weights=None,
+                                   interpret=None):
         from ..kernels import fused_multi_sgd_mom
 
         def fn(*data):  # w0, g0, m0, w1, g1, m1, ..., lrs, wds
             arrs, lrs, wds = data[:-2], data[-2], data[-1]
             ws, gs, ms = arrs[0::3], arrs[1::3], arrs[2::3]
             w_out, m_out = fused_multi_sgd_mom(
-                ws, gs, ms, lrs, wds, momentum, rescale_grad, clip_gradient)
+                ws, gs, ms, lrs, wds, momentum, rescale_grad, clip_gradient,
+                interpret=interpret)
             out = []
             for w, m in zip(w_out, m_out):
                 out.extend((w, m))
@@ -203,7 +209,8 @@ def _register():
                 differentiable=False)
 
     def multi_mp_sgd_mom_update_maker(momentum=0.0, rescale_grad=1.0,
-                                      clip_gradient=-1.0, num_weights=None):
+                                      clip_gradient=-1.0, num_weights=None,
+                                      interpret=None):
         from ..kernels import fused_multi_sgd_mom
 
         def fn(*data):  # w0, g0, m0, w32_0, w1, g1, m1, w32_1, ..., lrs, wds
@@ -213,7 +220,7 @@ def _register():
             ms, w32s = arrs[2::4], arrs[3::4]
             w32_out, m_out = fused_multi_sgd_mom(
                 w32s, gs, ms, lrs, wds, momentum, rescale_grad,
-                clip_gradient)
+                clip_gradient, interpret=interpret)
             out = []
             for w, w32, m in zip(ws, w32_out, m_out):
                 out.extend((w32.astype(w.dtype), m, w32))

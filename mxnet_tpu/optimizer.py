@@ -263,6 +263,12 @@ class SGD(Optimizer):
                                  _np.float32), ctx=ctx)
         kw: Dict[str, Any] = {"rescale_grad": self.rescale_grad,
                               "num_weights": len(indices)}
+        # Mosaic vs interpret must be decided OUTSIDE the trace (a traced
+        # array has no device); key it on the concrete weight context
+        try:
+            kw["interpret"] = ctx.device.platform not in ("tpu", "axon")
+        except Exception:
+            pass
         if self.clip_gradient is not None:
             kw["clip_gradient"] = self.clip_gradient
         data: list = []

@@ -1,0 +1,17 @@
+"""mx.rnn — the legacy (pre-gluon) symbolic RNN cell API.
+
+Reference parity: python/mxnet/rnn/ (SURVEY.md §2.5 frontend) — cells
+compose Symbol graphs step by step, the BucketingModule consumes
+``unroll`` outputs, and BucketSentenceIter feeds variable-length text.
+The gluon cells (gluon/rnn) are the imperative/hybrid face; this package
+is the Module-era face over the same registry ops.
+"""
+from .rnn_cell import (BaseRNNCell, RNNCell, LSTMCell, GRUCell,
+                       FusedRNNCell, SequentialRNNCell, BidirectionalCell,
+                       DropoutCell, ResidualCell, RNNParams)
+from .io import BucketSentenceIter, encode_sentences
+
+__all__ = ["BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell", "FusedRNNCell",
+           "SequentialRNNCell", "BidirectionalCell", "DropoutCell",
+           "ResidualCell", "RNNParams", "BucketSentenceIter",
+           "encode_sentences"]

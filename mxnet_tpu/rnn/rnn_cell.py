@@ -1,0 +1,527 @@
+"""Symbolic RNN cells (reference: python/mxnet/rnn/rnn_cell.py).
+
+Each cell is a Symbol-graph factory: ``cell(inputs, states)`` appends one
+step, ``cell.unroll(T, inputs)`` builds the whole sequence.  TPU-native
+note: the unrolled graph hits CachedOp/simple_bind as ONE jitted XLA
+computation per bucket (SURVEY §5.7), so explicit unrolling costs nothing
+at run time; FusedRNNCell lowers to the single fused ``RNN`` op
+(lax.scan inside) when the whole sequence is wanted at once.
+
+Gate orders match the reference exactly (i,f,c,o for LSTM; r,z,o for GRU)
+so packed weights are interchangeable with fused-op parameters.
+"""
+from __future__ import annotations
+
+from typing import List, Optional, Sequence, Tuple
+
+from ..base import MXNetError
+
+__all__ = ["RNNParams", "BaseRNNCell", "RNNCell", "LSTMCell", "GRUCell",
+           "FusedRNNCell", "SequentialRNNCell", "BidirectionalCell",
+           "DropoutCell", "ResidualCell"]
+
+
+def _sym():
+    from .. import symbol
+    return symbol
+
+
+class RNNParams:
+    """Container for a cell's Symbol variables, keyed by name suffix."""
+
+    def __init__(self, prefix: str = ""):
+        self._prefix = prefix
+        self._params = {}
+
+    def get(self, name: str, **kwargs):
+        name = self._prefix + name
+        if name not in self._params:
+            self._params[name] = _sym().var(name, **kwargs)
+        return self._params[name]
+
+
+class BaseRNNCell:
+    """Abstract cell: one step of computation on Symbol inputs."""
+
+    def __init__(self, prefix: str = "", params: Optional[RNNParams] = None):
+        if params is None:
+            params = RNNParams(prefix)
+            self._own_params = True
+        else:
+            self._own_params = False
+        self._prefix = prefix
+        self._params = params
+        self._modified = False
+        self.reset()
+
+    def reset(self) -> None:
+        self._init_counter = -1
+        self._counter = -1
+
+    @property
+    def params(self) -> RNNParams:
+        self._own_params = False
+        return self._params
+
+    @property
+    def state_info(self) -> List[dict]:
+        raise NotImplementedError
+
+    @property
+    def _gate_names(self) -> Tuple[str, ...]:
+        return ()
+
+    def __call__(self, inputs, states):
+        raise NotImplementedError
+
+    # -- states ------------------------------------------------------------
+    def begin_state(self, func=None, **kwargs) -> List:
+        """Zero initial states.  With no batch reference available the
+        reference emits shape-(0,·) zeros resolved at bind; here each
+        state becomes a variable named ``{prefix}begin_state_N`` that
+        simple_bind treats as an auxiliary input (bind zeros), unless the
+        caller passes ``batch_ref`` (any (N, ·) Symbol) — then the state
+        is synthesized in-graph as broadcast zeros and needs no binding."""
+        assert not self._modified, \
+            "After applying modifier cells, call begin_state on the base"
+        batch_ref = kwargs.pop("batch_ref", None)
+        states = []
+        for info in self.state_info:
+            self._init_counter += 1
+            name = f"{self._prefix}begin_state_{self._init_counter}"
+            n_hidden = info["shape"][-1]
+            if batch_ref is not None:
+                sym = _sym()
+                col = sym.zeros_like(
+                    sym.slice_axis(batch_ref, axis=-1, begin=0, end=1))
+                state = sym.broadcast_to(col, shape=(0, n_hidden))
+            elif func is not None:
+                state = func(name=name, **info)
+            else:
+                state = _sym().var(name, shape=info["shape"])
+            states.append(state)
+        return states
+
+    # -- weights (fused-op interchange) ------------------------------------
+    def unpack_weights(self, args: dict) -> dict:
+        return dict(args)
+
+    def pack_weights(self, args: dict) -> dict:
+        return dict(args)
+
+    # -- unroll ------------------------------------------------------------
+    def _normalize_inputs(self, length: int, inputs, layout: str):
+        sym = _sym()
+        if isinstance(inputs, (list, tuple)):
+            if len(inputs) != length:
+                raise MXNetError(f"unroll: {len(inputs)} inputs for "
+                                 f"length {length}")
+            return list(inputs)
+        axis = layout.find("T")
+        if axis not in (0, 1):
+            raise MXNetError(f"unsupported layout {layout!r}")
+        split = sym.split(inputs, num_outputs=length, axis=axis,
+                          squeeze_axis=True)
+        if length == 1:
+            return [split]
+        return [split[i] for i in range(length)]
+
+    def unroll(self, length: int, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        """Unroll the cell ``length`` steps.
+
+        Returns (outputs, states): outputs is a list of per-step Symbols,
+        or one stacked Symbol when merge_outputs=True (stacked on the
+        layout's T axis)."""
+        self.reset()
+        seq = self._normalize_inputs(length, inputs, layout)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_ref=seq[0])
+        states = begin_state
+        outputs = []
+        for t in range(length):
+            out, states = self(seq[t], states)
+            outputs.append(out)
+        if merge_outputs:
+            axis = layout.find("T")
+            outputs = _sym().stack(*outputs, axis=axis)
+        return outputs, states
+
+
+class RNNCell(BaseRNNCell):
+    """Vanilla tanh/relu cell (reference RNNCell)."""
+
+    def __init__(self, num_hidden: int, activation: str = "tanh",
+                 prefix: str = "rnn_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._activation = activation
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("",)
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        sym = _sym()
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=self._num_hidden,
+                                 name=f"{name}i2h")
+        h2h = sym.FullyConnected(data=states[0], weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=self._num_hidden,
+                                 name=f"{name}h2h")
+        output = sym.Activation(i2h + h2h, act_type=self._activation,
+                                name=f"{name}out")
+        return output, [output]
+
+
+class LSTMCell(BaseRNNCell):
+    """LSTM (reference LSTMCell; gate order i, f, c, o)."""
+
+    def __init__(self, num_hidden: int, prefix: str = "lstm_", params=None,
+                 forget_bias: float = 1.0):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+        self._forget_bias = forget_bias
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"},
+                {"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_i", "_f", "_c", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        sym = _sym()
+        name = f"{self._prefix}t{self._counter}_"
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=4 * self._num_hidden,
+                                 name=f"{name}i2h")
+        h2h = sym.FullyConnected(data=states[0], weight=self._hW,
+                                 bias=self._hB,
+                                 num_hidden=4 * self._num_hidden,
+                                 name=f"{name}h2h")
+        gates = i2h + h2h
+        g = sym.split(gates, num_outputs=4, axis=1,
+                      name=f"{name}slice")
+        in_gate = sym.Activation(g[0], act_type="sigmoid")
+        forget_gate = sym.Activation(g[1] + self._forget_bias,
+                                     act_type="sigmoid")
+        in_transform = sym.Activation(g[2], act_type="tanh")
+        out_gate = sym.Activation(g[3], act_type="sigmoid")
+        next_c = forget_gate * states[1] + in_gate * in_transform
+        next_h = out_gate * sym.Activation(next_c, act_type="tanh")
+        return next_h, [next_h, next_c]
+
+
+class GRUCell(BaseRNNCell):
+    """GRU (reference GRUCell; gate order r, z, o)."""
+
+    def __init__(self, num_hidden: int, prefix: str = "gru_", params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._iW = self.params.get("i2h_weight")
+        self._iB = self.params.get("i2h_bias")
+        self._hW = self.params.get("h2h_weight")
+        self._hB = self.params.get("h2h_bias")
+
+    @property
+    def state_info(self):
+        return [{"shape": (0, self._num_hidden), "__layout__": "NC"}]
+
+    @property
+    def _gate_names(self):
+        return ("_r", "_z", "_o")
+
+    def __call__(self, inputs, states):
+        self._counter += 1
+        sym = _sym()
+        name = f"{self._prefix}t{self._counter}_"
+        prev = states[0]
+        i2h = sym.FullyConnected(data=inputs, weight=self._iW, bias=self._iB,
+                                 num_hidden=3 * self._num_hidden,
+                                 name=f"{name}i2h")
+        h2h = sym.FullyConnected(data=prev, weight=self._hW, bias=self._hB,
+                                 num_hidden=3 * self._num_hidden,
+                                 name=f"{name}h2h")
+        ii = sym.split(i2h, num_outputs=3, axis=1)
+        hh = sym.split(h2h, num_outputs=3, axis=1)
+        reset = sym.Activation(ii[0] + hh[0], act_type="sigmoid")
+        update = sym.Activation(ii[1] + hh[1], act_type="sigmoid")
+        next_h_tmp = sym.Activation(ii[2] + reset * hh[2], act_type="tanh")
+        next_h = (1.0 - update) * next_h_tmp + update * prev
+        return next_h, [next_h]
+
+
+class FusedRNNCell(BaseRNNCell):
+    """Whole-sequence fused cell over the single ``RNN`` op — the XLA
+    lax.scan lowering (reference FusedRNNCell over the cuDNN kernel)."""
+
+    def __init__(self, num_hidden: int, num_layers: int = 1,
+                 mode: str = "lstm", bidirectional: bool = False,
+                 dropout: float = 0.0, prefix: Optional[str] = None,
+                 params=None, forget_bias: float = 1.0,
+                 get_next_state: bool = False, input_size: int = 0):
+        if prefix is None:
+            prefix = f"{mode}_"
+        super().__init__(prefix=prefix, params=params)
+        self._num_hidden = num_hidden
+        self._num_layers = num_layers
+        self._mode = mode
+        self._bidirectional = bidirectional
+        self._dropout = dropout
+        self._get_next_state = get_next_state
+        self._input_size = input_size
+        # shape inference here is forward-only (eval_shape), so the packed
+        # parameter length cannot be derived backward from the data shape;
+        # with input_size given, the variable carries its exact shape and
+        # simple_bind needs nothing else
+        kw = {}
+        if input_size:
+            kw["shape"] = (self._param_count(input_size),)
+        self._param = self.params.get("parameters", **kw)
+
+    def _param_count(self, input_size: int) -> int:
+        ngates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[
+            self._mode]
+        ndir = 2 if self._bidirectional else 1
+        H = self._num_hidden
+        total = 0
+        layer_in = input_size
+        for _ in range(self._num_layers):
+            for _d in range(ndir):
+                total += ngates * H * layer_in + ngates * H * H \
+                    + 2 * ngates * H
+            layer_in = H * ndir
+        return total
+
+    @property
+    def state_info(self):
+        d = 2 if self._bidirectional else 1
+        info = [{"shape": (d * self._num_layers, 0, self._num_hidden),
+                 "__layout__": "LNC"}]
+        if self._mode == "lstm":
+            info.append({"shape": (d * self._num_layers, 0,
+                                   self._num_hidden),
+                         "__layout__": "LNC"})
+        return info
+
+    def __call__(self, inputs, states):
+        raise MXNetError("FusedRNNCell cannot step; use unroll()")
+
+    def unroll(self, length: int, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        sym = _sym()
+        if isinstance(inputs, (list, tuple)):
+            axis = layout.find("T")
+            inputs = sym.stack(*inputs, axis=axis)
+        if layout == "NTC":      # RNN op wants TNC
+            inputs = sym.swapaxes(inputs, dim1=0, dim2=1)
+        kwargs = {}
+        if begin_state is None:
+            # in-graph zero states (L*D, N, H), synthesized from the data
+            # symbol so simple_bind needs no extra shapes
+            ndir = 2 if self._bidirectional else 1
+            zcol = sym.zeros_like(sym.slice_axis(
+                sym.slice_axis(inputs, axis=0, begin=0, end=1),
+                axis=2, begin=0, end=1))               # (1, N, 1)
+            zstate = sym.broadcast_to(
+                zcol, shape=(ndir * self._num_layers, 0,
+                             self._num_hidden))
+            begin_state = [zstate] + ([zstate] if self._mode == "lstm"
+                                      else [])
+        kwargs["state"] = begin_state[0]
+        if self._mode == "lstm":
+            kwargs["state_cell"] = begin_state[1]
+        out = sym.RNN(data=inputs, parameters=self._param,
+                      state_size=self._num_hidden,
+                      num_layers=self._num_layers, mode=self._mode,
+                      bidirectional=self._bidirectional, p=self._dropout,
+                      state_outputs=True,
+                      name=f"{self._prefix}rnn", **kwargs)
+        seq = out[0]
+        if layout == "NTC":
+            seq = _sym().swapaxes(seq, dim1=0, dim2=1)
+        states = [out[1]] + ([out[2]] if self._mode == "lstm" else [])
+        if merge_outputs is False:
+            sym = _sym()
+            t_axis = layout.find("T")
+            split = sym.split(seq, num_outputs=length, axis=t_axis,
+                              squeeze_axis=True)
+            seq = [split] if length == 1 else \
+                [split[i] for i in range(length)]
+        if not self._get_next_state:
+            states = []
+        return seq, states
+
+    def unfuse(self) -> "SequentialRNNCell":
+        """Equivalent stack of unfused cells (reference unfuse)."""
+        stack = SequentialRNNCell()
+        get = {"rnn_relu": lambda p: RNNCell(self._num_hidden,
+                                             activation="relu", prefix=p),
+               "rnn_tanh": lambda p: RNNCell(self._num_hidden,
+                                             activation="tanh", prefix=p),
+               "lstm": lambda p: LSTMCell(self._num_hidden, prefix=p),
+               "gru": lambda p: GRUCell(self._num_hidden, prefix=p)}[
+            self._mode]
+        for i in range(self._num_layers):
+            if self._bidirectional:
+                stack.add(BidirectionalCell(
+                    get(f"{self._prefix}l{i}_"),
+                    get(f"{self._prefix}r{i}_"),
+                    output_prefix=f"{self._prefix}bi_l{i}_"))
+            else:
+                stack.add(get(f"{self._prefix}l{i}_"))
+            if self._dropout > 0 and i != self._num_layers - 1:
+                stack.add(DropoutCell(self._dropout,
+                                      prefix=f"{self._prefix}_dropout{i}_"))
+        return stack
+
+
+class SequentialRNNCell(BaseRNNCell):
+    """Stack of cells applied in order each step."""
+
+    def __init__(self, params=None):
+        super().__init__(prefix="", params=params)
+        self._cells: List[BaseRNNCell] = []
+
+    def add(self, cell: BaseRNNCell) -> None:
+        self._cells.append(cell)
+
+    @property
+    def state_info(self):
+        return [i for c in self._cells for i in c.state_info]
+
+    def begin_state(self, func=None, **kwargs):
+        return [s for c in self._cells
+                for s in c.begin_state(func=func, **kwargs)]
+
+    def __call__(self, inputs, states):
+        next_states = []
+        p = 0
+        for cell in self._cells:
+            n = len(cell.state_info)
+            inputs, st = cell(inputs, states[p:p + n])
+            next_states.extend(st)
+            p += n
+        return inputs, next_states
+
+    def unpack_weights(self, args):
+        for c in self._cells:
+            args = c.unpack_weights(args)
+        return args
+
+    def pack_weights(self, args):
+        for c in self._cells:
+            args = c.pack_weights(args)
+        return args
+
+
+class DropoutCell(BaseRNNCell):
+    """Dropout on the step output (reference DropoutCell)."""
+
+    def __init__(self, dropout: float, prefix: str = "dropout_",
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._dropout = dropout
+
+    @property
+    def state_info(self):
+        return []
+
+    def __call__(self, inputs, states):
+        if self._dropout > 0:
+            inputs = _sym().Dropout(inputs, p=self._dropout)
+        return inputs, states
+
+
+class ResidualCell(BaseRNNCell):
+    """Adds the step input to the base cell's output (reference
+    ResidualCell modifier)."""
+
+    def __init__(self, base_cell: BaseRNNCell):
+        super().__init__(prefix=base_cell._prefix, params=base_cell._params)
+        self.base_cell = base_cell
+        base_cell._modified = True
+
+    @property
+    def state_info(self):
+        return self.base_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        self.base_cell._modified = False
+        st = self.base_cell.begin_state(func=func, **kwargs)
+        self.base_cell._modified = True
+        return st
+
+    def __call__(self, inputs, states):
+        out, states = self.base_cell(inputs, states)
+        return out + inputs, states
+
+
+class BidirectionalCell(BaseRNNCell):
+    """Runs one cell forward and one backward over the sequence; per-step
+    outputs are concatenated (reference BidirectionalCell — unroll only)."""
+
+    def __init__(self, l_cell: BaseRNNCell, r_cell: BaseRNNCell,
+                 params=None, output_prefix: str = "bi_"):
+        super().__init__(prefix="", params=params)
+        self._l_cell = l_cell
+        self._r_cell = r_cell
+        self._output_prefix = output_prefix
+
+    @property
+    def state_info(self):
+        return self._l_cell.state_info + self._r_cell.state_info
+
+    def begin_state(self, func=None, **kwargs):
+        return (self._l_cell.begin_state(func=func, **kwargs) +
+                self._r_cell.begin_state(func=func, **kwargs))
+
+    def __call__(self, inputs, states):
+        raise MXNetError("BidirectionalCell cannot step; use unroll()")
+
+    def unroll(self, length: int, inputs, begin_state=None, layout="NTC",
+               merge_outputs=None):
+        self.reset()
+        sym = _sym()
+        seq = self._normalize_inputs(length, inputs, layout)
+        if begin_state is None:
+            begin_state = self.begin_state(batch_ref=seq[0])
+        nl = len(self._l_cell.state_info)
+        l_out, l_states = self._unroll_one(self._l_cell, seq,
+                                           begin_state[:nl])
+        r_out, r_states = self._unroll_one(self._r_cell, list(reversed(seq)),
+                                           begin_state[nl:])
+        r_out = list(reversed(r_out))
+        outputs = [sym.concat(lo, ro, dim=1,
+                              name=f"{self._output_prefix}t{t}")
+                   for t, (lo, ro) in enumerate(zip(l_out, r_out))]
+        if merge_outputs:
+            outputs = sym.stack(*outputs, axis=layout.find("T"))
+        return outputs, l_states + r_states
+
+    @staticmethod
+    def _unroll_one(cell, seq, states):
+        outs = []
+        for x in seq:
+            o, states = cell(x, states)
+            outs.append(o)
+        return outs, states

@@ -16,7 +16,11 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from mxnet_tpu.base import force_cpu_mesh  # noqa: E402
 
-force_cpu_mesh(8)
+# MXNET_TEST_ON_TPU=1 leaves the axon/TPU backend live so the TPU-gated
+# files (test_kernels_tpu.py) can actually reach the chip; default is the
+# virtual CPU mesh
+if os.environ.get("MXNET_TEST_ON_TPU", "") != "1":
+    force_cpu_mesh(8)
 
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402

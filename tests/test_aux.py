@@ -157,3 +157,39 @@ def test_viz_print_summary_and_dot():
     assert "FullyConnected" in text and "(32, 64)" in text
     dot = mx.viz.plot_network(out, shape={"data": (32, 128)})
     assert dot.startswith("digraph") and "->" in dot
+
+
+def test_monitor_collects_stats():
+    """mx.monitor.Monitor (reference python/mxnet/monitor.py): engine-tap
+    stat collection honoring interval and pattern."""
+    import numpy as np
+    import mxnet_tpu as mx
+
+    mon = mx.Monitor(interval=2, pattern=".*FullyConnected.*|.*relu.*")
+    mon.install()
+    try:
+        x = mx.nd.array(np.ones((2, 3), np.float32))
+        w = mx.nd.array(np.ones((4, 3), np.float32))
+
+        mon.tic()                       # step 0: active
+        mx.nd.FullyConnected(x, w, num_hidden=4, no_bias=True)
+        mx.nd.relu(x)
+        mx.nd.sigmoid(x)                # filtered out by pattern
+        res = mon.toc()
+        names = [n for _, n, _ in res]
+        assert any("FullyConnected" in n for n in names)
+        assert any("relu" in n for n in names)
+        assert not any("sigmoid" in n for n in names)
+        # norm/sqrt(size) of the FC output (all threes): == 3.0
+        fc_stat = [s for _, n, s in res if "FullyConnected" in n][0]
+        assert abs(float(fc_stat) - 3.0) < 1e-5
+
+        mon.tic()                       # step 1: inactive (interval=2)
+        mx.nd.relu(x)
+        assert mon.toc() == []
+
+        mon.tic()                       # step 2: active again
+        mx.nd.relu(x)
+        assert len(mon.toc()) == 1
+    finally:
+        mon.uninstall()

@@ -78,19 +78,23 @@ def test_multi_sgd_mom_mosaic_matches_xla_update():
 
 def test_trainer_update_multi_runs_kernel_on_tpu():
     """The imperative Trainer's fused group apply goes through the
-    Pallas kernel (optimizer.py update_multi) — drive it on-device."""
+    Pallas kernel (optimizer.py update_multi) — drive it on-device.
+    Params and data are placed on mx.tpu(0): the kernel selects Mosaic
+    from the DATA's device, so host-resident params would silently fall
+    back to interpret mode and prove nothing."""
     import mxnet_tpu as mx
     from mxnet_tpu import autograd, gluon
 
+    ctx = mx.tpu(0)
     net = gluon.nn.HybridSequential()
     with net.name_scope():
         net.add(gluon.nn.Dense(32, activation="relu"))
         net.add(gluon.nn.Dense(8))
-    net.initialize()
+    net.initialize(ctx=ctx)
     tr = gluon.Trainer(net.collect_params(), "sgd",
                        {"learning_rate": 0.1, "momentum": 0.9})
-    x = mx.nd.array(np.random.randn(16, 20).astype(np.float32))
-    y = mx.nd.array(np.random.randint(0, 8, 16))
+    x = mx.nd.array(np.random.randn(16, 20).astype(np.float32), ctx=ctx)
+    y = mx.nd.array(np.random.randint(0, 8, 16), ctx=ctx)
     loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
     l0 = None
     for _ in range(10):

@@ -1,0 +1,248 @@
+"""Legacy mx.rnn cell API tests (reference model:
+tests/python/unittest/test_rnn.py) — symbolic cells vs numpy recurrences,
+unroll layouts, modifier/stacked/bidirectional composition, and
+BucketSentenceIter feeding a BucketingModule.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+
+
+def _sigmoid(x):
+    return 1.0 / (1.0 + np.exp(-x))
+
+
+def _bind_forward(out_sym, feed):
+    shapes = {k: v.shape for k, v in feed.items()}
+    exe = out_sym.simple_bind(ctx=mx.cpu(), **shapes)
+    for k, v in feed.items():
+        exe.arg_dict[k][:] = mx.nd.array(v)
+    # any remaining free args (weights) are filled by the caller
+    return exe
+
+
+def test_lstm_cell_matches_numpy():
+    T, N, E, H = 3, 4, 5, 6
+    rs = np.random.RandomState(0)
+    x = rs.randn(N, T, E).astype(np.float32)
+    iW = rs.randn(4 * H, E).astype(np.float32) * 0.5
+    iB = rs.randn(4 * H).astype(np.float32) * 0.1
+    hW = rs.randn(4 * H, H).astype(np.float32) * 0.5
+    hB = rs.randn(4 * H).astype(np.float32) * 0.1
+
+    cell = rnn.LSTMCell(H, prefix="l_")
+    outs, states = cell.unroll(T, mx.sym.var("data"), layout="NTC",
+                               merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    exe.arg_dict["l_i2h_weight"][:] = mx.nd.array(iW)
+    exe.arg_dict["l_i2h_bias"][:] = mx.nd.array(iB)
+    exe.arg_dict["l_h2h_weight"][:] = mx.nd.array(hW)
+    exe.arg_dict["l_h2h_bias"][:] = mx.nd.array(hB)
+    got = exe.forward(is_train=False)[0].asnumpy()
+
+    # numpy recurrence, reference gate order i,f,c,o with forget_bias=1
+    h = np.zeros((N, H), np.float32)
+    c = np.zeros((N, H), np.float32)
+    ref = []
+    for t in range(T):
+        g = x[:, t] @ iW.T + iB + h @ hW.T + hB
+        i, f, cc, o = np.split(g, 4, axis=1)
+        i = _sigmoid(i)
+        f = _sigmoid(f + 1.0)
+        cc = np.tanh(cc)
+        o = _sigmoid(o)
+        c = f * c + i * cc
+        h = o * np.tanh(c)
+        ref.append(h)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_gru_cell_matches_numpy():
+    T, N, E, H = 3, 2, 4, 5
+    rs = np.random.RandomState(1)
+    x = rs.randn(N, T, E).astype(np.float32)
+    iW = rs.randn(3 * H, E).astype(np.float32) * 0.5
+    iB = rs.randn(3 * H).astype(np.float32) * 0.1
+    hW = rs.randn(3 * H, H).astype(np.float32) * 0.5
+    hB = rs.randn(3 * H).astype(np.float32) * 0.1
+
+    cell = rnn.GRUCell(H, prefix="g_")
+    outs, _ = cell.unroll(T, mx.sym.var("data"), layout="NTC",
+                          merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    exe.arg_dict["g_i2h_weight"][:] = mx.nd.array(iW)
+    exe.arg_dict["g_i2h_bias"][:] = mx.nd.array(iB)
+    exe.arg_dict["g_h2h_weight"][:] = mx.nd.array(hW)
+    exe.arg_dict["g_h2h_bias"][:] = mx.nd.array(hB)
+    got = exe.forward(is_train=False)[0].asnumpy()
+
+    h = np.zeros((N, H), np.float32)
+    ref = []
+    for t in range(T):
+        gi = x[:, t] @ iW.T + iB
+        gh = h @ hW.T + hB
+        ir, iz, io = np.split(gi, 3, axis=1)
+        hr, hz, ho = np.split(gh, 3, axis=1)
+        r = _sigmoid(ir + hr)
+        z = _sigmoid(iz + hz)
+        o = np.tanh(io + r * ho)
+        h = (1 - z) * o + z * h
+        ref.append(h)
+    ref = np.stack(ref, axis=1)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+
+def test_stack_residual_dropout_bidirectional_shapes():
+    T, N, E, H = 4, 3, 6, 6
+    stack = rnn.SequentialRNNCell()
+    stack.add(rnn.LSTMCell(H, prefix="s0_"))
+    stack.add(rnn.ResidualCell(rnn.LSTMCell(H, prefix="s1_")))
+    stack.add(rnn.DropoutCell(0.0))
+    outs, states = stack.unroll(T, mx.sym.var("data"), layout="NTC",
+                                merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
+    exe.arg_dict["data"][:] = mx.nd.random.normal(0, 1, shape=(N, T, E))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (N, T, H)
+    # 2 LSTM cells -> 4 state symbols
+    assert len(states) == 4
+
+    bi = rnn.BidirectionalCell(rnn.LSTMCell(H, prefix="bl_"),
+                               rnn.LSTMCell(H, prefix="br_"))
+    outs, _ = bi.unroll(T, mx.sym.var("data"), layout="NTC",
+                        merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (N, T, 2 * H)
+
+
+def test_rnn_cell_relu_and_unroll_list_inputs():
+    N, E, H = 2, 3, 4
+    cell = rnn.RNNCell(H, activation="relu", prefix="r_")
+    xs = [mx.sym.var(f"x{t}") for t in range(3)]
+    outs, _ = cell.unroll(3, xs, merge_outputs=False)
+    assert len(outs) == 3
+    exe = outs[-1].simple_bind(ctx=mx.cpu(),
+                               **{f"x{t}": (N, E) for t in range(3)})
+    for k, v in exe.arg_dict.items():
+        v[:] = mx.nd.random.normal(0, 0.5, shape=v.shape)
+    assert exe.forward(is_train=False)[0].shape == (N, H)
+
+
+def test_fused_rnn_cell_unroll_and_unfuse():
+    T, N, E, H = 5, 2, 4, 8
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm",
+                             get_next_state=True, prefix="f_",
+                             input_size=E)
+    outs, states = fused.unroll(T, mx.sym.var("data"), layout="NTC",
+                                merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
+    exe.arg_dict["data"][:] = mx.nd.random.normal(0, 1, shape=(N, T, E))
+    out = exe.forward(is_train=False)[0]
+    assert out.shape == (N, T, H)
+    assert len(states) == 2
+
+    stack = fused.unfuse()
+    assert len(stack._cells) == 2
+    outs2, _ = stack.unroll(T, mx.sym.var("data"), layout="NTC",
+                            merge_outputs=True)
+    exe2 = outs2.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    for k, v in exe2.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
+    assert exe2.forward(is_train=False)[0].shape == (N, T, H)
+
+
+def test_encode_sentences_and_bucket_iter():
+    sents = [["the", "cat", "sat"], ["a", "dog", "ran", "far"],
+             ["hi"], ["the", "dog", "sat"], ["a", "cat", "ran", "home"],
+             ["go"], ["the", "cat", "ran"], ["a", "dog", "sat", "down"]]
+    coded, vocab = rnn.encode_sentences(sents, invalid_label=0,
+                                        start_label=1)
+    assert len(coded) == len(sents)
+    assert all(all(c > 0 for c in s) for s in coded)
+    # known vocab round trip
+    coded2, _ = rnn.encode_sentences([["cat", "sat"]], vocab=vocab)
+    assert coded2[0] == [vocab["cat"], vocab["sat"]]
+    with pytest.raises(Exception):
+        rnn.encode_sentences([["UNSEEN"]], vocab=vocab)
+
+    it = rnn.BucketSentenceIter(coded, batch_size=2, buckets=[3, 4],
+                                invalid_label=0)
+    seen = []
+    for batch in it:
+        assert batch.data[0].shape[0] == 2
+        assert batch.bucket_key in (3, 4)
+        assert batch.data[0].shape[1] == batch.bucket_key
+        seen.append(batch.bucket_key)
+    assert set(seen) == {3, 4}
+
+
+def test_bucketing_module_with_rnn_cells_trains():
+    """Full legacy stack: BucketSentenceIter -> sym_gen with LSTMCell
+    unroll -> BucketingModule.fit (SURVEY §5.7 long-context path)."""
+    rs = np.random.RandomState(7)
+    vocab_size, emb, H = 16, 8, 12
+    # toy language: next token = (token + 1) % vocab_size
+    sents = []
+    for _ in range(60):
+        L = rs.choice([3, 5])
+        start = rs.randint(1, vocab_size - 1)
+        sents.append([(start + i) % (vocab_size - 1) + 1
+                      for i in range(L)])
+    it = rnn.BucketSentenceIter(sents, batch_size=4, buckets=[3, 5],
+                                invalid_label=0)
+
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=emb, name="embed")
+        cell = rnn.LSTMCell(H, prefix="lstm_")
+        outputs, _ = cell.unroll(seq_len, embed, layout="NTC",
+                                 merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, H))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="cls")
+        label = mx.sym.reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+
+    mod = mx.mod.BucketingModule(sym_gen,
+                                 default_bucket_key=it.default_bucket_key,
+                                 context=mx.cpu())
+    metric = mx.metric.Perplexity(invalid_label=0) \
+        if hasattr(mx.metric, "Perplexity") else "acc"
+    mod.fit(it, num_epoch=15, eval_metric=metric, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05})
+    # the toy grammar is deterministic: scoring perplexity must be low
+    score = mod.score(it, mx.metric.Perplexity(invalid_label=0))
+    assert dict(score)["perplexity"] < 4.0, score
+
+
+def test_fused_rnn_cell_unmerged_outputs():
+    T, N, E, H = 4, 2, 3, 5
+    fused = rnn.FusedRNNCell(H, num_layers=1, mode="gru", prefix="fu_",
+                             input_size=E)
+    outs, _ = fused.unroll(T, mx.sym.var("data"), layout="NTC",
+                           merge_outputs=False)
+    assert isinstance(outs, list) and len(outs) == T
+    exe = outs[-1].simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
+    assert exe.forward(is_train=False)[0].shape == (N, H)
