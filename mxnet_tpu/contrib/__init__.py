@@ -5,3 +5,4 @@ post-training), onnx (import/export).
 from . import amp  # noqa: F401
 from . import quantization  # noqa: F401
 from . import onnx  # noqa: F401
+from . import text  # noqa: F401

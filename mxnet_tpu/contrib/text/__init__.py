@@ -1,0 +1,7 @@
+"""mx.contrib.text — text token indexing and embeddings (reference:
+python/mxnet/contrib/text/{utils,vocab,embedding}.py, SURVEY.md §2.5 misc
+frontend)."""
+from . import utils  # noqa: F401
+from . import vocab  # noqa: F401
+from . import embedding  # noqa: F401
+from .vocab import Vocabulary  # noqa: F401

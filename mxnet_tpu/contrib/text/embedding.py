@@ -1,0 +1,189 @@
+"""Token embeddings (reference: contrib/text/embedding.py).
+
+File-based: ``CustomEmbedding`` parses 'token v1 v2 ...' text files, the
+registered ``glove``/``fasttext`` classes read the same format from a
+local ``pretrained_file_path`` — this environment has zero egress, so the
+reference's URL-download path is replaced by an explicit local-file
+contract (raised as an error with guidance when the file is absent).
+"""
+from __future__ import annotations
+
+import io
+import os
+from typing import Callable, Dict, List, Optional, Sequence, Union
+
+import numpy as _np
+
+from ...base import MXNetError
+from .vocab import Vocabulary
+
+__all__ = ["register", "create", "list_embedding_names", "TokenEmbedding",
+           "CustomEmbedding", "CompositeEmbedding", "GloVe", "FastText"]
+
+_registry: Dict[str, type] = {}
+
+
+def register(klass):
+    _registry[klass.__name__.lower()] = klass
+    return klass
+
+
+def create(embedding_name: str, **kwargs) -> "TokenEmbedding":
+    name = embedding_name.lower()
+    if name not in _registry:
+        raise MXNetError(f"unknown embedding {embedding_name!r}; "
+                         f"registered: {sorted(_registry)}")
+    return _registry[name](**kwargs)
+
+
+def list_embedding_names() -> List[str]:
+    return sorted(_registry)
+
+
+class TokenEmbedding:
+    """Token → vector map with unknown-token fallback (reference
+    _TokenEmbedding)."""
+
+    def __init__(self, unknown_token: str = "<unk>",
+                 init_unknown_vec: Callable = _np.zeros):
+        self._unknown_token = unknown_token
+        self._init_unknown_vec = init_unknown_vec
+        self._idx_to_token: List[str] = [unknown_token]
+        self._token_to_idx: Dict[str, int] = {unknown_token: 0}
+        self._idx_to_vec: Optional[_np.ndarray] = None
+
+    # -- loading -----------------------------------------------------------
+    def _load_embedding_txt(self, path: str, elem_delim: str = " ",
+                            encoding: str = "utf8") -> None:
+        vecs: List[_np.ndarray] = []
+        dim = None
+        with io.open(path, "r", encoding=encoding) as f:
+            for line_num, line in enumerate(f):
+                parts = line.rstrip().split(elem_delim)
+                if line_num == 0 and len(parts) == 2 and \
+                        parts[0].isdigit() and parts[1].isdigit():
+                    continue          # fastText header line: "count dim"
+                token, elems = parts[0], parts[1:]
+                if not elems:
+                    continue
+                if dim is None:
+                    dim = len(elems)
+                elif len(elems) != dim:
+                    raise MXNetError(
+                        f"{path}:{line_num}: inconsistent vector length")
+                if token in self._token_to_idx:
+                    continue
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                vecs.append(_np.asarray(elems, dtype=_np.float32))
+        if dim is None:
+            raise MXNetError(f"no vectors found in {path}")
+        unk = self._init_unknown_vec((dim,)).astype(_np.float32)
+        self._idx_to_vec = _np.vstack([unk] + vecs)
+
+    # -- access ------------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def vec_len(self) -> int:
+        return 0 if self._idx_to_vec is None else self._idx_to_vec.shape[1]
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self):
+        return self._idx_to_token
+
+    @property
+    def idx_to_vec(self):
+        from ... import ndarray as nd
+        return nd.array(self._idx_to_vec)
+
+    def get_vecs_by_tokens(self, tokens: Union[str, Sequence[str]],
+                           lower_case_backup: bool = False):
+        from ... import ndarray as nd
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else list(tokens)
+        rows = []
+        for t in toks:
+            i = self._token_to_idx.get(t)
+            if i is None and lower_case_backup:
+                i = self._token_to_idx.get(t.lower())
+            rows.append(self._idx_to_vec[i if i is not None else 0])
+        out = _np.stack(rows)
+        return nd.array(out[0]) if single else nd.array(out)
+
+    def update_token_vectors(self, tokens: Union[str, Sequence[str]],
+                             new_vectors) -> None:
+        toks = [tokens] if isinstance(tokens, str) else list(tokens)
+        vecs = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
+            else _np.asarray(new_vectors)
+        vecs = vecs.reshape(len(toks), -1)
+        for t, v in zip(toks, vecs):
+            if t not in self._token_to_idx:
+                raise MXNetError(f"token {t!r} is not in the embedding")
+            self._idx_to_vec[self._token_to_idx[t]] = v
+
+
+class CustomEmbedding(TokenEmbedding):
+    """'token v1 v2 …' text-file embedding (reference CustomEmbedding)."""
+
+    def __init__(self, pretrained_file_path: str, elem_delim: str = " ",
+                 encoding: str = "utf8", **kwargs):
+        super().__init__(**kwargs)
+        self._load_embedding_txt(pretrained_file_path, elem_delim, encoding)
+
+
+class _PretrainedFileEmbedding(TokenEmbedding):
+    def __init__(self, pretrained_file_name: str = "",
+                 embedding_root: str = "", pretrained_file_path: str = "",
+                 **kwargs):
+        super().__init__(**kwargs)
+        path = pretrained_file_path or (
+            os.path.join(embedding_root, pretrained_file_name)
+            if pretrained_file_name else "")
+        if not path or not os.path.exists(path):
+            raise MXNetError(
+                f"{type(self).__name__}: pretrained file not found at "
+                f"{path!r}. This environment cannot download embeddings; "
+                "pass pretrained_file_path= pointing at a local "
+                "'token v1 v2 ...' text file.")
+        self._load_embedding_txt(path)
+
+
+@register
+class GloVe(_PretrainedFileEmbedding):
+    """GloVe vectors from a local file (reference GloVe; download replaced
+    by the local-file contract)."""
+
+
+@register
+class FastText(_PretrainedFileEmbedding):
+    """fastText vectors from a local .vec file (header line skipped)."""
+
+
+class CompositeEmbedding(TokenEmbedding):
+    """Concatenate several embeddings, indexed by one Vocabulary
+    (reference CompositeEmbedding)."""
+
+    def __init__(self, vocabulary: Vocabulary,
+                 token_embeddings: Union[TokenEmbedding,
+                                         Sequence[TokenEmbedding]]):
+        super().__init__(unknown_token=vocabulary.unknown_token)
+        if isinstance(token_embeddings, TokenEmbedding):
+            token_embeddings = [token_embeddings]
+        self._vocab = vocabulary
+        self._idx_to_token = list(vocabulary.idx_to_token)
+        self._token_to_idx = dict(vocabulary.token_to_idx)
+        blocks = []
+        for emb in token_embeddings:
+            vecs = emb.get_vecs_by_tokens(self._idx_to_token).asnumpy()
+            blocks.append(vecs)
+        self._idx_to_vec = _np.concatenate(blocks, axis=1)
+
+    @property
+    def vocabulary(self) -> Vocabulary:
+        return self._vocab

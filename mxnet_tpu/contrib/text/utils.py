@@ -1,0 +1,25 @@
+"""Token counting helpers (reference: contrib/text/utils.py)."""
+from __future__ import annotations
+
+import collections
+import re
+from typing import Optional
+
+__all__ = ["count_tokens_from_str"]
+
+
+def count_tokens_from_str(source_str: str, token_delim: str = " ",
+                          seq_delim: str = "\n", to_lower: bool = False,
+                          counter_to_update: Optional[
+                              collections.Counter] = None
+                          ) -> collections.Counter:
+    """Count all tokens in ``source_str``, splitting on ``token_delim``
+    and ``seq_delim`` (reference count_tokens_from_str semantics)."""
+    source_str = re.sub(f"({re.escape(token_delim)})|"
+                        f"({re.escape(seq_delim)})", " ", source_str)
+    if to_lower:
+        source_str = source_str.lower()
+    counter = counter_to_update if counter_to_update is not None \
+        else collections.Counter()
+    counter.update(source_str.split())
+    return counter

@@ -1,0 +1,86 @@
+"""Vocabulary (reference: contrib/text/vocab.py) — frequency-ordered
+token↔index maps with unknown/reserved token handling."""
+from __future__ import annotations
+
+import collections
+from typing import List, Optional, Sequence, Union
+
+from ...base import MXNetError
+
+__all__ = ["Vocabulary"]
+
+
+class Vocabulary:
+    """Indexes tokens by decreasing frequency (ties broken
+    lexicographically, the reference's ordering), after the unknown token
+    (index 0) and any reserved tokens."""
+
+    def __init__(self, counter: Optional[collections.Counter] = None,
+                 most_freq_count: Optional[int] = None, min_freq: int = 1,
+                 unknown_token: str = "<unk>",
+                 reserved_tokens: Optional[Sequence[str]] = None):
+        if min_freq < 1:
+            raise MXNetError("min_freq must be >= 1")
+        if reserved_tokens is not None:
+            if len(set(reserved_tokens)) != len(reserved_tokens) or \
+                    unknown_token in reserved_tokens:
+                raise MXNetError("reserved_tokens must be unique and must "
+                                 "not contain unknown_token")
+        self._unknown_token = unknown_token
+        self._reserved_tokens = list(reserved_tokens) if reserved_tokens \
+            else None
+        self._idx_to_token = [unknown_token] + (list(reserved_tokens)
+                                                if reserved_tokens else [])
+        self._token_to_idx = {t: i for i, t in
+                              enumerate(self._idx_to_token)}
+        if counter is not None:
+            self._index_counter(counter, most_freq_count, min_freq)
+
+    def _index_counter(self, counter, most_freq_count, min_freq):
+        pairs = sorted(counter.items(), key=lambda kv: (-kv[1], kv[0]))
+        taken = 0
+        for token, freq in pairs:
+            if freq < min_freq:
+                break
+            if most_freq_count is not None and taken >= most_freq_count:
+                break
+            if token not in self._token_to_idx:
+                self._token_to_idx[token] = len(self._idx_to_token)
+                self._idx_to_token.append(token)
+                taken += 1
+
+    # -- protocol ----------------------------------------------------------
+    def __len__(self) -> int:
+        return len(self._idx_to_token)
+
+    @property
+    def token_to_idx(self):
+        return self._token_to_idx
+
+    @property
+    def idx_to_token(self) -> List[str]:
+        return self._idx_to_token
+
+    @property
+    def unknown_token(self) -> str:
+        return self._unknown_token
+
+    @property
+    def reserved_tokens(self):
+        return self._reserved_tokens
+
+    def to_indices(self, tokens: Union[str, Sequence[str]]):
+        single = isinstance(tokens, str)
+        toks = [tokens] if single else tokens
+        idx = [self._token_to_idx.get(t, 0) for t in toks]
+        return idx[0] if single else idx
+
+    def to_tokens(self, indices: Union[int, Sequence[int]]):
+        single = isinstance(indices, int)
+        idxs = [indices] if single else indices
+        toks = []
+        for i in idxs:
+            if not 0 <= i < len(self._idx_to_token):
+                raise MXNetError(f"token index {i} out of range")
+            toks.append(self._idx_to_token[i])
+        return toks[0] if single else toks

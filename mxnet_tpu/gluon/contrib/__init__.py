@@ -3,3 +3,4 @@ experimental-layer namespace; HybridConcurrent/Identity live in core nn
 here but are re-exported under the reference's import path."""
 from . import nn  # noqa: F401
 from . import cnn  # noqa: F401
+from . import rnn  # noqa: F401

@@ -298,3 +298,276 @@ class ImageIter(DataIter):
             [nd_array(_np.asarray(labels, dtype=_np.float32), ctx=cpu())],
             provide_data=self.provide_data,
             provide_label=self.provide_label)
+
+
+# ---------------------------------------------------------------------------
+# Detection augmentation (reference: python/mxnet/image/detection.py)
+# ---------------------------------------------------------------------------
+# Labels ride with the image through every augmenter as an (N, 5+) float
+# array [cls, x1, y1, x2, y2, ...] with corner coords normalized to [0,1];
+# geometric augmenters transform the boxes, photometric ones borrow the
+# plain image augmenters unchanged.
+
+class DetAugmenter:
+    """Base detection augmenter: ``(src, label) -> (src, label)``."""
+
+    def __call__(self, src, label):
+        raise NotImplementedError
+
+
+class DetBorrowAug(DetAugmenter):
+    """Wrap a geometry-preserving image Augmenter (reference
+    DetBorrowAug)."""
+
+    def __init__(self, augmenter: Augmenter):
+        self.augmenter = augmenter
+
+    def __call__(self, src, label):
+        return self.augmenter(src), label
+
+
+class DetHorizontalFlipAug(DetAugmenter):
+    """Mirror image and boxes with probability p."""
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.p:
+            src = nd_array(src.asnumpy()[:, ::-1].copy(), ctx=cpu())
+            label = label.copy()
+            x1 = label[:, 1].copy()
+            label[:, 1] = 1.0 - label[:, 3]
+            label[:, 3] = 1.0 - x1
+        return src, label
+
+
+class DetRandomCropAug(DetAugmenter):
+    """IoU-constrained random crop (reference DetRandomCropAug): sample a
+    crop whose min-object coverage clears the threshold; keep boxes whose
+    centers fall inside, clip and renormalize them."""
+
+    def __init__(self, min_object_covered=0.1,
+                 aspect_ratio_range=(0.75, 1.33), area_range=(0.05, 1.0),
+                 max_attempts=50):
+        self.min_object_covered = min_object_covered
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+
+    def _coverage(self, boxes, crop):
+        cx1, cy1, cx2, cy2 = crop
+        ix1 = _np.maximum(boxes[:, 1], cx1)
+        iy1 = _np.maximum(boxes[:, 2], cy1)
+        ix2 = _np.minimum(boxes[:, 3], cx2)
+        iy2 = _np.minimum(boxes[:, 4], cy2)
+        inter = _np.maximum(ix2 - ix1, 0) * _np.maximum(iy2 - iy1, 0)
+        area = (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 4] - boxes[:, 2])
+        return inter / _np.maximum(area, 1e-12)
+
+    def __call__(self, src, label):
+        h, w = src.shape[0], src.shape[1]
+        for _ in range(self.max_attempts):
+            area_f = _pyrandom.uniform(*self.area_range)
+            ar = _pyrandom.uniform(*self.aspect_ratio_range)
+            cw = min(1.0, _np.sqrt(area_f * ar))
+            ch = min(1.0, area_f / max(cw, 1e-12))
+            cx = _pyrandom.uniform(0, 1 - cw)
+            cy = _pyrandom.uniform(0, 1 - ch)
+            crop = (cx, cy, cx + cw, cy + ch)
+            if label.shape[0]:
+                cov = self._coverage(label, crop)
+                if cov.max(initial=0.0) < self.min_object_covered:
+                    continue
+                centers_x = (label[:, 1] + label[:, 3]) / 2
+                centers_y = (label[:, 2] + label[:, 4]) / 2
+                keep = ((centers_x >= cx) & (centers_x <= cx + cw) &
+                        (centers_y >= cy) & (centers_y <= cy + ch))
+                if not keep.any():
+                    continue
+            else:
+                keep = _np.zeros((0,), bool)
+            x0, y0 = int(cx * w), int(cy * h)
+            pw, ph = max(1, int(cw * w)), max(1, int(ch * h))
+            img = fixed_crop(src, x0, y0, pw, ph)
+            new = label[keep].copy()
+            if new.shape[0]:
+                new[:, 1] = _np.clip((new[:, 1] - cx) / cw, 0, 1)
+                new[:, 3] = _np.clip((new[:, 3] - cx) / cw, 0, 1)
+                new[:, 2] = _np.clip((new[:, 2] - cy) / ch, 0, 1)
+                new[:, 4] = _np.clip((new[:, 4] - cy) / ch, 0, 1)
+            return img, new
+        return src, label
+
+
+class DetRandomPadAug(DetAugmenter):
+    """Expand the canvas and place the image randomly (reference
+    DetRandomPadAug); boxes shrink into the new frame."""
+
+    def __init__(self, aspect_ratio_range=(0.75, 1.33),
+                 area_range=(1.0, 3.0), max_attempts=50,
+                 pad_val=(127, 127, 127)):
+        self.aspect_ratio_range = aspect_ratio_range
+        self.area_range = area_range
+        self.max_attempts = max_attempts
+        self.pad_val = pad_val
+
+    def __call__(self, src, label):
+        h, w, c = src.shape
+        scale = _pyrandom.uniform(*self.area_range)
+        if scale <= 1.0:
+            return src, label
+        ar = _pyrandom.uniform(*self.aspect_ratio_range)
+        nw = int(w * _np.sqrt(scale * ar))
+        nh = int(h * scale / max(_np.sqrt(scale * ar), 1e-12))
+        nw, nh = max(nw, w), max(nh, h)
+        x0 = _pyrandom.randint(0, nw - w)
+        y0 = _pyrandom.randint(0, nh - h)
+        arr = src.asnumpy()          # one device->host copy
+        canvas = _np.empty((nh, nw, c), arr.dtype)
+        canvas[:] = _np.asarray(self.pad_val)[:c]
+        canvas[y0:y0 + h, x0:x0 + w] = arr
+        new = label.copy()
+        if new.shape[0]:
+            new[:, 1] = (new[:, 1] * w + x0) / nw
+            new[:, 3] = (new[:, 3] * w + x0) / nw
+            new[:, 2] = (new[:, 2] * h + y0) / nh
+            new[:, 4] = (new[:, 4] * h + y0) / nh
+        return nd_array(canvas, ctx=cpu()), new
+
+
+class DetRandomSelectAug(DetAugmenter):
+    """Randomly pick one of several augmenter lists (reference
+    DetRandomSelectAug); skip_prob leaves the sample unchanged."""
+
+    def __init__(self, aug_list, skip_prob: float = 0.0):
+        self.aug_list = aug_list
+        self.skip_prob = skip_prob
+
+    def __call__(self, src, label):
+        if _pyrandom.random() < self.skip_prob:
+            return src, label
+        for aug in _pyrandom.choice(self.aug_list):
+            src, label = aug(src, label)
+        return src, label
+
+
+def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
+                       rand_mirror=False, mean=None, std=None, brightness=0,
+                       contrast=0, saturation=0, pad_val=(127, 127, 127),
+                       min_object_covered=0.1,
+                       aspect_ratio_range=(0.75, 1.33),
+                       area_range=(0.05, 3.0), max_attempts=50,
+                       inter_method=1, **kwargs) -> List[DetAugmenter]:
+    """Detection pipeline factory (reference CreateDetAugmenter)."""
+    auglist: List[DetAugmenter] = []
+    if resize > 0:
+        auglist.append(DetBorrowAug(ResizeAug(resize, inter_method)))
+    if rand_crop > 0:
+        crop = DetRandomCropAug(min_object_covered, aspect_ratio_range,
+                                (area_range[0], min(1.0, area_range[1])),
+                                max_attempts)
+        auglist.append(DetRandomSelectAug([[crop]], 1 - rand_crop))
+    if rand_pad > 0:
+        pad = DetRandomPadAug(aspect_ratio_range,
+                              (1.0, max(1.0, area_range[1])), max_attempts,
+                              pad_val)
+        auglist.append(DetRandomSelectAug([[pad]], 1 - rand_pad))
+    if rand_mirror:
+        auglist.append(DetHorizontalFlipAug(0.5))
+    # final shape: force resize to the network input
+    auglist.append(DetBorrowAug(
+        ForceResizeAug((data_shape[2], data_shape[1]), inter_method)))
+    auglist.append(DetBorrowAug(CastAug()))
+    if brightness:
+        auglist.append(DetBorrowAug(BrightnessJitterAug(brightness)))
+    if contrast:
+        auglist.append(DetBorrowAug(ContrastJitterAug(contrast)))
+    if saturation:
+        auglist.append(DetBorrowAug(SaturationJitterAug(saturation)))
+    if mean is True:
+        mean = _np.array([123.68, 116.28, 103.53])
+    if std is True:
+        std = _np.array([58.395, 57.12, 57.375])
+    if mean is not None:
+        auglist.append(DetBorrowAug(ColorNormalizeAug(mean, std)))
+    return auglist
+
+
+class ImageDetIter(DataIter):
+    """Detection iterator (reference mx.image.ImageDetIter): images plus
+    variable-count box labels, padded to a fixed (batch, max_objs, 5)
+    label tensor with -1 rows — the static shape the SSD target ops (and
+    XLA) need."""
+
+    def __init__(self, batch_size: int, data_shape: Sequence[int],
+                 path_root: str = "", imglist=None, shuffle: bool = False,
+                 aug_list=None, data_name: str = "data",
+                 label_name: str = "label", **kwargs):
+        super().__init__(batch_size)
+        self.data_shape = tuple(data_shape)
+        self.path_root = path_root
+        self.data_name = data_name
+        self.label_name = label_name
+        if not imglist:
+            raise MXNetError("ImageDetIter needs imglist: entries of "
+                             "[label_array (N,5+), path]")
+        self.imglist = []
+        for lab, path in imglist:
+            lab = _np.asarray(lab, _np.float32)
+            if lab.ndim == 1:
+                lab = lab.reshape(1, -1)
+            if lab.ndim != 2 or lab.shape[1] < 5:
+                raise MXNetError(
+                    f"detection label for {path!r} must be (N, 5+) "
+                    f"[cls, x1, y1, x2, y2, ...], got {lab.shape}")
+            # extra columns beyond 5 (difficult flags etc.) are dropped;
+            # never re-chunk the buffer
+            self.imglist.append((lab[:, :5].copy(), path))
+        self.max_objs = max(lab.shape[0] for lab, _ in self.imglist)
+        self.shuffle = shuffle
+        self.aug_list = aug_list if aug_list is not None else \
+            CreateDetAugmenter(data_shape)
+        self.reset()
+
+    @property
+    def provide_data(self):
+        return [DataDesc(self.data_name,
+                         (self.batch_size,) + self.data_shape)]
+
+    @property
+    def provide_label(self):
+        return [DataDesc(self.label_name,
+                         (self.batch_size, self.max_objs, 5))]
+
+    def reset(self):
+        if self.shuffle:
+            _pyrandom.shuffle(self.imglist)
+        self.cur = 0
+
+    def next(self) -> DataBatch:
+        if self.cur + self.batch_size > len(self.imglist):
+            raise StopIteration
+        datas, labels = [], []
+        for lab, path in self.imglist[self.cur:self.cur + self.batch_size]:
+            img = imread(os.path.join(self.path_root, path))
+            label = lab.copy()
+            for aug in self.aug_list:
+                img, label = aug(img, label)
+            datas.append(img.asnumpy().transpose(2, 0, 1))
+            pad = _np.full((self.max_objs, 5), -1.0, _np.float32)
+            n = min(label.shape[0], self.max_objs)
+            if n:
+                pad[:n] = label[:n, :5]
+            labels.append(pad)
+        self.cur += self.batch_size
+        return DataBatch(
+            [nd_array(_np.stack(datas).astype(_np.float32), ctx=cpu())],
+            [nd_array(_np.stack(labels), ctx=cpu())],
+            provide_data=self.provide_data,
+            provide_label=self.provide_label)
+
+
+__all__ += ["DetAugmenter", "DetBorrowAug", "DetHorizontalFlipAug",
+            "DetRandomCropAug", "DetRandomPadAug", "DetRandomSelectAug",
+            "CreateDetAugmenter", "ImageDetIter"]

@@ -1,0 +1,40 @@
+"""``python -m mxnet_tpu.kvstore_server`` — the reference's server-process
+entrypoint (reference: python/mxnet/kvstore_server.py), kept so cluster
+scripts written for the parameter-server launcher run unchanged.
+
+There are no parameter servers in this build: the PS push/pull plane is
+replaced by synchronous SPMD collectives (in-graph psum over ICI; one host
+allreduce per step over DCN — SURVEY.md §2.3/§5.8, parallel/dist.py).
+A process launched with DMLC_ROLE=server or =scheduler therefore has
+nothing to serve; it logs that fact and exits 0 so job trackers see a
+clean completion instead of a crash.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+
+def _main() -> int:
+    role = os.environ.get("DMLC_ROLE", "")
+    logging.basicConfig(level=logging.INFO)
+    log = logging.getLogger("kvstore_server")
+    if role in ("server", "scheduler"):
+        log.info(
+            "DMLC_ROLE=%s: this build has no parameter servers — gradient "
+            "exchange is synchronous collective allreduce (kvstore "
+            "dist_sync over jax.distributed). Exiting cleanly; only "
+            "worker processes participate.", role)
+        return 0
+    if role == "worker":
+        log.info("DMLC_ROLE=worker: nothing to do in kvstore_server; "
+                 "run your training script directly (it joins the "
+                 "process group via mxnet_tpu.parallel.dist).")
+        return 0
+    log.error("kvstore_server: DMLC_ROLE is not set")
+    return 1
+
+
+if __name__ == "__main__":
+    sys.exit(_main())

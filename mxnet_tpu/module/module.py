@@ -119,6 +119,8 @@ class Module(BaseModule):
         if initializer is None:
             initializer = Uniform(0.01)
         exe = self._exec_group.execs[0]
+        attrs = self._symbol.attr_dict() if hasattr(self._symbol,
+                                                    "attr_dict") else {}
         for name in self._param_names:
             arr = exe.arg_dict[name]
             if arg_params and name in arg_params:
@@ -130,7 +132,16 @@ class Module(BaseModule):
                         arg_params != {}:
                     raise MXNetError(f"missing parameter {name!r}")
                 dst = nd_zeros(arr.shape, ctx=arr.context)
-                initializer(name, dst)
+                spec = attrs.get(name, {}).get("__init__")
+                if spec:
+                    # per-variable initializer attr (reference InitDesc):
+                    # JSON ["name", {kwargs}] beats the pattern rules
+                    import json
+                    from ..initializer import create as _mk_init
+                    iname, ikw = json.loads(spec)
+                    _mk_init(iname, **ikw).init_weight(name, dst)
+                else:
+                    initializer(name, dst)
                 self._arg_params[name] = dst
         for name in self._aux_names:
             arr = exe.aux_dict[name]

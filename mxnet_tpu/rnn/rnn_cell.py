@@ -186,14 +186,23 @@ class RNNCell(BaseRNNCell):
 
 
 class LSTMCell(BaseRNNCell):
-    """LSTM (reference LSTMCell; gate order i, f, c, o)."""
+    """LSTM (reference LSTMCell; gate order i, f, c, o).
+
+    ``forget_bias`` follows the reference convention: applied at
+    INITIALIZATION (an ``__init__`` attr on the bias variable consumed by
+    Module.init_params), never at runtime — so trained weights stay
+    bit-interchangeable with the fused RNN op's packed parameters."""
 
     def __init__(self, num_hidden: int, prefix: str = "lstm_", params=None,
                  forget_bias: float = 1.0):
         super().__init__(prefix=prefix, params=params)
+        import json
         self._num_hidden = num_hidden
         self._iW = self.params.get("i2h_weight")
-        self._iB = self.params.get("i2h_bias")
+        self._iB = self.params.get(
+            "i2h_bias",
+            __init__=json.dumps(["lstmbias",
+                                 {"forget_bias": forget_bias}]))
         self._hW = self.params.get("h2h_weight")
         self._hB = self.params.get("h2h_bias")
         self._forget_bias = forget_bias
@@ -222,8 +231,7 @@ class LSTMCell(BaseRNNCell):
         g = sym.split(gates, num_outputs=4, axis=1,
                       name=f"{name}slice")
         in_gate = sym.Activation(g[0], act_type="sigmoid")
-        forget_gate = sym.Activation(g[1] + self._forget_bias,
-                                     act_type="sigmoid")
+        forget_gate = sym.Activation(g[1], act_type="sigmoid")
         in_transform = sym.Activation(g[2], act_type="tanh")
         out_gate = sym.Activation(g[3], act_type="sigmoid")
         next_c = forget_gate * states[1] + in_gate * in_transform
@@ -370,6 +378,92 @@ class FusedRNNCell(BaseRNNCell):
         if not self._get_next_state:
             states = []
         return seq, states
+
+    def _ngates(self) -> int:
+        return {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[
+            self._mode]
+
+    def _infer_input_size(self, total: int) -> int:
+        """Recover layer-0 input size from the packed parameter length."""
+        g, H = self._ngates(), self._num_hidden
+        ndir = 2 if self._bidirectional else 1
+        rest = 0
+        layer_in = H * ndir
+        for _ in range(1, self._num_layers):
+            rest += ndir * (g * H * layer_in + g * H * H + 2 * g * H)
+        first_fixed = ndir * (g * H * H + 2 * g * H)
+        num = total - rest - first_fixed
+        in_size, rem = divmod(num, ndir * g * H)
+        if rem or in_size <= 0:
+            raise MXNetError(
+                f"packed parameter length {total} does not match "
+                f"mode={self._mode} layers={self._num_layers} "
+                f"hidden={self._num_hidden}")
+        return in_size
+
+    def _slices(self, input_size: int):
+        """(cell_prefix, name, shape, offset) for the reference packed
+        layout: per layer, per direction: Wx, Wh, bx, bh."""
+        g, H = self._ngates(), self._num_hidden
+        ndir = 2 if self._bidirectional else 1
+        out = []
+        offset = 0
+        layer_in = input_size
+        for layer in range(self._num_layers):
+            for d in range(ndir):
+                cp = f"{self._prefix}{'lr'[d]}{layer}_"
+                for nm, shape in (("i2h_weight", (g * H, layer_in)),
+                                  ("h2h_weight", (g * H, H)),
+                                  ("i2h_bias", (g * H,)),
+                                  ("h2h_bias", (g * H,))):
+                    n = 1
+                    for s in shape:
+                        n *= s
+                    out.append((cp, nm, shape, offset))
+                    offset += n
+            layer_in = H * ndir
+        return out, offset
+
+    def unpack_weights(self, args: dict) -> dict:
+        """Split the packed ``{prefix}parameters`` vector into the per-cell
+        weights ``unfuse()``'s cells expect (reference unpack_weights)."""
+        from .. import ndarray as nd
+        key = f"{self._prefix}parameters"
+        if key not in args:
+            return dict(args)
+        args = dict(args)
+        flat = args.pop(key).asnumpy().reshape(-1)
+        slices, total = self._slices(self._infer_input_size(flat.size))
+        if total != flat.size:
+            raise MXNetError("packed parameter length mismatch")
+        for cp, nm, shape, offset in slices:
+            n = 1
+            for s in shape:
+                n *= s
+            args[cp + nm] = nd.array(
+                flat[offset:offset + n].reshape(shape))
+        return args
+
+    def pack_weights(self, args: dict) -> dict:
+        """Inverse of unpack_weights: gather per-cell weights back into
+        one packed vector."""
+        import numpy as _np
+        from .. import ndarray as nd
+        probe = f"{self._prefix}l0_i2h_weight"
+        if probe not in args:
+            return dict(args)
+        args = dict(args)
+        in_size = args[probe].shape[-1]
+        slices, total = self._slices(in_size)
+        flat = _np.zeros((total,), _np.float32)
+        for cp, nm, shape, offset in slices:
+            n = 1
+            for s in shape:
+                n *= s
+            flat[offset:offset + n] = \
+                args.pop(cp + nm).asnumpy().reshape(-1)
+        args[f"{self._prefix}parameters"] = nd.array(flat)
+        return args
 
     def unfuse(self) -> "SequentialRNNCell":
         """Equivalent stack of unfused cells (reference unfuse)."""

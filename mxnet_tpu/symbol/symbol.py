@@ -176,6 +176,15 @@ class Symbol:
     def attr(self, key):
         return self._heads[0][0].attrs.get(key)
 
+    def attr_dict(self):
+        """name → attrs for every node carrying attrs (reference
+        Symbol.attr_dict; init_params reads per-variable ``__init__``)."""
+        out = {}
+        for node in self._topo():
+            if node.attrs:
+                out[node.name] = dict(node.attrs)
+        return out
+
     # -- lowering to a JAX function ---------------------------------------
     def compile(self, training: bool = False):
         """Return fn(feed: dict name→jax value) → list of output values."""

@@ -182,3 +182,51 @@ def test_gluon_deformable_convolution_block():
 
     net.hybridize()
     assert net(x).shape == (2, 8, 10, 10)
+
+
+def test_contrib_text_vocab_and_embedding(tmp_path):
+    """mx.contrib.text (reference contrib/text/): counting, vocabulary
+    ordering, file embeddings, composite lookup."""
+    import numpy as np
+    from mxnet_tpu.contrib import text
+
+    counter = text.utils.count_tokens_from_str(
+        "a b b c c c\nd", to_lower=True)
+    assert counter["c"] == 3 and counter["b"] == 2 and counter["a"] == 1
+
+    vocab = text.Vocabulary(counter, most_freq_count=None, min_freq=2,
+                            reserved_tokens=["<pad>"])
+    # order: <unk>, <pad>, then freq desc (ties lexicographic)
+    assert vocab.idx_to_token[:4] == ["<unk>", "<pad>", "c", "b"]
+    assert vocab.to_indices(["c", "b", "UNSEEN"]) == [2, 3, 0]
+    assert vocab.to_tokens([2, 0]) == ["c", "<unk>"]
+    assert len(vocab) == 4          # min_freq=2 drops a, d,
+
+    # custom embedding file
+    p = tmp_path / "emb.txt"
+    p.write_text("c 1.0 2.0\nb 3.0 4.0\nzz 5.0 6.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    assert emb.vec_len == 2
+    v = emb.get_vecs_by_tokens(["c", "nope"]).asnumpy()
+    np.testing.assert_allclose(v, [[1.0, 2.0], [0.0, 0.0]])
+    emb.update_token_vectors("c", np.array([[9.0, 9.0]], np.float32))
+    np.testing.assert_allclose(
+        emb.get_vecs_by_tokens("c").asnumpy(), [9.0, 9.0])
+
+    # composite over the vocabulary: rows follow vocab indices
+    p2 = tmp_path / "emb2.txt"
+    p2.write_text("b 7.0\nc 8.0\n")
+    emb2 = text.embedding.CustomEmbedding(str(p2))
+    comp = text.embedding.CompositeEmbedding(vocab, [emb, emb2])
+    assert comp.vec_len == 3
+    got = comp.get_vecs_by_tokens(["c", "b"]).asnumpy()
+    np.testing.assert_allclose(got, [[9.0, 9.0, 8.0], [3.0, 4.0, 7.0]])
+
+    # registry + zero-egress contract
+    import pytest
+    assert "glove" in text.embedding.list_embedding_names()
+    with pytest.raises(Exception, match="local"):
+        text.embedding.create("glove", pretrained_file_path="/nope.txt")
+    # glove from local file works
+    g = text.embedding.create("glove", pretrained_file_path=str(p))
+    assert g.vec_len == 2

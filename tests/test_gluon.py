@@ -311,3 +311,74 @@ def test_resnet_nhwc_layout_parity():
         p2.set_data(F.array(p1.data().asnumpy()))
     y2 = n2(mx.nd.array(xt)).asnumpy()
     np.testing.assert_allclose(y2, y1, rtol=1e-4, atol=1e-4)
+
+
+def test_conv_rnn_cells():
+    """gluon.contrib.rnn Conv*Cell (reference conv_rnn_cell.py): spatial
+    recurrences preserve state shape; ConvLSTM reduces to dense-LSTM math
+    when kernels are 1x1 on a 1x1 map."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    for cls, n_states in [(gluon.contrib.rnn.ConvRNNCell, 1),
+                          (gluon.contrib.rnn.ConvLSTMCell, 2),
+                          (gluon.contrib.rnn.ConvGRUCell, 1)]:
+        cell = cls(input_shape=(2, 8, 8), hidden_channels=4)
+        cell.initialize()
+        x = mx.nd.array(np.random.RandomState(0)
+                        .randn(3, 2, 8, 8).astype(np.float32))
+        states = cell.begin_state(batch_size=3)
+        assert len(states) == n_states
+        out, new_states = cell(x, states)
+        assert out.shape == (3, 4, 8, 8)
+        for s in new_states:
+            assert s.shape == (3, 4, 8, 8)
+        # unroll over a (N, T, C, H, W) sequence
+        seq = mx.nd.array(np.random.RandomState(1)
+                          .randn(3, 5, 2, 8, 8).astype(np.float32))
+        outs, _ = cell.unroll(5, seq, layout="NTC", merge_outputs=True)
+        assert outs.shape == (3, 5, 4, 8, 8)
+        # gradient flows to the recurrent weights
+        for p in cell.collect_params().values():
+            p.grad_req = "write"
+        with autograd.record():
+            # two chained steps: step 2's h2h input is nonzero, so the
+            # recurrent weight receives gradient
+            out, st = cell(x, cell.begin_state(batch_size=3))
+            out, _ = cell(x, st)
+            L = mx.nd.mean(mx.nd.square(out))
+        L.backward()
+        assert float(mx.nd.sum(mx.nd.abs(
+            cell.h2h_weight.grad())).asnumpy()) > 0
+
+
+def test_variational_dropout_cell_mask_reuse():
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon
+
+    base = gluon.rnn.RNNCell(8, input_size=8)
+    cell = gluon.contrib.rnn.VariationalDropoutCell(base, drop_inputs=0.5)
+    cell.initialize()
+    np.random.seed(0)
+    x = mx.nd.array(np.ones((2, 8), np.float32))
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        # same mask across steps within one sequence
+        out1, states = cell(x, states)
+        m1 = cell._input_mask.asnumpy().copy()
+        out2, states = cell(x, states)
+        m2 = cell._input_mask.asnumpy().copy()
+    np.testing.assert_array_equal(m1, m2)
+    assert (m1 == 0).any() and (m1 > 0).any()
+    # new sequence -> new mask
+    states = cell.begin_state(batch_size=2)
+    with autograd.record():
+        cell(x, states)
+    assert not np.array_equal(m1, cell._input_mask.asnumpy())
+    # inference: no dropout
+    out_inf, _ = cell(x, cell.begin_state(batch_size=2))
+    base_out, _ = base(x, base.begin_state(batch_size=2))
+    np.testing.assert_allclose(out_inf.asnumpy(), base_out.asnumpy(),
+                               rtol=1e-5)

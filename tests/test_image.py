@@ -68,3 +68,77 @@ def test_image_iter(tmp_path):
     assert batches[0].data[0].shape == (2, 3, 24, 24)
     assert batches[0].label[0].shape == (2,)
     assert len(list(it)) == 3   # reset works
+
+
+def test_det_augmenters_transform_boxes():
+    """Detection augmenters (reference image/detection.py): flips and
+    crops must transform box coords consistently with the pixels."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import image as img
+
+    # asymmetric image: bright square at left third
+    arr = np.zeros((60, 90, 3), np.uint8)
+    arr[20:40, 10:30] = 255
+    src = mx.nd.array(arr.astype(np.float32))
+    label = np.array([[0, 10 / 90, 20 / 60, 30 / 90, 40 / 60]], np.float32)
+
+    flip = img.DetHorizontalFlipAug(p=1.0)
+    fsrc, flab = flip(src, label)
+    # box mirrors: x1' = 1-x2, x2' = 1-x1
+    np.testing.assert_allclose(flab[0, 1], 1 - label[0, 3], atol=1e-6)
+    np.testing.assert_allclose(flab[0, 3], 1 - label[0, 1], atol=1e-6)
+    # pixels moved with it: bright region now at right
+    out = fsrc.asnumpy()
+    assert out[30, 70].sum() > out[30, 20].sum()
+
+    pad = img.DetRandomPadAug(area_range=(2.0, 2.0),
+                              aspect_ratio_range=(1.0, 1.0))
+    psrc, plab = pad(src, label)
+    assert psrc.shape[0] >= 60 and psrc.shape[1] >= 90
+    # padded box shrinks but stays normalized
+    assert 0 <= plab[0, 1] <= 1 and 0 <= plab[0, 4] <= 1
+    w = plab[0, 3] - plab[0, 1]
+    assert w < (label[0, 3] - label[0, 1])
+
+    crop = img.DetRandomCropAug(min_object_covered=0.9,
+                                area_range=(0.5, 1.0), max_attempts=100)
+    csrc, clab = crop(src, label)
+    assert clab.shape[1] == 5
+    if clab.shape[0]:        # crop kept the object
+        assert 0 <= clab[0, 1] <= 1
+
+
+def test_image_det_iter_batches(tmp_path):
+    import numpy as np
+    from PIL import Image
+    import mxnet_tpu as mx
+    from mxnet_tpu import image as img
+
+    rs = np.random.RandomState(0)
+    entries = []
+    for i in range(6):
+        a = rs.randint(0, 255, (40 + i, 50, 3), np.uint8)
+        p = tmp_path / f"im{i}.jpg"
+        Image.fromarray(a).save(p)
+        nobj = 1 + i % 3
+        boxes = []
+        for j in range(nobj):
+            x1, y1 = rs.uniform(0, 0.5, 2)
+            boxes.append([j % 2, x1, y1, x1 + 0.3, y1 + 0.3])
+        entries.append((np.array(boxes, np.float32), p.name))
+
+    it = img.ImageDetIter(batch_size=2, data_shape=(3, 32, 32),
+                          path_root=str(tmp_path), imglist=entries,
+                          aug_list=img.CreateDetAugmenter(
+                              (3, 32, 32), rand_mirror=True, rand_crop=0.5,
+                              rand_pad=0.5))
+    nb = 0
+    for batch in it:
+        assert batch.data[0].shape == (2, 3, 32, 32)
+        assert batch.label[0].shape == (2, 3, 5)   # max_objs == 3
+        lab = batch.label[0].asnumpy()
+        valid = lab[lab[:, :, 0] >= 0]
+        assert ((valid[:, 1:] >= -1e-6) & (valid[:, 1:] <= 1 + 1e-6)).all()
+        nb += 1
+    assert nb == 3

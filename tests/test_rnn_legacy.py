@@ -43,7 +43,9 @@ def test_lstm_cell_matches_numpy():
     exe.arg_dict["l_h2h_bias"][:] = mx.nd.array(hB)
     got = exe.forward(is_train=False)[0].asnumpy()
 
-    # numpy recurrence, reference gate order i,f,c,o with forget_bias=1
+    # numpy recurrence, reference gate order i,f,c,o (forget_bias lives in
+    # the bias INITIALIZER, not the runtime graph — weights here are
+    # explicit, so plain sigmoid)
     h = np.zeros((N, H), np.float32)
     c = np.zeros((N, H), np.float32)
     ref = []
@@ -51,7 +53,7 @@ def test_lstm_cell_matches_numpy():
         g = x[:, t] @ iW.T + iB + h @ hW.T + hB
         i, f, cc, o = np.split(g, 4, axis=1)
         i = _sigmoid(i)
-        f = _sigmoid(f + 1.0)
+        f = _sigmoid(f)
         cc = np.tanh(cc)
         o = _sigmoid(o)
         c = f * c + i * cc
@@ -246,3 +248,83 @@ def test_fused_rnn_cell_unmerged_outputs():
         if k != "data":
             v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
     assert exe.forward(is_train=False)[0].shape == (N, H)
+
+
+def test_fused_pack_unpack_weight_interchange():
+    """unpack_weights must make FusedRNNCell's packed vector drive the
+    unfused stack to IDENTICAL outputs (reference unpack/pack contract)."""
+    T, N, E, H = 3, 2, 4, 5
+    rs = np.random.RandomState(5)
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="lstm", prefix="f_",
+                             input_size=E)
+    outs, _ = fused.unroll(T, mx.sym.var("data"), layout="NTC",
+                           merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    pv = (rs.randn(*exe.arg_dict["f_parameters"].shape) * 0.3).astype(
+        np.float32)
+    exe.arg_dict["f_parameters"][:] = mx.nd.array(pv)
+    x = rs.randn(N, T, E).astype(np.float32)
+    exe.arg_dict["data"][:] = mx.nd.array(x)
+    ref = exe.forward(is_train=False)[0].asnumpy()
+
+    # unpack -> unfused stack -> same outputs
+    unpacked = fused.unpack_weights({"f_parameters": mx.nd.array(pv)})
+    assert "f_parameters" not in unpacked
+    stack = fused.unfuse()
+    outs2, _ = stack.unroll(T, mx.sym.var("data"), layout="NTC",
+                            merge_outputs=True)
+    exe2 = outs2.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    for k, v in unpacked.items():
+        exe2.arg_dict[k][:] = v
+    exe2.arg_dict["data"][:] = mx.nd.array(x)
+    got = exe2.forward(is_train=False)[0].asnumpy()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+
+    # pack round-trips bit-exactly
+    repacked = fused.pack_weights(unpacked)
+    np.testing.assert_array_equal(
+        repacked["f_parameters"].asnumpy(), pv)
+
+
+def test_image_det_iter_rejects_and_slices_wide_labels(tmp_path):
+    from PIL import Image
+    from mxnet_tpu.image import ImageDetIter
+    a = np.zeros((20, 20, 3), np.uint8)
+    p = tmp_path / "a.jpg"
+    Image.fromarray(a).save(p)
+    # (1, 6) labels: extra 'difficult' column sliced off, not re-chunked
+    lab6 = np.array([[1, 0.1, 0.1, 0.5, 0.5, 0.0]], np.float32)
+    it = ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                      path_root=str(tmp_path), imglist=[(lab6, "a.jpg")],
+                      aug_list=[])
+    batch = next(iter(it))
+    np.testing.assert_allclose(batch.label[0].asnumpy()[0, 0],
+                               [1, 0.1, 0.1, 0.5, 0.5], rtol=1e-6)
+    with pytest.raises(Exception, match="5"):
+        ImageDetIter(batch_size=1, data_shape=(3, 16, 16),
+                     path_root=str(tmp_path),
+                     imglist=[(np.zeros((1, 4), np.float32), "a.jpg")])
+
+
+def test_lstm_forget_bias_applied_at_init():
+    """Module.init_params honors the cell's __init__ attr: forget-gate
+    bias slice = forget_bias, rest zero; runtime graph stays plain."""
+    from mxnet_tpu import io as mio
+    H = 4
+    cell = rnn.LSTMCell(H, prefix="fb_", forget_bias=2.5)
+    outs, _ = cell.unroll(2, mx.sym.var("data"), layout="NTC",
+                          merge_outputs=True)
+    pred = mx.sym.FullyConnected(mx.sym.reshape(outs, shape=(-1, H)),
+                                 num_hidden=2, name="cls")
+    out = mx.sym.SoftmaxOutput(pred, mx.sym.var("softmax_label"),
+                               name="softmax")
+    mod = mx.mod.Module(out, context=mx.cpu())
+    x = np.random.RandomState(0).randn(6, 2, 3).astype(np.float32)
+    y = np.zeros((6, 2), np.float32)
+    it = mio.NDArrayIter(x, y, batch_size=3)
+    mod.bind(data_shapes=it.provide_data, label_shapes=it.provide_label)
+    mod.init_params()
+    arg, _ = mod.get_params()
+    b = arg["fb_i2h_bias"].asnumpy()
+    np.testing.assert_allclose(b[H:2 * H], 2.5)
+    np.testing.assert_allclose(np.delete(b, np.s_[H:2 * H]), 0.0)
