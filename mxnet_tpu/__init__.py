@@ -53,6 +53,9 @@ from . import callback
 from . import monitor
 from .monitor import Monitor
 from . import rnn
+from . import name
+from . import attribute
+from .attribute import AttrScope
 from . import gluon
 from . import parallel
 from . import symbol

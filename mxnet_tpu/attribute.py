@@ -1,0 +1,60 @@
+"""mx.AttrScope (reference: python/mxnet/attribute.py) — scoped default
+attributes stamped onto every symbol created inside the ``with`` block.
+The Symbol-era model-parallel idiom rides this: ``with mx.AttrScope(
+ctx_group='stage1'):`` tags ops for ``bind(group2ctx=...)`` placement;
+here those tags flow to the sharding rules instead of a PlaceDevice pass.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+__all__ = ["AttrScope", "current"]
+
+_state = threading.local()
+
+
+def current() -> "AttrScope":
+    stack = getattr(_state, "stack", None)
+    if not stack:
+        _state.stack = [AttrScope()]
+    return _state.stack[-1]
+
+
+class AttrScope:
+    _RESERVED = ("shape", "dtype", "aux", "init", "layout")
+
+    def __init__(self, **kwargs):
+        for k, v in kwargs.items():
+            if not isinstance(v, str):
+                raise ValueError("AttrScope values must be strings")
+            if k in self._RESERVED or (k.startswith("__")
+                                       and k.endswith("__")):
+                # dunder-wrapping these would collide with the internal
+                # metadata namespace (__shape__/__dtype__/__aux__/...)
+                raise ValueError(
+                    f"AttrScope key {k!r} is reserved for internal "
+                    "variable metadata")
+        self._attrs: Dict[str, str] = dict(kwargs)
+
+    def get(self, attrs: Dict[str, str] = None) -> Dict[str, str]:
+        """Active scope attrs (``__key__``-wrapped, so they ride node
+        attrs as metadata rather than op parameters) merged under
+        explicitly-passed ones."""
+        out = {f"__{k}__": v for k, v in self._attrs.items()}
+        if attrs:
+            out.update(attrs)
+        return out
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = [AttrScope()]
+        merged = dict(_state.stack[-1]._attrs)
+        merged.update(self._attrs)
+        scope = AttrScope()
+        scope._attrs = merged
+        _state.stack.append(scope)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()

@@ -122,10 +122,15 @@ class TokenEmbedding:
         vecs = new_vectors.asnumpy() if hasattr(new_vectors, "asnumpy") \
             else _np.asarray(new_vectors)
         vecs = vecs.reshape(len(toks), -1)
-        for t, v in zip(toks, vecs):
+        # resolve every index BEFORE writing: an unknown token must not
+        # leave the table half-mutated
+        idxs = []
+        for t in toks:
             if t not in self._token_to_idx:
                 raise MXNetError(f"token {t!r} is not in the embedding")
-            self._idx_to_vec[self._token_to_idx[t]] = v
+            idxs.append(self._token_to_idx[t])
+        for i, v in zip(idxs, vecs):
+            self._idx_to_vec[i] = v
 
 
 class CustomEmbedding(TokenEmbedding):

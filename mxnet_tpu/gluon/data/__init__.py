@@ -1,6 +1,7 @@
 """gluon.data: datasets, samplers, DataLoader (reference:
 python/mxnet/gluon/data/)."""
 from .dataset import Dataset, ArrayDataset, SimpleDataset, RecordFileDataset
-from .sampler import Sampler, SequentialSampler, RandomSampler, BatchSampler
+from .sampler import Sampler, SequentialSampler, RandomSampler, \
+    BatchSampler, FilterSampler
 from .dataloader import DataLoader
 from . import vision

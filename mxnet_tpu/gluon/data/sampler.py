@@ -3,7 +3,8 @@ from __future__ import annotations
 
 import numpy as _np
 
-__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler"]
+__all__ = ["Sampler", "SequentialSampler", "RandomSampler", "BatchSampler",
+           "FilterSampler"]
 
 
 class Sampler:
@@ -68,3 +69,18 @@ class BatchSampler(Sampler):
         if self._last_batch == "discard":
             return n // self._batch_size
         return (n + len(self._prev)) // self._batch_size
+
+
+class FilterSampler(Sampler):
+    """Samples indices of dataset elements for which ``fn(sample)`` is
+    truthy (reference: gluon/data/sampler.py FilterSampler)."""
+
+    def __init__(self, fn, dataset):
+        self._indices = [i for i in range(len(dataset))
+                         if fn(dataset[i])]
+
+    def __iter__(self):
+        return iter(self._indices)
+
+    def __len__(self):
+        return len(self._indices)

@@ -15,7 +15,7 @@ from ...block import Block, HybridBlock
 __all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
-           "RandomSaturation", "RandomLighting", "RandomColorJitter"]
+           "RandomSaturation", "RandomHue", "RandomLighting", "RandomColorJitter"]
 
 
 def _to_np(x):
@@ -167,6 +167,29 @@ class RandomSaturation(_RandomJitter):
         return nd_array(_np.clip(a * f + gray * (1 - f), 0, 255))
 
 
+class RandomHue(_RandomJitter):
+    """Hue jitter (reference transforms.RandomHue): rotate RGB around the
+    gray axis by a random angle scaled from the jitter amount."""
+
+    def __call__(self, x):
+        a = _to_np(x).astype(_np.float32)
+        f = self._factor() - 1.0            # in [-amount, amount]
+        theta = f * _np.pi
+        cos, sin = _np.cos(theta), _np.sin(theta)
+        # YIQ-space hue rotation (the classic fast-hue-shift matrix)
+        t_yiq = _np.array([[0.299, 0.587, 0.114],
+                           [0.596, -0.274, -0.321],
+                           [0.211, -0.523, 0.311]], _np.float32)
+        t_rgb = _np.array([[1.0, 0.956, 0.621],
+                           [1.0, -0.272, -0.647],
+                           [1.0, -1.107, 1.705]], _np.float32)
+        rot = _np.array([[1, 0, 0],
+                         [0, cos, -sin],
+                         [0, sin, cos]], _np.float32)
+        m = t_rgb @ rot @ t_yiq
+        return nd_array(_np.clip(a @ m.T, 0, 255))
+
+
 class RandomLighting:
     def __init__(self, alpha):
         self._alpha = alpha
@@ -192,6 +215,8 @@ class RandomColorJitter:
             self._ts.append(RandomContrast(contrast))
         if saturation:
             self._ts.append(RandomSaturation(saturation))
+        if hue:
+            self._ts.append(RandomHue(hue))
 
     def __call__(self, x):
         for t in self._ts:

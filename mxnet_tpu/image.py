@@ -414,7 +414,13 @@ class DetRandomPadAug(DetAugmenter):
 
     def __call__(self, src, label):
         h, w, c = src.shape
-        scale = _pyrandom.uniform(*self.area_range)
+        # retry like DetRandomCropAug: keep sampling until the draw
+        # actually expands the canvas
+        scale = 1.0
+        for _ in range(self.max_attempts):
+            scale = _pyrandom.uniform(*self.area_range)
+            if scale > 1.0:
+                break
         if scale <= 1.0:
             return src, label
         ar = _pyrandom.uniform(*self.aspect_ratio_range)

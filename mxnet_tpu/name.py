@@ -1,0 +1,51 @@
+"""mx.name — NameManager / Prefix scopes (reference:
+python/mxnet/name.py).  Symbol auto-naming consults the active manager,
+so ``with mx.name.Prefix('stage1_'):`` namespaces every op created inside
+the scope, exactly as Symbol-era model code expects."""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+__all__ = ["NameManager", "Prefix", "current"]
+
+_state = threading.local()
+
+
+def current() -> "NameManager":
+    stack = getattr(_state, "stack", None)
+    if not stack:
+        _state.stack = [NameManager()]
+    return _state.stack[-1]
+
+
+class NameManager:
+    """Default manager: ``{hint}{counter}`` names (the reference
+    behavior, shared with symbol._auto_name)."""
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name is not None:
+            return name
+        from .symbol.symbol import _auto_name
+        return _auto_name(hint)
+
+    def __enter__(self):
+        if not hasattr(_state, "stack"):
+            _state.stack = [NameManager()]
+        _state.stack.append(self)
+        return self
+
+    def __exit__(self, *exc):
+        _state.stack.pop()
+
+
+class Prefix(NameManager):
+    """Prepends ``prefix`` to every auto-generated name in the scope."""
+
+    def __init__(self, prefix: str):
+        self._prefix = prefix
+
+    def get(self, name: Optional[str], hint: str) -> str:
+        if name is not None:
+            return name            # explicit names are never prefixed
+        return self._prefix + super().get(None, hint)

@@ -67,8 +67,17 @@ def apply_op(opname: str, args: List[Symbol], kwargs: Dict[str, Any],
                      if isinstance(v, Symbol)}
     attrs = {k: v for k, v in kwargs.items()
              if not isinstance(v, Symbol) and k not in ("name",)}
-    node_name = name or kwargs.get("name") or _auto_name(
-        canonical.lower().lstrip("_"))
+    # scoped defaults: active NameManager names the node, active AttrScope
+    # stamps its attrs (reference name.py/attribute.py behavior)
+    from .. import attribute as _attribute
+    from .. import name as _name
+    node_name = _name.current().get(name or kwargs.get("name"),
+                                    canonical.lower().lstrip("_"))
+    scope_attrs = _attribute.current().get()
+    if scope_attrs:
+        merged = dict(scope_attrs)
+        merged.update(attrs)
+        attrs = merged
     attrs.pop("name", None)
 
     inputs: List = []

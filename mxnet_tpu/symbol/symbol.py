@@ -16,6 +16,14 @@ _name_lock = threading.Lock()
 _name_counters: Dict[str, int] = {}
 
 
+def _op_kwargs(attrs):
+    """Node attrs minus scoped user attributes: ``__key__``-style entries
+    (AttrScope stamps, __shape__/__dtype__, ...) are metadata, never op
+    parameters."""
+    return {k: v for k, v in attrs.items()
+            if not (k.startswith("__") and k.endswith("__"))}
+
+
 def _auto_name(hint: str) -> str:
     with _name_lock:
         idx = _name_counters.get(hint, 0)
@@ -50,7 +58,8 @@ class Symbol:
     # -- construction ------------------------------------------------------
     @staticmethod
     def var(name: str, shape=None, dtype=None, **kwargs) -> "Symbol":
-        attrs = {}
+        from .. import attribute as _attribute
+        attrs = dict(_attribute.current().get())
         if shape is not None:
             attrs["__shape__"] = tuple(shape)
         if dtype is not None:
@@ -174,7 +183,11 @@ class Symbol:
         return Symbol(heads)
 
     def attr(self, key):
-        return self._heads[0][0].attrs.get(key)
+        attrs = self._heads[0][0].attrs
+        v = attrs.get(key)
+        if v is None and not key.startswith("__"):
+            v = attrs.get(f"__{key}__")   # AttrScope-stamped user attr
+        return v
 
     def attr_dict(self):
         """name → attrs for every node carrying attrs (reference
@@ -203,7 +216,7 @@ class Symbol:
                     vals[id(node)] = (feed[node.name],)
                     continue
                 op = get_op(node.op)
-                kwargs = dict(node.attrs)
+                kwargs = _op_kwargs(node.attrs)
                 if node.op == "BatchNorm":
                     kwargs.setdefault("_training", training)
                 extra = _scalar_extra(node.op, kwargs)
@@ -476,7 +489,7 @@ def _infer_missing(sym: Symbol, known: Dict[str, Tuple[int, ...]],
         if any(s is None for s in in_shapes):
             continue
         op = get_op(node.op)
-        kwargs = dict(node.attrs)
+        kwargs = _op_kwargs(node.attrs)
         if node.op == "BatchNorm":
             kwargs.setdefault("_training", False)
         try:

@@ -193,3 +193,56 @@ def test_monitor_collects_stats():
         assert len(mon.toc()) == 1
     finally:
         mon.uninstall()
+
+
+def test_name_and_attr_scopes():
+    """mx.name.Prefix and mx.AttrScope (reference name.py/attribute.py):
+    scoped auto-naming and attribute stamping on symbols."""
+    import mxnet_tpu as mx
+
+    with mx.name.Prefix("stage1_"):
+        s = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=4)
+    assert s.name.startswith("stage1_fullyconnected")
+    # explicit names win
+    with mx.name.Prefix("p_"):
+        s2 = mx.sym.FullyConnected(mx.sym.var("d"), num_hidden=4,
+                                   name="explicit")
+    assert s2.name == "explicit"
+
+    with mx.AttrScope(ctx_group="dev1"):
+        s3 = mx.sym.FullyConnected(mx.sym.var("d2"), num_hidden=4)
+        v = mx.sym.var("w_in_scope")
+    assert s3.attr("ctx_group") == "dev1"
+    assert v.attr("ctx_group") == "dev1"
+    # nesting merges; inner wins on conflict
+    with mx.AttrScope(ctx_group="dev1", tag="a"):
+        with mx.AttrScope(ctx_group="dev2"):
+            s4 = mx.sym.relu(mx.sym.var("d3"))
+    assert s4.attr("ctx_group") == "dev2"
+    assert s4.attr("tag") == "a"
+    # outside scope: nothing stamped
+    s5 = mx.sym.relu(mx.sym.var("d4"))
+    assert s5.attr("ctx_group") is None
+    # stamped symbols still execute (attrs are metadata, not op kwargs)
+    out = s4.eval_dict({"d3": mx.nd.array([-1.0, 2.0])})
+    if isinstance(out, (list, tuple)):
+        out = out[0]
+    import numpy as np
+    np.testing.assert_allclose(out.asnumpy(), [0.0, 2.0])
+
+
+def test_filter_sampler():
+    from mxnet_tpu.gluon.data import FilterSampler, ArrayDataset
+    import numpy as np
+    ds = ArrayDataset(np.arange(10, dtype=np.float32))
+    samp = FilterSampler(lambda x: float(x) % 2 == 0, ds)
+    assert list(samp) == [0, 2, 4, 6, 8]
+    assert len(samp) == 5
+
+
+def test_attr_scope_rejects_reserved_keys():
+    import pytest
+    import mxnet_tpu as mx
+    for key in ("shape", "dtype", "aux", "init", "layout", "__x__"):
+        with pytest.raises(ValueError, match="reserved|strings"):
+            mx.AttrScope(**{key: "v"})

@@ -230,3 +230,19 @@ def test_contrib_text_vocab_and_embedding(tmp_path):
     # glove from local file works
     g = text.embedding.create("glove", pretrained_file_path=str(p))
     assert g.vec_len == 2
+
+
+def test_update_token_vectors_atomic(tmp_path):
+    import numpy as np
+    import pytest
+    from mxnet_tpu.contrib import text
+    p = tmp_path / "e.txt"
+    p.write_text("a 1.0 1.0\nb 2.0 2.0\n")
+    emb = text.embedding.CustomEmbedding(str(p))
+    before = emb.get_vecs_by_tokens("a").asnumpy().copy()
+    with pytest.raises(Exception, match="not in the embedding"):
+        emb.update_token_vectors(["a", "missing"],
+                                 np.zeros((2, 2), np.float32))
+    # nothing written: the failed call must not half-mutate the table
+    np.testing.assert_array_equal(emb.get_vecs_by_tokens("a").asnumpy(),
+                                  before)

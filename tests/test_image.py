@@ -142,3 +142,25 @@ def test_image_det_iter_batches(tmp_path):
         assert ((valid[:, 1:] >= -1e-6) & (valid[:, 1:] <= 1 + 1e-6)).all()
         nb += 1
     assert nb == 3
+
+
+def test_random_hue_transform():
+    import numpy as np
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    rs = np.random.RandomState(0)
+    img = rs.randint(0, 255, (8, 8, 3)).astype(np.float32)
+    np.random.seed(0)
+    out = T.RandomHue(0.5)(img).asnumpy()
+    assert out.shape == img.shape
+    assert (out >= 0).all() and (out <= 255).all()
+    # hue rotation approximately preserves luma (Y of YIQ)
+    y_in = img @ np.array([0.299, 0.587, 0.114], np.float32)
+    y_out = out @ np.array([0.299, 0.587, 0.114], np.float32)
+    # clipped pixels distort slightly; compare medians
+    assert abs(np.median(y_in) - np.median(y_out)) < 15
+    # zero amount ≈ identity (truncated YIQ matrix constants leave ~0.2%)
+    same = T.RandomHue(0.0)(img).asnumpy()
+    np.testing.assert_allclose(same, np.clip(img, 0, 255), atol=1.0)
+    # jitter composes
+    j = T.RandomColorJitter(brightness=0.1, hue=0.2)
+    assert j(img).shape == img.shape
