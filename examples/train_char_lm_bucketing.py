@@ -1,0 +1,102 @@
+"""Bucketed language-model training with the legacy mx.rnn cell API
+(BASELINE config #4's workflow; reference: example/rnn/bucketing/
+lstm_bucketing.py).
+
+Variable-length token sequences bucket into a few padded lengths; the
+BucketingModule compiles ONE XLA executable per bucket (jit cache per
+shape — SURVEY.md §5.7) over a stacked LSTM built with
+mx.rnn.LSTMCell.unroll.
+
+    python examples/train_char_lm_bucketing.py --epochs 8
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import rnn
+
+CORPUS = (
+    "the quick brown fox jumps over the lazy dog . "
+    "a stitch in time saves nine . "
+    "all that glitters is not gold . "
+    "actions speak louder than words . "
+) * 4
+
+
+def build_data(batch_size, buckets):
+    words = CORPUS.split()
+    rng = np.random.RandomState(0)
+    sents = []
+    for i in range(0, len(words) - max(buckets), 2):
+        L = int(rng.choice(buckets))
+        sents.append(words[i:i + L])
+    coded, vocab = rnn.encode_sentences(sents, invalid_label=0,
+                                        start_label=1)
+    it = rnn.BucketSentenceIter(coded, batch_size, buckets=buckets,
+                                invalid_label=0)
+    return it, len(vocab) + 1
+
+
+def sym_gen_factory(vocab_size, emb_dim, hidden, num_layers):
+    def sym_gen(seq_len):
+        data = mx.sym.var("data")
+        label = mx.sym.var("softmax_label")
+        embed = mx.sym.Embedding(data, input_dim=vocab_size,
+                                 output_dim=emb_dim, name="embed")
+        stack = rnn.SequentialRNNCell()
+        for i in range(num_layers):
+            stack.add(rnn.LSTMCell(hidden, prefix=f"lstm_l{i}_"))
+        outputs, _ = stack.unroll(seq_len, embed, layout="NTC",
+                                  merge_outputs=True)
+        pred = mx.sym.reshape(outputs, shape=(-1, hidden))
+        pred = mx.sym.FullyConnected(pred, num_hidden=vocab_size,
+                                     name="pred")
+        label = mx.sym.reshape(label, shape=(-1,))
+        pred = mx.sym.SoftmaxOutput(pred, label, name="softmax")
+        return pred, ("data",), ("softmax_label",)
+    return sym_gen
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--epochs", type=int, default=8)
+    ap.add_argument("--batch-size", type=int, default=4)
+    ap.add_argument("--hidden", type=int, default=32)
+    ap.add_argument("--emb", type=int, default=16)
+    ap.add_argument("--layers", type=int, default=2)
+    ap.add_argument("--lr", type=float, default=0.03)
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the CPU backend")
+    args = ap.parse_args()
+    if args.cpu:
+        import jax
+        jax.config.update("jax_platforms", "cpu")
+
+    buckets = [4, 6]
+    it, vocab_size = build_data(args.batch_size, buckets)
+    print(f"vocab={vocab_size} buckets={buckets} "
+          f"default={it.default_bucket_key}")
+
+    mod = mx.mod.BucketingModule(
+        sym_gen_factory(vocab_size, args.emb, args.hidden, args.layers),
+        default_bucket_key=it.default_bucket_key,
+        context=mx.context.cpu())
+    metric = mx.metric.Perplexity(invalid_label=0)
+    mod.fit(it, num_epoch=args.epochs, eval_metric=metric,
+            optimizer="adam",
+            optimizer_params={"learning_rate": args.lr},
+            batch_end_callback=mx.callback.Speedometer(
+                args.batch_size, frequent=50))
+    score = dict(mod.score(it, mx.metric.Perplexity(invalid_label=0)))
+    print(f"final perplexity: {score['perplexity']:.3f}")
+    return 0 if score["perplexity"] < float(vocab_size) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,282 @@
+"""SequentialModule + PythonModule (reference:
+python/mxnet/module/sequential_module.py, python_module.py — SURVEY.md
+§2.5 Module API row).
+
+SequentialModule chains Modules: each stage's outputs become the next
+stage's data, with backward gradients flowing back through
+``out_grads``.  PythonModule is the computation-in-Python escape hatch
+(its canonical subclass PythonLossModule implements a loss head whose
+gradient is supplied in Python).
+"""
+from __future__ import annotations
+
+from typing import List, Optional
+
+from ..base import MXNetError
+from ..io import DataBatch, DataDesc
+from .base_module import BaseModule
+
+__all__ = ["SequentialModule", "PythonModule", "PythonLossModule"]
+
+
+class SequentialModule(BaseModule):
+    """Chain of modules executed in order (reference SequentialModule).
+
+    ``add(mod, take_labels=True)`` marks the stage that receives the
+    batch labels (typically the final loss stage)."""
+
+    META_TAKE_LABELS = "take_labels"
+    META_AUTO_WIRING = "auto_wiring"
+
+    def __init__(self, logger=None):
+        super().__init__(logger) if logger is not None else \
+            super().__init__()
+        self._modules: List[BaseModule] = []
+        self._metas: List[dict] = []
+        self._label_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    def add(self, module: BaseModule, **kwargs) -> "SequentialModule":
+        self._modules.append(module)
+        self._metas.append(kwargs)
+        return self
+
+    # -- shapes ------------------------------------------------------------
+    @property
+    def data_names(self):
+        return self._modules[0].data_names if self._modules else []
+
+    @property
+    def output_names(self):
+        return self._modules[-1].output_names if self._modules else []
+
+    @property
+    def data_shapes(self):
+        return self._modules[0].data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._modules[-1].output_shapes
+
+    # -- lifecycle ---------------------------------------------------------
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        if self.binded and not force_rebind:
+            return
+        if not self._modules:
+            raise MXNetError("SequentialModule.bind: no modules added")
+        self._label_shapes = label_shapes
+        cur_shapes = data_shapes
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            takes_labels = meta.get(self.META_TAKE_LABELS, False)
+            # stage 0 honors the CALLER's inputs_need_grad (reference
+            # behavior); later stages always need input grads to keep the
+            # backward chain flowing
+            mod.bind(cur_shapes,
+                     label_shapes if takes_labels else None,
+                     for_training=for_training,
+                     inputs_need_grad=inputs_need_grad if i == 0 else True,
+                     force_rebind=force_rebind)
+            if i == len(self._modules) - 1:
+                break
+            # next stage's data = this stage's outputs, wired by position
+            # onto the next module's declared data names; output shapes
+            # come from symbolic inference (no forward needed at bind)
+            shape_feed = {d.name: d.shape for d in cur_shapes}
+            _, out_shapes, _ = mod.symbol.infer_shape(**shape_feed)
+            nxt = self._modules[i + 1]
+            if len(nxt.data_names) != len(out_shapes):
+                raise MXNetError(
+                    f"SequentialModule: stage {i} emits "
+                    f"{len(out_shapes)} outputs but stage {i + 1} "
+                    f"declares {len(nxt.data_names)} data inputs")
+            cur_shapes = [DataDesc(n, s)
+                          for n, s in zip(nxt.data_names, out_shapes)]
+        self.binded = True
+
+    def init_params(self, initializer=None, arg_params=None,
+                    aux_params=None, allow_missing=False,
+                    force_init=False, **kwargs):
+        for mod in self._modules:
+            mod.init_params(initializer=initializer,
+                            arg_params=arg_params, aux_params=aux_params,
+                            allow_missing=True, force_init=force_init)
+        self.params_initialized = True
+
+    def init_optimizer(self, kvstore="local", optimizer="sgd",
+                       optimizer_params=None, force_init=False):
+        for mod in self._modules:
+            mod.init_optimizer(kvstore=kvstore, optimizer=optimizer,
+                               optimizer_params=optimizer_params,
+                               force_init=force_init)
+        self.optimizer_initialized = True
+
+    # -- execution ---------------------------------------------------------
+    def forward(self, data_batch, is_train=None):
+        batch = data_batch
+        for i, (mod, meta) in enumerate(zip(self._modules, self._metas)):
+            mod.forward(batch, is_train=is_train)
+            if i == len(self._modules) - 1:
+                break
+            outs = mod.get_outputs()
+            nxt = self._modules[i + 1]
+            batch = DataBatch(
+                data=outs,
+                label=data_batch.label,
+                pad=getattr(data_batch, "pad", 0),
+                provide_data=[DataDesc(n, o.shape)
+                              for n, o in zip(nxt.data_names, outs)],
+                provide_label=getattr(data_batch, "provide_label", None))
+
+    def backward(self, out_grads=None):
+        for i in reversed(range(len(self._modules))):
+            mod = self._modules[i]
+            mod.backward(out_grads=out_grads)
+            if i == 0:
+                break
+            if not hasattr(mod, "get_input_grads"):
+                # out_grads=None would mean ones-cotangents for the stage
+                # below — silently wrong gradients; fail loudly instead
+                raise MXNetError(
+                    f"SequentialModule stage {i} "
+                    f"({type(mod).__name__}) does not implement "
+                    "get_input_grads; the backward chain cannot continue")
+            out_grads = mod.get_input_grads()
+
+    def get_input_grads(self, merge_multi_context=True):
+        return self._modules[0].get_input_grads(merge_multi_context)
+
+    def update(self):
+        for mod in self._modules:
+            mod.update()
+
+    def get_outputs(self, merge_multi_context=True):
+        return self._modules[-1].get_outputs(merge_multi_context)
+
+    def get_params(self):
+        arg, aux = {}, {}
+        for mod in self._modules:
+            a, x = mod.get_params()
+            arg.update(a)
+            aux.update(x)
+        return arg, aux
+
+    def update_metric(self, eval_metric, labels):
+        for mod, meta in zip(self._modules, self._metas):
+            if meta.get(self.META_TAKE_LABELS, False):
+                mod.update_metric(eval_metric, labels)
+                return
+        self._modules[-1].update_metric(eval_metric, labels)
+
+
+class PythonModule(BaseModule):
+    """Module whose computation is written directly in Python (reference
+    PythonModule) — subclass and override ``forward``/``backward``."""
+
+    def __init__(self, data_names, label_names, output_names, logger=None):
+        super().__init__()
+        self._data_names = list(data_names)
+        self._label_names = list(label_names or [])
+        self._output_names = list(output_names)
+        self._data_shapes = None
+        self._label_shapes = None
+        self._output_shapes = None
+        self.binded = False
+        self.params_initialized = False
+        self.optimizer_initialized = False
+
+    @property
+    def data_names(self):
+        return self._data_names
+
+    @property
+    def output_names(self):
+        return self._output_names
+
+    @property
+    def data_shapes(self):
+        return self._data_shapes
+
+    @property
+    def label_shapes(self):
+        return self._label_shapes
+
+    @property
+    def output_shapes(self):
+        return self._output_shapes
+
+    def bind(self, data_shapes, label_shapes=None, for_training=True,
+             inputs_need_grad=False, force_rebind=False, **kwargs):
+        if self.binded and not force_rebind:
+            return
+        self._data_shapes = data_shapes
+        self._label_shapes = label_shapes
+        self._output_shapes = self._compute_output_shapes()
+        self.binded = True
+
+    def _compute_output_shapes(self):
+        raise NotImplementedError
+
+    # parameter-free by default (the reference convention)
+    def init_params(self, *args, **kwargs):
+        self.params_initialized = True
+
+    def init_optimizer(self, *args, **kwargs):
+        self.optimizer_initialized = True
+
+    def get_params(self):
+        return {}, {}
+
+    def update(self):
+        pass
+
+    def update_metric(self, eval_metric, labels):
+        if self._label_shapes is not None:
+            eval_metric.update(labels, self.get_outputs())
+
+
+class PythonLossModule(PythonModule):
+    """Loss head in Python (reference PythonLossModule): forward caches
+    the scores, ``backward`` computes the gradient with a user function
+    (default: identity pass-through of scores as CE-style grads)."""
+
+    def __init__(self, name="pyloss", data_names=("data",),
+                 label_names=("softmax_label",), logger=None,
+                 grad_func=None):
+        super().__init__(data_names, label_names,
+                         [name + "_output"], logger)
+        self._name = name
+        self._scores = None
+        self._labels = None
+        self._scores_grad = None
+        self._grad_func = grad_func
+
+    def _compute_output_shapes(self):
+        return [(self._name + "_output", self._data_shapes[0].shape)]
+
+    def forward(self, data_batch, is_train=None):
+        self._scores = data_batch.data[0]
+        if data_batch.label:
+            self._labels = data_batch.label[0]
+
+    def get_outputs(self, merge_multi_context=True):
+        return [self._scores]
+
+    def backward(self, out_grads=None):
+        if self._grad_func is not None:
+            self._scores_grad = self._grad_func(self._scores, self._labels)
+        else:
+            from .. import ndarray as nd
+            # default: softmax CE gradient p - onehot(label)
+            p = nd.softmax(self._scores)
+            oh = nd.one_hot(self._labels, depth=self._scores.shape[-1])
+            self._scores_grad = p - oh
+
+    def get_input_grads(self, merge_multi_context=True):
+        return [self._scores_grad]

@@ -10,7 +10,8 @@ from __future__ import annotations
 import sys as _sys
 
 from .ndarray import (NDArray, array, from_jax, zeros, ones, empty, full,
-                      arange, linspace, zeros_like as _zeros_like_ctor,
+                      arange, linspace, eye, moveaxis,
+                      zeros_like as _zeros_like_ctor,
                       ones_like as _ones_like_ctor)
 from . import register as _register_mod
 from .register import (get_op, list_ops, invoke_by_name, make_frontend,

@@ -624,6 +624,22 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArra
     return NDArray(val, ctx=ctx)
 
 
+def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
+    """Identity-like matrix (reference mx.nd.eye: M=0 means square)."""
+    import jax
+    ctx = ctx if ctx is not None else current_context()
+    with jax.default_device(ctx.device):
+        val = _jnp().eye(int(N), int(M) if M else int(N), k=int(k),
+                         dtype=dtype_np(dtype))
+    return NDArray(val, ctx=ctx)
+
+
+def moveaxis(data: "NDArray", source, destination) -> NDArray:
+    """Reference mx.nd.moveaxis — thin transpose wrapper."""
+    return NDArray(_jnp().moveaxis(data._read(), source, destination),
+                   ctx=data.context)
+
+
 def linspace(start, stop, num, endpoint=True, ctx=None,
              dtype=None) -> NDArray:
     import jax

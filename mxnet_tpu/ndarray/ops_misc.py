@@ -431,5 +431,34 @@ def _register():
         return fn
     register_op("fill_element_0index", fill_element_0index_maker)
 
+    # ---- SoftmaxActivation (deprecated-but-present reference op) ---------
+    def softmax_activation_maker(mode="instance"):
+        def fn(x):
+            if mode == "channel":
+                return jax.nn.softmax(x, axis=1)
+            return jax.nn.softmax(x.reshape(x.shape[0], -1),
+                                  axis=-1).reshape(x.shape)
+        return fn
+    register_op("SoftmaxActivation", softmax_activation_maker,
+                aliases=("softmax_activation",))
+
+    # ---- _square_sum (reference: square_sum.cc — fused LARS ingredient) --
+    def square_sum_maker(axis=None, keepdims=False, exclude=False):
+        def fn(x):
+            ax = tuple(axis) if isinstance(axis, (list, tuple)) else \
+                (axis,) if axis is not None else None
+            if ax is not None and exclude:
+                norm = {a % x.ndim for a in ax}   # exclude needs
+                ax = tuple(i for i in range(x.ndim)  # non-negative dims
+                           if i not in norm)
+            return jnp.sum(jnp.square(x), axis=ax, keepdims=keepdims)
+        return fn
+    register_op("_square_sum", square_sum_maker, aliases=("square_sum",))
+
+    # ---- reference alias names for broadcast arithmetic ------------------
+    from .register import _registry as _reg
+    _reg["broadcast_plus"] = _reg["broadcast_add"]
+    _reg["broadcast_minus"] = _reg["broadcast_sub"]
+
 
 _register()

@@ -73,11 +73,7 @@ def apply_op(opname: str, args: List[Symbol], kwargs: Dict[str, Any],
     from .. import name as _name
     node_name = _name.current().get(name or kwargs.get("name"),
                                     canonical.lower().lstrip("_"))
-    scope_attrs = _attribute.current().get()
-    if scope_attrs:
-        merged = dict(scope_attrs)
-        merged.update(attrs)
-        attrs = merged
+    attrs = _attribute.current().get(attrs)
     attrs.pop("name", None)
 
     inputs: List = []

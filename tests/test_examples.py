@@ -31,3 +31,10 @@ def test_example_imagenet_style_runs(tmp_path):
               "--rec", rec])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "exported" in r.stdout
+
+
+def test_example_char_lm_bucketing_runs():
+    r = _run(["examples/train_char_lm_bucketing.py", "--epochs", "4",
+              "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "final perplexity" in r.stdout

@@ -499,3 +499,44 @@ def test_sample_mixed_scalar_array_params():
     assert not np.allclose(a[0] / 1.0, a[2] / 3.0)
     for i, hi in enumerate([1., 2., 3.]):
         assert (a[i] >= 0).all() and (a[i] <= hi).all()
+
+
+def test_softmax_activation_square_sum_aliases_eye_moveaxis():
+    import torch
+    rs = np.random.RandomState(0)
+    x = rs.randn(2, 3, 4, 4).astype(np.float32)
+    # channel mode = softmax over axis 1
+    out = nd.SoftmaxActivation(nd.array(x), mode="channel").asnumpy()
+    ref = torch.softmax(torch.tensor(x), dim=1).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+    # instance mode = softmax over flattened non-batch dims
+    out = nd.SoftmaxActivation(nd.array(x)).asnumpy()
+    ref = torch.softmax(torch.tensor(x).reshape(2, -1), dim=-1) \
+        .reshape(2, 3, 4, 4).numpy()
+    np.testing.assert_allclose(out, ref, rtol=1e-5, atol=1e-6)
+
+    m = rs.randn(3, 4).astype(np.float32)
+    np.testing.assert_allclose(
+        nd.square_sum(nd.array(m), axis=1).asnumpy(),
+        (m ** 2).sum(1), rtol=1e-5)
+    np.testing.assert_allclose(
+        nd.square_sum(nd.array(m)).asnumpy(), (m ** 2).sum(), rtol=1e-5)
+
+    a, b = nd.array([[1., 2.]]), nd.array([[3.], [4.]])
+    np.testing.assert_allclose(nd.broadcast_plus(a, b).asnumpy(),
+                               [[4., 5.], [5., 6.]])
+    np.testing.assert_allclose(nd.broadcast_minus(a, b).asnumpy(),
+                               [[-2., -1.], [-3., -2.]])
+
+    np.testing.assert_allclose(nd.eye(3).asnumpy(), np.eye(3))
+    np.testing.assert_allclose(nd.eye(2, 4, 1).asnumpy(), np.eye(2, 4, 1))
+    z = nd.array(rs.randn(2, 3, 4).astype(np.float32))
+    np.testing.assert_allclose(nd.moveaxis(z, 0, 2).asnumpy(),
+                               np.moveaxis(z.asnumpy(), 0, 2))
+
+
+def test_square_sum_exclude_negative_axis():
+    x = np.random.RandomState(0).randn(2, 3, 4).astype(np.float32)
+    out = nd.square_sum(nd.array(x), axis=-1, exclude=True).asnumpy()
+    assert out.shape == (4,)
+    np.testing.assert_allclose(out, (x ** 2).sum((0, 1)), rtol=1e-5)
