@@ -115,12 +115,33 @@ class MultiHeadAttention(HybridBlock):
         k = self._split_heads(F, k, b, sk)
         v = self._split_heads(F, v, b, sk)
         scale = 1.0 / math.sqrt(self._units // self._heads)
-        scores = F.batch_dot(q, k, transpose_b=True) * scale
-        att = _masked_softmax(F, scores, mask)
-        if self.drop is not None:
-            att = self.drop(att)
-        out = F.batch_dot(att, v)
+        if self._flash_eligible(F, mask):
+            # tiled online-softmax Pallas kernel: no (Lq, Lk) score matrix
+            # in HBM (kernels/flash_attention.py); inference-only for now
+            out = F.flash_attention(q, k, v, scale=scale)
+        else:
+            scores = F.batch_dot(q, k, transpose_b=True) * scale
+            att = _masked_softmax(F, scores, mask)
+            if self.drop is not None:
+                att = self.drop(att)
+            out = F.batch_dot(att, v)
         return self.proj(self._merge_heads(F, out, b, sq))
+
+    def _flash_eligible(self, F, mask) -> bool:
+        # env-gated (MXNET_USE_FLASH_ATTENTION=1), unmasked, inference
+        # only (the kernel has no backward yet; attention dropout is an
+        # identity outside autograd.record, so a dropout>0 CONSTRUCTION
+        # does not disqualify inference), imperative mode only
+        import os
+        if os.environ.get("MXNET_USE_FLASH_ATTENTION", "0") != "1":
+            return False
+        if mask is not None:
+            return False
+        if not hasattr(F, "flash_attention") or \
+                not hasattr(F, "NDArray"):
+            return False
+        from ... import autograd
+        return not autograd.is_recording()
 
 
 class PositionwiseFFN(HybridBlock):

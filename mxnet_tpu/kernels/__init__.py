@@ -8,5 +8,6 @@ also runs under the Pallas interpreter so the CPU test mesh exercises the
 same code path.
 """
 from .multi_sgd import fused_multi_sgd, fused_multi_sgd_mom
+from .flash_attention import flash_attention
 
-__all__ = ["fused_multi_sgd", "fused_multi_sgd_mom"]
+__all__ = ["fused_multi_sgd", "fused_multi_sgd_mom", "flash_attention"]

@@ -1,0 +1,150 @@
+"""Flash attention as a Pallas TPU kernel.
+
+Reference context: the reference's attention rides cuDNN/hand-CUDA
+softmax(QKᵀ)V with the full (Lq, Lk) score matrix in HBM; the TPU-native
+answer is the tiled online-softmax formulation (Flash Attention), which
+never materializes the score matrix: each grid step owns one
+(BLOCK_Q, D) query tile in VMEM and streams K/V tiles through the MXU,
+carrying the running max/denominator.  HBM traffic drops from
+O(Lq·Lk) to O(Lq·D + Lk·D) — exactly the memory-bound regime SURVEY §6
+flags for long sequences (ring attention in parallel/ring.py handles the
+multi-chip axis; this kernel is the single-chip inner loop).
+
+Grid: (batch·heads, Lq/BLOCK_Q); the K/V sweep is a lax.fori_loop inside
+the kernel over VMEM-resident K/V (one head's K/V must fit VMEM — fine
+through Lk·D ≈ 512k fp32 elements; beyond that, shard Lk over the ring).
+
+Numerics: f32 accumulation regardless of input dtype; causal masking and
+right-padding masks derive from 2-D broadcasted_iota (TPU requires ≥2-D
+iota).  Interpret mode runs the same kernel on CPU (tests/conftest mesh);
+Mosaic compiles it on the chip (tests/test_kernels_tpu.py).
+"""
+from __future__ import annotations
+
+import functools
+
+BLOCK_Q = 128
+BLOCK_K = 128
+_NEG_INF = -1e30
+
+
+def _interpret(example=None) -> bool:
+    from .multi_sgd import _interpret as _i
+    return _i(example)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
+                valid_lk: int, causal: bool, scale: float,
+                dtype_name: str, interpret: bool):
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.experimental import pallas as pl
+
+    nq = lq // BLOCK_Q
+    nk = lk // BLOCK_K
+    dtype = jnp.dtype(dtype_name)
+
+    def kernel(q_ref, k_ref, v_ref, o_ref):
+        qi = pl.program_id(1)
+        q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+
+        def body(ki, carry):
+            m, l, acc = carry
+            k_blk = k_ref[0, pl.dslice(ki * BLOCK_K, BLOCK_K)].astype(
+                jnp.float32)                               # (BK, D)
+            v_blk = v_ref[0, pl.dslice(ki * BLOCK_K, BLOCK_K)].astype(
+                jnp.float32)
+            s = jax.lax.dot_general(
+                q, k_blk, (((1,), (1,)), ((), ())),
+                preferred_element_type=jnp.float32)        # (BQ, BK)
+            # mask K padding (and the causal upper triangle)
+            k_idx = ki * BLOCK_K + lax.broadcasted_iota(
+                jnp.int32, (BLOCK_Q, BLOCK_K), 1)
+            mask = k_idx < valid_lk
+            if causal:
+                # bottom-right alignment (the flash/decode convention and
+                # this repo's reference): query i sits at absolute key
+                # position (valid_lk - valid_lq + i), so Lq=1 against a
+                # length-N cache attends ALL N keys
+                q_idx = qi * BLOCK_Q + lax.broadcasted_iota(
+                    jnp.int32, (BLOCK_Q, BLOCK_K), 0)
+                mask = mask & (k_idx <= q_idx + (valid_lk - valid_lq))
+            s = jnp.where(mask, s, _NEG_INF)
+            m_new = jnp.maximum(m, jnp.max(s, axis=1))
+            p = jnp.exp(s - m_new[:, None])
+            corr = jnp.exp(m - m_new)
+            l_new = l * corr + jnp.sum(p, axis=1)
+            acc_new = acc * corr[:, None] + jax.lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32)
+            return m_new, l_new, acc_new
+
+        m0 = jnp.full((BLOCK_Q,), _NEG_INF, jnp.float32)
+        l0 = jnp.zeros((BLOCK_Q,), jnp.float32)
+        a0 = jnp.zeros((BLOCK_Q, d), jnp.float32)
+        m, l, acc = lax.fori_loop(0, nk, body, (m0, l0, a0))
+        # rows with no valid keys (padded queries) divide by 1 instead
+        l = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc / l[:, None]).astype(dtype)
+
+    q_spec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0))
+    kv_spec = pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0))
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, nq),
+        in_specs=[q_spec, kv_spec, kv_spec],
+        out_specs=q_spec,
+        out_shape=jax.ShapeDtypeStruct((bh, lq, d), dtype),
+        interpret=interpret,
+    )
+
+
+def flash_attention(q, k, v, causal: bool = False, scale=None,
+                    interpret=None):
+    """Tiled attention: softmax(scale·QKᵀ + mask)V without materializing
+    the score matrix.
+
+    Accepts (B, H, L, D) or (BH, L, D); Lq/Lk/D are padded internally to
+    tile multiples (K padding is masked exactly, never approximated).
+    """
+    import jax.numpy as jnp
+
+    squeeze4 = q.ndim == 4
+    if squeeze4:
+        b, h, lq, dd = q.shape
+        q = q.reshape(b * h, lq, dd)
+        k = k.reshape(b * h, k.shape[2], dd)
+        v = v.reshape(b * h, v.shape[2], dd)
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    if interpret is None:
+        interpret = _interpret(q)
+
+    def pad_to(x, axis, mult):
+        n = x.shape[axis]
+        pad = (-n) % mult
+        if pad == 0:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        return jnp.pad(x, widths)
+
+    qp = pad_to(q, 1, BLOCK_Q)
+    kp = pad_to(k, 1, BLOCK_K)
+    vp = pad_to(v, 1, BLOCK_K)
+    # lanes: last dim to a 128 multiple (zero features change nothing)
+    qp = pad_to(qp, 2, 128)
+    kp = pad_to(kp, 2, 128)
+    vp = pad_to(vp, 2, 128)
+
+    call = _build_call(bh, qp.shape[1], kp.shape[1], qp.shape[2], lq, lk,
+                       bool(causal), float(scale),
+                       jnp.result_type(q).name, bool(interpret))
+    out = call(qp, kp, vp)[:, :lq, :d]
+    if squeeze4:
+        out = out.reshape(b, h, lq, d)
+    return out

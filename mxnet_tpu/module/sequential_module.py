@@ -86,10 +86,15 @@ class SequentialModule(BaseModule):
             if i == len(self._modules) - 1:
                 break
             # next stage's data = this stage's outputs, wired by position
-            # onto the next module's declared data names; output shapes
-            # come from symbolic inference (no forward needed at bind)
-            shape_feed = {d.name: d.shape for d in cur_shapes}
-            _, out_shapes, _ = mod.symbol.infer_shape(**shape_feed)
+            # onto the next module's declared data names; symbolic stages
+            # infer shapes from the graph, PythonModule-style stages
+            # report them via output_shapes (computed by their bind)
+            if hasattr(mod, "symbol"):
+                shape_feed = {d.name: d.shape for d in cur_shapes}
+                _, out_shapes, _ = mod.symbol.infer_shape(**shape_feed)
+            else:
+                out_shapes = [s if not isinstance(s, tuple) else s[1]
+                              for s in mod.output_shapes]
             nxt = self._modules[i + 1]
             if len(nxt.data_names) != len(out_shapes):
                 raise MXNetError(

@@ -844,6 +844,21 @@ def _register_round3b():
     register_op("_contrib_index_array", index_array_maker,
                 aliases=("index_array",), differentiable=False)
 
+    # ---- flash attention (kernels/flash_attention.py Pallas kernel) ------
+    # Inference path: the Pallas forward has no hand-written backward yet,
+    # so the op is non-differentiable; training attention stays on the
+    # XLA softmax(QKᵀ)V path.  Eager dispatch (use_jit=False) keeps the
+    # Mosaic-vs-interpret choice keyed on the data's actual device.
+    def flash_attention_maker(causal=False, scale=None):
+        from ..kernels import flash_attention as _fa
+
+        def fn(q, k, v):
+            return _fa(q, k, v, causal=causal, scale=scale)
+        return fn
+    register_op("_contrib_flash_attention", flash_attention_maker,
+                aliases=("flash_attention",), differentiable=False,
+                use_jit=False)
+
     # ---- allclose --------------------------------------------------------
     def allclose_maker(rtol=1e-5, atol=1e-8, equal_nan=False):
         def fn(a, b):

@@ -115,8 +115,8 @@ def _register_binary():
         "broadcast_hypot": jnp.hypot,
     }
     alias = {
-        "broadcast_add": ("elemwise_add", "_plus"),
-        "broadcast_sub": ("elemwise_sub", "_minus"),
+        "broadcast_add": ("elemwise_add", "_plus", "broadcast_plus"),
+        "broadcast_sub": ("elemwise_sub", "_minus", "broadcast_minus"),
         "broadcast_mul": ("elemwise_mul",),
         "broadcast_div": ("elemwise_div",),
         "broadcast_power": ("_power", "pow"),

@@ -455,10 +455,7 @@ def _register():
         return fn
     register_op("_square_sum", square_sum_maker, aliases=("square_sum",))
 
-    # ---- reference alias names for broadcast arithmetic ------------------
-    from .register import _registry as _reg
-    _reg["broadcast_plus"] = _reg["broadcast_add"]
-    _reg["broadcast_minus"] = _reg["broadcast_sub"]
+
 
 
 _register()

@@ -140,3 +140,109 @@ def test_lr_schedule_does_not_retrace():
         nd.multi_sgd_update(w, g, lrs, wds, num_weights=1)
     after = op._fn_cached.cache_info().misses
     assert after - before <= 1
+
+
+def _ref_attn(q, k, v, causal=False, scale=None):
+    if scale is None:
+        scale = 1.0 / np.sqrt(q.shape[-1])
+    s = (q * scale) @ np.swapaxes(k, -1, -2)
+    if causal:
+        lq, lk = s.shape[-2:]
+        mask = np.tril(np.ones((lq, lk), bool), lk - lq)
+        s = np.where(mask, s, -1e30)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p = p / p.sum(-1, keepdims=True)
+    return p @ v
+
+
+def test_flash_attention_matches_reference():
+    """Tiled online-softmax kernel == full softmax(QKᵀ)V, including
+    cross-attention lengths and causal masking (kernels/flash_attention)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels import flash_attention
+
+    rs = np.random.RandomState(0)
+    q = rs.randn(2, 256, 128).astype(np.float32)
+    k = rs.randn(2, 384, 128).astype(np.float32)
+    v = rs.randn(2, 384, 128).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q), jnp.array(k),
+                                     jnp.array(v)))
+    np.testing.assert_allclose(out, _ref_attn(q, k, v), atol=2e-5)
+
+    q2 = rs.randn(1, 256, 128).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q2), jnp.array(q2),
+                                     jnp.array(q2), causal=True))
+    np.testing.assert_allclose(out, _ref_attn(q2, q2, q2, causal=True),
+                               atol=2e-5)
+
+
+def test_flash_attention_ragged_and_4d():
+    """Non-tile-multiple L/D get padded internally with exact K masking;
+    (B, H, L, D) inputs round-trip."""
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels import flash_attention
+
+    rs = np.random.RandomState(1)
+    q = rs.randn(3, 100, 64).astype(np.float32)
+    k = rs.randn(3, 75, 64).astype(np.float32)
+    v = rs.randn(3, 75, 64).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q), jnp.array(k),
+                                     jnp.array(v)))
+    np.testing.assert_allclose(out, _ref_attn(q, k, v), atol=2e-5)
+
+    q4 = rs.randn(2, 4, 128, 32).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q4), jnp.array(q4),
+                                     jnp.array(q4), causal=True))
+    assert out.shape == (2, 4, 128, 32)
+    np.testing.assert_allclose(out, _ref_attn(q4, q4, q4, causal=True),
+                               atol=2e-5)
+
+
+def test_flash_attention_op_and_transformer_path(monkeypatch):
+    """The registered _contrib_flash_attention op and the env-gated
+    MultiHeadAttention inference path must match the XLA softmax path."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import MultiHeadAttention
+
+    rs = np.random.RandomState(2)
+    q = mx.nd.array(rs.randn(2, 40, 16).astype(np.float32))
+    k = mx.nd.array(rs.randn(2, 30, 16).astype(np.float32))
+    v = mx.nd.array(rs.randn(2, 30, 16).astype(np.float32))
+    out = mx.nd.flash_attention(q, k, v).asnumpy()
+    np.testing.assert_allclose(
+        out, _ref_attn(q.asnumpy(), k.asnumpy(), v.asnumpy(),
+                       scale=1.0 / np.sqrt(16)), atol=2e-5)
+
+    att = MultiHeadAttention(units=32, num_heads=4)
+    att.initialize()
+    x = mx.nd.array(rs.randn(2, 20, 32).astype(np.float32))
+    base = att(x).asnumpy()
+    monkeypatch.setenv("MXNET_USE_FLASH_ATTENTION", "1")
+    flash = att(x).asnumpy()
+    np.testing.assert_allclose(flash, base, atol=3e-5)
+
+
+def test_flash_attention_causal_decode_alignment():
+    """Causal masking must be bottom-right aligned: a 1-token query
+    against an N-token KV cache (decode step) attends ALL N keys, and
+    Lq<Lk generally offsets by Lk-Lq (review regression)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels import flash_attention
+
+    rs = np.random.RandomState(3)
+    # decode: Lq=1 vs cache of 16
+    q = rs.randn(1, 1, 32).astype(np.float32)
+    k = rs.randn(1, 16, 32).astype(np.float32)
+    v = rs.randn(1, 16, 32).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q), jnp.array(k),
+                                     jnp.array(v), causal=True))
+    np.testing.assert_allclose(out, _ref_attn(q, k, v, causal=True),
+                               atol=2e-5)
+    # general Lq < Lk
+    q = rs.randn(2, 4, 32).astype(np.float32)
+    k = rs.randn(2, 16, 32).astype(np.float32)
+    v = rs.randn(2, 16, 32).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q), jnp.array(k),
+                                     jnp.array(v), causal=True))
+    np.testing.assert_allclose(out, _ref_attn(q, k, v, causal=True),
+                               atol=2e-5)

@@ -108,3 +108,24 @@ def test_trainer_update_multi_runs_kernel_on_tpu():
         if l0 is None:
             l0 = float(L.asnumpy())
     assert float(L.asnumpy()) < l0
+
+
+def test_flash_attention_mosaic_compiles_and_matches():
+    """Mosaic-compile the flash-attention kernel on the chip; outputs
+    must match the full-softmax XLA reference computed on-device."""
+    import jax
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels import flash_attention
+
+    rs = np.random.default_rng(0)
+    q = jnp.asarray(rs.standard_normal((2, 256, 128), np.float32))
+    k = jnp.asarray(rs.standard_normal((2, 256, 128), np.float32))
+    v = jnp.asarray(rs.standard_normal((2, 256, 128), np.float32))
+    out = flash_attention(q, k, v, causal=True)   # Mosaic path on TPU
+    scale = 1.0 / np.sqrt(128)
+    s = (q * scale) @ jnp.swapaxes(k, -1, -2)
+    mask = jnp.tril(jnp.ones((256, 256), bool))
+    s = jnp.where(mask, s, -1e30)
+    ref = jax.nn.softmax(s, axis=-1) @ v
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=3e-5)

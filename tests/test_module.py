@@ -305,3 +305,47 @@ def test_sequential_module_exposes_input_grads():
     grads = seq.get_input_grads()
     assert grads[0].shape == (32, 5)
     assert float(mx.nd.sum(mx.nd.abs(grads[0])).asnumpy()) > 0
+
+
+def test_sequential_module_python_stage_mid_chain():
+    """A PythonModule stage anywhere but last must bind (shapes come from
+    its output_shapes, not a symbol) — review regression."""
+    class ScaleModule(mx.mod.PythonModule):
+        """Identity×2 stage with a hand-written gradient."""
+
+        def __init__(self):
+            super().__init__(("data",), (), ("scaled_output",))
+            self._x = None
+
+        def _compute_output_shapes(self):
+            return [("scaled_output", self._data_shapes[0].shape)]
+
+        def forward(self, data_batch, is_train=None):
+            self._x = data_batch.data[0]
+
+        def get_outputs(self, merge_multi_context=True):
+            return [self._x * 2.0]
+
+        def backward(self, out_grads=None):
+            self._g = [g * 2.0 for g in out_grads]
+
+        def get_input_grads(self, merge_multi_context=True):
+            return self._g
+
+    x, y = _toy_data(n=64, d=6, k=3)
+    feat = mx.sym.FullyConnected(mx.sym.var("data"), num_hidden=8,
+                                 name="f")
+    head = mx.sym.SoftmaxOutput(
+        mx.sym.FullyConnected(mx.sym.var("h"), num_hidden=3, name="c"),
+        mx.sym.var("softmax_label"), name="softmax")
+    seq = mx.mod.SequentialModule()
+    seq.add(mx.mod.Module(feat, data_names=("data",), label_names=(),
+                          context=mx.context.cpu()))
+    seq.add(ScaleModule())
+    seq.add(mx.mod.Module(head, data_names=("h",),
+                          context=mx.context.cpu()), take_labels=True)
+    it = mio.NDArrayIter(x, y, batch_size=32, shuffle=True)
+    seq.fit(it, num_epoch=15, optimizer="adam",
+            optimizer_params={"learning_rate": 0.05})
+    score = dict(seq.score(mio.NDArrayIter(x, y, batch_size=32), "acc"))
+    assert score["accuracy"] > 0.9, score
