@@ -141,7 +141,9 @@ class MultiHeadAttention(HybridBlock):
                 not hasattr(F, "NDArray"):
             return False
         from ... import autograd
-        return not autograd.is_recording()
+        # dropout activates under train_mode (not just record), so MC-
+        # dropout inference must keep the XLA path where self.drop runs
+        return not (autograd.is_recording() or autograd.is_training())
 
 
 class PositionwiseFFN(HybridBlock):
@@ -337,6 +339,97 @@ class TransformerNMT(HybridBlock):
         mem = self.encoder(self.word_embed(src) * scale, src_mask)
         dec = self.decoder(self.word_embed(tgt) * scale, mem, src_mask)
         return self.out_proj(dec)
+
+    # -- inference (the Sockeye translate workflow, config #4) -------------
+    def _decode_logits(self, F, tgt, mem, src_mask):
+        scale = math.sqrt(self._units)
+        dec = self.decoder(self.word_embed(tgt) * scale, mem, src_mask)
+        return self.out_proj(dec)
+
+    def translate(self, src, bos: int, eos: int, max_len: int = 50,
+                  beam_size: int = 1, alpha: float = 0.6,
+                  src_mask=None):
+        """Greedy (beam_size=1) or length-normalized beam-search decoding
+        (reference workflow: Sockeye's translate CLI over the same
+        encoder-decoder; scores use the GNMT length penalty with
+        ``alpha``).
+
+        The prefix grows step by step and the decoder re-runs on it —
+        per-step jit caches keyed by prefix length keep every step
+        compiled (the bucketing discipline of §5.7); the decode-aligned
+        flash kernel covers the long-cache regime when enabled.
+
+        Returns (tokens, scores): a list per batch row (EOS stripped)."""
+        import numpy as _np
+
+        from ... import ndarray as nd
+
+        scale = math.sqrt(self._units)
+        mem = self.encoder(self.word_embed(src) * scale, src_mask)
+        b = src.shape[0]
+        mem_np_ctx = src.context
+
+        if beam_size <= 1:
+            tgt = nd.full((b, 1), bos, ctx=mem_np_ctx)
+            finished = _np.zeros((b,), bool)
+            logprob = _np.zeros((b,), _np.float64)
+            steps = _np.zeros((b,), _np.int64)
+            for _ in range(max_len):
+                logits = self._decode_logits(nd, tgt, mem, src_mask)
+                logp = nd.log_softmax(logits[:, -1, :]).asnumpy()
+                nxt = logp.argmax(-1)
+                nxt = _np.where(finished, eos, nxt)
+                logprob += _np.where(finished, 0.0,
+                                     logp[_np.arange(b), nxt])
+                steps += (~finished).astype(_np.int64)
+                finished |= (nxt == eos)
+                tgt = nd.concat(tgt, nd.array(nxt.reshape(b, 1),
+                                              ctx=mem_np_ctx), dim=1)
+                if finished.all():
+                    break
+            out = []
+            for row in tgt.asnumpy()[:, 1:].astype(int).tolist():
+                out.append(row[:row.index(eos)] if eos in row else row)
+            # same GNMT length normalization as the beam path, so greedy
+            # and beam scores are comparable
+            lens = _np.maximum(steps, 1)
+            scores = logprob / (((5 + lens) / 6.0) ** alpha)
+            return out, [float(s) for s in scores]
+
+        # beam search, one source row at a time (clarity over batching;
+        # the per-length jit cache is shared across rows and steps)
+        def norm(entry):
+            toks, lp, _ = entry
+            length = max(len(toks) - 1, 1)
+            return lp / (((5 + length) / 6.0) ** alpha)
+
+        results, scores = [], []
+        for i in range(b):
+            mem_i = mem[i:i + 1]
+            mask_i = None if src_mask is None else src_mask[i:i + 1]
+            beams = [([bos], 0.0, False)]
+            for _ in range(max_len):
+                if all(f for _, _, f in beams):
+                    break
+                cand = []
+                for toks, lp, fin in beams:
+                    if fin:
+                        cand.append((toks, lp, True))
+                        continue
+                    tgt = nd.array(_np.asarray([toks]), ctx=mem_np_ctx)
+                    logits = self._decode_logits(nd, tgt, mem_i, mask_i)
+                    logp = nd.log_softmax(logits[0, -1, :]).asnumpy()
+                    top = _np.argsort(logp)[-beam_size:]
+                    for t in top:
+                        cand.append((toks + [int(t)], lp + float(logp[t]),
+                                     int(t) == eos))
+                cand.sort(key=norm, reverse=True)
+                beams = cand[:beam_size]
+            best, best_lp, _ = max(beams, key=norm)
+            row = best[1:]
+            results.append(row[:row.index(eos)] if eos in row else row)
+            scores.append(norm((best, best_lp, True)))
+        return results, scores
 
 
 class BERTEncoder(TransformerEncoder):

@@ -74,6 +74,15 @@ def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
             s = jnp.where(mask, s, _NEG_INF)
             m_new = jnp.maximum(m, jnp.max(s, axis=1))
             p = jnp.exp(s - m_new[:, None])
+            # rows whose every key is masked (causal bound < 0): the
+            # reference softmaxes a uniform -NEG_INF row, i.e. uniform
+            # attention over the valid_lk keys — exp(0)=1 here would
+            # instead spread over PADDED slots, so substitute the valid
+            # mask as the weights (masks are prefixes, so a row dead in
+            # this block is dead in every block)
+            dead = m_new <= (_NEG_INF * 0.5)
+            p = jnp.where(dead[:, None],
+                          (k_idx < valid_lk).astype(jnp.float32), p)
             corr = jnp.exp(m - m_new)
             l_new = l * corr + jnp.sum(p, axis=1)
             acc_new = acc * corr[:, None] + jax.lax.dot_general(

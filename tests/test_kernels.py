@@ -246,3 +246,22 @@ def test_flash_attention_causal_decode_alignment():
                                      jnp.array(v), causal=True))
     np.testing.assert_allclose(out, _ref_attn(q, k, v, causal=True),
                                atol=2e-5)
+
+
+def test_flash_attention_causal_lq_gt_lk_dead_rows():
+    """valid_lq > valid_lk under causal: early queries have NO unmasked
+    keys; the reference degenerates to uniform attention over the valid
+    keys — padded slots must not absorb weight (review regression)."""
+    import jax.numpy as jnp
+    from mxnet_tpu.kernels import flash_attention
+
+    rs = np.random.RandomState(4)
+    q = rs.randn(1, 8, 32).astype(np.float32)
+    k = rs.randn(1, 4, 32).astype(np.float32)
+    v = rs.randn(1, 4, 32).astype(np.float32)
+    out = np.asarray(flash_attention(jnp.array(q), jnp.array(k),
+                                     jnp.array(v), causal=True))
+    np.testing.assert_allclose(out, _ref_attn(q, k, v, causal=True),
+                               atol=2e-5)
+    # rows 0..3 (bound < 0) must equal mean of the 4 valid V rows
+    np.testing.assert_allclose(out[0, 0], v[0].mean(0), atol=2e-5)

@@ -98,3 +98,92 @@ def test_bert_tp_sp_training():
         losses.append(
             float(tr.step((toks, types, valid), labels).asnumpy()))
     assert losses[-1] < losses[0], losses
+
+
+def test_nmt_translate_greedy_and_beam():
+    """translate() (the Sockeye workflow, config #4): a copy-task model
+    must reproduce source tokens through greedy and beam decoding."""
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, gluon, nd
+    from mxnet_tpu.gluon.model_zoo.transformer import TransformerNMT
+
+    V, BOS, EOS, L = 8, 1, 2, 4
+    rs = np.random.RandomState(0)
+    net = TransformerNMT(vocab_size=V, num_layers=1, units=32,
+                         hidden_size=64, num_heads=4, max_length=16,
+                         dropout=0.0)
+    net.initialize(mx.init.Xavier())
+    tr = gluon.Trainer(net.collect_params(), "adam",
+                       {"learning_rate": 5e-3})
+    lf = gluon.loss.SoftmaxCrossEntropyLoss()
+
+    def make_batch(n=32):
+        src = rs.randint(3, V, (n, L))
+        tgt_in = np.concatenate([np.full((n, 1), BOS), src], 1)
+        tgt_out = np.concatenate([src, np.full((n, 1), EOS)], 1)
+        return nd.array(src), nd.array(tgt_in), nd.array(tgt_out)
+
+    for _ in range(260):
+        src, ti, to = make_batch()
+        with autograd.record():
+            logits = net(src, ti)
+            loss = nd.mean(lf(nd.reshape(logits, shape=(-1, V)),
+                              nd.reshape(to, shape=(-1,))))
+        loss.backward()
+        tr.step(32)
+    assert float(loss.asnumpy()) < 0.2, float(loss.asnumpy())
+
+    src, _, _ = make_batch(4)
+    srcl = src.asnumpy().astype(int).tolist()
+
+    def token_acc(outs):
+        hits = total = 0
+        for o, s in zip(outs, srcl):
+            for i, t in enumerate(s):
+                hits += (i < len(o) and o[i] == t)
+                total += 1
+        return hits / total
+
+    greedy, _ = net.translate(src, bos=BOS, eos=EOS, max_len=8)
+    assert token_acc(greedy) >= 0.8, (greedy, srcl)
+    beam, scores = net.translate(src, bos=BOS, eos=EOS, max_len=8,
+                                 beam_size=3)
+    assert token_acc(beam) >= 0.8, (beam, srcl)
+    assert len(scores) == 4 and all(s <= 0 for s in scores)
+
+
+def test_translate_scores_and_edge_cases():
+    """Greedy scores are real GNMT-normalized log-probs (comparable to
+    beam); beam with max_len=0 returns empty rows, not a crash; MC-
+    dropout (train_mode inference) keeps the stochastic XLA attention
+    path even with the flash flag set (review regressions)."""
+    import os
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import autograd, nd
+    from mxnet_tpu.gluon.model_zoo.transformer import (
+        MultiHeadAttention, TransformerNMT)
+
+    net = TransformerNMT(vocab_size=8, num_layers=1, units=16,
+                         hidden_size=32, num_heads=2, max_length=16)
+    net.initialize()
+    src = nd.array(np.random.RandomState(0).randint(3, 8, (2, 4)))
+    out, gs = net.translate(src, bos=1, eos=2, max_len=5)
+    assert len(gs) == 2 and all(s <= 0 for s in gs)
+    assert any(s < 0 for s in gs)
+    outb, bs = net.translate(src, bos=1, eos=2, max_len=0, beam_size=2)
+    assert outb == [[], []]
+
+    att = MultiHeadAttention(units=16, num_heads=2, dropout=0.5)
+    att.initialize()
+    x = nd.array(np.random.RandomState(1).randn(1, 6, 16)
+                 .astype(np.float32))
+    os.environ["MXNET_USE_FLASH_ATTENTION"] = "1"
+    try:
+        with autograd.train_mode():
+            a = att(x).asnumpy()
+            b = att(x).asnumpy()
+    finally:
+        del os.environ["MXNET_USE_FLASH_ATTENTION"]
+    assert not np.allclose(a, b)
