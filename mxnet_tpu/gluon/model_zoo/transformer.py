@@ -337,8 +337,7 @@ class TransformerNMT(HybridBlock):
     def hybrid_forward(self, F, src, tgt, src_mask=None):
         scale = math.sqrt(self._units)
         mem = self.encoder(self.word_embed(src) * scale, src_mask)
-        dec = self.decoder(self.word_embed(tgt) * scale, mem, src_mask)
-        return self.out_proj(dec)
+        return self._decode_logits(F, tgt, mem, src_mask)
 
     # -- inference (the Sockeye translate workflow, config #4) -------------
     def _decode_logits(self, F, tgt, mem, src_mask):

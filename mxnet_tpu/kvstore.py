@@ -14,6 +14,7 @@ intentionally unsupported-by-design on TPU, as SURVEY.md §5.8 prescribes.
 """
 from __future__ import annotations
 
+import functools as _functools
 from typing import Any, Dict, List, Optional, Union
 
 from .base import MXNetError
@@ -23,13 +24,63 @@ __all__ = ["KVStore", "create"]
 
 
 def _reduce(values: List[NDArray]) -> NDArray:
-    """Sum replicas onto the first value's device."""
+    """Sum replicas onto the first value's device (KVStoreLocal: serial
+    device-to-device adds, the reference CommCPU shape)."""
     if len(values) == 1:
         return values[0]
     acc = values[0].copy()
     for v in values[1:]:
         acc += v.as_in_context(acc.context)
     return acc
+
+
+@_functools.lru_cache(maxsize=None)
+def _psum_fn(devs: tuple):
+    """One compiled XLA collective summing len(devs) per-device shards.
+
+    The reference's CommDevice/NCCL rings become lax.psum over a Mesh of
+    the participating devices (SURVEY §2.3: 'the north-star mapping') —
+    XLA schedules the reduction over ICI instead of a hand-rolled
+    peer-to-peer loop.  Devices are hashable, so they key the jit cache
+    directly."""
+    import jax
+    try:
+        from jax import shard_map
+    except ImportError:                    # older jax
+        from jax.experimental.shard_map import shard_map
+    from jax.sharding import Mesh, PartitionSpec as P
+
+    mesh = Mesh(list(devs), ("kv",))
+
+    def f(x):
+        return jax.lax.psum(x, "kv")
+
+    return jax.jit(shard_map(f, mesh=mesh, in_specs=P("kv"),
+                             out_specs=P()))
+
+
+def _reduce_collective(values: List[NDArray]) -> NDArray:
+    """Device-mode reduce: ONE in-graph psum across the values' devices
+    (used by kvstore 'device'/'nccl' when replicas sit on distinct
+    devices); falls back to the serial path otherwise."""
+    devs = []
+    for v in values:
+        d = v.context.device
+        if d in devs:
+            return _reduce(values)          # duplicate device: serial path
+        devs.append(d)
+    if len(devs) < 2:
+        return _reduce(values)
+    import jax
+
+    stacked = jax.device_put_sharded([v._read()[None] for v in values],
+                                     devs)
+    fn = _psum_fn(tuple(devs))
+    # the psum result is replicated over the mesh; commit one copy to the
+    # first pusher's device so downstream (server-side optimizer) sees a
+    # single-device array
+    out = jax.device_put(fn(stacked).reshape(values[0].shape), devs[0])
+    return NDArray(out, ctx=values[0].context)
 
 
 class KVStore:
@@ -81,10 +132,14 @@ class KVStore:
 
     def push(self, key, value, priority: int = 0) -> None:
         keys, values = _pair(key, value)
+        # 'device'/'nccl' stores reduce multi-device pushes with ONE
+        # compiled psum collective; 'local' keeps the serial CPU path
+        reducer = _reduce_collective if "device" in self._type \
+            or self._type == "nccl" else _reduce
         reduced_list = []
         for k, v in zip(keys, values):
             vlist = list(v) if isinstance(v, (list, tuple)) else [v]
-            reduced_list.append(_reduce(vlist))
+            reduced_list.append(reducer(vlist))
         if self._dist:
             # one coalesced cross-worker sync for the whole key list —
             # push a LIST of keys to get one DCN round-trip per step

@@ -93,7 +93,8 @@ class SequentialModule(BaseModule):
                 shape_feed = {d.name: d.shape for d in cur_shapes}
                 _, out_shapes, _ = mod.symbol.infer_shape(**shape_feed)
             else:
-                out_shapes = [shape for _, shape in mod.output_shapes]
+                out_shapes = [s.shape if hasattr(s, "shape") else s[1]
+                              for s in mod.output_shapes]
             nxt = self._modules[i + 1]
             if len(nxt.data_names) != len(out_shapes):
                 raise MXNetError(

@@ -382,3 +382,38 @@ def test_variational_dropout_cell_mask_reuse():
     base_out, _ = base(x, base.begin_state(batch_size=2))
     np.testing.assert_allclose(out_inf.asnumpy(), base_out.asnumpy(),
                                rtol=1e-5)
+
+
+def test_kvstore_device_collective_reduce():
+    """kvstore 'device': multi-device pushes reduce through ONE compiled
+    psum collective over a Mesh of the participating devices (the
+    CommDevice/NCCL -> lax.psum mapping, SURVEY §2.3) — exercised on the
+    virtual 8-device CPU mesh."""
+    from mxnet_tpu import kvstore as kvmod
+
+    kv = mx.kv.create("device")
+    assert kv.type == "device"
+    ctxs = [mx.context.cpu(i) for i in range(4)]
+    vals = [nd.ones((4, 5), ctx=c) * (i + 1)
+            for i, c in enumerate(ctxs)]
+    kv.init(9, nd.zeros((4, 5)))
+    before = kvmod._psum_fn.cache_info().misses
+    kv.push(9, vals)
+    after = kvmod._psum_fn.cache_info().misses
+    assert after == before + 1, "collective path must compile one psum"
+    out = nd.zeros((4, 5))
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 10.0)   # 1+2+3+4
+    # second push of same signature: cache hit, same result path
+    kv.push(9, vals)
+    assert kvmod._psum_fn.cache_info().misses == after
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 10.0)
+    # single-value and duplicate-device pushes fall back safely
+    kv.push(9, nd.ones((4, 5)) * 7)
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 7.0)
+    kv.push(9, [nd.ones((4, 5), ctx=ctxs[0]),
+                nd.ones((4, 5), ctx=ctxs[0])])
+    kv.pull(9, out=out)
+    np.testing.assert_allclose(out.asnumpy(), 2.0)
