@@ -1,8 +1,7 @@
 """Checkpoint helpers + BatchEndParam (reference: python/mxnet/model.py).
 
-The reference file also carries the legacy ``FeedForward`` API; its role was
-subsumed by ``mx.mod.Module`` years before the fork era, so here only the
-pieces the Module/callback paths need are kept: ``BatchEndParam``,
+The reference file also carries the legacy ``FeedForward`` API (kept below
+as a thin Module adapter), plus: ``BatchEndParam``,
 ``save_checkpoint``/``load_checkpoint`` with the reference's on-disk layout
 (``prefix-symbol.json`` + ``prefix-%04d.params``; ``arg:``/``aux:`` key
 prefixes inside the params dict — SURVEY.md §5.4).
@@ -10,13 +9,13 @@ prefixes inside the params dict — SURVEY.md §5.4).
 from __future__ import annotations
 
 import collections
-from typing import Dict, Tuple
+from typing import Dict, Optional, Tuple
 
 from .ndarray import NDArray
 from .ndarray.utils import save as nd_save, load as nd_load
 
 __all__ = ["BatchEndParam", "save_checkpoint", "load_checkpoint",
-           "load_params"]
+           "load_params", "FeedForward"]
 
 BatchEndParam = collections.namedtuple(
     "BatchEndParams", ["epoch", "nbatch", "eval_metric", "locals"])
@@ -53,3 +52,128 @@ def load_checkpoint(prefix: str, epoch: int):
     symbol = sym_mod.load(f"{prefix}-symbol.json")
     arg_params, aux_params = load_params(f"{prefix}-{epoch:04d}.params")
     return symbol, arg_params, aux_params
+
+
+class FeedForward:
+    """The pre-Module training API (reference: python/mxnet/model.py
+    FeedForward) — kept as a thin adapter over ``mx.mod.Module``, which is
+    what the reference itself deprecated it in favor of.  Old tutorials'
+    ``FeedForward.create(sym, X=..., y=...)`` keep working; numpy inputs
+    wrap into NDArrayIter automatically."""
+
+    def __init__(self, symbol, ctx=None, num_epoch=None,
+                 optimizer="sgd", initializer=None, numpy_batch_size=128,
+                 arg_params=None, aux_params=None, begin_epoch=0,
+                 **kwargs):
+        from .context import cpu as _cpu
+        self.symbol = symbol
+        self.ctx = ctx if ctx is not None else _cpu()
+        self.num_epoch = num_epoch
+        self.optimizer = optimizer
+        self.initializer = initializer
+        self.numpy_batch_size = numpy_batch_size
+        self.arg_params = arg_params
+        self.aux_params = aux_params
+        self.begin_epoch = begin_epoch
+        self._opt_kwargs = kwargs
+        self._module = None
+
+    # -- helpers -----------------------------------------------------------
+    def _as_iter(self, X, y=None, shuffle=False):
+        from .io import DataIter, NDArrayIter
+        if isinstance(X, DataIter):
+            return X
+        return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
+                           shuffle=shuffle)
+
+    # -- API ---------------------------------------------------------------
+    def fit(self, X, y=None, eval_data=None, eval_metric="acc",
+            epoch_end_callback=None, batch_end_callback=None,
+            kvstore="local", logger=None):
+        import logging as _logging
+        from .module import Module
+        it = self._as_iter(X, y, shuffle=True)
+        self._module = Module(self.symbol, context=self.ctx,
+                              logger=logger or _logging)
+        opt_params = dict(self._opt_kwargs)
+        opt_params.setdefault("learning_rate", 0.01)
+        self._module.fit(
+            it, eval_data=eval_data, eval_metric=eval_metric,
+            epoch_end_callback=epoch_end_callback,
+            batch_end_callback=batch_end_callback, kvstore=kvstore,
+            optimizer=self.optimizer, optimizer_params=opt_params,
+            initializer=self.initializer,
+            arg_params=self.arg_params, aux_params=self.aux_params,
+            allow_missing=self.arg_params is not None,
+            begin_epoch=self.begin_epoch, num_epoch=self.num_epoch)
+        self.arg_params, self.aux_params = self._module.get_params()
+        return self
+
+    @staticmethod
+    def _num_examples(X):
+        # dict/list inputs are legal everywhere NDArrayIter is
+        if isinstance(X, dict):
+            X = next(iter(X.values()))
+        elif isinstance(X, (list, tuple)):
+            X = X[0]
+        return len(X)
+
+    def _lazy_bind(self, it) -> None:
+        if self._module is not None:
+            return
+        from .module import Module
+        self._module = Module(self.symbol, context=self.ctx)
+        self._module.bind(data_shapes=it.provide_data,
+                          label_shapes=it.provide_label,
+                          for_training=False)
+        self._module.init_params(arg_params=self.arg_params,
+                                 aux_params=self.aux_params)
+
+    def predict(self, X, num_batch=None):
+        import numpy as _np
+        from .io import DataIter
+        if not isinstance(X, DataIter):
+            # loss heads (SoftmaxOutput) keep their label input in the
+            # graph; inference ignores it, so feed zeros
+            it = self._as_iter(
+                X, _np.zeros((self._num_examples(X),), _np.float32))
+        else:
+            it = X
+        self._lazy_bind(it)
+        return self._module.predict(it, num_batch=num_batch).asnumpy()
+
+    def score(self, X, y=None, eval_metric="acc"):
+        """Single metric: returns its value; composite metrics: returns
+        the full {name: value} dict (nothing silently dropped)."""
+        it = self._as_iter(X, y)
+        self._lazy_bind(it)
+        res = dict(self._module.score(it, eval_metric))
+        if len(res) == 1:
+            return next(iter(res.values()))
+        return res
+
+    def save(self, prefix: str, epoch: Optional[int] = None) -> None:
+        e = epoch if epoch is not None else (self.num_epoch or 0)
+        save_checkpoint(prefix, e, self.symbol, self.arg_params or {},
+                        self.aux_params or {})
+
+    @classmethod
+    def load(cls, prefix: str, epoch: int, ctx=None, **kwargs):
+        symbol, arg_params, aux_params = load_checkpoint(prefix, epoch)
+        return cls(symbol, ctx=ctx, arg_params=arg_params,
+                   aux_params=aux_params, begin_epoch=epoch, **kwargs)
+
+    @classmethod
+    def create(cls, symbol, X, y=None, ctx=None, num_epoch=None,
+               optimizer="sgd", initializer=None, eval_data=None,
+               eval_metric="acc", epoch_end_callback=None,
+               batch_end_callback=None, kvstore="local", logger=None,
+               **kwargs):
+        """Reference one-shot constructor+fit."""
+        model = cls(symbol, ctx=ctx, num_epoch=num_epoch,
+                    optimizer=optimizer, initializer=initializer, **kwargs)
+        model.fit(X, y, eval_data=eval_data, eval_metric=eval_metric,
+                  epoch_end_callback=epoch_end_callback,
+                  batch_end_callback=batch_end_callback, kvstore=kvstore,
+                  logger=logger)
+        return model

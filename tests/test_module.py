@@ -349,3 +349,38 @@ def test_sequential_module_python_stage_mid_chain():
             optimizer_params={"learning_rate": 0.05})
     score = dict(seq.score(mio.NDArrayIter(x, y, batch_size=32), "acc"))
     assert score["accuracy"] > 0.9, score
+
+
+def test_feedforward_legacy_api(tmp_path):
+    """mx.model.FeedForward (the pre-Module API, reference model.py):
+    create/fit/predict/score/save/load over numpy inputs."""
+    import logging
+    logging.disable(logging.INFO)
+    try:
+        _run_feedforward_body(tmp_path)
+    finally:
+        logging.disable(logging.NOTSET)
+
+
+def _run_feedforward_body(tmp_path):
+    x, y = _toy_data(n=128, d=6, k=3)
+    sym = _mlp_sym(num_hidden=16, k=3)
+    model = mx.model.FeedForward.create(
+        sym, X=x, y=y, num_epoch=10, optimizer="adam",
+        learning_rate=0.03, numpy_batch_size=32)
+    acc = model.score(x, y)
+    assert acc > 0.9, acc
+    pred = model.predict(x)
+    assert pred.shape == (128, 3)
+    assert (pred.argmax(1) == y).mean() > 0.9
+
+    prefix = str(tmp_path / "ff")
+    model.save(prefix, 10)
+    loaded = mx.model.FeedForward.load(prefix, 10)
+    pred2 = loaded.predict(x)
+    np.testing.assert_allclose(pred2, pred, rtol=1e-5, atol=1e-6)
+    # score on a freshly loaded model lazily binds (review regression)
+    assert mx.model.FeedForward.load(prefix, 10).score(x, y) > 0.9
+    # dict-form inputs predict symmetrically with fit
+    pred3 = loaded.predict({"data": x})
+    np.testing.assert_allclose(pred3, pred, rtol=1e-5, atol=1e-6)
