@@ -11,6 +11,7 @@ from __future__ import annotations
 import collections
 from typing import Dict, Optional, Tuple
 
+from .base import MXNetError
 from .ndarray import NDArray
 from .ndarray.utils import save as nd_save, load as nd_load
 
@@ -79,12 +80,33 @@ class FeedForward:
         self._module = None
 
     # -- helpers -----------------------------------------------------------
+    def _names(self):
+        """(data_names, label_names) derived from the symbol: label vars
+        follow the reference ``*_label`` naming convention; everything the
+        symbol itself declares a variable for is excluded from params by
+        Module via these lists."""
+        inputs = self.symbol.list_inputs()
+        label_names = tuple(n for n in inputs if n.endswith("_label"))
+        if "data" in inputs:
+            data_names = ("data",)
+        else:
+            params = {n for n in inputs
+                      if n.endswith(("weight", "bias", "gamma", "beta"))}
+            cands = [n for n in inputs
+                     if n not in params and n not in label_names]
+            data_names = tuple(cands[:1]) or ("data",)
+        return data_names, label_names
+
     def _as_iter(self, X, y=None, shuffle=False):
         from .io import DataIter, NDArrayIter
         if isinstance(X, DataIter):
             return X
+        if isinstance(X, tuple) and len(X) == 2 and y is None:
+            X, y = X                       # legacy (val_x, val_y) form
+        dn, ln = self._names()
         return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
-                           shuffle=shuffle)
+                           shuffle=shuffle, data_name=dn[0],
+                           label_name=ln[0] if ln else "softmax_label")
 
     # -- API ---------------------------------------------------------------
     def fit(self, X, y=None, eval_data=None, eval_metric="acc",
@@ -93,8 +115,12 @@ class FeedForward:
         import logging as _logging
         from .module import Module
         it = self._as_iter(X, y, shuffle=True)
-        self._module = Module(self.symbol, context=self.ctx,
-                              logger=logger or _logging)
+        if eval_data is not None:
+            eval_data = self._as_iter(eval_data)
+        dn, ln = self._names()
+        self._module = Module(self.symbol, data_names=dn, label_names=ln,
+                              context=self.ctx, logger=logger or _logging)
+        self._label_shapes = it.provide_label
         opt_params = dict(self._opt_kwargs)
         opt_params.setdefault("learning_rate", 0.01)
         self._module.fit(
@@ -118,29 +144,51 @@ class FeedForward:
             X = X[0]
         return len(X)
 
-    def _lazy_bind(self, it) -> None:
+    def _lazy_bind(self, it, label_shapes=None) -> None:
         if self._module is not None:
             return
+        if self.arg_params is None:
+            raise MXNetError(
+                "FeedForward: model has no parameters — call fit() or "
+                "load() before predict()/score()")
         from .module import Module
-        self._module = Module(self.symbol, context=self.ctx)
+        dn, ln = self._names()
+        self._module = Module(self.symbol, data_names=dn, label_names=ln,
+                              context=self.ctx)
         self._module.bind(data_shapes=it.provide_data,
-                          label_shapes=it.provide_label,
+                          label_shapes=label_shapes or it.provide_label,
                           for_training=False)
         self._module.init_params(arg_params=self.arg_params,
                                  aux_params=self.aux_params)
 
-    def predict(self, X, num_batch=None):
+    def predict(self, X, num_batch=None, label_shapes=None):
+        """Predict over numpy/dict/DataIter input.  Loss heads keep
+        their label input in the graph but ignore it at inference, so
+        zero labels are fed; non-(N,)-shaped labels can be described via
+        ``label_shapes`` (defaults to the shapes seen at fit time)."""
         import numpy as _np
         from .io import DataIter
+        if label_shapes is None:
+            label_shapes = getattr(self, "_label_shapes", None)
+        _, label_names = self._names()
         if not isinstance(X, DataIter):
-            # loss heads (SoftmaxOutput) keep their label input in the
-            # graph; inference ignores it, so feed zeros
-            it = self._as_iter(
-                X, _np.zeros((self._num_examples(X),), _np.float32))
+            if not label_names:
+                it = self._as_iter(X)      # pure-prediction graph
+            else:
+                n = self._num_examples(X)
+                if label_shapes:
+                    y = [_np.zeros((n,) + tuple(d.shape[1:]), _np.float32)
+                         for d in label_shapes][0]
+                else:
+                    y = _np.zeros((n,), _np.float32)
+                it = self._as_iter(X, y)
         else:
             it = X
         self._lazy_bind(it)
-        return self._module.predict(it, num_batch=num_batch).asnumpy()
+        out = self._module.predict(it, num_batch=num_batch)
+        if isinstance(out, (list, tuple)):
+            return [o.asnumpy() for o in out]
+        return out.asnumpy()
 
     def score(self, X, y=None, eval_metric="acc"):
         """Single metric: returns its value; composite metrics: returns

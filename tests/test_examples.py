@@ -38,3 +38,10 @@ def test_example_char_lm_bucketing_runs():
               "--cpu"])
     assert r.returncode == 0, r.stderr[-2000:]
     assert "final perplexity" in r.stdout
+
+
+def test_example_translate_nmt_runs():
+    r = _run(["examples/translate_nmt.py", "--epochs", "200", "--cpu"],
+             timeout=1200)
+    assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1500:])
+    assert "translation OK" in r.stdout

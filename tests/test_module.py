@@ -384,3 +384,40 @@ def _run_feedforward_body(tmp_path):
     # dict-form inputs predict symmetrically with fit
     pred3 = loaded.predict({"data": x})
     np.testing.assert_allclose(pred3, pred, rtol=1e-5, atol=1e-6)
+
+
+def test_feedforward_hardening():
+    """Review regressions: custom label names, tuple eval_data, unfitted
+    predict raises, multi-output predict returns a list."""
+    import pytest
+    x = np.random.RandomState(0).randn(40, 5).astype(np.float32)
+    yr = (x @ np.ones((5, 1), np.float32))
+
+    # LinearRegressionOutput uses 'lin_reg_label'-style naming
+    data = mx.sym.var("data")
+    pred = mx.sym.FullyConnected(data, num_hidden=1, name="fc")
+    out = mx.sym.LinearRegressionOutput(pred, mx.sym.var("reg_label"),
+                                        name="lro")
+    model = mx.model.FeedForward(out, num_epoch=30, optimizer="adam",
+                                 learning_rate=0.05, numpy_batch_size=20)
+    model.fit(x, yr, eval_data=(x, yr))       # tuple eval_data form
+    arg, _ = model._module.get_params()
+    assert "reg_label" not in arg             # label never a parameter
+    p = model.predict(x)
+    assert p.shape == (40, 1)
+    assert np.mean((p - yr) ** 2) < 0.05
+
+    # unfitted predict raises instead of random-init garbage
+    fresh = mx.model.FeedForward(out)
+    with pytest.raises(Exception, match="fit|load"):
+        fresh.predict(x)
+
+    # multi-output symbol -> list of arrays
+    two = mx.symbol.Group([pred, pred * 2]) if hasattr(mx.symbol, "Group") \
+        else None
+    if two is not None:
+        m2 = mx.model.FeedForward(two)
+        m2.arg_params, m2.aux_params = model.arg_params, {}
+        outs = m2.predict(x)
+        assert isinstance(outs, list) and len(outs) == 2
+        np.testing.assert_allclose(outs[1], outs[0] * 2, rtol=1e-5)
