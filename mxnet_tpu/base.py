@@ -275,3 +275,19 @@ def resolve_reshape_spec(in_dims, spec, reverse=False):
             total *= s
         out[out.index(-1)] = total // max(known, 1)
     return tuple(out)
+
+
+def rnn_packed_param_count(mode: str, input_size: int, hidden: int,
+                           num_layers: int, bidirectional: bool) -> int:
+    """Length of the packed cuDNN-layout RNN parameter vector (shared by
+    symbol shape inference and mx.rnn.FusedRNNCell so the two can never
+    disagree): per layer, per direction: Wx, Wh, bx, bh."""
+    ngates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[mode]
+    ndir = 2 if bidirectional else 1
+    total = 0
+    layer_in = input_size
+    for _ in range(num_layers):
+        total += ndir * (ngates * hidden * layer_in
+                         + ngates * hidden * hidden + 2 * ngates * hidden)
+        layer_in = hidden * ndir
+    return total

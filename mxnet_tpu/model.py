@@ -101,8 +101,6 @@ class FeedForward:
         from .io import DataIter, NDArrayIter
         if isinstance(X, DataIter):
             return X
-        if isinstance(X, tuple) and len(X) == 2 and y is None:
-            X, y = X                       # legacy (val_x, val_y) form
         dn, ln = self._names()
         return NDArrayIter(X, y, batch_size=self.numpy_batch_size,
                            shuffle=shuffle, data_name=dn[0],
@@ -116,7 +114,12 @@ class FeedForward:
         from .module import Module
         it = self._as_iter(X, y, shuffle=True)
         if eval_data is not None:
-            eval_data = self._as_iter(eval_data)
+            # legacy (val_x, val_y) tuple form accepted HERE only — a
+            # bare 2-tuple of X would be ambiguous elsewhere
+            if isinstance(eval_data, tuple) and len(eval_data) == 2:
+                eval_data = self._as_iter(*eval_data)
+            else:
+                eval_data = self._as_iter(eval_data)
         dn, ln = self._names()
         self._module = Module(self.symbol, data_names=dn, label_names=ln,
                               context=self.ctx, logger=logger or _logging)
@@ -177,14 +180,16 @@ class FeedForward:
             else:
                 n = self._num_examples(X)
                 if label_shapes:
-                    y = [_np.zeros((n,) + tuple(d.shape[1:]), _np.float32)
-                         for d in label_shapes][0]
+                    # one zero array PER declared label input
+                    y = {d.name: _np.zeros((n,) + tuple(d.shape[1:]),
+                                           _np.float32)
+                         for d in label_shapes}
                 else:
                     y = _np.zeros((n,), _np.float32)
                 it = self._as_iter(X, y)
         else:
             it = X
-        self._lazy_bind(it)
+        self._lazy_bind(it, label_shapes=label_shapes)
         out = self._module.predict(it, num_batch=num_batch)
         if isinstance(out, (list, tuple)):
             return [o.asnumpy() for o in out]

@@ -186,7 +186,10 @@ def _register():
                 ws, gs, lrs, wds, rescale_grad, clip_gradient,
                 interpret=interpret))
         return fn
+    # preloaded_* variants ARE this signature: lrs/wds ride as array
+    # inputs (reference preloaded_multi_sgd_update)
     register_op("multi_sgd_update", multi_sgd_update_maker,
+                aliases=("preloaded_multi_sgd_update",),
                 differentiable=False)
 
     def multi_sgd_mom_update_maker(momentum=0.0, rescale_grad=1.0,
@@ -206,6 +209,7 @@ def _register():
             return tuple(out)
         return fn
     register_op("multi_sgd_mom_update", multi_sgd_mom_update_maker,
+                aliases=("preloaded_multi_sgd_mom_update",),
                 differentiable=False)
 
     def multi_mp_sgd_mom_update_maker(momentum=0.0, rescale_grad=1.0,
@@ -227,6 +231,7 @@ def _register():
             return tuple(out)
         return fn
     register_op("multi_mp_sgd_mom_update", multi_mp_sgd_mom_update_maker,
+                aliases=("preloaded_multi_mp_sgd_mom_update",),
                 differentiable=False)
 
     # ---- AdamW (decoupled weight decay; reference:

@@ -307,18 +307,10 @@ class FusedRNNCell(BaseRNNCell):
         self._param = self.params.get("parameters", **kw)
 
     def _param_count(self, input_size: int) -> int:
-        ngates = {"lstm": 4, "gru": 3, "rnn_tanh": 1, "rnn_relu": 1}[
-            self._mode]
-        ndir = 2 if self._bidirectional else 1
-        H = self._num_hidden
-        total = 0
-        layer_in = input_size
-        for _ in range(self._num_layers):
-            for _d in range(ndir):
-                total += ngates * H * layer_in + ngates * H * H \
-                    + 2 * ngates * H
-            layer_in = H * ndir
-        return total
+        from ..base import rnn_packed_param_count
+        return rnn_packed_param_count(self._mode, input_size,
+                                      self._num_hidden, self._num_layers,
+                                      self._bidirectional)
 
     @property
     def state_info(self):

@@ -549,6 +549,27 @@ def _infer_node_params(node: _Node, in_shapes, unknown, out) -> None:
             if pos == 1:
                 out[p.name] = (int(a.get("input_dim")),
                                int(a.get("output_dim")))
+    elif node.op == "RNN":
+        # packed cuDNN-layout parameter vector + zero states derived from
+        # data (T, N, I) and the op attrs — lets FusedRNNCell bind without
+        # a declared input_size.  Malformed graphs degrade to
+        # shape-unknown (the pre-existing contract), never crash here.
+        from ..base import rnn_packed_param_count
+        mode = a.get("mode", "lstm")
+        if len(data) != 3 or mode not in ("lstm", "gru", "rnn_tanh",
+                                          "rnn_relu"):
+            return
+        T, N, I = data
+        H = int(a.get("state_size"))
+        nl = int(a.get("num_layers", 1))
+        ndir = 2 if a.get("bidirectional") else 1
+        total = rnn_packed_param_count(mode, I, H, nl,
+                                       bool(a.get("bidirectional")))
+        for p, pos in unknown:
+            if pos == 1:
+                out[p.name] = (total,)
+            elif pos in (2, 3):
+                out[p.name] = (ndir * nl, N, H)
 
 
 def var(name: str, **kwargs) -> Symbol:

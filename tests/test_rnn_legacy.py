@@ -328,3 +328,21 @@ def test_lstm_forget_bias_applied_at_init():
     b = arg["fb_i2h_bias"].asnumpy()
     np.testing.assert_allclose(b[H:2 * H], 2.5)
     np.testing.assert_allclose(np.delete(b, np.s_[H:2 * H]), 0.0)
+
+
+def test_fused_rnn_binds_without_input_size():
+    """InferShape now derives the packed RNN parameter length (and zero
+    state shapes) from the data shape, so FusedRNNCell needs no declared
+    input_size — matching the reference's fixed-point pass behavior."""
+    T, N, E, H = 3, 2, 5, 7
+    fused = rnn.FusedRNNCell(H, num_layers=2, mode="gru", prefix="nf_")
+    outs, _ = fused.unroll(T, mx.sym.var("data"), layout="NTC",
+                           merge_outputs=True)
+    exe = outs.simple_bind(ctx=mx.cpu(), data=(N, T, E))
+    expected = fused._param_count(E)
+    assert exe.arg_dict["nf_parameters"].shape == (expected,)
+    for k, v in exe.arg_dict.items():
+        if k != "data":
+            v[:] = mx.nd.random.normal(0, 0.1, shape=v.shape)
+    exe.arg_dict["data"][:] = mx.nd.random.normal(0, 1, shape=(N, T, E))
+    assert exe.forward(is_train=False)[0].shape == (N, T, H)
