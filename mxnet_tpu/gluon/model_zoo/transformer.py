@@ -116,8 +116,9 @@ class MultiHeadAttention(HybridBlock):
         v = self._split_heads(F, v, b, sk)
         scale = 1.0 / math.sqrt(self._units // self._heads)
         if self._flash_eligible(F, mask):
-            # tiled online-softmax Pallas kernel: no (Lq, Lk) score matrix
-            # in HBM (kernels/flash_attention.py); inference-only for now
+            # tiled online-softmax Pallas kernel with a chunked-scan
+            # custom VJP — differentiable, no (Lq, Lk) score matrix in
+            # either direction (kernels/flash_attention.py)
             out = F.flash_attention(q, k, v, scale=scale)
         else:
             scores = F.batch_dot(q, k, transpose_b=True) * scale
@@ -128,10 +129,12 @@ class MultiHeadAttention(HybridBlock):
         return self.proj(self._merge_heads(F, out, b, sq))
 
     def _flash_eligible(self, F, mask) -> bool:
-        # env-gated (MXNET_USE_FLASH_ATTENTION=1), unmasked, inference
-        # only (the kernel has no backward yet; attention dropout is an
-        # identity outside autograd.record, so a dropout>0 CONSTRUCTION
-        # does not disqualify inference), imperative mode only
+        # env-gated (MXNET_USE_FLASH_ATTENTION=1), unmasked, imperative
+        # mode only.  The kernel is differentiable (custom VJP over the
+        # chunked formulation), so training may ride it too — EXCEPT when
+        # this block has attention dropout and dropout is live
+        # (train_mode/record), since the flash path has no probs tensor
+        # to drop.
         import os
         if os.environ.get("MXNET_USE_FLASH_ATTENTION", "0") != "1":
             return False
@@ -140,9 +143,9 @@ class MultiHeadAttention(HybridBlock):
         if not hasattr(F, "flash_attention") or \
                 not hasattr(F, "NDArray"):
             return False
+        if self.drop is None:
+            return True
         from ... import autograd
-        # dropout activates under train_mode (not just record), so MC-
-        # dropout inference must keep the XLA path where self.drop runs
         return not (autograd.is_recording() or autograd.is_training())
 
 

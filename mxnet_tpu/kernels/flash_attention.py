@@ -110,6 +110,118 @@ def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
     )
 
 
+def _chunked_reference(q, k, v, causal: bool, scale: float):
+    """Pure-jnp online-softmax attention, chunked over KV blocks with
+    lax.scan — numerically identical to the kernel (same masks, same
+    dead-row semantics) and DIFFERENTIABLE.  The custom VJP below runs
+    the Pallas kernel forward and differentiates THIS formulation
+    backward, so training never materializes the (Lq, Lk) score matrix
+    either (per-step residuals are O(Lq·D·Lk/BLOCK_K))."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    pad = (-lk) % BLOCK_K
+    if pad:
+        k = jnp.pad(k, ((0, 0), (0, pad), (0, 0)))
+        v = jnp.pad(v, ((0, 0), (0, pad), (0, 0)))
+    nk = k.shape[1] // BLOCK_K
+    qf = q.astype(jnp.float32) * scale
+    kb = k.astype(jnp.float32).reshape(bh, nk, BLOCK_K, d)
+    vb = v.astype(jnp.float32).reshape(bh, nk, BLOCK_K, d)
+    q_idx = jnp.arange(lq)
+
+    # remat: without checkpointing, vjp-of-scan stacks each step's p
+    # (bh, Lq, BLOCK_K) — a full probability matrix across steps; with it,
+    # backward recomputes per-block and stores only the carries
+    # (O(Lq·(D+2)·nk))
+    @jax.checkpoint
+    def step(carry, blk):
+        m, l, acc = carry
+        k_blk, v_blk, ki = blk
+        s = jnp.einsum("bqd,bkd->bqk", qf, k_blk)
+        k_ids = ki * BLOCK_K + jnp.arange(BLOCK_K)
+        mask = (k_ids < lk)[None, None, :]
+        if causal:
+            mask = mask & (k_ids[None, None, :] <=
+                           q_idx[None, :, None] + (lk - lq))
+        s = jnp.where(mask, s, _NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        p = jnp.exp(s - m_new[..., None])
+        dead = m_new <= (_NEG_INF * 0.5)
+        p = jnp.where(dead[..., None],
+                      jnp.broadcast_to((k_ids < lk).astype(jnp.float32),
+                                       p.shape), p)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[..., None] + jnp.einsum(
+            "bqk,bkd->bqd", p, v_blk)
+        return (m_new, l_new, acc_new), None
+
+    m0 = jnp.full((bh, lq), _NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bh, lq), jnp.float32)
+    a0 = jnp.zeros((bh, lq, d), jnp.float32)
+    blks = (jnp.moveaxis(kb, 1, 0), jnp.moveaxis(vb, 1, 0),
+            jnp.arange(nk))
+    (m, l, acc), _ = lax.scan(step, (m0, l0, a0), blks)
+    l = jnp.where(l == 0.0, 1.0, l)
+    return (acc / l[..., None]).astype(q.dtype)
+
+
+@functools.lru_cache(maxsize=1)
+def _flash_core_fn():
+    """Module-singleton custom-VJP core (built lazily so importing this
+    module never imports jax)."""
+    import jax
+
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
+    def core(q, k, v, causal, scale, interpret):
+        return _run_kernel(q, k, v, causal, scale, interpret)
+
+    def core_fwd(q, k, v, causal, scale, interpret):
+        return _run_kernel(q, k, v, causal, scale, interpret), (q, k, v)
+
+    def core_bwd(causal, scale, interpret, res, g):
+        q, k, v = res
+        _, vjp = jax.vjp(
+            lambda a, b, c: _chunked_reference(a, b, c, causal, scale),
+            q, k, v)
+        return vjp(g)
+
+    core.defvjp(core_fwd, core_bwd)
+    return core
+
+
+def _flash_core(q, k, v, causal: bool, scale: float, interpret: bool):
+    return _flash_core_fn()(q, k, v, causal, scale, interpret)
+
+
+def _run_kernel(q, k, v, causal: bool, scale: float, interpret: bool):
+    import jax.numpy as jnp
+
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+
+    def pad_to(x, axis, mult):
+        n = x.shape[axis]
+        pad = (-n) % mult
+        if pad == 0:
+            return x
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        return jnp.pad(x, widths)
+
+    qp = pad_to(pad_to(q, 1, BLOCK_Q), 2, 128)
+    kp = pad_to(pad_to(k, 1, BLOCK_K), 2, 128)
+    vp = pad_to(pad_to(v, 1, BLOCK_K), 2, 128)
+    call = _build_call(bh, qp.shape[1], kp.shape[1], qp.shape[2], lq, lk,
+                       bool(causal), float(scale),
+                       jnp.result_type(q).name, bool(interpret))
+    return call(qp, kp, vp)[:, :lq, :d]
+
+
 def flash_attention(q, k, v, causal: bool = False, scale=None,
                     interpret=None):
     """Tiled attention: softmax(scale·QKᵀ + mask)V without materializing
@@ -117,6 +229,9 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
 
     Accepts (B, H, L, D) or (BH, L, D); Lq/Lk/D are padded internally to
     tile multiples (K padding is masked exactly, never approximated).
+    DIFFERENTIABLE: the forward runs the Pallas kernel, the backward
+    differentiates an equivalent chunked jnp formulation — gradients also
+    never touch an (Lq, Lk) score matrix.
     """
     import jax.numpy as jnp
 
@@ -133,27 +248,8 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
     if interpret is None:
         interpret = _interpret(q)
 
-    def pad_to(x, axis, mult):
-        n = x.shape[axis]
-        pad = (-n) % mult
-        if pad == 0:
-            return x
-        widths = [(0, 0)] * x.ndim
-        widths[axis] = (0, pad)
-        return jnp.pad(x, widths)
-
-    qp = pad_to(q, 1, BLOCK_Q)
-    kp = pad_to(k, 1, BLOCK_K)
-    vp = pad_to(v, 1, BLOCK_K)
-    # lanes: last dim to a 128 multiple (zero features change nothing)
-    qp = pad_to(qp, 2, 128)
-    kp = pad_to(kp, 2, 128)
-    vp = pad_to(vp, 2, 128)
-
-    call = _build_call(bh, qp.shape[1], kp.shape[1], qp.shape[2], lq, lk,
-                       bool(causal), float(scale),
-                       jnp.result_type(q).name, bool(interpret))
-    out = call(qp, kp, vp)[:, :lq, :d]
+    out = _flash_core(q, k, v, bool(causal), float(scale),
+                      bool(interpret))
     if squeeze4:
         out = out.reshape(b, h, lq, d)
     return out

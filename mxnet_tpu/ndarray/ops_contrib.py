@@ -845,10 +845,11 @@ def _register_round3b():
                 aliases=("index_array",), differentiable=False)
 
     # ---- flash attention (kernels/flash_attention.py Pallas kernel) ------
-    # Inference path: the Pallas forward has no hand-written backward yet,
-    # so the op is non-differentiable; training attention stays on the
-    # XLA softmax(QKᵀ)V path.  Eager dispatch (use_jit=False) keeps the
-    # Mosaic-vs-interpret choice keyed on the data's actual device.
+    # DIFFERENTIABLE: the Pallas forward carries a custom VJP that
+    # differentiates an equivalent chunked jnp formulation, so neither
+    # direction materializes the (Lq, Lk) score matrix.  Eager dispatch
+    # (use_jit=False) keeps the Mosaic-vs-interpret choice keyed on the
+    # data's actual device.
     def flash_attention_maker(causal=False, scale=None):
         from ..kernels import flash_attention as _fa
 
@@ -856,8 +857,7 @@ def _register_round3b():
             return _fa(q, k, v, causal=causal, scale=scale)
         return fn
     register_op("_contrib_flash_attention", flash_attention_maker,
-                aliases=("flash_attention",), differentiable=False,
-                use_jit=False)
+                aliases=("flash_attention",), use_jit=False)
 
     # ---- allclose --------------------------------------------------------
     def allclose_maker(rtol=1e-5, atol=1e-8, equal_nan=False):

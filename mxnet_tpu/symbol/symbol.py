@@ -556,8 +556,8 @@ def _infer_node_params(node: _Node, in_shapes, unknown, out) -> None:
         # shape-unknown (the pre-existing contract), never crash here.
         from ..base import rnn_packed_param_count
         mode = a.get("mode", "lstm")
-        if len(data) != 3 or mode not in ("lstm", "gru", "rnn_tanh",
-                                          "rnn_relu"):
+        if len(data) != 3 or a.get("state_size") is None or \
+                mode not in ("lstm", "gru", "rnn_tanh", "rnn_relu"):
             return
         T, N, I = data
         H = int(a.get("state_size"))
