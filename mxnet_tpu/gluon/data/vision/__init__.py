@@ -1,6 +1,6 @@
 """gluon.data.vision: datasets + transforms (reference:
 python/mxnet/gluon/data/vision/)."""
 from .datasets import MNIST, FashionMNIST, CIFAR10, CIFAR100, \
-    ImageRecordDataset
+    ImageRecordDataset, ImageFolderDataset
 from . import transforms
 from . import datasets

@@ -20,7 +20,7 @@ from ....base import MXNetError
 from ...data.dataset import Dataset, ArrayDataset
 
 __all__ = ["MNIST", "FashionMNIST", "CIFAR10", "CIFAR100",
-           "ImageRecordDataset"]
+           "ImageRecordDataset", "ImageFolderDataset"]
 
 
 def _synth_image_classification(num, shape, num_classes, seed):
@@ -203,3 +203,40 @@ class ImageRecordDataset(Dataset):
         if self._transform is not None:
             return self._transform(x, y)
         return x, y
+
+
+class ImageFolderDataset(Dataset):
+    """A class-per-subfolder image dataset (reference:
+    gluon/data/vision/datasets.py ImageFolderDataset): ``root/cat/1.jpg``
+    → label = index of sorted folder name.  Decodes via mx.image (PIL
+    here, OpenCV in the reference)."""
+
+    def __init__(self, root, flag=1, transform=None):
+        import os
+        self._root = os.path.expanduser(root)
+        self._flag = flag
+        self._transform = transform
+        self._exts = {".jpg", ".jpeg", ".png", ".bmp"}
+        self.synsets = []
+        self.items = []
+        for folder in sorted(os.listdir(self._root)):
+            path = os.path.join(self._root, folder)
+            if not os.path.isdir(path):
+                continue
+            label = len(self.synsets)
+            self.synsets.append(folder)
+            for fname in sorted(os.listdir(path)):
+                if os.path.splitext(fname)[1].lower() in self._exts:
+                    self.items.append((os.path.join(path, fname),
+                                       label))
+
+    def __len__(self):
+        return len(self.items)
+
+    def __getitem__(self, idx):
+        from ....image import imread
+        path, label = self.items[idx]
+        img = imread(path, flag=self._flag)
+        if self._transform is not None:
+            return self._transform(img, label)
+        return img, label

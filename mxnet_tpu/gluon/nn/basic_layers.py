@@ -17,7 +17,8 @@ from ..parameter import Parameter
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
            "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding", "Flatten",
            "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU",
-           "ELU", "SELU", "GELU", "Swish", "HybridConcurrent", "Identity"]
+           "ELU", "SELU", "GELU", "Swish", "HybridConcurrent", "Identity",
+           "ReflectionPad2D"]
 
 
 def _prod(it):
@@ -375,3 +376,30 @@ class Swish(HybridBlock):
 
     def hybrid_forward(self, F, x):
         return x * F.sigmoid(self._beta * x)
+
+
+class ReflectionPad2D(HybridBlock):
+    """Reflection padding on H/W of NCHW input (reference:
+    gluon/nn/basic_layers.py ReflectionPad2D over src/operator/pad.cc
+    reflect mode)."""
+
+    def __init__(self, padding=0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if isinstance(padding, int):
+            padding = (padding,) * 4      # (left, right, top, bottom)
+        padding = tuple(padding)
+        if len(padding) == 8:
+            # reference pad_width form (N..., C..., t, b, l, r)
+            t, b, l, r = padding[4:]
+            padding = (l, r, t, b)
+        if len(padding) != 4:
+            raise MXNetError(
+                "ReflectionPad2D padding must be an int, a 4-tuple "
+                "(left, right, top, bottom), or the reference 8-tuple "
+                f"pad_width; got {padding}")
+        self._padding = padding
+
+    def hybrid_forward(self, F, x):
+        l, r, t, b = self._padding
+        return F.pad(x, mode="reflect",
+                     pad_width=(0, 0, 0, 0, t, b, l, r))

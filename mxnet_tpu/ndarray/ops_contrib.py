@@ -856,8 +856,23 @@ def _register_round3b():
         def fn(q, k, v):
             return _fa(q, k, v, causal=causal, scale=scale)
         return fn
+
+    def flash_attention_vjp_maker(causal=False, scale=None):
+        # recording path: jax.vjp traces the op, so the Mosaic-vs-
+        # interpret choice must be made HERE on the concrete arrays,
+        # before tracing (the multi_sgd static-kwarg rule)
+        from ..kernels import flash_attention as _fa
+        from ..kernels.flash_attention import _interpret as _interp
+
+        def wrapper(q, k, v):
+            interp = _interp(q)
+            return jax.vjp(
+                lambda a, b, c: _fa(a, b, c, causal=causal, scale=scale,
+                                    interpret=interp), q, k, v)
+        return wrapper
     register_op("_contrib_flash_attention", flash_attention_maker,
-                aliases=("flash_attention",), use_jit=False)
+                aliases=("flash_attention",), use_jit=False,
+                vjp_maker=flash_attention_vjp_maker)
 
     # ---- allclose --------------------------------------------------------
     def allclose_maker(rtol=1e-5, atol=1e-8, equal_nan=False):

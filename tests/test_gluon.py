@@ -417,3 +417,54 @@ def test_kvstore_device_collective_reduce():
                 nd.ones((4, 5), ctx=ctxs[0])])
     kv.pull(9, out=out)
     np.testing.assert_allclose(out.asnumpy(), 2.0)
+
+
+def test_image_folder_dataset(tmp_path):
+    from PIL import Image
+    from mxnet_tpu.gluon.data.vision import ImageFolderDataset
+    rs = np.random.RandomState(0)
+    for cls in ("cat", "dog"):
+        d = tmp_path / cls
+        d.mkdir()
+        for i in range(3):
+            Image.fromarray(rs.randint(0, 255, (8, 10, 3), np.uint8)) \
+                .save(d / f"{i}.jpg")
+    (tmp_path / "notes.txt").write_text("ignored")
+    ds = ImageFolderDataset(str(tmp_path))
+    assert ds.synsets == ["cat", "dog"]
+    assert len(ds) == 6
+    img, label = ds[0]
+    assert img.shape == (8, 10, 3) and label == 0
+    assert ds[5][1] == 1
+    # transform hook
+    ds2 = ImageFolderDataset(str(tmp_path),
+                             transform=lambda x, y: (x.shape, y))
+    assert ds2[0] == ((8, 10, 3), 0)
+
+
+def test_reflection_pad2d():
+    import torch
+    layer = nn.ReflectionPad2D(2)
+    x = np.random.RandomState(1).randn(1, 2, 5, 6).astype(np.float32)
+    out = layer(nd.array(x)).asnumpy()
+    ref = torch.nn.functional.pad(torch.tensor(x), (2, 2, 2, 2),
+                                  mode="reflect").numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+    asym = nn.ReflectionPad2D((1, 2, 0, 1))   # (l, r, t, b)
+    out = asym(nd.array(x)).asnumpy()
+    ref = torch.nn.functional.pad(torch.tensor(x), (1, 2, 0, 1),
+                                  mode="reflect").numpy()
+    np.testing.assert_allclose(out, ref, atol=1e-6)
+
+
+def test_reflection_pad2d_reference_8tuple():
+    import pytest
+    import torch
+    x = np.random.RandomState(2).randn(1, 2, 5, 5).astype(np.float32)
+    layer = nn.ReflectionPad2D((0, 0, 0, 0, 1, 2, 1, 1))  # pad_width form
+    ref = torch.nn.functional.pad(torch.tensor(x), (1, 1, 1, 2),
+                                  mode="reflect").numpy()
+    np.testing.assert_allclose(layer(nd.array(x)).asnumpy(), ref,
+                               atol=1e-6)
+    with pytest.raises(Exception, match="padding"):
+        nn.ReflectionPad2D((1, 2, 3))
