@@ -81,6 +81,13 @@ sample_poisson = random.sample_poisson
 sample_negative_binomial = random.sample_negative_binomial
 sample_generalized_negative_binomial = \
     random.sample_generalized_negative_binomial
+# *_like draws follow the input's shape/dtype/ctx
+uniform_like = random.uniform_like
+normal_like = random.normal_like
+gamma_like = random.gamma_like
+exponential_like = random.exponential_like
+poisson_like = random.poisson_like
+randint_like = random.randint_like
 
 
 def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None,

@@ -431,6 +431,162 @@ def _register():
         return fn
     register_op("fill_element_0index", fill_element_0index_maker)
 
+    # ---- split_v2 (matrix_op.cc SplitV2: sections OR explicit indices) ---
+    def split_v2_maker(indices=(), axis=0, squeeze_axis=False,
+                      sections=0):
+        def fn(data):
+            if sections:
+                parts = jnp.split(data, int(sections), axis=axis)
+            else:
+                parts = jnp.split(data, list(indices), axis=axis)
+            if squeeze_axis:
+                parts = [jnp.squeeze(p, axis=axis) for p in parts]
+            if len(parts) == 1:
+                return parts[0]           # single output stays an array
+            return tuple(parts)
+        return fn
+    register_op("split_v2", split_v2_maker, aliases=("_split_v2",))
+
+    # ---- interleaved fused self/enc-dec attention primitives -------------
+    # (src/operator/contrib/transformer.cc interleaved_matmul_* — the
+    # reference's own fused-attention surface, introduced for GluonNLP's
+    # fast transformer.)  Layouts follow the reference: projections are
+    # (L, B, H*3*D) with q,k,v interleaved PER HEAD; attention matrices
+    # are (B*H, Lq, Lk); qk scales q by 1/sqrt(D).
+    def _split_interleaved_qkv(qkv, heads):
+        L, B, E = qkv.shape
+        d = E // (3 * heads)
+        x = qkv.reshape(L, B, heads, 3, d)
+        # (B*H, L, d) each
+        def take(i):
+            t = x[:, :, :, i, :]
+            return t.transpose(1, 2, 0, 3).reshape(B * heads, L, d)
+        return take(0), take(1), take(2)
+
+    def imm_selfatt_qk_maker(heads=1):
+        def fn(qkv):
+            q, k, _ = _split_interleaved_qkv(qkv, heads)
+            # python-float scale: weak typing keeps f16/bf16 inputs in
+            # their own dtype (the reference's fp16 fast-attention path)
+            scale = 1.0 / float(q.shape[-1]) ** 0.5
+            return jnp.einsum("nqd,nkd->nqk", q * scale, k)
+        return fn
+    register_op("_contrib_interleaved_matmul_selfatt_qk",
+                imm_selfatt_qk_maker,
+                aliases=("interleaved_matmul_selfatt_qk",))
+
+    def imm_selfatt_valatt_maker(heads=1):
+        def fn(qkv, att):
+            L, B, E = qkv.shape
+            d = E // (3 * heads)
+            _, _, v = _split_interleaved_qkv(qkv, heads)
+            out = jnp.einsum("nqk,nkd->nqd", att, v)   # (B*H, L, d)
+            return out.reshape(B, heads, L, d).transpose(2, 0, 1, 3) \
+                .reshape(L, B, heads * d)
+        return fn
+    register_op("_contrib_interleaved_matmul_selfatt_valatt",
+                imm_selfatt_valatt_maker,
+                aliases=("interleaved_matmul_selfatt_valatt",))
+
+    def _split_interleaved_kv(kv, heads):
+        L, B, E = kv.shape
+        d = E // (2 * heads)
+        x = kv.reshape(L, B, heads, 2, d)
+
+        def take(i):
+            t = x[:, :, :, i, :]
+            return t.transpose(1, 2, 0, 3).reshape(B * heads, L, d)
+        return take(0), take(1)
+
+    def imm_encdec_qk_maker(heads=1):
+        def fn(q_proj, kv):
+            Lq, B, E = q_proj.shape
+            d = E // heads
+            q = q_proj.reshape(Lq, B, heads, d).transpose(1, 2, 0, 3) \
+                .reshape(B * heads, Lq, d)
+            k, _ = _split_interleaved_kv(kv, heads)
+            scale = 1.0 / float(d) ** 0.5
+            return jnp.einsum("nqd,nkd->nqk", q * scale, k)
+        return fn
+    register_op("_contrib_interleaved_matmul_encdec_qk",
+                imm_encdec_qk_maker,
+                aliases=("interleaved_matmul_encdec_qk",))
+
+    def imm_encdec_valatt_maker(heads=1):
+        def fn(kv, att):
+            Lk, B, E = kv.shape
+            d = E // (2 * heads)
+            _, v = _split_interleaved_kv(kv, heads)
+            Lq = att.shape[1]
+            out = jnp.einsum("nqk,nkd->nqd", att, v)
+            return out.reshape(B, heads, Lq, d).transpose(2, 0, 1, 3) \
+                .reshape(Lq, B, heads * d)
+        return fn
+    register_op("_contrib_interleaved_matmul_encdec_valatt",
+                imm_encdec_valatt_maker,
+                aliases=("interleaved_matmul_encdec_valatt",))
+
+    # ---- hawkesll (src/operator/contrib/hawkes_ll.cc) --------------------
+    # Log-likelihood of a marked multivariate Hawkes process with
+    # exponential kernels, via the Ogata recursion over events:
+    #   λ_m(t_i) = μ_m + α_m β_m r_m(i),
+    #   r_m(i) = e^{-β_m Δt_i} (r_m(i-1) + 1{mark_{i-1}=m}),
+    # compensator over [0, T]: Σ_m μ_m T + Σ_m α_m Σ_{i≤n} (1 − e^{−β_m
+    # (T − t_i)}).  Returns (loglik (N,), final decayed states (N, K)).
+    def hawkesll_maker():
+        from jax import lax
+
+        def fn(lda, alpha, beta, state, lags, marks, valid_length,
+               max_time):
+            N, T = lags.shape
+            K = lda.shape[1]
+            marks_i = marks.astype(jnp.int32)
+            vl = valid_length.astype(jnp.int32)
+
+            def one(mu, st, lag_row, mark_row, n, Tmax):
+                def step(carry, inp):
+                    r, t, ll, prev_mark = carry
+                    lag, mark, idx = inp
+                    decay = jnp.exp(-beta * lag)
+                    r_new = decay * (r + jax.nn.one_hot(prev_mark, K,
+                                                        dtype=r.dtype))
+                    t_new = t + lag
+                    lam = mu[mark] + alpha[mark] * beta[mark] * r_new[mark]
+                    valid = idx < n
+                    ll_new = ll + jnp.where(valid, jnp.log(lam), 0.0)
+                    return ((jnp.where(valid, r_new, r),
+                             jnp.where(valid, t_new, t), ll_new,
+                             jnp.where(valid, mark, prev_mark)), t_new)
+
+                init = (st, jnp.float32(0.0), jnp.float32(0.0),
+                        jnp.int32(-1))
+                # prev_mark starts at -1: one_hot(-1) is all-zero, so the
+                # first event sees only the initial state
+                (r, t, ll, last_mark), times = lax.scan(
+                    step, init, (lag_row, mark_row, jnp.arange(T)))
+                # compensator: background over [0, Tmax] + excitation of
+                # each VALID event integrated to Tmax + the initial
+                # state's decayed excitation ∫₀ᵀ αβ·st·e^{−βt}
+                comp_bg = jnp.sum(mu) * Tmax
+                comp_init = jnp.sum(alpha * st *
+                                    (1.0 - jnp.exp(-beta * Tmax)))
+                ev_valid = jnp.arange(T) < n
+                contrib = alpha[mark_row] * (
+                    1.0 - jnp.exp(-beta[mark_row] *
+                                  jnp.maximum(Tmax - times, 0.0)))
+                comp_ex = jnp.sum(jnp.where(ev_valid, contrib, 0.0))
+                # final state decayed to Tmax (incl. the last event)
+                r_final = jnp.exp(-beta * jnp.maximum(Tmax - t, 0.0)) * \
+                    (r + jax.nn.one_hot(last_mark, K, dtype=r.dtype))
+                return ll - comp_bg - comp_init - comp_ex, r_final
+
+            ll, states = jax.vmap(one)(lda, state, lags, marks_i, vl,
+                                       max_time)
+            return ll, states
+        return fn
+    register_op("_contrib_hawkesll", hawkesll_maker,
+                aliases=("hawkesll",))
+
     # ---- SoftmaxActivation (deprecated-but-present reference op) ---------
     def softmax_activation_maker(mode="instance"):
         def fn(x):

@@ -312,3 +312,50 @@ __all__ += ["sample_uniform", "sample_normal", "sample_gamma",
             "sample_exponential", "sample_poisson",
             "sample_negative_binomial",
             "sample_generalized_negative_binomial", "sample_multinomial"]
+
+
+# ---------------------------------------------------------------------------
+# *_like draws (reference: sample_op.cc *_like variants — shape/ctx/dtype
+# follow the input array)
+# ---------------------------------------------------------------------------
+
+def _like(fn, data, dtype=None, out=None, **kw):
+    r = fn(shape=data.shape, dtype=dtype or str(data.dtype),
+           ctx=data.context, **kw)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+def uniform_like(data, low=0.0, high=1.0, dtype=None, out=None, **kwargs):
+    return _like(uniform, data, dtype=dtype, out=out, low=low, high=high)
+
+
+def normal_like(data, loc=0.0, scale=1.0, dtype=None, out=None, **kwargs):
+    return _like(normal, data, dtype=dtype, out=out, loc=loc, scale=scale)
+
+
+def gamma_like(data, alpha=1.0, beta=1.0, dtype=None, out=None, **kwargs):
+    return _like(gamma, data, dtype=dtype, out=out, alpha=alpha, beta=beta)
+
+
+def exponential_like(data, lam=1.0, dtype=None, out=None, **kwargs):
+    return _like(exponential, data, dtype=dtype, out=out, scale=1.0 / lam)
+
+
+def poisson_like(data, lam=1.0, dtype=None, out=None, **kwargs):
+    return _like(poisson, data, dtype=dtype, out=out, lam=lam)
+
+
+def randint_like(data, low=0, high=10, dtype="int32", out=None, **kwargs):
+    r = randint(low, high, shape=data.shape, dtype=dtype,
+                ctx=data.context)
+    if out is not None:
+        out._set_data(r._read())
+        return out
+    return r
+
+
+__all__ += ["uniform_like", "normal_like", "gamma_like",
+            "exponential_like", "poisson_like", "randint_like"]

@@ -48,6 +48,12 @@ def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
         return 3
     if opname in ("split", "SliceChannel"):
         return int(kwargs.get("num_outputs", 1))
+    if opname == "_contrib_hawkesll":
+        return 2
+    if opname == "split_v2":
+        if kwargs.get("sections"):
+            return int(kwargs["sections"])
+        return len(tuple(kwargs.get("indices", ()))) + 1
     if opname == "RNN":
         return 3 if kwargs.get("mode") == "lstm" else 2
     if opname == "topk" and kwargs.get("ret_typ") == "both":

@@ -540,3 +540,189 @@ def test_square_sum_exclude_negative_axis():
     out = nd.square_sum(nd.array(x), axis=-1, exclude=True).asnumpy()
     assert out.shape == (4,)
     np.testing.assert_allclose(out, (x ** 2).sum((0, 1)), rtol=1e-5)
+
+
+def test_split_v2_sections_and_indices():
+    x = nd.array(np.arange(48, dtype=np.float32).reshape(6, 8))
+    parts = nd.split_v2(x, sections=3)
+    assert [p.shape for p in parts] == [(2, 8)] * 3
+    np.testing.assert_allclose(parts[1].asnumpy(), x.asnumpy()[2:4])
+    parts = nd.split_v2(x, indices=(2, 5), axis=0)
+    assert [p.shape for p in parts] == [(2, 8), (3, 8), (1, 8)]
+    sq = nd.split_v2(nd.array(np.ones((4, 2), np.float32)), sections=4,
+                     squeeze_axis=True)
+    assert sq[0].shape == (2,)
+
+
+def test_random_like_family():
+    z = nd.zeros((50, 40), dtype="float32")
+    u = nd.uniform_like(z)
+    assert u.shape == (50, 40) and 0.4 < float(u.asnumpy().mean()) < 0.6
+    n = nd.normal_like(z, loc=3.0, scale=0.5)
+    assert abs(float(n.asnumpy().mean()) - 3.0) < 0.1
+    p = nd.poisson_like(z, lam=6.0)
+    assert abs(float(p.asnumpy().mean()) - 6.0) < 0.5
+    g = nd.gamma_like(z, alpha=4.0, beta=0.5)
+    assert abs(float(g.asnumpy().mean()) - 2.0) < 0.3
+    e = nd.exponential_like(z, lam=2.0)
+    assert abs(float(e.asnumpy().mean()) - 0.5) < 0.1
+    r = nd.randint_like(z, 0, 5)
+    a = r.asnumpy()
+    assert (a >= 0).all() and (a < 5).all()
+
+
+def test_interleaved_matmul_attention_ops():
+    """The reference's fused transformer primitives
+    (contrib/transformer.cc interleaved_matmul_*): reconstruct standard
+    multi-head attention and match a manual computation."""
+    rs = np.random.RandomState(0)
+    L, B, H, D = 5, 2, 3, 4
+    qkv = rs.randn(L, B, H * 3 * D).astype(np.float32)
+    att = nd.interleaved_matmul_selfatt_qk(nd.array(qkv), heads=H)
+    assert att.shape == (B * H, L, L)
+    out = nd.interleaved_matmul_selfatt_valatt(
+        nd.array(qkv), nd.softmax(att, axis=-1), heads=H)
+    assert out.shape == (L, B, H * D)
+    x = qkv.reshape(L, B, H, 3, D)
+    q, k, v = (x[:, :, :, i, :].transpose(1, 2, 0, 3) for i in range(3))
+    s = (q / np.sqrt(D)) @ k.transpose(0, 1, 3, 2)
+    p = np.exp(s - s.max(-1, keepdims=True))
+    p /= p.sum(-1, keepdims=True)
+    ref = (p @ v).transpose(2, 0, 1, 3).reshape(L, B, H * D)
+    np.testing.assert_allclose(out.asnumpy(), ref, atol=1e-5)
+
+    Lk = 7
+    qp = rs.randn(L, B, H * D).astype(np.float32)
+    kv = rs.randn(Lk, B, H * 2 * D).astype(np.float32)
+    att2 = nd.interleaved_matmul_encdec_qk(nd.array(qp), nd.array(kv),
+                                           heads=H)
+    assert att2.shape == (B * H, L, Lk)
+    out2 = nd.interleaved_matmul_encdec_valatt(
+        nd.array(kv), nd.softmax(att2, axis=-1), heads=H)
+    assert out2.shape == (L, B, H * D)
+    qh = qp.reshape(L, B, H, D).transpose(1, 2, 0, 3)
+    xkv = kv.reshape(Lk, B, H, 2, D)
+    kh, vh = (xkv[:, :, :, i, :].transpose(1, 2, 0, 3) for i in range(2))
+    s2 = (qh / np.sqrt(D)) @ kh.transpose(0, 1, 3, 2)
+    p2 = np.exp(s2 - s2.max(-1, keepdims=True))
+    p2 /= p2.sum(-1, keepdims=True)
+    ref2 = (p2 @ vh).transpose(2, 0, 1, 3).reshape(L, B, H * D)
+    np.testing.assert_allclose(out2.asnumpy(), ref2, atol=1e-5)
+
+
+def test_hawkesll():
+    """hawkesll (contrib/hawkes_ll.cc): zero-alpha reduces to the exact
+    Poisson log-likelihood; nonzero-alpha matches a direct O(n²)
+    evaluation of the same exponential-kernel model."""
+    K = 2
+    mu = np.array([[0.5, 1.0]], np.float32)
+    ll, st = nd.hawkesll(
+        nd.array(mu), nd.array(np.zeros(K, np.float32)),
+        nd.array(np.ones(K, np.float32)),
+        nd.array(np.zeros((1, K), np.float32)),
+        nd.array(np.array([[0.3, 0.7, 0.2]], np.float32)),
+        nd.array(np.array([[0, 1, 0]], np.float32)),
+        nd.array(np.array([3], np.float32)),
+        nd.array(np.array([2.0], np.float32)))
+    expect = np.log(0.5) + np.log(1.0) + np.log(0.5) - 1.5 * 2.0
+    np.testing.assert_allclose(ll.asnumpy(), [expect], rtol=1e-5)
+
+    mu1 = np.array([[0.4, 0.8]], np.float32)
+    al = np.array([0.3, 0.5], np.float32)
+    be = np.array([1.2, 0.7], np.float32)
+    lags = np.array([[0.4, 0.3, 0.6, 0.2]], np.float32)
+    marks = np.array([[0, 1, 0, 0]], np.float32)
+    ll2, st2 = nd.hawkesll(
+        nd.array(mu1), nd.array(al), nd.array(be),
+        nd.array(np.zeros((1, K), np.float32)), nd.array(lags),
+        nd.array(marks), nd.array(np.array([4], np.float32)),
+        nd.array(np.array([2.0], np.float32)))
+    t = np.cumsum(lags[0])
+    mk = marks[0].astype(int)
+    direct = 0.0
+    for i in range(4):
+        lam = mu1[0, mk[i]] + al[mk[i]] * be[mk[i]] * sum(
+            np.exp(-be[mk[i]] * (t[i] - t[j]))
+            for j in range(i) if mk[j] == mk[i])
+        direct += np.log(lam)
+    direct -= mu1[0].sum() * 2.0
+    for i in range(4):
+        direct -= al[mk[i]] * (1 - np.exp(-be[mk[i]] * (2.0 - t[i])))
+    np.testing.assert_allclose(ll2.asnumpy(), [direct], rtol=1e-5)
+    assert st2.shape == (1, K)
+
+
+def test_hawkesll_nonzero_state_and_gradients():
+    """Review regressions: nonzero initial state's excitation enters the
+    compensator; the op is differentiable (gradient-based MLE works)."""
+    from mxnet_tpu import autograd
+    K = 1
+    mu = np.array([[0.6]], np.float32)
+    al = np.array([0.4], np.float32)
+    be = np.array([1.1], np.float32)
+    st0 = np.array([[0.8]], np.float32)          # nonzero initial state
+    lags = np.array([[0.5, 0.7]], np.float32)
+    marks = np.zeros((1, 2), np.float32)
+    T = 2.0
+    ll, _ = nd.hawkesll(nd.array(mu), nd.array(al), nd.array(be),
+                        nd.array(st0), nd.array(lags), nd.array(marks),
+                        nd.array([2.0]), nd.array([T]))
+    # direct evaluation with the state as pre-t0 excitation
+    t = np.cumsum(lags[0])
+    r = st0[0, 0]
+    direct = 0.0
+    prev_t = 0.0
+    for i in range(2):
+        r = np.exp(-be[0] * (t[i] - prev_t)) * (r + (1 if i else 0))
+        direct += np.log(mu[0, 0] + al[0] * be[0] * r)
+        prev_t = t[i]
+    direct -= mu[0, 0] * T
+    direct -= al[0] * st0[0, 0] * (1 - np.exp(-be[0] * T))
+    for i in range(2):
+        direct -= al[0] * (1 - np.exp(-be[0] * (T - t[i])))
+    np.testing.assert_allclose(ll.asnumpy(), [direct], rtol=1e-5)
+
+    # differentiable: d(ll)/d(mu) exists and matches finite differences
+    mu_nd = nd.array(mu)
+    mu_nd.attach_grad()
+    with autograd.record():
+        ll2, _ = nd.hawkesll(mu_nd, nd.array(al), nd.array(be),
+                             nd.array(st0), nd.array(lags),
+                             nd.array(marks), nd.array([2.0]),
+                             nd.array([T]))
+        s = nd.sum(ll2)
+    s.backward()
+    eps = 1e-3
+    def f(m):
+        ll3, _ = nd.hawkesll(nd.array([[m]]), nd.array(al), nd.array(be),
+                             nd.array(st0), nd.array(lags),
+                             nd.array(marks), nd.array([2.0]),
+                             nd.array([T]))
+        return float(ll3.asnumpy()[0])
+    fd = (f(0.6 + eps) - f(0.6 - eps)) / (2 * eps)
+    np.testing.assert_allclose(mu_nd.grad.asnumpy()[0, 0], fd, rtol=1e-2)
+
+
+def test_split_v2_single_output_and_f16_attention_dtype():
+    x = nd.array(np.ones((4, 4), np.float32))
+    y = nd.split_v2(x, sections=1)
+    assert hasattr(y, "shape") and y.shape == (4, 4)   # not a list
+
+    qkv = nd.array(np.random.RandomState(0)
+                   .randn(4, 2, 2 * 3 * 8).astype(np.float16))
+    att = nd.interleaved_matmul_selfatt_qk(qkv, heads=2)
+    assert att.dtype == np.float16                     # no f32 promotion
+    out = nd.interleaved_matmul_selfatt_valatt(
+        qkv, nd.softmax(att, axis=-1), heads=2)
+    assert out.dtype == np.float16
+
+
+def test_random_like_out_and_dtype():
+    z = nd.zeros((6, 5), dtype="float32")
+    buf = nd.zeros((6, 5))
+    r = nd.uniform_like(z, out=buf)
+    assert r is buf and float(buf.asnumpy().sum()) != 0.0
+    h = nd.normal_like(z, dtype="float16")
+    assert h.dtype == np.float16
+    ri = nd.randint_like(z, 0, 9, dtype="int64")
+    assert str(ri.dtype).startswith("int")
