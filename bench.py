@@ -308,7 +308,7 @@ def bench_pipeline(n_images=1024, batch=128, threads=None):
             "decode_threads": threads}
 
 
-def _backend_reachable(timeout=300):
+def _backend_reachable(timeout=600):
     """Probe the accelerator in a SUBPROCESS: a wedged TPU claim hangs
     inside the PJRT client where no Python timeout can interrupt it, so
     the only safe watchdog is process isolation.  (Observed this round:
@@ -353,7 +353,7 @@ def main():
             "metric": "bench_failed", "value": 0.0, "unit": "n/a",
             "vs_baseline": 0.0,
             "rows": {"error": "accelerator backend unreachable "
-                              "(claim hang or init failure) after 300s "
+                              "(claim hang or init failure) after 600s "
                               "subprocess probe"}}))
         sys.exit(1)
 
