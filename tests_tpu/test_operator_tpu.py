@@ -12,3 +12,4 @@ from tests.test_contrib_misc import *     # noqa: F401,F403
 from tests.test_ctc import *              # noqa: F401,F403
 from tests.test_quantization import *     # noqa: F401,F403
 from tests.test_ops_misc import *         # noqa: F401,F403
+from tests.test_kernels import *          # noqa: F401,F403
