@@ -349,12 +349,37 @@ def main():
 
     import sys
     if not _backend_reachable():
+        # the chip is gone, but two BASELINE rows are host-side by
+        # nature: run each in its OWN timeout-guarded CPU-forced
+        # subprocess (the parent must never touch jax after the probe
+        # proved the backend wedged — bounded termination is this
+        # path's whole purpose) so the record still carries real
+        # numbers next to the outage marker
+        rows = {"error": "accelerator backend unreachable (claim hang "
+                         "or init failure) after 600s subprocess probe; "
+                         "host-only rows follow"}
+
+        def host_row(only, timeout=900):
+            import os
+            import subprocess
+            env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+                       JAX_PLATFORMS="cpu")
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--only", only],
+                    capture_output=True, text=True, timeout=timeout,
+                    env=env)
+                data = json.loads(r.stdout.strip().splitlines()[-1])
+                return next(iter(data["rows"].values()))
+            except Exception as e:  # noqa: BLE001
+                return {"error": f"{type(e).__name__}: {e}"[:300]}
+
+        rows["mnist_mlp_imperative_cpu_host"] = host_row("mnist_mlp")
+        rows["input_pipeline"] = host_row("pipeline")
         print(json.dumps({
-            "metric": "bench_failed", "value": 0.0, "unit": "n/a",
-            "vs_baseline": 0.0,
-            "rows": {"error": "accelerator backend unreachable "
-                              "(claim hang or init failure) after 600s "
-                              "subprocess probe"}}))
+            "metric": "bench_chip_unavailable", "value": 0.0,
+            "unit": "n/a", "vs_baseline": 0.0, "rows": rows}))
         sys.exit(1)
 
     import contextlib
