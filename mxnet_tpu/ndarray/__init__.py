@@ -47,6 +47,25 @@ for _name, _op in list(_register_mod._registry.items()):
 # frontends that need special handling
 # ---------------------------------------------------------------------------
 
+def split_v2(ary, indices_or_sections=None, axis=0, squeeze_axis=False,
+             **kwargs):
+    """Reference-parity frontend (python/mxnet/ndarray/ndarray.py split_v2):
+    positional ``indices_or_sections`` — an int selects equal sections, a
+    tuple gives split indices (a leading 0 per the raw-op segment-start
+    convention is accepted).  ``sections=``/``indices=`` kwargs also work."""
+    if indices_or_sections is not None:
+        import numpy as _np
+        if isinstance(indices_or_sections, (int, _np.integer)):
+            kwargs["sections"] = int(indices_or_sections)
+        else:
+            kwargs["indices"] = tuple(indices_or_sections)
+    out = kwargs.pop("out", None)
+    kwargs.pop("name", None)
+    kwargs.setdefault("axis", axis)
+    kwargs.setdefault("squeeze_axis", squeeze_axis)
+    return invoke_by_name("split_v2", [ary], kwargs, out=out)
+
+
 def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=None, **kwargs):
     """Dropout; active only under autograd.train_mode (or mode='always'),
     matching the reference op's behavior."""

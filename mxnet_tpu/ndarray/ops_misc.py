@@ -30,6 +30,17 @@ import numpy as _np
 from .register import register_op
 
 
+def normalize_split_indices(indices):
+    """Canonical split points for jnp.split: the reference's raw _split_v2
+    op passes segment STARTS (leading 0 included); np.split-style split
+    points omit it.  One helper shared by the op maker and the symbol-side
+    output-count logic so the convention cannot drift."""
+    idx = list(indices)
+    if idx and idx[0] == 0:
+        idx = idx[1:]
+    return idx
+
+
 def _register():
     import jax
     import jax.numpy as jnp
@@ -132,6 +143,14 @@ def _register():
     # ---- IdentityAttachKLSparseReg --------------------------------------
     # Identity forward; backward adds the KL sparsity-penalty gradient
     # (reference: src/operator/identity_attach_KL_sparse_reg.cc).
+    #
+    # Intentional deviation (ADVICE r3): the reference keeps a momentum
+    # moving average of rho_hat across batches in mutable op state.  Ops
+    # here are pure functions traced once under jit, so cross-call mutable
+    # state is not representable; rho_hat is computed from the current
+    # batch only and `momentum` is accepted for signature parity but
+    # unused.  Users needing the smoothed estimate can carry rho_hat as an
+    # explicit model state (the functional idiom for all such statistics).
     def kl_sparse_reg_maker(sparseness_target=0.1, penalty=0.001,
                             momentum=0.9):
         rho = float(sparseness_target)
@@ -434,11 +453,13 @@ def _register():
     # ---- split_v2 (matrix_op.cc SplitV2: sections OR explicit indices) ---
     def split_v2_maker(indices=(), axis=0, squeeze_axis=False,
                       sections=0):
+        idx = normalize_split_indices(indices)
+
         def fn(data):
             if sections:
                 parts = jnp.split(data, int(sections), axis=axis)
             else:
-                parts = jnp.split(data, list(indices), axis=axis)
+                parts = jnp.split(data, idx, axis=axis)
             if squeeze_axis:
                 parts = [jnp.squeeze(p, axis=axis) for p in parts]
             if len(parts) == 1:

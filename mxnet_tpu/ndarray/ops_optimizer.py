@@ -315,9 +315,7 @@ def _register():
                           wd=0.0, rescale_grad=1.0, clip_grad=-1.0):
         def fn(weight, grad, d, v, z, lr):
             lr = lr.astype(weight.dtype)
-            g = grad * rescale_grad + wd * weight
-            if clip_grad is not None and clip_grad > 0:
-                g = jnp.clip(g, -clip_grad, clip_grad)
+            g = _prep_grad(grad, wd, weight, rescale_grad, clip_grad)
             v_new = beta2 * v + (1 - beta2) * g * g
             d_new = (1 - beta1 ** t) / lr * (
                 jnp.sqrt(v_new / (1 - beta2 ** t)) + epsilon)

@@ -53,7 +53,8 @@ def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
     if opname == "split_v2":
         if kwargs.get("sections"):
             return int(kwargs["sections"])
-        return len(tuple(kwargs.get("indices", ()))) + 1
+        from ..ndarray.ops_misc import normalize_split_indices
+        return len(normalize_split_indices(kwargs.get("indices", ()))) + 1
     if opname == "RNN":
         return 3 if kwargs.get("mode") == "lstm" else 2
     if opname == "topk" and kwargs.get("ret_typ") == "both":

@@ -259,6 +259,23 @@ def test_ftml_update():
     np.testing.assert_allclose(o[2].asnumpy(), [v], rtol=1e-5)
 
 
+def test_ftml_update_clip_before_wd():
+    # Regression (ADVICE r3): clip applies to grad*rescale only; wd*weight
+    # is added AFTER clipping, matching the reference kernel and _prep_grad.
+    beta1, beta2, eps = 0.6, 0.999, 1e-8
+    w, g, lr, clip, wd = 1.0, 5.0, 0.1, 0.5, 0.2
+    g_eff = np.clip(g * 1.0, -clip, clip) + wd * w     # 0.5 + 0.2 = 0.7
+    v = (1 - beta2) * g_eff * g_eff
+    d = (1 - beta1) / lr * (np.sqrt(v / (1 - beta2)) + eps)
+    z = (1 - beta1) * g_eff - d * w
+    w_new = -z / d
+    o = nd.ftml_update(nd.array([w]), nd.array([g]), nd.zeros((1,)),
+                       nd.zeros((1,)), nd.zeros((1,)), nd.array(lr), t=1,
+                       beta1=beta1, beta2=beta2, epsilon=eps,
+                       wd=wd, clip_grad=clip)
+    np.testing.assert_allclose(o[0].asnumpy(), [w_new], rtol=1e-5)
+
+
 def test_lamb_update_phases():
     w = np.array([0.5, -0.3, 0.8], np.float32)
     g = np.array([0.1, -0.2, 0.05], np.float32)
@@ -552,6 +569,14 @@ def test_split_v2_sections_and_indices():
     sq = nd.split_v2(nd.array(np.ones((4, 2), np.float32)), sections=4,
                      squeeze_axis=True)
     assert sq[0].shape == (2,)
+    # reference-style positional indices_or_sections (ADVICE r3)
+    parts = nd.split_v2(x, 3)
+    assert [p.shape for p in parts] == [(2, 8)] * 3
+    parts = nd.split_v2(x, (2, 5))
+    assert [p.shape for p in parts] == [(2, 8), (3, 8), (1, 8)]
+    # raw-op segment-start convention: leading 0 is NOT an empty first part
+    parts = nd.split_v2(x, (0, 2, 5))
+    assert [p.shape for p in parts] == [(2, 8), (3, 8), (1, 8)]
 
 
 def test_random_like_family():
