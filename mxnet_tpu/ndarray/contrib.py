@@ -54,20 +54,87 @@ def _ctx_of(*xs):
     return current_context()
 
 
+def _recording():
+    from .. import autograd as _ag
+    return _ag.is_recording()
+
+
+def _stack0(rows):
+    """Stack a list of per-step NDArray results along a new axis 0."""
+    from .register import invoke_by_name
+    return invoke_by_name("stack", rows, {"axis": 0})
+
+
+def _probe_step_shapes(func, lv_vals, ctx):
+    """Abstract-probe ``func``'s step outputs WITHOUT executing it (the body
+    must not run when the loop never executes — jax.eval_shape traces with
+    avals only).  Recording is paused so the trace leaves no tape nodes.
+    Returns (list_of_ShapeDtypeStructs, outputs_were_single)."""
+    import jax
+    from .. import autograd as _ag
+    single = [True]
+
+    def _probe(*vals):
+        outs, _ = func(*[_to_nds(v, ctx) for v in vals])
+        ovals = _to_vals(outs)
+        single[0] = not isinstance(ovals, (list, tuple))
+        return [ovals] if single[0] else list(ovals)
+
+    with _ag.pause():
+        avals = jax.eval_shape(_probe, *lv_vals)
+    return avals, single[0]
+
+
 def foreach(body, data, init_states):
     """Run ``body(x_t, states) -> (out_t, states)`` over axis 0 of data —
     the reference's foreach (≡ lax.scan).  Returns (stacked_outs, states).
+
+    Under ``autograd.record()`` this unrolls as a Python loop — exactly the
+    reference's ndarray-mode foreach (python/mxnet/ndarray/contrib.py is a
+    for loop) — so the tape sees every inner op and gradients flow to loop
+    inputs AND closure-captured parameters.  Outside recording it is one
+    fused ``lax.scan``.
     """
     import jax
     from .ndarray import NDArray
     ctx = _ctx_of(data, init_states)
 
+    # zero-length data: the fused scan still yields correctly-shaped
+    # (0, ...) outputs (scan traces the body abstractly); there is nothing
+    # for the tape to record, so the fused path is right even when recording
+    n_steps = (data.shape[0] if isinstance(data, NDArray)
+               else list(data)[0].shape[0])
+    if _recording() and n_steps > 0:
+        data_single = isinstance(data, NDArray)
+        data_list = [data] if data_single else list(data)
+        n = n_steps
+        states = init_states
+        out_rows = None
+        for t in range(n):
+            xt = data_list[0][t] if data_single else [d[t] for d in data_list]
+            outs, states = body(xt, states)
+            outs_list = [outs] if isinstance(outs, NDArray) else list(outs)
+            if out_rows is None:
+                out_rows = [[] for _ in outs_list]
+            for acc, o in zip(out_rows, outs_list):
+                acc.append(o)
+        stacked = [_stack0(acc) for acc in (out_rows or [])]
+        single_out = out_rows is not None and not isinstance(outs, (list, tuple))
+        return (stacked[0] if single_out else stacked), states
+
     def step(carry, x):
         outs, new_states = body(_to_nds(x, ctx), _to_nds(carry, ctx))
         return _to_vals(new_states), _to_vals(outs)
 
-    carry, ys = jax.lax.scan(step, _to_vals(init_states), _to_vals(data))
-    return _to_nds(ys, ctx), _to_nds(carry, ctx)
+    def _fused():
+        carry, ys = jax.lax.scan(step, _to_vals(init_states), _to_vals(data))
+        return _to_nds(ys, ctx), _to_nds(carry, ctx)
+
+    if _recording():                    # zero-length case only (see above):
+        from .. import autograd as _ag  # trace must leave no tape nodes
+        with _ag.pause():
+            return _fused()
+    return _fused()
 
 
 def while_loop(cond, func, loop_vars, max_iterations=None):
@@ -82,20 +149,11 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
         raise MXNetError("while_loop requires max_iterations on TPU "
                          "(static shapes)")
     ctx = _ctx_of(loop_vars)
+
+    if _recording():
+        return _while_loop_eager(cond, func, loop_vars, int(max_iterations))
     lv0 = tuple(_to_vals(v) for v in loop_vars)
-
-    # abstract shape probe: trace func without executing it (the body must
-    # not run — or run twice — when cond is initially false)
-    _single = [True]
-
-    def _probe(*vals):
-        outs, _ = func(*[_to_nds(v, ctx) for v in vals])
-        ovals = _to_vals(outs)
-        _single[0] = not isinstance(ovals, (list, tuple))
-        return [ovals] if _single[0] else list(ovals)
-
-    probe_avals = jax.eval_shape(_probe, *lv0)
-    single = _single[0]
+    probe_avals, single = _probe_step_shapes(func, lv0, ctx)
     bufs0 = tuple(jnp.zeros((max_iterations,) + v.shape, v.dtype)
                   for v in probe_avals)
 
@@ -120,10 +178,61 @@ def while_loop(cond, func, loop_vars, max_iterations=None):
     return outs, [_to_nds(v, ctx) for v in lv]
 
 
+def _while_loop_eager(cond, func, loop_vars, max_iterations):
+    """Reference ndarray-mode while_loop: host-evaluated condition, Python
+    loop, tape-visible ops; outputs zero-padded to max_iterations rows so
+    shapes match the fused path."""
+    import numpy as np
+    from .ndarray import NDArray
+    from . import zeros as nd_zeros
+    from .register import invoke_by_name
+    lv = list(loop_vars)
+    rows = None
+    single = True
+    it = 0
+    while it < max_iterations and bool(np.asarray(
+            cond(*lv).asnumpy()).reshape(())):
+        outs, new_lv = func(*lv)
+        lv = list(new_lv) if isinstance(new_lv, (list, tuple)) else [new_lv]
+        single = isinstance(outs, NDArray)
+        outs_list = [outs] if single else list(outs)
+        if rows is None:
+            rows = [[] for _ in outs_list]
+        for acc, o in zip(rows, outs_list):
+            acc.append(o)
+        it += 1
+    if rows is None:
+        # zero executed steps: abstract shape probe (the body must not run)
+        ctx = _ctx_of(lv)
+        avals, single = _probe_step_shapes(
+            func, [v._read() for v in lv], ctx)
+        bufs = [nd_zeros((max_iterations,) + tuple(a.shape), dtype=a.dtype)
+                for a in avals]
+        return (bufs[0] if single else bufs), lv
+    bufs = []
+    for acc in rows:
+        stacked = _stack0(acc)
+        if it < max_iterations:
+            pad = nd_zeros((max_iterations - it,) + acc[0].shape,
+                           dtype=acc[0].dtype)
+            stacked = invoke_by_name("concat", [stacked, pad],
+                                     {"dim": 0})
+        bufs.append(stacked)
+    return (bufs[0] if single else bufs), lv
+
+
 def cond(pred, then_func, else_func):
-    """reference: contrib.cond ≡ lax.cond (both branches traced once)."""
+    """reference: contrib.cond ≡ lax.cond (both branches traced once).
+    Under autograd recording the predicate is evaluated on the host and
+    only the taken branch runs (reference ndarray-mode semantics — the
+    tape then differentiates exactly the executed branch)."""
     import jax
     import jax.numpy as jnp
+    if _recording():
+        import numpy as np
+        p = bool(np.asarray(
+            pred.asnumpy() if hasattr(pred, "asnumpy") else pred).reshape(()))
+        return then_func() if p else else_func()
     p = pred._read() if hasattr(pred, "_read") else pred
     ctx = _ctx_of(pred)
 

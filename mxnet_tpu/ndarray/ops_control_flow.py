@@ -1,0 +1,162 @@
+"""Control-flow operators: ``_foreach`` / ``_while_loop`` / ``_cond``.
+
+Reference parity (SURVEY.md §2.2 contrib long tail):
+  src/operator/control_flow.cc registers _foreach/_while_loop/_cond as ops
+  whose bodies are NNVM *subgraphs* stored in node attributes, so dynamic
+  models (variable-step RNNs, beam search) run inside ONE executor graph.
+
+TPU-first design: the subgraph attribute here is a traced ``Symbol`` and
+the op bodies ARE the structured-control-flow primitives XLA requires —
+this is the one place the reference's design and the TPU's constraints
+coincide exactly (the reference added these ops so control flow could live
+inside the graph; jit *demands* it live inside the graph):
+
+  - ``_foreach``    ≡ ``lax.scan`` over axis 0.
+  - ``_while_loop`` ≡ a masked ``lax.scan`` over ``max_iterations`` steps.
+    ``lax.while_loop`` is not reverse-mode differentiable (XLA cannot
+    record a dynamic trip count), so the registry op — which the symbol
+    executor differentiates through ``jax.vjp`` — trades early exit for a
+    bounded scan with an ``active`` mask, keeping backward exact.  The
+    imperative frontend (ndarray/contrib.py) keeps the early-exiting
+    ``lax.while_loop`` for inference.
+  - ``_cond``       ≡ ``lax.cond`` (both branches traced once).
+
+Free variables (weights captured by the body closure) become explicit op
+inputs, so executor backward produces their gradients — same contract as
+the reference's subgraph FGradient.
+"""
+from __future__ import annotations
+
+import json as _json
+
+from .register import register_op
+
+__all__ = ["SubgraphAttr"]
+
+
+class SubgraphAttr:
+    """A Symbol-valued node attribute.
+
+    Identity-hashed so the op compile cache can key on it (Symbol itself
+    defines arithmetic dunders and must not be hashed); serializes to the
+    subgraph's JSON so control-flow graphs round-trip through
+    ``Symbol.tojson`` / ``load_json`` like the reference's subgraph attrs.
+    """
+
+    __slots__ = ("sym",)
+
+    def __init__(self, sym):
+        self.sym = sym
+
+    def __hash__(self):
+        return id(self.sym)
+
+    def __eq__(self, other):
+        return isinstance(other, SubgraphAttr) and other.sym is self.sym
+
+    def __str__(self):
+        return self.sym.tojson()
+
+    def __repr__(self):
+        return f"<SubgraphAttr {self.sym!r}>"
+
+
+def _names(v):
+    """Attr tuples may arrive as JSON-parsed lists after a load round-trip."""
+    if isinstance(v, str):
+        v = _json.loads(v)
+    return tuple(v)
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+
+    def foreach_maker(subgraph=None, data_names=(), state_names=(),
+                      free_names=(), n_outs=1):
+        data_names = _names(data_names)
+        state_names = _names(state_names)
+        free_names = _names(free_names)
+        run = subgraph.sym.compile()
+        nd_, ns = len(data_names), len(state_names)
+
+        def fn(*vals):
+            data = vals[:nd_]
+            states = tuple(vals[nd_:nd_ + ns])
+            feed_free = dict(zip(free_names, vals[nd_ + ns:]))
+
+            def step(carry, xs):
+                feed = dict(zip(data_names, xs))
+                feed.update(zip(state_names, carry))
+                feed.update(feed_free)
+                res = run(feed)
+                return tuple(res[n_outs:]), tuple(res[:n_outs])
+
+            carry, ys = jax.lax.scan(step, states, tuple(data))
+            out = tuple(ys) + tuple(carry)
+            return out if len(out) > 1 else out[0]
+        return fn
+    register_op("_foreach", foreach_maker,
+                ref="src/operator/control_flow.cc (foreach)")
+
+    def while_loop_maker(cond_subgraph=None, body_subgraph=None,
+                         loop_names=(), free_names=(), n_outs=1,
+                         max_iterations=0):
+        loop_names = _names(loop_names)
+        free_names = _names(free_names)
+        cond_run = cond_subgraph.sym.compile()
+        body_run = body_subgraph.sym.compile()
+        nl = len(loop_names)
+        T = int(max_iterations)
+
+        def fn(*vals):
+            lv0 = tuple(vals[:nl])
+            feed_free = dict(zip(free_names, vals[nl:]))
+
+            def feed_of(lv):
+                feed = dict(zip(loop_names, lv))
+                feed.update(feed_free)
+                return feed
+
+            def step(carry, _):
+                active, lv = carry
+                active = jnp.logical_and(
+                    active,
+                    jnp.asarray(cond_run(feed_of(lv))[0]).reshape(())
+                    .astype(bool))
+                res = body_run(feed_of(lv))
+                outs = tuple(jnp.where(active, o, jnp.zeros_like(o))
+                             for o in res[:n_outs])
+                new_lv = tuple(
+                    jnp.where(active, n, p)
+                    for n, p in zip(res[n_outs:], lv))
+                return (active, new_lv), outs
+
+            (_, lv), bufs = jax.lax.scan(
+                step, (jnp.asarray(True), lv0), None, length=T)
+            out = tuple(bufs) + tuple(lv)
+            return out if len(out) > 1 else out[0]
+        return fn
+    register_op("_while_loop", while_loop_maker,
+                ref="src/operator/control_flow.cc (while_loop)")
+
+    def cond_maker(then_subgraph=None, else_subgraph=None, free_names=(),
+                   n_outs=1):
+        free_names = _names(free_names)
+        then_run = then_subgraph.sym.compile()
+        else_run = else_subgraph.sym.compile()
+
+        def fn(pred, *frees):
+            feed = dict(zip(free_names, frees))
+            p = jnp.asarray(pred).reshape(()).astype(bool)
+            out = jax.lax.cond(p,
+                               lambda f: tuple(then_run(f)[:n_outs]),
+                               lambda f: tuple(else_run(f)[:n_outs]),
+                               feed)
+            return out if len(out) > 1 else out[0]
+        return fn
+    register_op("_cond", cond_maker,
+                ref="src/operator/control_flow.cc (cond)")
+
+
+_register()

@@ -1,11 +1,24 @@
-"""``mx.sym.contrib``: symbol frontends for the _contrib_* ops
-(reference: python/mxnet/symbol/contrib.py)."""
+"""``mx.sym.contrib``: symbol frontends for the _contrib_* ops plus the
+control-flow constructors (reference: python/mxnet/symbol/contrib.py —
+foreach/while_loop/cond trace the user's body into a subgraph and emit one
+_foreach/_while_loop/_cond node; SURVEY.md §2.2).
+
+The tracing protocol mirrors the reference: body callables receive fresh
+placeholder Variables, the composed result becomes the subgraph attribute,
+and every outer Symbol the body captured (weights, constants) is detected
+as a free variable and wired in as an explicit op input — so
+``simple_bind`` binds them and backward yields their gradients.
+"""
 from __future__ import annotations
 
+import itertools as _itertools
 import sys as _sys
 
+from ..base import MXNetError
 from ..ndarray.register import _registry
-from .register import _make_sym_frontend
+from ..ndarray.ops_control_flow import SubgraphAttr
+from .register import _make_sym_frontend, apply_op
+from .symbol import Group, Symbol
 
 _PREFIX = "_contrib_"
 _mod = _sys.modules[__name__]
@@ -13,3 +26,108 @@ _mod = _sys.modules[__name__]
 for _name in list(_registry):
     if _name.startswith(_PREFIX):
         setattr(_mod, _name[len(_PREFIX):], _make_sym_frontend(_name))
+
+
+_uid = _itertools.count()
+
+
+def _as_list(x):
+    return ([x], True) if isinstance(x, Symbol) else (list(x), False)
+
+
+def _free_vars(inner, placeholder_names):
+    """Var nodes the traced subgraph references beyond its placeholders —
+    these are shared _Node objects with the outer graph, so wrapping them
+    links the control-flow node into the caller's graph."""
+    syms, names = [], []
+    for node in inner._topo():
+        if node.is_var and node.name not in placeholder_names:
+            syms.append(Symbol([(node, 0)]))
+            names.append(node.name)
+    return syms, names
+
+
+def foreach(body, data, init_states, name="foreach"):
+    """Scan ``body(x_t, states) -> (out_t, new_states)`` over axis 0 of
+    ``data`` inside the graph (reference sym.contrib.foreach ≡ lax.scan).
+    Returns ``(outputs, final_states)`` with the caller's nesting shape."""
+    tag = f"_{name}{next(_uid)}"
+    data_list, data_single = _as_list(data)
+    state_list, state_single = _as_list(init_states)
+    data_ph = [Symbol.var(f"{tag}_data{i}") for i in range(len(data_list))]
+    state_ph = [Symbol.var(f"{tag}_state{i}") for i in range(len(state_list))]
+    outs, new_states = body(data_ph[0] if data_single else data_ph,
+                            state_ph[0] if state_single else state_ph)
+    outs_list, out_single = _as_list(outs)
+    new_state_list, _ = _as_list(new_states)
+    if len(new_state_list) != len(state_list):
+        raise MXNetError("foreach body returned %d states, expected %d"
+                         % (len(new_state_list), len(state_list)))
+    inner = Group(outs_list + new_state_list)
+    ph_names = [s.name for s in data_ph + state_ph]
+    free_syms, free_names = _free_vars(inner, set(ph_names))
+    res = apply_op("_foreach", data_list + state_list + free_syms, {
+        "subgraph": SubgraphAttr(inner),
+        "data_names": tuple(s.name for s in data_ph),
+        "state_names": tuple(s.name for s in state_ph),
+        "free_names": tuple(free_names),
+        "n_outs": len(outs_list)}, name=name)
+    heads = list(res)
+    o = heads[:len(outs_list)]
+    st = heads[len(outs_list):]
+    return (o[0] if out_single else o), (st[0] if state_single else st)
+
+
+def while_loop(cond, func, loop_vars, max_iterations=None,
+               name="while_loop"):
+    """Bounded in-graph while loop (reference sym.contrib.while_loop).
+    ``cond(*loop_vars)`` must yield a scalar; ``func(*loop_vars)`` yields
+    ``(step_output, new_loop_vars)``.  Outputs are buffered to
+    ``max_iterations`` rows (static shapes); rows past the exit step are
+    zeros.  Reverse-mode differentiable — see ops_control_flow.py."""
+    if max_iterations is None:
+        raise MXNetError("while_loop requires max_iterations (static shapes)")
+    tag = f"_{name}{next(_uid)}"
+    lv_list, lv_single = _as_list(loop_vars)
+    lv_ph = [Symbol.var(f"{tag}_loop{i}") for i in range(len(lv_list))]
+    cond_out = cond(*lv_ph)
+    outs, new_lv = func(*lv_ph)
+    outs_list, out_single = _as_list(outs)
+    new_lv_list, _ = _as_list(new_lv)
+    if len(new_lv_list) != len(lv_list):
+        raise MXNetError("while_loop func returned %d loop_vars, expected %d"
+                         % (len(new_lv_list), len(lv_list)))
+    inner_body = Group(outs_list + new_lv_list)
+    ph_names = set(s.name for s in lv_ph)
+    free_syms, free_names = _free_vars(Group([cond_out] + outs_list
+                                             + new_lv_list), ph_names)
+    res = apply_op("_while_loop", lv_list + free_syms, {
+        "cond_subgraph": SubgraphAttr(cond_out),
+        "body_subgraph": SubgraphAttr(inner_body),
+        "loop_names": tuple(s.name for s in lv_ph),
+        "free_names": tuple(free_names),
+        "n_outs": len(outs_list),
+        "max_iterations": int(max_iterations)}, name=name)
+    heads = list(res)
+    o = heads[:len(outs_list)]
+    st = heads[len(outs_list):]
+    return (o[0] if out_single else o), (st[0] if lv_single else st)
+
+
+def cond(pred, then_func, else_func, name="cond"):
+    """In-graph conditional (reference sym.contrib.cond ≡ lax.cond): both
+    branches are traced once; outputs must agree in count (and, as XLA
+    requires, in shape/dtype)."""
+    then_out, then_single = _as_list(then_func())
+    else_out, _ = _as_list(else_func())
+    if len(then_out) != len(else_out):
+        raise MXNetError("cond branches disagree: %d vs %d outputs"
+                         % (len(then_out), len(else_out)))
+    free_syms, free_names = _free_vars(Group(then_out + else_out), set())
+    res = apply_op("_cond", [pred] + free_syms, {
+        "then_subgraph": SubgraphAttr(Group(then_out)),
+        "else_subgraph": SubgraphAttr(Group(else_out)),
+        "free_names": tuple(free_names),
+        "n_outs": len(then_out)}, name=name)
+    heads = list(res)
+    return heads[0] if then_single else heads

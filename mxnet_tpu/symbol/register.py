@@ -61,6 +61,12 @@ def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
         return 2
     if opname == "LayerNorm" and kwargs.get("output_mean_var"):
         return 3
+    if opname == "_foreach":
+        return int(kwargs.get("n_outs", 1)) + len(kwargs.get("state_names", ()))
+    if opname == "_while_loop":
+        return int(kwargs.get("n_outs", 1)) + len(kwargs.get("loop_names", ()))
+    if opname == "_cond":
+        return int(kwargs.get("n_outs", 1))
     return 1
 
 

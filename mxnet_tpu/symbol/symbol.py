@@ -98,14 +98,9 @@ class Symbol:
             args = (other, self) if reverse else (self, other)
             return apply_op(opname, list(args), {})
         scal = {"scalar": float(other)}
-        scalar_map = {
-            "broadcast_add": ("_plus_scalar", "_plus_scalar"),
-            "broadcast_sub": ("_minus_scalar", "_rminus_scalar"),
-            "broadcast_mul": ("_mul_scalar", "_mul_scalar"),
-            "broadcast_div": ("_div_scalar", "_rdiv_scalar"),
-            "broadcast_power": ("_power_scalar", "_rpower_scalar"),
-        }
-        fwd, rev = scalar_map[opname]
+        # one broadcast-op -> scalar-op mapping for both frontends
+        from ..ndarray.register import _SCALAR_MAP
+        fwd, rev = _SCALAR_MAP[opname]
         return apply_op(rev if reverse else fwd, [self], scal)
 
     def __add__(self, o):
@@ -134,6 +129,26 @@ class Symbol:
 
     def __pow__(self, o):
         return self._binop("broadcast_power", o)
+
+    # comparisons build graph nodes like the arithmetic dunders; __eq__ is
+    # deliberately NOT overridden (Symbols must stay identity-hashable for
+    # graph bookkeeping — use sym.broadcast_equal explicitly)
+    def __gt__(self, o):
+        return self._binop("broadcast_greater", o)
+
+    def __ge__(self, o):
+        return self._binop("broadcast_greater_equal", o)
+
+    def __lt__(self, o):
+        return self._binop("broadcast_lesser", o)
+
+    def __le__(self, o):
+        return self._binop("broadcast_lesser_equal", o)
+
+    def __ne__(self, o):
+        if isinstance(o, Symbol) or isinstance(o, (int, float)):
+            return self._binop("broadcast_not_equal", o)
+        return NotImplemented
 
     def __neg__(self):
         from .register import apply_op
@@ -586,11 +601,24 @@ def Group(symbols: Sequence[Symbol]) -> Symbol:
     return Symbol(heads)
 
 
+_SUBGRAPH_ATTRS = ("subgraph", "cond_subgraph", "body_subgraph",
+                   "then_subgraph", "else_subgraph")
+
+
 def load_json(json_str: str) -> Symbol:
     data = json.loads(json_str)
     nodes: List[_Node] = []
     for nd_ in data["nodes"]:
         attrs = {k: _parse_attr(v) for k, v in nd_.get("attrs", {}).items()}
+        if nd_["op"] in ("_foreach", "_while_loop", "_cond"):
+            # subgraph attrs serialized as embedded graph JSON — rebuild
+            # the Symbol wrapper (reference: subgraph deserialization in
+            # nnvm::Graph LoadJSON)
+            from ..ndarray.ops_control_flow import SubgraphAttr
+            for key in _SUBGRAPH_ATTRS:
+                if isinstance(attrs.get(key), dict):
+                    attrs[key] = SubgraphAttr(
+                        load_json(json.dumps(attrs[key])))
         inputs = [(nodes[i], oi) for i, oi, _ in nd_.get("inputs", [])]
         op = None if nd_["op"] == "null" else nd_["op"]
         num_out = 1
