@@ -884,6 +884,23 @@ def _register_round3b():
     register_op("_contrib_allclose", allclose_maker,
                 aliases=("allclose",), differentiable=False)
 
+    # ---- getnnz (src/operator/contrib/nnz.cc; csr there, storage-generic
+    # here: the count is the same question on any layout) ------------------
+    def getnnz_maker(axis=None):
+        def fn(data):
+            return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
+        return fn
+    register_op("_contrib_getnnz", getnnz_maker, differentiable=False)
+
+    # ---- backward_gradientmultiplier (gradient_multiplier_op.cc): the
+    # explicit backward of gradientmultiplier — a scalar scale ------------
+    def backward_gradmult_maker(scalar=1.0):
+        def fn(x):
+            return x * jnp.asarray(scalar, x.dtype)
+        return fn
+    register_op("_contrib_backward_gradientmultiplier",
+                backward_gradmult_maker)
+
 
 _register()
 _register_misc()

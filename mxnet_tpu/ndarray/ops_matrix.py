@@ -431,8 +431,10 @@ def _register():
         return fn
     register_op("space_to_depth", space_to_depth_maker)
 
-    simple_op("stop_gradient", lax.stop_gradient, aliases=("BlockGrad",))
-    simple_op("make_loss", lambda x: x, aliases=("MakeLoss",))
+    simple_op("stop_gradient", lax.stop_gradient,
+              aliases=("BlockGrad", "block_grad"))
+    # MakeLoss lives in ops_misc with the full reference backward contract
+    # (constant grad_scale gradient, batch/valid normalization)
     simple_op("identity", lambda x: x, aliases=("_copy",))
 
     def smooth_l1_maker(scalar=1.0):

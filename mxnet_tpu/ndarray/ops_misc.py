@@ -632,7 +632,71 @@ def _register():
         return fn
     register_op("_square_sum", square_sum_maker, aliases=("square_sum",))
 
+    # ---- hypot + logical binaries (elemwise_binary_op_extended.cc /
+    # elemwise_binary_op_logic.cc; scalar variants take the scalar as a
+    # 0-d array input, per the registry convention) ------------------------
+    def hypot_maker():
+        def fn(lhs, rhs):
+            return jnp.hypot(lhs, rhs)
+        return fn
+    register_op("_hypot", hypot_maker, aliases=("hypot",))
+    register_op("_hypot_scalar",
+                lambda: (lambda x, s: jnp.hypot(x, s.astype(x.dtype))))
 
+    for lname, lop in (("and", jnp.logical_and), ("or", jnp.logical_or),
+                       ("xor", jnp.logical_xor)):
+        def _mk(lop=lop):
+            def fn(lhs, rhs):
+                return lop(lhs.astype(bool),
+                           rhs.astype(bool)).astype(jnp.float32)
+            return fn
+
+        def _mk_scalar(lop=lop):
+            def fn(x, s):
+                return lop(x.astype(bool),
+                           s.astype(bool)).astype(jnp.float32)
+            return fn
+        register_op(f"_logical_{lname}", _mk, differentiable=False)
+        register_op(f"_logical_{lname}_scalar", _mk_scalar,
+                    differentiable=False)
+
+    # ---- MakeLoss (make_loss.cc): marks a loss head — identity forward,
+    # constant grad_scale gradient ignoring the incoming head gradient
+    # (BlockGrad, its graph-surgery sibling, is stop_gradient in
+    # ops_matrix) ----------------------------------------------------------
+    def make_loss_maker(grad_scale=1.0, valid_thresh=0.0,
+                        normalization="null"):
+        @jax.custom_vjp
+        def op(x):
+            return x
+
+        def op_fwd(x):
+            return x, x
+
+        def op_bwd(x, g):
+            scale = jnp.asarray(grad_scale, x.dtype)
+            if normalization == "batch":
+                scale = scale / x.shape[0]
+            elif normalization == "valid":
+                n_valid = jnp.maximum(
+                    jnp.sum((x > valid_thresh).astype(x.dtype)), 1.0)
+                scale = scale / n_valid
+            return (jnp.full_like(x, 1.0) * scale,)
+
+        op.defvjp(op_fwd, op_bwd)
+        return op
+    register_op("MakeLoss", make_loss_maker, aliases=("make_loss",))
+
+    # ---- _scatter_set_nd (indexing_op.cc): functional write of rhs into
+    # lhs at gather_nd-style indices — the storage op behind advanced
+    # index assignment ----------------------------------------------------
+    def scatter_set_nd_maker(shape=None):
+        def fn(lhs, rhs, indices):
+            idx = tuple(indices.astype(jnp.int32))
+            return lhs.at[idx].set(rhs)
+        return fn
+    register_op("_scatter_set_nd", scatter_set_nd_maker,
+                differentiable=False)
 
 
 _register()

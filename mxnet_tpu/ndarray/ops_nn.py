@@ -82,6 +82,9 @@ def _register():
             return y
         return fn
     register_op("Convolution", conv_maker, aliases=("convolution",))
+    # legacy 0.x surface (src/operator/convolution_v1.cc): same math, kept
+    # as a distinct op name for checkpoint/JSON compatibility
+    register_op("Convolution_v1", conv_maker)
 
     def deconv_maker(kernel=(), stride=None, dilate=None, pad=None,
                      adj=None, target_shape=None, num_filter=None,
@@ -182,6 +185,7 @@ def _register():
             raise ValueError(pool_type)
         return fn
     register_op("Pooling", pool_maker, aliases=("pooling",))
+    register_op("Pooling_v1", pool_maker)       # legacy pooling_v1.cc name
 
     # ---- activations -----------------------------------------------------
     def act_maker(act_type="relu"):
@@ -447,13 +451,34 @@ def _register():
     def bilinear_resize_maker(height=None, width=None, scale_height=None,
                               scale_width=None, mode="size",
                               align_corners=True):
+        # align_corners=True is the reference kernel's coordinate mapping
+        # (bilinear_resize.cc: src = dst*(in-1)/(out-1)); jax.image.resize
+        # only offers half-pixel centers, so that path is hand-gathered
         def fn(x):
             b, c, h, w = x.shape
             nh = height if height else int(h * scale_height)
             nw = width if width else int(w * scale_width)
-            return jax.image.resize(x, (b, c, nh, nw), method="linear")
+            if not align_corners:
+                return jax.image.resize(x, (b, c, nh, nw), method="linear")
+            ys = (jnp.linspace(0.0, h - 1.0, nh) if nh > 1
+                  else jnp.zeros((1,)))
+            xs = (jnp.linspace(0.0, w - 1.0, nw) if nw > 1
+                  else jnp.zeros((1,)))
+            y0 = jnp.floor(ys).astype(jnp.int32)
+            x0 = jnp.floor(xs).astype(jnp.int32)
+            y1 = jnp.minimum(y0 + 1, h - 1)
+            x1 = jnp.minimum(x0 + 1, w - 1)
+            wy = (ys - y0).astype(x.dtype)[None, None, :, None]
+            wx = (xs - x0).astype(x.dtype)[None, None, None, :]
+            rows0, rows1 = jnp.take(x, y0, axis=2), jnp.take(x, y1, axis=2)
+            r0 = jnp.take(rows0, x0, axis=3) * (1 - wx) \
+                + jnp.take(rows0, x1, axis=3) * wx
+            r1 = jnp.take(rows1, x0, axis=3) * (1 - wx) \
+                + jnp.take(rows1, x1, axis=3) * wx
+            return r0 * (1 - wy) + r1 * wy
         return fn
-    register_op("BilinearResize2D", bilinear_resize_maker)
+    register_op("BilinearResize2D", bilinear_resize_maker,
+                aliases=("_contrib_BilinearResize2D",))
 
     # ---- RNN (fused multi-layer LSTM/GRU/tanh/relu over lax.scan) -------
     # Reference: src/operator/rnn.cc (cuDNN-fused); the TPU-native form is a

@@ -120,9 +120,15 @@ def register_op(name: str, maker: Optional[Callable] = None, *,
         op = Operator(name, mk, aliases=aliases, differentiable=differentiable,
                       use_jit=use_jit, doc=doc or (mk.__doc__ or ""), ref=ref,
                       vjp_maker=vjp_maker)
-        _registry[name] = op
-        for a in aliases:
-            _registry[a] = op
+        for n in (name,) + tuple(aliases):
+            # silent shadowing caused a real regression (round-4 review):
+            # a later registration replaced an op under the same name with
+            # different semantics.  Double registration is always a bug.
+            if n in _registry:
+                raise MXNetError(
+                    f"operator name {n!r} is already registered "
+                    f"(by {_registry[n].name!r})")
+            _registry[n] = op
         return mk
     if maker is not None:
         do(maker)

@@ -3,12 +3,33 @@ python/mxnet/runtime.py, SURVEY.md §2.1).
 
 ``feature_list()`` / ``Features`` report what this build can do, resolved
 lazily from the live JAX install instead of compile-time flags.
+
+Large-tensor support: the reference gates int64 tensor sizes behind the
+MXNET_ENABLE_LARGE_TENSOR *compile* flag (reported as INT64_TENSOR_SIZE in
+runtime.Features); here it is a *runtime* switch — JAX truncates int64 to
+int32 unless ``jax_enable_x64`` is on, so ``enable_large_tensor()`` flips
+that config and the feature report follows the live value.
 """
 from __future__ import annotations
 
 from typing import Dict, List
 
-__all__ = ["Features", "feature_list", "is_enabled"]
+__all__ = ["Features", "feature_list", "is_enabled",
+           "enable_large_tensor", "large_tensor_enabled"]
+
+
+def enable_large_tensor(enabled: bool = True) -> None:
+    """Enable true int64 tensors/indices (reference: the
+    MXNET_ENABLE_LARGE_TENSOR build, tests/nightly/test_large_array.py).
+    Affects computations traced after the call; existing compiled graphs
+    keep their dtypes."""
+    import jax
+    jax.config.update("jax_enable_x64", bool(enabled))
+
+
+def large_tensor_enabled() -> bool:
+    import jax
+    return bool(jax.config.jax_enable_x64)
 
 
 class Feature:
@@ -69,8 +90,11 @@ class Features(dict):
     """Mapping name -> Feature (reference: mx.runtime.Features)."""
 
     def __init__(self):
-        # feature set is fixed per process — detect once (lru_cache)
+        # feature set is fixed per process — detect once (lru_cache);
+        # INT64_TENSOR_SIZE alone is live (a runtime switch here)
         super().__init__({k: Feature(k, v) for k, v in _detect_cached()})
+        self["INT64_TENSOR_SIZE"] = Feature("INT64_TENSOR_SIZE",
+                                            large_tensor_enabled())
 
     def is_enabled(self, name: str) -> bool:
         f = self.get(name)

@@ -29,7 +29,11 @@ from .ndarray import NDArray, array as nd_array
 
 __all__ = ["CSRNDArray", "RowSparseNDArray", "BaseSparseNDArray",
            "csr_matrix", "row_sparse_array", "zeros", "dot", "retain",
-           "cast_storage", "add", "elemwise_add"]
+           "cast_storage", "add", "elemwise_add", "elemwise_sub",
+           "elemwise_mul", "elemwise_div", "subtract", "multiply", "divide",
+           "minimum", "maximum", "sqrt", "square", "abs", "sign", "relu",
+           "sin", "tanh", "ceil", "floor", "trunc", "rint", "expm1",
+           "log1p", "negative"]
 
 
 class BaseSparseNDArray:
@@ -77,6 +81,43 @@ class BaseSparseNDArray:
     def __repr__(self):
         return (f"<{type(self).__name__} {self._shape} "
                 f"{self._dtype.name} @{self._ctx}>")
+
+
+    # arithmetic routes through the storage-aware elemwise family below
+    # (reference: the stype-dispatched FComputeEx kernels of
+    # elemwise_binary_op_basic.cc; scalars that break zero-preservation
+    # densify explicitly, never silently)
+    def __add__(self, other):
+        return elemwise_add(self, other)
+
+    def __radd__(self, other):
+        return elemwise_add(self, other)
+
+    def __sub__(self, other):
+        return elemwise_sub(self, other)
+
+    def __rsub__(self, other):
+        return negative(elemwise_sub(self, other))
+
+    def __mul__(self, other):
+        return elemwise_mul(self, other)
+
+    def __rmul__(self, other):
+        return elemwise_mul(self, other)
+
+    def __truediv__(self, other):
+        return elemwise_div(self, other)
+
+    def __rtruediv__(self, other):
+        # scalar / sparse breaks zero-preservation (s/0 = inf) — densify
+        # explicitly like the reference's _rdiv_scalar storage fallback
+        return _dense_fallback("broadcast_div",
+                               nd_array(_np.asarray(other,
+                                                    dtype=self.dtype)),
+                               self)
+
+    def __neg__(self):
+        return negative(self)
 
 
 class CSRNDArray(BaseSparseNDArray):
@@ -285,23 +326,241 @@ def retain(arr: RowSparseNDArray, indices) -> RowSparseNDArray:
     return arr.retain(indices)
 
 
+def _from_scipy(sp, shape, ctx) -> CSRNDArray:
+    sp = sp.tocsr()
+    sp.sort_indices()
+    return CSRNDArray(sp.data, sp.indices, sp.indptr, shape, ctx=ctx)
+
+
+def _csr_csr(lhs: CSRNDArray, rhs: CSRNDArray, op: str) -> CSRNDArray:
+    """csr ⊕ csr with a sparse result — structure algebra delegated to
+    scipy on the host (the reference's cpu FComputeEx kernels are the same
+    role: sparse structure work stays on the host/CPU side; only dense
+    compute belongs on the TPU)."""
+    a, b = lhs.asscipy(), rhs.asscipy()
+    if op == "add":
+        out = a + b
+    elif op == "sub":
+        out = a - b
+    elif op == "mul":
+        out = a.multiply(b)
+    elif op == "maximum":
+        out = a.maximum(b)
+    elif op == "minimum":
+        out = a.minimum(b)
+    else:
+        raise MXNetError(f"unsupported csr op {op!r}")
+    return _from_scipy(out, lhs.shape, lhs.context)
+
+
+def _rsp_union(lhs: RowSparseNDArray, rhs: RowSparseNDArray, rhs_sign=1.0):
+    """row_sparse ⊕ row_sparse over the union of row sets (add/sub)."""
+    idx = _np.union1d(lhs.indices, rhs.indices)
+    data = _np.zeros((len(idx),) + lhs.data.shape[1:],
+                     _np.result_type(lhs.data, rhs.data))
+    _np.add.at(data, _np.searchsorted(idx, lhs.indices), lhs.data)
+    _np.add.at(data, _np.searchsorted(idx, rhs.indices),
+               rhs_sign * rhs.data)
+    return RowSparseNDArray(data, idx, lhs.shape, ctx=lhs.context)
+
+
+def _rsp_pointwise(lhs: RowSparseNDArray, rhs: RowSparseNDArray, np_op,
+                   intersect: bool):
+    """mul/min/max on row_sparse pairs.  mul keeps only the row
+    intersection (0·x = 0); min/max need the union with zero rows."""
+    if intersect:
+        common, li, ri = _np.intersect1d(lhs.indices, rhs.indices,
+                                         return_indices=True)
+        return RowSparseNDArray(np_op(lhs.data[li], rhs.data[ri]), common,
+                                lhs.shape, ctx=lhs.context)
+    idx = _np.union1d(lhs.indices, rhs.indices)
+    shape_tail = lhs.data.shape[1:]
+    dt = _np.result_type(lhs.data, rhs.data)
+    a = _np.zeros((len(idx),) + shape_tail, dt)
+    b = _np.zeros((len(idx),) + shape_tail, dt)
+    a[_np.searchsorted(idx, lhs.indices)] = lhs.data
+    b[_np.searchsorted(idx, rhs.indices)] = rhs.data
+    return RowSparseNDArray(np_op(a, b), idx, lhs.shape, ctx=lhs.context)
+
+
+def _dense_fallback(name, lhs, rhs):
+    """Explicit densification — mirrors the reference's storage-fallback
+    log so silent dense blowups cannot hide (SURVEY.md §2.2 sparse note)."""
+    import warnings
+    warnings.warn(f"sparse {name}: falling back to dense storage",
+                  stacklevel=3)
+    dl = lhs.todense() if isinstance(lhs, BaseSparseNDArray) else lhs
+    dr = rhs.todense() if isinstance(rhs, BaseSparseNDArray) else rhs
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name(name, [dl, dr], {})
+
+
+def _scalar_apply(arr, np_fn):
+    if isinstance(arr, CSRNDArray):
+        return CSRNDArray(np_fn(arr.data), arr.indices, arr.indptr,
+                          arr.shape, ctx=arr.context)
+    return RowSparseNDArray(np_fn(arr.data), arr.indices, arr.shape,
+                            ctx=arr.context)
+
+
+def _scalar_scale(arr, s):
+    s = float(s)
+    return _scalar_apply(arr, lambda d: d * s)
+
+
 def elemwise_add(lhs, rhs):
+    if isinstance(lhs, NDArray) and isinstance(rhs, BaseSparseNDArray):
+        return elemwise_add(rhs, lhs)
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
         out = rhs.asnumpy().copy()
         _np.add.at(out, lhs.indices, lhs.data)
         return nd_array(out, ctx=rhs.context)
-    if isinstance(rhs, RowSparseNDArray) and isinstance(lhs, NDArray):
-        return elemwise_add(rhs, lhs)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        out = rhs.asnumpy().copy()
+        row_ids = _np.repeat(_np.arange(lhs.shape[0]),
+                             _np.diff(lhs.indptr))
+        _np.add.at(out, (row_ids, lhs.indices), lhs.data)
+        return nd_array(out, ctx=rhs.context)
     if isinstance(lhs, RowSparseNDArray) and \
             isinstance(rhs, RowSparseNDArray):
-        # vectorized: union1d is sorted, so positions come from searchsorted
-        idx = _np.union1d(lhs.indices, rhs.indices)
-        data = _np.zeros((len(idx),) + lhs.data.shape[1:], lhs.data.dtype)
-        for src in (lhs, rhs):
-            _np.add.at(data, _np.searchsorted(idx, src.indices), src.data)
-        return RowSparseNDArray(data, idx, lhs.shape, ctx=lhs.context)
+        return _rsp_union(lhs, rhs)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return _csr_csr(lhs, rhs, "add")
+    if isinstance(lhs, BaseSparseNDArray) and _np.isscalar(rhs):
+        return _dense_fallback("_plus_scalar",
+                               lhs, nd_array(_np.asarray(rhs)))
     from .ndarray.register import invoke_by_name
     return invoke_by_name("broadcast_add", [lhs, rhs], {})
 
 
+def elemwise_sub(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        return _rsp_union(lhs, rhs, rhs_sign=-1.0)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return _csr_csr(lhs, rhs, "sub")
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        return _dense_fallback("broadcast_sub", lhs, rhs)
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name("broadcast_sub", [lhs, rhs], {})
+
+
+def elemwise_mul(lhs, rhs):
+    if isinstance(lhs, NDArray) and isinstance(rhs, BaseSparseNDArray):
+        return elemwise_mul(rhs, lhs)
+    if isinstance(lhs, BaseSparseNDArray) and _np.isscalar(rhs):
+        return _scalar_scale(lhs, rhs)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        d = rhs.asnumpy()
+        return RowSparseNDArray(lhs.data * d[lhs.indices], lhs.indices,
+                                lhs.shape, ctx=lhs.context)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        d = rhs.asnumpy()
+        row_ids = _np.repeat(_np.arange(lhs.shape[0]),
+                             _np.diff(lhs.indptr))
+        return CSRNDArray(lhs.data * d[row_ids, lhs.indices], lhs.indices,
+                          lhs.indptr, lhs.shape, ctx=lhs.context)
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        return _rsp_pointwise(lhs, rhs, _np.multiply, intersect=True)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return _csr_csr(lhs, rhs, "mul")
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name("broadcast_mul", [lhs, rhs], {})
+
+
+def elemwise_div(lhs, rhs):
+    if isinstance(lhs, BaseSparseNDArray) and _np.isscalar(rhs):
+        # true division, not reciprocal-multiply: /0 must yield inf (the
+        # reference _div_scalar contract) and rounding must match numpy
+        s = float(rhs)
+
+        def _div(d):
+            with _np.errstate(divide="ignore", invalid="ignore"):
+                return d / s
+        return _scalar_apply(lhs, _div)
+    if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
+        d = rhs.asnumpy()
+        return RowSparseNDArray(lhs.data / d[lhs.indices], lhs.indices,
+                                lhs.shape, ctx=lhs.context)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
+        d = rhs.asnumpy()
+        row_ids = _np.repeat(_np.arange(lhs.shape[0]),
+                             _np.diff(lhs.indptr))
+        return CSRNDArray(lhs.data / d[row_ids, lhs.indices], lhs.indices,
+                          lhs.indptr, lhs.shape, ctx=lhs.context)
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        # 0/0 territory — the reference densifies here too
+        return _dense_fallback("broadcast_div", lhs, rhs)
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name("broadcast_div", [lhs, rhs], {})
+
+
+def minimum(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        return _rsp_pointwise(lhs, rhs, _np.minimum, intersect=False)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return _csr_csr(lhs, rhs, "minimum")
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        return _dense_fallback("broadcast_minimum", lhs, rhs)
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name("broadcast_minimum", [lhs, rhs], {})
+
+
+def maximum(lhs, rhs):
+    if isinstance(lhs, RowSparseNDArray) and \
+            isinstance(rhs, RowSparseNDArray):
+        return _rsp_pointwise(lhs, rhs, _np.maximum, intersect=False)
+    if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
+        return _csr_csr(lhs, rhs, "maximum")
+    if isinstance(lhs, BaseSparseNDArray) or \
+            isinstance(rhs, BaseSparseNDArray):
+        return _dense_fallback("broadcast_maximum", lhs, rhs)
+    from .ndarray.register import invoke_by_name
+    return invoke_by_name("broadcast_maximum", [lhs, rhs], {})
+
+
 add = elemwise_add
+subtract = elemwise_sub
+multiply = elemwise_mul
+divide = elemwise_div
+
+
+# ---------------------------------------------------------------------------
+# zero-preserving unary family (reference: the FComputeEx registrations of
+# elemwise_unary_op_basic.cc — f(0)=0 ops keep the sparse structure and
+# apply to stored values only)
+# ---------------------------------------------------------------------------
+
+def _unary_sparse(op_name: str, np_fn):
+    def fn(arr):
+        if isinstance(arr, CSRNDArray):
+            return CSRNDArray(np_fn(arr.data), arr.indices, arr.indptr,
+                              arr.shape, ctx=arr.context)
+        if isinstance(arr, RowSparseNDArray):
+            return RowSparseNDArray(np_fn(arr.data), arr.indices,
+                                    arr.shape, ctx=arr.context)
+        from .ndarray.register import invoke_by_name
+        return invoke_by_name(op_name, [arr], {})
+    fn.__name__ = op_name
+    return fn
+
+
+sqrt = _unary_sparse("sqrt", _np.sqrt)
+square = _unary_sparse("square", _np.square)
+abs = _unary_sparse("abs", _np.abs)            # noqa: A001 — reference name
+sign = _unary_sparse("sign", _np.sign)
+relu = _unary_sparse("relu", lambda d: _np.maximum(d, 0))
+sin = _unary_sparse("sin", _np.sin)
+tanh = _unary_sparse("tanh", _np.tanh)
+ceil = _unary_sparse("ceil", _np.ceil)
+floor = _unary_sparse("floor", _np.floor)
+trunc = _unary_sparse("trunc", _np.trunc)
+rint = _unary_sparse("rint", _np.rint)
+expm1 = _unary_sparse("expm1", _np.expm1)
+log1p = _unary_sparse("log1p", _np.log1p)
+negative = _unary_sparse("negative", _np.negative)

@@ -1,0 +1,86 @@
+"""Large-tensor / int64 index support (reference:
+tests/nightly/test_large_array.py + the MXNET_ENABLE_LARGE_TENSOR build).
+
+The TPU-native twist: int64 is a *runtime* switch
+(``mx.runtime.enable_large_tensor()`` flips ``jax_enable_x64``), so this
+suite checks three contracts:
+  1. default mode truncates int64 to int32 — documented, not silent
+     corruption of indices;
+  2. enabled mode carries real int64 dtypes through creation, arithmetic,
+     reductions, indexing, and randint ranges beyond 2**31;
+  3. the genuinely-huge (>2**31 element) paths are env-gated like the
+     reference's nightly (MXNET_TEST_LARGE=1) so CI stays small.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu import runtime
+
+
+@pytest.fixture()
+def int64_mode():
+    runtime.enable_large_tensor(True)
+    try:
+        yield
+    finally:
+        runtime.enable_large_tensor(False)
+
+
+def test_default_mode_truncates_to_int32():
+    assert not runtime.large_tensor_enabled()
+    x = nd.array(np.array([1, 2, 3], dtype=np.int64))
+    # documented truncation (jax default): int64 request lands as int32
+    assert x.dtype == np.int32
+    feats = runtime.Features()
+    assert not feats.is_enabled("INT64_TENSOR_SIZE")
+
+
+def test_int64_dtypes_survive_ops(int64_mode):
+    assert runtime.large_tensor_enabled()
+    assert runtime.Features().is_enabled("INT64_TENSOR_SIZE")
+    big = 3_000_000_000                      # > 2**31
+    x = nd.array(np.array([big, big + 1, big + 2], dtype=np.int64))
+    assert x.dtype == np.int64
+    assert x.asnumpy().tolist() == [big, big + 1, big + 2]
+    # arithmetic keeps int64 and exceeds the int32 range
+    y = (x * 2).asnumpy()
+    assert y.dtype == np.int64
+    assert y[0] == 2 * big
+    # reductions
+    s = nd.sum(x).asnumpy()
+    assert int(s) == 3 * big + 3
+
+
+def test_int64_indexing_paths(int64_mode):
+    data = nd.array(np.arange(100, dtype=np.float32).reshape(10, 10))
+    idx = nd.array(np.array([9, 0, 5], dtype=np.int64))
+    assert idx.dtype == np.int64
+    out = nd.take(data, idx).asnumpy()
+    np.testing.assert_allclose(out[0], np.arange(90, 100))
+    picked = nd.pick(data, nd.array(np.array([3] * 10, dtype=np.int64)),
+                     axis=1).asnumpy()
+    np.testing.assert_allclose(picked, np.arange(100).reshape(10, 10)[:, 3])
+
+
+def test_randint_beyond_int32(int64_mode):
+    lo = 2 ** 31
+    hi = 2 ** 33
+    draws = nd.random.randint(lo, hi, shape=(64,), dtype="int64").asnumpy()
+    assert draws.dtype == np.int64
+    assert draws.min() >= lo and draws.max() < hi
+
+
+@pytest.mark.skipif(os.environ.get("MXNET_TEST_LARGE", "") != "1",
+                    reason="huge-alloc nightly path (MXNET_TEST_LARGE=1)")
+def test_over_2g_element_vector(int64_mode):
+    """The reference nightly's core claim: arrays with >2**31 elements are
+    addressable.  ~2.2G int8 elements ≈ 2.2 GB."""
+    n = (2 ** 31) + 8
+    x = nd.zeros((n,), dtype="int8")
+    x[-1] = 7
+    assert int(x[-1].asnumpy()) == 7
+    assert x.shape == (n,)

@@ -751,3 +751,97 @@ def test_random_like_out_and_dtype():
     assert h.dtype == np.float16
     ri = nd.randint_like(z, 0, 9, dtype="int64")
     assert str(ri.dtype).startswith("int")
+
+
+def test_ravel_unravel_roundtrip():
+    """reference: src/operator/tensor/ravel.cc"""
+    shape = (3, 4, 5)
+    multi = np.array([[2, 0, 1], [3, 1, 0], [4, 2, 3]], np.int32)  # (ndim,N)
+    flat = nd.ravel_multi_index(nd.array(multi, dtype="int32"),
+                                shape=shape).asnumpy()
+    want = np.ravel_multi_index(tuple(multi), shape)
+    np.testing.assert_array_equal(flat, want)
+    back = nd.unravel_index(nd.array(flat.astype(np.int32), dtype="int32"),
+                            shape=shape).asnumpy()
+    np.testing.assert_array_equal(back, multi)
+
+
+def test_hypot_and_logical_family():
+    a = nd.array(np.array([3.0, 0.0, -5.0], np.float32))
+    b = nd.array(np.array([4.0, 0.0, 12.0], np.float32))
+    np.testing.assert_allclose(nd._hypot(a, b).asnumpy(), [5, 0, 13],
+                               rtol=1e-6)
+    x = nd.array(np.array([1.0, 0.0, 2.0], np.float32))
+    y = nd.array(np.array([1.0, 1.0, 0.0], np.float32))
+    np.testing.assert_array_equal(nd._logical_and(x, y).asnumpy(), [1, 0, 0])
+    np.testing.assert_array_equal(nd._logical_or(x, y).asnumpy(), [1, 1, 1])
+    np.testing.assert_array_equal(nd._logical_xor(x, y).asnumpy(), [0, 1, 1])
+
+
+def test_scatter_set_nd_and_index_copy():
+    base = nd.zeros((3, 4))
+    vals = nd.array(np.array([7.0, 9.0], np.float32))
+    idx = nd.array(np.array([[0, 2], [1, 3]], np.int32))  # (ndim, N)
+    out = nd._scatter_set_nd(base, vals, idx).asnumpy()
+    assert out[0, 1] == 7.0 and out[2, 3] == 9.0
+    assert out.sum() == 16.0
+
+    old = nd.zeros((4, 2))
+    new = nd.array(np.array([[1.0, 2.0], [3.0, 4.0]], np.float32))
+    out = nd.contrib.index_copy(old, nd.array(np.array([3, 0], np.int32),
+                                              dtype="int32"), new).asnumpy()
+    np.testing.assert_allclose(out[3], [1, 2])
+    np.testing.assert_allclose(out[0], [3, 4])
+
+
+def test_index_array_and_getnnz():
+    d = nd.zeros((2, 3))
+    ia = nd.contrib.index_array(d).asnumpy()
+    assert ia.shape == (2, 3, 2)
+    np.testing.assert_array_equal(ia[1, 2], [1, 2])
+    ia_ax = nd.contrib.index_array(d, axes=(1,)).asnumpy()
+    assert ia_ax.shape == (2, 3, 1)
+    x = nd.array(np.array([[1.0, 0.0], [2.0, 3.0]], np.float32))
+    assert int(nd.contrib.getnnz(x).asnumpy()) == 3
+    np.testing.assert_array_equal(
+        nd.contrib.getnnz(x, axis=0).asnumpy(), [2, 1])
+
+
+def test_blockgrad_and_makeloss():
+    """reference: elemwise_unary_op_basic.cc BlockGrad, make_loss.cc."""
+    x = nd.array(np.array([1.0, 2.0], np.float32))
+    x.attach_grad()
+    with autograd.record():
+        y = nd.sum(nd.BlockGrad(x) * 3.0 + x * 2.0)
+    y.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), [2.0, 2.0])
+
+    z = nd.array(np.array([[0.5, 1.5]], np.float32))
+    z.attach_grad()
+    with autograd.record():
+        L = nd.MakeLoss(z, grad_scale=4.0)
+    L.backward()
+    np.testing.assert_allclose(L.asnumpy(), z.asnumpy())
+    np.testing.assert_allclose(z.grad.asnumpy(), [[4.0, 4.0]])
+    # batch normalization divides by N
+    z.grad[:] = 0
+    with autograd.record():
+        L = nd.MakeLoss(z, normalization="batch")
+    L.backward()
+    np.testing.assert_allclose(z.grad.asnumpy(), [[1.0, 1.0]])
+
+
+def test_bilinear_resize_and_count_sketch():
+    x = nd.array(np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4))
+    out = nd.contrib.BilinearResize2D(x, height=8, width=8)
+    assert out.shape == (1, 1, 8, 8)
+    # corners preserved by linear resize
+    o = out.asnumpy()[0, 0]
+    assert abs(o[0, 0] - 0.0) < 0.5 and abs(o[-1, -1] - 15.0) < 0.5
+
+    d = np.array([[1.0, 2.0, 3.0, 4.0]], np.float32)
+    h = np.array([0, 1, 0, 1], np.float32)
+    s = np.array([1, -1, 1, 1], np.float32)
+    out = nd.contrib.count_sketch(nd.array(d), nd.array(h), nd.array(s),
+                                  out_dim=2).asnumpy()
+    np.testing.assert_allclose(out, [[4.0, 2.0]])  # 1+3, -2+4
