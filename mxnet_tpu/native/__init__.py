@@ -8,10 +8,13 @@ Components:
   (reference: src/io/iter_image_recordio_2.cc).
 - predict_core.cc — the MXPred* C predict ABI for embedding
   (reference: src/c_api/c_predict_api.cc).
+- ndarray_core.cc — the MXNDArray*/MXImperativeInvoke imperative C ABI,
+  the slice the reference's six language bindings are built on
+  (reference: src/c_api/c_api.cc + c_api_ndarray.cc).
 
-``load_io()`` / ``load_predict()`` return the ctypes library (building it
-the first time) or raise MXNetError with the toolchain failure; callers
-degrade gracefully to the pure-Python path.
+``load_io()`` / ``load_predict()`` / ``load_ndarray()`` return the ctypes
+library (building it the first time) or raise MXNetError with the
+toolchain failure; callers degrade gracefully to the pure-Python path.
 """
 from __future__ import annotations
 
@@ -91,32 +94,43 @@ def io_available() -> bool:
         return False
 
 
+def _load_embedded(cache: dict, src_name: str, so_name: str,
+                   what: str):
+    """Shared build+load+cache protocol for the embedded-CPython ABIs
+    (predict_core / ndarray_core): one place owns the link flags and the
+    error-caching discipline.  Caller must hold _LOCK."""
+    import sysconfig
+    if cache["lib"] is not None:
+        return cache["lib"]
+    if cache["err"] is not None:
+        raise cache["err"]
+    src = os.path.join(_DIR, src_name)
+    so = os.path.join(_DIR, so_name)
+    try:
+        if _stale(src, so):
+            inc = sysconfig.get_paths()["include"]
+            libdir = sysconfig.get_config_var("LIBDIR") or "/usr/lib"
+            ver = sysconfig.get_config_var("LDVERSION") or \
+                sysconfig.get_config_var("VERSION")
+            _build(src, so, [f"-I{inc}", f"-L{libdir}",
+                             f"-lpython{ver}", "-ldl"])
+        return ctypes.CDLL(so, mode=ctypes.RTLD_GLOBAL)
+    except (MXNetError, OSError, subprocess.SubprocessError) as e:
+        cache["err"] = e if isinstance(e, MXNetError) else \
+            MXNetError(f"cannot load {what}: {e}")
+        raise cache["err"]
+
+
 _PRED = {"lib": None, "err": None}
 
 
 def load_predict():
     """Build (if needed) + load the predict C ABI; cached process-wide."""
-    import sysconfig
     with _LOCK:
         if _PRED["lib"] is not None:
             return _PRED["lib"]
-        if _PRED["err"] is not None:
-            raise _PRED["err"]
-        src = os.path.join(_DIR, "predict_core.cc")
-        so = os.path.join(_DIR, "libmxtpu_predict.so")
-        try:
-            if _stale(src, so):
-                inc = sysconfig.get_paths()["include"]
-                libdir = sysconfig.get_config_var("LIBDIR") or "/usr/lib"
-                ver = sysconfig.get_config_var("LDVERSION") or \
-                    sysconfig.get_config_var("VERSION")
-                _build(src, so, [f"-I{inc}", f"-L{libdir}",
-                                 f"-lpython{ver}", "-ldl"])
-            lib = ctypes.CDLL(so, mode=ctypes.RTLD_GLOBAL)
-        except (MXNetError, OSError, subprocess.SubprocessError) as e:
-            _PRED["err"] = e if isinstance(e, MXNetError) else \
-                MXNetError(f"cannot load predict core: {e}")
-            raise _PRED["err"]
+        lib = _load_embedded(_PRED, "predict_core.cc",
+                             "libmxtpu_predict.so", "predict core")
         u32 = ctypes.c_uint32
         lib.MXPredCreate.restype = ctypes.c_int
         lib.MXPredCreate.argtypes = [
@@ -141,4 +155,52 @@ def load_predict():
         lib.MXPredFree.argtypes = [ctypes.c_void_p]
         lib.MXGetLastError.restype = ctypes.c_char_p
         _PRED["lib"] = lib
+        return lib
+
+
+_NDC = {"lib": None, "err": None}
+
+
+def load_ndarray():
+    """Build (if needed) + load the imperative C ABI; cached process-wide."""
+    with _LOCK:
+        if _NDC["lib"] is not None:
+            return _NDC["lib"]
+        lib = _load_embedded(_NDC, "ndarray_core.cc",
+                             "libmxtpu_ndarray.so", "ndarray core")
+        u32 = ctypes.c_uint32
+        vp = ctypes.c_void_p
+        lib.MXNDArrayCreate.restype = ctypes.c_int
+        lib.MXNDArrayCreate.argtypes = [
+            ctypes.POINTER(u32), u32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.POINTER(vp)]
+        lib.MXNDArrayCreateEx.restype = ctypes.c_int
+        lib.MXNDArrayCreateEx.argtypes = [
+            ctypes.POINTER(u32), u32, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(vp)]
+        lib.MXNDArrayFree.restype = ctypes.c_int
+        lib.MXNDArrayFree.argtypes = [vp]
+        lib.MXNDArrayGetShape.restype = ctypes.c_int
+        lib.MXNDArrayGetShape.argtypes = [
+            vp, ctypes.POINTER(u32), ctypes.POINTER(ctypes.POINTER(u32))]
+        lib.MXNDArrayGetDType.restype = ctypes.c_int
+        lib.MXNDArrayGetDType.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+        lib.MXNDArraySyncCopyFromCPU.restype = ctypes.c_int
+        lib.MXNDArraySyncCopyFromCPU.argtypes = [vp, vp, ctypes.c_size_t]
+        lib.MXNDArraySyncCopyToCPU.restype = ctypes.c_int
+        lib.MXNDArraySyncCopyToCPU.argtypes = [vp, vp, ctypes.c_size_t]
+        lib.MXNDArrayWaitAll.restype = ctypes.c_int
+        lib.MXListAllOpNames.restype = ctypes.c_int
+        lib.MXListAllOpNames.argtypes = [
+            ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
+        lib.NNGetOpHandle.restype = ctypes.c_int
+        lib.NNGetOpHandle.argtypes = [ctypes.c_char_p, ctypes.POINTER(vp)]
+        lib.MXImperativeInvoke.restype = ctypes.c_int
+        lib.MXImperativeInvoke.argtypes = [
+            vp, ctypes.c_int, ctypes.POINTER(vp), ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.POINTER(vp)), ctypes.c_int,
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p)]
+        lib.MXNDGetLastError.restype = ctypes.c_char_p
+        _NDC["lib"] = lib
         return lib

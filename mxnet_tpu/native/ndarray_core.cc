@@ -1,0 +1,474 @@
+// C NDArray + imperative-invoke ABI: the universal embedding seam.
+//
+// Reference parity: src/c_api/c_api.cc + c_api_ndarray.cc (SURVEY.md §2.1
+// L9) — the slice every reference language binding is built from:
+//   MXNDArrayCreate(Ex) / MXNDArrayFree / MXNDArrayGetShape /
+//   MXNDArrayGetDType / MXNDArraySyncCopyFromCPU / MXNDArraySyncCopyToCPU /
+//   MXNDArrayWaitAll / MXListAllOpNames / NNGetOpHandle /
+//   MXImperativeInvoke, errors via MXNDGetLastError.
+// Same contracts as the reference: opaque handles, CSR-free POD arguments,
+// op parameters passed as STRINGS (the reference's attr parser does the
+// string->typed conversion; here ast.literal_eval does), the output-handle
+// array owned by a thread-local scratch valid until the next invoke on the
+// thread (the reference's MXAPIThreadLocalEntry ret_handles discipline).
+//
+// TPU-native design: the reference backs these with its C++ NDArray/engine;
+// here a handle IS a Python mxnet_tpu NDArray reached through embedded
+// CPython, and the "engine push" is the registry's cached-jit dispatch —
+// the C surface proves the seam without duplicating the runtime.
+
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_nd_last_error;
+
+void nd_set_err(const std::string& m) { g_nd_last_error = m; }
+
+void nd_set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);
+      if (u) msg = u;
+      Py_DECREF(s);
+    }
+  }
+  PyErr_Clear();  // a failed str()/utf8 conversion must not leak an
+                  // exception into the caller's next CPython call
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  nd_set_err(msg);
+}
+
+struct NDHandle {
+  PyObject* obj = nullptr;                 // mxnet_tpu NDArray
+  std::vector<uint32_t> shape_cache;
+};
+
+const char kNDBootstrap[] = R"PY(
+import ast as _ast
+import sys as _sys
+if _MXTPU_ROOT not in _sys.path:
+    _sys.path.insert(0, _MXTPU_ROOT)
+import numpy as _np
+import mxnet_tpu as _mx
+from mxnet_tpu.ndarray.register import invoke_by_name as _invoke
+
+# mshadow dtype codes (reference: include/mxnet/base.h TypeFlag)
+_DT = {0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
+       5: "int8", 6: "int64"}
+_DT_REV = {v: k for k, v in _DT.items()}
+
+
+class _NDCore:
+    @staticmethod
+    def create(shape, dev_type, dev_id, dtype):
+        ctx = _mx.cpu(dev_id) if dev_type == 1 else _mx.tpu(dev_id)
+        return _mx.nd.zeros(tuple(shape), dtype=_DT[dtype], ctx=ctx)
+
+    @staticmethod
+    def shape(arr):
+        return tuple(arr.shape)
+
+    @staticmethod
+    def dtype_code(arr):
+        return _DT_REV[_np.dtype(arr.dtype).name]
+
+    @staticmethod
+    def copy_from(arr, raw):
+        a = _np.frombuffer(raw, _np.dtype(arr.dtype)).reshape(arr.shape)
+        arr[:] = _mx.nd.array(a, ctx=arr.context, dtype=arr.dtype)
+
+    @staticmethod
+    def copy_to(arr):
+        return arr.asnumpy().tobytes()
+
+    @staticmethod
+    def invoke(op_name, inputs, keys, vals, out=None):
+        kwargs = {}
+        for k, v in zip(keys, vals):
+            try:
+                kwargs[k] = _ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                kwargs[k] = v            # plain string attr
+        res = _invoke(op_name, list(inputs), kwargs, out=out)
+        return list(res) if isinstance(res, (list, tuple)) else [res]
+
+    @staticmethod
+    def list_ops():
+        return _mx.nd.list_ops()
+
+    @staticmethod
+    def wait_all():
+        _mx.nd.waitall()
+)PY";
+
+PyObject* g_ndcore_cls = nullptr;
+
+std::once_flag g_py_init_once;
+
+bool nd_ensure_python() {
+  // PyGILState_Ensure cannot guard this (it needs a live interpreter), so
+  // a once_flag serializes first-touch from concurrent C host threads
+  std::call_once(g_py_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+  return true;
+}
+
+bool nd_ensure_bootstrap() {
+  if (g_ndcore_cls) return true;
+  Dl_info info;
+  std::string root = ".";
+  if (dladdr(reinterpret_cast<void*>(&nd_ensure_bootstrap), &info) &&
+      info.dli_fname) {
+    std::string p = info.dli_fname;
+    for (int up = 0; up < 3; ++up) {
+      auto pos = p.find_last_of('/');
+      if (pos == std::string::npos) break;
+      p = p.substr(0, pos);
+    }
+    if (!p.empty()) root = p;
+  }
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* rootstr = PyUnicode_FromString(root.c_str());
+  PyDict_SetItemString(globals, "_MXTPU_ROOT", rootstr);
+  Py_DECREF(rootstr);
+  PyObject* res = PyRun_String(kNDBootstrap, Py_file_input, globals, globals);
+  if (!res) {
+    nd_set_err_from_python();
+    Py_DECREF(globals);
+    return false;
+  }
+  Py_DECREF(res);
+  g_ndcore_cls = PyDict_GetItemString(globals, "_NDCore");
+  Py_XINCREF(g_ndcore_cls);
+  Py_DECREF(globals);
+  if (!g_ndcore_cls) {
+    nd_set_err("bootstrap did not define _NDCore");
+    return false;
+  }
+  return true;
+}
+
+// thread-local output scratch (reference: MXAPIThreadLocalEntry) — the
+// handle-pointer array returned by MXImperativeInvoke lives here until the
+// thread's next invoke
+thread_local std::vector<void*> g_ret_handles;
+// op-name table for MXListAllOpNames: interned once, immortal
+std::vector<std::string>* g_op_names = nullptr;
+std::vector<const char*>* g_op_name_ptrs = nullptr;
+
+}  // namespace
+
+extern "C" {
+
+const char* MXNDGetLastError() { return g_nd_last_error.c_str(); }
+
+int MXNDArrayCreateEx(const uint32_t* shape, uint32_t ndim, int dev_type,
+                      int dev_id, int delay_alloc, int dtype, void** out) {
+  (void)delay_alloc;  // XLA owns allocation; the flag is accepted for parity
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* tup = PyTuple_New(ndim);
+    for (uint32_t i = 0; i < ndim; ++i)
+      PyTuple_SET_ITEM(tup, i, PyLong_FromUnsignedLong(shape[i]));
+    PyObject* obj = PyObject_CallMethod(g_ndcore_cls, "create", "Oiii",
+                                        tup, dev_type, dev_id, dtype);
+    Py_DECREF(tup);
+    if (!obj) {
+      nd_set_err_from_python();
+      break;
+    }
+    auto* h = new NDHandle();
+    h->obj = obj;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayCreate(const uint32_t* shape, uint32_t ndim, int dev_type,
+                    int dev_id, int delay_alloc, void** out) {
+  return MXNDArrayCreateEx(shape, ndim, dev_type, dev_id, delay_alloc,
+                           /*dtype=float32*/ 0, out);
+}
+
+int MXNDArrayFree(void* handle) {
+  auto* h = static_cast<NDHandle*>(handle);
+  if (!h) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
+}
+
+int MXNDArrayGetShape(void* handle, uint32_t* out_dim,
+                      const uint32_t** out_pdata) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "shape", "O", h->obj);
+  if (r) {
+    Py_ssize_t n = PyTuple_Size(r);
+    h->shape_cache.resize(n);
+    for (Py_ssize_t i = 0; i < n; ++i)
+      h->shape_cache[i] = static_cast<uint32_t>(
+          PyLong_AsUnsignedLong(PyTuple_GET_ITEM(r, i)));
+    *out_dim = static_cast<uint32_t>(n);
+    *out_pdata = h->shape_cache.data();
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayGetDType(void* handle, int* out_dtype) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "dtype_code", "O",
+                                    h->obj);
+  if (r) {
+    *out_dtype = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySyncCopyFromCPU(void* handle, const void* data, size_t size) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  // size is an ELEMENT count (reference contract); bytes follow dtype
+  int dtype_code = 0;
+  PyObject* dt = PyObject_CallMethod(g_ndcore_cls, "dtype_code", "O",
+                                     h->obj);
+  if (dt) {
+    dtype_code = static_cast<int>(PyLong_AsLong(dt));
+    Py_DECREF(dt);
+    static const size_t kBytes[] = {4, 8, 2, 1, 4, 1, 8};
+    size_t nbytes = size * kBytes[dtype_code];
+    PyObject* raw = PyBytes_FromStringAndSize(
+        static_cast<const char*>(data), nbytes);
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "copy_from", "OO",
+                                      h->obj, raw);
+    Py_DECREF(raw);
+    if (r) {
+      Py_DECREF(r);
+      rc = 0;
+    } else {
+      nd_set_err_from_python();
+    }
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySyncCopyToCPU(void* handle, void* data, size_t size) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "copy_to", "O", h->obj);
+  if (r) {
+    char* buf = nullptr;
+    Py_ssize_t n = 0;
+    if (PyBytes_AsStringAndSize(r, &buf, &n) == 0) {
+      // size is the caller's buffer ELEMENT count (reference contract):
+      // never write more than the caller allocated
+      int dtype_code = 0;
+      PyObject* dt = PyObject_CallMethod(g_ndcore_cls, "dtype_code", "O",
+                                         h->obj);
+      if (dt) {
+        dtype_code = static_cast<int>(PyLong_AsLong(dt));
+        Py_DECREF(dt);
+        static const size_t kBytes[] = {4, 8, 2, 1, 4, 1, 8};
+        size_t cap = size * kBytes[dtype_code];
+        if (static_cast<size_t>(n) > cap) {
+          nd_set_err("destination buffer too small for array");
+        } else {
+          std::memcpy(data, buf, n);
+          rc = 0;
+        }
+      } else {
+        nd_set_err_from_python();
+      }
+    } else {
+      nd_set_err("output buffer read failed");
+    }
+    Py_DECREF(r);
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayWaitAll() {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  if (nd_ensure_bootstrap()) {
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "wait_all", nullptr);
+    if (r) {
+      Py_DECREF(r);
+      rc = 0;
+    } else {
+      nd_set_err_from_python();
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXListAllOpNames(uint32_t* out_size, const char*** out_array) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    if (!g_op_names) {
+      PyObject* r = PyObject_CallMethod(g_ndcore_cls, "list_ops", nullptr);
+      if (!r) {
+        nd_set_err_from_python();
+        break;
+      }
+      g_op_names = new std::vector<std::string>();
+      g_op_name_ptrs = new std::vector<const char*>();
+      Py_ssize_t n = PyList_Size(r);
+      g_op_names->reserve(n);
+      for (Py_ssize_t i = 0; i < n; ++i) {
+        const char* u = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+        if (u) g_op_names->emplace_back(u);
+        else PyErr_Clear();
+      }
+      for (auto& s : *g_op_names) g_op_name_ptrs->push_back(s.c_str());
+      Py_DECREF(r);
+    }
+    *out_size = static_cast<uint32_t>(g_op_name_ptrs->size());
+    *out_array = g_op_name_ptrs->data();
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// An op handle is the interned name pointer from the table above — stable
+// for the process lifetime (the reference hands out nnvm::Op*; the name is
+// this registry's primary key).
+int NNGetOpHandle(const char* op_name, void** out) {
+  uint32_t n = 0;
+  const char** names = nullptr;
+  if (MXListAllOpNames(&n, &names) != 0) return -1;
+  for (uint32_t i = 0; i < n; ++i) {
+    if (std::strcmp(names[i], op_name) == 0) {
+      *out = const_cast<char*>(names[i]);
+      return 0;
+    }
+  }
+  nd_set_err(std::string("operator not registered: ") + op_name);
+  return -1;
+}
+
+int MXImperativeInvoke(void* creator, int num_inputs, void** inputs,
+                       int* num_outputs, void*** outputs, int num_params,
+                       const char** param_keys, const char** param_vals) {
+  const char* op_name = static_cast<const char*>(creator);
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  // reference contract (c_api_ndarray.cc): caller-supplied output handles
+  // (*outputs non-NULL, *num_outputs > 0) request an IN-PLACE write into
+  // those arrays (the out= path); otherwise the library allocates
+  bool in_place = (*outputs != nullptr && *num_outputs > 0);
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* ins = PyList_New(num_inputs);
+    for (int i = 0; i < num_inputs; ++i) {
+      PyObject* o = static_cast<NDHandle*>(inputs[i])->obj;
+      Py_INCREF(o);
+      PyList_SET_ITEM(ins, i, o);
+    }
+    PyObject* keys = PyList_New(num_params);
+    PyObject* vals = PyList_New(num_params);
+    for (int i = 0; i < num_params; ++i) {
+      PyList_SET_ITEM(keys, i, PyUnicode_FromString(param_keys[i]));
+      PyList_SET_ITEM(vals, i, PyUnicode_FromString(param_vals[i]));
+    }
+    PyObject* out_arg;
+    if (in_place) {
+      out_arg = PyList_New(*num_outputs);
+      for (int i = 0; i < *num_outputs; ++i) {
+        PyObject* o = static_cast<NDHandle*>((*outputs)[i])->obj;
+        Py_INCREF(o);
+        PyList_SET_ITEM(out_arg, i, o);
+      }
+    } else {
+      out_arg = Py_None;
+      Py_INCREF(out_arg);
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "invoke", "sOOOO",
+                                      op_name, ins, keys, vals, out_arg);
+    Py_DECREF(ins);
+    Py_DECREF(keys);
+    Py_DECREF(vals);
+    Py_DECREF(out_arg);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    if (in_place) {
+      // results were written into the caller's handles; leave them be
+      Py_DECREF(r);
+      rc = 0;
+      break;
+    }
+    Py_ssize_t n = PyList_Size(r);
+    g_ret_handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      auto* h = new NDHandle();
+      h->obj = PyList_GET_ITEM(r, i);
+      Py_INCREF(h->obj);
+      g_ret_handles.push_back(h);
+    }
+    Py_DECREF(r);
+    *num_outputs = static_cast<int>(n);
+    *outputs = g_ret_handles.data();
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // extern "C"

@@ -233,3 +233,203 @@ def test_predict_abi_from_pure_c_host(tmp_path):
         capture_output=True, text=True, timeout=300, env=env)
     assert r.returncode == 0, r.stderr
     assert "C-HOST-OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# imperative C ABI (ndarray_core.cc — reference c_api.cc/c_api_ndarray.cc)
+# ---------------------------------------------------------------------------
+
+def test_ndarray_abi_in_process():
+    """ctypes drive of the MXNDArray*/MXImperativeInvoke slice: create two
+    arrays, upload data, invoke `dot` with a transpose attr, read back."""
+    import ctypes
+    lib = native.load_ndarray()
+    u32, vp = ctypes.c_uint32, ctypes.c_void_p
+
+    def make(shape_t, values):
+        sh = (u32 * len(shape_t))(*shape_t)
+        h = vp()
+        assert lib.MXNDArrayCreate(sh, len(shape_t), 1, 0, 0,
+                                   ctypes.byref(h)) == 0, \
+            lib.MXNDGetLastError()
+        arr = np.ascontiguousarray(values, np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(vp), arr.size) == 0
+        return h
+
+    a_np = np.arange(6, dtype=np.float32).reshape(2, 3)
+    b_np = np.arange(12, dtype=np.float32).reshape(4, 3) * 0.5
+    ha, hb = make((2, 3), a_np), make((4, 3), b_np)
+
+    # shape/dtype introspection
+    ndim = u32()
+    pdata = ctypes.POINTER(u32)()
+    assert lib.MXNDArrayGetShape(ha, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    assert [pdata[i] for i in range(ndim.value)] == [2, 3]
+    dt = ctypes.c_int()
+    assert lib.MXNDArrayGetDType(ha, ctypes.byref(dt)) == 0
+    assert dt.value == 0                      # float32
+
+    # registry surfaces through C
+    n_ops = u32()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXListAllOpNames(ctypes.byref(n_ops),
+                                ctypes.byref(names)) == 0
+    assert n_ops.value >= 300
+    op = vp()
+    assert lib.NNGetOpHandle(b"dot", ctypes.byref(op)) == 0
+
+    # invoke dot(a, b, transpose_b=True) -> (2, 4)
+    ins = (vp * 2)(ha, hb)
+    n_out = ctypes.c_int()
+    outs = ctypes.POINTER(vp)()
+    keys = (ctypes.c_char_p * 1)(b"transpose_b")
+    vals = (ctypes.c_char_p * 1)(b"True")
+    assert lib.MXImperativeInvoke(op, 2, ins, ctypes.byref(n_out),
+                                  ctypes.byref(outs), 1, keys, vals) == 0, \
+        lib.MXNDGetLastError()
+    assert n_out.value == 1
+    out_h = outs[0]
+    assert lib.MXNDArrayGetShape(out_h, ctypes.byref(ndim),
+                                 ctypes.byref(pdata)) == 0
+    out_shape = tuple(pdata[i] for i in range(ndim.value))
+    assert out_shape == (2, 4)
+    buf = np.empty(out_shape, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(out_h, buf.ctypes.data_as(vp),
+                                      buf.size) == 0
+    np.testing.assert_allclose(buf, a_np @ b_np.T, rtol=1e-6)
+    assert lib.MXNDArrayWaitAll() == 0
+
+    # unknown op reports through MXNDGetLastError
+    bad = vp()
+    assert lib.NNGetOpHandle(b"definitely_not_an_op",
+                             ctypes.byref(bad)) != 0
+    assert b"not registered" in lib.MXNDGetLastError()
+    for h in (ha, hb, out_h):
+        lib.MXNDArrayFree(h)
+
+
+ND_C_HOST = r"""
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+typedef int (*create_fn)(const uint32_t*, uint32_t, int, int, int, void**);
+typedef int (*copyfrom_fn)(void*, const void*, size_t);
+typedef int (*copyto_fn)(void*, void*, size_t);
+typedef int (*getshape_fn)(void*, uint32_t*, const uint32_t**);
+typedef int (*ophandle_fn)(const char*, void**);
+typedef int (*invoke_fn)(void*, int, void**, int*, void***, int,
+                         const char**, const char**);
+typedef int (*free_fn)(void*);
+typedef const char* (*err_fn)(void);
+int main(int argc, char** argv) {
+  void* so = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!so) { fprintf(stderr, "%s\n", dlerror()); return 2; }
+  create_fn nd_create = (create_fn)dlsym(so, "MXNDArrayCreate");
+  copyfrom_fn nd_from = (copyfrom_fn)dlsym(so, "MXNDArraySyncCopyFromCPU");
+  copyto_fn nd_to = (copyto_fn)dlsym(so, "MXNDArraySyncCopyToCPU");
+  getshape_fn nd_shape = (getshape_fn)dlsym(so, "MXNDArrayGetShape");
+  ophandle_fn op_get = (ophandle_fn)dlsym(so, "NNGetOpHandle");
+  invoke_fn invoke = (invoke_fn)dlsym(so, "MXImperativeInvoke");
+  free_fn nd_free = (free_fn)dlsym(so, "MXNDArrayFree");
+  err_fn lasterr = (err_fn)dlsym(so, "MXNDGetLastError");
+
+  uint32_t sa[2] = {2, 3}, sb[2] = {3, 2};
+  void *ha = NULL, *hb = NULL;
+  if (nd_create(sa, 2, 1, 0, 0, &ha)) {
+    fprintf(stderr, "create: %s\n", lasterr()); return 1; }
+  if (nd_create(sb, 2, 1, 0, 0, &hb)) return 1;
+  float a[6] = {1, 2, 3, 4, 5, 6}, b[6] = {1, 0, 0, 1, 1, 1};
+  if (nd_from(ha, a, 6) || nd_from(hb, b, 6)) return 1;
+
+  void* op = NULL;
+  if (op_get("dot", &op)) { fprintf(stderr, "op: %s\n", lasterr()); return 1; }
+  void* ins[2]; ins[0] = ha; ins[1] = hb;
+  int n_out = 0; void** outs = NULL;
+  if (invoke(op, 2, ins, &n_out, &outs, 0, NULL, NULL)) {
+    fprintf(stderr, "invoke: %s\n", lasterr()); return 1; }
+  uint32_t ndim = 0; const uint32_t* shp = NULL;
+  if (nd_shape(outs[0], &ndim, &shp) || ndim != 2 || shp[0] != 2
+      || shp[1] != 2) { fprintf(stderr, "shape wrong\n"); return 1; }
+  float out[4];
+  if (nd_to(outs[0], out, 4)) return 1;
+  /* [[1,2,3],[4,5,6]] @ [[1,0],[0,1],[1,1]] = [[4,5],[10,11]] */
+  if (out[0] != 4 || out[1] != 5 || out[2] != 10 || out[3] != 11) {
+    fprintf(stderr, "values wrong: %f %f %f %f\n",
+            out[0], out[1], out[2], out[3]);
+    return 1;
+  }
+  nd_free(ha); nd_free(hb); nd_free(outs[0]);
+  printf("ND-C-HOST-OK\n");
+  return 0;
+}
+"""
+
+
+def test_ndarray_abi_from_pure_c_host(tmp_path):
+    """A C binary with no Python linkage creates arrays, invokes `dot`
+    through the registry, and reads the result back — the reference's
+    language-binding story (c_api.cc is what Scala/Julia/R bind against)."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    native.load_ndarray()            # ensure the .so is built
+    so = os.path.join(os.path.dirname(native.__file__),
+                      "libmxtpu_ndarray.so")
+    csrc = tmp_path / "nd_host.c"
+    csrc.write_text(ND_C_HOST)
+    exe = str(tmp_path / "nd_host")
+    subprocess.run(["gcc", "-O2", "-o", exe, str(csrc), "-ldl"],
+                   check=True)
+    env = dict(os.environ,
+               PALLAS_AXON_POOL_IPS="",   # standalone host: force CPU jax
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe, so], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "ND-C-HOST-OK" in r.stdout
+
+
+def test_ndarray_abi_inplace_out_and_bounds():
+    """Reference c_api_ndarray.cc contracts: caller-supplied output handles
+    mean in-place write; SyncCopyToCPU must refuse a too-small buffer."""
+    import ctypes
+    lib = native.load_ndarray()
+    u32, vp = ctypes.c_uint32, ctypes.c_void_p
+
+    def make(shape_t, values):
+        sh = (u32 * len(shape_t))(*shape_t)
+        h = vp()
+        assert lib.MXNDArrayCreate(sh, len(shape_t), 1, 0, 0,
+                                   ctypes.byref(h)) == 0
+        arr = np.ascontiguousarray(values, np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(vp), arr.size) == 0
+        return h
+
+    a = make((2, 2), np.ones((2, 2)))
+    b = make((2, 2), 2 * np.ones((2, 2)))
+    dst = make((2, 2), np.zeros((2, 2)))
+    op = vp()
+    assert lib.NNGetOpHandle(b"broadcast_add", ctypes.byref(op)) == 0
+    ins = (vp * 2)(a, b)
+    outs_arr = (vp * 1)(dst)
+    outs = ctypes.cast(outs_arr, ctypes.POINTER(vp))
+    n_out = ctypes.c_int(1)
+    assert lib.MXImperativeInvoke(op, 2, ins, ctypes.byref(n_out),
+                                  ctypes.byref(outs), 0, None, None) == 0, \
+        lib.MXNDGetLastError()
+    buf = np.empty((2, 2), np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(dst, buf.ctypes.data_as(vp),
+                                      buf.size) == 0
+    np.testing.assert_allclose(buf, 3.0)      # written IN PLACE into dst
+
+    # bounds: reading a 4-element array into a 2-element buffer must fail
+    small = np.empty(2, np.float32)
+    assert lib.MXNDArraySyncCopyToCPU(dst, small.ctypes.data_as(vp),
+                                      small.size) != 0
+    assert b"too small" in lib.MXNDGetLastError()
+    for h in (a, b, dst):
+        lib.MXNDArrayFree(h)
