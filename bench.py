@@ -119,54 +119,88 @@ def bench_mnist_mlp(iters=200, warmup=30, batch=64):
             "batch": batch}
 
 
-def bench_bert_base(iters=10, warmup=3, batch=8, seq=128,
-                    dtype="float32"):
-    """Config #3: BERT-base whole-step time on the dp mesh (dp×tp×sp on
-    multi-chip — tested in tests/test_parallel.py; one real chip here).
+def bench_bert_base(iters=10, warmup=3, batch=8, seq=256,
+                    dtype="float32", attention="xla"):
+    """Config #3: BERT-base pretraining whole-step time on the dp mesh
+    (dp×tp×sp on multi-chip — tested in tests/test_parallel.py; one real
+    chip here).  The objective is the REAL pretraining loss: masked-LM
+    cross-entropy over the 15%-masked positions plus the NSP head's CE —
+    with per-sequence padding (valid lengths in [seq/2, seq]), so the
+    attention mask path is exercised.  attention='flash' routes the
+    encoder's self-attention through the Pallas flash kernel (per-row
+    valid-length masking); 'xla' is the additive-mask softmax path.
     dtype='bfloat16' enables the AMP hook (the MXU-native mode)."""
-    import mxnet_tpu as mx
-    from mxnet_tpu import parallel as par
     from mxnet_tpu.contrib import amp
-    from mxnet_tpu.gluon.model_zoo.transformer import bert_base
 
     if dtype == "bfloat16":
         amp.init("bfloat16")
+    prev = os.environ.get("MXNET_USE_FLASH_ATTENTION")
+    os.environ["MXNET_USE_FLASH_ATTENTION"] = \
+        "1" if attention == "flash" else "0"
     try:
-        return _bench_bert_inner(iters, warmup, batch, seq)
+        return _bench_bert_inner(iters, warmup, batch, seq, attention)
     finally:
         amp.disable()
+        if prev is None:
+            os.environ.pop("MXNET_USE_FLASH_ATTENTION", None)
+        else:
+            os.environ["MXNET_USE_FLASH_ATTENTION"] = prev
 
 
-def _bench_bert_inner(iters, warmup, batch, seq):
+def _bench_bert_inner(iters, warmup, batch, seq, attention):
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.gluon.model_zoo.transformer import bert_base
 
-    net = bert_base()
+    # dropout=0 keeps the two attention paths numerically comparable (the
+    # flash kernel has no attention-probs tensor to drop) — standard
+    # benchmarking config
+    net = bert_base(dropout=0.0)
     net.initialize()
 
-    def mlm_loss(out, y):
-        mlm = out[0] if isinstance(out, (list, tuple)) else out
-        return mx.nd.mean(mx.nd.square(mlm)) * 0.5
+    MASK_ID, VOCAB = 103, 30522
 
-    tr = par.ShardedTrainer(net, mlm_loss, "adam",
+    def mlm_nsp_loss(out, ys):
+        mlm, nsp = out
+        labels, weights, nsp_y = ys
+        logp = mx.nd.log_softmax(mlm, axis=-1)
+        ce = -mx.nd.pick(logp, labels, axis=-1)           # (B, S)
+        mlm_l = mx.nd.sum(ce * weights) / mx.nd.sum(weights)
+        nsp_logp = mx.nd.log_softmax(nsp, axis=-1)
+        nsp_l = -mx.nd.mean(mx.nd.pick(nsp_logp, nsp_y, axis=-1))
+        return mlm_l + nsp_l
+
+    tr = par.ShardedTrainer(net, mlm_nsp_loss, "adam",
                             {"learning_rate": 1e-4})
     rng = np.random.default_rng(0)
-    tokens = rng.integers(0, 30000, (batch, seq))
+    tokens = rng.integers(0, VOCAB, (batch, seq))
+    valid_lens = rng.integers(seq // 2, seq + 1, (batch,))
+    valid = (np.arange(seq)[None, :] < valid_lens[:, None]) \
+        .astype(np.float32)
+    mask_pos = (rng.random((batch, seq)) < 0.15) & (valid > 0)
+    mask_pos[:, 0] = True                    # >=1 masked position per row
+    inputs = np.where(mask_pos, MASK_ID, tokens)
+    weights = mask_pos.astype(np.float32)
     segs = np.zeros((batch, seq), np.int64)
-    mask = np.ones((batch, seq), np.float32)
-    y = np.zeros((batch,), np.float32)
-    loss = tr.step((tokens, segs, mask), y)
+    nsp_y = rng.integers(0, 2, (batch,))
+    # padding as (B,) valid LENGTHS (the GluonNLP valid_length idiom) —
+    # authoritative, so the flash path can mask per row under jit
+    x = (inputs, segs, valid_lens.astype(np.float32))
+    y = (tokens, weights, nsp_y)
+    loss = tr.step(x, y)                     # build + compile
     for _ in range(warmup):
-        loss = tr.step((tokens, segs, mask), y)
+        loss = tr.step(x, y)
     float(loss.asnumpy())
     t0 = time.perf_counter()
     for _ in range(iters):
-        loss = tr.step((tokens, segs, mask), y)
-    float(loss.asnumpy())
+        loss = tr.step(x, y)
+    lval = float(loss.asnumpy())
     dt = time.perf_counter() - t0
+    assert np.isfinite(lval), "non-finite BERT loss in benchmark"
     return {"step_ms": round(dt / iters * 1e3, 2), "batch": batch,
-            "seq_len": seq,
+            "seq_len": seq, "attention": attention,
+            "masked_positions": int(weights.sum()),
+            "loss": round(lval, 3),
             "sequences_per_sec": round(batch * iters / dt, 1)}
 
 
@@ -395,6 +429,7 @@ def main():
         rows["mnist_mlp_imperative"] = bench_mnist_mlp()
     elif args.only == "bert":
         rows["bert_base"] = bench_bert_base()
+        rows["bert_base_flash"] = bench_bert_base(attention="flash")
     elif args.only == "nmt":
         rows["nmt_transformer"] = bench_nmt()
     elif args.only == "ssd":
@@ -429,14 +464,26 @@ def main():
             "float32", args.batch, args.iters, args.warmup, args.size,
             args.layout))
         guarded("mnist_mlp_imperative", bench_mnist_mlp)
-        guarded("bert_base", bench_bert_base)
         # CPU CI host (1 core) gets reduced step counts; the TPU run
         # keeps the real ones
         import jax as _jax
         cpu_ci = _jax.default_backend() == "cpu"
-        if not cpu_ci:                  # MXU-native BERT row (TPU only)
+        if cpu_ci:
+            guarded("bert_base", lambda: bench_bert_base(
+                iters=2, warmup=1, batch=2, seq=256))
+            guarded("bert_base_flash", lambda: bench_bert_base(
+                iters=2, warmup=1, batch=2, seq=256, attention="flash"))
+        else:
+            # both attention paths on-chip: XLA additive-mask softmax vs
+            # the Pallas flash kernel (identical model/loss/data)
+            guarded("bert_base", bench_bert_base)
+            guarded("bert_base_flash",
+                    lambda: bench_bert_base(attention="flash"))
             guarded("bert_base_bf16",
                     lambda: bench_bert_base(dtype="bfloat16"))
+            guarded("bert_base_bf16_flash",
+                    lambda: bench_bert_base(dtype="bfloat16",
+                                            attention="flash"))
         guarded("nmt_transformer",
                 (lambda: bench_nmt(iters=2, warmup=1)) if cpu_ci
                 else bench_nmt)
@@ -451,7 +498,9 @@ def main():
         "resnet50_fp32": ("images_per_sec_per_chip", "images/sec/chip"),
         "mnist_mlp_imperative": ("images_per_sec", "images/sec"),
         "bert_base": ("step_ms", "ms/step"),
+        "bert_base_flash": ("step_ms", "ms/step"),
         "bert_base_bf16": ("step_ms", "ms/step"),
+        "bert_base_bf16_flash": ("step_ms", "ms/step"),
         "nmt_transformer": ("tokens_per_sec", "tokens/sec"),
         "ssd_detection": ("images_per_sec", "images/sec"),
         "input_pipeline": ("images_per_sec", "images/sec"),

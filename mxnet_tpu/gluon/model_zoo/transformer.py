@@ -98,7 +98,13 @@ class MultiHeadAttention(HybridBlock):
         x = F.transpose(x, axes=(0, 2, 1, 3))
         return F.reshape(x, shape=(batch, seq, self._units))
 
-    def hybrid_forward(self, F, x, mask=None, mem=None):
+    def hybrid_forward(self, F, x, mask=None, mem=None, valid_len=None):
+        """``mask``: arbitrary (B*H, Sq, Sk) attention mask (exact XLA
+        softmax path).  ``valid_len``: per-sequence key lengths (B,) or
+        (B*H,) — the GluonNLP valid_length idiom; authoritative, so the
+        flash kernel can honor it even under jit.  Passing both is
+        allowed when they express the SAME prefix mask (the XLA path
+        uses ``mask``, flash uses ``valid_len``)."""
         b, sq = x.shape[0], x.shape[1]
         if self._self:
             qkv = self.qkv(x)
@@ -115,11 +121,14 @@ class MultiHeadAttention(HybridBlock):
         k = self._split_heads(F, k, b, sk)
         v = self._split_heads(F, v, b, sk)
         scale = 1.0 / math.sqrt(self._units // self._heads)
-        if self._flash_eligible(F, mask):
+        if self._flash_eligible(F, mask, valid_len):
             # tiled online-softmax Pallas kernel with a chunked-scan
             # custom VJP — differentiable, no (Lq, Lk) score matrix in
             # either direction (kernels/flash_attention.py)
-            out = F.flash_attention(q, k, v, scale=scale)
+            if valid_len is None:
+                out = F.flash_attention(q, k, v, scale=scale)
+            else:
+                out = F.flash_attention(q, k, v, valid_len, scale=scale)
         else:
             scores = F.batch_dot(q, k, transpose_b=True) * scale
             att = _masked_softmax(F, scores, mask)
@@ -128,17 +137,22 @@ class MultiHeadAttention(HybridBlock):
             out = F.batch_dot(att, v)
         return self.proj(self._merge_heads(F, out, b, sq))
 
-    def _flash_eligible(self, F, mask) -> bool:
-        # env-gated (MXNET_USE_FLASH_ATTENTION=1), unmasked, imperative
-        # mode only.  The kernel is differentiable (custom VJP over the
-        # chunked formulation), so training may ride it too — EXCEPT when
-        # this block has attention dropout and dropout is live
-        # (train_mode/record), since the flash path has no probs tensor
-        # to drop.
+    def _flash_eligible(self, F, mask, valid_len) -> bool:
+        # env-gated (MXNET_USE_FLASH_ATTENTION=1), imperative mode only.
+        # Masks: none always works; explicit ``valid_len`` lengths ride
+        # the kernel's per-row masking.  An arbitrary (B*H,Sq,Sk) mask
+        # WITHOUT lengths falls back to the XLA path — a 2-D mask cannot
+        # be proven to be a prefix mask under trace, and collapsing a
+        # non-prefix mask to a length silently corrupts attention (caught
+        # in round-4 review).  The kernel is differentiable (custom VJP
+        # over the chunked formulation), so training may ride it too —
+        # EXCEPT when this block has attention dropout and dropout is
+        # live (train_mode/record), since the flash path has no probs
+        # tensor to drop.
         import os
         if os.environ.get("MXNET_USE_FLASH_ATTENTION", "0") != "1":
             return False
-        if mask is not None:
+        if mask is not None and valid_len is None:
             return False
         if not hasattr(F, "flash_attention") or \
                 not hasattr(F, "NDArray"):
@@ -187,8 +201,10 @@ class TransformerEncoderCell(HybridBlock):
             self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
             self.drop = Dropout(dropout) if dropout else None
 
-    def hybrid_forward(self, F, x, mask=None):
-        a = self.attn(x, mask)
+    def hybrid_forward(self, F, x, mask=None, valid_len=None):
+        # positional: Block.__call__ forwards *args only (reference Gluon
+        # calling convention); mem slot is None for self-attention
+        a = self.attn(x, mask, None, valid_len)
         if self.drop is not None:
             a = self.drop(a)
         x = self.ln1(x + a)
@@ -254,21 +270,35 @@ class TransformerEncoder(HybridBlock):
                         units, hidden_size, num_heads, dropout, activation))
 
     def hybrid_forward(self, F, x, mask=None):
-        """x: (B, S, units) embedded input; mask: (B, S) 1=valid."""
+        """x: (B, S, units) embedded input.  mask: (B, S) 1=valid, OR a
+        1-D (B,) array of per-sequence valid LENGTHS (the GluonNLP
+        valid_length idiom) — the length form is authoritative padding
+        information, letting the flash-attention path mask by row length
+        instead of falling back to the XLA softmax."""
         b, s = x.shape[0], x.shape[1]
         if s > self._max_len:
             raise ValueError(
                 f"sequence length {s} exceeds max_length={self._max_len}")
         x = x + self.pos_embed(_positions(F, b, s))
         att_mask = None
+        valid_len = None
         if mask is not None:
+            if mask.ndim == 1:                     # (B,) valid lengths
+                valid_len = mask
+                key_mask = F.broadcast_lesser(
+                    F.reshape(F.arange(s, dtype="float32"),
+                              shape=(1, s)),
+                    F.reshape(F.cast(mask, dtype="float32"),
+                              shape=(b, 1)))
+            else:                                  # (B, S) 0/1 mask
+                key_mask = mask
             # (B,S) -> (B,1,1,S) -> (B*H, Sq, Sk)
-            att_mask = F.reshape(mask, shape=(b, 1, 1, s))
+            att_mask = F.reshape(key_mask, shape=(b, 1, 1, s))
             att_mask = F.broadcast_to(att_mask,
                                       shape=(b, self._heads, s, s))
             att_mask = F.reshape(att_mask, shape=(-1, s, s))
         for cell in self.cells:
-            x = cell(x, att_mask)
+            x = cell(x, att_mask, valid_len)
         return x
 
 

@@ -46,9 +46,12 @@ def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
     nk = lk // BLOCK_K
     dtype = jnp.dtype(dtype_name)
 
-    def kernel(q_ref, k_ref, v_ref, o_ref):
+    def kernel(q_ref, k_ref, v_ref, vl_ref, o_ref):
         qi = pl.program_id(1)
         q = q_ref[0].astype(jnp.float32) * scale          # (BQ, D)
+        # per-sequence valid key length (padding mask support): the tile
+        # padding bound `valid_lk` is static; vl tightens it per row
+        vl = jnp.minimum(vl_ref[0], jnp.float32(valid_lk))
 
         def body(ki, carry):
             m, l, acc = carry
@@ -62,7 +65,8 @@ def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
             # mask K padding (and the causal upper triangle)
             k_idx = ki * BLOCK_K + lax.broadcasted_iota(
                 jnp.int32, (BLOCK_Q, BLOCK_K), 1)
-            mask = k_idx < valid_lk
+            kmask = k_idx.astype(jnp.float32) < vl
+            mask = kmask
             if causal:
                 # bottom-right alignment (the flash/decode convention and
                 # this repo's reference): query i sits at absolute key
@@ -76,13 +80,12 @@ def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
             p = jnp.exp(s - m_new[:, None])
             # rows whose every key is masked (causal bound < 0): the
             # reference softmaxes a uniform -NEG_INF row, i.e. uniform
-            # attention over the valid_lk keys — exp(0)=1 here would
+            # attention over the valid keys — exp(0)=1 here would
             # instead spread over PADDED slots, so substitute the valid
             # mask as the weights (masks are prefixes, so a row dead in
             # this block is dead in every block)
             dead = m_new <= (_NEG_INF * 0.5)
-            p = jnp.where(dead[:, None],
-                          (k_idx < valid_lk).astype(jnp.float32), p)
+            p = jnp.where(dead[:, None], kmask.astype(jnp.float32), p)
             corr = jnp.exp(m - m_new)
             l_new = l * corr + jnp.sum(p, axis=1)
             acc_new = acc * corr[:, None] + jax.lax.dot_general(
@@ -100,17 +103,18 @@ def _build_call(bh: int, lq: int, lk: int, d: int, valid_lq: int,
 
     q_spec = pl.BlockSpec((1, BLOCK_Q, d), lambda b, i: (b, i, 0))
     kv_spec = pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0))
+    vl_spec = pl.BlockSpec((1,), lambda b, i: (b,))
     return pl.pallas_call(
         kernel,
         grid=(bh, nq),
-        in_specs=[q_spec, kv_spec, kv_spec],
+        in_specs=[q_spec, kv_spec, kv_spec, vl_spec],
         out_specs=q_spec,
         out_shape=jax.ShapeDtypeStruct((bh, lq, d), dtype),
         interpret=interpret,
     )
 
 
-def _chunked_reference(q, k, v, causal: bool, scale: float):
+def _chunked_reference(q, k, v, vl, causal: bool, scale: float):
     """Pure-jnp online-softmax attention, chunked over KV blocks with
     lax.scan — numerically identical to the kernel (same masks, same
     dead-row semantics) and DIFFERENTIABLE.  The custom VJP below runs
@@ -132,6 +136,7 @@ def _chunked_reference(q, k, v, causal: bool, scale: float):
     kb = k.astype(jnp.float32).reshape(bh, nk, BLOCK_K, d)
     vb = v.astype(jnp.float32).reshape(bh, nk, BLOCK_K, d)
     q_idx = jnp.arange(lq)
+    vl_eff = jnp.minimum(vl.astype(jnp.float32), jnp.float32(lk))  # (bh,)
 
     # remat: without checkpointing, vjp-of-scan stacks each step's p
     # (bh, Lq, BLOCK_K) — a full probability matrix across steps; with it,
@@ -143,7 +148,9 @@ def _chunked_reference(q, k, v, causal: bool, scale: float):
         k_blk, v_blk, ki = blk
         s = jnp.einsum("bqd,bkd->bqk", qf, k_blk)
         k_ids = ki * BLOCK_K + jnp.arange(BLOCK_K)
-        mask = (k_ids < lk)[None, None, :]
+        kmask = (k_ids[None, :].astype(jnp.float32)
+                 < vl_eff[:, None])[:, None, :]        # (bh, 1, BK)
+        mask = kmask
         if causal:
             mask = mask & (k_ids[None, None, :] <=
                            q_idx[None, :, None] + (lk - lq))
@@ -152,7 +159,7 @@ def _chunked_reference(q, k, v, causal: bool, scale: float):
         p = jnp.exp(s - m_new[..., None])
         dead = m_new <= (_NEG_INF * 0.5)
         p = jnp.where(dead[..., None],
-                      jnp.broadcast_to((k_ids < lk).astype(jnp.float32),
+                      jnp.broadcast_to(kmask.astype(jnp.float32),
                                        p.shape), p)
         corr = jnp.exp(m - m_new)
         l_new = l * corr + jnp.sum(p, axis=-1)
@@ -176,29 +183,31 @@ def _flash_core_fn():
     module never imports jax)."""
     import jax
 
-    @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5))
-    def core(q, k, v, causal, scale, interpret):
-        return _run_kernel(q, k, v, causal, scale, interpret)
+    @functools.partial(jax.custom_vjp, nondiff_argnums=(4, 5, 6))
+    def core(q, k, v, vl, causal, scale, interpret):
+        return _run_kernel(q, k, v, vl, causal, scale, interpret)
 
-    def core_fwd(q, k, v, causal, scale, interpret):
-        return _run_kernel(q, k, v, causal, scale, interpret), (q, k, v)
+    def core_fwd(q, k, v, vl, causal, scale, interpret):
+        return _run_kernel(q, k, v, vl, causal, scale, interpret), \
+            (q, k, v, vl)
 
     def core_bwd(causal, scale, interpret, res, g):
-        q, k, v = res
+        q, k, v, vl = res
         _, vjp = jax.vjp(
-            lambda a, b, c: _chunked_reference(a, b, c, causal, scale),
+            lambda a, b, c: _chunked_reference(a, b, c, vl, causal, scale),
             q, k, v)
-        return vjp(g)
-
+        dq, dk, dv = vjp(g)
+        import jax.numpy as jnp
+        return dq, dk, dv, jnp.zeros_like(vl)   # vl is a mask, not a weight
     core.defvjp(core_fwd, core_bwd)
     return core
 
 
-def _flash_core(q, k, v, causal: bool, scale: float, interpret: bool):
-    return _flash_core_fn()(q, k, v, causal, scale, interpret)
+def _flash_core(q, k, v, vl, causal: bool, scale: float, interpret: bool):
+    return _flash_core_fn()(q, k, v, vl, causal, scale, interpret)
 
 
-def _run_kernel(q, k, v, causal: bool, scale: float, interpret: bool):
+def _run_kernel(q, k, v, vl, causal: bool, scale: float, interpret: bool):
     import jax.numpy as jnp
 
     bh, lq, d = q.shape
@@ -219,16 +228,19 @@ def _run_kernel(q, k, v, causal: bool, scale: float, interpret: bool):
     call = _build_call(bh, qp.shape[1], kp.shape[1], qp.shape[2], lq, lk,
                        bool(causal), float(scale),
                        jnp.result_type(q).name, bool(interpret))
-    return call(qp, kp, vp)[:, :lq, :d]
+    return call(qp, kp, vp, vl.astype(jnp.float32))[:, :lq, :d]
 
 
 def flash_attention(q, k, v, causal: bool = False, scale=None,
-                    interpret=None):
+                    interpret=None, valid_len=None):
     """Tiled attention: softmax(scale·QKᵀ + mask)V without materializing
     the score matrix.
 
     Accepts (B, H, L, D) or (BH, L, D); Lq/Lk/D are padded internally to
     tile multiples (K padding is masked exactly, never approximated).
+    ``valid_len`` enables per-sequence key-padding masks — shape (B,) or
+    (B*H,); keys at positions >= valid_len[i] are masked exactly like the
+    additive -1e9 padding mask of the XLA path.
     DIFFERENTIABLE: the forward runs the Pallas kernel, the backward
     differentiates an equivalent chunked jnp formulation — gradients also
     never touch an (Lq, Lk) score matrix.
@@ -247,8 +259,18 @@ def flash_attention(q, k, v, causal: bool = False, scale=None,
         scale = 1.0 / (d ** 0.5)
     if interpret is None:
         interpret = _interpret(q)
+    if valid_len is None:
+        vl = jnp.full((bh,), lk, jnp.float32)
+    else:
+        vl = jnp.asarray(valid_len).reshape(-1).astype(jnp.float32)
+        if vl.shape[0] != bh:
+            if bh % vl.shape[0]:
+                raise ValueError(
+                    f"valid_len length {vl.shape[0]} does not divide "
+                    f"batch*heads {bh}")
+            vl = jnp.repeat(vl, bh // vl.shape[0])
 
-    out = _flash_core(q, k, v, bool(causal), float(scale),
+    out = _flash_core(q, k, v, vl, bool(causal), float(scale),
                       bool(interpret))
     if squeeze4:
         out = out.reshape(b, h, lq, d)

@@ -168,6 +168,26 @@ bool nd_ensure_bootstrap() {
   return true;
 }
 
+// one dtype-code -> byte-size table (mirrors the bootstrap's _DT map)
+bool nd_elem_size(NDHandle* h, size_t* out) {
+  static const size_t kBytes[] = {4, 8, 2, 1, 4, 1, 8};
+  PyObject* dt = PyObject_CallMethod(g_ndcore_cls, "dtype_code", "O",
+                                     h->obj);
+  if (!dt) {
+    nd_set_err_from_python();
+    return false;
+  }
+  long code = PyLong_AsLong(dt);
+  Py_DECREF(dt);
+  if (code < 0 ||
+      code >= static_cast<long>(sizeof(kBytes) / sizeof(kBytes[0]))) {
+    nd_set_err("unknown dtype code");
+    return false;
+  }
+  *out = kBytes[code];
+  return true;
+}
+
 // thread-local output scratch (reference: MXAPIThreadLocalEntry) — the
 // handle-pointer array returned by MXImperativeInvoke lives here until the
 // thread's next invoke
@@ -270,16 +290,10 @@ int MXNDArraySyncCopyFromCPU(void* handle, const void* data, size_t size) {
   PyGILState_STATE gil = PyGILState_Ensure();
   int rc = -1;
   // size is an ELEMENT count (reference contract); bytes follow dtype
-  int dtype_code = 0;
-  PyObject* dt = PyObject_CallMethod(g_ndcore_cls, "dtype_code", "O",
-                                     h->obj);
-  if (dt) {
-    dtype_code = static_cast<int>(PyLong_AsLong(dt));
-    Py_DECREF(dt);
-    static const size_t kBytes[] = {4, 8, 2, 1, 4, 1, 8};
-    size_t nbytes = size * kBytes[dtype_code];
+  size_t esize = 0;
+  if (nd_elem_size(h, &esize)) {
     PyObject* raw = PyBytes_FromStringAndSize(
-        static_cast<const char*>(data), nbytes);
+        static_cast<const char*>(data), size * esize);
     PyObject* r = PyObject_CallMethod(g_ndcore_cls, "copy_from", "OO",
                                       h->obj, raw);
     Py_DECREF(raw);
@@ -289,8 +303,6 @@ int MXNDArraySyncCopyFromCPU(void* handle, const void* data, size_t size) {
     } else {
       nd_set_err_from_python();
     }
-  } else {
-    nd_set_err_from_python();
   }
   PyGILState_Release(gil);
   return rc;
@@ -307,22 +319,14 @@ int MXNDArraySyncCopyToCPU(void* handle, void* data, size_t size) {
     if (PyBytes_AsStringAndSize(r, &buf, &n) == 0) {
       // size is the caller's buffer ELEMENT count (reference contract):
       // never write more than the caller allocated
-      int dtype_code = 0;
-      PyObject* dt = PyObject_CallMethod(g_ndcore_cls, "dtype_code", "O",
-                                         h->obj);
-      if (dt) {
-        dtype_code = static_cast<int>(PyLong_AsLong(dt));
-        Py_DECREF(dt);
-        static const size_t kBytes[] = {4, 8, 2, 1, 4, 1, 8};
-        size_t cap = size * kBytes[dtype_code];
-        if (static_cast<size_t>(n) > cap) {
+      size_t esize = 0;
+      if (nd_elem_size(h, &esize)) {
+        if (static_cast<size_t>(n) > size * esize) {
           nd_set_err("destination buffer too small for array");
         } else {
           std::memcpy(data, buf, n);
           rc = 0;
         }
-      } else {
-        nd_set_err_from_python();
       }
     } else {
       nd_set_err("output buffer read failed");

@@ -853,8 +853,10 @@ def _register_round3b():
     def flash_attention_maker(causal=False, scale=None):
         from ..kernels import flash_attention as _fa
 
-        def fn(q, k, v):
-            return _fa(q, k, v, causal=causal, scale=scale)
+        def fn(q, k, v, valid_len=None):
+            # optional 4th input: per-sequence key-padding lengths
+            return _fa(q, k, v, causal=causal, scale=scale,
+                       valid_len=valid_len)
         return fn
 
     def flash_attention_vjp_maker(causal=False, scale=None):
@@ -864,11 +866,23 @@ def _register_round3b():
         from ..kernels import flash_attention as _fa
         from ..kernels.flash_attention import _interpret as _interp
 
-        def wrapper(q, k, v):
+        def wrapper(q, k, v, valid_len=None):
             interp = _interp(q)
-            return jax.vjp(
+            if valid_len is None:
+                return jax.vjp(
+                    lambda a, b, c: _fa(a, b, c, causal=causal,
+                                        scale=scale, interpret=interp),
+                    q, k, v)
+            out, vjp3 = jax.vjp(
                 lambda a, b, c: _fa(a, b, c, causal=causal, scale=scale,
-                                    interpret=interp), q, k, v)
+                                    interpret=interp, valid_len=valid_len),
+                q, k, v)
+
+            def vjp4(g):
+                # the tape sees 4 parents; valid_len is a mask, zero grad
+                dq, dk, dv = vjp3(g)
+                return dq, dk, dv, jnp.zeros_like(valid_len)
+            return out, vjp4
         return wrapper
     register_op("_contrib_flash_attention", flash_attention_maker,
                 aliases=("flash_attention",), use_jit=False,

@@ -101,7 +101,13 @@ class ShardedTrainer:
         # per-input sharding: the data spec truncated to each input's rank
         self._x_sh = tuple(
             shard(mesh, *self._data_spec[:v.ndim]) for v in xs)
-        self._y_sh = shard(mesh, *self._label_spec[:y.ndim])
+        # tuple labels (multi-stream, e.g. MLM+NSP) shard element-wise
+        self._y_multi = isinstance(y, tuple)
+        if self._y_multi:
+            self._y_sh = tuple(shard(mesh, *self._label_spec[:v.ndim])
+                               for v in y)
+        else:
+            self._y_sh = shard(mesh, *self._label_spec[:y.ndim])
         self._r_sh = replicated(mesh)
 
         # move weights onto the mesh — the trainer owns them from here on
@@ -130,8 +136,13 @@ class ShardedTrainer:
                     _autograd._RecordingScope(False, training), \
                     _KeyScope(key):
                 out = block(*[NDArray(v, ctx=ctx) for v in xv])
-                l_nd = loss_blk(out, NDArray(yv, ctx=ctx)) \
-                    if yv is not None else None
+                if yv is None:
+                    l_nd = None
+                elif isinstance(yv, tuple):
+                    l_nd = loss_blk(out, tuple(NDArray(v, ctx=ctx)
+                                               for v in yv))
+                else:
+                    l_nd = loss_blk(out, NDArray(yv, ctx=ctx))
             for w in tw:
                 if w._version > 0:
                     raise MXNetError(
@@ -202,8 +213,12 @@ class ShardedTrainer:
         self._ensure_built(xv, yv)
         xs = tuple(jax.device_put(v, s)
                    for v, s in zip(xv, self._x_sh))
-        return (xs if len(xs) > 1 else xs[0],
-                jax.device_put(yv, self._y_sh))
+        if self._y_multi:
+            ys = tuple(jax.device_put(v, s)
+                       for v, s in zip(yv, self._y_sh))
+        else:
+            ys = jax.device_put(yv, self._y_sh)
+        return (xs if len(xs) > 1 else xs[0], ys)
 
     def step(self, x, y, batch_size: Optional[int] = None):
         """Run one sharded train step; returns the (device) mean loss.
@@ -218,13 +233,24 @@ class ShardedTrainer:
                 f"step() got {len(xv)} inputs but the trainer was built "
                 f"with {len(self._x_sh)} — optional inputs must be passed "
                 f"consistently from the first call")
+        if isinstance(yv, tuple) != self._y_multi or \
+                (self._y_multi and len(yv) != len(self._y_sh)):
+            want = (f"a tuple of {len(self._y_sh)} label streams"
+                    if self._y_multi else "a single label array")
+            raise MXNetError(
+                f"step() label structure changed: the trainer was built "
+                f"with {want} — labels must keep the first call's shape")
         if batch_size is None:
             batch_size = int(xv[0].shape[0])
         self._t += 1
         self._optimizer.num_update = self._t
         key = _grandom.next_key()
         xv = tuple(jax.device_put(v, s) for v, s in zip(xv, self._x_sh))
-        yv = jax.device_put(yv, self._y_sh)
+        if self._y_multi:
+            yv = tuple(jax.device_put(v, s)
+                       for v, s in zip(yv, self._y_sh))
+        else:
+            yv = jax.device_put(yv, self._y_sh)
         t = jnp.asarray(self._t, dtype=jnp.int32)
         lr = jnp.asarray(self._optimizer.learning_rate, dtype=jnp.float32)
         rescale = jnp.asarray(self._scale / batch_size, dtype=jnp.float32)
@@ -358,14 +384,22 @@ def _np_to_dev(val, ctx):
 
 
 def _to_val(y):
-    """Normalize ONE label array: unlike inputs, a python list here is one
-    array of values, not a tuple of separate label streams."""
+    """Normalize the label side.  A TUPLE means multiple label streams
+    (e.g. BERT pretraining: mlm_labels, mlm_weights, nsp_labels) — each is
+    normalized and the tuple preserved; a python LIST stays one array of
+    values (reference mx.nd.array(list) semantics)."""
     import jax
-    if isinstance(y, NDArray):
-        return y._read()
-    if isinstance(y, jax.Array):
-        return y
-    return _np.asarray(y)
+
+    def one(v):
+        if isinstance(v, NDArray):
+            return v._read()
+        if isinstance(v, jax.Array):
+            return v
+        return _np.asarray(v)
+
+    if isinstance(y, tuple):
+        return tuple(one(v) for v in y)
+    return one(y)
 
 
 def _to_vals(x):

@@ -275,3 +275,33 @@ def test_sharded_trainer_checkpoint_resume(tmp_path):
     import os
     dirs = sorted(os.listdir(tmp_path / "ckpt"))
     assert dirs == ["state-00000005", "state-00000008"]
+
+
+def test_sharded_trainer_tuple_labels():
+    """Multi-stream labels (BERT pretraining shape: mlm labels + weights +
+    nsp labels) shard element-wise and reach the loss as a tuple."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import gluon, nd
+    from mxnet_tpu import parallel as par
+
+    net = gluon.nn.Dense(4, flatten=False)
+    net.initialize()
+
+    def loss_fn(out, ys):
+        lab, w = ys
+        return nd.sum(nd.square(out - lab) * w) / nd.maximum(
+            nd.sum(w), nd.array(np.array(1.0, np.float32)))
+
+    tr = par.ShardedTrainer(net, loss_fn, "sgd", {"learning_rate": 0.2})
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((8, 4, 6)).astype(np.float32)
+    w_true = rng.standard_normal((6, 4)).astype(np.float32)
+    lab = x @ w_true                    # learnable target
+    w = (rng.random((8, 4, 4)) < 0.5).astype(np.float32)
+    # the loss is already weight-normalized; batch_size=1 keeps the
+    # trainer's 1/batch rescale from shrinking the effective lr
+    l0 = float(tr.step(x, (lab, w), batch_size=1).asnumpy())
+    for _ in range(60):
+        loss = tr.step(x, (lab, w), batch_size=1)
+    l1 = float(loss.asnumpy())
+    assert l1 < 0.2 * l0, (l0, l1)
