@@ -148,7 +148,11 @@ class NDArrayIter(DataIter):
         self.shuffle = shuffle
         self.last_batch_handle = last_batch_handle
         self.idx = _np.arange(self.num_data)
-        self._rng = _np.random.default_rng()
+        # seeded from the GLOBAL numpy stream so mx.random.seed()/the test
+        # harness's per-test seeding controls shuffle order (reference
+        # parity: the C++ iterators draw from the seeded global RNG)
+        self._rng = _np.random.default_rng(
+            _np.random.randint(0, 2 ** 31))
         self.cursor = -batch_size
         self._carry = _np.empty(0, dtype=_np.int64)
         self._epoch_idx = self.idx

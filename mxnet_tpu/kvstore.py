@@ -73,8 +73,16 @@ def _reduce_collective(values: List[NDArray]) -> NDArray:
         return _reduce(values)
     import jax
 
-    stacked = jax.device_put_sharded([v._read()[None] for v in values],
-                                     devs)
+    # one shard per pushing device (jax.device_put_sharded is deprecated;
+    # the explicit-sharding constructor is its modern spelling)
+    import numpy as _np
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec
+    mesh = Mesh(_np.array(devs), ("kv",))
+    sharding = NamedSharding(mesh, PartitionSpec("kv"))
+    shards = [jax.device_put(v._read()[None], d)
+              for v, d in zip(values, devs)]
+    stacked = jax.make_array_from_single_device_arrays(
+        (len(devs),) + tuple(values[0].shape), sharding, shards)
     fn = _psum_fn(tuple(devs))
     # the psum result is replicated over the mesh; commit one copy to the
     # first pusher's device so downstream (server-side optimizer) sees a

@@ -22,10 +22,10 @@ from mxnet_tpu.base import force_cpu_mesh  # noqa: E402
 if os.environ.get("MXNET_TEST_ON_TPU", "") != "1":
     force_cpu_mesh(8)
 
-import zlib  # noqa: E402
-
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+from tests._seedutil import attach_replay_section, test_seed  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
@@ -38,27 +38,13 @@ def _seed_everything(request):
     is printed on failure so `MXNET_TEST_SEED=<n> pytest <nodeid>` replays
     the exact failing draw — both halves of the @with_seed contract.
     """
-    np.random.seed(_test_seed(request.node.nodeid))
+    np.random.seed(test_seed(request.node.nodeid))
     import mxnet_tpu as mx
-    mx.random.seed(_test_seed(request.node.nodeid))
+    mx.random.seed(test_seed(request.node.nodeid))
     yield
-
-
-def _test_seed(nodeid: str) -> int:
-    env_seed = os.environ.get("MXNET_TEST_SEED")
-    return (int(env_seed) if env_seed
-            else zlib.crc32(nodeid.encode("utf-8")) % (2 ** 31))
 
 
 @pytest.hookimpl(hookwrapper=True)
 def pytest_runtest_makereport(item, call):
-    """Attach the replay command to the FAILING report itself (a fixture-
-    teardown stderr write is swallowed by capture — the call-phase report is
-    finalized before teardown runs)."""
     outcome = yield
-    rep = outcome.get_result()
-    if rep.when == "call" and rep.failed:
-        seed = _test_seed(item.nodeid)
-        rep.sections.append((
-            "mxnet_tpu seed",
-            "replay with: MXNET_TEST_SEED=%d pytest '%s'" % (seed, item.nodeid)))
+    attach_replay_section(item, outcome.get_result())

@@ -43,10 +43,21 @@ def pytest_collection_modifyitems(config, items):
             item.add_marker(skip)
 
 
+# one seed formula + failure-replay hook for both harnesses (the shared
+# module is import-side-effect free: it must not trigger tests/conftest's
+# CPU forcing here)
+from tests._seedutil import attach_replay_section, test_seed  # noqa: E402
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_makereport(item, call):
+    outcome = yield
+    attach_replay_section(item, outcome.get_result())
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything(request):
-    seed = os.environ.get("MXNET_TEST_SEED")
-    seed = int(seed) if seed else abs(hash(request.node.nodeid)) % (2 ** 31)
+    seed = test_seed(request.node.nodeid)
     np.random.seed(seed)
     try:
         from mxnet_tpu import random as _r
