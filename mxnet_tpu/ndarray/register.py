@@ -91,16 +91,22 @@ class Operator:
         wrapper = lambda *p: jax.vjp(fn, *p)   # noqa: E731
         return jax.jit(wrapper) if self.use_jit else wrapper
 
-    def get_vjp_fn(self, kwargs: Dict[str, Any]) -> Callable:
+    def get_vjp_fn(self, kwargs: Dict[str, Any]) -> Tuple[Callable, bool]:
+        """Returns (wrapper, runner_safe).  runner_safe is True ONLY for
+        the jitted cached wrapper: its returned vjp closures have a
+        STABLE pytree treedef across calls, so backward()'s jitted
+        runner caches one compiled backward per signature.  The other
+        paths produce fresh-treedef Partials or plain closures — running
+        those through the runner would recompile every backward."""
         if self.vjp_maker is not None:
             # hand-built (primals -> (outs, vjp_fn)) wrapper — the escape
             # hatch for ops whose output shape depends on input VALUES
             # (jax.vjp cannot trace those); they run eagerly by
             # construction, so no jit cache applies
-            return self.vjp_maker(**kwargs)
+            return self.vjp_maker(**kwargs), False
         kwkey = tuple(sorted((k, _canon(v)) for k, v in kwargs.items()))
         try:
-            return self._vjp_cached(kwkey)
+            return self._vjp_cached(kwkey), self.use_jit
         except TypeError:
             # unhashable kwargs: uncached — a fresh jax.jit here would be
             # a guaranteed cache miss (keyed on callable identity), i.e.
@@ -108,7 +114,7 @@ class Operator:
             # per-primitive caches is the cheaper fallback
             import jax
             fn = self.maker(**kwargs)
-            return lambda *p: jax.vjp(fn, *p)
+            return (lambda *p: jax.vjp(fn, *p)), False
 
 
 def register_op(name: str, maker: Optional[Callable] = None, *,
@@ -206,7 +212,8 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
     _timed = bool(eng._listeners)
     _t0 = _perf_counter() if _timed else 0.0
     if recording:
-        out_vals, vjp_fn = op.get_vjp_fn(kwargs)(*in_vals)
+        vjp_wrapper, runner_safe = op.get_vjp_fn(kwargs)
+        out_vals, vjp_fn = vjp_wrapper(*in_vals)
     else:
         out_vals = op.get_fn(kwargs)(*in_vals)
     _dispatch_us = (_perf_counter() - _t0) * 1e6 if _timed else 0.0
@@ -218,7 +225,8 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
     if recording:
         parents = [getattr(x, "_ag", None) for x in nd_inputs]
         node = _autograd.TapeNode(op.name, vjp_fn, parents,
-                                  [(o.shape, o.dtype) for o in outs], multi)
+                                  [(o.shape, o.dtype) for o in outs], multi,
+                                  runner_safe=runner_safe)
         for i, o in enumerate(outs):
             o._ag = _autograd.AGInfo(node=node, index=i)
 
