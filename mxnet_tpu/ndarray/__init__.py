@@ -33,15 +33,15 @@ from . import ops_control_flow as _ops_cf        # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401
 
-_mod = _sys.modules[__name__]
+_this_module = _sys.modules[__name__]
 
 for _name in list_ops():
-    if not hasattr(_mod, _name):
-        setattr(_mod, _name, make_frontend(get_op(_name)))
+    if not hasattr(_this_module, _name):
+        setattr(_this_module, _name, make_frontend(get_op(_name)))
 # aliases registered under alternative names
 for _name, _op in list(_register_mod._registry.items()):
-    if not hasattr(_mod, _name):
-        setattr(_mod, _name, make_frontend(_op))
+    if not hasattr(_this_module, _name):
+        setattr(_this_module, _name, make_frontend(_op))
 
 
 # ---------------------------------------------------------------------------

@@ -906,6 +906,60 @@ def _register_round3b():
         return fn
     register_op("_contrib_getnnz", getnnz_maker, differentiable=False)
 
+    # ---- div_sqrt_dim (src/operator/contrib/transformer.cc): divide by
+    # sqrt of the last dim — the attention-scaling helper -----------------
+    def div_sqrt_dim_maker():
+        def fn(data):
+            return data / jnp.sqrt(jnp.asarray(data.shape[-1],
+                                               data.dtype))
+        return fn
+    register_op("_contrib_div_sqrt_dim", div_sqrt_dim_maker,
+                aliases=("div_sqrt_dim",))
+
+    # ---- _sample_unique_zipfian (sample_op.cc, the sampled-softmax
+    # candidate sampler): per batch row, n draws from Zipf(range_max) with
+    # rejection-dedup; returns (samples, num_tries).  Host-side sampling
+    # by design: data-dependent rejection loops do not belong under trace
+    # (same stance as boolean_mask), and candidates feed CPU-side lookup
+    # anyway ---------------------------------------------------------------
+    def sample_unique_zipfian_maker(range_max=None, shape=None):
+        import numpy as onp
+
+        from ..base import MXNetError
+        rm = int(range_max)
+        shp = tuple(int(s) for s in shape)
+        if shp[1] > rm:
+            raise MXNetError(
+                f"_sample_unique_zipfian: cannot draw {shp[1]} unique "
+                f"candidates from range_max={rm}")
+
+        def fn():
+            # seeded from the library key stream so mx.random.seed()
+            # covers this sampler like every other random op
+            from .. import random as _grandom
+            key_bits = onp.asarray(_grandom.next_key()).ravel()
+            rng = onp.random.default_rng(key_bits.astype(onp.uint32))
+            out = onp.empty(shp, onp.int64)
+            tries = onp.empty(shp[0], onp.int64)
+            log_rm1 = onp.log(rm + 1.0)
+            for b in range(shp[0]):
+                seen, t = [], 0
+                seen_set = set()
+                while len(seen) < shp[1]:
+                    # inverse-CDF zipfian: floor(exp(u*log(rm+1)))-1
+                    cand = int(onp.exp(rng.random() * log_rm1)) - 1
+                    cand = min(max(cand, 0), rm - 1)
+                    t += 1
+                    if cand not in seen_set:
+                        seen_set.add(cand)
+                        seen.append(cand)
+                out[b] = seen
+                tries[b] = t
+            return jnp.asarray(out), jnp.asarray(tries)
+        return fn
+    register_op("_sample_unique_zipfian", sample_unique_zipfian_maker,
+                differentiable=False, use_jit=False)
+
     # ---- backward_gradientmultiplier (gradient_multiplier_op.cc): the
     # explicit backward of gradientmultiplier — a scalar scale ------------
     def backward_gradmult_maker(scalar=1.0):

@@ -117,11 +117,12 @@ def _register_binary():
     alias = {
         "broadcast_add": ("elemwise_add", "_plus", "broadcast_plus"),
         "broadcast_sub": ("elemwise_sub", "_minus", "broadcast_minus"),
-        "broadcast_mul": ("elemwise_mul",),
-        "broadcast_div": ("elemwise_div",),
+        "broadcast_mul": ("elemwise_mul", "_mul"),
+        "broadcast_div": ("elemwise_div", "_div"),
+        "broadcast_mod": ("_mod",),
         "broadcast_power": ("_power", "pow"),
-        "broadcast_maximum": ("maximum",),
-        "broadcast_minimum": ("minimum",),
+        "broadcast_maximum": ("maximum", "_maximum"),
+        "broadcast_minimum": ("minimum", "_minimum"),
     }
     for name, fn in binary.items():
         simple_op(name, fn, aliases=alias.get(name, ()))
@@ -141,6 +142,13 @@ def _register_binary():
         "broadcast_logical_and": ("logical_and",),
         "broadcast_logical_or": ("logical_or",),
         "broadcast_logical_xor": ("logical_xor",),
+        # same-shape elemwise duals (elemwise_binary_op_logic.cc)
+        "broadcast_equal": ("_equal",),
+        "broadcast_not_equal": ("_not_equal",),
+        "broadcast_greater": ("_greater",),
+        "broadcast_greater_equal": ("_greater_equal",),
+        "broadcast_lesser": ("_lesser",),
+        "broadcast_lesser_equal": ("_lesser_equal",),
     }
     for name, fn in cmps.items():
         simple_op(name, _cmp(fn), differentiable=False,

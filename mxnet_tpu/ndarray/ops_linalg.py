@@ -25,6 +25,7 @@ def _register():
             return alpha * jnp.matmul(av, bv) + beta * c
         return fn
     register_op("linalg_gemm", gemm_maker)
+    # linalg_gemm2 already lives in ops_matrix.py (batch_dot's sibling)
 
     def potrf_maker(lower=True):
         def fn(a):
@@ -142,6 +143,15 @@ def _register():
                 -1, out.shape[-1])
         return out
     simple_op("khatri_rao", khatri_rao_fn)
+
+    # reference canonical names are the underscore forms (_linalg_gemm
+    # etc. in src/operator/tensor/la_op.cc); public linalg_* are aliases
+    from .register import add_alias
+    for base in ("gemm", "gemm2", "potrf", "potri", "trsm", "trmm",
+                 "syrk", "gelqf", "sumlogdiag", "extractdiag", "makediag",
+                 "extracttrian", "maketrian", "inverse", "det",
+                 "slogdet"):
+        add_alias(f"linalg_{base}", f"_linalg_{base}")
 
 
 _register()

@@ -687,6 +687,92 @@ def _register():
         return op
     register_op("MakeLoss", make_loss_maker, aliases=("make_loss",))
 
+    # ---- creation ops (init_op.cc _zeros/_ones/_full/_arange/_linspace/
+    # _eye) — the registry forms behind mx.nd.zeros etc.; zero-input ops
+    # so language bindings can create through MXImperativeInvoke alone ---
+    def _creation(make):
+        def maker(shape=(), dtype="float32", value=0.0, start=0.0,
+                  stop=None, step=1.0, num=50, N=0, M=0, k=0,
+                  repeat=1, infer_range=False, ctx=None):
+            dt = jnp.dtype(dtype)
+
+            def fn():
+                return make(shape=tuple(int(s) for s in shape)
+                            if shape else (), dtype=dt,
+                            value=value, start=start, stop=stop,
+                            step=step, num=int(num), N=int(N), M=int(M),
+                            k=int(k), repeat=int(repeat))
+            return fn
+        return maker
+
+    register_op("_zeros", _creation(
+        lambda shape, dtype, **kw: jnp.zeros(shape, dtype)),
+        differentiable=False)
+    register_op("_ones", _creation(
+        lambda shape, dtype, **kw: jnp.ones(shape, dtype)),
+        differentiable=False)
+    register_op("_full", _creation(
+        lambda shape, dtype, value, **kw: jnp.full(shape, value, dtype)),
+        differentiable=False)
+
+    def _arange_impl(shape, dtype, start, stop, step, repeat, **kw):
+        if stop is None:                       # reference: [0, start)
+            start, stop = 0, start
+        out = jnp.arange(start, stop, step, dtype=dtype)
+        return jnp.repeat(out, repeat) if repeat > 1 else out
+    register_op("_arange", _creation(_arange_impl), differentiable=False)
+    register_op("_linspace", _creation(
+        lambda shape, dtype, start, stop, num, **kw:
+        jnp.linspace(start, stop, num, dtype=dtype)),
+        differentiable=False)
+    register_op("_eye", _creation(
+        lambda shape, dtype, N, M, k, **kw:
+        jnp.eye(N, M if M else None, k=k, dtype=dtype)),
+        differentiable=False)
+
+    # ---- _slice_assign / _slice_assign_scalar (matrix_op.cc — the
+    # functional write behind x[a:b] = y) ---------------------------------
+    def _assign_slices(begin, end, step, shape):
+        # None passes through to Python slice() (like the sibling `slice`
+        # op), which natively handles negative steps and open ends
+        idx = []
+        for i in range(len(shape)):
+            b = begin[i] if i < len(begin) else None
+            e = end[i] if i < len(end) else None
+            st = step[i] if i < len(step) else None
+            idx.append(slice(None if b is None else int(b),
+                             None if e is None else int(e),
+                             None if st is None else int(st)))
+        return tuple(idx)
+
+    def slice_assign_maker(begin=(), end=(), step=()):
+        def fn(lhs, rhs):
+            return lhs.at[_assign_slices(begin, end, step,
+                                         lhs.shape)].set(rhs)
+        return fn
+    register_op("_slice_assign", slice_assign_maker,
+                aliases=("_crop_assign",))
+
+    def slice_assign_scalar_maker(begin=(), end=(), step=(), scalar=0.0):
+        def fn(lhs):
+            return lhs.at[_assign_slices(begin, end, step,
+                                         lhs.shape)].set(
+                jnp.asarray(scalar, lhs.dtype))
+        return fn
+    register_op("_slice_assign_scalar", slice_assign_scalar_maker,
+                aliases=("_crop_assign_scalar",))
+
+    # ---- _onehot_encode (legacy ndarray_function.cc): row i gets a
+    # one-hot of indices[i] written into an out-shaped array --------------
+    def onehot_encode_maker():
+        def fn(indices, out):
+            oh = jax.nn.one_hot(indices.astype(jnp.int32), out.shape[1],
+                                dtype=out.dtype)
+            return oh
+        return fn
+    register_op("_onehot_encode", onehot_encode_maker,
+                differentiable=False)
+
     # ---- _scatter_set_nd (indexing_op.cc): functional write of rhs into
     # lhs at gather_nd-style indices — the storage op behind advanced
     # index assignment ----------------------------------------------------

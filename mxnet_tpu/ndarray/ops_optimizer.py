@@ -310,6 +310,24 @@ def _register():
     register_op("mp_nag_mom_update", mp_nag_mom_update_maker,
                 differentiable=False)
 
+    # ---- GroupAdaGrad (src/operator/contrib/optimizer_op.cc): AdaGrad
+    # with ONE history scalar per row (group) — the sparse-embedding
+    # optimizer of GluonNLP ------------------------------------------------
+    def group_adagrad_update_maker(epsilon=1e-5, rescale_grad=1.0,
+                                   clip_gradient=-1.0):
+        def fn(weight, grad, history, lr):
+            lr = lr.astype(weight.dtype)
+            g = _prep_grad(grad, 0.0, weight, rescale_grad, clip_gradient)
+            red_axes = tuple(range(1, g.ndim))
+            h_new = history + jnp.mean(jnp.square(g), axis=red_axes,
+                                       keepdims=True) if g.ndim > 1 \
+                else history + jnp.square(g)
+            denom = jnp.sqrt(h_new) + epsilon
+            return (weight - lr * g / denom, h_new)
+        return fn
+    register_op("_contrib_group_adagrad_update", group_adagrad_update_maker,
+                aliases=("group_adagrad_update",), differentiable=False)
+
     # ---- FTML (reference: src/operator/optimizer_op.cc ftml_update) -----
     def ftml_update_maker(beta1=0.6, beta2=0.999, epsilon=1e-8, t=1,
                           wd=0.0, rescale_grad=1.0, clip_grad=-1.0):

@@ -147,6 +147,19 @@ def simple_op(name: str, fn: Callable, **kw):
     register_op(name, lambda: fn, **kw)
 
 
+def add_alias(existing: str, *aliases: str) -> None:
+    """Point additional names at an already-registered op (reference: the
+    underscore canonical vs public-name dualities, e.g. _linalg_gemm /
+    linalg_gemm).  Subject to the same duplicate check as register_op."""
+    op = get_op(existing)
+    for a in aliases:
+        if a in _registry:
+            raise MXNetError(
+                f"operator name {a!r} is already registered "
+                f"(by {_registry[a].name!r})")
+        _registry[a] = op
+
+
 def get_op(name: str) -> Operator:
     op = _registry.get(name)
     if op is None:

@@ -845,3 +845,94 @@ def test_bilinear_resize_and_count_sketch():
     out = nd.contrib.count_sketch(nd.array(d), nd.array(h), nd.array(s),
                                   out_dim=2).asnumpy()
     np.testing.assert_allclose(out, [[4.0, 2.0]])  # 1+3, -2+4
+
+
+def test_creation_ops_registry_forms():
+    """reference: init_op.cc — the registry ops behind mx.nd.zeros etc.,
+    reachable through bare imperative invoke (the C-ABI creation path)."""
+    from mxnet_tpu.ndarray.register import invoke_by_name
+    z = invoke_by_name("_zeros", [], {"shape": (2, 3)})
+    assert z.shape == (2, 3) and float(z.asnumpy().sum()) == 0.0
+    o = invoke_by_name("_ones", [], {"shape": (4,), "dtype": "int32"})
+    assert o.asnumpy().tolist() == [1, 1, 1, 1]
+    f = invoke_by_name("_full", [], {"shape": (2,), "value": 2.5})
+    np.testing.assert_allclose(f.asnumpy(), [2.5, 2.5])
+    a = invoke_by_name("_arange", [], {"start": 5.0})
+    np.testing.assert_allclose(a.asnumpy(), np.arange(5))
+    a = invoke_by_name("_arange", [], {"start": 2.0, "stop": 8.0,
+                                       "step": 2.0})
+    np.testing.assert_allclose(a.asnumpy(), [2, 4, 6])
+    ls = invoke_by_name("_linspace", [], {"start": 0.0, "stop": 1.0,
+                                          "num": 5})
+    np.testing.assert_allclose(ls.asnumpy(), np.linspace(0, 1, 5))
+    e = invoke_by_name("_eye", [], {"N": 3, "k": 1})
+    np.testing.assert_allclose(e.asnumpy(), np.eye(3, k=1))
+
+
+def test_slice_assign_ops():
+    x = nd.zeros((4, 5))
+    y = nd.array(np.ones((2, 3), np.float32) * 7)
+    out = nd._slice_assign(x, y, begin=(1, 1), end=(3, 4)).asnumpy()
+    assert out[1:3, 1:4].sum() == 7 * 6 and out.sum() == 42
+    out = nd._slice_assign_scalar(x, begin=(0, 0), end=(2, 2),
+                                  scalar=3.0).asnumpy()
+    assert out[:2, :2].sum() == 12 and out.sum() == 12
+
+
+def test_group_adagrad_and_zipfian_and_div_sqrt_dim():
+    # group_adagrad: one history scalar per row
+    w = nd.array(np.ones((3, 4), np.float32))
+    g = nd.array(np.full((3, 4), 2.0, np.float32))
+    h = nd.zeros((3, 1))
+    w2, h2 = nd.contrib.group_adagrad_update(w, g, h, nd.array(0.1))
+    np.testing.assert_allclose(h2.asnumpy(), 4.0)  # mean(2^2) per row
+    np.testing.assert_allclose(
+        w2.asnumpy(), 1.0 - 0.1 * 2.0 / (2.0 + 1e-5), rtol=1e-5)
+
+    # zipfian candidate sampler: unique per row, in range, low ids favored
+    s, tries = nd._sample_unique_zipfian(range_max=1000, shape=(4, 50))
+    sv = s.asnumpy()
+    assert sv.shape == (4, 50)
+    for row in sv:
+        assert len(set(row.tolist())) == 50
+        assert row.min() >= 0 and row.max() < 1000
+    assert (tries.asnumpy() >= 50).all()
+    # zipf skew: the low third should dominate
+    assert (sv < 333).mean() > 0.5
+
+    x = nd.array(np.ones((2, 16), np.float32))
+    np.testing.assert_allclose(nd.contrib.div_sqrt_dim(x).asnumpy(),
+                               0.25, rtol=1e-6)
+
+
+def test_elemwise_underscore_duals_and_linalg_aliases():
+    a = nd.array(np.array([3.0, 1.0], np.float32))
+    b = nd.array(np.array([2.0, 5.0], np.float32))
+    np.testing.assert_allclose(nd._mul(a, b).asnumpy(), [6, 5])
+    np.testing.assert_allclose(nd._maximum(a, b).asnumpy(), [3, 5])
+    np.testing.assert_allclose(nd._mod(a, b).asnumpy(), [1, 1])
+    np.testing.assert_allclose(nd._greater(a, b).asnumpy(), [1, 0])
+    m = nd.array(np.array([[2.0, 0.0], [1.0, 3.0]], np.float32))
+    np.testing.assert_allclose(nd._linalg_det(m).asnumpy(), [6.0],
+                               rtol=1e-5)
+
+
+def test_zipfian_reproducible_and_validated():
+    import mxnet_tpu as mx
+    import pytest as _pt
+    from mxnet_tpu.base import MXNetError
+    mx.random.seed(7)
+    s1 = nd._sample_unique_zipfian(range_max=100, shape=(2, 10))[0].asnumpy()
+    mx.random.seed(7)
+    s2 = nd._sample_unique_zipfian(range_max=100, shape=(2, 10))[0].asnumpy()
+    np.testing.assert_array_equal(s1, s2)
+    with _pt.raises(MXNetError):
+        nd._sample_unique_zipfian(range_max=5, shape=(1, 10))
+
+
+def test_slice_assign_negative_step_and_open_ends():
+    x = nd.zeros((4,))
+    y = nd.array(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    out = nd._slice_assign(x, y, begin=(None,), end=(None,),
+                           step=(-1,)).asnumpy()
+    np.testing.assert_allclose(out, [4.0, 3.0, 2.0, 1.0])
