@@ -25,9 +25,18 @@ __all__ = ["KVStore", "create"]
 
 def _reduce(values: List[NDArray]) -> NDArray:
     """Sum replicas onto the first value's device (KVStoreLocal: serial
-    device-to-device adds, the reference CommCPU shape)."""
+    device-to-device adds, the reference CommCPU shape).  row_sparse
+    replicas aggregate over the UNION of their row sets and stay sparse
+    (reference: CommCPU::ReduceRowSparse) — the gradient never densifies
+    on the way to the server-side optimizer's lazy row update."""
+    from .sparse import BaseSparseNDArray, elemwise_add
     if len(values) == 1:
         return values[0]
+    if isinstance(values[0], BaseSparseNDArray):
+        acc = values[0]
+        for v in values[1:]:
+            acc = elemwise_add(acc, v)
+        return acc
     acc = values[0].copy()
     for v in values[1:]:
         acc += v.as_in_context(acc.context)
@@ -62,7 +71,12 @@ def _psum_fn(devs: tuple):
 def _reduce_collective(values: List[NDArray]) -> NDArray:
     """Device-mode reduce: ONE in-graph psum across the values' devices
     (used by kvstore 'device'/'nccl' when replicas sit on distinct
-    devices); falls back to the serial path otherwise."""
+    devices); falls back to the serial path otherwise.  Sparse replicas
+    always take the serial union path — their structure algebra is
+    host-side, and a dense psum of row-sparse grads would densify."""
+    from .sparse import BaseSparseNDArray
+    if any(isinstance(v, BaseSparseNDArray) for v in values):
+        return _reduce(values)
     devs = []
     for v in values:
         d = v.context.device

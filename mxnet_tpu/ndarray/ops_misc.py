@@ -689,46 +689,69 @@ def _register():
 
     # ---- creation ops (init_op.cc _zeros/_ones/_full/_arange/_linspace/
     # _eye) — the registry forms behind mx.nd.zeros etc.; zero-input ops
-    # so language bindings can create through MXImperativeInvoke alone ---
-    def _creation(make):
-        def maker(shape=(), dtype="float32", value=0.0, start=0.0,
-                  stop=None, step=1.0, num=50, N=0, M=0, k=0,
-                  repeat=1, infer_range=False, ctx=None):
-            dt = jnp.dtype(dtype)
+    # so language bindings can create through MXImperativeInvoke alone.
+    # Each op declares exactly its own parameters (bad kwargs error out)
+    # and honors ``ctx`` via device placement — the reference ops carry
+    # ctx as an op attribute for exactly this binding path -----------------
+    def _place(fn_make, ctx):
+        if ctx is None:
+            return fn_make
+        from ..context import Context
 
-            def fn():
-                return make(shape=tuple(int(s) for s in shape)
-                            if shape else (), dtype=dt,
-                            value=value, start=start, stop=stop,
-                            step=step, num=int(num), N=int(N), M=int(M),
-                            k=int(k), repeat=int(repeat))
-            return fn
-        return maker
+        def parse(c):
+            if isinstance(c, Context):
+                return c
+            s = str(c)
+            if "(" in s:
+                kind, _, idx = s.partition("(")
+                return Context(kind, int(idx.rstrip(")")))
+            return Context(s, 0)
+        dev = parse(ctx).device
 
-    register_op("_zeros", _creation(
-        lambda shape, dtype, **kw: jnp.zeros(shape, dtype)),
-        differentiable=False)
-    register_op("_ones", _creation(
-        lambda shape, dtype, **kw: jnp.ones(shape, dtype)),
-        differentiable=False)
-    register_op("_full", _creation(
-        lambda shape, dtype, value, **kw: jnp.full(shape, value, dtype)),
-        differentiable=False)
+        def placed():
+            import jax
+            return jax.device_put(fn_make(), dev)
+        return placed
 
-    def _arange_impl(shape, dtype, start, stop, step, repeat, **kw):
-        if stop is None:                       # reference: [0, start)
-            start, stop = 0, start
-        out = jnp.arange(start, stop, step, dtype=dtype)
-        return jnp.repeat(out, repeat) if repeat > 1 else out
-    register_op("_arange", _creation(_arange_impl), differentiable=False)
-    register_op("_linspace", _creation(
-        lambda shape, dtype, start, stop, num, **kw:
-        jnp.linspace(start, stop, num, dtype=dtype)),
-        differentiable=False)
-    register_op("_eye", _creation(
-        lambda shape, dtype, N, M, k, **kw:
-        jnp.eye(N, M if M else None, k=k, dtype=dtype)),
-        differentiable=False)
+    def zeros_maker(shape=(), dtype="float32", ctx=None):
+        shp, dt = tuple(int(s) for s in shape), jnp.dtype(dtype)
+        return _place(lambda: jnp.zeros(shp, dt), ctx)
+    register_op("_zeros", zeros_maker, differentiable=False)
+
+    def ones_maker(shape=(), dtype="float32", ctx=None):
+        shp, dt = tuple(int(s) for s in shape), jnp.dtype(dtype)
+        return _place(lambda: jnp.ones(shp, dt), ctx)
+    register_op("_ones", ones_maker, differentiable=False)
+
+    def full_maker(shape=(), dtype="float32", value=0.0, ctx=None):
+        shp, dt = tuple(int(s) for s in shape), jnp.dtype(dtype)
+        return _place(lambda: jnp.full(shp, value, dt), ctx)
+    register_op("_full", full_maker, differentiable=False)
+
+    def arange_maker(start=0.0, stop=None, step=1.0, repeat=1,
+                     infer_range=False, dtype="float32", ctx=None):
+        dt = jnp.dtype(dtype)
+        lo, hi = (0, start) if stop is None else (start, stop)
+
+        def make():
+            out = jnp.arange(lo, hi, step, dtype=dt)
+            return jnp.repeat(out, int(repeat)) if repeat > 1 else out
+        return _place(make, ctx)
+    register_op("_arange", arange_maker, differentiable=False)
+
+    def linspace_maker(start=0.0, stop=1.0, num=50, endpoint=True,
+                       dtype="float32", ctx=None):
+        dt = jnp.dtype(dtype)
+        return _place(lambda: jnp.linspace(start, stop, int(num),
+                                           endpoint=endpoint, dtype=dt),
+                      ctx)
+    register_op("_linspace", linspace_maker, differentiable=False)
+
+    def eye_maker(N=0, M=0, k=0, dtype="float32", ctx=None):
+        dt = jnp.dtype(dtype)
+        return _place(lambda: jnp.eye(int(N), int(M) if M else None,
+                                      k=int(k), dtype=dt), ctx)
+    register_op("_eye", eye_maker, differentiable=False)
 
     # ---- _slice_assign / _slice_assign_scalar (matrix_op.cc — the
     # functional write behind x[a:b] = y) ---------------------------------

@@ -212,7 +212,19 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
             ctx = x.context
             break
     if ctx is None:
-        ctx = current_context()
+        # zero-input creation ops carry ctx as an op attribute
+        # (reference init_op.cc convention) — honor it for the tag too
+        ckw = kwargs.get("ctx")
+        if ckw is not None:
+            from ..context import Context
+            if isinstance(ckw, Context):
+                ctx = ckw
+            else:
+                s = str(ckw)
+                kind, _, idx = s.partition("(")
+                ctx = Context(kind, int(idx.rstrip(")")) if idx else 0)
+        else:
+            ctx = current_context()
     nd_inputs = [_as_nd(x, ctx) for x in inputs]
     in_vals = [x._read() for x in nd_inputs]
 

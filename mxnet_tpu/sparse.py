@@ -78,6 +78,17 @@ class BaseSparseNDArray:
     def copyto(self, other):
         self.todense().copyto(other)
 
+    def copy(self):
+        raise NotImplementedError            # per-subclass deep copy
+
+    def as_in_context(self, ctx):
+        # sparse structure lives host-side; only the context tag moves
+        if ctx == self._ctx:
+            return self
+        out = self.copy()
+        out._ctx = ctx
+        return out
+
     def __repr__(self):
         return (f"<{type(self).__name__} {self._shape} "
                 f"{self._dtype.name} @{self._ctx}>")
@@ -173,6 +184,10 @@ class CSRNDArray(BaseSparseNDArray):
         return sp_csr((self.data, self.indices, self.indptr),
                       shape=self._shape)
 
+    def copy(self) -> "CSRNDArray":
+        return CSRNDArray(self.data.copy(), self.indices.copy(),
+                          self.indptr.copy(), self._shape, ctx=self._ctx)
+
     def __getitem__(self, key) -> "CSRNDArray":
         if isinstance(key, slice):
             start, stop, step = key.indices(self._shape[0])
@@ -216,6 +231,10 @@ class RowSparseNDArray(BaseSparseNDArray):
         keep = _np.asarray(indices, dtype=_np.int64)
         mask = _np.isin(self.indices, keep)
         return RowSparseNDArray(self.data[mask], self.indices[mask],
+                                self._shape, ctx=self._ctx)
+
+    def copy(self) -> "RowSparseNDArray":
+        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
                                 self._shape, ctx=self._ctx)
 
 

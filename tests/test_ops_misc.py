@@ -936,3 +936,13 @@ def test_slice_assign_negative_step_and_open_ends():
     out = nd._slice_assign(x, y, begin=(None,), end=(None,),
                            step=(-1,)).asnumpy()
     np.testing.assert_allclose(out, [4.0, 3.0, 2.0, 1.0])
+
+
+def test_creation_ops_honor_ctx_and_reject_bad_kwargs():
+    import mxnet_tpu as mx
+    from mxnet_tpu.ndarray.register import invoke_by_name
+    z = invoke_by_name("_zeros", [], {"shape": (2,), "ctx": "cpu(0)"})
+    assert z.context == mx.cpu(0)
+    import pytest as _pt
+    with _pt.raises(TypeError):
+        invoke_by_name("_zeros", [], {"shape": (2,), "start": 5.0})

@@ -247,3 +247,71 @@ def test_duplicate_op_registration_rejected():
     from mxnet_tpu.ndarray.register import register_op
     with _pt.raises(MXNetError):
         register_op("broadcast_add", lambda: (lambda x, y: x + y))
+
+
+def test_kvstore_row_sparse_push_and_pull():
+    """Reference: KVStoreLocal sparse push (CommCPU::ReduceRowSparse) +
+    server-side lazy row update + PullRowSparse."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+
+    kv = mx.kv.create("local")
+    V, D = 10, 4
+    w0 = np.ones((V, D), np.float32)
+    kv.init(3, nd.array(w0))
+    kv.set_optimizer(mx.optimizer.SGD(learning_rate=0.5))
+
+    # two replicas' sparse grads: rows {1,3} and {3,7} -> union {1,3,7}
+    g1 = sparse.row_sparse_array((np.full((2, D), 1.0, np.float32),
+                                  np.array([1, 3])), shape=(V, D))
+    g2 = sparse.row_sparse_array((np.full((2, D), 2.0, np.float32),
+                                  np.array([3, 7])), shape=(V, D))
+    kv.push(3, [g1, g2])
+
+    out = nd.zeros((V, D))
+    kv.pull(3, out)
+    w = out.asnumpy()
+    np.testing.assert_allclose(w[1], 1.0 - 0.5 * 1.0)   # only g1
+    np.testing.assert_allclose(w[3], 1.0 - 0.5 * 3.0)   # summed
+    np.testing.assert_allclose(w[7], 1.0 - 0.5 * 2.0)   # only g2
+    np.testing.assert_allclose(w[0], 1.0)               # untouched row
+
+    # row_sparse_pull returns exactly the requested rows
+    from mxnet_tpu.sparse import RowSparseNDArray
+    dst = sparse.zeros("row_sparse", (V, D))
+    got = kv.row_sparse_pull(3, out=dst, row_ids=nd.array(
+        np.array([3, 7], np.float32)))
+    rs = got if isinstance(got, RowSparseNDArray) else dst
+    np.testing.assert_allclose(rs.todense().asnumpy()[3], w[3])
+    np.testing.assert_allclose(rs.todense().asnumpy()[7], w[7])
+    assert rs.todense().asnumpy()[1].sum() == 0  # not requested
+
+
+def test_sparse_copy_and_context_roundtrip():
+    a = np.eye(4, dtype=np.float32)
+    r = sparse.row_sparse_array(a)
+    c = r.copy()
+    c.data[0, 0] = 99.0
+    assert r.todense().asnumpy()[0, 0] == 1.0   # deep copy
+    import mxnet_tpu as mx
+    moved = r.as_in_context(mx.cpu(0))
+    np.testing.assert_allclose(moved.todense().asnumpy(), a)
+
+
+def test_kvstore_device_sparse_push_serial_union():
+    """'device' kvstore with sparse replicas must take the serial union
+    path, not the dense psum collective (review regression)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    kv = mx.kv.create("device")
+    kv.init(1, nd.zeros((6, 2)))
+    g1 = sparse.row_sparse_array((np.ones((1, 2), np.float32),
+                                  np.array([0])), shape=(6, 2))
+    g2 = sparse.row_sparse_array((np.ones((1, 2), np.float32) * 2,
+                                  np.array([4])), shape=(6, 2))
+    kv.push(1, [g1, g2])
+    out = nd.zeros((6, 2))
+    kv.pull(1, out)
+    w = out.asnumpy()
+    np.testing.assert_allclose(w[0], 1.0)
+    np.testing.assert_allclose(w[4], 2.0)
