@@ -42,6 +42,14 @@ class Context:
         self.device_type = device_type
         self.device_id = int(device_id)
 
+    @classmethod
+    def from_str(cls, s: str) -> "Context":
+        """Parse 'cpu(0)' / 'tpu(1)' / 'cpu' (the reference's repr form)."""
+        s = str(s).strip()
+        kind, _, idx = s.partition("(")
+        idx = idx.rstrip(")").strip()
+        return cls(kind.strip(), int(idx) if idx else 0)
+
     # -- resolution --------------------------------------------------------
     @property
     def device(self):

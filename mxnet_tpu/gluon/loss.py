@@ -12,7 +12,8 @@ from .block import HybridBlock
 __all__ = ["Loss", "L2Loss", "L1Loss", "SigmoidBinaryCrossEntropyLoss",
            "SigmoidBCELoss", "SoftmaxCrossEntropyLoss", "SoftmaxCELoss",
            "KLDivLoss", "HuberLoss", "HingeLoss", "SquaredHingeLoss",
-           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss", "CTCLoss"]
+           "LogisticLoss", "TripletLoss", "CosineEmbeddingLoss", "CTCLoss",
+           "PoissonNLLLoss", "SDMLLoss"]
 
 
 def _apply_weighting(F, loss, weight=None, sample_weight=None):
@@ -227,6 +228,63 @@ class CosineEmbeddingLoss(Loss):
         loss = F.where(label == 1, pos, neg)
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return loss
+
+
+class PoissonNLLLoss(Loss):
+    """Poisson negative log likelihood (reference gluon.loss.PoissonNLLLoss):
+    ``pred`` is the predicted MEAN (or its log when ``from_logits``);
+    optional Stirling approximation adds the target-dependent constant."""
+
+    def __init__(self, weight=None, from_logits=True, batch_axis=0,
+                 compute_full=False, **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._from_logits = from_logits
+        self._compute_full = compute_full
+
+    def hybrid_forward(self, F, pred, target, sample_weight=None,
+                       epsilon=1e-08):
+        target = _reshape_like(F, target, pred)
+        if self._from_logits:
+            loss = F.exp(pred) - target * pred
+        else:
+            loss = pred - target * F.log(pred + epsilon)
+        if self._compute_full:
+            # Stirling: t*log(t) - t + 0.5*log(2*pi*t), for t > 1
+            import math
+            stirling = target * F.log(target + epsilon) - target \
+                + 0.5 * F.log(2 * math.pi * (target + epsilon))
+            loss = loss + F.where(target > 1.0, stirling,
+                                  F.zeros_like(target))
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
+
+
+class SDMLLoss(Loss):
+    """Smoothed deep metric learning loss (reference
+    gluon.loss.SDMLLoss): treats the i-th rows of two batches as the only
+    positive pair among 2N candidates and cross-entropies a smoothed
+    target against the negated-distance softmax."""
+
+    def __init__(self, smoothing_parameter=0.3, weight=1.0, batch_axis=0,
+                 **kwargs):
+        super().__init__(weight, batch_axis, **kwargs)
+        self._smoothing = smoothing_parameter
+
+    def hybrid_forward(self, F, x1, x2, sample_weight=None):
+        n = x1.shape[0]
+        # pairwise SQUARED euclidean distances (N, N) — the reference's
+        # _compute_distances has no sqrt; the softmax logits are -d²
+        d = F.sum(F.square(
+            F.expand_dims(x1, axis=1) - F.expand_dims(x2, axis=0)),
+            axis=-1)
+        logp = F.log_softmax(-d, axis=-1)
+        # smoothed one-hot: (1-s) on the diagonal, s/(N-1) elsewhere
+        eye = F.one_hot(F.arange(n, dtype="int32"), depth=n)
+        smooth = eye * (1.0 - self._smoothing) \
+            + (1.0 - eye) * (self._smoothing / max(n - 1, 1))
+        loss = -F.sum(smooth * logp, axis=-1)
+        loss = _apply_weighting(F, loss, self._weight, sample_weight)
+        return F.mean(loss)
 
 
 class CTCLoss(Loss):

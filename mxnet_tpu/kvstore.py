@@ -32,7 +32,12 @@ def _reduce(values: List[NDArray]) -> NDArray:
     from .sparse import BaseSparseNDArray, elemwise_add
     if len(values) == 1:
         return values[0]
-    if isinstance(values[0], BaseSparseNDArray):
+    n_sparse = sum(isinstance(v, BaseSparseNDArray) for v in values)
+    if n_sparse:
+        if n_sparse != len(values):
+            raise MXNetError(
+                "kvstore push got mixed dense and sparse replicas for one "
+                "key — all replicas of a key must share a storage type")
         acc = values[0]
         for v in values[1:]:
             acc = elemwise_add(acc, v)

@@ -697,16 +697,8 @@ def _register():
         if ctx is None:
             return fn_make
         from ..context import Context
-
-        def parse(c):
-            if isinstance(c, Context):
-                return c
-            s = str(c)
-            if "(" in s:
-                kind, _, idx = s.partition("(")
-                return Context(kind, int(idx.rstrip(")")))
-            return Context(s, 0)
-        dev = parse(ctx).device
+        dev = (ctx if isinstance(ctx, Context)
+               else Context.from_str(ctx)).device
 
         def placed():
             import jax

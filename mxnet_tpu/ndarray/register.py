@@ -217,12 +217,7 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
         ckw = kwargs.get("ctx")
         if ckw is not None:
             from ..context import Context
-            if isinstance(ckw, Context):
-                ctx = ckw
-            else:
-                s = str(ckw)
-                kind, _, idx = s.partition("(")
-                ctx = Context(kind, int(idx.rstrip(")")) if idx else 0)
+            ctx = ckw if isinstance(ckw, Context) else Context.from_str(ckw)
         else:
             ctx = current_context()
     nd_inputs = [_as_nd(x, ctx) for x in inputs]

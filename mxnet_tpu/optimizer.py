@@ -20,7 +20,8 @@ from .ndarray import NDArray, zeros as nd_zeros, array as nd_array
 from .ndarray.register import invoke_by_name
 
 __all__ = ["Optimizer", "SGD", "NAG", "Adam", "AdaGrad", "RMSProp", "Ftrl",
-           "Signum", "AdaDelta", "AdamW", "LARS", "LBSGD", "register",
+           "Signum", "AdaDelta", "AdamW", "LARS", "LBSGD", "Adamax",
+           "Nadam", "SGLD", "DCASGD", "FTML", "LAMB", "register",
            "create", "Updater", "get_updater"]
 
 _registry: Dict[str, type] = {}
@@ -835,3 +836,152 @@ def _states_from_np(state):
 
 def get_updater(optimizer: Optimizer) -> Updater:
     return Updater(optimizer)
+
+
+# ---------------------------------------------------------------------------
+# python-composed optimizers (reference optimizer.py implements these from
+# primitive ops too — no fused kernels upstream either)
+# ---------------------------------------------------------------------------
+
+def _prepped(opt: Optimizer, index, grad, weight, with_wd=True):
+    """Python-composed-optimizer gradient prep.  NOTE the order differs
+    from the fused kernels' _prep_grad: the reference's python optimizers
+    (Adamax/Nadam/...) add wd*weight FIRST and clip the SUM, while its
+    C++ update kernels clip first — both conventions are mirrored
+    faithfully on their respective paths."""
+    g = grad * opt.rescale_grad
+    if with_wd:
+        wd = opt._get_wd(index)
+        if wd:
+            g = g + wd * weight
+    if opt.clip_gradient is not None:
+        from .ndarray import clip as nd_clip
+        g = nd_clip(g, a_min=-opt.clip_gradient, a_max=opt.clip_gradient)
+    return g
+
+
+@register
+class Adamax(Optimizer):
+    """AdaMax (reference optimizer.py Adamax — Adam with the ∞-norm)."""
+
+    def __init__(self, learning_rate=0.002, beta1=0.9, beta2=0.999,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        from .ndarray import abs as nd_abs, maximum as nd_maximum
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index) / (1.0 - self.beta1 ** t)
+        g = _prepped(self, index, grad, weight)
+        m, u = state
+        m_new = self.beta1 * m + (1.0 - self.beta1) * g
+        u_new = nd_maximum(self.beta2 * u, nd_abs(g))
+        m._set_data(m_new._read())
+        u._set_data(u_new._read())
+        weight._set_data((weight - lr * m_new / (u_new + 1e-8))._read())
+
+
+@register
+class Nadam(Optimizer):
+    """Nesterov Adam (reference optimizer.py Nadam — Adam with the
+    momentum schedule of Dozat 2016)."""
+
+    def __init__(self, learning_rate=0.001, beta1=0.9, beta2=0.999,
+                 epsilon=1e-8, schedule_decay=0.004, **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.beta1, self.beta2 = beta1, beta2
+        self.epsilon = epsilon
+        self.schedule_decay = schedule_decay
+        self.m_schedule = 1.0
+
+    def create_state(self, index, weight):
+        return (nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype),
+                nd_zeros(weight.shape, ctx=weight.context,
+                         dtype=weight.dtype))
+
+    def update(self, index, weight, grad, state):
+        from .ndarray import sqrt as nd_sqrt
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr = self._get_lr(index)
+        g = _prepped(self, index, grad, weight)
+        mu_t = self.beta1 * (1.0 - 0.5 * 0.96 ** (t * self.schedule_decay))
+        mu_t1 = self.beta1 * (1.0 - 0.5 * 0.96 **
+                              ((t + 1) * self.schedule_decay))
+        self.m_schedule = self.m_schedule * mu_t
+        m_schedule_next = self.m_schedule * mu_t1
+        m, v = state
+        m_new = self.beta1 * m + (1.0 - self.beta1) * g
+        v_new = self.beta2 * v + (1.0 - self.beta2) * g * g
+        g_prime = g / (1.0 - self.m_schedule)
+        m_prime = m_new / (1.0 - m_schedule_next)
+        v_prime = v_new / (1.0 - self.beta2 ** t)
+        m_bar = (1.0 - mu_t) * g_prime + mu_t1 * m_prime
+        m._set_data(m_new._read())
+        v._set_data(v_new._read())
+        weight._set_data(
+            (weight - lr * m_bar / (nd_sqrt(v_prime) + self.epsilon))
+            ._read())
+
+
+@register
+class SGLD(Optimizer):
+    """Stochastic Gradient Langevin Dynamics (reference optimizer.py
+    SGLD): gradient step + N(0, sqrt(lr)) noise — the sampling optimizer."""
+
+    def create_state(self, index, weight):
+        return None
+
+    def update(self, index, weight, grad, state):
+        from .ndarray import random as nd_random
+        self._update_count(index)
+        lr = self._get_lr(index)
+        g = _prepped(self, index, grad, weight)
+        noise = nd_random.normal(0.0, _np.sqrt(lr), shape=weight.shape,
+                                 ctx=weight.context, dtype=weight.dtype)
+        weight._set_data((weight - 0.5 * lr * g + noise)._read())
+
+
+@register
+class DCASGD(Optimizer):
+    """Delay-compensated async SGD (reference optimizer.py DCASGD):
+    compensates stale gradients with the Taylor term
+    ``lambda * g² * (w - w_prev)``."""
+
+    def __init__(self, learning_rate=0.01, momentum=0.0, lamda=0.04,
+                 **kwargs):
+        super().__init__(learning_rate=learning_rate, **kwargs)
+        self.momentum = momentum
+        self.lamda = lamda
+
+    def create_state(self, index, weight):
+        mom = None if self.momentum == 0.0 else nd_zeros(
+            weight.shape, ctx=weight.context, dtype=weight.dtype)
+        return (mom, weight.copy())
+
+    def update(self, index, weight, grad, state):
+        self._update_count(index)
+        lr = self._get_lr(index)
+        # reference formula: wd rides OUTSIDE the squared Taylor term —
+        # only the raw (rescaled/clipped) gradient is squared
+        g = _prepped(self, index, grad, weight, with_wd=False)
+        wd = self._get_wd(index)
+        mom, prev = state
+        comp = g + wd * weight + self.lamda * g * g * (weight - prev)
+        if mom is None:
+            step = -lr * comp
+        else:
+            mom_new = self.momentum * mom - lr * comp
+            mom._set_data(mom_new._read())
+            step = mom_new
+        prev._set_data(weight._read())
+        weight._set_data((weight + step)._read())

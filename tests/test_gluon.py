@@ -468,3 +468,34 @@ def test_reflection_pad2d_reference_8tuple():
                                atol=1e-6)
     with pytest.raises(Exception, match="padding"):
         nn.ReflectionPad2D((1, 2, 3))
+
+
+def test_poisson_nll_and_sdml_losses():
+    """reference: gluon.loss.PoissonNLLLoss / SDMLLoss."""
+    from mxnet_tpu.gluon.loss import PoissonNLLLoss, SDMLLoss
+    rng = np.random.RandomState(0)
+    pred = nd.array(rng.uniform(0.1, 2.0, (4, 3)).astype(np.float32))
+    tgt = nd.array(rng.poisson(1.0, (4, 3)).astype(np.float32))
+    # from_logits: exp(pred) - target*pred
+    want = (np.exp(pred.asnumpy()) - tgt.asnumpy() * pred.asnumpy()).mean()
+    got = float(PoissonNLLLoss()(pred, tgt).asnumpy())
+    np.testing.assert_allclose(got, want, rtol=1e-5)
+    # mean-space with Stirling runs and is finite
+    full = float(PoissonNLLLoss(from_logits=False, compute_full=True)(
+        pred, tgt).asnumpy())
+    assert np.isfinite(full)
+
+    # SDML: the aligned pairing must score strictly better than a
+    # shuffled (wrong) pairing — the metric-learning signal itself
+    x = nd.array(rng.randn(6, 5).astype(np.float32))
+    loss_same = float(SDMLLoss()(x, x).asnumpy())
+    perm = np.roll(np.arange(6), 1)
+    loss_shuffled = float(SDMLLoss()(x, nd.array(
+        x.asnumpy()[perm])).asnumpy())
+    assert loss_same < loss_shuffled, (loss_same, loss_shuffled)
+    # gradients flow
+    x.attach_grad()
+    with autograd.record():
+        L = SDMLLoss()(x, x * 1.1)
+    L.backward()
+    assert np.isfinite(x.grad.asnumpy()).all()

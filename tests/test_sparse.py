@@ -315,3 +315,16 @@ def test_kvstore_device_sparse_push_serial_union():
     w = out.asnumpy()
     np.testing.assert_allclose(w[0], 1.0)
     np.testing.assert_allclose(w[4], 2.0)
+
+
+def test_kvstore_mixed_storage_push_rejected():
+    import mxnet_tpu as mx
+    import pytest as _pt
+    from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
+    kv = mx.kv.create("local")
+    kv.init(2, nd.zeros((4, 2)))
+    g_sparse = sparse.row_sparse_array((np.ones((1, 2), np.float32),
+                                        np.array([0])), shape=(4, 2))
+    with _pt.raises(MXNetError):
+        kv.push(2, [nd.ones((4, 2)), g_sparse])

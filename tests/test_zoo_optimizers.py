@@ -178,3 +178,48 @@ def test_ftml_converges():
         if l0 is None:
             l0 = float(L.asnumpy())
     assert float(L.asnumpy()) < l0 * 0.3
+
+
+@pytest.mark.parametrize("name,params", [
+    ("adamax", {"learning_rate": 0.1}),
+    ("nadam", {"learning_rate": 0.05}),
+    ("dcasgd", {"learning_rate": 0.1, "momentum": 0.9}),
+])
+def test_python_composed_optimizers_converge(name, params):
+    """reference optimizer.py Adamax/Nadam/SGLD/DCASGD — python-composed
+    from primitive ops upstream too."""
+    np.random.seed(5)
+    net = gluon.nn.Dense(1, in_units=6)
+    net.initialize()
+    tr = gluon.Trainer(net.collect_params(), name, dict(params))
+    X = np.random.randn(64, 6).astype(np.float32)
+    yt = X @ np.ones((6, 1), np.float32)
+    losses = []
+    for _ in range(80):
+        with autograd.record():
+            L = mx.nd.mean(mx.nd.square(
+                net(mx.nd.array(X)) - mx.nd.array(yt)))
+        L.backward()
+        tr.step(64)
+        losses.append(float(L.asnumpy()))
+    assert losses[-1] < 0.4 * losses[0], (name, losses[0], losses[-1])
+
+
+def test_sgld_langevin_mechanics():
+    """SGLD is a posterior SAMPLER (w += -lr/2*g + N(0, sqrt(lr))), so the
+    right check is its drift and diffusion statistics, not point
+    convergence: over N steps of constant gradient c the displacement is
+    Gaussian with mean -N*lr/2*c and variance N*lr."""
+    mx.random.seed(11)
+    opt = mx.optimizer.SGLD(learning_rate=0.01)
+    N, c, lr = 400, 3.0, 0.01
+    w = mx.nd.zeros((256,))
+    g = mx.nd.array(np.full((256,), c, np.float32))
+    state = opt.create_state(0, w)
+    for _ in range(N):
+        opt.update(0, w, g, state)
+    disp = w.asnumpy()
+    want_mean = -N * lr / 2 * c
+    np.testing.assert_allclose(disp.mean(), want_mean,
+                               atol=4 * np.sqrt(N * lr / 256))
+    np.testing.assert_allclose(disp.std(), np.sqrt(N * lr), rtol=0.2)
