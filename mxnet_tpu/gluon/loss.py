@@ -248,13 +248,14 @@ class PoissonNLLLoss(Loss):
             loss = F.exp(pred) - target * pred
         else:
             loss = pred - target * F.log(pred + epsilon)
-        if self._compute_full:
-            # Stirling: t*log(t) - t + 0.5*log(2*pi*t), for t > 1
-            import math
-            stirling = target * F.log(target + epsilon) - target \
-                + 0.5 * F.log(2 * math.pi * (target + epsilon))
-            loss = loss + F.where(target > 1.0, stirling,
-                                  F.zeros_like(target))
+            if self._compute_full:
+                # Stirling: t*log(t) - t + 0.5*log(2*pi*t), for t > 1 —
+                # the reference applies it in the mean-space branch only
+                import math
+                stirling = target * F.log(target + epsilon) - target \
+                    + 0.5 * F.log(2 * math.pi * (target + epsilon))
+                loss = loss + F.where(target > 1.0, stirling,
+                                      F.zeros_like(target))
         loss = _apply_weighting(F, loss, self._weight, sample_weight)
         return F.mean(loss)
 

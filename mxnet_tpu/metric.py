@@ -16,6 +16,7 @@ from .base import MXNetError
 
 __all__ = ["EvalMetric", "Accuracy", "TopKAccuracy", "F1", "MAE", "MSE",
            "RMSE", "CrossEntropy", "Perplexity", "Loss",
+           "NegativeLogLikelihood", "PearsonCorrelation", "MCC",
            "CompositeEvalMetric", "CustomMetric", "create", "np"]
 
 _registry: Dict[str, type] = {}
@@ -224,6 +225,89 @@ class CrossEntropy(EvalMetric):
             prob = pred[_np.arange(label.shape[0]), label]
             self.sum_metric += float((-_np.log(prob + self.eps)).sum())
             self.num_inst += label.shape[0]
+
+
+@register
+class NegativeLogLikelihood(EvalMetric):
+    """reference metric.py NegativeLogLikelihood: mean -log p(label)."""
+
+    def __init__(self, eps=1e-12, name="nll-loss", **kwargs):
+        super().__init__(name, **kwargs)
+        self.eps = eps
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            label = _to_np(label).astype(_np.int32).flatten()
+            pred = _to_np(pred).reshape(label.shape[0], -1)
+            prob = pred[_np.arange(label.shape[0]), label]
+            self.sum_metric += float((-_np.log(prob + self.eps)).sum())
+            self.num_inst += label.shape[0]
+
+
+@register
+class PearsonCorrelation(EvalMetric):
+    """reference metric.py PearsonCorrelation — streaming over batches via
+    accumulated moments (the reference's updated 1.6 form, which unlike
+    per-batch averaging is exact over the whole stream)."""
+
+    def __init__(self, name="pearsonr", **kwargs):
+        super().__init__(name, **kwargs)
+        self.reset()
+
+    def reset(self) -> None:
+        super().reset()
+        self._n = 0
+        self._sx = self._sy = self._sxx = self._syy = self._sxy = 0.0
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            x = _to_np(label).astype(_np.float64).ravel()
+            y = _to_np(pred).astype(_np.float64).ravel()
+            self._n += x.size
+            self._sx += x.sum()
+            self._sy += y.sum()
+            self._sxx += (x * x).sum()
+            self._syy += (y * y).sum()
+            self._sxy += (x * y).sum()
+        n = self._n
+        if n == 0:
+            return                     # no data yet: metric stays nan
+        self.num_inst = 1
+        cov = self._sxy - self._sx * self._sy / n
+        vx = self._sxx - self._sx ** 2 / n
+        vy = self._syy - self._sy ** 2 / n
+        denom = _np.sqrt(max(vx * vy, 1e-24))
+        self.sum_metric = float(cov / denom)
+
+
+@register
+class MCC(EvalMetric):
+    """reference metric.py MCC — binary Matthews correlation coefficient
+    from streaming confusion counts."""
+
+    def __init__(self, name="mcc", **kwargs):
+        super().__init__(name, **kwargs)
+        self.reset()
+
+    def reset(self) -> None:
+        super().reset()
+        self._tp = self._tn = self._fp = self._fn = 0
+
+    def update(self, labels, preds) -> None:
+        for label, pred in zip(_as_list(labels), _as_list(preds)):
+            y = _to_np(label).astype(_np.int32).ravel()
+            p = _to_np(pred)
+            yhat = (p.reshape(y.shape[0], -1).argmax(-1)
+                    if p.ndim > 1 and p.shape[-1] > 1
+                    else (p.ravel() > 0.5).astype(_np.int32))
+            self._tp += int(((yhat == 1) & (y == 1)).sum())
+            self._tn += int(((yhat == 0) & (y == 0)).sum())
+            self._fp += int(((yhat == 1) & (y == 0)).sum())
+            self._fn += int(((yhat == 0) & (y == 1)).sum())
+        tp, tn, fp, fn = self._tp, self._tn, self._fp, self._fn
+        denom = _np.sqrt(float(tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+        self.num_inst = 1
+        self.sum_metric = ((tp * tn - fp * fn) / denom) if denom else 0.0
 
 
 @register

@@ -76,6 +76,16 @@ class Optimizer:
         self.aggregate_num = 0
 
     # -- bookkeeping -------------------------------------------------------
+    def extra_state(self):
+        """Scalar optimizer state beyond per-param tensors (e.g. Nadam's
+        momentum-schedule product) — serialized by Updater.get_states
+        (dump_optimizer=True) so time-dependent optimizers resume
+        exactly.  Return None when there is nothing extra."""
+        return None
+
+    def set_extra_state(self, extra) -> None:
+        pass
+
     def _update_count(self, index) -> None:
         cnt = self._index_update_count.get(index, self.begin_num_update)
         self._index_update_count[index] = cnt + 1
@@ -803,6 +813,9 @@ class Updater:
             blob["num_update"] = self.optimizer.num_update
             blob["index_update_count"] = \
                 dict(self.optimizer._index_update_count)
+            extra = self.optimizer.extra_state()
+            if extra is not None:
+                blob["optimizer_extra"] = extra
         return pickle.dumps(blob)
 
     def set_states(self, states) -> None:
@@ -816,6 +829,8 @@ class Updater:
             self.optimizer.num_update = loaded["num_update"]
             self.optimizer._index_update_count = dict(
                 loaded["index_update_count"])
+        if "optimizer_extra" in loaded:
+            self.optimizer.set_extra_state(loaded["optimizer_extra"])
 
 
 def _states_to_np(state):
@@ -902,6 +917,12 @@ class Nadam(Optimizer):
         self.schedule_decay = schedule_decay
         self.m_schedule = 1.0
 
+    def extra_state(self):
+        return {"m_schedule": self.m_schedule}
+
+    def set_extra_state(self, extra) -> None:
+        self.m_schedule = float(extra["m_schedule"])
+
     def create_state(self, index, weight):
         return (nd_zeros(weight.shape, ctx=weight.context,
                          dtype=weight.dtype),
@@ -945,7 +966,10 @@ class SGLD(Optimizer):
         from .ndarray import random as nd_random
         self._update_count(index)
         lr = self._get_lr(index)
-        g = _prepped(self, index, grad, weight)
+        # reference SGLD: clip the raw rescaled gradient; wd*weight rides
+        # OUTSIDE the clip (unlike Adamax/Nadam, which clip the sum)
+        g = _prepped(self, index, grad, weight, with_wd=False)
+        g = g + self._get_wd(index) * weight
         noise = nd_random.normal(0.0, _np.sqrt(lr), shape=weight.shape,
                                  ctx=weight.context, dtype=weight.dtype)
         weight._set_data((weight - 0.5 * lr * g + noise)._read())

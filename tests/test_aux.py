@@ -246,3 +246,39 @@ def test_attr_scope_rejects_reserved_keys():
     for key in ("shape", "dtype", "aux", "init", "layout", "__x__"):
         with pytest.raises(ValueError, match="reserved|strings"):
             mx.AttrScope(**{key: "v"})
+
+
+def test_pearson_mcc_nll_metrics():
+    """reference metric.py PearsonCorrelation (streaming-exact) / MCC /
+    NegativeLogLikelihood."""
+    from scipy import stats as sps
+    import mxnet_tpu as mx
+    rng = np.random.RandomState(0)
+
+    m = mx.metric.PearsonCorrelation()
+    x = rng.randn(100); y = 0.8 * x + 0.2 * rng.randn(100)
+    # feed in two chunks: streaming must equal the whole-stream pearson
+    m.update([mx.nd.array(x[:60])], [mx.nd.array(y[:60])])
+    m.update([mx.nd.array(x[60:])], [mx.nd.array(y[60:])])
+    want = sps.pearsonr(x, y)[0]
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-5)
+
+    m = mx.metric.MCC()
+    lab = np.array([1, 1, 1, 0, 0, 0, 1, 0])
+    prob = np.array([[0.2, 0.8], [0.3, 0.7], [0.6, 0.4], [0.8, 0.2],
+                     [0.4, 0.6], [0.7, 0.3], [0.1, 0.9], [0.9, 0.1]])
+    m.update([mx.nd.array(lab)], [mx.nd.array(prob)])
+    # sklearn-free closed form
+    tp, tn, fp, fn = 3, 3, 1, 1
+    want = (tp * tn - fp * fn) / np.sqrt((tp+fp)*(tp+fn)*(tn+fp)*(tn+fn))
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-6)
+
+    m = mx.metric.NegativeLogLikelihood()
+    m.update([mx.nd.array([0, 1])],
+             [mx.nd.array([[0.9, 0.1], [0.2, 0.8]])])
+    want = -(np.log(0.9) + np.log(0.8)) / 2
+    np.testing.assert_allclose(m.get()[1], want, rtol=1e-6)
+
+    # registry create() path
+    assert mx.metric.create("mcc").name == "mcc"
+    assert mx.metric.create("pearsoncorrelation").name == "pearsonr"

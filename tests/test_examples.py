@@ -45,3 +45,13 @@ def test_example_translate_nmt_runs():
              timeout=1200)
     assert r.returncode == 0, (r.stdout[-500:], r.stderr[-1500:])
     assert "translation OK" in r.stdout
+
+
+def test_example_bert_pretrain_runs():
+    r = _run(["examples/pretrain_bert_mlm.py", "--steps", "6",
+              "--batch", "2", "--seq", "32"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    lines = [l for l in r.stdout.splitlines() if "loss" in l]
+    first = float(lines[0].split()[-1])
+    last = float(lines[-1].split()[-1])
+    assert last < first, (first, last)

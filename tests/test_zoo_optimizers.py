@@ -223,3 +223,23 @@ def test_sgld_langevin_mechanics():
     np.testing.assert_allclose(disp.mean(), want_mean,
                                atol=4 * np.sqrt(N * lr / 256))
     np.testing.assert_allclose(disp.std(), np.sqrt(N * lr), rtol=0.2)
+
+
+def test_nadam_m_schedule_survives_checkpoint():
+    """Updater.get_states(dump_optimizer=True) must carry Nadam's
+    momentum-schedule product; a resumed optimizer must not spike."""
+    opt = mx.optimizer.Nadam(learning_rate=0.01)
+    upd = mx.optimizer.get_updater(opt)
+    w = mx.nd.array(np.ones((4,), np.float32))
+    g = mx.nd.array(np.full((4,), 0.1, np.float32))
+    for _ in range(50):
+        upd(0, g, w)
+    blob = upd.get_states(dump_optimizer=True)
+    opt2 = mx.optimizer.Nadam(learning_rate=0.01)
+    upd2 = mx.optimizer.get_updater(opt2)
+    upd2.set_states(blob)
+    assert abs(opt2.m_schedule - opt.m_schedule) < 1e-12
+    w2 = mx.nd.array(w.asnumpy())
+    upd2(0, g, w2)
+    upd(0, g, w)
+    np.testing.assert_allclose(w2.asnumpy(), w.asnumpy(), rtol=1e-6)
