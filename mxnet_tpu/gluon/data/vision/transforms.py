@@ -161,8 +161,9 @@ class RandomContrast(_RandomJitter):
 
 class RandomSaturation(_RandomJitter):
     def __call__(self, x):
+        from ....ndarray.ops_image import LUMA
         a = _to_np(x).astype(_np.float32)
-        gray = a.mean(axis=-1, keepdims=True)
+        gray = (a * LUMA).sum(axis=-1, keepdims=True)
         f = self._factor()
         return nd_array(_np.clip(a * f + gray * (1 - f), 0, 255))
 
@@ -172,21 +173,12 @@ class RandomHue(_RandomJitter):
     gray axis by a random angle scaled from the jitter amount."""
 
     def __call__(self, x):
+        # one shared YIQ rotation (ops_image.py) — op and transform
+        # cannot drift, and f=0 is an exact identity
+        from ....ndarray.ops_image import hue_rotation_matrix
         a = _to_np(x).astype(_np.float32)
         f = self._factor() - 1.0            # in [-amount, amount]
-        theta = f * _np.pi
-        cos, sin = _np.cos(theta), _np.sin(theta)
-        # YIQ-space hue rotation (the classic fast-hue-shift matrix)
-        t_yiq = _np.array([[0.299, 0.587, 0.114],
-                           [0.596, -0.274, -0.321],
-                           [0.211, -0.523, 0.311]], _np.float32)
-        t_rgb = _np.array([[1.0, 0.956, 0.621],
-                           [1.0, -0.272, -0.647],
-                           [1.0, -1.107, 1.705]], _np.float32)
-        rot = _np.array([[1, 0, 0],
-                         [0, cos, -sin],
-                         [0, sin, cos]], _np.float32)
-        m = t_rgb @ rot @ t_yiq
+        m = hue_rotation_matrix(f)
         return nd_array(_np.clip(a @ m.T, 0, 255))
 
 
@@ -195,14 +187,11 @@ class RandomLighting:
         self._alpha = alpha
 
     def __call__(self, x):
+        from ....ndarray.ops_image import (LIGHTING_EIGVAL,
+                                           LIGHTING_EIGVEC)
         a = _to_np(x).astype(_np.float32)
-        # PCA lighting noise (AlexNet-style) with fixed RGB eigenbasis
-        eigval = _np.array([55.46, 4.794, 1.148])
-        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
-                            [-0.5808, -0.0045, -0.8140],
-                            [-0.5836, -0.6948, 0.4203]])
         alpha = _np.random.normal(0, self._alpha, 3)
-        rgb = eigvec @ (alpha * eigval)
+        rgb = LIGHTING_EIGVEC @ (alpha * LIGHTING_EIGVAL)
         return nd_array(_np.clip(a + rgb, 0, 255))
 
 

@@ -30,8 +30,10 @@ from . import ops_quantization as _ops_quant     # noqa: F401
 from . import ops_ctc as _ops_ctc                # noqa: F401
 from . import ops_misc as _ops_misc              # noqa: F401
 from . import ops_control_flow as _ops_cf        # noqa: F401
+from . import ops_image as _ops_image            # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401
+from . import image                               # noqa: F401
 
 _this_module = _sys.modules[__name__]
 

@@ -1,0 +1,15 @@
+"""``mx.nd.image`` namespace (reference: the _image_* op frontends in
+python/mxnet/ndarray/image.py) — thin wrappers over the registry ops in
+ops_image.py, named without the underscore prefix."""
+from __future__ import annotations
+
+import sys as _sys
+
+from .register import _registry, make_frontend
+
+_PREFIX = "_image_"
+_this_module = _sys.modules[__name__]
+
+for _name, _op in list(_registry.items()):
+    if _name.startswith(_PREFIX):
+        setattr(_this_module, _name[len(_PREFIX):], make_frontend(_op))

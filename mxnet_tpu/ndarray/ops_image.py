@@ -1,0 +1,212 @@
+"""The ``_image_*`` operator namespace (reference: src/operator/image/
+image_random.cc, resize.cc, crop.cc — the ops behind ``mx.nd.image.*``
+and the gluon vision transforms; SURVEY.md §2.2 image/ row).
+
+TPU-first notes: deterministic ops are ordinary jitted XLA computations
+on HWC/NHWC uint8-or-float arrays.  The ``random_*`` variants draw their
+factors HOST-side from the library's seeded stream (use_jit=False) — a
+per-call scalar factor then parameterizes one jitted kernel, mirroring
+how the reference draws on CPU and dispatches a deterministic kernel;
+putting the draw on-device would force key plumbing through every
+augmentation for no bandwidth win (factors are scalars).
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from .register import register_op
+
+# ---------------------------------------------------------------------------
+# shared photometric math — single source for these constants; the gluon
+# vision transforms import them so op and transform cannot drift
+# ---------------------------------------------------------------------------
+
+#: ITU-R BT.601 luma weights (the reference's RGB2GRAY convention)
+LUMA = _np.array([0.299, 0.587, 0.114], _np.float32)
+
+#: AlexNet PCA lighting basis over ImageNet RGB
+LIGHTING_EIGVAL = _np.array([55.46, 4.794, 1.148], _np.float32)
+LIGHTING_EIGVEC = _np.array([[-0.5675, 0.7192, 0.4009],
+                             [-0.5808, -0.0045, -0.8140],
+                             [-0.5836, -0.6948, 0.4203]], _np.float32)
+
+_T_YIQ = _np.array([[0.299, 0.587, 0.114],
+                    [0.596, -0.274, -0.321],
+                    [0.211, -0.523, 0.311]], _np.float64)
+
+
+def hue_rotation_matrix(f: float) -> _np.ndarray:
+    """RGB->RGB matrix rotating hue by f (in half-turns) in YIQ space.
+    Uses the exact numeric inverse of the YIQ matrix — the textbook
+    rounded t_rgb constants make even f=0 a visible non-identity."""
+    u, w = _np.cos(f * _np.pi), _np.sin(f * _np.pi)
+    rot = _np.array([[1.0, 0.0, 0.0],
+                     [0.0, u, -w],
+                     [0.0, w, u]], _np.float64)
+    return (_np.linalg.inv(_T_YIQ) @ rot @ _T_YIQ).astype(_np.float32)
+
+
+def _host_uniform(lo: float, hi: float) -> float:
+    """Host-side augmentation draw from the LIBRARY key stream, so
+    mx.random.seed() reproduces augmentation sequences (the module
+    contract; plain np.random would escape it)."""
+    from .. import random as _grandom
+    key_bits = _np.asarray(_grandom.next_key()).ravel().astype(_np.uint32)
+    rng = _np.random.default_rng(key_bits)
+    return float(rng.uniform(lo, hi))
+
+
+def _register():
+    import jax
+    import jax.numpy as jnp
+
+    def _is_batch(x):
+        return x.ndim == 4
+
+    # ---- to_tensor: HWC [0,255] -> CHW float32 [0,1] ---------------------
+    def to_tensor_maker():
+        def fn(x):
+            y = x.astype(jnp.float32) / 255.0
+            axes = (0, 3, 1, 2) if _is_batch(x) else (2, 0, 1)
+            return jnp.transpose(y, axes)
+        return fn
+    register_op("_image_to_tensor", to_tensor_maker)
+
+    # ---- normalize: CHW (or NCHW) with per-channel mean/std --------------
+    def normalize_maker(mean=(0.0,), std=(1.0,)):
+        m = _np.asarray(mean, _np.float32)
+        s = _np.asarray(std, _np.float32)
+
+        def fn(x):
+            shape = (1, -1, 1, 1) if _is_batch(x) else (-1, 1, 1)
+            return (x - jnp.asarray(m).reshape(shape)) \
+                / jnp.asarray(s).reshape(shape)
+        return fn
+    register_op("_image_normalize", normalize_maker)
+
+    # ---- flips (HWC / NHWC: width is -2, height is -3) -------------------
+    def flip_lr_maker():
+        def fn(x):
+            return jnp.flip(x, axis=-2)
+        return fn
+    register_op("_image_flip_left_right", flip_lr_maker)
+
+    def flip_tb_maker():
+        def fn(x):
+            return jnp.flip(x, axis=-3)
+        return fn
+    register_op("_image_flip_top_bottom", flip_tb_maker)
+
+    def random_flip_lr_maker():
+        def fn(x):
+            return jnp.flip(x, axis=-2) \
+                if _host_uniform(0.0, 1.0) < 0.5 else x
+        return fn
+    register_op("_image_random_flip_left_right", random_flip_lr_maker,
+                use_jit=False, differentiable=False)
+
+    def random_flip_tb_maker():
+        def fn(x):
+            return jnp.flip(x, axis=-3) \
+                if _host_uniform(0.0, 1.0) < 0.5 else x
+        return fn
+    register_op("_image_random_flip_top_bottom", random_flip_tb_maker,
+                use_jit=False, differentiable=False)
+
+    # ---- resize / crop (HWC) ---------------------------------------------
+    def resize_maker(size=0, keep_ratio=False, interp=1):
+        def fn(x):
+            batch = _is_batch(x)
+            hh, ww = (x.shape[1], x.shape[2]) if batch \
+                else (x.shape[0], x.shape[1])
+            if isinstance(size, (tuple, list)):
+                w, h = int(size[0]), int(size[1])
+            elif keep_ratio:
+                # reference resize-inl.h: scalar size + keep_ratio scales
+                # the SHORT edge to size
+                scale = int(size) / min(ww, hh)
+                w, h = int(round(ww * scale)), int(round(hh * scale))
+            else:
+                w = h = int(size)
+            method = "nearest" if interp == 0 else "linear"
+            dtype = x.dtype
+            xf = x.astype(jnp.float32)
+            shape = (x.shape[0], h, w, x.shape[3]) if batch \
+                else (h, w, x.shape[2])
+            out = jax.image.resize(xf, shape, method=method)
+            return out.astype(dtype) if dtype != jnp.float32 else out
+        return fn
+    register_op("_image_resize", resize_maker)
+
+    def crop_maker(x=0, y=0, width=0, height=0):
+        from ..base import MXNetError
+
+        def fn(data):
+            hh, ww = (data.shape[1], data.shape[2]) if _is_batch(data) \
+                else (data.shape[0], data.shape[1])
+            if x < 0 or y < 0 or width <= 0 or height <= 0 \
+                    or x + width > ww or y + height > hh:
+                raise MXNetError(
+                    f"crop window ({x},{y},{width},{height}) outside "
+                    f"image ({hh}x{ww})")
+            if _is_batch(data):
+                return data[:, y:y + height, x:x + width, :]
+            return data[y:y + height, x:x + width, :]
+        return fn
+    register_op("_image_crop", crop_maker, use_jit=False)
+
+    # ---- photometric (reference image_random.cc semantics) ---------------
+    def adjust_lighting_maker(alpha=()):
+        from ..base import MXNetError
+        a = _np.asarray(alpha, _np.float32)
+
+        def fn(x):
+            if not jnp.issubdtype(x.dtype, jnp.floating):
+                raise MXNetError(
+                    "adjust_lighting requires float input (the PCA delta "
+                    "is signed; integer wraparound would corrupt pixels)")
+            delta = (LIGHTING_EIGVEC * a * LIGHTING_EIGVAL).sum(axis=1)
+            return x + jnp.asarray(delta, x.dtype)
+        return fn
+    register_op("_image_adjust_lighting", adjust_lighting_maker,
+                use_jit=False)
+
+    def random_brightness_maker(min_factor=0.0, max_factor=0.0):
+        def fn(x):
+            return x * _host_uniform(min_factor, max_factor)
+        return fn
+    register_op("_image_random_brightness", random_brightness_maker,
+                use_jit=False, differentiable=False)
+
+    def random_contrast_maker(min_factor=0.0, max_factor=0.0):
+        def fn(x):
+            f = _host_uniform(min_factor, max_factor)
+            coef = jnp.asarray(LUMA, x.dtype)
+            gray_mean = jnp.mean(jnp.sum(x * coef, axis=-1, keepdims=True),
+                                 axis=(-3, -2), keepdims=True)
+            return x * f + gray_mean * (1.0 - f)
+        return fn
+    register_op("_image_random_contrast", random_contrast_maker,
+                use_jit=False, differentiable=False)
+
+    def random_saturation_maker(min_factor=0.0, max_factor=0.0):
+        def fn(x):
+            f = _host_uniform(min_factor, max_factor)
+            coef = jnp.asarray(LUMA, x.dtype)
+            gray = jnp.sum(x * coef, axis=-1, keepdims=True)
+            return x * f + gray * (1.0 - f)
+        return fn
+    register_op("_image_random_saturation", random_saturation_maker,
+                use_jit=False, differentiable=False)
+
+    def random_hue_maker(min_factor=0.0, max_factor=0.0):
+        def fn(x):
+            # the reference's YIQ rotation (image_random-inl.h RandomHue)
+            m = hue_rotation_matrix(_host_uniform(min_factor, max_factor))
+            return jnp.einsum("...c,dc->...d", x, jnp.asarray(m, x.dtype))
+        return fn
+    register_op("_image_random_hue", random_hue_maker,
+                use_jit=False, differentiable=False)
+
+
+_register()

@@ -164,3 +164,88 @@ def test_random_hue_transform():
     # jitter composes
     j = T.RandomColorJitter(brightness=0.1, hue=0.2)
     assert j(img).shape == img.shape
+
+
+def test_nd_image_op_namespace():
+    """reference: the _image_* registry ops + mx.nd.image frontends
+    (src/operator/image/image_random.cc, resize.cc, crop.cc)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    rng = np.random.RandomState(0)
+    img = rng.randint(0, 255, (8, 10, 3)).astype(np.uint8)
+    x = nd.array(img, dtype="uint8")
+
+    t = nd.image.to_tensor(x)
+    assert t.shape == (3, 8, 10) and t.dtype == np.float32
+    np.testing.assert_allclose(t.asnumpy(),
+                               img.transpose(2, 0, 1) / 255.0, rtol=1e-6)
+
+    n = nd.image.normalize(t, mean=(0.5, 0.5, 0.5), std=(0.2, 0.2, 0.2))
+    np.testing.assert_allclose(
+        n.asnumpy(), (img.transpose(2, 0, 1) / 255.0 - 0.5) / 0.2,
+        rtol=1e-4)
+
+    f = nd.image.flip_left_right(x).asnumpy()
+    np.testing.assert_array_equal(f, img[:, ::-1, :])
+    f = nd.image.flip_top_bottom(x).asnumpy()
+    np.testing.assert_array_equal(f, img[::-1, :, :])
+
+    r = nd.image.resize(x, size=(5, 4))
+    assert r.shape == (4, 5, 3)
+    c = nd.image.crop(x, x=2, y=1, width=6, height=5).asnumpy()
+    np.testing.assert_array_equal(c, img[1:6, 2:8, :])
+
+    # photometric: mean-preservation properties
+    xf = nd.array(img.astype(np.float32))
+    mx.random.seed(0)
+    s = nd.image.random_saturation(xf, min_factor=0.5,
+                                   max_factor=0.5).asnumpy()
+    coef = np.array([0.299, 0.587, 0.114])
+    gray = (img.astype(np.float32) * coef).sum(-1, keepdims=True)
+    np.testing.assert_allclose(s, img * 0.5 + gray * 0.5, rtol=1e-4)
+
+    h = nd.image.random_hue(xf, min_factor=0.0, max_factor=0.0).asnumpy()
+    np.testing.assert_allclose(h, img.astype(np.float32), atol=1e-2)
+
+    al = nd.image.adjust_lighting(xf, alpha=(0.0, 0.0, 0.0)).asnumpy()
+    np.testing.assert_allclose(al, img.astype(np.float32), atol=1e-5)
+
+    # batched NHWC forms
+    b = nd.array(rng.randint(0, 255, (2, 8, 10, 3)).astype(np.uint8),
+                 dtype="uint8")
+    assert nd.image.to_tensor(b).shape == (2, 3, 8, 10)
+    assert nd.image.resize(b, size=4).shape == (2, 4, 4, 3)
+
+
+def test_image_random_ops_seeded_by_mx_random():
+    """Augmentation draws come from the LIBRARY key stream: mx.random.seed
+    alone must reproduce them (review regression: np.random leaked in)."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    img = nd.array(np.arange(48, dtype=np.float32).reshape(4, 4, 3))
+    mx.random.seed(123)
+    a = nd.image.random_brightness(img, min_factor=0.3,
+                                   max_factor=1.7).asnumpy()
+    b = nd.image.random_hue(img, min_factor=-0.4, max_factor=0.4).asnumpy()
+    mx.random.seed(123)
+    a2 = nd.image.random_brightness(img, min_factor=0.3,
+                                    max_factor=1.7).asnumpy()
+    b2 = nd.image.random_hue(img, min_factor=-0.4,
+                             max_factor=0.4).asnumpy()
+    np.testing.assert_array_equal(a, a2)
+    np.testing.assert_array_equal(b, b2)
+
+
+def test_image_crop_bounds_and_lighting_dtype():
+    import pytest as _pt
+    from mxnet_tpu import nd
+    from mxnet_tpu.base import MXNetError
+    img = nd.array(np.zeros((8, 10, 3), np.float32))
+    with _pt.raises(MXNetError):
+        nd.image.crop(img, x=7, y=0, width=6, height=5)
+    u8 = nd.array(np.zeros((8, 10, 3), np.uint8), dtype="uint8")
+    with _pt.raises(MXNetError):
+        nd.image.adjust_lighting(u8, alpha=(0.1, 0.0, 0.0))
+    # short-edge keep_ratio (reference semantics): 8x10 short=8 -> 4
+    r = nd.image.resize(img, size=4, keep_ratio=True)
+    assert r.shape == (4, 5, 3)
