@@ -35,6 +35,25 @@ def _jnp():
     return jnp
 
 
+def _is_bool_mask(key) -> bool:
+    """A 1-D boolean array key (numpy or jax) selecting leading-axis rows."""
+    dt = getattr(key, "dtype", None)
+    return dt is not None and _np.dtype(dt) == _np.bool_ \
+        and getattr(key, "ndim", 0) == 1
+
+
+def _mask_to_rows(key, shape) -> _np.ndarray:
+    """Validate a boolean mask against axis 0 and materialize row indices
+    (numpy/reference contract: mismatched length is an IndexError, never a
+    silent clamp)."""
+    key = _np.asarray(key)
+    if key.shape[0] != shape[0]:
+        raise IndexError(
+            f"boolean index of length {key.shape[0]} does not match "
+            f"axis 0 of shape {shape}")
+    return _np.nonzero(key)[0]
+
+
 def _is_basic_index(key) -> bool:
     if isinstance(key, (int, slice, type(Ellipsis), type(None), _np.integer)):
         return True
@@ -301,6 +320,14 @@ class NDArray:
     def __getitem__(self, key):
         if isinstance(key, NDArray):
             key = key._read()
+        if isinstance(key, list):              # bool lists are masks too
+            key = _np.asarray(key)
+        if _is_bool_mask(key):
+            # boolean-mask indexing (reference ndarray.py advanced
+            # indexing): data-dependent output shape, so the mask is
+            # materialized host-side into integer rows — same eager
+            # stance as boolean_mask the op
+            key = _mask_to_rows(key, self._shape)
         if _is_basic_index(key):
             if _autograd.is_recording():
                 from .register import invoke_by_name
@@ -316,6 +343,10 @@ class NDArray:
     def __setitem__(self, key, value):
         if isinstance(key, NDArray):
             key = key._read()
+        if isinstance(key, list):
+            key = _np.asarray(key)
+        if _is_bool_mask(key):
+            key = _mask_to_rows(key, self._shape)
         if isinstance(value, NDArray):
             value = value._read()
         cur = self._read()

@@ -264,3 +264,34 @@ def test_logical_moments_reshape_like_linspace():
     assert r.shape == (2, 3)
     assert np.allclose(nd.linspace(0, 1, 5).asnumpy(),
                        np.linspace(0, 1, 5))
+
+
+def test_boolean_mask_indexing():
+    """reference advanced indexing: x[bool_array] selects rows (eager by
+    nature — data-dependent shape)."""
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    m = np.array([True, False, True])
+    np.testing.assert_allclose(x[m].asnumpy(), x.asnumpy()[m])
+    # a float 1/0 array is INTEGER indices, not a mask (reference
+    # semantics: only bool dtype masks)
+    np.testing.assert_allclose(
+        x[np.array([1.0, 0.0])].asnumpy(), x.asnumpy()[[1, 0]])
+    y = nd.array(np.zeros((3, 4), np.float32))
+    y[m] = 5.0
+    want = np.zeros((3, 4), np.float32); want[m] = 5.0
+    np.testing.assert_allclose(y.asnumpy(), want)
+
+
+def test_boolean_mask_indexing_validation_and_lists():
+    import pytest as _pt
+    x = nd.array(np.arange(12, dtype=np.float32).reshape(3, 4))
+    with _pt.raises(IndexError):
+        x[np.array([True, False])]             # wrong length
+    with _pt.raises(IndexError):
+        x[np.array([True] * 5)]
+    y = nd.array(np.zeros((3, 4), np.float32))
+    with _pt.raises(IndexError):
+        y[np.array([True, False])] = 1.0
+    # plain bool list is a mask (numpy/reference semantics)
+    np.testing.assert_allclose(x[[True, False, True]].asnumpy(),
+                               x.asnumpy()[[True, False, True]])
