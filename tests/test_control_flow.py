@@ -232,3 +232,47 @@ def test_fused_and_eager_foreach_agree():
                                rtol=1e-5)
     np.testing.assert_allclose(fin_fused[0].asnumpy(),
                                fin_eager[0].asnumpy(), rtol=1e-5)
+
+
+def test_sym_control_flow_numeric_gradients():
+    """FD-check the symbol-mode trio with the reference's load-bearing
+    checker (test_utils.check_numeric_gradient)."""
+    from mxnet_tpu.test_utils import check_numeric_gradient
+
+    # foreach: cumulative tanh scan with a captured weight
+    data = sym.var("data")
+    s0 = sym.var("s0")
+    w = sym.var("w")
+
+    def body(x, st):
+        ns = st[0] + sym.tanh(x * w)
+        return ns * ns, [ns]
+
+    outs, fin = sym.contrib.foreach(body, data, [s0])
+    loss = sym.sum(outs) + sym.sum(fin[0])
+    check_numeric_gradient(
+        loss, {"data": np.random.randn(3, 4).astype(np.float64) * 0.5,
+               "s0": np.zeros(4), "w": np.random.randn(4) * 0.5})
+
+    # while_loop: geometric growth, bounded
+    v = sym.var("v")
+    outs, _ = sym.contrib.while_loop(
+        lambda x: sym.sum(x) < 100.0,
+        lambda x: (sym.tanh(x) * 2.0, [x * 1.5]),
+        [v], max_iterations=4)
+    check_numeric_gradient(sym.sum(outs), {"v": np.array([1.0, 2.0])})
+
+    # cond: both branches touch the free var
+    p = sym.var("p")
+    a = sym.var("a")
+    out = sym.contrib.cond(sym.sum(p) > 0.0,
+                           lambda: sym.tanh(a) * 3.0,
+                           lambda: a * a)
+    check_numeric_gradient(sym.sum(out),
+                           {"p": np.array([1.0]),
+                            "a": np.random.randn(3) * 0.5},
+                           grad_nodes=["a"])
+    check_numeric_gradient(sym.sum(out),
+                           {"p": np.array([-1.0]),
+                            "a": np.random.randn(3) * 0.5},
+                           grad_nodes=["a"])
