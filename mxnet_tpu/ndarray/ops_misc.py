@@ -327,8 +327,8 @@ def _register():
                 aliases=("gradientmultiplier",))
 
     # ---- Crop (legacy src/operator/crop.cc) ------------------------------
-    def crop_maker(offset=(0, 0), h_w=(0, 0), center_crop=False,
-                   num_args=1):
+    def crop_maker(num_args=1, offset=(0, 0), h_w=(0, 0),
+                   center_crop=False):
         offset = tuple(offset)
         h_w = tuple(h_w)
 
@@ -705,23 +705,23 @@ def _register():
             return jax.device_put(fn_make(), dev)
         return placed
 
-    def zeros_maker(shape=(), dtype="float32", ctx=None):
+    def zeros_maker(shape=(), ctx=None, dtype="float32"):
         shp, dt = tuple(int(s) for s in shape), jnp.dtype(dtype)
         return _place(lambda: jnp.zeros(shp, dt), ctx)
     register_op("_zeros", zeros_maker, differentiable=False)
 
-    def ones_maker(shape=(), dtype="float32", ctx=None):
+    def ones_maker(shape=(), ctx=None, dtype="float32"):
         shp, dt = tuple(int(s) for s in shape), jnp.dtype(dtype)
         return _place(lambda: jnp.ones(shp, dt), ctx)
     register_op("_ones", ones_maker, differentiable=False)
 
-    def full_maker(shape=(), dtype="float32", value=0.0, ctx=None):
+    def full_maker(shape=(), ctx=None, dtype="float32", value=0.0):
         shp, dt = tuple(int(s) for s in shape), jnp.dtype(dtype)
         return _place(lambda: jnp.full(shp, value, dt), ctx)
     register_op("_full", full_maker, differentiable=False)
 
     def arange_maker(start=0.0, stop=None, step=1.0, repeat=1,
-                     infer_range=False, dtype="float32", ctx=None):
+                     infer_range=False, ctx=None, dtype="float32"):
         dt = jnp.dtype(dtype)
         lo, hi = (0, start) if stop is None else (start, stop)
 
@@ -732,14 +732,14 @@ def _register():
     register_op("_arange", arange_maker, differentiable=False)
 
     def linspace_maker(start=0.0, stop=1.0, num=50, endpoint=True,
-                       dtype="float32", ctx=None):
+                       ctx=None, dtype="float32"):
         dt = jnp.dtype(dtype)
         return _place(lambda: jnp.linspace(start, stop, int(num),
                                            endpoint=endpoint, dtype=dt),
                       ctx)
     register_op("_linspace", linspace_maker, differentiable=False)
 
-    def eye_maker(N=0, M=0, k=0, dtype="float32", ctx=None):
+    def eye_maker(N=0, M=0, k=0, ctx=None, dtype="float32"):
         dt = jnp.dtype(dtype)
         return _place(lambda: jnp.eye(int(N), int(M) if M else None,
                                       k=int(k), dtype=dt), ctx)

@@ -434,8 +434,8 @@ def _register():
     register_op("Dropout", dropout_maker, aliases=("dropout",))
 
     # ---- resize / upsample ----------------------------------------------
-    def upsampling_maker(scale=1, sample_type="nearest", num_args=1,
-                         num_filter=0, multi_input_mode="concat",
+    def upsampling_maker(scale=1, num_filter=0, sample_type="nearest",
+                         multi_input_mode="concat", num_args=1,
                          workspace=None):
         def fn(*xs):
             x = xs[0]

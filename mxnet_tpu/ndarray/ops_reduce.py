@@ -58,7 +58,7 @@ def _register():
     register_op("max", _make_reduce(jnp.max), aliases=("max_axis",))
     register_op("min", _make_reduce(jnp.min), aliases=("min_axis",))
 
-    def norm_maker(ord=2, axis=None, keepdims=False, out_dtype=None):
+    def norm_maker(ord=2, axis=None, out_dtype=None, keepdims=False):
         axis_t = _norm_axis(axis)
 
         def fn(x):

@@ -305,12 +305,65 @@ def invoke_binary(name: str, lhs, rhs, reverse: bool = False):
     return invoke_by_name(sop, [lhs, scal], {})
 
 
+@functools.lru_cache(maxsize=None)
+def _maker_param_names(op: Operator) -> Tuple[str, ...]:
+    import inspect
+    try:
+        return tuple(
+            p.name for p in inspect.signature(op.maker).parameters.values()
+            if p.kind in (inspect.Parameter.POSITIONAL_OR_KEYWORD,
+                          inspect.Parameter.KEYWORD_ONLY))
+    except (TypeError, ValueError):
+        return ()
+
+
+def _is_param_value(v) -> bool:
+    """Positional values that are op PARAMETERS, not tensor inputs.
+    Tuples are parameters (shape/axes); plain lists stay tensor-ish
+    (mx.nd converts lists to arrays)."""
+    import jax
+    if isinstance(v, (bool, int, float, str, tuple, _np.generic)):
+        return True
+    if isinstance(v, (_np.ndarray, jax.Array, list)):
+        return False
+    if hasattr(v, "_heads"):                # Symbol (duck-typed: symbol
+        return False                        # imports this module)
+    from .ndarray import NDArray
+    return not isinstance(v, NDArray)
+
+
+def split_positional_params(op: Operator, args: Sequence,
+                            kwargs: Dict[str, Any]):
+    """Reference-parity calling convention for generated wrappers: the
+    C-side registry gave each wrapper an explicit signature
+    ``op(data..., param1, param2, ...)``, so trailing non-tensor
+    positionals map onto the op's parameters in maker-declaration order
+    (``nd.sum(x, 1)`` ≡ ``nd.sum(x, axis=1)``)."""
+    inputs = list(args)
+    split = len(inputs)
+    while split > 0 and _is_param_value(inputs[split - 1]):
+        split -= 1
+    extra = inputs[split:]
+    if not extra:
+        return inputs, kwargs
+    names = _maker_param_names(op)
+    if len(extra) > len(names):
+        return inputs, kwargs               # unmappable: legacy behavior
+    for n, v in zip(names, extra):
+        if n in kwargs:
+            raise TypeError(
+                f"{op.name}() got multiple values for argument {n!r}")
+        kwargs[n] = v
+    return inputs[:split], kwargs
+
+
 def make_frontend(op: Operator) -> Callable:
     """Build the user-facing ``mx.nd.<op>`` function."""
     def frontend(*args, **kwargs):
         out = kwargs.pop("out", None)
         kwargs.pop("name", None)        # accepted for symbol-API symmetry
-        return invoke(op, list(args), kwargs, out=out)
+        inputs, kwargs = split_positional_params(op, args, kwargs)
+        return invoke(op, inputs, kwargs, out=out)
     frontend.__name__ = op.name
     frontend.__qualname__ = op.name
     frontend.__doc__ = op.doc

@@ -123,7 +123,11 @@ def apply_op(opname: str, args: List[Symbol], kwargs: Dict[str, Any],
 def _make_sym_frontend(opname: str):
     def frontend(*args, **kwargs):
         name = kwargs.pop("name", None)
-        return apply_op(opname, list(args), kwargs, name=name)
+        # same positional-parameter convention as the nd wrappers
+        from ..ndarray.register import get_op, split_positional_params
+        inputs, kwargs = split_positional_params(get_op(opname), args,
+                                                 kwargs)
+        return apply_op(opname, inputs, kwargs, name=name)
     frontend.__name__ = opname
     return frontend
 

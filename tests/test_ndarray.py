@@ -295,3 +295,52 @@ def test_boolean_mask_indexing_validation_and_lists():
     # plain bool list is a mask (numpy/reference semantics)
     np.testing.assert_allclose(x[[True, False, True]].asnumpy(),
                                x.asnumpy()[[True, False, True]])
+
+
+def test_positional_op_parameters():
+    """Reference generated-wrapper convention: trailing non-tensor
+    positionals are op parameters in declaration order."""
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    idx = nd.array([0, 2], dtype="int32")
+    assert nd.one_hot(idx, 4).shape == (2, 4)
+    assert nd.reshape(x, (3, 2)).shape == (3, 2)
+    assert nd.expand_dims(x, 0).shape == (1, 2, 3)
+    assert nd.transpose(x, (1, 0)).shape == (3, 2)
+    np.testing.assert_allclose(nd.sum(x, 1).asnumpy(), x.asnumpy().sum(1))
+    import pytest as _pt
+    with _pt.raises(TypeError):
+        nd.sum(x, 1, axis=0)          # double assignment
+    # tensors (incl. plain lists) still route as inputs
+    np.testing.assert_allclose(
+        nd.broadcast_add(x, [[1.0, 1.0, 1.0]] * 2).asnumpy(),
+        x.asnumpy() + 1.0)
+
+
+def test_positional_op_parameters_symbol_side():
+    from mxnet_tpu import sym
+    import pytest as _pt
+    d = sym.var("d")
+    x = nd.array(np.arange(6, dtype=np.float32).reshape(2, 3))
+    r = sym.sum(d, 1).eval_dict({"d": x})
+    np.testing.assert_allclose(r.asnumpy(), x.asnumpy().sum(1))
+    r = sym.reshape(sym.transpose(d, (1, 0)), (-1,)).eval_dict({"d": x})
+    np.testing.assert_allclose(r.asnumpy(),
+                               x.asnumpy().T.reshape(-1))
+    with _pt.raises(TypeError):
+        sym.sum(d, 1, axis=0)
+
+
+def test_positional_param_order_matches_reference_decl():
+    """Makers whose kwarg order diverged from the reference declaration
+    order were re-aligned (review finding): norm(ord, axis, out_dtype,
+    keepdims), clip(a_min, a_max), creation ops (shape, ctx, dtype)."""
+    x = nd.array(np.array([[3.0, 4.0], [6.0, 8.0]], np.float32))
+    # norm(x, ord, axis) positionally
+    np.testing.assert_allclose(nd.norm(x, 2, 1).asnumpy(), [5.0, 10.0],
+                               rtol=1e-6)
+    np.testing.assert_allclose(nd.clip(x, 4.0, 7.0).asnumpy(),
+                               np.clip(x.asnumpy(), 4, 7))
+    from mxnet_tpu.ndarray.register import invoke_by_name
+    z = invoke_by_name("_zeros", [], {"shape": (2,), "ctx": "cpu(0)",
+                                      "dtype": "int32"})
+    assert z.dtype == np.int32
