@@ -10,9 +10,7 @@ from __future__ import annotations
 import sys as _sys
 
 from .ndarray import (NDArray, array, from_jax, zeros, ones, empty, full,
-                      arange, linspace, eye, moveaxis,
-                      zeros_like as _zeros_like_ctor,
-                      ones_like as _ones_like_ctor)
+                      arange, linspace, eye, moveaxis)
 from . import register as _register_mod
 from .register import (get_op, list_ops, invoke_by_name, make_frontend,
                        register_op)
@@ -44,6 +42,66 @@ for _name in list_ops():
 for _name, _op in list(_register_mod._registry.items()):
     if not hasattr(_this_module, _name):
         setattr(_this_module, _name, make_frontend(_op))
+
+
+# ---------------------------------------------------------------------------
+# fluent NDArray methods (reference: _set_ndarray_class + the generated
+# method surface — x.sum(axis), x.take(idx), ... delegate to the op
+# frontends with self as the first input)
+# ---------------------------------------------------------------------------
+
+_FLUENT_METHODS = (
+    "prod", "abs", "swapaxes", "repeat", "flip", "sort", "argsort",
+    "topk", "round", "floor", "ceil", "trunc", "rint", "fix", "sign",
+    "tanh", "sinh", "cosh", "arcsinh", "arccosh", "arctanh", "sin",
+    "cos", "tan", "arcsin", "arccos", "arctan", "degrees", "radians",
+    "exp", "expm1", "log", "log1p", "log2", "log10", "sqrt", "rsqrt",
+    "cbrt", "rcbrt", "square", "reciprocal", "erf", "erfinv", "gamma",
+    "gammaln", "relu", "sigmoid", "softmax", "log_softmax", "softmin",
+    "norm", "split", "slice_axis", "slice_like", "take", "pick", "diag",
+    "nansum", "nanprod", "tile", "pad", "shape_array", "size_array",
+    "broadcast_like", "reshape_like", "one_hot", "clip", "zeros_like",
+    "ones_like")
+
+
+def _attach_fluent(name):
+    fn = getattr(_this_module, name)
+
+    def method(self, *args, **kwargs):
+        return fn(self, *args, **kwargs)
+    method.__name__ = name
+    method.__doc__ = f"Fluent form of ``mx.nd.{name}`` (self as data)."
+    return method
+
+
+for _m in _FLUENT_METHODS:
+    if not hasattr(NDArray, _m) and hasattr(_this_module, _m):
+        setattr(NDArray, _m, _attach_fluent(_m))
+
+
+# core methods NDArray implements by hand (views/host sync) but Symbol
+# gets from the op registry — part of the same lockstep surface
+_CORE_SYM_METHODS = (
+    "sum", "mean", "max", "min", "argmax", "argmin", "reshape",
+    "transpose", "dot", "broadcast_to", "flatten", "expand_dims",
+    "squeeze", "slice")
+
+
+# the same generated surface attaches to Symbol (reference keeps the two
+# frontends in lockstep; hybridize would otherwise AttributeError on any
+# fluent call inside hybrid_forward)
+def _attach_symbol_fluent():
+    from ..symbol.symbol import Symbol
+    from ..symbol.register import _make_sym_frontend
+    for m in _FLUENT_METHODS + _CORE_SYM_METHODS:
+        if not hasattr(Symbol, m) and hasattr(_this_module, m):
+            fe = _make_sym_frontend(
+                getattr(_this_module, m).__name__)
+
+            def method(self, *args, _fe=fe, **kwargs):
+                return _fe(self, *args, **kwargs)
+            method.__name__ = m
+            setattr(Symbol, m, method)
 
 
 # ---------------------------------------------------------------------------

@@ -502,45 +502,9 @@ class NDArray:
         from . import dot
         return dot(self, other)
 
-    def clip(self, a_min, a_max):
-        from . import clip
-        return clip(self, a_min=a_min, a_max=a_max)
-
-    def relu(self):
-        from . import relu
-        return relu(self)
-
-    def sigmoid(self):
-        from . import sigmoid
-        return sigmoid(self)
-
-    def exp(self):
-        from . import exp
-        return exp(self)
-
-    def log(self):
-        from . import log
-        return log(self)
-
-    def sqrt(self):
-        from . import sqrt
-        return sqrt(self)
-
-    def square(self):
-        from . import square
-        return square(self)
-
-    def softmax(self, axis=-1):
-        from . import softmax
-        return softmax(self, axis=axis)
-
-    def one_hot(self, depth, on_value=1.0, off_value=0.0):
-        from . import one_hot
-        return one_hot(self, depth=depth, on_value=on_value, off_value=off_value)
-
-    def tile(self, reps):
-        from . import tile
-        return tile(self, reps=reps)
+    # clip/relu/sigmoid/exp/log/sqrt/square/softmax/one_hot/tile are
+    # attached by the generic fluent loop in __init__.py (full frontend
+    # kwargs incl. out=) — hand-written duplicates were deleted
 
     def broadcast_to(self, shape):
         from . import broadcast_to

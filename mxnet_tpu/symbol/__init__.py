@@ -19,3 +19,9 @@ from .register import _attach_frontends
 _attach_frontends(_sys.modules[__name__])
 
 from . import contrib  # noqa: E402,F401  (after frontends exist)
+
+# fluent method surface, kept in lockstep with NDArray's (the generated
+# method lists live in ndarray/__init__.py)
+from ..ndarray import _attach_symbol_fluent as _asf  # noqa: E402
+
+_asf()

@@ -344,3 +344,53 @@ def test_positional_param_order_matches_reference_decl():
     z = invoke_by_name("_zeros", [], {"shape": (2,), "ctx": "cpu(0)",
                                       "dtype": "int32"})
     assert z.dtype == np.int32
+
+
+def test_fluent_methods():
+    """reference: the generated NDArray method surface — x.op(args) ==
+    nd.op(x, args)."""
+    x = nd.array(np.array([[3.0, 1.0, 2.0], [6.0, 5.0, 4.0]], np.float32))
+    np.testing.assert_allclose(x.prod(1).asnumpy(), [6.0, 120.0])
+    np.testing.assert_allclose(x.abs().asnumpy(), np.abs(x.asnumpy()))
+    assert x.swapaxes(0, 1).shape == (3, 2)
+    np.testing.assert_allclose(x.sort(1).asnumpy(),
+                               np.sort(x.asnumpy(), 1))
+    np.testing.assert_allclose(x.argsort(1).asnumpy(),
+                               np.argsort(x.asnumpy(), 1))
+    np.testing.assert_allclose(x.tanh().asnumpy(),
+                               np.tanh(x.asnumpy()), rtol=1e-6)
+    np.testing.assert_allclose(x.norm(2, 1).asnumpy(),
+                               np.linalg.norm(x.asnumpy(), 2, 1),
+                               rtol=1e-6)
+    np.testing.assert_allclose(x.clip(2.0, 5.0).asnumpy(),
+                               np.clip(x.asnumpy(), 2, 5))
+    idx = nd.array([1, 0], dtype="int32")
+    np.testing.assert_allclose(x.take(idx).asnumpy(),
+                               x.asnumpy()[[1, 0]])
+    np.testing.assert_allclose(x.pick(idx, axis=1).asnumpy(),
+                               x.asnumpy()[np.arange(2), [1, 0]])
+    np.testing.assert_allclose(x.zeros_like().asnumpy(), 0.0)
+    np.testing.assert_allclose(x.ones_like().asnumpy(), 1.0)
+    parts = x.split(num_outputs=3, axis=1)
+    assert len(parts) == 3 and parts[0].shape == (2, 1)
+
+
+def test_fluent_methods_symbol_lockstep():
+    """The same fluent surface attaches to Symbol (hybridize safety)."""
+    from mxnet_tpu import sym, gluon
+    x = nd.array(np.array([[3.0, 1.0], [2.0, 4.0]], np.float32))
+    d = sym.var("d")
+    r = d.abs().sum(1).eval_dict({"d": x})
+    np.testing.assert_allclose(r.asnumpy(), np.abs(x.asnumpy()).sum(1))
+
+    class Net(gluon.HybridBlock):
+        def hybrid_forward(self, F, v):
+            return v.tanh().norm(2, 1)
+    n = Net(); n.initialize(); n.hybridize()
+    np.testing.assert_allclose(
+        n(x).asnumpy(),
+        np.linalg.norm(np.tanh(x.asnumpy()), 2, 1), rtol=1e-5)
+    # out= flows through the frontends on the nd side
+    y = nd.zeros((2, 2))
+    x.zeros_like(out=y)
+    assert float(y.asnumpy().sum()) == 0.0
