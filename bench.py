@@ -363,12 +363,22 @@ def bench_pipeline(n_images=1024, batch=128, threads=None,
     return row
 
 
-def _backend_reachable(timeout=600):
+PROBE_TIMEOUT_S = 2700
+
+
+def _backend_reachable(timeout=PROBE_TIMEOUT_S):
     """Probe the accelerator in a SUBPROCESS: a wedged TPU claim hangs
     inside the PJRT client where no Python timeout can interrupt it, so
-    the only safe watchdog is process isolation.  (Observed this round:
-    a killed remote compile left every jax.devices() call hanging
-    indefinitely — PERF.md outage log.)"""
+    the only safe watchdog is process isolation.  (Observed round 3: a
+    killed remote compile left every jax.devices() call hanging
+    indefinitely — PERF.md outage log.)
+
+    Timeout tradeoff, stated honestly: hitting TimeoutExpired still
+    SIGKILLs a child that may hold a chip claim — the wedge hazard is
+    reduced, not removed, by isolation.  The budget therefore carries a
+    wide margin over the outage fast-fail signature (round-4 probes took
+    a consistent ~25 min to return UNAVAILABLE; 45 min ≈ 1.8× that),
+    so only a genuinely hung probe gets killed."""
     import subprocess
     import sys
     try:
@@ -411,8 +421,8 @@ def main():
         # path's whole purpose) so the record still carries real
         # numbers next to the outage marker
         rows = {"error": "accelerator backend unreachable (claim hang "
-                         "or init failure) after 600s subprocess probe; "
-                         "host-only rows follow"}
+                         f"or init failure) after {PROBE_TIMEOUT_S}s "
+                         "subprocess probe; host-only rows follow"}
 
         def host_row(only, timeout=900):
             import os
