@@ -12,6 +12,11 @@ from tests.test_contrib_misc import *     # noqa: F401,F403
 from tests.test_ctc import *              # noqa: F401,F403
 from tests.test_quantization import *     # noqa: F401,F403
 from tests.test_ops_misc import *         # noqa: F401,F403
+from tests.test_op_sweep import *         # noqa: F401,F403
+from tests.test_control_flow import *     # noqa: F401,F403
+from tests.test_sparse import *           # noqa: F401,F403
+from tests.test_large_array import *      # noqa: F401,F403
+from tests.test_image import *            # noqa: F401,F403
 from tests.test_kernels import *          # noqa: F401,F403
 from tests.test_kernels_tpu import *      # noqa: F401,F403
 
