@@ -54,6 +54,38 @@ def _ctx_of(*xs):
     return current_context()
 
 
+def rand_zipfian(true_classes, num_sampled, range_max):
+    """Sampled-softmax candidate sampler (reference:
+    python/mxnet/ndarray/contrib.py rand_zipfian over
+    _sample_unique_zipfian): draws ``num_sampled`` unique classes from
+    Zipf(range_max) and returns (samples, expected_count_true,
+    expected_count_sampled) — the expected counts make the sampled
+    softmax an unbiased estimator (log-uniform class prior
+    p(c) = log((c+2)/(c+1)) / log(range_max+1))."""
+    import numpy as np
+
+    from .register import invoke_by_name
+    from .ndarray import array as nd_array
+
+    ctx = true_classes.context
+    # ctx as an op attr: invoke() honors it for zero-input ops, so ALL
+    # three outputs share true_classes' context (reference contract)
+    samples, num_tries = invoke_by_name(
+        "_sample_unique_zipfian", [],
+        {"range_max": int(range_max), "shape": (1, int(num_sampled)),
+         "ctx": ctx})
+    samples = samples.reshape((int(num_sampled),))
+    tries = float(num_tries.asnumpy()[0])
+    log_rm1 = np.log(float(range_max) + 1.0)
+    sv = samples.asnumpy().astype(np.float64)
+    p_sampled = np.log((sv + 2.0) / (sv + 1.0)) / log_rm1
+    tv = true_classes.asnumpy().astype(np.float64)
+    p_true = np.log((tv + 2.0) / (tv + 1.0)) / log_rm1
+    return (samples,
+            nd_array((p_true * tries).astype(np.float32), ctx=ctx),
+            nd_array((p_sampled * tries).astype(np.float32), ctx=ctx))
+
+
 def _recording():
     from .. import autograd as _ag
     return _ag.is_recording()

@@ -922,7 +922,7 @@ def _register_round3b():
     # by design: data-dependent rejection loops do not belong under trace
     # (same stance as boolean_mask), and candidates feed CPU-side lookup
     # anyway ---------------------------------------------------------------
-    def sample_unique_zipfian_maker(range_max=None, shape=None):
+    def sample_unique_zipfian_maker(range_max=None, shape=None, ctx=None):
         import numpy as onp
 
         from ..base import MXNetError
@@ -932,6 +932,11 @@ def _register_round3b():
             raise MXNetError(
                 f"_sample_unique_zipfian: cannot draw {shp[1]} unique "
                 f"candidates from range_max={rm}")
+        dev = None
+        if ctx is not None:
+            from ..context import Context
+            dev = (ctx if isinstance(ctx, Context)
+                   else Context.from_str(ctx)).device
 
         def fn():
             # seeded from the library key stream so mx.random.seed()
@@ -955,7 +960,11 @@ def _register_round3b():
                         seen.append(cand)
                 out[b] = seen
                 tries[b] = t
-            return jnp.asarray(out), jnp.asarray(tries)
+            o, tr = jnp.asarray(out), jnp.asarray(tries)
+            if dev is not None:
+                o = jax.device_put(o, dev)
+                tr = jax.device_put(tr, dev)
+            return o, tr
         return fn
     register_op("_sample_unique_zipfian", sample_unique_zipfian_maker,
                 differentiable=False, use_jit=False)

@@ -246,3 +246,35 @@ def test_update_token_vectors_atomic(tmp_path):
     # nothing written: the failed call must not half-mutate the table
     np.testing.assert_array_equal(emb.get_vecs_by_tokens("a").asnumpy(),
                                   before)
+
+
+def test_rand_zipfian_sampled_softmax_counts():
+    """reference: nd.contrib.rand_zipfian — unique candidates plus the
+    log-uniform expected counts that de-bias sampled softmax."""
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    mx.random.seed(5)
+    true = nd.array(np.array([1, 7, 42], np.float32))
+    samples, cnt_true, cnt_sampled = nd.contrib.rand_zipfian(
+        true, num_sampled=30, range_max=500)
+    sv = samples.asnumpy()
+    assert sv.shape == (30,) and len(set(sv.tolist())) == 30
+    assert sv.min() >= 0 and sv.max() < 500
+    # expected counts follow the log-uniform prior: ratio between two
+    # classes matches the analytic prior ratio
+    p = lambda c: np.log((c + 2.0) / (c + 1.0)) / np.log(501.0)
+    ct = cnt_true.asnumpy()
+    np.testing.assert_allclose(ct[0] / ct[1], p(1) / p(7), rtol=1e-5)
+    assert (cnt_sampled.asnumpy() > 0).all()
+    # reproducible under the library seed
+    mx.random.seed(5)
+    s2, _, _ = nd.contrib.rand_zipfian(true, num_sampled=30, range_max=500)
+    np.testing.assert_array_equal(sv, s2.asnumpy())
+
+
+def test_rand_zipfian_context_consistency():
+    import mxnet_tpu as mx
+    from mxnet_tpu import nd
+    true = nd.array(np.array([1.0, 2.0], np.float32), ctx=mx.cpu(0))
+    s, ct, cs = nd.contrib.rand_zipfian(true, num_sampled=5, range_max=50)
+    assert s.context == ct.context == cs.context == mx.cpu(0)
