@@ -1,0 +1,17 @@
+import json, datetime, sys
+t0 = datetime.datetime.now().isoformat()
+try:
+    import jax
+    devs = jax.devices()
+    import jax.numpy as jnp
+    x = jnp.ones((256, 256))
+    y = (x @ x).block_until_ready()
+    ok = True
+    err = None
+    extra = {"devices": [str(d) for d in devs], "sum": float(y.sum())}
+except Exception as e:
+    ok = False
+    err = f"{type(e).__name__}: {e}"
+    extra = {}
+t1 = datetime.datetime.now().isoformat()
+print(json.dumps({"t0": t0, "t1": t1, "ok": ok, "err": err, **extra}))

@@ -25,6 +25,7 @@ from . import ops_contrib as _ops_contrib        # noqa: F401
 from . import ops_linalg as _ops_linalg          # noqa: F401
 from . import ops_spatial as _ops_spatial        # noqa: F401
 from . import ops_quantization as _ops_quant     # noqa: F401
+from . import ops_random as _ops_random          # noqa: F401
 from . import ops_ctc as _ops_ctc                # noqa: F401
 from . import ops_misc as _ops_misc              # noqa: F401
 from . import ops_control_flow as _ops_cf        # noqa: F401
@@ -131,12 +132,13 @@ def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=None, **kwargs):
     """Dropout; active only under autograd.train_mode (or mode='always'),
     matching the reference op's behavior."""
     from .. import autograd as _ag
-    from .. import random as _grandom
     if mode != "always" and not _ag.is_training():
         return identity(data)                                 # noqa: F821
-    key = _grandom.next_key()
-    return invoke_by_name("Dropout", [data, from_jax(key, ctx=data.context)],
-                          {"p": p, "axes": tuple(axes)})
+    # this frontend has already decided the op is ACTIVE, so it invokes
+    # with mode='always' — which also tells node_takes_key to append the
+    # PRNG key (the training-gated form exists only as a graph node)
+    return invoke_by_name("Dropout", [data],
+                          {"p": p, "axes": tuple(axes), "mode": "always"})
 
 
 dropout = Dropout

@@ -72,90 +72,142 @@ def _register():
     import jax
     import jax.numpy as jnp
 
+    # The three control-flow ops are registered needs_rng=True so a base
+    # PRNG key always arrives as their LAST input (eager invoke appends
+    # one; the symbol runner splits one off the per-forward key).  Bodies
+    # containing sampling nodes (Dropout under is_train, _random_*) get
+    # per-iteration subkeys threaded through the scan carry — fresh draws
+    # every step, still one XLA compilation.  Bodies without sampling
+    # ignore the key.  The executor's train/eval mode reaches the body
+    # through the ``_training`` parameter (the BatchNorm convention), so
+    # Dropout inside a body is real dropout under is_train=True and
+    # identity at inference.
+
     def foreach_maker(subgraph=None, data_names=(), state_names=(),
-                      free_names=(), n_outs=1):
+                      free_names=(), n_outs=1, _training=False):
         data_names = _names(data_names)
         state_names = _names(state_names)
         free_names = _names(free_names)
-        run = subgraph.sym.compile()
+        run = subgraph.sym.compile(training=_training)
         nd_, ns = len(data_names), len(state_names)
 
+        takes_key = run.needs_rng    # must mirror register.op_takes_key
+
         def fn(*vals):
+            import jax.random as jr
+            key = None
+            if takes_key:
+                key, vals = vals[-1], vals[:-1]
             data = vals[:nd_]
             states = tuple(vals[nd_:nd_ + ns])
             feed_free = dict(zip(free_names, vals[nd_ + ns:]))
 
             def step(carry, xs):
+                key, state = carry
                 feed = dict(zip(data_names, xs))
-                feed.update(zip(state_names, carry))
+                feed.update(zip(state_names, state))
                 feed.update(feed_free)
+                if takes_key:
+                    key, sub = jr.split(key)
+                    feed["__rng_key__"] = sub
                 res = run(feed)
-                return tuple(res[n_outs:]), tuple(res[:n_outs])
+                return (key, tuple(res[n_outs:])), tuple(res[:n_outs])
 
-            carry, ys = jax.lax.scan(step, states, tuple(data))
+            if not takes_key:
+                key = jnp.zeros((), jnp.uint32)   # inert carry slot
+            (_, carry), ys = jax.lax.scan(step, (key, states), tuple(data))
             out = tuple(ys) + tuple(carry)
             return out if len(out) > 1 else out[0]
         return fn
-    register_op("_foreach", foreach_maker,
+    register_op("_foreach", foreach_maker, needs_rng=True,
                 ref="src/operator/control_flow.cc (foreach)")
 
     def while_loop_maker(cond_subgraph=None, body_subgraph=None,
                          loop_names=(), free_names=(), n_outs=1,
-                         max_iterations=0):
+                         max_iterations=0, _training=False):
         loop_names = _names(loop_names)
         free_names = _names(free_names)
-        cond_run = cond_subgraph.sym.compile()
-        body_run = body_subgraph.sym.compile()
+        cond_run = cond_subgraph.sym.compile(training=_training)
+        body_run = body_subgraph.sym.compile(training=_training)
         nl = len(loop_names)
         T = int(max_iterations)
 
+        takes_key = cond_run.needs_rng or body_run.needs_rng
+
         def fn(*vals):
+            import jax.random as jr
+            key = None
+            if takes_key:
+                key, vals = vals[-1], vals[:-1]
             lv0 = tuple(vals[:nl])
             feed_free = dict(zip(free_names, vals[nl:]))
 
-            def feed_of(lv):
+            def feed_of(lv, sub):
                 feed = dict(zip(loop_names, lv))
                 feed.update(feed_free)
+                if sub is not None:
+                    feed["__rng_key__"] = sub
                 return feed
 
             def step(carry, _):
-                active, lv = carry
+                key, active, lv = carry
+                kc = kb = None
+                if takes_key:
+                    key, kc, kb = jr.split(key, 3)
                 active = jnp.logical_and(
                     active,
-                    jnp.asarray(cond_run(feed_of(lv))[0]).reshape(())
-                    .astype(bool))
-                res = body_run(feed_of(lv))
+                    jnp.asarray(cond_run(feed_of(
+                        lv, kc if cond_run.needs_rng else None))[0])
+                    .reshape(()).astype(bool))
+                res = body_run(feed_of(
+                    lv, kb if body_run.needs_rng else None))
                 outs = tuple(jnp.where(active, o, jnp.zeros_like(o))
                              for o in res[:n_outs])
                 new_lv = tuple(
                     jnp.where(active, n, p)
                     for n, p in zip(res[n_outs:], lv))
-                return (active, new_lv), outs
+                return (key, active, new_lv), outs
 
-            (_, lv), bufs = jax.lax.scan(
-                step, (jnp.asarray(True), lv0), None, length=T)
+            if not takes_key:
+                key = jnp.zeros((), jnp.uint32)   # inert carry slot
+            (_, _, lv), bufs = jax.lax.scan(
+                step, (key, jnp.asarray(True), lv0), None, length=T)
             out = tuple(bufs) + tuple(lv)
             return out if len(out) > 1 else out[0]
         return fn
-    register_op("_while_loop", while_loop_maker,
+    register_op("_while_loop", while_loop_maker, needs_rng=True,
                 ref="src/operator/control_flow.cc (while_loop)")
 
     def cond_maker(then_subgraph=None, else_subgraph=None, free_names=(),
-                   n_outs=1):
+                   n_outs=1, _training=False):
         free_names = _names(free_names)
-        then_run = then_subgraph.sym.compile()
-        else_run = else_subgraph.sym.compile()
+        then_run = then_subgraph.sym.compile(training=_training)
+        else_run = else_subgraph.sym.compile(training=_training)
+
+        takes_key = then_run.needs_rng or else_run.needs_rng
 
         def fn(pred, *frees):
+            import jax.random as jr
+            if takes_key:
+                key, frees = frees[-1], frees[:-1]
+                kt, ke = jr.split(key)
             feed = dict(zip(free_names, frees))
             p = jnp.asarray(pred).reshape(()).astype(bool)
-            out = jax.lax.cond(p,
-                               lambda f: tuple(then_run(f)[:n_outs]),
-                               lambda f: tuple(else_run(f)[:n_outs]),
-                               feed)
+
+            def then_branch(f):
+                if then_run.needs_rng:
+                    f = dict(f, __rng_key__=kt)
+                return tuple(then_run(f)[:n_outs])
+
+            def else_branch(f):
+                if else_run.needs_rng:
+                    f = dict(f, __rng_key__=ke)
+                return tuple(else_run(f)[:n_outs])
+
+            out = jax.lax.cond(p, then_branch, else_branch, feed)
             return out if len(out) > 1 else out[0]
         return fn
-    register_op("_cond", cond_maker,
+    register_op("_cond", cond_maker, needs_rng=True,
                 ref="src/operator/control_flow.cc (cond)")
 
 

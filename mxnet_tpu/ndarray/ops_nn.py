@@ -431,7 +431,8 @@ def _register():
             mask = jax.random.bernoulli(key, kp, tuple(shape))
             return jnp.where(mask, x / kp, 0.0).astype(x.dtype)
         return fn
-    register_op("Dropout", dropout_maker, aliases=("dropout",))
+    register_op("Dropout", dropout_maker, aliases=("dropout",),
+                needs_rng=True)
 
     # ---- resize / upsample ----------------------------------------------
     def upsampling_maker(scale=1, num_filter=0, sample_type="nearest",

@@ -1,0 +1,338 @@
+"""Sampling operators: the ``_random_*`` / ``_sample_*`` registry families.
+
+Reference role: src/operator/random/sample_op.cc (scalar-parameter draws),
+src/operator/random/multisample_op.cc (per-element parameter draws) and
+src/operator/random/shuffle_op.cc — the raw ops behind ``mx.nd.random.*`` /
+``mx.sym.random.*`` (SURVEY.md §2.2 random/ row).
+
+TPU-native design: every sampling op is a *pure* function taking a PRNG key
+as its LAST input (``Operator.needs_rng``).  Eager frontends split the key
+off the process-global stream (mxnet_tpu/random.py) per call; the symbol
+runner splits one base key per forward across all sampling nodes
+(symbol.py ``compile``).  This replaces the reference's per-device resource
+RNG states (src/resource.cc) with the jax key discipline: draws are
+reproducible from ``mx.random.seed`` yet jit-compatible — the key is an
+argument, so compiled graphs get fresh randomness per call without
+recompiling.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import dtype_np
+from .register import register_op
+
+
+def _canon_shape(shape):
+    if shape is None:
+        return (1,)
+    if isinstance(shape, (int, _np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _draw_shape(shape):
+    """Trailing draw shape for _sample_* ops (default: one draw/element)."""
+    if shape is None or shape == () or shape == 0:
+        return ()
+    if isinstance(shape, (int, _np.integer)):
+        return (int(shape),)
+    return tuple(int(s) for s in shape)
+
+
+def _register():
+    import jax.numpy as jnp
+    import jax.random as jr
+
+    def _placed(fn, ctx):
+        """Honor the reference's ctx-as-op-attribute convention (init_op.cc
+        creation ops do the same — see ops_misc._place): place the draw on
+        the requested device."""
+        if ctx is None:
+            return fn
+        from ..context import Context
+        dev = (ctx if isinstance(ctx, Context)
+               else Context.from_str(ctx)).device
+
+        def placed(*a):
+            import jax
+            return jax.device_put(fn(*a), dev)
+        return placed
+
+    # -- scalar-parameter draws (sample_op.cc) ----------------------------
+    # use_jit=False throughout this family: distribution parameters live in
+    # the maker closure, so a jitted fn would trigger one permanent XLA
+    # compilation PER PARAMETER VALUE (unbounded for loops sweeping lam/
+    # low/high).  Eager jax.random calls cache their kernels by shape, so
+    # the eager path costs nothing extra — and inside a jitted GRAPH
+    # (symbol runner / CachedOp) the fn is traced into the enclosing
+    # compilation anyway, where use_jit is irrelevant.
+
+    def uniform_maker(low=0.0, high=1.0, shape=None, dtype=None, ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            return jr.uniform(key, shp, dt, float(low), float(high))
+        return _placed(fn, ctx)
+    register_op("_random_uniform", uniform_maker, needs_rng=True,
+                differentiable=False, use_jit=False)
+
+    def normal_maker(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            return (jr.normal(key, shp, dt) * scale + loc).astype(dt)
+        return _placed(fn, ctx)
+    register_op("_random_normal", normal_maker, needs_rng=True,
+                differentiable=False, use_jit=False)
+
+    def gamma_maker(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            a = jnp.asarray(float(alpha), dt)
+            return (jr.gamma(key, a, shp, dt) * beta).astype(dt)
+        return _placed(fn, ctx)
+    register_op("_random_gamma", gamma_maker, needs_rng=True,
+                differentiable=False, use_jit=False)
+
+    def exponential_maker(lam=1.0, shape=None, dtype=None, ctx=None,
+                          scale=None):
+        # reference parameterizes by rate lambda; the eager frontend's
+        # historical `scale` (=1/lambda) is accepted too
+        sc = float(scale) if scale is not None else 1.0 / float(lam)
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            return (jr.exponential(key, shp, dt) * sc).astype(dt)
+        return _placed(fn, ctx)
+    register_op("_random_exponential", exponential_maker, needs_rng=True,
+                differentiable=False, use_jit=False)
+
+    def poisson_maker(lam=1.0, shape=None, dtype=None, ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            return jr.poisson(key, float(lam), shp).astype(dt)
+        return _placed(fn, ctx)
+    register_op("_random_poisson", poisson_maker, needs_rng=True,
+                differentiable=False, use_jit=False)
+
+    def negative_binomial_maker(k=1, p=1.0, shape=None, dtype=None,
+                                ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            kg, kp = jr.split(key)
+            # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
+            g = jr.gamma(kg, jnp.asarray(float(k), jnp.float32), shp)
+            lam = g * ((1.0 - float(p)) / float(p))
+            return jr.poisson(kp, lam, shp).astype(dt)
+        return _placed(fn, ctx)
+    register_op("_random_negative_binomial", negative_binomial_maker,
+                needs_rng=True, differentiable=False, use_jit=False)
+
+    def gnb_maker(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        k = 1.0 / float(alpha)
+        p = k / (k + float(mu))
+
+        def fn(key):
+            kg, kp = jr.split(key)
+            g = jr.gamma(kg, jnp.asarray(k, jnp.float32), shp)
+            lam = g * ((1.0 - p) / p)
+            return jr.poisson(kp, lam, shp).astype(dt)
+        return _placed(fn, ctx)
+    register_op("_random_generalized_negative_binomial", gnb_maker,
+                needs_rng=True, differentiable=False, use_jit=False)
+
+    def randint_maker(low=0, high=1, shape=None, dtype="int32", ctx=None):
+        shp, dt = _canon_shape(shape), dtype_np(dtype)
+
+        def fn(key):
+            return jr.randint(key, shp, int(low), int(high), dt)
+        return _placed(fn, ctx)
+    register_op("_random_randint", randint_maker, needs_rng=True,
+                differentiable=False, use_jit=False)
+
+    # -- *_like draws: shape/dtype follow the data input ------------------
+
+    def _like(drawer):
+        def like_maker(dtype=None, **params):
+            def fn(data, key):
+                dt = data.dtype if dtype is None else dtype_np(dtype)
+                return drawer(key, data.shape, dt, params)
+            return fn
+        return like_maker
+
+    register_op("_random_uniform_like", _like(
+        lambda key, s, dt, p: jr.uniform(key, s, dt, float(p.get("low", 0.0)),
+                                         float(p.get("high", 1.0)))),
+        needs_rng=True, differentiable=False, use_jit=False)
+    register_op("_random_normal_like", _like(
+        lambda key, s, dt, p: jr.normal(key, s, dt)
+        * float(p.get("scale", 1.0)) + float(p.get("loc", 0.0))),
+        needs_rng=True, differentiable=False, use_jit=False)
+    register_op("_random_gamma_like", _like(
+        lambda key, s, dt, p: jr.gamma(
+            key, jnp.asarray(float(p.get("alpha", 1.0)), dt), s, dt)
+        * float(p.get("beta", 1.0))),
+        needs_rng=True, differentiable=False, use_jit=False)
+    register_op("_random_exponential_like", _like(
+        lambda key, s, dt, p: jr.exponential(key, s, dt)
+        / float(p.get("lam", 1.0))),
+        needs_rng=True, differentiable=False, use_jit=False)
+    register_op("_random_poisson_like", _like(
+        lambda key, s, dt, p: jr.poisson(
+            key, float(p.get("lam", 1.0)), s).astype(dt)),
+        needs_rng=True, differentiable=False, use_jit=False)
+
+    # -- per-element-parameter draws (multisample_op.cc) ------------------
+    # Params are tensor inputs of a common (broadcast) shape s; output is
+    # s + shape, one independent draw block per parameter element.
+
+    def _bcast(vals):
+        return jnp.broadcast_arrays(*vals) if len(vals) > 1 else list(vals)
+
+    def _expand(v, ndraw):
+        return jnp.reshape(v, v.shape + (1,) * ndraw)
+
+    def sample_uniform_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(low, high, key):
+            low, high = _bcast([low, high])
+            out_shape = tuple(low.shape) + draw
+            u = jr.uniform(key, out_shape, dt)
+            lo, hi = _expand(low, len(draw)), _expand(high, len(draw))
+            return (lo + u * (hi - lo)).astype(dt)
+        return fn
+    register_op("_sample_uniform", sample_uniform_maker, needs_rng=True,
+                differentiable=False)
+
+    def sample_normal_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(mu, sigma, key):
+            mu, sigma = _bcast([mu, sigma])
+            out_shape = tuple(mu.shape) + draw
+            z = jr.normal(key, out_shape, dt)
+            return (_expand(mu, len(draw))
+                    + z * _expand(sigma, len(draw))).astype(dt)
+        return fn
+    register_op("_sample_normal", sample_normal_maker, needs_rng=True,
+                differentiable=False)
+
+    def sample_gamma_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(alpha, beta, key):
+            alpha, beta = _bcast([alpha, beta])
+            out_shape = tuple(alpha.shape) + draw
+            a = jnp.broadcast_to(_expand(alpha, len(draw)), out_shape)
+            g = jr.gamma(key, a.astype(dt), out_shape, dt)
+            return (g * _expand(beta, len(draw))).astype(dt)  # beta = scale
+        return fn
+    register_op("_sample_gamma", sample_gamma_maker, needs_rng=True,
+                differentiable=False)
+
+    def sample_exponential_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(lam, key):
+            out_shape = tuple(lam.shape) + draw
+            e = jr.exponential(key, out_shape, dt)
+            return (e / _expand(lam, len(draw))).astype(dt)
+        return fn
+    register_op("_sample_exponential", sample_exponential_maker,
+                needs_rng=True, differentiable=False)
+
+    def sample_poisson_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(lam, key):
+            out_shape = tuple(lam.shape) + draw
+            lam_b = jnp.broadcast_to(_expand(lam, len(draw)), out_shape)
+            return jr.poisson(key, lam_b.astype(_np.float32),
+                              out_shape).astype(dt)
+        return fn
+    register_op("_sample_poisson", sample_poisson_maker, needs_rng=True,
+                differentiable=False)
+
+    def sample_nb_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(k, p, key):
+            k, p = _bcast([k, p])
+            out_shape = tuple(k.shape) + draw
+            kg, kp = jr.split(key)
+            k_b = jnp.broadcast_to(_expand(k, len(draw)), out_shape)
+            p_b = jnp.broadcast_to(_expand(p, len(draw)), out_shape)
+            g = jr.gamma(kg, k_b.astype(_np.float32), out_shape)
+            lam = g * (1.0 - p_b) / p_b
+            return jr.poisson(kp, lam, out_shape).astype(dt)
+        return fn
+    register_op("_sample_negative_binomial", sample_nb_maker,
+                needs_rng=True, differentiable=False)
+
+    def sample_gnb_maker(shape=None, dtype=None, ctx=None):
+        draw = _draw_shape(shape)
+        dt = dtype_np(dtype)
+
+        def fn(mu, alpha, key):
+            mu, alpha = _bcast([mu, alpha])
+            out_shape = tuple(mu.shape) + draw
+            # gnb(mu, alpha) == NB(k=1/alpha, p=1/(1+alpha*mu))
+            k = 1.0 / jnp.maximum(alpha, 1e-12)
+            p = 1.0 / (1.0 + alpha * mu)
+            kg, kp = jr.split(key)
+            k_b = jnp.broadcast_to(_expand(k, len(draw)), out_shape)
+            p_b = jnp.broadcast_to(_expand(p, len(draw)), out_shape)
+            g = jr.gamma(kg, k_b.astype(_np.float32), out_shape)
+            lam = g * (1.0 - p_b) / p_b
+            return jr.poisson(kp, lam, out_shape).astype(dt)
+        return fn
+    register_op("_sample_generalized_negative_binomial", sample_gnb_maker,
+                needs_rng=True, differentiable=False)
+
+    def sample_multinomial_maker(shape=None, get_prob=False, dtype="int32",
+                                 ctx=None):
+        n = 1 if shape in (None, ()) else (
+            int(shape) if isinstance(shape, (int, _np.integer))
+            else int(_np.prod(shape)))
+        squeeze = shape in (None, ())
+        dt = dtype_np(dtype)
+
+        def fn(p, key):
+            logits = jnp.log(jnp.maximum(p, 1e-30))
+            batch = p.shape[:-1]
+            samples = jr.categorical(key, logits[..., None, :], axis=-1,
+                                     shape=batch + (n,)).astype(dt)
+            out = samples[..., 0] if squeeze else samples
+            if not get_prob:
+                return out
+            lp = jnp.take_along_axis(
+                logits.reshape(-1, p.shape[-1]),
+                samples.reshape(-1, n).astype(jnp.int32), axis=-1)
+            lp = lp.reshape(batch + (n,))
+            return out, (lp[..., 0] if squeeze else lp)
+        return fn
+    register_op("_sample_multinomial", sample_multinomial_maker,
+                needs_rng=True, differentiable=False)
+
+    def shuffle_maker(ctx=None):
+        def fn(data, key):
+            perm = jr.permutation(key, data.shape[0])
+            return jnp.take(data, perm, axis=0)
+        return fn
+    register_op("_shuffle", shuffle_maker, needs_rng=True,
+                differentiable=False)
+
+
+_register()

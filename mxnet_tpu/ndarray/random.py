@@ -3,60 +3,73 @@
 Reference parity: src/operator/random/sample_op.cc (SURVEY.md §2.2) — the
 same distributions (uniform/normal/gamma/exponential/poisson/negative
 binomial/randint/multinomial), with shapes/dtypes/ctx semantics of the
-reference frontends.  Keys come from the process-global stream in
-mxnet_tpu.random; draws are not differentiable (as in the reference).
+reference frontends.  Every draw routes through the ``_random_*`` /
+``_sample_*`` registry ops (ops_random.py) — the same ops the symbol
+frontends and the C ABI dispatch — with the PRNG key split off the
+process-global stream (mxnet_tpu.random) and passed as the op's last
+input.  Draws are not differentiable (as in the reference).
 """
 from __future__ import annotations
 
 import numpy as _np
 
-from ..base import dtype_np
 from ..context import current_context
 from .. import random as _grandom
 from .ndarray import NDArray
+from .register import invoke_by_name
 
 __all__ = ["uniform", "normal", "randn", "randint", "exponential", "gamma",
            "poisson", "negative_binomial", "generalized_negative_binomial",
            "multinomial", "shuffle", "bernoulli"]
 
 
-def _prep(shape, ctx, dtype):
-    import jax
-    ctx = ctx if ctx is not None else current_context()
-    if shape is None:
-        shape = (1,)
-    if isinstance(shape, int):
-        shape = (shape,)
-    return tuple(shape), ctx, dtype_np(dtype)
+from .ops_random import _canon_shape as _shape_attr  # shared rule
 
 
-def _wrap(val, ctx):
+def _dtype_attr(dtype):
+    """Canonical string form for the op's dtype attribute."""
+    return dtype if isinstance(dtype, str) else str(_np.dtype(dtype))
+
+
+def _is_tensor_param(p):
     import jax
-    return NDArray(jax.device_put(val, ctx.device), ctx=ctx)
+    return isinstance(p, (NDArray, _np.ndarray, list, jax.Array))
+
+
+def _dispatch(scalar_op, sample_op, params, names, shape, dtype, ctx, out,
+              **scalar_extra):
+    """Reference frontend rule (python/mxnet/ndarray/random.py
+    _random_helper): all-scalar parameters -> the ``_random_*`` op;
+    tensor parameters -> the per-element ``_sample_*`` op (output shape
+    = param shape + draw shape)."""
+    if any(_is_tensor_param(p) for p in params):
+        return _sample(sample_op, list(params), shape, dtype, out=out)
+    kw = dict(zip(names, (float(p) for p in params)))
+    kw.update(scalar_extra)
+    return _draw(scalar_op, shape, dtype, ctx, out, **kw)
+
+
+def _draw(op_name, shape, dtype, ctx, out, **params):
+    attrs = {"shape": _shape_attr(shape), **params}
+    # always pin the device (nd.zeros places on current_context() too) —
+    # otherwise the buffer would land on jax's default device while the
+    # NDArray is tagged with the current context
+    attrs["ctx"] = str(ctx if ctx is not None else current_context())
+    if dtype is not None:
+        attrs["dtype"] = _dtype_attr(dtype)
+    return invoke_by_name(op_name, [], attrs, out=out)
 
 
 def uniform(low=0.0, high=1.0, shape=None, dtype=None, ctx=None, out=None,
             **kwargs):
-    import jax.random as jr
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    val = jr.uniform(_grandom.next_key(), shape, dt, low, high)
-    r = _wrap(val, ctx)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    return _dispatch("_random_uniform", "_sample_uniform", [low, high],
+                     ("low", "high"), shape, dtype, ctx, out)
 
 
 def normal(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None, out=None,
            **kwargs):
-    import jax.random as jr
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    val = jr.normal(_grandom.next_key(), shape, dt) * scale + loc
-    r = _wrap(val, ctx)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    return _dispatch("_random_normal", "_sample_normal", [loc, scale],
+                     ("loc", "scale"), shape, dtype, ctx, out)
 
 
 def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kwargs):
@@ -66,120 +79,67 @@ def randn(*shape, loc=0.0, scale=1.0, dtype=None, ctx=None, **kwargs):
 
 def randint(low, high, shape=None, dtype="int32", ctx=None, out=None,
             **kwargs):
-    import jax.random as jr
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    val = jr.randint(_grandom.next_key(), shape, int(low), int(high), dt)
-    r = _wrap(val, ctx)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    return _draw("_random_randint", shape, dtype, ctx, out,
+                 low=int(low), high=int(high))
 
 
 def exponential(scale=1.0, shape=None, dtype=None, ctx=None, out=None,
                 **kwargs):
-    import jax.random as jr
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    val = jr.exponential(_grandom.next_key(), shape, dt) * scale
-    r = _wrap(val, ctx)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    if _is_tensor_param(scale):
+        lam = (1.0 / scale) if isinstance(scale, NDArray) \
+            else 1.0 / _np.asarray(scale, _np.float32)
+        return _sample("_sample_exponential", [lam], shape, dtype, out=out)
+    return _draw("_random_exponential", shape, dtype, ctx, out,
+                 scale=float(scale))
 
 
 def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None, out=None,
           **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    a = jnp.asarray(alpha, dt)
-    val = jr.gamma(_grandom.next_key(), a, shape, dt) * beta
-    r = _wrap(val, ctx)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    return _dispatch("_random_gamma", "_sample_gamma", [alpha, beta],
+                     ("alpha", "beta"), shape, dtype, ctx, out)
 
 
 def poisson(lam=1.0, shape=None, dtype=None, ctx=None, out=None, **kwargs):
-    import jax.random as jr
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    val = jr.poisson(_grandom.next_key(), lam, shape).astype(dt)
-    r = _wrap(val, ctx)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    return _dispatch("_random_poisson", "_sample_poisson", [lam],
+                     ("lam",), shape, dtype, ctx, out)
 
 
 def negative_binomial(k=1, p=1.0, shape=None, dtype=None, ctx=None,
                       out=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    # NB(k, p) = Poisson(Gamma(k, (1-p)/p))
-    g = jr.gamma(_grandom.next_key(), jnp.asarray(float(k), jnp.float32),
-                 shape) * ((1.0 - p) / p)
-    val = jr.poisson(_grandom.next_key(), g, shape).astype(dt)
-    return _wrap(val, ctx)
+    return _dispatch("_random_negative_binomial",
+                     "_sample_negative_binomial", [k, p], ("k", "p"),
+                     shape, dtype, ctx, out)
 
 
 def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
                                   ctx=None, out=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    k = 1.0 / alpha
-    p = k / (k + mu)
-    g = jr.gamma(_grandom.next_key(), jnp.asarray(k, jnp.float32),
-                 shape) * ((1.0 - p) / p)
-    val = jr.poisson(_grandom.next_key(), g, shape).astype(dt)
-    return _wrap(val, ctx)
+    return _dispatch("_random_generalized_negative_binomial",
+                     "_sample_generalized_negative_binomial", [mu, alpha],
+                     ("mu", "alpha"), shape, dtype, ctx, out)
 
 
 def multinomial(data, shape=None, get_prob=False, dtype="int32", **kwargs):
     """Sample category indices from (batched) probability rows."""
-    import jax.random as jr
-    import jax.numpy as jnp
-    n = 1 if shape is None else (shape if isinstance(shape, int)
-                                 else int(_np.prod(shape)))
-    p = data._read()
-    logits = jnp.log(jnp.maximum(p, 1e-30))
-    if p.ndim == 1:
-        out_shape = (n,)
-        samples = jr.categorical(_grandom.next_key(), logits, shape=(n,))
-    else:
-        samples = jr.categorical(_grandom.next_key(), logits[:, None, :],
-                                 axis=-1, shape=(p.shape[0], n))
-        out_shape = (p.shape[0], n)
-    val = samples.reshape(out_shape).astype(dtype_np(dtype))
-    if shape is None:
-        val = val.reshape(val.shape[:-1] + ()) if p.ndim == 1 else \
-            val.reshape((p.shape[0],))
-        if p.ndim == 1:
-            val = val.reshape(())
-    r = _wrap(val, data.context)
-    if get_prob:
-        lp = jnp.take_along_axis(
-            jnp.log(jnp.maximum(p, 1e-30)).reshape(-1, p.shape[-1]),
-            val.reshape(-1, 1).astype(jnp.int32), axis=-1)
-        return r, _wrap(lp.reshape(val.shape), data.context)
-    return r
+    attrs = {"get_prob": bool(get_prob), "dtype": dtype}
+    if shape is not None:
+        attrs["shape"] = shape if isinstance(shape, int) else tuple(shape)
+    return invoke_by_name("_sample_multinomial", [data], attrs)
 
 
 def shuffle(data, **kwargs):
-    import jax.random as jr
-    val = data._read()
-    perm = jr.permutation(_grandom.next_key(), val.shape[0])
-    return _wrap(val[perm], data.context)
+    return invoke_by_name("_shuffle", [data], {})
 
 
 def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, **kwargs):
+    # not a reference 1.x op; kept as a convenience frontend
+    import jax
     import jax.random as jr
-    shape, ctx, dt = _prep(shape, ctx, dtype)
-    val = jr.bernoulli(_grandom.next_key(), prob, shape).astype(dt)
-    return _wrap(val, ctx)
+    from ..base import dtype_np
+    ctx = ctx if ctx is not None else current_context()
+    shape = _shape_attr(shape)
+    val = jr.bernoulli(_grandom.next_key(), prob, shape).astype(
+        dtype_np(dtype))
+    return NDArray(jax.device_put(val, ctx.device), ctx=ctx)
 
 
 # ---------------------------------------------------------------------------
@@ -188,117 +148,45 @@ def bernoulli(prob=0.5, shape=None, dtype=None, ctx=None, **kwargs):
 # output s + shape, one draw block per parameter element)
 # ---------------------------------------------------------------------------
 
-def _sample_params(params, shape):
-    """Common prep: read param arrays, broadcast them to a common shape
-    (so scalar/array parameter mixes work and every parameter row gets
-    its own independent draw block), normalize the draw shape."""
-    vals = [p._read() if isinstance(p, NDArray) else _np.asarray(
-        p, dtype=_np.float32) for p in params]
-    if len(vals) > 1:
-        vals = list(_np.broadcast_arrays(*[_np.asarray(v) for v in vals]))
-    else:
-        vals = [_np.asarray(vals[0])]
-    if shape is None:
-        shape = ()
-    if isinstance(shape, int):
-        shape = (shape,)
-    ctx = next((p.context for p in params if isinstance(p, NDArray)),
-               current_context())
-    return vals, tuple(shape), ctx
-
-
-def _sample_out_shape(pshape, shape):
-    return tuple(pshape) + tuple(shape)
+def _sample(op_name, params, shape, dtype, out=None, **extra):
+    arrs = [p if isinstance(p, NDArray) else _np.asarray(p, _np.float32)
+            for p in params]
+    attrs = dict(extra)
+    if shape is not None:
+        attrs["shape"] = shape if isinstance(shape, int) else tuple(shape)
+    if dtype is not None:
+        attrs["dtype"] = _dtype_attr(dtype)
+    return invoke_by_name(op_name, arrs, attrs, out=out)
 
 
 def sample_uniform(low, high, shape=None, dtype=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    (lo, hi), shape, ctx = _sample_params([low, high], shape)
-    dt = dtype_np(dtype)
-    out_shape = _sample_out_shape(lo.shape, shape)
-    u = jr.uniform(_grandom.next_key(), out_shape, dt or _np.float32)
-    lo_b = jnp.reshape(lo, lo.shape + (1,) * len(shape))
-    hi_b = jnp.reshape(hi, hi.shape + (1,) * len(shape))
-    return _wrap((lo_b + u * (hi_b - lo_b)).astype(dt or lo.dtype), ctx)
+    return _sample("_sample_uniform", [low, high], shape, dtype)
 
 
 def sample_normal(mu, sigma, shape=None, dtype=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    (mu_v, sg), shape, ctx = _sample_params([mu, sigma], shape)
-    dt = dtype_np(dtype)
-    out_shape = _sample_out_shape(mu_v.shape, shape)
-    z = jr.normal(_grandom.next_key(), out_shape, dt or _np.float32)
-    mu_b = jnp.reshape(mu_v, mu_v.shape + (1,) * len(shape))
-    sg_b = jnp.reshape(sg, sg.shape + (1,) * len(shape))
-    return _wrap((mu_b + z * sg_b).astype(dt or mu_v.dtype), ctx)
+    return _sample("_sample_normal", [mu, sigma], shape, dtype)
 
 
 def sample_gamma(alpha, beta, shape=None, dtype=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    (al, be), shape, ctx = _sample_params([alpha, beta], shape)
-    dt = dtype_np(dtype) or _np.float32
-    out_shape = _sample_out_shape(al.shape, shape)
-    al_b = jnp.broadcast_to(
-        jnp.reshape(al, al.shape + (1,) * len(shape)), out_shape)
-    g = jr.gamma(_grandom.next_key(), al_b.astype(dt), out_shape, dt)
-    be_b = jnp.reshape(be, be.shape + (1,) * len(shape))
-    return _wrap((g * be_b).astype(dt), ctx)   # beta is the scale
+    return _sample("_sample_gamma", [alpha, beta], shape, dtype)
 
 
 def sample_exponential(lam, shape=None, dtype=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    (lv,), shape, ctx = _sample_params([lam], shape)
-    dt = dtype_np(dtype) or _np.float32
-    out_shape = _sample_out_shape(lv.shape, shape)
-    e = jr.exponential(_grandom.next_key(), out_shape, dt)
-    lam_b = jnp.reshape(lv, lv.shape + (1,) * len(shape))
-    return _wrap((e / lam_b).astype(dt), ctx)
+    return _sample("_sample_exponential", [lam], shape, dtype)
 
 
 def sample_poisson(lam, shape=None, dtype=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    (lv,), shape, ctx = _sample_params([lam], shape)
-    dt = dtype_np(dtype) or _np.float32
-    out_shape = _sample_out_shape(lv.shape, shape)
-    lam_b = jnp.broadcast_to(
-        jnp.reshape(lv, lv.shape + (1,) * len(shape)), out_shape)
-    p = jr.poisson(_grandom.next_key(), lam_b.astype(_np.float32),
-                   out_shape)
-    return _wrap(p.astype(dt), ctx)
+    return _sample("_sample_poisson", [lam], shape, dtype)
 
 
 def sample_negative_binomial(k, p, shape=None, dtype=None, **kwargs):
-    import jax.random as jr
-    import jax.numpy as jnp
-    (kv, pv), shape, ctx = _sample_params([k, p], shape)
-    dt = dtype_np(dtype) or _np.float32
-    out_shape = _sample_out_shape(kv.shape, shape)
-    # NB(k,p) = Poisson(lambda), lambda ~ Gamma(k, (1-p)/p)
-    k_b = jnp.broadcast_to(
-        jnp.reshape(kv, kv.shape + (1,) * len(shape)), out_shape)
-    p_b = jnp.broadcast_to(
-        jnp.reshape(pv, pv.shape + (1,) * len(shape)), out_shape)
-    g = jr.gamma(_grandom.next_key(), k_b.astype(_np.float32), out_shape)
-    lam = g * (1.0 - p_b) / p_b
-    draw = jr.poisson(_grandom.next_key(), lam, out_shape)
-    return _wrap(draw.astype(dt), ctx)
+    return _sample("_sample_negative_binomial", [k, p], shape, dtype)
 
 
 def sample_generalized_negative_binomial(mu, alpha, shape=None, dtype=None,
                                          **kwargs):
-    import jax.numpy as jnp
-    (mv, av), shape, ctx = _sample_params([mu, alpha], shape)
-    # gnb(mu, alpha) == NB(k=1/alpha, p=1/(1+alpha*mu))
-    k = 1.0 / _np.maximum(av, 1e-12)
-    p = 1.0 / (1.0 + av * mv)
-    return sample_negative_binomial(
-        _wrap(jnp.asarray(k), ctx), _wrap(jnp.asarray(p), ctx),
-        shape=shape, dtype=dtype)
+    return _sample("_sample_generalized_negative_binomial", [mu, alpha],
+                   shape, dtype)
 
 
 def sample_multinomial(data, shape=None, get_prob=False, dtype="int32",
@@ -319,42 +207,40 @@ __all__ += ["sample_uniform", "sample_normal", "sample_gamma",
 # follow the input array)
 # ---------------------------------------------------------------------------
 
-def _like(fn, data, dtype=None, out=None, **kw):
-    r = fn(shape=data.shape, dtype=dtype or str(data.dtype),
-           ctx=data.context, **kw)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+def _like(op_name, data, out=None, dtype=None, **params):
+    if dtype is not None:
+        params["dtype"] = _dtype_attr(dtype)
+    return invoke_by_name(op_name, [data], params, out=out)
 
 
 def uniform_like(data, low=0.0, high=1.0, dtype=None, out=None, **kwargs):
-    return _like(uniform, data, dtype=dtype, out=out, low=low, high=high)
+    return _like("_random_uniform_like", data, out=out, dtype=dtype,
+                 low=float(low), high=float(high))
 
 
 def normal_like(data, loc=0.0, scale=1.0, dtype=None, out=None, **kwargs):
-    return _like(normal, data, dtype=dtype, out=out, loc=loc, scale=scale)
+    return _like("_random_normal_like", data, out=out, dtype=dtype,
+                 loc=float(loc), scale=float(scale))
 
 
 def gamma_like(data, alpha=1.0, beta=1.0, dtype=None, out=None, **kwargs):
-    return _like(gamma, data, dtype=dtype, out=out, alpha=alpha, beta=beta)
+    return _like("_random_gamma_like", data, out=out, dtype=dtype,
+                 alpha=float(alpha), beta=float(beta))
 
 
 def exponential_like(data, lam=1.0, dtype=None, out=None, **kwargs):
-    return _like(exponential, data, dtype=dtype, out=out, scale=1.0 / lam)
+    return _like("_random_exponential_like", data, out=out, dtype=dtype,
+                 lam=float(lam))
 
 
 def poisson_like(data, lam=1.0, dtype=None, out=None, **kwargs):
-    return _like(poisson, data, dtype=dtype, out=out, lam=lam)
+    return _like("_random_poisson_like", data, out=out, dtype=dtype,
+                 lam=float(lam))
 
 
 def randint_like(data, low=0, high=10, dtype="int32", out=None, **kwargs):
-    r = randint(low, high, shape=data.shape, dtype=dtype,
-                ctx=data.context)
-    if out is not None:
-        out._set_data(r._read())
-        return out
-    return r
+    return _draw("_random_randint", data.shape, dtype, data.context, out,
+                 low=int(low), high=int(high))
 
 
 __all__ += ["uniform_like", "normal_like", "gamma_like",

@@ -49,11 +49,12 @@ class Operator:
     """A registered operator (analog of ``nnvm::Op``)."""
 
     __slots__ = ("name", "maker", "aliases", "differentiable", "use_jit",
-                 "doc", "ref", "vjp_maker")
+                 "doc", "ref", "vjp_maker", "needs_rng")
 
     def __init__(self, name: str, maker: Callable, aliases: Sequence[str] = (),
                  differentiable: bool = True, use_jit: bool = True,
-                 doc: str = "", ref: str = "", vjp_maker: Callable = None):
+                 doc: str = "", ref: str = "", vjp_maker: Callable = None,
+                 needs_rng: bool = False):
         self.name = name
         self.maker = maker
         self.aliases = tuple(aliases)
@@ -62,6 +63,11 @@ class Operator:
         self.use_jit = use_jit
         self.doc = doc
         self.ref = ref              # reference file pointer for parity audits
+        # sampling ops take a PRNG key as their LAST tensor input (the
+        # jax key-threading discipline replacing the reference's per-device
+        # resource RNG states, src/resource.cc): eager frontends pass it
+        # explicitly; the symbol runner splits one per-forward base key
+        self.needs_rng = needs_rng
 
     @functools.lru_cache(maxsize=None)
     def _fn_cached(self, kwkey: Tuple) -> Callable:
@@ -120,12 +126,13 @@ class Operator:
 def register_op(name: str, maker: Optional[Callable] = None, *,
                 aliases: Sequence[str] = (), differentiable: bool = True,
                 use_jit: bool = True, doc: str = "", ref: str = "",
-                vjp_maker: Optional[Callable] = None):
+                vjp_maker: Optional[Callable] = None,
+                needs_rng: bool = False):
     """Register an operator.  Usable directly or as a decorator on the maker."""
     def do(mk):
         op = Operator(name, mk, aliases=aliases, differentiable=differentiable,
                       use_jit=use_jit, doc=doc or (mk.__doc__ or ""), ref=ref,
-                      vjp_maker=vjp_maker)
+                      vjp_maker=vjp_maker, needs_rng=needs_rng)
         for n in (name,) + tuple(aliases):
             # silent shadowing caused a real regression (round-4 review):
             # a later registration replaced an op under the same name with
@@ -195,6 +202,47 @@ def set_invoke_hook(fn) -> None:
     _invoke_hook = fn
 
 
+_SUBGRAPH_OPS = ("_foreach", "_while_loop", "_cond")
+
+
+def node_takes_key(op_name: str, attrs: Dict[str, Any],
+                   training: bool) -> bool:
+    """THE single active-sampling predicate: whether one op application
+    (given its attrs and the executor's train/eval mode) consumes a PRNG
+    key.  Every key decision — eager invoke, the symbol runner's per-node
+    split, graph-level needs_rng — routes through here, so key-feeding
+    and key-consumption cannot drift apart.
+      - Dropout gated to identity at inference consumes nothing.
+      - Control-flow ops consume only if a SUBGRAPH samples (recursively)
+        — an rng-free foreach must not advance the stream."""
+    op = _registry.get(op_name)
+    if op is None or not op.needs_rng:
+        return False
+    if op_name == "Dropout" and not training and \
+            attrs.get("mode", "training") != "always":
+        return False
+    if op_name in _SUBGRAPH_OPS:
+        return any(graph_needs_rng(v.sym, training)
+                   for v in attrs.values() if hasattr(v, "sym"))
+    return True
+
+
+def graph_needs_rng(sym, training: bool) -> bool:
+    """Any active sampling node in the graph (duck-typed Symbol: needs
+    only ``_topo()``)?  The cheap form of ``sym.compile(training)
+    .needs_rng`` — no runner closures are built just to read the bool."""
+    return any(not n.is_var and node_takes_key(n.op, n.attrs, training)
+               for n in sym._topo())
+
+
+def op_takes_key(op: Operator, kwargs: Dict[str, Any]) -> bool:
+    """``node_takes_key`` for an imperative invocation: kwargs play the
+    role of node attrs (``_training`` rides in them on the symbol path;
+    eager control flow runs in eval mode unless told otherwise)."""
+    return node_takes_key(op.name, kwargs,
+                          bool(kwargs.get("_training", False)))
+
+
 def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
            out=None):
     """Dispatch an op imperatively (reference stack §3.1).
@@ -222,6 +270,13 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
             ctx = current_context()
     nd_inputs = [_as_nd(x, ctx) for x in inputs]
     in_vals = [x._read() for x in nd_inputs]
+    if op_takes_key(op, kwargs):
+        # sampling ops take a PRNG key as their last input; eager dispatch
+        # draws it here (under a hybrid trace, next_key() yields a TRACED
+        # subkey of the CachedOp's key argument — push_key in random.py —
+        # so compiled graphs stay fresh per call)
+        from .. import random as _grandom
+        in_vals.append(_grandom.next_key())
 
     recording = (_autograd.is_recording() and op.differentiable
                  and any(getattr(x, "_ag", None) is not None

@@ -74,3 +74,19 @@ def set_state(key) -> None:
     global _key
     with _lock:
         _key = key
+
+
+def __getattr__(name):
+    # reference parity: python/mxnet/random.py re-exports the draw
+    # frontends, so ``mx.random.uniform(...)`` works alongside
+    # ``mx.nd.random.uniform``.  Lazy to avoid an import cycle (this
+    # module is imported by ndarray.random for the key stream).
+    _DRAWS = ("uniform", "normal", "randn", "randint", "exponential",
+              "gamma", "poisson", "negative_binomial",
+              "generalized_negative_binomial", "multinomial", "shuffle",
+              "bernoulli")
+    if name in _DRAWS:
+        from .ndarray import random as _ndrandom
+        return getattr(_ndrandom, name)
+    raise AttributeError(f"module 'mxnet_tpu.random' has no attribute "
+                         f"{name!r}")

@@ -19,6 +19,7 @@ from .register import _attach_frontends
 _attach_frontends(_sys.modules[__name__])
 
 from . import contrib  # noqa: E402,F401  (after frontends exist)
+from . import random   # noqa: E402,F401  (sampling-node frontends)
 
 # fluent method surface, kept in lockstep with NDArray's (the generated
 # method lists live in ndarray/__init__.py)

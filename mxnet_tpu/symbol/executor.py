@@ -53,16 +53,22 @@ class Executor:
     # -- execution ---------------------------------------------------------
     def _get_run(self, training: bool):
         import jax
-        fn = self._run_cache.get(training)
-        if fn is None:
+        cached = self._run_cache.get(training)
+        if cached is None:
             run = self._symbol.compile(training=training)
             names = self._arg_names + self._aux_names
+            needs_rng = run.needs_rng
 
             def flat(*vals):
-                return tuple(run(dict(zip(names, vals))))
-            fn = jax.jit(flat)
-            self._run_cache[training] = fn
-        return fn
+                feed = dict(zip(names, vals))
+                if needs_rng:
+                    # base key rides as the LAST argument so the arg/aux
+                    # cotangent slice in backward() stays positional
+                    feed["__rng_key__"] = vals[len(names)]
+                return tuple(run(feed))
+            cached = (jax.jit(flat), needs_rng)
+            self._run_cache[training] = cached
+        return cached
 
     def forward(self, is_train: bool = False, **kwargs) -> List[NDArray]:
         import jax
@@ -76,7 +82,10 @@ class Executor:
         # copied in from another context (multi-device executor groups)
         vals = [jax.device_put(a._read(), dev) for a in self.arg_arrays] + \
             [jax.device_put(a._read(), dev) for a in self.aux_arrays]
-        fn = self._get_run(is_train)
+        fn, needs_rng = self._get_run(is_train)
+        if needs_rng:
+            from .. import random as _grandom
+            vals = vals + [jax.device_put(_grandom.next_key(), dev)]
         if is_train and self._grad_req != "null":
             outs, self._vjp_fn = jax.vjp(fn, *vals)
         else:

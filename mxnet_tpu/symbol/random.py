@@ -1,0 +1,114 @@
+"""``mx.sym.random``: sampling ops as graph nodes.
+
+Reference role: python/mxnet/symbol/random.py — the symbol frontends over
+src/operator/random/sample_op.cc, so random draws can live INSIDE a
+composed graph (noise layers, VAE reparameterization, symbolic dropout
+experiments).  Each call creates a ``_random_*`` / ``_sample_*`` node; at
+execution the symbol runner splits the executor's per-forward base key
+across all sampling nodes (symbol.py ``compile``), so every ``forward``
+draws fresh values — under one jit compilation, because the key is an
+argument of the compiled function.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+from .register import apply_op
+from .symbol import Symbol
+
+__all__ = ["uniform", "normal", "randint", "exponential", "gamma",
+           "poisson", "negative_binomial", "generalized_negative_binomial",
+           "multinomial", "shuffle"]
+
+
+def _attrs(shape, dtype, **params) -> Dict[str, Any]:
+    from ..ndarray.ops_random import _canon_shape
+    attrs = dict(params)
+    attrs["shape"] = _canon_shape(shape)   # shared None->(1,) rule
+    if dtype is not None:
+        attrs["dtype"] = dtype
+    return attrs
+
+
+def _scalar_or_sample(scalar_op: str, sample_op: str, params, shape, dtype,
+                      names, name: Optional[str]):
+    """Reference dispatch rule (symbol/random.py _random_helper): all-scalar
+    parameters go to the ``_random_*`` op; Symbol parameters go to the
+    per-element ``_sample_*`` op."""
+    if any(isinstance(p, Symbol) for p in params):
+        attrs = dict(_attrs(shape, dtype))
+        if shape is None:
+            attrs.pop("shape")
+        return apply_op(sample_op, list(params), attrs, name=name)
+    attrs = _attrs(shape, dtype, **dict(zip(names, map(float, params))))
+    return apply_op(scalar_op, [], attrs, name=name)
+
+
+def uniform(low=0.0, high=1.0, shape=None, dtype=None, name=None, **kwargs):
+    return _scalar_or_sample("_random_uniform", "_sample_uniform",
+                             [low, high], shape, dtype, ("low", "high"),
+                             name)
+
+
+def normal(loc=0.0, scale=1.0, shape=None, dtype=None, name=None, **kwargs):
+    return _scalar_or_sample("_random_normal", "_sample_normal",
+                             [loc, scale], shape, dtype, ("loc", "scale"),
+                             name)
+
+
+def gamma(alpha=1.0, beta=1.0, shape=None, dtype=None, name=None, **kwargs):
+    return _scalar_or_sample("_random_gamma", "_sample_gamma",
+                             [alpha, beta], shape, dtype, ("alpha", "beta"),
+                             name)
+
+
+def exponential(scale=1.0, shape=None, dtype=None, name=None, **kwargs):
+    # parameterized by SCALE (mean), matching the reference frontend and
+    # mx.nd.random.exponential; the per-element _sample_exponential op
+    # takes a RATE, so a Symbol scale is inverted in-graph
+    if isinstance(scale, Symbol):
+        attrs = dict(_attrs(shape, dtype))
+        if shape is None:
+            attrs.pop("shape")
+        return apply_op("_sample_exponential", [1.0 / scale], attrs,
+                        name=name)
+    return apply_op("_random_exponential", [],
+                    _attrs(shape, dtype, scale=float(scale)), name=name)
+
+
+def poisson(lam=1.0, shape=None, dtype=None, name=None, **kwargs):
+    return _scalar_or_sample("_random_poisson", "_sample_poisson",
+                             [lam], shape, dtype, ("lam",), name)
+
+
+def negative_binomial(k=1, p=1.0, shape=None, dtype=None, name=None,
+                      **kwargs):
+    return _scalar_or_sample("_random_negative_binomial",
+                             "_sample_negative_binomial",
+                             [k, p], shape, dtype, ("k", "p"), name)
+
+
+def generalized_negative_binomial(mu=1.0, alpha=1.0, shape=None, dtype=None,
+                                  name=None, **kwargs):
+    return _scalar_or_sample("_random_generalized_negative_binomial",
+                             "_sample_generalized_negative_binomial",
+                             [mu, alpha], shape, dtype, ("mu", "alpha"),
+                             name)
+
+
+def randint(low, high, shape=None, dtype="int32", name=None, **kwargs):
+    return apply_op("_random_randint", [],
+                    _attrs(shape, dtype, low=int(low), high=int(high)),
+                    name=name)
+
+
+def multinomial(data, shape=None, get_prob=False, dtype="int32", name=None,
+                **kwargs):
+    attrs: Dict[str, Any] = {"get_prob": bool(get_prob), "dtype": dtype}
+    if shape is not None:
+        attrs["shape"] = shape if isinstance(shape, int) else tuple(shape)
+    return apply_op("_sample_multinomial", [data], attrs, name=name)
+
+
+def shuffle(data, name=None, **kwargs):
+    return apply_op("_shuffle", [data], {}, name=name)

@@ -215,12 +215,38 @@ class Symbol:
 
     # -- lowering to a JAX function ---------------------------------------
     def compile(self, training: bool = False):
-        """Return fn(feed: dict name→jax value) → list of output values."""
+        """Return fn(feed: dict name→jax value) → list of output values.
+
+        If the graph contains sampling ops (``Operator.needs_rng`` — Dropout,
+        the ``_random_*``/``_sample_*`` families), the feed must carry a base
+        PRNG key under ``"__rng_key__"``; the runner splits one subkey per
+        sampling node, so a single fresh key per forward gives every node an
+        independent draw (and, under jit, fresh randomness per call with no
+        recompilation — the key is an argument, not a constant).  The
+        returned function advertises this via its ``needs_rng`` attribute."""
         from ..ndarray.register import get_op
 
         order = self._topo()
 
+        # only ACTIVE sampling nodes demand a key (node_takes_key is THE
+        # shared predicate): a pure-inference executor of a Dropout model
+        # or an rng-free foreach must not advance the global stream,
+        # keeping seed(n); predict(); draw() reproducible
+        from ..ndarray.register import _SUBGRAPH_OPS, node_takes_key
+        rng_ids = [id(n) for n in order
+                   if not n.is_var
+                   and node_takes_key(n.op, n.attrs, training)]
+
         def run(feed: Dict[str, Any]) -> List[Any]:
+            keymap: Dict[int, Any] = {}
+            if rng_ids:
+                import jax.random as jr
+                base = feed.get("__rng_key__")
+                if base is None:
+                    raise MXNetError(
+                        "graph contains sampling ops; feed must carry a "
+                        "'__rng_key__' base key")
+                keymap = dict(zip(rng_ids, jr.split(base, len(rng_ids))))
             vals: Dict[int, Any] = {}
             for node in order:
                 if node.is_var:
@@ -232,15 +258,31 @@ class Symbol:
                     continue
                 op = get_op(node.op)
                 kwargs = _op_kwargs(node.attrs)
-                if node.op == "BatchNorm":
+                if node.op in ("BatchNorm", "_foreach", "_while_loop",
+                               "_cond"):
+                    # train/eval-sensitive ops (BatchNorm statistics;
+                    # subgraph bodies may hold Dropout/BatchNorm of their
+                    # own) follow the executor's mode
                     kwargs.setdefault("_training", training)
+                if op.needs_rng and id(node) not in keymap and \
+                        node.op not in _SUBGRAPH_OPS:
+                    # a sampling node node_takes_key() excluded from the
+                    # key split (inference-gated Dropout) executes as
+                    # identity — DERIVED from the shared predicate, so the
+                    # gate cannot drift from the key-feed decision
+                    vals[id(node)] = (vals[id(node.inputs[0][0])]
+                                      [node.inputs[0][1]],)
+                    continue
                 extra = _scalar_extra(node.op, kwargs)
                 fn = op.get_fn(kwargs)
                 ins = [vals[id(p)][i] for p, i in node.inputs] + extra
+                if id(node) in keymap:
+                    ins.append(keymap[id(node)])
                 out = fn(*ins)
                 vals[id(node)] = out if isinstance(out, tuple) else (out,)
             return [vals[id(n)][i] for n, i in self._heads]
 
+        run.needs_rng = bool(rng_ids)
         return run
 
     def eval_dict(self, feed: Dict[str, Any]):
@@ -255,6 +297,9 @@ class Symbol:
             else:
                 jfeed[k] = v
         run = self.compile()
+        if run.needs_rng:
+            from .. import random as _grandom
+            jfeed["__rng_key__"] = _grandom.next_key()
         outs = [NDArray(v, ctx=ctx or current_context())
                 for v in run(jfeed)]
         return outs[0] if len(outs) == 1 else outs
@@ -290,6 +335,8 @@ class Symbol:
                                                _np.float32)
                     for name in self.list_inputs()}
             run = self.compile()
+            if run.needs_rng:
+                feed["__rng_key__"] = _key_struct()
             outs = jax.eval_shape(lambda f: run(f), feed)
             out_shapes = [tuple(o.shape) for o in outs]
         except KeyError as e:
@@ -439,6 +486,22 @@ class Symbol:
         return load_json(self.tojson())
 
 
+_KEY_STRUCT = None
+
+
+def _key_struct():
+    """ShapeDtypeStruct of a PRNG key, for abstract (eval_shape) runs.
+    Computed once — the struct is invariant and building a real key per
+    call would waste a device computation on every shape inference."""
+    global _KEY_STRUCT
+    if _KEY_STRUCT is None:
+        import jax
+        import jax.random as jr
+        k = jr.PRNGKey(0)
+        _KEY_STRUCT = jax.ShapeDtypeStruct(k.shape, k.dtype)
+    return _KEY_STRUCT
+
+
 def _scalar_extra(opname: str, kwargs: Dict[str, Any]) -> list:
     """The *_scalar op family takes the scalar as a 0-d array input (one
     compile per shape, not per constant — see ops_elemwise); in symbol
@@ -509,6 +572,14 @@ def _infer_missing(sym: Symbol, known: Dict[str, Tuple[int, ...]],
             kwargs.setdefault("_training", False)
         try:
             extra = _scalar_extra(node.op, kwargs)
+            # match the maker fn's ARITY exactly: non-subgraph sampling
+            # fns always take a key (the runner may skip them, but when
+            # called they expect it); the control-flow trio pops a key
+            # only when a subgraph samples (op_takes_key)
+            if op.needs_rng:
+                from ..ndarray.register import _SUBGRAPH_OPS, op_takes_key
+                if node.op not in _SUBGRAPH_OPS or op_takes_key(op, kwargs):
+                    extra = extra + [_key_struct()]
             fn = op.get_fn(kwargs)
             outs = jax.eval_shape(
                 fn, *[jax.ShapeDtypeStruct(s, _np.float32)

@@ -1,0 +1,354 @@
+"""Sampling-op registry + symbol-mode randomness.
+
+Reference model: tests/python/unittest/test_random.py (sample_op.cc /
+multisample_op.cc coverage) and the symbol-mode dropout/noise idioms.
+The TPU-native contract under test: every draw is a registry op taking a
+PRNG key as its last input (Operator.needs_rng) — eager dispatch appends
+a key from the global stream, the symbol runner splits one base key per
+forward across all sampling nodes, and compiled executors stay fresh per
+call because the key is an argument, not a baked constant.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+
+
+def test_raw_registry_op_eager():
+    # the raw `_random_*` op is invokable with zero inputs (the C-ABI /
+    # MXImperativeInvoke path): invoke() supplies the key
+    from mxnet_tpu.ndarray.register import invoke_by_name
+    r = invoke_by_name("_random_uniform",
+                       [], {"low": 2.0, "high": 3.0, "shape": (50,)})
+    a = r.asnumpy()
+    assert a.shape == (50,)
+    assert a.min() >= 2.0 and a.max() <= 3.0
+
+
+def test_scalar_draw_family_shapes_and_ranges():
+    u = mx.nd.random.uniform(-1.0, 1.0, shape=(200,)).asnumpy()
+    assert u.min() >= -1.0 and u.max() <= 1.0
+    n = mx.nd.random.normal(3.0, 0.5, shape=(4000,)).asnumpy()
+    assert abs(n.mean() - 3.0) < 0.1 and abs(n.std() - 0.5) < 0.1
+    r = mx.nd.random.randint(5, 15, shape=(500,)).asnumpy()
+    assert r.dtype == np.int32 and r.min() >= 5 and r.max() < 15
+    p = mx.nd.random.poisson(6.0, shape=(4000,)).asnumpy()
+    assert abs(p.mean() - 6.0) < 0.5
+    g = mx.nd.random.gamma(2.0, 3.0, shape=(4000,)).asnumpy()
+    assert abs(g.mean() - 6.0) < 0.6          # E[gamma(a, scale b)] = a*b
+    e = mx.nd.random.exponential(2.0, shape=(4000,)).asnumpy()
+    assert abs(e.mean() - 2.0) < 0.3
+
+
+def test_seeded_reproducibility_and_freshness():
+    mx.random.seed(1234)
+    a = mx.nd.random.uniform(shape=(16,)).asnumpy()
+    b = mx.nd.random.uniform(shape=(16,)).asnumpy()
+    assert not np.allclose(a, b)              # stream advances
+    mx.random.seed(1234)
+    a2 = mx.nd.random.uniform(shape=(16,)).asnumpy()
+    assert np.allclose(a, a2)                 # replay from the seed
+
+
+def test_sample_family_per_element_params():
+    lo = mx.nd.array(np.array([0.0, 100.0], np.float32))
+    hi = mx.nd.array(np.array([1.0, 200.0], np.float32))
+    s = mx.nd.sample_uniform(lo, hi, shape=64).asnumpy()
+    assert s.shape == (2, 64)
+    assert s[0].max() <= 1.0 and s[1].min() >= 100.0
+    mu = mx.nd.array(np.array([-5.0, 5.0], np.float32))
+    sg = mx.nd.array(np.array([0.1, 2.0], np.float32))
+    z = mx.nd.sample_normal(mu, sg, shape=4000).asnumpy()
+    assert abs(z[0].mean() + 5.0) < 0.1 and abs(z[1].std() - 2.0) < 0.2
+    lam = mx.nd.array(np.array([1.0, 20.0], np.float32))
+    pv = mx.nd.sample_poisson(lam, shape=2000).asnumpy()
+    assert abs(pv[0].mean() - 1.0) < 0.3 and abs(pv[1].mean() - 20.0) < 1.5
+
+
+def test_eager_frontends_accept_tensor_params():
+    # reference _random_helper rule: NDArray/array parameters dispatch to
+    # the per-element _sample_* op (review regression: float() coercion
+    # broke this)
+    loc = mx.nd.array(np.array([0.0, 100.0], np.float32))
+    z = mx.nd.random.normal(loc=loc, scale=1.0, shape=2000)
+    assert z.shape == (2, 2000)
+    zv = z.asnumpy()
+    assert abs(zv[0].mean()) < 0.2 and abs(zv[1].mean() - 100.0) < 0.2
+    # numpy-array / list parameters work too
+    u = mx.nd.random.uniform(low=[0.0, 10.0], high=[1.0, 20.0], shape=50)
+    uv = u.asnumpy()
+    assert uv.shape == (2, 50)
+    assert uv[0].max() <= 1.0 and uv[1].min() >= 10.0
+    # exponential's tensor path converts scale -> rate
+    sc = mx.nd.array(np.array([0.5, 5.0], np.float32))
+    e = mx.nd.random.exponential(sc, shape=4000).asnumpy()
+    assert abs(e[0].mean() - 0.5) < 0.15 and abs(e[1].mean() - 5.0) < 1.0
+    # poisson with per-element lam
+    lam = mx.nd.array(np.array([1.0, 15.0], np.float32))
+    p = mx.nd.random.poisson(lam, shape=2000).asnumpy()
+    assert abs(p[0].mean() - 1.0) < 0.3 and abs(p[1].mean() - 15.0) < 1.0
+
+
+def test_sym_random_exponential_scale_parameterization():
+    # review regression: sym and nd frontends must agree that exponential
+    # takes SCALE (mean), not rate
+    ex = mx.sym.random.exponential(4.0, shape=(4000,)).simple_bind()
+    m = float(ex.forward(is_train=False)[0].asnumpy().mean())
+    assert abs(m - 4.0) < 0.8, m
+    # Symbol parameter: inverted in-graph to the _sample op's rate
+    s = mx.sym.Variable("s")
+    ex2 = mx.sym.random.exponential(s).simple_bind(s=(2000,))
+    sv = mx.nd.array(np.full((2000,), 3.0, np.float32))
+    m2 = float(ex2.forward(is_train=False, s=sv)[0].asnumpy().mean())
+    assert abs(m2 - 3.0) < 0.6, m2
+
+
+def test_multinomial_and_shuffle():
+    probs = mx.nd.array(np.array([[0, 0, 1], [1, 0, 0]], np.float32))
+    m = mx.nd.random.multinomial(probs).asnumpy()
+    assert (m == np.array([2, 0])).all()
+    m2, lp = mx.nd.random.multinomial(probs, shape=8, get_prob=True)
+    assert m2.shape == (2, 8) and lp.shape == (2, 8)
+    assert np.allclose(lp.asnumpy(), 0.0)     # picked certain categories
+    d = mx.nd.array(np.arange(20).reshape(10, 2).astype(np.float32))
+    sh = mx.nd.shuffle(d).asnumpy()
+    assert sorted(sh[:, 0].tolist()) == sorted(
+        np.arange(0, 20, 2).tolist())
+    assert (sh[:, 1] - sh[:, 0] == 1).all()   # rows stay intact
+
+
+def test_like_family():
+    base = mx.nd.zeros((3, 5))
+    u = mx.nd.uniform_like(base, low=1.0, high=2.0).asnumpy()
+    assert u.shape == (3, 5) and u.min() >= 1.0 and u.max() <= 2.0
+    n = mx.nd.normal_like(base)
+    assert n.shape == (3, 5)
+
+
+# -- symbol mode -----------------------------------------------------------
+
+def test_symbol_dropout_executor():
+    # round-4 regression: Dropout in a bound symbolic graph never received
+    # its key input (simple_bind raised); now the runner threads a
+    # per-forward base key split across sampling nodes
+    x = mx.sym.Variable("x")
+    d = mx.sym.Dropout(x, p=0.5)
+    ex = d.simple_bind(x=(64, 64))
+    ones = mx.nd.array(np.ones((64, 64), np.float32))
+    out_eval = ex.forward(is_train=False, x=ones)[0].asnumpy()
+    assert np.allclose(out_eval, 1.0)         # inference = identity
+    o1 = ex.forward(is_train=True, x=ones)[0].asnumpy()
+    o2 = ex.forward(is_train=True, x=ones)[0].asnumpy()
+    assert set(np.unique(o1.round(3))) == {0.0, 2.0}   # inverted scaling
+    assert not np.allclose(o1, o2)            # fresh mask per forward
+    drop = (o1 == 0).mean()
+    assert 0.3 < drop < 0.7
+    ex.backward(out_grads=mx.nd.array(np.ones((64, 64), np.float32)))
+    g = ex.grad_arrays[0].asnumpy()
+    # gradient mask must MATCH the mask of the forward it pairs with (the
+    # LAST is_train forward — executor vjp semantics)
+    assert np.allclose((g > 0), (o2 > 0))
+
+
+def test_symbol_random_graph():
+    z = mx.sym.Variable("z")
+    noise = mx.sym.random.normal(0.0, 1.0, shape=(32, 8))
+    y = z + noise
+    args, outs, _ = y.infer_shape(z=(32, 8))
+    assert outs == [(32, 8)]
+    ex = y.simple_bind(z=(32, 8))
+    zv = mx.nd.array(np.zeros((32, 8), np.float32))
+    r1 = ex.forward(is_train=False, z=zv)[0].asnumpy()
+    r2 = ex.forward(is_train=False, z=zv)[0].asnumpy()
+    assert not np.allclose(r1, r2)            # fresh draw per forward
+    assert abs(r1.mean()) < 0.5
+
+
+def test_symbol_random_seeded_replay():
+    y = mx.sym.random.uniform(0.0, 1.0, shape=(64,))
+    ex = y.simple_bind()
+    mx.random.seed(77)
+    a = ex.forward(is_train=False)[0].asnumpy()
+    mx.random.seed(77)
+    b = ex.forward(is_train=False)[0].asnumpy()
+    assert np.allclose(a, b)
+
+
+def test_symbol_sample_dispatch():
+    # Symbol parameters route to the per-element _sample_* op
+    lam = mx.sym.Variable("lam")
+    pois = mx.sym.random.poisson(lam=lam, shape=500)
+    ex = pois.simple_bind(lam=(3,))
+    lv = mx.nd.array(np.array([1.0, 8.0, 30.0], np.float32))
+    pv = ex.forward(is_train=False, lam=lv)[0].asnumpy()
+    assert pv.shape == (3, 500)
+    means = pv.mean(axis=1)
+    assert abs(means[0] - 1.0) < 0.4 and abs(means[2] - 30.0) < 2.5
+
+
+def test_symbol_multinomial_get_prob_outputs():
+    p = mx.sym.Variable("p")
+    s = mx.sym.random.multinomial(p, shape=4, get_prob=True)
+    assert len(s.list_outputs()) == 2
+    ex = s.simple_bind(p=(2, 3))
+    pv = mx.nd.array(np.array([[0, 1, 0], [1, 0, 0]], np.float32))
+    samp, lp = ex.forward(is_train=False, p=pv)
+    assert samp.shape == (2, 4) and lp.shape == (2, 4)
+    assert (samp.asnumpy() == np.array([[1], [0]])).all()
+
+
+def test_symbol_random_json_roundtrip():
+    z = mx.sym.Variable("z")
+    y = z * mx.sym.random.uniform(0.5, 1.5, shape=(4, 4)) \
+        + mx.sym.random.normal(0.0, 0.1, shape=(4, 4))
+    y2 = mx.sym.load_json(y.tojson())
+    ex = y2.simple_bind(z=(4, 4))
+    out = ex.forward(is_train=False,
+                     z=mx.nd.array(np.ones((4, 4), np.float32)))
+    assert out[0].shape == (4, 4)
+    # two sampling nodes must draw DIFFERENT subkeys of the base key
+    a = out[0].asnumpy()
+    assert not np.allclose(a, a.T) or a.std() > 0
+
+
+def test_sampling_inside_foreach_body():
+    # review regression: a sampling node inside a control-flow subgraph
+    # must receive a per-iteration subkey (threaded through the scan
+    # carry), not fail for a missing '__rng_key__'
+    import mxnet_tpu.symbol.contrib as sc
+
+    def step(x, state):
+        noise = mx.sym.random.uniform(0.0, 1.0, shape=(2,))
+        out = x + noise
+        return [out], [state[0] + out]
+
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    outs, states = sc.foreach(step, data, [init])
+    g = mx.sym.Group(list(outs) + list(states))
+    ex = g.simple_bind(data=(5, 2), init=(2,))
+    dv = mx.nd.array(np.zeros((5, 2), np.float32))
+    iv = mx.nd.array(np.zeros((2,), np.float32))
+    ys = ex.forward(is_train=False, data=dv, init=iv)
+    y = ys[0].asnumpy()
+    assert y.shape == (5, 2)
+    assert y.min() >= 0.0 and y.max() <= 1.0
+    # each iteration draws its OWN subkey: rows must differ
+    assert not np.allclose(y[0], y[1]) or not np.allclose(y[1], y[2])
+    # running state accumulated the same draws the outputs saw
+    assert np.allclose(ys[1].asnumpy(), y.sum(axis=0), atol=1e-5)
+
+
+def test_dropout_inside_foreach_respects_train_mode():
+    # review finding: the executor's train/eval mode must reach subgraph
+    # bodies (_training param), so Dropout in a foreach body is REAL
+    # dropout under is_train=True and identity at inference
+    import mxnet_tpu.symbol.contrib as sc
+
+    def step(x, state):
+        out = mx.sym.Dropout(x, p=0.5)
+        return [out], state
+
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    outs, _ = sc.foreach(step, data, [init])
+    ex = outs[0].simple_bind(data=(6, 32), init=(1,))
+    dv = mx.nd.array(np.ones((6, 32), np.float32))
+    iv = mx.nd.array(np.zeros((1,), np.float32))
+    y_eval = ex.forward(is_train=False, data=dv, init=iv)[0].asnumpy()
+    assert np.allclose(y_eval, 1.0)           # inference: identity
+    y_tr = ex.forward(is_train=True, data=dv, init=iv)[0].asnumpy()
+    assert set(np.unique(y_tr.round(3))) == {0.0, 2.0}
+    # per-iteration subkeys: different rows get different masks
+    assert any(not np.allclose(y_tr[i], y_tr[i + 1]) for i in range(5))
+
+
+def test_inference_dropout_does_not_consume_stream():
+    # review finding: a pure-inference executor of a Dropout model must
+    # not advance the global key stream (seed; predict; draw must equal
+    # seed; draw)
+    x = mx.sym.Variable("x")
+    d = mx.sym.Dropout(x, p=0.5)
+    ex = d.simple_bind(x=(4, 4))
+    xv = mx.nd.array(np.ones((4, 4), np.float32))
+    mx.random.seed(99)
+    ex.forward(is_train=False, x=xv)
+    ex.forward(is_train=False, x=xv)
+    a = mx.nd.random.uniform(shape=(8,)).asnumpy()
+    mx.random.seed(99)
+    b = mx.nd.random.uniform(shape=(8,)).asnumpy()
+    assert np.allclose(a, b)
+
+
+def test_rng_free_control_flow_does_not_consume_stream():
+    # review finding: an rng-free foreach (no sampling in the body) must
+    # not demand a key or advance the stream — only bodies that actually
+    # sample make the graph needs_rng
+    import mxnet_tpu.symbol.contrib as sc
+
+    def step(x, state):
+        return [x * 2.0], [state[0] + x]
+
+    data = mx.sym.Variable("data")
+    init = mx.sym.Variable("init")
+    outs, _ = sc.foreach(step, data, [init])
+    run = outs[0].compile()
+    assert not run.needs_rng
+    ex = outs[0].simple_bind(data=(4, 2), init=(2,))
+    dv = mx.nd.array(np.ones((4, 2), np.float32))
+    iv = mx.nd.array(np.zeros((2,), np.float32))
+    mx.random.seed(55)
+    ex.forward(is_train=False, data=dv, init=iv)
+    a = mx.nd.random.uniform(shape=(6,)).asnumpy()
+    mx.random.seed(55)
+    b = mx.nd.random.uniform(shape=(6,)).asnumpy()
+    assert np.allclose(a, b)
+
+
+def test_repeated_scalar_params_do_not_grow_compile_cache():
+    # review finding: sweeping a distribution parameter must not build one
+    # permanent XLA compilation per value (scalar draws run eagerly)
+    from mxnet_tpu.ndarray.register import get_op
+    op = get_op("_random_poisson")
+    assert not op.use_jit
+    for lam in np.linspace(0.5, 5.0, 20):
+        mx.nd.random.poisson(float(lam), shape=(8,))
+
+
+def test_draw_lands_on_current_context_device():
+    # draws follow nd.zeros' placement convention: the buffer lives on
+    # current_context().device, not jax's default device
+    import jax
+    x = mx.nd.random.uniform(shape=(4,))
+    want = mx.current_context().device
+    got = list(x._read().devices())[0]
+    assert got == want, (got, want)
+
+
+def test_mx_random_module_reexports():
+    # reference python/mxnet/random.py re-exports the draw frontends
+    a = mx.random.uniform(0.0, 1.0, shape=(8,))
+    assert a.shape == (8,)
+    mx.random.seed(3)
+    x = mx.random.normal(shape=(4,)).asnumpy()
+    mx.random.seed(3)
+    y = mx.random.normal(shape=(4,)).asnumpy()
+    assert np.allclose(x, y)
+    with pytest.raises(AttributeError):
+        mx.random.not_a_distribution
+
+
+def test_hybridized_dropout_stays_fresh():
+    from mxnet_tpu import autograd, gluon
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(16))
+        net.add(gluon.nn.Dropout(0.5))
+    net.initialize()
+    net.hybridize()
+    x = mx.nd.array(np.ones((4, 8), np.float32))
+    with autograd.record():
+        a = net(x).asnumpy()
+    with autograd.record():
+        b = net(x).asnumpy()
+    assert not np.allclose(a, b)              # no baked-in key constant
