@@ -11,10 +11,14 @@ Components:
 - ndarray_core.cc — the MXNDArray*/MXImperativeInvoke imperative C ABI,
   the slice the reference's six language bindings are built on
   (reference: src/c_api/c_api.cc + c_api_ndarray.cc).
+- symbol_core.cc — the MXSymbol* graph-construction C ABI
+  (variable/atomic/compose/JSON/list/InferShape; reference:
+  src/c_api/c_api_symbolic.cc).
 
-``load_io()`` / ``load_predict()`` / ``load_ndarray()`` return the ctypes
-library (building it the first time) or raise MXNetError with the
-toolchain failure; callers degrade gracefully to the pure-Python path.
+``load_io()`` / ``load_predict()`` / ``load_ndarray()`` / ``load_symbol()``
+return the ctypes library (building it the first time) or raise MXNetError
+with the toolchain failure; callers degrade gracefully to the pure-Python
+path.
 """
 from __future__ import annotations
 
@@ -155,6 +159,56 @@ def load_predict():
         lib.MXPredFree.argtypes = [ctypes.c_void_p]
         lib.MXGetLastError.restype = ctypes.c_char_p
         _PRED["lib"] = lib
+        return lib
+
+
+_SYMC = {"lib": None, "err": None}
+
+
+def load_symbol():
+    """Build (if needed) + load the symbol C ABI; cached process-wide."""
+    with _LOCK:
+        if _SYMC["lib"] is not None:
+            return _SYMC["lib"]
+        lib = _load_embedded(_SYMC, "symbol_core.cc",
+                             "libmxtpu_symbol.so", "symbol core")
+        u32 = ctypes.c_uint32
+        vp = ctypes.c_void_p
+        pu32 = ctypes.POINTER(u32)
+        ppu32 = ctypes.POINTER(pu32)
+        pppu32 = ctypes.POINTER(ppu32)
+        strs = ctypes.POINTER(ctypes.c_char_p)
+        lib.MXSymbolCreateVariable.restype = ctypes.c_int
+        lib.MXSymbolCreateVariable.argtypes = [ctypes.c_char_p,
+                                               ctypes.POINTER(vp)]
+        lib.MXSymbolCreateFromJSON.restype = ctypes.c_int
+        lib.MXSymbolCreateFromJSON.argtypes = [ctypes.c_char_p,
+                                               ctypes.POINTER(vp)]
+        lib.MXSymbolSaveToJSON.restype = ctypes.c_int
+        lib.MXSymbolSaveToJSON.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_char_p)]
+        lib.MXSymbolCreateAtomicSymbol.restype = ctypes.c_int
+        lib.MXSymbolCreateAtomicSymbol.argtypes = [
+            ctypes.c_char_p, u32, strs, strs, ctypes.POINTER(vp)]
+        lib.MXSymbolCompose.restype = ctypes.c_int
+        lib.MXSymbolCompose.argtypes = [vp, ctypes.c_char_p, u32, strs,
+                                        ctypes.POINTER(vp)]
+        for fname in ("MXSymbolListArguments", "MXSymbolListOutputs",
+                      "MXSymbolListAuxiliaryStates"):
+            f = getattr(lib, fname)
+            f.restype = ctypes.c_int
+            f.argtypes = [vp, pu32, ctypes.POINTER(strs)]
+        lib.MXSymbolInferShape.restype = ctypes.c_int
+        lib.MXSymbolInferShape.argtypes = [
+            vp, u32, strs, pu32, pu32,
+            pu32, ppu32, pppu32,
+            pu32, ppu32, pppu32,
+            pu32, ppu32, pppu32,
+            ctypes.POINTER(ctypes.c_int)]
+        lib.MXSymbolFree.restype = ctypes.c_int
+        lib.MXSymbolFree.argtypes = [vp]
+        lib.MXSymGetLastError.restype = ctypes.c_char_p
+        _SYMC["lib"] = lib
         return lib
 
 

@@ -1,0 +1,522 @@
+// C Symbol ABI: graph construction / serialization / inference from C.
+//
+// Reference parity: src/c_api/c_api_symbolic.cc (SURVEY.md §2.1 L9) — the
+// slice the reference language bindings use to BUILD graphs (the Scala/R/
+// Julia model constructors are all Compose loops over this surface):
+//   MXSymbolCreateVariable / MXSymbolCreateAtomicSymbol / MXSymbolCompose /
+//   MXSymbolCreateFromJSON / MXSymbolSaveToJSON / MXSymbolListArguments /
+//   MXSymbolListOutputs / MXSymbolListAuxiliaryStates / MXSymbolInferShape /
+//   MXSymbolFree, errors via MXSymGetLastError.
+// Reference contracts kept: opaque handles; attrs as STRINGS; Compose
+// mutates the atomic handle in place; list results and inferred shapes
+// live in per-handle scratch valid until the next call on that handle
+// (the reference's MXAPIThreadLocalEntry discipline, narrowed per-handle);
+// InferShape takes CSR-packed input shapes keyed by argument name.
+//
+// TPU-native design: a handle holds a Python mxnet_tpu Symbol reached
+// through embedded CPython — graph nodes compose through the SAME registry
+// the Python frontend uses, and InferShape IS jax.eval_shape, so the C
+// surface cannot drift from the Python one.
+
+#include <Python.h>
+#include <dlfcn.h>
+
+#include <cstdint>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <vector>
+
+namespace {
+
+thread_local std::string g_sym_last_error;
+
+void sym_set_err(const std::string& m) { g_sym_last_error = m; }
+
+void sym_set_err_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  std::string msg = "python error";
+  if (value) {
+    PyObject* s = PyObject_Str(value);
+    if (s) {
+      const char* u = PyUnicode_AsUTF8(s);
+      if (u) msg = u;
+      Py_DECREF(s);
+    }
+  }
+  PyErr_Clear();
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+  sym_set_err(msg);
+}
+
+struct SymHandle {
+  PyObject* obj = nullptr;     // mxnet_tpu Symbol OR pending-atomic dict
+  // scratch caches (valid until the next call on this handle)
+  std::string json_cache;
+  std::vector<std::string> str_store;
+  std::vector<const char*> str_ptrs;
+  // InferShape scratch: three CSR groups (arg / out / aux)
+  std::vector<uint32_t> shape_ndim[3];
+  std::vector<std::vector<uint32_t>> shape_rows[3];
+  std::vector<const uint32_t*> shape_ptrs[3];
+};
+
+const char kSymBootstrap[] = R"PY(
+import ast as _ast
+import sys as _sys
+if _MXTPU_ROOT not in _sys.path:
+    _sys.path.insert(0, _MXTPU_ROOT)
+import mxnet_tpu as _mx
+from mxnet_tpu.symbol.register import apply_op as _apply_op
+
+
+class _SymCore:
+    @staticmethod
+    def variable(name):
+        return _mx.sym.Variable(name)
+
+    @staticmethod
+    def from_json(js):
+        return _mx.sym.load_json(js)
+
+    @staticmethod
+    def to_json(s):
+        return s.tojson()
+
+    @staticmethod
+    def atomic(op, keys, vals):
+        # reference two-phase protocol: CreateAtomicSymbol holds op+attrs,
+        # Compose later binds inputs.  The pending node is a plain dict.
+        kwargs = {}
+        for k, v in zip(keys, vals):
+            try:
+                kwargs[k] = _ast.literal_eval(v)
+            except (ValueError, SyntaxError):
+                kwargs[k] = v
+        return {"__pending_op__": op, "kwargs": kwargs}
+
+    @staticmethod
+    def compose(pending, name, keys, args):
+        if not (isinstance(pending, dict) and "__pending_op__" in pending):
+            raise ValueError("MXSymbolCompose: handle is not an atomic "
+                             "symbol (already composed?)")
+        op = pending["__pending_op__"]
+        kw = dict(pending["kwargs"])
+        if keys:
+            kw.update(zip(keys, args))
+            return _apply_op(op, [], kw, name=name or None)
+        return _apply_op(op, list(args), kw, name=name or None)
+
+    @staticmethod
+    def list_arguments(s):
+        return list(s.list_arguments())
+
+    @staticmethod
+    def list_outputs(s):
+        return list(s.list_outputs())
+
+    @staticmethod
+    def list_aux(s):
+        return list(s.list_auxiliary_states())
+
+    @staticmethod
+    def infer_shape(s, names, shapes):
+        # reference contract: under-specified inputs are NOT an error —
+        # rc=0 with *complete=0 (partial inference); only malformed
+        # graphs raise
+        kw = {n: tuple(int(d) for d in sh)
+              for n, sh in zip(names, shapes)}
+        arg, out, aux = s.infer_shape_partial(**kw)
+        if arg is None:
+            return None
+        conv = lambda rows: [tuple(int(d) for d in r) for r in rows]
+        return conv(arg), conv(out), conv(aux)
+)PY";
+
+PyObject* g_symcore_cls = nullptr;
+
+std::once_flag g_py_init_once;
+
+bool sym_ensure_python() {
+  std::call_once(g_py_init_once, [] {
+    if (!Py_IsInitialized()) {
+      Py_InitializeEx(0);
+      PyEval_SaveThread();
+    }
+  });
+  return true;
+}
+
+bool sym_ensure_bootstrap() {
+  if (g_symcore_cls) return true;
+  Dl_info info;
+  std::string root = ".";
+  if (dladdr(reinterpret_cast<void*>(&sym_ensure_bootstrap), &info) &&
+      info.dli_fname) {
+    std::string p = info.dli_fname;
+    for (int up = 0; up < 3; ++up) {
+      auto pos = p.find_last_of('/');
+      if (pos == std::string::npos) break;
+      p = p.substr(0, pos);
+    }
+    if (!p.empty()) root = p;
+  }
+  PyObject* globals = PyDict_New();
+  PyDict_SetItemString(globals, "__builtins__", PyEval_GetBuiltins());
+  PyObject* rootstr = PyUnicode_FromString(root.c_str());
+  PyDict_SetItemString(globals, "_MXTPU_ROOT", rootstr);
+  Py_DECREF(rootstr);
+  PyObject* res =
+      PyRun_String(kSymBootstrap, Py_file_input, globals, globals);
+  if (!res) {
+    sym_set_err_from_python();
+    Py_DECREF(globals);
+    return false;
+  }
+  Py_DECREF(res);
+  g_symcore_cls = PyDict_GetItemString(globals, "_SymCore");
+  Py_XINCREF(g_symcore_cls);
+  Py_DECREF(globals);
+  if (!g_symcore_cls) {
+    sym_set_err("bootstrap did not define _SymCore");
+    return false;
+  }
+  return true;
+}
+
+PyObject* str_list(uint32_t n, const char** items) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i)
+    PyList_SET_ITEM(lst, i, PyUnicode_FromString(items[i] ? items[i] : ""));
+  return lst;
+}
+
+// shared body of the three MXSymbolList* calls
+int list_strings(void* handle, const char* method, uint32_t* out_size,
+                 const char*** out_array) {
+  auto* h = static_cast<SymHandle*>(handle);
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* r =
+        PyObject_CallMethod(g_symcore_cls, method, "O", h->obj);
+    if (!r) {
+      sym_set_err_from_python();
+      break;
+    }
+    Py_ssize_t n = PyList_Size(r);
+    h->str_store.clear();
+    h->str_store.reserve(n);
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      const char* u = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+      h->str_store.emplace_back(u ? u : "");
+    }
+    Py_DECREF(r);
+    h->str_ptrs.clear();
+    for (const auto& s : h->str_store) h->str_ptrs.push_back(s.c_str());
+    *out_size = static_cast<uint32_t>(h->str_store.size());
+    *out_array = h->str_ptrs.data();
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// unpack one python list-of-shape-tuples into the handle's CSR scratch.
+// EVERY false return clears any pending CPython exception — leaking one
+// across the ABI poisons the host's next CPython call (the
+// PyLong_AsUnsignedLong path documents the same rule)
+bool fill_shapes(SymHandle* h, int group, PyObject* rows) {
+  if (!rows) {
+    PyErr_Clear();
+    return false;
+  }
+  Py_ssize_t n = PySequence_Size(rows);
+  if (n < 0) {
+    PyErr_Clear();
+    return false;
+  }
+  h->shape_ndim[group].resize(n);
+  h->shape_rows[group].assign(n, {});
+  h->shape_ptrs[group].resize(n);
+  for (Py_ssize_t i = 0; i < n; ++i) {
+    PyObject* row = PySequence_GetItem(rows, i);
+    if (!row) {
+      PyErr_Clear();
+      return false;
+    }
+    Py_ssize_t nd = PySequence_Size(row);
+    if (nd < 0) {
+      PyErr_Clear();
+      Py_DECREF(row);
+      return false;
+    }
+    auto& dst = h->shape_rows[group][i];
+    dst.resize(nd);
+    for (Py_ssize_t d = 0; d < nd; ++d) {
+      PyObject* it = PySequence_GetItem(row, d);
+      unsigned long v = it ? PyLong_AsUnsignedLong(it) : 0;
+      Py_XDECREF(it);
+      if (PyErr_Occurred()) {
+        // never report success with garbage dims or leak a pending
+        // CPython exception past the ABI boundary
+        PyErr_Clear();
+        Py_DECREF(row);
+        return false;
+      }
+      dst[d] = static_cast<uint32_t>(v);
+    }
+    Py_DECREF(row);
+    h->shape_ndim[group][i] = static_cast<uint32_t>(nd);
+    h->shape_ptrs[group][i] = dst.data();
+  }
+  return true;
+}
+
+}  // namespace
+
+extern "C" {
+
+const char* MXSymGetLastError() { return g_sym_last_error.c_str(); }
+
+int MXSymbolCreateVariable(const char* name, void** out) {
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* obj =
+        PyObject_CallMethod(g_symcore_cls, "variable", "s", name);
+    if (!obj) {
+      sym_set_err_from_python();
+      break;
+    }
+    auto* h = new SymHandle();
+    h->obj = obj;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCreateFromJSON(const char* json, void** out) {
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* obj =
+        PyObject_CallMethod(g_symcore_cls, "from_json", "s", json);
+    if (!obj) {
+      sym_set_err_from_python();
+      break;
+    }
+    auto* h = new SymHandle();
+    h->obj = obj;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolSaveToJSON(void* handle, const char** out_json) {
+  auto* h = static_cast<SymHandle*>(handle);
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_symcore_cls, "to_json", "O", h->obj);
+  if (r) {
+    const char* u = PyUnicode_AsUTF8(r);
+    h->json_cache = u ? u : "";
+    *out_json = h->json_cache.c_str();
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    sym_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCreateAtomicSymbol(const char* op_name, uint32_t num_param,
+                               const char** keys, const char** vals,
+                               void** out) {
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* k = str_list(num_param, keys);
+    PyObject* v = str_list(num_param, vals);
+    PyObject* obj = PyObject_CallMethod(g_symcore_cls, "atomic", "sOO",
+                                        op_name, k, v);
+    Py_DECREF(k);
+    Py_DECREF(v);
+    if (!obj) {
+      sym_set_err_from_python();
+      break;
+    }
+    auto* h = new SymHandle();
+    h->obj = obj;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolCompose(void* handle, const char* name, uint32_t num_args,
+                    const char** keys, void** args) {
+  auto* h = static_cast<SymHandle*>(handle);
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* klist;
+    if (keys) {
+      klist = str_list(num_args, keys);
+    } else {
+      klist = PyList_New(0);
+    }
+    PyObject* alist = PyList_New(num_args);
+    bool bad = false;
+    for (uint32_t i = 0; i < num_args; ++i) {
+      auto* ah = static_cast<SymHandle*>(args[i]);
+      if (!ah || !ah->obj) {
+        bad = true;
+        break;
+      }
+      Py_INCREF(ah->obj);
+      PyList_SET_ITEM(alist, i, ah->obj);
+    }
+    if (bad) {
+      Py_DECREF(klist);
+      Py_DECREF(alist);
+      sym_set_err("MXSymbolCompose: null argument handle");
+      break;
+    }
+    PyObject* obj = PyObject_CallMethod(
+        g_symcore_cls, "compose", "OsOO", h->obj, name ? name : "", klist,
+        alist);
+    Py_DECREF(klist);
+    Py_DECREF(alist);
+    if (!obj) {
+      sym_set_err_from_python();
+      break;
+    }
+    // reference semantics: Compose mutates the atomic handle in place
+    Py_XDECREF(h->obj);
+    h->obj = obj;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolListArguments(void* handle, uint32_t* out_size,
+                          const char*** out_array) {
+  return list_strings(handle, "list_arguments", out_size, out_array);
+}
+
+int MXSymbolListOutputs(void* handle, uint32_t* out_size,
+                        const char*** out_array) {
+  return list_strings(handle, "list_outputs", out_size, out_array);
+}
+
+int MXSymbolListAuxiliaryStates(void* handle, uint32_t* out_size,
+                                const char*** out_array) {
+  return list_strings(handle, "list_aux", out_size, out_array);
+}
+
+int MXSymbolInferShape(void* handle, uint32_t num_args, const char** keys,
+                       const uint32_t* arg_ind_ptr,
+                       const uint32_t* arg_shape_data,
+                       uint32_t* in_shape_size,
+                       const uint32_t** in_shape_ndim,
+                       const uint32_t*** in_shape_data,
+                       uint32_t* out_shape_size,
+                       const uint32_t** out_shape_ndim,
+                       const uint32_t*** out_shape_data,
+                       uint32_t* aux_shape_size,
+                       const uint32_t** aux_shape_ndim,
+                       const uint32_t*** aux_shape_data, int* complete) {
+  auto* h = static_cast<SymHandle*>(handle);
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* names = str_list(num_args, keys);
+    PyObject* shapes = PyList_New(num_args);
+    for (uint32_t i = 0; i < num_args; ++i) {
+      uint32_t lo = arg_ind_ptr[i], hi = arg_ind_ptr[i + 1];
+      PyObject* row = PyTuple_New(hi - lo);
+      for (uint32_t d = lo; d < hi; ++d)
+        PyTuple_SET_ITEM(row, d - lo,
+                         PyLong_FromUnsignedLong(arg_shape_data[d]));
+      PyList_SET_ITEM(shapes, i, row);
+    }
+    PyObject* r = PyObject_CallMethod(g_symcore_cls, "infer_shape", "OOO",
+                                      h->obj, names, shapes);
+    Py_DECREF(names);
+    Py_DECREF(shapes);
+    if (!r) {
+      sym_set_err_from_python();
+      break;
+    }
+    if (r == Py_None) {
+      // partial inference: success with *complete = 0 and empty groups
+      // (reference c_api_symbolic.cc contract)
+      Py_DECREF(r);
+      *in_shape_size = *out_shape_size = *aux_shape_size = 0;
+      *in_shape_ndim = *out_shape_ndim = *aux_shape_ndim = nullptr;
+      *in_shape_data = *out_shape_data = *aux_shape_data = nullptr;
+      *complete = 0;
+      rc = 0;
+      break;
+    }
+    bool ok = true;
+    PyObject* groups[3] = {PyTuple_GetItem(r, 0), PyTuple_GetItem(r, 1),
+                           PyTuple_GetItem(r, 2)};
+    for (int g = 0; g < 3 && ok; ++g) ok = fill_shapes(h, g, groups[g]);
+    Py_DECREF(r);
+    if (!ok) {
+      sym_set_err("MXSymbolInferShape: malformed python result");
+      break;
+    }
+    *in_shape_size = static_cast<uint32_t>(h->shape_ndim[0].size());
+    *in_shape_ndim = h->shape_ndim[0].data();
+    *in_shape_data = h->shape_ptrs[0].data();
+    *out_shape_size = static_cast<uint32_t>(h->shape_ndim[1].size());
+    *out_shape_ndim = h->shape_ndim[1].data();
+    *out_shape_data = h->shape_ptrs[1].data();
+    *aux_shape_size = static_cast<uint32_t>(h->shape_ndim[2].size());
+    *aux_shape_ndim = h->shape_ndim[2].data();
+    *aux_shape_data = h->shape_ptrs[2].data();
+    *complete = 1;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolFree(void* handle) {
+  auto* h = static_cast<SymHandle*>(handle);
+  if (!h) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
+}
+
+}  // extern "C"

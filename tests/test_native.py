@@ -433,3 +433,224 @@ def test_ndarray_abi_inplace_out_and_bounds():
     assert b"too small" in lib.MXNDGetLastError()
     for h in (a, b, dst):
         lib.MXNDArrayFree(h)
+
+
+# ---------------------------------------------------------------------------
+# symbol C ABI (symbol_core.cc — reference src/c_api/c_api_symbolic.cc):
+# graph CONSTRUCTION from C, the surface the reference's language bindings
+# build models through (atomic-symbol + compose loops)
+# ---------------------------------------------------------------------------
+
+def _sym_check(lib, rc):
+    if rc != 0:
+        raise AssertionError(lib.MXSymGetLastError().decode())
+
+
+def test_symbol_abi_compose_json_infer():
+    """Variable -> CreateAtomicSymbol(FullyConnected) -> Compose -> lists,
+    JSON round-trip, InferShape (CSR in/out) — all through ctypes."""
+    lib = native.load_symbol()
+    vp = ctypes.c_void_p
+    u32 = ctypes.c_uint32
+
+    data = vp()
+    _sym_check(lib, lib.MXSymbolCreateVariable(b"data", ctypes.byref(data)))
+    keys = (ctypes.c_char_p * 2)(b"num_hidden", b"no_bias")
+    vals = (ctypes.c_char_p * 2)(b"8", b"True")
+    fc = vp()
+    _sym_check(lib, lib.MXSymbolCreateAtomicSymbol(
+        b"FullyConnected", 2, keys, vals, ctypes.byref(fc)))
+    args = (vp * 1)(data)
+    _sym_check(lib, lib.MXSymbolCompose(fc, b"fc1", 1, None, args))
+
+    n = u32()
+    arr = ctypes.POINTER(ctypes.c_char_p)()
+    _sym_check(lib, lib.MXSymbolListArguments(fc, ctypes.byref(n),
+                                              ctypes.byref(arr)))
+    names = [arr[i].decode() for i in range(n.value)]
+    assert names == ["data", "fc1_weight"]
+    _sym_check(lib, lib.MXSymbolListOutputs(fc, ctypes.byref(n),
+                                            ctypes.byref(arr)))
+    assert [arr[i].decode() for i in range(n.value)] == ["fc1_output"]
+
+    js = ctypes.c_char_p()
+    _sym_check(lib, lib.MXSymbolSaveToJSON(fc, ctypes.byref(js)))
+    h2 = vp()
+    _sym_check(lib, lib.MXSymbolCreateFromJSON(js.value, ctypes.byref(h2)))
+
+    # the reloaded graph must agree with the python frontend's view
+    s = mx.sym.load_json(js.value.decode())
+    assert s.list_arguments() == ["data", "fc1_weight"]
+
+    keys2 = (ctypes.c_char_p * 1)(b"data")
+    ind = (u32 * 2)(0, 2)
+    shp = (u32 * 2)(4, 16)
+    iss, oss, ass_ = u32(), u32(), u32()
+    isn = ctypes.POINTER(u32)()
+    osn = ctypes.POINTER(u32)()
+    asn = ctypes.POINTER(u32)()
+    isd = ctypes.POINTER(ctypes.POINTER(u32))()
+    osd = ctypes.POINTER(ctypes.POINTER(u32))()
+    asd = ctypes.POINTER(ctypes.POINTER(u32))()
+    comp = ctypes.c_int()
+    _sym_check(lib, lib.MXSymbolInferShape(
+        h2, 1, keys2, ind, shp,
+        ctypes.byref(iss), ctypes.byref(isn), ctypes.byref(isd),
+        ctypes.byref(oss), ctypes.byref(osn), ctypes.byref(osd),
+        ctypes.byref(ass_), ctypes.byref(asn), ctypes.byref(asd),
+        ctypes.byref(comp)))
+    assert comp.value == 1
+    in_shapes = [[isd[i][d] for d in range(isn[i])]
+                 for i in range(iss.value)]
+    out_shapes = [[osd[i][d] for d in range(osn[i])]
+                  for i in range(oss.value)]
+    assert out_shapes == [[4, 8]]
+    assert in_shapes == [[4, 16], [8, 16]]     # data, fc1_weight (O, I)
+
+    # named-argument compose (keys non-NULL) binds by input name
+    d2 = vp()
+    _sym_check(lib, lib.MXSymbolCreateVariable(b"x", ctypes.byref(d2)))
+    act = vp()
+    akeys = (ctypes.c_char_p * 1)(b"act_type")
+    avals = (ctypes.c_char_p * 1)(b"relu")
+    _sym_check(lib, lib.MXSymbolCreateAtomicSymbol(
+        b"Activation", 1, akeys, avals, ctypes.byref(act)))
+    ckeys = (ctypes.c_char_p * 1)(b"data")
+    cargs = (vp * 1)(d2)
+    _sym_check(lib, lib.MXSymbolCompose(act, b"relu0", 1, ckeys, cargs))
+    _sym_check(lib, lib.MXSymbolListArguments(act, ctypes.byref(n),
+                                              ctypes.byref(arr)))
+    assert [arr[i].decode() for i in range(n.value)] == ["x"]
+
+    # error surface: bad JSON must fail with a message
+    bad = vp()
+    assert lib.MXSymbolCreateFromJSON(b"not json",
+                                      ctypes.byref(bad)) != 0
+    assert len(lib.MXSymGetLastError()) > 0
+    for h in (data, fc, h2, d2, act):
+        lib.MXSymbolFree(h)
+
+
+SYM_C_HOST = r"""
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <string.h>
+typedef int (*var_fn)(const char*, void**);
+typedef int (*atomic_fn)(const char*, uint32_t, const char**, const char**,
+                         void**);
+typedef int (*compose_fn)(void*, const char*, uint32_t, const char**,
+                          void**);
+typedef int (*list_fn)(void*, uint32_t*, const char***);
+typedef int (*tojson_fn)(void*, const char**);
+typedef int (*fromjson_fn)(const char*, void**);
+typedef int (*infer_fn)(void*, uint32_t, const char**, const uint32_t*,
+                        const uint32_t*, uint32_t*, const uint32_t**,
+                        const uint32_t***, uint32_t*, const uint32_t**,
+                        const uint32_t***, uint32_t*, const uint32_t**,
+                        const uint32_t***, int*);
+typedef int (*free_fn)(void*);
+typedef const char* (*err_fn)(void);
+int main(int argc, char** argv) {
+  void* so = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!so) { fprintf(stderr, "%s\n", dlerror()); return 2; }
+  var_fn mkvar = (var_fn)dlsym(so, "MXSymbolCreateVariable");
+  atomic_fn atomic = (atomic_fn)dlsym(so, "MXSymbolCreateAtomicSymbol");
+  compose_fn compose = (compose_fn)dlsym(so, "MXSymbolCompose");
+  list_fn listargs = (list_fn)dlsym(so, "MXSymbolListArguments");
+  tojson_fn tojson = (tojson_fn)dlsym(so, "MXSymbolSaveToJSON");
+  fromjson_fn fromjson = (fromjson_fn)dlsym(so, "MXSymbolCreateFromJSON");
+  infer_fn infer = (infer_fn)dlsym(so, "MXSymbolInferShape");
+  free_fn sfree = (free_fn)dlsym(so, "MXSymbolFree");
+  err_fn lasterr = (err_fn)dlsym(so, "MXSymGetLastError");
+
+  void* x = NULL;
+  if (mkvar("x", &x)) { fprintf(stderr, "var: %s\n", lasterr()); return 1; }
+  const char* keys[1]; const char* vals[1];
+  keys[0] = "num_hidden"; vals[0] = "4";
+  void* fc = NULL;
+  if (atomic("FullyConnected", 1, keys, vals, &fc)) {
+    fprintf(stderr, "atomic: %s\n", lasterr()); return 1; }
+  void* args[1]; args[0] = x;
+  if (compose(fc, "out", 1, NULL, args)) {
+    fprintf(stderr, "compose: %s\n", lasterr()); return 1; }
+
+  uint32_t n = 0; const char** names = NULL;
+  if (listargs(fc, &n, &names) || n != 3) {
+    fprintf(stderr, "listargs: %s\n", lasterr()); return 1; }
+  /* x, out_weight, out_bias */
+  if (strcmp(names[0], "x") != 0) return 1;
+
+  const char* js = NULL;
+  if (tojson(fc, &js)) return 1;
+  void* clone = NULL;
+  if (fromjson(js, &clone)) return 1;
+
+  const char* ikeys[1]; ikeys[0] = "x";
+  uint32_t ind[2]; ind[0] = 0; ind[1] = 2;
+  uint32_t shp[2]; shp[0] = 2; shp[1] = 6;
+  uint32_t iss, oss, ass; const uint32_t *isn, *osn, *asn;
+  const uint32_t **isd, **osd, **asd; int comp = 0;
+  if (infer(clone, 1, ikeys, ind, shp, &iss, &isn, &isd, &oss, &osn, &osd,
+            &ass, &asn, &asd, &comp)) {
+    fprintf(stderr, "infer: %s\n", lasterr()); return 1; }
+  if (oss != 1 || osn[0] != 2 || osd[0][0] != 2 || osd[0][1] != 4) {
+    fprintf(stderr, "bad out shape\n"); return 1; }
+  sfree(x); sfree(fc); sfree(clone);
+  printf("SYM-C-HOST-OK\n");
+  return 0;
+}
+"""
+
+
+def test_symbol_abi_from_pure_c_host(tmp_path):
+    """A C binary with no Python linkage builds an FC graph through
+    atomic+compose, JSON round-trips it, and infers shapes — the
+    reference's model-constructor story for non-Python bindings."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    native.load_symbol()             # ensure the .so is built
+    so = os.path.join(os.path.dirname(native.__file__),
+                      "libmxtpu_symbol.so")
+    csrc = tmp_path / "sym_host.c"
+    csrc.write_text(SYM_C_HOST)
+    exe = str(tmp_path / "sym_host")
+    subprocess.run(["gcc", "-O2", "-o", exe, str(csrc), "-ldl"],
+                   check=True)
+    env = dict(os.environ,
+               PALLAS_AXON_POOL_IPS="",   # standalone host: force CPU jax
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe, so], capture_output=True, text=True,
+                       timeout=300, env=env)
+    assert r.returncode == 0, r.stderr
+    assert "SYM-C-HOST-OK" in r.stdout
+
+
+def test_symbol_abi_partial_infer_shape():
+    """Under-specified inputs are not an error: rc=0 with complete=0
+    (reference c_api_symbolic.cc partial-inference contract)."""
+    lib = native.load_symbol()
+    vp = ctypes.c_void_p
+    u32 = ctypes.c_uint32
+    # two unknowable inputs: without shapes for both, inference is partial
+    js = (mx.sym.Variable("a") + mx.sym.Variable("b")).tojson()
+    h = vp()
+    _sym_check(lib, lib.MXSymbolCreateFromJSON(js.encode(),
+                                               ctypes.byref(h)))
+    iss, oss, ass_ = u32(), u32(), u32()
+    isn = ctypes.POINTER(u32)()
+    osn = ctypes.POINTER(u32)()
+    asn = ctypes.POINTER(u32)()
+    isd = ctypes.POINTER(ctypes.POINTER(u32))()
+    osd = ctypes.POINTER(ctypes.POINTER(u32))()
+    asd = ctypes.POINTER(ctypes.POINTER(u32))()
+    comp = ctypes.c_int(7)
+    _sym_check(lib, lib.MXSymbolInferShape(
+        h, 0, None, (u32 * 1)(0), None,
+        ctypes.byref(iss), ctypes.byref(isn), ctypes.byref(isd),
+        ctypes.byref(oss), ctypes.byref(osn), ctypes.byref(osd),
+        ctypes.byref(ass_), ctypes.byref(asn), ctypes.byref(asd),
+        ctypes.byref(comp)))
+    assert comp.value == 0
+    assert iss.value == 0 and oss.value == 0
+    lib.MXSymbolFree(h)
