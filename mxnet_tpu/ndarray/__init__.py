@@ -29,6 +29,7 @@ from . import ops_random as _ops_random          # noqa: F401
 from . import ops_ctc as _ops_ctc                # noqa: F401
 from . import ops_misc as _ops_misc              # noqa: F401
 from . import ops_control_flow as _ops_cf        # noqa: F401
+from . import ops_custom as _ops_custom          # noqa: F401
 from . import ops_image as _ops_image            # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401

@@ -1,0 +1,132 @@
+"""The ``Custom`` registry op: python-callback operators inside graphs.
+
+Reference role: src/operator/custom/custom.cc — the "Custom" op that
+looks up a registered ``CustomOpProp`` by ``op_type`` and runs the user's
+Python ``forward``/``backward`` from within a composed graph, which is
+how Symbol-era models embedded python losses/layers.
+
+TPU-native design: the reference ran the callback on a dedicated engine
+thread so the async engine kept flowing; under XLA the graph is a single
+compiled computation, so the callback becomes a ``jax.pure_callback``
+(host round-trip at the op's position in the graph) wrapped in a
+``jax.custom_vjp`` whose backward is a second pure_callback into the
+user's ``backward`` — jit/symbol-executor compatible, gradients exact.
+Eager ``mx.nd.Custom`` keeps the tape-bridge in mxnet_tpu/operator.py
+(no host round-trip needed there); this op serves the SYMBOL path, the
+C ABI (MXImperativeInvoke of "Custom"), and hybridized graphs.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from .register import register_op
+
+
+def _register():
+    import jax
+
+    def custom_maker(op_type=None, _training=False, **user_kwargs):
+        def fn(*ins):
+            from ..operator import _custom_registry
+            if op_type not in _custom_registry:
+                raise MXNetError(
+                    f"unknown custom op_type {op_type!r}; registered: "
+                    f"{sorted(_custom_registry)}")
+            prop = _custom_registry[op_type](**user_kwargs)
+            in_shapes = [tuple(x.shape) for x in ins]
+            in_dtypes = [_np.dtype(x.dtype) for x in ins]
+            default_dt = in_dtypes[0] if in_dtypes else \
+                _np.dtype(_np.float32)        # zero-input custom source op
+            _, out_shapes, _ = prop.infer_shape(
+                [list(s) for s in in_shapes])
+            try:
+                _, out_types, _ = prop.infer_type(list(in_dtypes))
+            except (NotImplementedError, IndexError):
+                out_types = [default_dt] * len(out_shapes)
+            out_struct = tuple(
+                jax.ShapeDtypeStruct(
+                    tuple(s),
+                    out_types[i] if i < len(out_types)
+                    and out_types[i] is not None else default_dt)
+                for i, s in enumerate(out_shapes))
+            in_struct = tuple(
+                jax.ShapeDtypeStruct(tuple(s), in_dtypes[i])
+                for i, s in enumerate(in_shapes))
+            n_in, n_out = len(in_shapes), len(out_shapes)
+
+            def _nd(a):
+                from .ndarray import array
+                return array(_np.asarray(a))
+
+            # ONE operator instance per graph node, shared by the
+            # forward and backward callbacks (custom.cc semantics): ops
+            # that stash state on self in forward read it in backward
+            op_box = {}
+
+            def _the_op():
+                if "op" not in op_box:
+                    from ..context import current_context
+                    op_box["op"] = prop.create_operator(
+                        current_context(), [list(s) for s in in_shapes],
+                        list(in_dtypes))
+                return op_box["op"]
+
+            def host_forward(*np_ins):
+                from .. import autograd as _ag
+                from .ndarray import zeros as nd_zeros
+                op = _the_op()
+                ins_nd = [_nd(a) for a in np_ins]
+                outs = [nd_zeros(tuple(s)) for s in out_shapes]
+                with _ag.pause():
+                    op.forward(is_train=bool(_training),
+                               req=["write"] * n_out, in_data=ins_nd,
+                               out_data=outs, aux=[])
+                return tuple(
+                    _np.asarray(o.asnumpy(), out_struct[i].dtype)
+                    for i, o in enumerate(outs))
+
+            def host_backward(*flat):
+                from .. import autograd as _ag
+                from .ndarray import zeros as nd_zeros
+                op = _the_op()
+                ins_nd = [_nd(a) for a in flat[:n_in]]
+                outs_nd = [_nd(a) for a in flat[n_in:n_in + n_out]]
+                cts_nd = [_nd(a) for a in flat[n_in + n_out:]]
+                igrads = [nd_zeros(tuple(s)) for s in in_shapes]
+                with _ag.pause():
+                    op.backward(req=["write"] * n_in, out_grad=cts_nd,
+                                in_data=ins_nd, out_data=outs_nd,
+                                in_grad=igrads, aux=[])
+                return tuple(
+                    _np.asarray(g.asnumpy(), in_struct[i].dtype)
+                    for i, g in enumerate(igrads))
+
+            def call_fwd(*args):
+                return tuple(jax.pure_callback(host_forward, out_struct,
+                                               *args))
+
+            cfn = jax.custom_vjp(call_fwd)
+
+            def vjp_fwd(*args):
+                outs = call_fwd(*args)
+                return outs, (args, outs)
+
+            def vjp_bwd(res, cts):
+                args, outs = res
+                grads = jax.pure_callback(host_backward, in_struct,
+                                          *args, *outs, *cts)
+                return tuple(grads)
+
+            cfn.defvjp(vjp_fwd, vjp_bwd)
+            out = cfn(*ins)
+            return out if n_out > 1 else out[0]
+        return fn
+    # use_jit=False: user kwargs may be unhashable and the body is a host
+    # callback — there is nothing for a per-op jit to fuse; under an outer
+    # jitted graph the callback is staged into that compilation anyway
+    register_op("Custom", custom_maker, use_jit=False,
+                ref="src/operator/custom/custom.cc")
+
+
+_register()

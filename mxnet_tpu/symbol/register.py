@@ -61,6 +61,17 @@ def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
         return 2
     if opname == "_sample_multinomial" and kwargs.get("get_prob"):
         return 2
+    if opname == "Custom":
+        from ..operator import _custom_registry
+        prop_cls = _custom_registry.get(kwargs.get("op_type"))
+        if prop_cls is not None:
+            # strip op machinery AND __key__-style scoped metadata
+            # (AttrScope stamps are node attrs, never prop kwargs)
+            user = {k: v for k, v in kwargs.items()
+                    if k not in ("op_type", "_training")
+                    and not (k.startswith("__") and k.endswith("__"))}
+            return len(prop_cls(**user).list_outputs())
+        return 1
     if opname == "LayerNorm" and kwargs.get("output_mean_var"):
         return 3
     if opname == "_foreach":

@@ -258,8 +258,8 @@ class Symbol:
                     continue
                 op = get_op(node.op)
                 kwargs = _op_kwargs(node.attrs)
-                if node.op in ("BatchNorm", "_foreach", "_while_loop",
-                               "_cond"):
+                if node.op in ("BatchNorm", "Custom", "_foreach",
+                               "_while_loop", "_cond"):
                     # train/eval-sensitive ops (BatchNorm statistics;
                     # subgraph bodies may hold Dropout/BatchNorm of their
                     # own) follow the executor's mode
