@@ -135,6 +135,17 @@ def _register():
         return sign, logdet
     simple_op("linalg_slogdet", slogdet_fn)
 
+    def syevd_fn(a):
+        # reference la_op.cc syevd: A = U^T * diag(L) * U with the ROWS
+        # of U as eigenvectors (jnp.linalg.eigh returns columns, so U is
+        # the transpose), eigenvalues ascending.  symmetrize_input=False
+        # matches LAPACK 'L' — only the lower triangle is read, as the
+        # reference documents.  eigh has a defined JVP, so autograd works
+        # away from degeneracies.
+        w, v = jnp.linalg.eigh(a, symmetrize_input=False)
+        return jnp.swapaxes(v, -1, -2), w
+    simple_op("linalg_syevd", syevd_fn)
+
     def khatri_rao_fn(*mats):
         # column-wise Kronecker product (reference: khatri_rao op)
         out = mats[0]
@@ -150,7 +161,7 @@ def _register():
     for base in ("gemm", "gemm2", "potrf", "potri", "trsm", "trmm",
                  "syrk", "gelqf", "sumlogdiag", "extractdiag", "makediag",
                  "extracttrian", "maketrian", "inverse", "det",
-                 "slogdet"):
+                 "slogdet", "syevd"):
         add_alias(f"linalg_{base}", f"_linalg_{base}")
 
 

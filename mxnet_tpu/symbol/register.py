@@ -59,6 +59,8 @@ def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
         return 3 if kwargs.get("mode") == "lstm" else 2
     if opname == "topk" and kwargs.get("ret_typ") == "both":
         return 2
+    if opname in ("linalg_gelqf", "linalg_slogdet", "linalg_syevd"):
+        return 2
     if opname == "_sample_multinomial" and kwargs.get("get_prob"):
         return 2
     if opname == "Custom":

@@ -163,3 +163,37 @@ def test_while_loop_body_not_run_when_cond_false():
     assert float(i.asnumpy()) == 1.0      # unchanged
     # tracing may call the python fn, but no iteration output is produced
     np.testing.assert_allclose(outs.asnumpy(), np.zeros(4))
+
+
+def test_linalg_syevd():
+    """reference: src/operator/tensor/la_op.cc syevd — A = U^T diag(L) U,
+    rows of U are eigenvectors, eigenvalues ascending."""
+    rng = np.random.default_rng(3)
+    m = rng.normal(size=(5, 5)).astype(np.float32)
+    a = (m + m.T) / 2.0
+    U, L = nd.linalg_syevd(nd.array(a))
+    Uv, Lv = U.asnumpy(), L.asnumpy()
+    np.testing.assert_allclose(Uv.T @ np.diag(Lv) @ Uv, a, atol=1e-4)
+    assert (np.diff(Lv) >= -1e-6).all()          # ascending
+    np.testing.assert_allclose(Uv @ Uv.T, np.eye(5), atol=1e-5)
+    # LAPACK 'L' contract: only the LOWER triangle is read (reference
+    # la_op.cc syevd docs) — garbage above the diagonal must not matter
+    junk = a.copy()
+    junk[np.triu_indices(5, 1)] = 99.0
+    L_junk = nd.linalg_syevd(nd.array(junk))[1].asnumpy()
+    np.testing.assert_allclose(L_junk, Lv, atol=1e-5)
+    # canonical underscore alias + symbol mode (two outputs)
+    s = mx.sym.Variable("a")
+    u_s, l_s = mx.sym._linalg_syevd(s)
+    ex = mx.sym.Group([u_s, l_s]).simple_bind(a=(5, 5))
+    u2, l2 = ex.forward(is_train=False, a=nd.array(a))
+    np.testing.assert_allclose(l2.asnumpy(), Lv, atol=1e-5)
+    # gradient through eigenvalues: d(sum L)/dA = I for symmetric input
+    from mxnet_tpu import autograd
+    x = nd.array(a)
+    x.attach_grad()
+    with autograd.record():
+        _, lam = nd.linalg_syevd(x)
+        s_ = lam.sum()
+    s_.backward()
+    np.testing.assert_allclose(x.grad.asnumpy(), np.eye(5), atol=1e-4)

@@ -14,6 +14,7 @@ from tests.test_quantization import *     # noqa: F401,F403
 from tests.test_ops_misc import *         # noqa: F401,F403
 from tests.test_op_sweep import *         # noqa: F401,F403
 from tests.test_control_flow import *     # noqa: F401,F403
+from tests.test_random_ops import *       # noqa: F401,F403
 from tests.test_sparse import *           # noqa: F401,F403
 from tests.test_large_array import *      # noqa: F401,F403
 from tests.test_image import *            # noqa: F401,F403
