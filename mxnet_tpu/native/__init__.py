@@ -256,5 +256,26 @@ def load_ndarray():
             ctypes.POINTER(ctypes.POINTER(vp)), ctypes.c_int,
             ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(ctypes.c_char_p)]
         lib.MXNDGetLastError.restype = ctypes.c_char_p
+        # kvstore slice (same .so — handles are shared with MXNDArray*)
+        pint = ctypes.POINTER(ctypes.c_int)
+        lib.MXKVStoreCreate.restype = ctypes.c_int
+        lib.MXKVStoreCreate.argtypes = [ctypes.c_char_p,
+                                        ctypes.POINTER(vp)]
+        lib.MXKVStoreFree.restype = ctypes.c_int
+        lib.MXKVStoreFree.argtypes = [vp]
+        for fname in ("MXKVStoreInit", "MXKVStorePush", "MXKVStorePull"):
+            f = getattr(lib, fname)
+            f.restype = ctypes.c_int
+            f.argtypes = [vp, u32, pint, ctypes.POINTER(vp)] + \
+                ([] if fname == "MXKVStoreInit" else [ctypes.c_int])
+        lib.MXKVStoreGetType.restype = ctypes.c_int
+        lib.MXKVStoreGetType.argtypes = [
+            vp, ctypes.POINTER(ctypes.c_char_p)]
+        lib.MXKVStoreGetRank.restype = ctypes.c_int
+        lib.MXKVStoreGetRank.argtypes = [vp, pint]
+        lib.MXKVStoreGetGroupSize.restype = ctypes.c_int
+        lib.MXKVStoreGetGroupSize.argtypes = [vp, pint]
+        lib.MXKVStoreBarrier.restype = ctypes.c_int
+        lib.MXKVStoreBarrier.argtypes = [vp]
         _NDC["lib"] = lib
         return lib

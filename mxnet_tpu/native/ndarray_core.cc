@@ -114,6 +114,53 @@ class _NDCore:
     @staticmethod
     def wait_all():
         _mx.nd.waitall()
+
+    # ---- kvstore (reference c_api.cc MXKVStore*): handles share this
+    # bootstrap so pushed/pulled arrays ARE the MXNDArray* handles ------
+    @staticmethod
+    def kv_create(kv_type):
+        return _mx.kv.create(kv_type)
+
+    @staticmethod
+    def kv_init(kv, keys, vals, priority=0):
+        # priority accepted (and ignored) so the C side's shared
+        # pair-call helper can drive init too
+        kv.init(list(keys), list(vals))
+
+    @staticmethod
+    def kv_push(kv, keys, vals, priority):
+        # repeated keys = multi-device push of one key: group values
+        groups = {}
+        order = []
+        for k, v in zip(keys, vals):
+            if k not in groups:
+                groups[k] = []
+                order.append(k)
+            groups[k].append(v)
+        push_keys = order
+        push_vals = [groups[k][0] if len(groups[k]) == 1 else groups[k]
+                     for k in order]
+        kv.push(push_keys, push_vals, priority=priority)
+
+    @staticmethod
+    def kv_pull(kv, keys, outs, priority):
+        kv.pull(list(keys), out=list(outs), priority=priority)
+
+    @staticmethod
+    def kv_type(kv):
+        return kv.type
+
+    @staticmethod
+    def kv_rank(kv):
+        return kv.rank
+
+    @staticmethod
+    def kv_group_size(kv):
+        return kv.num_workers
+
+    @staticmethod
+    def kv_barrier(kv):
+        kv.barrier()
 )PY";
 
 PyObject* g_ndcore_cls = nullptr;
@@ -471,6 +518,186 @@ int MXImperativeInvoke(void* creator, int num_inputs, void** inputs,
     *outputs = g_ret_handles.data();
     rc = 0;
   } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// MXKVStore*: the store C ABI (reference src/c_api/c_api.cc kvstore slice).
+// Lives in THIS library so pushed/pulled values are the same NDHandle
+// objects MXNDArrayCreate hands out — one seam, like the reference's
+// single libmxnet.so.  Int keys (the classic surface); a handle is a
+// Python mxnet_tpu KVStore.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct KVHandle {
+  PyObject* obj = nullptr;     // mxnet_tpu KVStore
+  std::string type_cache;
+};
+
+// shared body: build [keys], [value-objs] lists and call a _NDCore kv_*
+// classmethod.  vals[i] are NDHandle*.
+int kv_call_pairs(const char* method, void* handle, uint32_t num,
+                  const int* keys, void** vals, int priority) {
+  auto* h = static_cast<KVHandle*>(handle);
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* klist = PyList_New(num);
+    PyObject* vlist = PyList_New(num);
+    bool bad = false;
+    for (uint32_t i = 0; i < num; ++i) {
+      PyList_SET_ITEM(klist, i, PyLong_FromLong(keys[i]));
+      auto* nd = static_cast<NDHandle*>(vals[i]);
+      if (!nd || !nd->obj) {
+        bad = true;
+        break;
+      }
+      Py_INCREF(nd->obj);
+      PyList_SET_ITEM(vlist, i, nd->obj);
+    }
+    if (bad) {
+      Py_DECREF(klist);
+      Py_DECREF(vlist);
+      nd_set_err("null NDArray handle in kvstore call");
+      break;
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, method, "OOOi",
+                                      h->obj, klist, vlist, priority);
+    Py_DECREF(klist);
+    Py_DECREF(vlist);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    Py_DECREF(r);
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXKVStoreCreate(const char* type, void** out) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* obj = PyObject_CallMethod(g_ndcore_cls, "kv_create", "s",
+                                        type ? type : "local");
+    if (!obj) {
+      nd_set_err_from_python();
+      break;
+    }
+    auto* h = new KVHandle();
+    h->obj = obj;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXKVStoreFree(void* handle) {
+  auto* h = static_cast<KVHandle*>(handle);
+  if (!h) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
+}
+
+int MXKVStoreInit(void* handle, uint32_t num, const int* keys,
+                  void** vals) {
+  // init has no priority in the C signature; the shared helper (which
+  // also guards null handles) passes a dummy 0 the bootstrap ignores
+  return kv_call_pairs("kv_init", handle, num, keys, vals, 0);
+}
+
+int MXKVStorePush(void* handle, uint32_t num, const int* keys, void** vals,
+                  int priority) {
+  return kv_call_pairs("kv_push", handle, num, keys, vals, priority);
+}
+
+int MXKVStorePull(void* handle, uint32_t num, const int* keys, void** outs,
+                  int priority) {
+  return kv_call_pairs("kv_pull", handle, num, keys, outs, priority);
+}
+
+int MXKVStoreGetType(void* handle, const char** out_type) {
+  auto* h = static_cast<KVHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "kv_type", "O", h->obj);
+  if (r) {
+    const char* u = PyUnicode_AsUTF8(r);
+    h->type_cache = u ? u : "";
+    *out_type = h->type_cache.c_str();
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXKVStoreGetRank(void* handle, int* out) {
+  auto* h = static_cast<KVHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "kv_rank", "O", h->obj);
+  if (r) {
+    *out = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXKVStoreGetGroupSize(void* handle, int* out) {
+  auto* h = static_cast<KVHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "kv_group_size", "O",
+                                    h->obj);
+  if (r) {
+    *out = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    nd_set_err_from_python();
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXKVStoreBarrier(void* handle) {
+  auto* h = static_cast<KVHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, "kv_barrier", "O",
+                                    h->obj);
+  if (r) {
+    Py_DECREF(r);
+    rc = 0;
+  } else {
+    nd_set_err_from_python();
+  }
   PyGILState_Release(gil);
   return rc;
 }

@@ -654,3 +654,64 @@ def test_symbol_abi_partial_infer_shape():
     assert comp.value == 0
     assert iss.value == 0 and oss.value == 0
     lib.MXSymbolFree(h)
+
+
+def test_kvstore_abi_init_push_pull():
+    """MXKVStore* slice (reference c_api.cc): create/init/push/pull with
+    int keys; pushed values are MXNDArray* handles from the same .so, a
+    repeated key is a multi-device push that reduces before the updater
+    (KVStoreLocal semantics)."""
+    lib = native.load_ndarray()
+    vp = ctypes.c_void_p
+    u32 = ctypes.c_uint32
+
+    def check(rc):
+        assert rc == 0, lib.MXNDGetLastError().decode()
+
+    def make_nd(arr):
+        arr = np.ascontiguousarray(arr, np.float32)
+        shp = (u32 * arr.ndim)(*arr.shape)
+        h = vp()
+        check(lib.MXNDArrayCreate(shp, arr.ndim, 1, 0, 0,
+                                  ctypes.byref(h)))
+        check(lib.MXNDArraySyncCopyFromCPU(h, arr.ctypes.data_as(vp),
+                                           arr.size))
+        return h
+
+    kv = vp()
+    check(lib.MXKVStoreCreate(b"local", ctypes.byref(kv)))
+    t = ctypes.c_char_p()
+    check(lib.MXKVStoreGetType(kv, ctypes.byref(t)))
+    assert t.value == b"local"
+    r, g = ctypes.c_int(), ctypes.c_int()
+    check(lib.MXKVStoreGetRank(kv, ctypes.byref(r)))
+    check(lib.MXKVStoreGetGroupSize(kv, ctypes.byref(g)))
+    assert (r.value, g.value) == (0, 1)
+
+    init = make_nd(np.zeros((2, 2)))
+    check(lib.MXKVStoreInit(kv, 1, (ctypes.c_int * 1)(3),
+                            (vp * 1)(init)))
+    a = make_nd(np.full((2, 2), 1.5))
+    b = make_nd(np.full((2, 2), 2.0))
+    check(lib.MXKVStorePush(kv, 2, (ctypes.c_int * 2)(3, 3),
+                            (vp * 2)(a, b), 0))
+    out = make_nd(np.zeros((2, 2)))
+    ovals = (vp * 1)(out)
+    check(lib.MXKVStorePull(kv, 1, (ctypes.c_int * 1)(3), ovals, 0))
+    res = np.zeros((2, 2), np.float32)
+    check(lib.MXNDArraySyncCopyToCPU(out, res.ctypes.data_as(vp),
+                                     res.size))
+    np.testing.assert_allclose(res, 3.5)       # multi-device reduce
+    check(lib.MXKVStoreBarrier(kv))
+    # cross-check through the PYTHON frontend: same store semantics
+    import mxnet_tpu as mx2
+    pykv = mx2.kv.create("local")
+    pykv.init(3, mx2.nd.zeros((2, 2)))
+    pykv.push(3, [mx2.nd.full((2, 2), 1.5), mx2.nd.full((2, 2), 2.0)])
+    np.testing.assert_allclose(pykv.pull(3).asnumpy(), res)
+    # error surface
+    rc = lib.MXKVStorePull(kv, 1, (ctypes.c_int * 1)(99), ovals, 0)
+    assert rc != 0 and b"not initialized" in lib.MXNDGetLastError()
+    for h in (init, a, b, out):
+        lib.MXNDArrayFree(h)
+    lib.MXKVStoreFree(kv)
