@@ -382,9 +382,15 @@ def _backend_reachable(timeout=PROBE_TIMEOUT_S):
     import subprocess
     import sys
     try:
+        # a REAL data round-trip, not just jax.devices(): round 4 saw a
+        # window where the claim succeeded but the first transfer hit
+        # "connection dropped ... giving up" after 5 h of PJRT retries —
+        # a tiny matmul catches a dead data path in seconds
         r = subprocess.run(
             [sys.executable, "-c",
-             "import jax; jax.devices(); print('ok')"],
+             "import jax, jax.numpy as jnp; "
+             "x = (jnp.ones((64, 64)) @ jnp.ones((64, 64)))"
+             ".block_until_ready(); print('ok', float(x[0, 0]))"],
             capture_output=True, text=True, timeout=timeout)
         return r.returncode == 0 and "ok" in r.stdout
     except subprocess.TimeoutExpired:
@@ -398,8 +404,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--only", choices=["resnet_bf16", "resnet_fp32",
-                                       "mnist_mlp", "bert", "nmt", "ssd",
-                                       "pipeline"],
+                                       "mnist_mlp", "bert", "bert_bf16",
+                                       "nmt", "ssd", "pipeline"],
                     help="run a single row (default: the full suite)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
@@ -455,16 +461,32 @@ def main():
             return jax.profiler.trace(args.profile)
         return contextlib.nullcontext()
 
+    def _small(**reduced):
+        """CPU CI host (1 core) gets reduced step counts; TPU keeps the
+        real ones.  Only called in --only subprocesses, where THIS
+        process owns the backend anyway."""
+        import jax as _jax
+        return reduced if _jax.default_backend() == "cpu" else {}
+
     rows = {}
     if args.only == "mnist_mlp":
         rows["mnist_mlp_imperative"] = bench_mnist_mlp()
     elif args.only == "bert":
-        rows["bert_base"] = bench_bert_base()
-        rows["bert_base_flash"] = bench_bert_base(attention="flash")
+        small = _small(iters=2, warmup=1, batch=2, seq=256)
+        rows["bert_base"] = bench_bert_base(**small)
+        rows["bert_base_flash"] = bench_bert_base(attention="flash",
+                                                  **small)
+    elif args.only == "bert_bf16":
+        small = _small(iters=2, warmup=1, batch=2, seq=256)
+        rows["bert_base_bf16"] = bench_bert_base(dtype="bfloat16",
+                                                 **small)
+        rows["bert_base_bf16_flash"] = bench_bert_base(
+            dtype="bfloat16", attention="flash", **small)
     elif args.only == "nmt":
-        rows["nmt_transformer"] = bench_nmt()
+        rows["nmt_transformer"] = bench_nmt(**_small(iters=2, warmup=1))
     elif args.only == "ssd":
-        rows["ssd_detection"] = bench_ssd()
+        rows["ssd_detection"] = bench_ssd(
+            **_small(iters=2, warmup=1, batch=2))
     elif args.only == "pipeline":
         rows["input_pipeline"] = bench_pipeline()
     elif args.only in ("resnet_bf16", "resnet_fp32") or args.dtype:
@@ -476,52 +498,89 @@ def main():
                                        args.warmup, args.size,
                                        args.layout)
     else:
-        # one failing row must not zero the whole suite: record the
-        # error string in its row and keep going
-        def guarded(key, fn):
+        # FULL suite: every row runs in its OWN subprocess (`--only ROW`)
+        # with a hard timeout.  Two reasons, both learned on real
+        # hardware: (a) one failing row must not zero the suite; (b) a
+        # chip dying MID-ROW can park the parent inside PJRT's retry loop
+        # for hours (round 4: net.initialize() retried a dropped
+        # connection for ~5 h) — only process isolation bounds that.
+        # Rows share no in-process compile cache anyway (different
+        # graphs); the persistent XLA cache still amortizes across
+        # subprocesses where enabled.
+        import subprocess
+
+        # the parent must NOT touch jax here: initializing the backend
+        # would hold the exclusive chip claim the row subprocesses need.
+        # CPU-CI detection from env only (the conftest/CI convention).
+        cpu_ci = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        # generous budgets: first-compile over the remote tunnel has
+        # taken tens of minutes; a DEAD chip burns hours — cap each row
+        row_budget = 1800 if cpu_ci else 5400
+
+        def sub_row(only, canonical_keys, timeout):
+            """Run one row via `--only` in its own process; record errors
+            under the row's CANONICAL key with the child's stderr tail
+            (the only place a crash explains itself)."""
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--only", only,
+                   "--batch", str(args.batch), "--iters", str(args.iters),
+                   "--warmup", str(args.warmup), "--size", str(args.size)]
+            if args.layout != "NCHW":
+                cmd += ["--layout", args.layout]
+
+            def err(msg):
+                for k in canonical_keys:
+                    rows[k] = {"error": msg[:400]}
             try:
-                rows[key] = fn()
-            except Exception as e:      # noqa: BLE001
-                rows[key] = {"error": f"{type(e).__name__}: {e}"[:300]}
+                r = subprocess.run(cmd, capture_output=True, text=True,
+                                   timeout=timeout)
+            except subprocess.TimeoutExpired:
+                err(f"row timed out after {timeout}s (subprocess killed; "
+                    "chip hang contained)")
+                return
+            try:
+                data = json.loads(r.stdout.strip().splitlines()[-1])
+                got = data.get("rows", {})
+            except Exception:  # noqa: BLE001
+                err(f"row subprocess rc={r.returncode}, unparseable "
+                    f"output; stderr: {r.stderr[-300:]}")
+                return
+            missing = [k for k in canonical_keys if k not in got]
+            if missing:
+                # e.g. the child hit its own chip-unavailable fallback
+                detail = got.get("error") if isinstance(
+                    got.get("error"), str) else r.stderr[-300:]
+                err(f"row subprocess rc={r.returncode} returned no "
+                    f"{missing}; {detail}")
+                return
+            for k in canonical_keys:
+                rows[k] = got[k]
 
-        def headline_resnet():
-            with profiled():
-                return bench_resnet50(
-                    "bfloat16", args.batch, args.iters, args.warmup,
-                    args.size, args.layout)
-
-        guarded("resnet50_bf16", headline_resnet)
-        guarded("resnet50_fp32", lambda: bench_resnet50(
-            "float32", args.batch, args.iters, args.warmup, args.size,
-            args.layout))
-        guarded("mnist_mlp_imperative", bench_mnist_mlp)
-        # CPU CI host (1 core) gets reduced step counts; the TPU run
-        # keeps the real ones
-        import jax as _jax
-        cpu_ci = _jax.default_backend() == "cpu"
-        if cpu_ci:
-            guarded("bert_base", lambda: bench_bert_base(
-                iters=2, warmup=1, batch=2, seq=256))
-            guarded("bert_base_flash", lambda: bench_bert_base(
-                iters=2, warmup=1, batch=2, seq=256, attention="flash"))
+        if args.profile:
+            # the profiled headline row stays in-process so the trace
+            # context wraps the real execution (accepting the hang
+            # exposure ONLY when a profile was explicitly requested)
+            try:
+                with profiled():
+                    rows["resnet50_bf16"] = bench_resnet50(
+                        "bfloat16", args.batch, args.iters, args.warmup,
+                        args.size, args.layout)
+            except Exception as e:  # noqa: BLE001
+                rows["resnet50_bf16"] = {
+                    "error": f"{type(e).__name__}: {e}"[:300]}
         else:
-            # both attention paths on-chip: XLA additive-mask softmax vs
-            # the Pallas flash kernel (identical model/loss/data)
-            guarded("bert_base", bench_bert_base)
-            guarded("bert_base_flash",
-                    lambda: bench_bert_base(attention="flash"))
-            guarded("bert_base_bf16",
-                    lambda: bench_bert_base(dtype="bfloat16"))
-            guarded("bert_base_bf16_flash",
-                    lambda: bench_bert_base(dtype="bfloat16",
-                                            attention="flash"))
-        guarded("nmt_transformer",
-                (lambda: bench_nmt(iters=2, warmup=1)) if cpu_ci
-                else bench_nmt)
-        guarded("ssd_detection",
-                (lambda: bench_ssd(iters=2, warmup=1, batch=2)) if cpu_ci
-                else bench_ssd)
-        guarded("input_pipeline", bench_pipeline)
+            sub_row("resnet_bf16", ["resnet50_bf16"], row_budget)
+        sub_row("resnet_fp32", ["resnet50_fp32"], row_budget)
+        sub_row("mnist_mlp", ["mnist_mlp_imperative"], 900)
+        sub_row("bert", ["bert_base", "bert_base_flash"], row_budget)
+        if not cpu_ci:
+            # the MXU-native BERT pair (cpu CI covers the fp32 pair only)
+            sub_row("bert_bf16",
+                    ["bert_base_bf16", "bert_base_bf16_flash"],
+                    row_budget)
+        sub_row("nmt", ["nmt_transformer"], row_budget)
+        sub_row("ssd", ["ssd_detection"], row_budget)
+        sub_row("pipeline", ["input_pipeline"], 900)
 
     # per-row headline field + unit, so --only rows are labeled honestly
     HEADLINE = {
