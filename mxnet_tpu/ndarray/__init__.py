@@ -129,6 +129,17 @@ def split_v2(ary, indices_or_sections=None, axis=0, squeeze_axis=False,
     return invoke_by_name("split_v2", [ary], kwargs, out=out)
 
 
+def _set_value(src=0.0, out=None, **kwargs):
+    """Reference calling convention (c_api 1.x): a pure out= fill —
+    ``_set_value(2.5, out=arr)`` with NO tensor inputs; the target
+    supplies the shape."""
+    from ..base import MXNetError
+    if out is None:
+        raise MXNetError("_set_value requires out=")
+    return invoke_by_name("_set_value", [out], {"src": float(src)},
+                          out=out)
+
+
 def Dropout(data, p=0.5, mode="training", axes=(), cudnn_off=None, **kwargs):
     """Dropout; active only under autograd.train_mode (or mode='always'),
     matching the reference op's behavior."""

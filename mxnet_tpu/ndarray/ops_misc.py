@@ -27,7 +27,7 @@ from __future__ import annotations
 
 import numpy as _np
 
-from .register import register_op
+from .register import register_op, simple_op
 
 
 def normalize_split_indices(indices):
@@ -798,6 +798,64 @@ def _register():
         return fn
     register_op("_scatter_set_nd", scatter_set_nd_maker,
                 differentiable=False)
+
+
+    # ---- small 1.x internals kept for name-level parity -----------------
+    simple_op("_copyto", lambda x: x,
+              doc="reference _copyto: device/dtype copy (placement is "
+                  "handled by invoke's ctx logic; jit output is a fresh "
+                  "buffer, preserving copy semantics)")
+
+    def set_value_maker(src=0.0):
+        # reference _set_value: fill the (out=) target with a scalar
+        def fn(x):
+            return jnp.full_like(x, src)
+        return fn
+    register_op("_set_value", set_value_maker, differentiable=False)
+
+    simple_op("_identity_with_attr_like_rhs", lambda lhs, rhs: lhs,
+              doc="reference: identity on lhs carrying rhs's storage "
+                  "attrs (sparse-grad plumbing); dense XLA arrays make "
+                  "it a plain identity")
+
+    def rnn_param_concat_maker(dim=0, num_args=1):
+        # reference _rnn_param_concat (rnn-inl.h): concat of per-layer
+        # RNN parameter blobs — shape-inference-special in nnvm, a plain
+        # concat under eval_shape
+        def fn(*parts):
+            return jnp.concatenate([p.reshape(-1) if dim == 0 and
+                                    p.ndim > 1 else p for p in parts],
+                                   axis=dim)
+        return fn
+    register_op("_rnn_param_concat", rnn_param_concat_maker)
+
+    # straight-through estimators (reference contrib round_ste/sign_ste,
+    # src/operator/contrib/stes_op.cc): quantization-aware training —
+    # discrete forward, identity backward
+    def _ste(fwd):
+        def maker():
+            @jax.custom_vjp
+            def fn(x):
+                return fwd(x)
+
+            def fn_fwd(x):
+                return fwd(x), None
+
+            def fn_bwd(_, ct):
+                return (ct,)          # gradient passes STRAIGHT THROUGH
+            fn.defvjp(fn_fwd, fn_bwd)
+            return fn
+        return maker
+    def _round_half_away(x):
+        # reference stes_op.cc rounds half AWAY from zero (::roundf);
+        # jnp.round is half-to-even — match the reference for QAT parity
+        return jnp.where(x >= 0, jnp.floor(x + 0.5), jnp.ceil(x - 0.5))
+    register_op("round_ste", _ste(_round_half_away),
+                aliases=("_contrib_round_ste",),
+                ref="src/operator/contrib/stes_op.cc")
+    register_op("sign_ste", _ste(jnp.sign),
+                aliases=("_contrib_sign_ste",),
+                ref="src/operator/contrib/stes_op.cc")
 
 
 _register()

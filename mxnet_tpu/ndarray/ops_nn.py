@@ -330,6 +330,20 @@ def _register():
         return fn
     register_op("BatchNorm", batchnorm_maker, aliases=("batch_norm",))
 
+    def batchnorm_v1_maker(eps=1e-3, momentum=0.9, fix_gamma=True,
+                           use_global_stats=False, output_mean_var=False,
+                           _training=True):
+        # reference src/operator/batch_norm_v1.cc: the pre-0.12 op — NCHW
+        # only (channel axis 1), no cudnn/axis options; kept because
+        # legacy symbol JSON files reference it by name
+        return batchnorm_maker(eps=eps, momentum=momentum,
+                               fix_gamma=fix_gamma,
+                               use_global_stats=use_global_stats,
+                               axis=1, _training=_training)
+    register_op("BatchNorm_v1", batchnorm_v1_maker,
+                ref="src/operator/batch_norm_v1.cc")
+
+
     def layernorm_maker(axis=-1, eps=1e-5, output_mean_var=False):
         def fn(x, gamma, beta):
             mean = jnp.mean(x, axis=axis, keepdims=True)

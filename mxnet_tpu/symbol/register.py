@@ -21,6 +21,8 @@ _OP_INPUTS: Dict[str, List[str]] = {
     "Convolution": ["data", "weight", "bias"],
     "Deconvolution": ["data", "weight", "bias"],
     "BatchNorm": ["data", "gamma", "beta", "moving_mean", "moving_var"],
+    "BatchNorm_v1": ["data", "gamma", "beta", "moving_mean",
+                     "moving_var"],
     "LayerNorm": ["data", "gamma", "beta"],
     "InstanceNorm": ["data", "gamma", "beta"],
     "Embedding": ["data", "weight"],
@@ -28,7 +30,8 @@ _OP_INPUTS: Dict[str, List[str]] = {
     "RNN": ["data", "parameters", "state", "state_cell"],
     "SoftmaxOutput": ["data", "label"],
 }
-_OP_AUX = {"BatchNorm": ("moving_mean", "moving_var")}
+_OP_AUX = {"BatchNorm": ("moving_mean", "moving_var"),
+           "BatchNorm_v1": ("moving_mean", "moving_var")}
 
 # ops whose trailing inputs are optional depending on params
 def _needed_inputs(opname: str, kwargs: Dict[str, Any]) -> List[str]:
@@ -44,7 +47,7 @@ def _needed_inputs(opname: str, kwargs: Dict[str, Any]) -> List[str]:
 
 
 def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
-    if opname == "BatchNorm":
+    if opname in ("BatchNorm", "BatchNorm_v1"):
         return 3
     if opname in ("split", "SliceChannel"):
         return int(kwargs.get("num_outputs", 1))

@@ -258,8 +258,8 @@ class Symbol:
                     continue
                 op = get_op(node.op)
                 kwargs = _op_kwargs(node.attrs)
-                if node.op in ("BatchNorm", "Custom", "_foreach",
-                               "_while_loop", "_cond"):
+                if node.op in ("BatchNorm", "BatchNorm_v1", "Custom",
+                               "_foreach", "_while_loop", "_cond"):
                     # train/eval-sensitive ops (BatchNorm statistics;
                     # subgraph bodies may hold Dropout/BatchNorm of their
                     # own) follow the executor's mode
@@ -568,7 +568,7 @@ def _infer_missing(sym: Symbol, known: Dict[str, Tuple[int, ...]],
             continue
         op = get_op(node.op)
         kwargs = _op_kwargs(node.attrs)
-        if node.op == "BatchNorm":
+        if node.op in ("BatchNorm", "BatchNorm_v1"):
             kwargs.setdefault("_training", False)
         try:
             extra = _scalar_extra(node.op, kwargs)
@@ -625,8 +625,10 @@ def _infer_node_params(node: _Node, in_shapes, unknown, out) -> None:
                     out[p.name] = (cin, nf // ng) + k
             elif pos == 2:
                 out[p.name] = (nf,)
-    elif node.op in ("BatchNorm", "LayerNorm", "InstanceNorm"):
-        axis = int(a.get("axis", 1 if node.op == "BatchNorm" else -1))
+    elif node.op in ("BatchNorm", "BatchNorm_v1", "LayerNorm",
+                     "InstanceNorm"):
+        axis = int(a.get("axis",
+                         1 if node.op.startswith("BatchNorm") else -1))
         c = data[axis % len(data)]
         for p, pos in unknown:
             out[p.name] = (c,)

@@ -946,3 +946,75 @@ def test_creation_ops_honor_ctx_and_reject_bad_kwargs():
     import pytest as _pt
     with _pt.raises(TypeError):
         invoke_by_name("_zeros", [], {"shape": (2,), "start": 5.0})
+
+
+def test_small_internal_parity_ops():
+    """_copyto/_set_value/_identity_with_attr_like_rhs/_rnn_param_concat
+    (reference internal registry names kept for name-level parity)."""
+    x = nd.array(np.arange(4, dtype=np.float32))
+    y = nd._copyto(x)
+    assert y is not x
+    np.testing.assert_allclose(y.asnumpy(), x.asnumpy())
+    buf = nd.zeros((3,))
+    nd._set_value(2.5, out=buf)          # reference form: out= fill
+    np.testing.assert_allclose(buf.asnumpy(), 2.5)
+    z = nd._identity_with_attr_like_rhs(x, y)
+    np.testing.assert_allclose(z.asnumpy(), x.asnumpy())
+    a = nd.array(np.ones((2, 3), np.float32))
+    b = nd.array(np.full((5,), 2.0, np.float32))
+    c = nd._rnn_param_concat(a, b, dim=0, num_args=2)
+    assert c.shape == (11,)
+    np.testing.assert_allclose(c.asnumpy(),
+                               np.concatenate([np.ones(6), np.full(5, 2.0)]))
+
+
+def test_straight_through_estimators():
+    """round_ste/sign_ste (reference contrib/stes_op.cc): discrete
+    forward, identity backward — the QAT building block."""
+    from mxnet_tpu import autograd
+    v = nd.array(np.array([-1.4, -0.4, 0.6, 1.5], np.float32))
+    v.attach_grad()
+    w = nd.array(np.array([1.0, 2.0, 3.0, 4.0], np.float32))
+    with autograd.record():
+        L = (nd.round_ste(v) * w).sum()
+    L.backward()
+    np.testing.assert_allclose(v.grad.asnumpy(), w.asnumpy())
+    np.testing.assert_allclose(nd.round_ste(v).asnumpy(), [-1, -0, 1, 2])
+    # half-AWAY-from-zero at .5 (reference ::roundf, not half-to-even)
+    np.testing.assert_allclose(
+        nd.round_ste(nd.array(np.array([0.5, 1.5, 2.5, -0.5, -2.5],
+                                       np.float32))).asnumpy(),
+        [1., 2., 3., -1., -3.])
+    v.attach_grad()
+    with autograd.record():
+        L = (nd.sign_ste(v) * w).sum()
+    L.backward()
+    np.testing.assert_allclose(v.grad.asnumpy(), w.asnumpy())
+    np.testing.assert_allclose(nd.sign_ste(v).asnumpy(), [-1, -1, 1, 1])
+    # contrib aliases exist
+    assert nd._contrib_round_ste is not None
+
+
+def test_batchnorm_v1_matches_batchnorm():
+    """BatchNorm_v1 (reference batch_norm_v1.cc): the legacy NCHW-only op
+    — same math as BatchNorm at axis=1, distinct name so old JSON loads."""
+    rng = np.random.default_rng(0)
+    x = nd.array(rng.normal(size=(4, 3, 5, 5)).astype(np.float32))
+    g = nd.array(np.ones(3, np.float32))
+    b = nd.array(np.zeros(3, np.float32))
+    mm, mv = nd.zeros((3,)), nd.ones((3,))
+    o = nd.BatchNorm(x, g, b, mm, mv)
+    o1 = (o[0] if isinstance(o, list) else o).asnumpy()
+    v = nd.BatchNorm_v1(x, g, b, mm, mv)
+    v1 = (v[0] if isinstance(v, list) else v).asnumpy()
+    np.testing.assert_allclose(o1, v1, atol=1e-5)
+    # symbol mode auto-creates params incl. aux moving stats, AND shape
+    # inference fills them (legacy JSON graphs must simple_bind)
+    s = mx.sym.BatchNorm_v1(mx.sym.Variable("x"), name="bn1")
+    assert "bn1_gamma" in s.list_arguments()
+    assert "bn1_moving_mean" in s.list_auxiliary_states()
+    arg_shapes, out_shapes, aux_shapes = s[0].infer_shape(x=(4, 3, 5, 5))
+    assert (3,) in arg_shapes and aux_shapes == [(3,), (3,)]
+    ex = s[0].simple_bind(x=(4, 3, 5, 5))
+    y = ex.forward(is_train=False, x=x)[0]
+    assert y.shape == (4, 3, 5, 5)
