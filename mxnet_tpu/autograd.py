@@ -15,6 +15,7 @@ threaded engine.
 """
 from __future__ import annotations
 
+import functools
 import threading
 from typing import Any, List, Optional, Sequence
 
@@ -166,6 +167,27 @@ def _is_float0(ct) -> bool:
     return getattr(ct, "dtype", None) == float0
 
 
+@functools.lru_cache(maxsize=256)
+def _ones_seed_cached(shape, dtype_str):
+    import jax.numpy as jnp
+    return jnp.ones(shape, dtype_str)
+
+
+def _ones_seed(shape, dtype_str):
+    """Default head cotangent: eagerly building jnp.ones dispatches two
+    primitives EVERY backward, and losses reuse the same (shape, dtype)
+    every step — so SMALL seeds (the scalar/loss case) are cached.  Large
+    heads get a fresh buffer: pinning up to 256 arbitrary activations for
+    the process lifetime could hold gigabytes of device memory."""
+    n = 1
+    for d in shape:
+        n *= d
+    if n <= 16384:
+        return _ones_seed_cached(shape, dtype_str)
+    import jax.numpy as jnp
+    return jnp.ones(shape, dtype_str)
+
+
 def backward(heads: Sequence, head_grads=None, retain_graph: bool = False,
              train_mode: bool = True) -> None:
     """Run backward from ``heads`` accumulating into variables' ``.grad``.
@@ -175,8 +197,6 @@ def backward(heads: Sequence, head_grads=None, retain_graph: bool = False,
     node's ``jax.vjp`` closure is invoked in reverse topological order and the
     resulting ops dispatch asynchronously through XLA.
     """
-    import jax.numpy as jnp
-
     heads = list(heads)
     if head_grads is None:
         head_grads = [None] * len(heads)
@@ -209,7 +229,8 @@ def backward(heads: Sequence, head_grads=None, retain_graph: bool = False,
         info = getattr(h, "_ag", None)
         if info is None:
             continue
-        seed = (jnp.ones(h.shape, h.dtype) if hg is None else hg._read())
+        seed = (_ones_seed(tuple(h.shape), str(h.dtype))
+                if hg is None else hg._read())
         if info.node is None:
             # head is itself a variable
             _accum_var(info, seed)
