@@ -12,7 +12,7 @@ from ....base import MXNetError
 from ....ndarray import NDArray, array as nd_array
 from ...block import Block, HybridBlock
 
-__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize",
+__all__ = ["Compose", "Cast", "ToTensor", "Normalize", "Resize", "CropResize",
            "CenterCrop", "RandomResizedCrop", "RandomFlipLeftRight",
            "RandomFlipTopBottom", "RandomBrightness", "RandomContrast",
            "RandomSaturation", "RandomHue", "RandomLighting", "RandomColorJitter"]
@@ -96,6 +96,30 @@ class CenterCrop:
         y0 = max(0, (h - ch) // 2)
         x0 = max(0, (w - cw) // 2)
         return nd_array(a[y0:y0 + ch, x0:x0 + cw])
+
+
+class CropResize:
+    """Crop a fixed box then resize (reference transforms.CropResize:
+    x0/y0 upper-left corner, width/height box, optional output size)."""
+
+    def __init__(self, x0, y0, width, height, size=None, interpolation=1):
+        self._box = (int(x0), int(y0), int(width), int(height))
+        self._size = None if size is None else (
+            (size, size) if isinstance(size, int) else tuple(size))
+
+    def __call__(self, x):
+        a = _to_np(x)
+        x0, y0, w, h = self._box
+        H, W = a.shape[:2]
+        if x0 < 0 or y0 < 0 or x0 + w > W or y0 + h > H:
+            from ....base import MXNetError
+            raise MXNetError(
+                f"CropResize box (x0={x0}, y0={y0}, w={w}, h={h}) exceeds "
+                f"image size (w={W}, h={H})")
+        crop = a[y0:y0 + h, x0:x0 + w]
+        if self._size is not None:
+            crop = _resize_np(crop, self._size)
+        return nd_array(crop)
 
 
 class RandomResizedCrop:

@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import io as _io
 import os
+import math as _math
 import random as _pyrandom
 from typing import List, Optional, Sequence, Tuple
 
@@ -26,7 +27,10 @@ __all__ = ["imread", "imdecode", "imresize", "resize_short", "fixed_crop",
            "ResizeAug", "ForceResizeAug", "CenterCropAug", "RandomCropAug",
            "CastAug", "ColorNormalizeAug", "BrightnessJitterAug",
            "ContrastJitterAug", "SaturationJitterAug", "CreateAugmenter",
-           "Augmenter", "ImageIter"]
+           "Augmenter", "ImageIter",
+           "scale_down", "random_size_crop", "RandomSizedCropAug",
+           "HueJitterAug", "RandomOrderAug", "ColorJitterAug",
+           "LightingAug", "RandomGrayAug"]
 
 
 def _pil():
@@ -102,6 +106,40 @@ def random_crop(src, size: Tuple[int, int], interp: int = 1):
     y0 = _pyrandom.randint(0, max(h - ch, 0))
     out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
     return out, (x0, y0, cw, ch)
+
+
+def scale_down(src_size: Tuple[int, int], size: Tuple[int, int]):
+    """Shrink `size` (w, h) to fit within `src_size` keeping aspect
+    (reference mx.image.scale_down)."""
+    w, h = size
+    sw, sh = src_size
+    if sh < h:
+        w, h = float(w * sh) / h, sh
+    if sw < w:
+        w, h = sw, float(h * sw) / w
+    return int(w), int(h)
+
+
+def random_size_crop(src, size: Tuple[int, int], area, ratio,
+                     interp: int = 1, **kwargs):
+    """Random area/aspect crop then resize to `size` (reference
+    mx.image.random_size_crop — the inception-style crop)."""
+    h, w = src.shape[:2]
+    src_area = h * w
+    if isinstance(area, (int, float)):
+        area = (area, 1.0)
+    for _ in range(10):
+        target_area = _pyrandom.uniform(area[0], area[1]) * src_area
+        log_ratio = (_math.log(ratio[0]), _math.log(ratio[1]))
+        new_ratio = _math.exp(_pyrandom.uniform(*log_ratio))
+        new_w = int(round(_math.sqrt(target_area * new_ratio)))
+        new_h = int(round(_math.sqrt(target_area / new_ratio)))
+        if new_w <= w and new_h <= h:
+            x0 = _pyrandom.randint(0, w - new_w)
+            y0 = _pyrandom.randint(0, h - new_h)
+            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            return out, (x0, y0, new_w, new_h)
+    return center_crop(src, size, interp)      # fallback
 
 
 def color_normalize(src, mean, std=None) -> NDArray:
@@ -209,26 +247,139 @@ class SaturationJitterAug(_JitterAug):
         return nd_array(arr * c + gray * (1.0 - c), ctx=cpu())
 
 
+class RandomSizedCropAug(Augmenter):
+    def __init__(self, size, area, ratio, interp: int = 1):
+        self.size, self.area, self.ratio, self.interp = \
+            size, area, ratio, interp
+
+    def __call__(self, src):
+        return random_size_crop(src, self.size, self.area, self.ratio,
+                                self.interp)[0]
+
+
+class HueJitterAug(Augmenter):
+    """Hue rotation in YIQ space (reference HueJitterAug's tyiq/ityiq
+    matrices)."""
+
+    _TYIQ = _np.array([[0.299, 0.587, 0.114],
+                       [0.596, -0.274, -0.321],
+                       [0.211, -0.523, 0.311]], _np.float32)
+    _ITYIQ = _np.array([[1.0, 0.956, 0.621],
+                        [1.0, -0.272, -0.647],
+                        [1.0, -1.107, 1.705]], _np.float32)
+
+    def __init__(self, hue: float):
+        self.hue = hue
+
+    def __call__(self, src):
+        alpha = _pyrandom.uniform(-self.hue, self.hue)
+        u = _math.cos(alpha * _math.pi)
+        w = _math.sin(alpha * _math.pi)
+        bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
+                       _np.float32)
+        t = self._ITYIQ @ bt @ self._TYIQ
+        arr = src.asnumpy().astype(_np.float32)
+        return nd_array(arr @ t.T, ctx=cpu())
+
+
+class RandomOrderAug(Augmenter):
+    """Apply child augmenters in random order (reference RandomOrderAug)."""
+
+    def __init__(self, ts):
+        self.ts = list(ts)
+
+    def __call__(self, src):
+        order = list(self.ts)
+        _pyrandom.shuffle(order)
+        for t in order:
+            src = t(src)
+        return src
+
+
+class ColorJitterAug(RandomOrderAug):
+    """Brightness/contrast/saturation jitter in random order (reference
+    ColorJitterAug)."""
+
+    def __init__(self, brightness: float, contrast: float,
+                 saturation: float):
+        ts = []
+        if brightness > 0:
+            ts.append(BrightnessJitterAug(brightness))
+        if contrast > 0:
+            ts.append(ContrastJitterAug(contrast))
+        if saturation > 0:
+            ts.append(SaturationJitterAug(saturation))
+        super().__init__(ts)
+
+
+class LightingAug(Augmenter):
+    """PCA-noise lighting (reference LightingAug / AlexNet-style)."""
+
+    def __init__(self, alphastd, eigval, eigvec):
+        self.alphastd = alphastd
+        self.eigval = _np.asarray(eigval, _np.float32)
+        self.eigvec = _np.asarray(eigvec, _np.float32)
+
+    def __call__(self, src):
+        alpha = _np.random.normal(0, self.alphastd, size=(3,))
+        rgb = (self.eigvec * alpha) @ self.eigval
+        return nd_array(src.asnumpy().astype(_np.float32) + rgb,
+                        ctx=cpu())
+
+
+class RandomGrayAug(Augmenter):
+    """Convert to 3-channel gray with probability p (reference
+    RandomGrayAug)."""
+
+    _MAT = _np.array([[0.21, 0.21, 0.21],
+                      [0.72, 0.72, 0.72],
+                      [0.07, 0.07, 0.07]], _np.float32)
+
+    def __init__(self, p: float = 0.5):
+        self.p = p
+
+    def __call__(self, src):
+        if _pyrandom.random() < self.p:
+            arr = src.asnumpy().astype(_np.float32)
+            return nd_array(arr @ self._MAT, ctx=cpu())
+        return src
+
+
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
                     rand_mirror=False, mean=None, std=None,
-                    brightness=0, contrast=0, saturation=0,
-                    inter_method=1, **kwargs) -> List[Augmenter]:
+                    brightness=0, contrast=0, saturation=0, hue=0,
+                    pca_noise=0, rand_gray=0, inter_method=1,
+                    **kwargs) -> List[Augmenter]:
     """Standard augmenter pipeline factory (reference: CreateAugmenter)."""
     auglist: List[Augmenter] = []
     if resize > 0:
         auglist.append(ResizeAug(resize, inter_method))
     crop = (data_shape[2], data_shape[1])
-    auglist.append(RandomCropAug(crop, inter_method) if rand_crop
-                   else CenterCropAug(crop, inter_method))
+    if rand_resize:
+        # inception-style random area/aspect crop (reference: rand_resize
+        # implies rand_crop)
+        auglist.append(RandomSizedCropAug(crop, (0.08, 1.0),
+                                          (3.0 / 4.0, 4.0 / 3.0),
+                                          inter_method))
+    elif rand_crop:
+        auglist.append(RandomCropAug(crop, inter_method))
+    else:
+        auglist.append(CenterCropAug(crop, inter_method))
     if rand_mirror:
         auglist.append(HorizontalFlipAug(0.5))
     auglist.append(CastAug())
-    if brightness:
-        auglist.append(BrightnessJitterAug(brightness))
-    if contrast:
-        auglist.append(ContrastJitterAug(contrast))
-    if saturation:
-        auglist.append(SaturationJitterAug(saturation))
+    if brightness or contrast or saturation:
+        auglist.append(ColorJitterAug(brightness, contrast, saturation))
+    if hue:
+        auglist.append(HueJitterAug(hue))
+    if pca_noise > 0:
+        eigval = _np.array([55.46, 4.794, 1.148])
+        eigvec = _np.array([[-0.5675, 0.7192, 0.4009],
+                            [-0.5808, -0.0045, -0.8140],
+                            [-0.5836, -0.6948, 0.4203]])
+        auglist.append(LightingAug(pca_noise, eigval, eigvec))
+    if rand_gray > 0:
+        auglist.append(RandomGrayAug(rand_gray))
     if mean is True:
         mean = _np.array([123.68, 116.28, 103.53])
     if std is True:

@@ -249,3 +249,61 @@ def test_image_crop_bounds_and_lighting_dtype():
     # short-edge keep_ratio (reference semantics): 8x10 short=8 -> 4
     r = nd.image.resize(img, size=4, keep_ratio=True)
     assert r.shape == (4, 5, 3)
+
+
+def test_crop_resize_transform():
+    """reference gluon.data.vision.transforms.CropResize: fixed-box crop
+    (x0, y0, w, h) with optional resize to `size` (w, h)."""
+    from mxnet_tpu import nd
+    from mxnet_tpu.gluon.data.vision import transforms as T
+    img = nd.array(np.arange(10 * 8 * 3, dtype=np.uint8).reshape(10, 8, 3))
+    out = T.CropResize(2, 1, 4, 6)(img)
+    assert out.shape == (6, 4, 3)
+    np.testing.assert_array_equal(out.asnumpy(), img.asnumpy()[1:7, 2:6])
+    out2 = T.CropResize(2, 1, 4, 6, size=(8, 12))(img)
+    assert out2.shape == (12, 8, 3)
+    with pytest.raises(Exception, match="exceeds"):
+        T.CropResize(0, 20, 4, 4)(img)     # box beyond image bounds
+
+
+def test_image_augmenter_long_tail():
+    """scale_down/random_size_crop + RandomSizedCrop/Hue/ColorJitter/
+    Lighting/RandomGray augmenters (reference mx.image long tail) and the
+    full CreateAugmenter signature (rand_resize/hue/pca_noise/rand_gray)."""
+    from mxnet_tpu import image as I, nd
+    rng = np.random.default_rng(0)
+    img = nd.array(rng.integers(0, 255, (64, 48, 3)).astype(np.uint8))
+    assert I.scale_down((100, 50), (80, 80)) == (50, 50)
+    assert I.scale_down((40, 100), (80, 80)) == (40, 40)
+    out, box = I.random_size_crop(img, (32, 32), (0.1, 1.0),
+                                  (0.75, 1.333))
+    assert out.shape == (32, 32, 3)
+    x0, y0, w, h = box
+    assert 0 <= x0 and x0 + w <= 48 and 0 <= y0 and y0 + h <= 64
+    assert I.RandomSizedCropAug(
+        (24, 24), (0.08, 1.0), (0.75, 1.333))(img).shape == (24, 24, 3)
+    # hue=0 is identity up to the reference's own rounded YIQ constants
+    h0 = I.HueJitterAug(0.0)(img.astype("float32"))
+    np.testing.assert_allclose(h0.asnumpy(),
+                               img.astype("float32").asnumpy(), atol=1.0)
+    hj = I.HueJitterAug(0.5)(img.astype("float32"))
+    assert hj.shape == (64, 48, 3)
+    assert I.ColorJitterAug(0.1, 0.1, 0.1)(
+        img.astype("float32")).shape == (64, 48, 3)
+    la = I.LightingAug(0.1, np.array([55.46, 4.794, 1.148]),
+                       np.array([[-0.5675, 0.7192, 0.4009],
+                                 [-0.5808, -0.0045, -0.8140],
+                                 [-0.5836, -0.6948, 0.4203]]))
+    assert la(img.astype("float32")).shape == (64, 48, 3)
+    g = I.RandomGrayAug(1.0)(img.astype("float32")).asnumpy()
+    assert np.allclose(g[..., 0], g[..., 1])
+    assert np.allclose(g[..., 1], g[..., 2])
+    augs = I.CreateAugmenter((3, 32, 32), rand_resize=True,
+                             rand_mirror=True, brightness=0.1,
+                             contrast=0.1, saturation=0.1, hue=0.1,
+                             pca_noise=0.1, rand_gray=0.2, mean=True,
+                             std=True)
+    x = img
+    for a in augs:
+        x = a(x)
+    assert x.shape == (32, 32, 3)
