@@ -15,9 +15,9 @@ import numpy as _np
 
 from .base import MXNetError
 
-__all__ = ["Initializer", "Uniform", "Normal", "Zero", "One", "Constant",
-           "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear", "LSTMBias",
-           "Mixed", "register", "create"]
+__all__ = ["Initializer", "InitDesc", "Uniform", "Normal", "Zero", "One",
+           "Constant", "Xavier", "MSRAPrelu", "Orthogonal", "Bilinear",
+           "LSTMBias", "Mixed", "Load", "register", "create"]
 
 _registry = {}
 
@@ -37,6 +37,18 @@ def create(init, **kwargs) -> "Initializer":
         if name not in _registry:
             raise MXNetError(f"unknown initializer {init!r}")
         return _registry[name](**kwargs)
+    if isinstance(init, type):
+        # a CLASS (missing parens: initialize(mx.init.Xavier)) would be
+        # silently "callable" and leave params at zero — reject loudly
+        raise MXNetError(
+            f"cannot create initializer from the class {init!r}; "
+            f"pass an INSTANCE (e.g. {getattr(init, '__name__', init)}())")
+    if callable(init):
+        # Mixed/Load and user functions follow the reference's
+        # (name, arr) calling convention without subclassing Initializer;
+        # the adapter supplies the init_weight() surface the per-param
+        # explicit-initializer call site uses
+        return _CallableInit(init)
     raise MXNetError(f"cannot create initializer from {init!r}")
 
 
@@ -48,6 +60,23 @@ class Initializer:
         self._kwargs = kwargs
 
     def __call__(self, name, arr):
+        if isinstance(name, InitDesc):
+            # reference semantics: attrs['__init__'] overrides the
+            # pattern rules ("zeros" or the json '["zeros", {}]' form)
+            desc = name.attrs.get("__init__")
+            if desc:
+                import json as _json
+                try:
+                    parsed = _json.loads(desc)
+                except (ValueError, TypeError):
+                    parsed = desc
+                if isinstance(parsed, (list, tuple)):
+                    sub = create(parsed[0],
+                                 **(parsed[1] if len(parsed) > 1 else {}))
+                else:
+                    sub = create(parsed)
+                sub.init_weight(str(name), arr)
+                return
         self.init_weight_by_name(name, arr)
 
     def init_weight(self, name, arr):
@@ -259,3 +288,71 @@ class Mixed:
                 init(name, arr)
                 return
         raise MXNetError(f"no initializer pattern matches {name!r}")
+
+
+class _CallableInit(Initializer):
+    """Adapter giving bare callables (Mixed, Load, user functions) the
+    Initializer surface — both the global path (__call__) and the
+    explicit per-parameter path (init_weight) route to the callable."""
+
+    def __init__(self, fn):
+        super().__init__()
+        self._fn = fn
+
+    def __call__(self, name, arr):
+        self._fn(name, arr)
+
+    def init_weight(self, name, arr):
+        self._fn(name, arr)
+
+
+class InitDesc(str):
+    """Name descriptor carrying variable attrs to the initializer
+    (reference mx.init.InitDesc: a str subclass, so name-pattern
+    dispatch keeps working while attrs/global_init ride along)."""
+
+    def __new__(cls, name, attrs=None, global_init=None):
+        obj = super().__new__(cls, name)
+        obj.attrs = attrs or {}
+        obj.global_init = global_init
+        return obj
+
+
+class Load:
+    """Initialize from saved parameters with a fallback initializer
+    (reference mx.init.Load): param is a dict name->NDArray or a file
+    saved by mx.nd.save; names may carry 'arg:'/'aux:' prefixes."""
+
+    def __init__(self, param, default_init=None, verbose=False):
+        if isinstance(param, str):
+            from .ndarray import load as nd_load
+            param = nd_load(param)
+        self.param = {}
+        for name, arr in param.items():
+            if name.startswith(("arg:", "aux:")):
+                name = name[4:]
+            self.param[name] = arr
+        self.default_init = default_init
+        self.verbose = verbose
+
+    def __call__(self, name, arr):
+        if name in self.param:
+            src = self.param[name]
+            if tuple(src.shape) != tuple(arr.shape):
+                raise MXNetError(
+                    f"Parameter {name!r} cannot be initialized from "
+                    f"loading: incompatible shapes {tuple(src.shape)} vs "
+                    f"{tuple(arr.shape)}")
+            arr[:] = src
+            if self.verbose:
+                import logging
+                logging.info("Initialized %s by loading", name)
+            return
+        if self.default_init is None:
+            raise MXNetError(
+                f"Cannot Initialize parameter {name!r}: not found in the "
+                f"loaded file and no default_init given")
+        self.default_init(name, arr)
+        if self.verbose:
+            import logging
+            logging.info("Initialized %s by default", name)

@@ -390,3 +390,55 @@ def test_pearson_mcc_nll_metrics():
     # registry create() path
     assert mx.metric.create("mcc").name == "mcc"
     assert mx.metric.create("pearsoncorrelation").name == "pearsonr"
+
+
+def test_initializer_load_mixed_initdesc(tmp_path):
+    """mx.init.Load / InitDesc (reference initializer long tail) and
+    callable initializers through net.initialize."""
+    import mxnet_tpu.initializer as init
+
+    # InitDesc attrs['__init__'] overrides the pattern rules
+    d = init.InitDesc("fc1_weight", attrs={"__init__": "zeros"})
+    assert isinstance(d, str) and d == "fc1_weight"
+    arr = nd.array(np.full((3,), 9.0, np.float32))
+    init.Uniform()(d, arr)
+    np.testing.assert_allclose(arr.asnumpy(), 0.0)   # honored, not random
+    # json ["one", {}] form too
+    d2 = init.InitDesc("w", attrs={"__init__": '["one", {}]'})
+    init.Uniform()(d2, arr)
+    np.testing.assert_allclose(arr.asnumpy(), 1.0)
+
+    # a CLASS (missing parens) is rejected loudly, not silently zero
+    net_bad = mx.gluon.nn.Dense(2, in_units=2)
+    with pytest.raises(Exception, match="INSTANCE"):
+        net_bad.initialize(init.Xavier)
+
+    # explicit per-parameter initializer may be a bare callable
+    netc = mx.gluon.nn.Dense(
+        2, in_units=2, prefix="c_",
+        weight_initializer=init.Mixed([".*"], [init.One()]))
+    netc.initialize()
+    np.testing.assert_allclose(netc.weight.data().asnumpy(), 1.0)
+
+    params = {"arg:w1": nd.array(np.full((2, 3), 7.0, np.float32)),
+              "aux:bn_mean": nd.array(np.ones((3,), np.float32))}
+    f = str(tmp_path / "p.params")
+    nd.save(f, params)
+    ld = init.Load(f, default_init=init.Zero())
+    w = nd.zeros((2, 3))
+    ld("w1", w)                          # arg: prefix stripped
+    np.testing.assert_allclose(w.asnumpy(), 7.0)
+    m = nd.zeros((3,))
+    ld("bn_mean", m)
+    np.testing.assert_allclose(m.asnumpy(), 1.0)
+    o = nd.array(np.full((4,), 5.0, np.float32))
+    ld("other", o)                       # fallback default_init
+    np.testing.assert_allclose(o.asnumpy(), 0.0)
+    with pytest.raises(Exception, match="incompatible shapes"):
+        ld("w1", nd.zeros((9, 9)))
+
+    net = mx.gluon.nn.Dense(3, in_units=3, prefix="d_")
+    net.initialize(init.Load(
+        {"d_weight": nd.array(np.eye(3, dtype=np.float32))},
+        default_init=init.Zero()))
+    np.testing.assert_allclose(net.weight.data().asnumpy(), np.eye(3))
