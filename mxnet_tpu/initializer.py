@@ -327,12 +327,19 @@ class Load:
         if isinstance(param, str):
             from .ndarray import load as nd_load
             param = nd_load(param)
+        if not hasattr(param, "items"):
+            raise MXNetError(
+                "Load expects a name->NDArray dict (or a file saved from "
+                "one); got a list — save params as a dict")
         self.param = {}
         for name, arr in param.items():
             if name.startswith(("arg:", "aux:")):
                 name = name[4:]
             self.param[name] = arr
-        self.default_init = default_init
+        # normalize eagerly: catches the missing-parens/class and
+        # registry-string forms with create()'s loud errors up front
+        self.default_init = None if default_init is None \
+            else create(default_init)
         self.verbose = verbose
 
     def __call__(self, name, arr):

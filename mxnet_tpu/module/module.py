@@ -132,14 +132,13 @@ class Module(BaseModule):
                         arg_params != {}:
                     raise MXNetError(f"missing parameter {name!r}")
                 dst = nd_zeros(arr.shape, ctx=arr.context)
-                spec = attrs.get(name, {}).get("__init__")
-                if spec:
-                    # per-variable initializer attr (reference InitDesc):
-                    # JSON ["name", {kwargs}] beats the pattern rules
-                    import json
-                    from ..initializer import create as _mk_init
-                    iname, ikw = json.loads(spec)
-                    _mk_init(iname, **ikw).init_weight(name, dst)
+                node_attrs = attrs.get(name, {})
+                if node_attrs.get("__init__"):
+                    # per-variable initializer attr: ONE mechanism —
+                    # InitDesc handling in Initializer.__call__ (accepts
+                    # both the plain-name and JSON ["name", {kw}] forms)
+                    from ..initializer import InitDesc
+                    initializer(InitDesc(name, node_attrs), dst)
                 else:
                     initializer(name, dst)
                 self._arg_params[name] = dst

@@ -214,3 +214,82 @@ def test_reduce_forward(name):
         fn(nd.array(x), axis=(0, 2), keepdims=True).asnumpy(),
         npf(x.astype(np.float64), axis=(0, 2), keepdims=True),
         rtol=1e-4, atol=1e-5, err_msg=f"{name} keepdims")
+
+
+# ---------------------------------------------------------------------------
+# shape / indexing family: forward parity vs the numpy formulation
+# (reference test_operator.py's matrix_op/indexing sections, table-ized)
+# ---------------------------------------------------------------------------
+
+SHAPED = {
+    "reshape": (lambda a: nd.reshape(a, shape=(5, 24)),
+                lambda x: x.reshape(5, 24)),
+    "transpose": (lambda a: nd.transpose(a, axes=(1, 0, 2)),
+                  lambda x: np.transpose(x, (1, 0, 2))),
+    "swapaxes": (lambda a: nd.swapaxes(a, dim1=0, dim2=2),
+                 lambda x: np.swapaxes(x, 0, 2)),
+    "flip": (lambda a: nd.flip(a, axis=1), lambda x: np.flip(x, 1)),
+    "tile": (lambda a: nd.tile(a, reps=(2, 1, 3)),
+             lambda x: np.tile(x, (2, 1, 3))),
+    "repeat": (lambda a: nd.repeat(a, repeats=2, axis=1),
+               lambda x: np.repeat(x, 2, 1)),
+    "expand_dims": (lambda a: nd.expand_dims(a, axis=2),
+                    lambda x: np.expand_dims(x, 2)),
+    "clip": (lambda a: nd.clip(a, -0.5, 0.5),
+             lambda x: np.clip(x, -0.5, 0.5)),
+    "slice_axis": (lambda a: nd.slice_axis(a, axis=1, begin=1, end=4),
+                   lambda x: x[:, 1:4]),
+    "slice": (lambda a: nd.slice(a, begin=(1, 0, 2), end=(3, 4, 6)),
+              lambda x: x[1:3, 0:4, 2:6]),
+    "reverse": (lambda a: nd.reverse(a, axis=0), lambda x: x[::-1]),
+    "diag": (lambda a: nd.diag(nd.reshape(a, shape=(12, 10))),
+             lambda x: np.diag(x.reshape(12, 10))),
+    "tril": (lambda a: nd.tril(nd.reshape(a, shape=(12, 10))),
+             lambda x: np.tril(x.reshape(12, 10))),
+    "triu": (lambda a: nd.triu(nd.reshape(a, shape=(12, 10))),
+             lambda x: np.triu(x.reshape(12, 10))),
+    "cumsum": (lambda a: nd.cumsum(a, axis=1), lambda x: np.cumsum(x, 1)),
+    "depth_to_space": (
+        lambda a: nd.depth_to_space(nd.reshape(a, shape=(2, 4, 3, 5)),
+                                    block_size=2),
+        lambda x: x.reshape(2, 2, 2, 1, 3, 5).transpose(0, 3, 4, 1, 5, 2)
+        .reshape(2, 1, 6, 10)),
+    "squeeze": (lambda a: nd.squeeze(nd.reshape(a, shape=(1, 120, 1))),
+                lambda x: x.reshape(120)),
+    "flatten": (lambda a: nd.flatten(a), lambda x: x.reshape(4, 30)),
+}
+
+
+@pytest.mark.parametrize("name", sorted(SHAPED))
+def test_shaped_forward(name):
+    mxf, npf = SHAPED[name]
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    x = rng.standard_normal((4, 5, 6)).astype(np.float32)
+    np.testing.assert_allclose(mxf(nd.array(x)).asnumpy(), npf(x),
+                               rtol=1e-6, atol=1e-6, err_msg=name)
+
+
+INDEXING = {
+    "take": (lambda a, i: nd.take(a, i, axis=0),
+             lambda x, i: np.take(x, i, 0)),
+    "pick": (lambda a, i: nd.pick(a, i, axis=1),
+             lambda x, i: x[np.arange(len(i)), i]),
+    "one_hot": (lambda a, i: nd.one_hot(i, 6),
+                lambda x, i: np.eye(6, dtype=np.float32)[i]),
+    "batch_take": (lambda a, i: nd.batch_take(a, i),
+                   lambda x, i: x[np.arange(len(i)), i]),
+    "gather_nd": (
+        lambda a, i: nd.gather_nd(
+            a, nd.array(np.stack([i, i]), dtype="int32")),
+        lambda x, i: x[i, i]),
+}
+
+
+@pytest.mark.parametrize("name", sorted(INDEXING))
+def test_indexing_forward(name):
+    mxf, npf = INDEXING[name]
+    rng = np.random.default_rng(zlib.crc32(name.encode()))
+    x = rng.standard_normal((5, 6)).astype(np.float32)
+    idx = rng.integers(0, 5, (5,))
+    got = mxf(nd.array(x), nd.array(idx, dtype="int32")).asnumpy()
+    np.testing.assert_allclose(got, npf(x, idx), rtol=1e-6, err_msg=name)
