@@ -213,6 +213,24 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
         elif op in ("softmax", "Softmax"):
             nodes_pb.append(_node("Softmax", ins, outs, node.name,
                                   _a_int("axis", _attr(a, "axis", -1))))
+        elif op in ("_random_uniform", "_random_normal"):
+            # ONNX TensorProto dtype codes for the dtypes jax can draw
+            _RAND_DT = {"float32": 1, "float16": 10, "float64": 11}
+            dt = _attr(a, "dtype", "float32") or "float32"
+            if dt not in _RAND_DT:
+                raise MXNetError(
+                    f"ONNX export: random op dtype {dt!r} unsupported")
+            if op == "_random_uniform":
+                attrs = _a_float("low", float(_attr(a, "low", 0.0))) + \
+                    _a_float("high", float(_attr(a, "high", 1.0)))
+                onnx_op = "RandomUniform"
+            else:
+                attrs = _a_float("mean", float(_attr(a, "loc", 0.0))) + \
+                    _a_float("scale", float(_attr(a, "scale", 1.0)))
+                onnx_op = "RandomNormal"
+            attrs += _a_ints("shape", _attr(a, "shape", (1,))) + \
+                _a_int("dtype", _RAND_DT[dt])
+            nodes_pb.append(_node(onnx_op, [], outs, node.name, attrs))
         elif op in ("Reshape", "reshape"):
             shp = _np.asarray(_attr(a, "shape"), _np.int64)
             sname = f"{node.name}_shape{extra[0]}"

@@ -187,6 +187,22 @@ def import_model(model_file: str):
             prod[out_names[0]] = prod[in_names[0]] if in_names[0] in prod \
                 else var(in_names[0])
             continue
+        elif op_type in ("RandomUniform", "RandomNormal"):
+            _RAND_DT = {1: "float32", 10: "float16", 11: "float64"}
+            code = int(a.get("dtype", 1))
+            if code not in _RAND_DT:
+                raise MXNetError(
+                    f"ONNX import: random op dtype code {code} unsupported")
+            common = {"shape": tuple(a.get("shape", (1,))),
+                      "dtype": _RAND_DT[code]}
+            if op_type == "RandomUniform":
+                node = emit("_random_uniform", name,
+                            dict(common, low=a.get("low", 0.0),
+                                 high=a.get("high", 1.0)), [])
+            else:
+                node = emit("_random_normal", name,
+                            dict(common, loc=a.get("mean", 0.0),
+                                 scale=a.get("scale", 1.0)), [])
         elif op_type == "Softmax":
             node = emit("softmax", name, {"axis": a.get("axis", -1)},
                         in_names)

@@ -95,3 +95,29 @@ def test_onnx_export_rejects_unknown_op(tmp_path):
     with pytest.raises(MXNetError, match="no translation"):
         export_model(y, {}, input_shape=(1,),
                      onnx_file_path=str(tmp_path / "x.onnx"))
+
+
+def test_onnx_random_ops_roundtrip(tmp_path):
+    """RandomUniform/RandomNormal map to the _random_* registry ops in
+    both directions; the reimported graph still draws fresh per forward."""
+    from mxnet_tpu.contrib import onnx as mxonnx
+    from mxnet_tpu import nd
+    x = mx.sym.Variable("data")
+    y = mx.sym.broadcast_add(
+        x, mx.sym.random.normal(5.0, 0.1, shape=(4,)))
+    f = str(tmp_path / "m.onnx")
+    mxonnx.export_model(y, {}, input_shape=(4,), onnx_file_path=f)
+    sym2, _, _ = mxonnx.import_model(f)
+    ex = sym2.simple_bind(data=(4,))
+    zero = nd.array(np.zeros(4, np.float32))
+    a = ex.forward(is_train=False, data=zero)[0].asnumpy()
+    b = ex.forward(is_train=False, data=zero)[0].asnumpy()
+    assert abs(a.mean() - 5.0) < 0.5
+    assert not np.allclose(a, b)
+
+    u = mx.sym.random.uniform(2.0, 3.0, shape=(8,))
+    f2 = str(tmp_path / "u.onnx")
+    mxonnx.export_model(u, {}, input_shape=None, onnx_file_path=f2)
+    sym3, _, _ = mxonnx.import_model(f2)
+    v = sym3.simple_bind().forward(is_train=False)[0].asnumpy()
+    assert v.min() >= 2.0 and v.max() <= 3.0
