@@ -96,3 +96,43 @@ def test_module_fit_epoch_on_chip():
             optimizer_params={"learning_rate": 0.5})
     score = dict(mod.score(mio.NDArrayIter(x, y, batch_size=64), "acc"))
     assert score["accuracy"] > 0.85, score
+
+
+def test_dropout_training_on_chip():
+    """Round-4 RNG discipline on the chip: a hybridized net WITH Dropout
+    keeps the whole-graph-jit economics (the PRNG key is an ARGUMENT of
+    the cached computation — fresh mask per step, no recompilation) and
+    inference is deterministic identity.  Two XLA computations (train
+    graph + eval graph)."""
+    x, y = _toy_cls(n=128, d=16)
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(32, activation="relu"))
+        net.add(gluon.nn.Dropout(0.3))
+        net.add(gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    net.hybridize()
+    tr = gluon.Trainer(net.collect_params(), "sgd",
+                       {"learning_rate": 0.3})
+    loss_fn = gluon.loss.SoftmaxCrossEntropyLoss()
+    xb, yb = nd.array(x), nd.array(y)
+    first = last = None
+    for i in range(30):
+        with autograd.record():
+            L = nd.mean(loss_fn(net(xb), yb))
+        L.backward()
+        tr.step(1)
+        v = float(L.asnumpy())
+        first = v if first is None else first
+        last = v
+    assert last < first * 0.7, (first, last)
+    # inference: dropout off, two forwards bitwise-identical
+    p1 = net(xb).asnumpy()
+    p2 = net(xb).asnumpy()
+    assert np.array_equal(p1, p2)
+    # train-mode masks vary across calls (key is an argument, not baked)
+    with autograd.record():
+        a = net(xb).asnumpy()
+    with autograd.record():
+        b = net(xb).asnumpy()
+    assert not np.allclose(a, b)
