@@ -41,6 +41,7 @@ def _draw_shape(shape):
 
 
 def _register():
+    import jax
     import jax.numpy as jnp
     import jax.random as jr
 
@@ -309,22 +310,57 @@ def _register():
         squeeze = shape in (None, ())
         dt = dtype_np(dtype)
 
-        def fn(p, key):
+        def draw(p, key):
             logits = jnp.log(jnp.maximum(p, 1e-30))
             batch = p.shape[:-1]
             samples = jr.categorical(key, logits[..., None, :], axis=-1,
-                                     shape=batch + (n,)).astype(dt)
-            out = samples[..., 0] if squeeze else samples
-            if not get_prob:
-                return out
+                                     shape=batch + (n,))
             lp = jnp.take_along_axis(
                 logits.reshape(-1, p.shape[-1]),
-                samples.reshape(-1, n).astype(jnp.int32), axis=-1)
-            lp = lp.reshape(batch + (n,))
-            return out, (lp[..., 0] if squeeze else lp)
+                samples.reshape(-1, n), axis=-1).reshape(batch + (n,))
+            return samples, lp
+
+        if not get_prob:
+            def fn(p, key):
+                samples, _ = draw(p, key)
+                out = samples.astype(dt)
+                return out[..., 0] if squeeze else out
+            return fn
+
+        # get_prob=True: the log-prob output is DIFFERENTIABLE wrt p
+        # (reference sample_multinomial backward — the REINFORCE idiom:
+        # d logp_i / d p_j = 1/p_c for the sampled class c, else 0)
+        @jax.custom_vjp
+        def fn(p, key):
+            samples, lp = draw(p, key)
+            out = samples.astype(dt)
+            return ((out[..., 0], lp[..., 0]) if squeeze
+                    else (out, lp))
+
+        def fwd(p, key):
+            samples, lp = draw(p, key)
+            out = samples.astype(dt)
+            res = (p, samples)
+            return (((out[..., 0], lp[..., 0]) if squeeze
+                     else (out, lp)), res)
+
+        def bwd(res, cts):
+            p, samples = res
+            _, ct_lp = cts
+            ct = ct_lp[..., None] if squeeze else ct_lp   # (batch, n)
+            p_c = jnp.take_along_axis(p, samples, axis=-1)  # (batch, n)
+            oh = jax.nn.one_hot(samples, p.shape[-1], dtype=p.dtype)
+            grad_p = ((ct / jnp.maximum(p_c, 1e-30))[..., None]
+                      * oh).sum(axis=-2)
+            return grad_p, None
+        fn.defvjp(fwd, bwd)
         return fn
+    # differentiable only in the get_prob=True form: the samples-only
+    # mode must NOT silently record zero gradients (a forgotten
+    # get_prob=True in an RL loop should fail loudly, as before)
     register_op("_sample_multinomial", sample_multinomial_maker,
-                needs_rng=True, differentiable=False)
+                needs_rng=True,
+                differentiable=lambda kw: bool(kw.get("get_prob")))
 
     def shuffle_maker(ctx=None):
         def fn(data, key):

@@ -278,7 +278,9 @@ def invoke(op: Operator, inputs: Sequence, kwargs: Dict[str, Any],
         from .. import random as _grandom
         in_vals.append(_grandom.next_key())
 
-    recording = (_autograd.is_recording() and op.differentiable
+    differentiable = op.differentiable(kwargs) \
+        if callable(op.differentiable) else op.differentiable
+    recording = (_autograd.is_recording() and differentiable
                  and any(getattr(x, "_ag", None) is not None
                          for x in nd_inputs))
     eng = engine()

@@ -352,3 +352,34 @@ def test_hybridized_dropout_stays_fresh():
     with autograd.record():
         b = net(x).asnumpy()
     assert not np.allclose(a, b)              # no baked-in key constant
+
+
+def test_multinomial_get_prob_gradient():
+    """reference sample_multinomial backward (the REINFORCE idiom): the
+    log-prob output is differentiable — d logp / d p_j = 1/p_c for the
+    sampled class, accumulated over draws."""
+    p = mx.nd.array(np.array([[0.2, 0.3, 0.5], [0.6, 0.3, 0.1]],
+                             np.float32))
+    from mxnet_tpu import autograd
+    p.attach_grad()
+    with autograd.record():
+        s, lp = mx.nd.random.multinomial(p, shape=4, get_prob=True)
+    lp.backward()
+    g = p.grad.asnumpy()
+    sv, pv = s.asnumpy(), p.asnumpy()
+    want = np.zeros_like(pv)
+    for b in range(2):
+        for i in range(4):
+            c = int(sv[b, i])
+            want[b, c] += 1.0 / pv[b, c]
+    np.testing.assert_allclose(g, want, rtol=1e-5)
+    # squeeze (shape=None) path
+    p.attach_grad()
+    with autograd.record():
+        s1, lp1 = mx.nd.random.multinomial(p, get_prob=True)
+    lp1.backward()
+    g1, s1v = p.grad.asnumpy(), s1.asnumpy()
+    want1 = np.zeros_like(pv)
+    for b in range(2):
+        want1[b, int(s1v[b])] = 1.0 / pv[b, int(s1v[b])]
+    np.testing.assert_allclose(g1, want1, rtol=1e-5)
