@@ -513,9 +513,31 @@ def main():
         # would hold the exclusive chip claim the row subprocesses need.
         # CPU-CI detection from env only (the conftest/CI convention).
         cpu_ci = os.environ.get("JAX_PLATFORMS", "").strip() == "cpu"
+        # a stale partial file from a PREVIOUS run must never read as this
+        # run's evidence — reset before the first row can flush
+        try:
+            with open("bench_rows_partial.json", "w") as f:
+                json.dump({"partial": True, "rows": {}}, f)
+        except OSError:
+            pass
         # generous budgets: first-compile over the remote tunnel has
         # taken tens of minutes; a DEAD chip burns hours — cap each row
         row_budget = 1800 if cpu_ci else 5400
+
+        def _flush():
+            """Persist every completed row immediately.  Lesson from the
+            round-4 outage (PERF.md): the chip window can be minutes wide
+            and the driver's run can be killed mid-suite — a row that only
+            lives in this process's memory is a row lost.  The partial
+            file is overwritten atomically per row and left in-repo so an
+            interrupted run still yields evidence."""
+            try:
+                tmp = "bench_rows_partial.json.tmp"
+                with open(tmp, "w") as f:
+                    json.dump({"partial": True, "rows": rows}, f)
+                os.replace(tmp, "bench_rows_partial.json")
+            except OSError:
+                pass  # read-only cwd must never kill the bench
 
         def sub_row(only, canonical_keys, timeout):
             """Run one row via `--only` in its own process; record errors
@@ -532,29 +554,32 @@ def main():
                 for k in canonical_keys:
                     rows[k] = {"error": msg[:400]}
             try:
-                r = subprocess.run(cmd, capture_output=True, text=True,
-                                   timeout=timeout)
-            except subprocess.TimeoutExpired:
-                err(f"row timed out after {timeout}s (subprocess killed; "
-                    "chip hang contained)")
-                return
-            try:
-                data = json.loads(r.stdout.strip().splitlines()[-1])
-                got = data.get("rows", {})
-            except Exception:  # noqa: BLE001
-                err(f"row subprocess rc={r.returncode}, unparseable "
-                    f"output; stderr: {r.stderr[-300:]}")
-                return
-            missing = [k for k in canonical_keys if k not in got]
-            if missing:
-                # e.g. the child hit its own chip-unavailable fallback
-                detail = got.get("error") if isinstance(
-                    got.get("error"), str) else r.stderr[-300:]
-                err(f"row subprocess rc={r.returncode} returned no "
-                    f"{missing}; {detail}")
-                return
-            for k in canonical_keys:
-                rows[k] = got[k]
+                try:
+                    r = subprocess.run(cmd, capture_output=True,
+                                       text=True, timeout=timeout)
+                except subprocess.TimeoutExpired:
+                    err(f"row timed out after {timeout}s (subprocess "
+                        "killed; chip hang contained)")
+                    return
+                try:
+                    data = json.loads(r.stdout.strip().splitlines()[-1])
+                    got = data.get("rows", {})
+                except Exception:  # noqa: BLE001
+                    err(f"row subprocess rc={r.returncode}, unparseable "
+                        f"output; stderr: {r.stderr[-300:]}")
+                    return
+                missing = [k for k in canonical_keys if k not in got]
+                if missing:
+                    # e.g. the child hit its own chip-unavailable fallback
+                    detail = got.get("error") if isinstance(
+                        got.get("error"), str) else r.stderr[-300:]
+                    err(f"row subprocess rc={r.returncode} returned no "
+                        f"{missing}; {detail}")
+                    return
+                for k in canonical_keys:
+                    rows[k] = got[k]
+            finally:
+                _flush()
 
         if args.profile:
             # the profiled headline row stays in-process so the trace
@@ -568,6 +593,7 @@ def main():
             except Exception as e:  # noqa: BLE001
                 rows["resnet50_bf16"] = {
                     "error": f"{type(e).__name__}: {e}"[:300]}
+            _flush()
         else:
             sub_row("resnet_bf16", ["resnet50_bf16"], row_budget)
         sub_row("resnet_fp32", ["resnet50_fp32"], row_budget)

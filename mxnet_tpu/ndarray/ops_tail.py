@@ -1,0 +1,223 @@
+"""Long-tail operators: special functions, numpy-namespace tail, shape
+utilities, masked softmax, and the LARS single-tensor update.
+
+Reference parity: the remaining small families of src/operator/ (SURVEY.md
+§2.2) — special_functions-inl.h (polygamma, Bessel), mshadow_op.h activation
+tail (log_sigmoid, mish, silu/swish, gelu, hard_swish), the numpy-interface
+ops under src/operator/numpy/ (_npi_* — isnan/isinf family, bincount,
+interp, ediff1d, kron, tensordot, vander, rot90, roll, cumprod, digitize,
+searchsorted, nan_to_num, logaddexp, heaviside, copysign, lcm/gcd/ldexp),
+src/operator/nn/softmax.cc masked_softmax/masked_log_softmax, and
+src/operator/optimizer_op.cc lars_update.  Each body is the direct
+jnp/jax.scipy dual — XLA fuses these into neighbouring MXU work, which is
+the whole TPU-first design for elementwise tails.
+
+MXNet conventions preserved: predicate ops (isnan etc.) return 0/1 in the
+input float dtype, not bool (the registry-wide comparison rule,
+ops_elemwise.py); integer-domain ops (lcm/gcd, bincount, digitize,
+searchsorted) are non-differentiable.
+"""
+from __future__ import annotations
+
+from .register import add_alias, register_op, simple_op
+
+
+def _register_special():
+    import jax
+    import jax.numpy as jnp
+    import jax.scipy.special as jsp
+
+    unary = {
+        "erfc": jsp.erfc,
+        "bessel_i0": jsp.i0,
+        "bessel_i1": jsp.i1,
+        "bessel_i0e": jsp.i0e,
+        "bessel_i1e": jsp.i1e,
+        "log_sigmoid": jax.nn.log_sigmoid,
+        "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+        "silu": jax.nn.silu,
+        "hard_swish": lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+    }
+    for name, fn in unary.items():
+        simple_op(name, fn)
+    add_alias("silu", "swish")
+
+    # erfcinv via the reflection erfcinv(x) = erfinv(1 - x): jax ships no
+    # direct dual, and the reflection is exact in fp32's domain of use
+    simple_op("erfcinv", lambda x: jax.lax.erf_inv(1.0 - x))
+
+    # order parameter n is an op attribute (static), matching the
+    # reference's scalar-parameter calling convention
+    register_op("polygamma", lambda n=0: (lambda x: jsp.polygamma(n, x)))
+
+    # regularized incomplete gamma pair: two-tensor-input special fns
+    simple_op("gammainc", jsp.gammainc)
+    simple_op("gammaincc", jsp.gammaincc)
+    simple_op("zeta", jsp.zeta)
+
+    def gelu_maker(approximation="erf"):
+        approx = approximation == "tanh"
+        return lambda x: jax.nn.gelu(x, approximate=approx)
+    register_op("gelu", gelu_maker)
+
+
+def _register_np_tail():
+    import jax.numpy as jnp
+
+    def _pred(fn):
+        # MXNet predicate convention: 0/1 in the input dtype, not bool
+        def f(x):
+            return fn(x).astype(x.dtype)
+        return f
+
+    for name, fn in {"isnan": jnp.isnan, "isinf": jnp.isinf,
+                     "isfinite": jnp.isfinite, "isposinf": jnp.isposinf,
+                     "isneginf": jnp.isneginf}.items():
+        simple_op(name, _pred(fn), differentiable=False)
+
+    def nan_to_num_maker(nan=0.0, posinf=None, neginf=None, copy=True):
+        del copy                      # functional arrays: always a copy
+        return lambda x: jnp.nan_to_num(x, nan=nan, posinf=posinf,
+                                        neginf=neginf)
+    register_op("nan_to_num", nan_to_num_maker)
+
+    simple_op("logaddexp", jnp.logaddexp)
+    simple_op("heaviside", jnp.heaviside)
+    simple_op("copysign", jnp.copysign)
+    simple_op("ldexp", lambda x, e: jnp.ldexp(x, e.astype(jnp.int32)))
+    for name, fn in {"lcm": jnp.lcm, "gcd": jnp.gcd}.items():
+        simple_op(name, fn, differentiable=False)
+
+    def cumprod_maker(axis=None, dtype=None):
+        return lambda x: jnp.cumprod(x, axis=axis, dtype=dtype)
+    register_op("cumprod", cumprod_maker)
+
+    def logsumexp_maker(axis=None, keepdims=False):
+        from jax.scipy.special import logsumexp
+        ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+        return lambda x: logsumexp(x, axis=ax, keepdims=keepdims)
+    register_op("logsumexp", logsumexp_maker)
+
+    # bincount's output length is data-dependent unless minlength pins it:
+    # run eagerly (use_jit=False) so concrete values size the output — the
+    # same escape hatch as the other value-dependent-shape ops
+    def bincount_maker(minlength=0):
+        def fn(x, *weights):
+            w = weights[0] if weights else None
+            n = max(int(minlength), int(x.max()) + 1 if x.size else 0)
+            return jnp.bincount(x.astype(jnp.int32), weights=w, length=n)
+        return fn
+    register_op("bincount", bincount_maker, use_jit=False,
+                differentiable=False)
+
+    def digitize_maker(right=False):
+        return lambda x, bins: jnp.digitize(x, bins, right=right)
+    register_op("digitize", digitize_maker, differentiable=False)
+
+    def searchsorted_maker(side="left"):
+        return lambda a, v: jnp.searchsorted(a, v, side=side)
+    register_op("searchsorted", searchsorted_maker, differentiable=False)
+
+    simple_op("interp", jnp.interp)
+
+    def ediff1d_maker():
+        return lambda x: jnp.ediff1d(x)
+    register_op("ediff1d", ediff1d_maker)
+
+    def trapz_maker(dx=1.0, axis=-1):
+        trap = getattr(jnp, "trapezoid", None) or jnp.trapz
+        def fn(y, *xp):
+            if xp:
+                return trap(y, x=xp[0], axis=axis)
+            return trap(y, dx=dx, axis=axis)
+        return fn
+    register_op("trapz", trapz_maker)
+
+
+def _register_shape_tail():
+    import jax.numpy as jnp
+
+    def roll_maker(shift=None, axis=None):
+        sh = shift if shift is None or isinstance(shift, int) \
+            else tuple(shift)
+        ax = axis if axis is None or isinstance(axis, int) else tuple(axis)
+        return lambda x: jnp.roll(x, sh, axis=ax)
+    register_op("roll", roll_maker)
+
+    def rot90_maker(k=1, axes=(0, 1)):
+        return lambda x: jnp.rot90(x, k=k, axes=tuple(axes))
+    register_op("rot90", rot90_maker)
+
+    simple_op("kron", jnp.kron)
+
+    def tensordot_maker(axes=2):
+        ax = axes if isinstance(axes, int) else \
+            tuple(tuple(a) for a in axes)
+        return lambda a, b: jnp.tensordot(a, b, axes=ax)
+    register_op("tensordot", tensordot_maker)
+
+    def vander_maker(N=None, increasing=False):
+        return lambda x: jnp.vander(x, N=N, increasing=increasing)
+    register_op("vander", vander_maker)
+
+    def meshgrid_maker(indexing="xy", sparse=False):
+        return lambda *xs: tuple(jnp.meshgrid(*xs, indexing=indexing,
+                                              sparse=sparse))
+    register_op("meshgrid", meshgrid_maker)
+
+
+def _register_masked_softmax():
+    import jax.numpy as jnp
+
+    def _masked(log):
+        def maker(axis=-1, temperature=1.0, normalize=True):
+            del normalize
+            def fn(x, mask):
+                m = mask != 0
+                neg = jnp.finfo(x.dtype).min
+                z = jnp.where(m, x / temperature, neg)
+                z = z - jnp.max(z, axis=axis, keepdims=True)
+                if log:
+                    lse = jnp.log(jnp.sum(jnp.where(m, jnp.exp(z), 0.0),
+                                          axis=axis, keepdims=True))
+                    return jnp.where(m, z - lse, neg)
+                e = jnp.where(m, jnp.exp(z), 0.0)
+                return e / jnp.maximum(
+                    jnp.sum(e, axis=axis, keepdims=True),
+                    jnp.finfo(x.dtype).tiny)
+            return fn
+        return maker
+    register_op("masked_softmax", _masked(log=False))
+    register_op("masked_log_softmax", _masked(log=True))
+
+
+def _register_lars():
+    import jax.numpy as jnp
+
+    # single-tensor LARS step (reference optimizer_op.cc lars_update):
+    # trust ratio ||w||/(||g*rescale|| + wd*||w|| + eps) scales the lr,
+    # then a plain (momentum-free) sgd step — the multi-tensor trust-ratio
+    # path lives in multi_lars (ops_optimizer.py)
+    def lars_update_maker(lr, eta=0.001, wd=0.0, rescale_grad=1.0,
+                          clip_gradient=-1.0, epsilon=1e-9):
+        def fn(weight, grad):
+            g = grad.astype(jnp.float32) * rescale_grad
+            if clip_gradient > 0:
+                g = jnp.clip(g, -clip_gradient, clip_gradient)
+            wn = jnp.sqrt(jnp.sum(weight.astype(jnp.float32) ** 2))
+            gn = jnp.sqrt(jnp.sum(g ** 2))
+            trust = jnp.where(
+                (wn > 0) & (gn > 0),
+                eta * wn / (gn + wd * wn + epsilon), 1.0)
+            step = trust * lr * (g + wd * weight.astype(jnp.float32))
+            return (weight.astype(jnp.float32) - step).astype(weight.dtype)
+        return fn
+    register_op("lars_update", lars_update_maker, differentiable=False)
+
+
+_register_special()
+_register_np_tail()
+_register_shape_tail()
+_register_masked_softmax()
+_register_lars()
+add_alias("_sample_multinomial", "multinomial")

@@ -46,7 +46,10 @@ def _needed_inputs(opname: str, kwargs: Dict[str, Any]) -> List[str]:
     return names
 
 
-def _num_outputs(opname: str, kwargs: Dict[str, Any]) -> int:
+def _num_outputs(opname: str, kwargs: Dict[str, Any],
+                 n_inputs: int = 1) -> int:
+    if opname == "meshgrid":
+        return n_inputs                  # one grid per input coordinate
     if opname in ("BatchNorm", "BatchNorm_v1"):
         return 3
     if opname in ("split", "SliceChannel"):
@@ -133,7 +136,7 @@ def apply_op(opname: str, args: List[Symbol], kwargs: Dict[str, Any],
         head_refs.append(s._heads[0])
 
     node = _Node(canonical, node_name, attrs, head_refs,
-                 _num_outputs(canonical, attrs))
+                 _num_outputs(canonical, attrs, len(head_refs)))
     return Symbol([(node, i) for i in range(node.num_outputs)]) \
         if node.num_outputs > 1 else Symbol([(node, 0)])
 

@@ -67,6 +67,22 @@ UNARY = {
     "fix": (np.fix, _ANY),
     "erfinv": (None, _UNIT),
     "digamma": (None, _POS),
+    # long-tail additions (ops_tail.py)
+    "erfc": (None, _ANY),
+    "erfcinv": (None, ("unit01", lambda rng, s: rng.uniform(0.1, 1.9, s))),
+    "bessel_i0": (None, _UNIT),
+    "bessel_i1": (None, _UNIT),
+    "bessel_i0e": (None, _UNIT),
+    "bessel_i1e": (None, _UNIT),
+    "log_sigmoid": (lambda x: -np.log1p(np.exp(-x)), _ANY),
+    "mish": (lambda x: x * np.tanh(np.log1p(np.exp(x))), _ANY),
+    "silu": (lambda x: x / (1.0 + np.exp(-x)), _ANY),
+    "hard_swish": (lambda x: x * np.clip(x + 3, 0, 6) / 6.0, _ANY),
+    "isnan": (lambda x: np.isnan(x).astype(np.float32), _ANY),
+    "isinf": (lambda x: np.isinf(x).astype(np.float32), _ANY),
+    "isfinite": (lambda x: np.isfinite(x).astype(np.float32), _ANY),
+    "isposinf": (lambda x: np.isposinf(x).astype(np.float32), _ANY),
+    "isneginf": (lambda x: np.isneginf(x).astype(np.float32), _ANY),
 }
 
 BINARY = {
@@ -91,6 +107,12 @@ BINARY = {
         np.logical_or(a != 0, b != 0).astype(np.float32),
     "broadcast_logical_xor": lambda a, b:
         np.logical_xor(a != 0, b != 0).astype(np.float32),
+    # long-tail additions (ops_tail.py)
+    "logaddexp": np.logaddexp,
+    "heaviside": np.heaviside,
+    "copysign": np.copysign,
+    "gammainc": None,                       # scipy reference below
+    "gammaincc": None,
 }
 
 REDUCE = {
@@ -104,13 +126,18 @@ REDUCE = {
 }
 
 # ops whose gradient is zero/undefined a.e. — forward check only
+# (gammainc/gammaincc: jax defines d/dx only, not d/da — forward-only here,
+# like the reference's own backward-not-implemented special functions;
+# heaviside/copysign: zero-a.e. or sign-switching gradients break FD)
 _NON_DIFF = {"sign", "ceil", "floor", "trunc", "rint", "round", "fix",
              "logical_not",
              "broadcast_equal", "broadcast_not_equal", "broadcast_greater",
              "broadcast_greater_equal", "broadcast_lesser",
              "broadcast_lesser_equal", "broadcast_mod",
              "broadcast_logical_and", "broadcast_logical_or",
-             "broadcast_logical_xor"}
+             "broadcast_logical_xor",
+             "isnan", "isinf", "isfinite", "isposinf", "isneginf",
+             "heaviside", "copysign", "gammainc", "gammaincc"}
 
 
 def _np_ref(name, npf):
@@ -119,7 +146,12 @@ def _np_ref(name, npf):
     from scipy import special
     return {"erf": special.erf, "erfinv": special.erfinv,
             "gamma": special.gamma, "gammaln": special.gammaln,
-            "digamma": special.digamma}[name]
+            "digamma": special.digamma, "erfc": special.erfc,
+            "erfcinv": special.erfcinv,
+            "bessel_i0": special.i0, "bessel_i1": special.i1,
+            "bessel_i0e": special.i0e, "bessel_i1e": special.i1e,
+            "gammainc": special.gammainc,
+            "gammaincc": special.gammaincc}[name]
 
 
 @pytest.mark.parametrize("name", sorted(UNARY))
@@ -153,7 +185,7 @@ def test_unary_forward_and_grad(name):
 
 @pytest.mark.parametrize("name", sorted(BINARY))
 def test_binary_forward_and_grad(name):
-    npf = BINARY[name]
+    npf = _np_ref(name, BINARY[name])
     rng = np.random.default_rng(zlib.crc32(name.encode()))
     a = rng.uniform(0.5, 2.0, (3, 5)).astype(np.float32)
     b = rng.uniform(0.5, 2.0, (3, 5)).astype(np.float32)

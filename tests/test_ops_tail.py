@@ -1,0 +1,238 @@
+"""Long-tail ops with parameters or non-elementwise shapes (ops_tail.py).
+
+Reference model: the per-op checks of tests/python/unittest/test_operator.py
+(SURVEY.md §4.2) for the numpy-interface tail, masked softmax, and
+lars_update.  Elementwise members of the family ride the sweep tables in
+test_op_sweep.py; this file covers everything with attrs, data-dependent
+output shapes, multiple outputs, or reference semantics numpy can't state
+in one lambda.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _arr(x):
+    return nd.array(np.asarray(x))
+
+
+def test_polygamma_orders():
+    from scipy import special
+    x = np.array([0.7, 1.3, 2.9], np.float32)
+    for n in (0, 1, 2):
+        got = nd.polygamma(_arr(x), n=n).asnumpy()
+        np.testing.assert_allclose(got, special.polygamma(n, x),
+                                   rtol=2e-4, atol=2e-5)
+
+
+def test_zeta():
+    from scipy import special
+    x = np.array([1.5, 2.0, 3.5], np.float32)
+    q = np.array([1.0, 2.0, 0.5], np.float32)
+    got = nd.zeta(_arr(x), _arr(q)).asnumpy()
+    np.testing.assert_allclose(got, special.zeta(x, q), rtol=2e-4)
+
+
+def test_gelu_exact_and_tanh():
+    from scipy import special
+    x = np.linspace(-3, 3, 13).astype(np.float32)
+    exact = 0.5 * x * (1 + special.erf(x / np.sqrt(2)))
+    np.testing.assert_allclose(nd.gelu(_arr(x)).asnumpy(), exact,
+                               rtol=1e-4, atol=1e-5)
+    tanh_ref = 0.5 * x * (1 + np.tanh(
+        np.sqrt(2 / np.pi) * (x + 0.044715 * x ** 3)))
+    np.testing.assert_allclose(
+        nd.gelu(_arr(x), approximation="tanh").asnumpy(), tanh_ref,
+        rtol=1e-4, atol=1e-5)
+
+
+def test_nan_to_num():
+    x = np.array([np.nan, np.inf, -np.inf, 2.0], np.float32)
+    got = nd.nan_to_num(_arr(x), nan=1.0, posinf=9.0, neginf=-9.0).asnumpy()
+    np.testing.assert_allclose(got, [1.0, 9.0, -9.0, 2.0])
+
+
+def test_ldexp_lcm_gcd():
+    np.testing.assert_allclose(
+        nd.ldexp(_arr(np.float32([1.5, 2.0])),
+                 _arr(np.float32([2, 3]))).asnumpy(), [6.0, 16.0])
+    np.testing.assert_array_equal(
+        nd.lcm(_arr(np.int32([4, 6])), _arr(np.int32([6, 4]))).asnumpy(),
+        [12, 12])
+    np.testing.assert_array_equal(
+        nd.gcd(_arr(np.int32([4, 6])), _arr(np.int32([6, 4]))).asnumpy(),
+        [2, 2])
+
+
+def test_cumprod_and_logsumexp():
+    x = np.float32([[1, 2, 3], [4, 5, 6]])
+    np.testing.assert_allclose(
+        nd.cumprod(_arr(x), axis=1).asnumpy(), np.cumprod(x, 1))
+    np.testing.assert_allclose(
+        nd.cumprod(_arr(x)).asnumpy(), np.cumprod(x))
+    from scipy.special import logsumexp as sls
+    np.testing.assert_allclose(
+        nd.logsumexp(_arr(x), axis=1, keepdims=True).asnumpy(),
+        sls(x, axis=1, keepdims=True), rtol=1e-5)
+
+
+def test_bincount():
+    x = np.int32([0, 1, 1, 3, 5])
+    np.testing.assert_array_equal(nd.bincount(_arr(x)).asnumpy(),
+                                  np.bincount(x))
+    np.testing.assert_array_equal(
+        nd.bincount(_arr(x), minlength=8).asnumpy(),
+        np.bincount(x, minlength=8))
+    w = np.float32([1, 2, 3, 4, 5])
+    np.testing.assert_allclose(
+        nd.bincount(_arr(x), _arr(w)).asnumpy(), np.bincount(x, w))
+
+
+def test_digitize_searchsorted_interp():
+    bins = np.float32([0.0, 1.0, 2.0])
+    x = np.float32([-0.5, 0.5, 1.0, 2.5])
+    np.testing.assert_array_equal(
+        nd.digitize(_arr(x), _arr(bins)).asnumpy(), np.digitize(x, bins))
+    np.testing.assert_array_equal(
+        nd.digitize(_arr(x), _arr(bins), right=True).asnumpy(),
+        np.digitize(x, bins, right=True))
+    a = np.float32([1, 3, 5, 7])
+    v = np.float32([3, 6])
+    np.testing.assert_array_equal(
+        nd.searchsorted(_arr(a), _arr(v)).asnumpy(),
+        np.searchsorted(a, v))
+    np.testing.assert_array_equal(
+        nd.searchsorted(_arr(a), _arr(v), side="right").asnumpy(),
+        np.searchsorted(a, v, side="right"))
+    xp = np.float32([0, 1, 2])
+    fp = np.float32([0, 10, 20])
+    xq = np.float32([0.5, 1.5])
+    np.testing.assert_allclose(
+        nd.interp(_arr(xq), _arr(xp), _arr(fp)).asnumpy(),
+        np.interp(xq, xp, fp))
+
+
+def test_ediff1d_trapz():
+    x = np.float32([1, 4, 9, 16])
+    np.testing.assert_allclose(nd.ediff1d(_arr(x)).asnumpy(),
+                               np.ediff1d(x))
+    y = np.float32([[1, 2, 3], [4, 5, 6]])
+    np.testing.assert_allclose(nd.trapz(_arr(y), dx=0.5).asnumpy(),
+                               np.trapezoid(y, dx=0.5)
+                               if hasattr(np, "trapezoid")
+                               else np.trapz(y, dx=0.5))
+    t = np.float32([0, 1, 3])
+    np.testing.assert_allclose(nd.trapz(_arr(y), _arr(t)).asnumpy(),
+                               np.trapezoid(y, x=t)
+                               if hasattr(np, "trapezoid")
+                               else np.trapz(y, x=t))
+
+
+def test_shape_tail():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_array_equal(
+        nd.roll(_arr(x), shift=2, axis=1).asnumpy(), np.roll(x, 2, 1))
+    np.testing.assert_array_equal(
+        nd.roll(_arr(x), shift=(1, -1), axis=(0, 1)).asnumpy(),
+        np.roll(x, (1, -1), (0, 1)))
+    np.testing.assert_array_equal(
+        nd.rot90(_arr(x), k=3).asnumpy(), np.rot90(x, 3))
+    a = np.float32([[1, 2], [3, 4]])
+    b = np.float32([[0, 1], [1, 0]])
+    np.testing.assert_allclose(nd.kron(_arr(a), _arr(b)).asnumpy(),
+                               np.kron(a, b))
+    t1 = np.arange(24, dtype=np.float32).reshape(2, 3, 4)
+    t2 = np.arange(12, dtype=np.float32).reshape(3, 4)
+    np.testing.assert_allclose(
+        nd.tensordot(_arr(t1), _arr(t2), axes=2).asnumpy(),
+        np.tensordot(t1, t2, 2), rtol=1e-6)
+    np.testing.assert_allclose(
+        nd.tensordot(_arr(t1), _arr(t2),
+                     axes=((1,), (0,))).asnumpy(),
+        np.tensordot(t1, t2, axes=((1,), (0,))), rtol=1e-6)
+    v = np.float32([1, 2, 3])
+    np.testing.assert_allclose(nd.vander(_arr(v), N=4).asnumpy(),
+                               np.vander(v, 4))
+    gx, gy = nd.meshgrid(_arr(v), _arr(np.float32([4, 5])))
+    ex, ey = np.meshgrid(v, np.float32([4, 5]))
+    np.testing.assert_array_equal(gx.asnumpy(), ex)
+    np.testing.assert_array_equal(gy.asnumpy(), ey)
+
+
+def test_masked_softmax_matches_reference():
+    rng = np.random.default_rng(7)
+    x = rng.standard_normal((2, 5)).astype(np.float32)
+    mask = np.array([[1, 1, 0, 1, 0], [1, 0, 0, 0, 1]], np.float32)
+    got = nd.masked_softmax(_arr(x), _arr(mask), axis=-1).asnumpy()
+    # dense reference: softmax over unmasked entries, exact zeros elsewhere
+    ref = np.zeros_like(x)
+    for i in range(2):
+        idx = mask[i] != 0
+        e = np.exp(x[i, idx] - x[i, idx].max())
+        ref[i, idx] = e / e.sum()
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+    assert (got[mask == 0] == 0).all()
+    lg = nd.masked_log_softmax(_arr(x), _arr(mask), axis=-1).asnumpy()
+    np.testing.assert_allclose(np.exp(lg[mask != 0]), ref[mask != 0],
+                               rtol=1e-5)
+    # temperature scales the logits before normalization
+    hot = nd.masked_softmax(_arr(x), _arr(mask), temperature=10.0).asnumpy()
+    row = hot[0][mask[0] != 0]
+    assert row.max() - row.min() < got[0][mask[0] != 0].max()
+
+
+def test_masked_softmax_gradient():
+    x = np.random.default_rng(3).standard_normal((3, 4)).astype(np.float32)
+    mask = np.float32([[1, 1, 1, 0], [1, 0, 1, 1], [1, 1, 1, 1]])
+    xa = _arr(x)
+    xa.attach_grad()
+    with autograd.record():
+        y = nd.masked_softmax(xa, _arr(mask))
+        L = nd.sum(y * y)
+    L.backward()
+    g = xa.grad.asnumpy()
+    assert np.isfinite(g).all()
+    assert (g[mask == 0] == 0).all()        # masked logits get no gradient
+
+
+def test_lars_update_trust_ratio():
+    w = np.float32([3.0, 4.0])              # ||w|| = 5
+    g = np.float32([0.6, 0.8])              # ||g|| = 1
+    out = nd.lars_update(_arr(w), _arr(g), lr=1.0, eta=0.1, wd=0.0).asnumpy()
+    # trust = 0.1*5/1 = 0.5 -> step = 0.5 * g
+    np.testing.assert_allclose(out, w - 0.5 * g, rtol=1e-5)
+    # zero gradient -> trust falls back to 1, step stays zero
+    out0 = nd.lars_update(_arr(w), _arr(np.zeros(2, np.float32)),
+                          lr=1.0, eta=0.1).asnumpy()
+    np.testing.assert_allclose(out0, w)
+
+
+def test_multinomial_alias():
+    mx.random.seed(11)
+    p = _arr(np.float32([[0.0, 1.0, 0.0]]))
+    s = nd.multinomial(p, shape=4).asnumpy()
+    assert (s == 1).all()
+
+
+def test_tail_ops_through_symbol():
+    """attrs round-trip the symbol path: compose, infer, bind, run."""
+    import mxnet_tpu.symbol as sym
+    x = sym.Variable("x")
+    y = sym.roll(sym.mish(x), shift=1, axis=0)
+    ex = y.bind(mx.cpu(), {"x": _arr(np.float32([1.0, 2.0, 3.0]))})
+    out = ex.forward()[0].asnumpy()
+    ref = np.roll(np.float32([1, 2, 3]) *
+                  np.tanh(np.log1p(np.exp(np.float32([1, 2, 3])))), 1)
+    np.testing.assert_allclose(out, ref, rtol=1e-5)
+    # multi-output through the symbol path: one grid per input
+    a, b = sym.Variable("a"), sym.Variable("b")
+    g = sym.meshgrid(a, b)
+    ex = g.bind(mx.cpu(), {"a": _arr(np.float32([1, 2, 3])),
+                           "b": _arr(np.float32([4, 5]))})
+    outs = ex.forward()
+    assert len(outs) == 2
+    ex_np, ey_np = np.meshgrid(np.float32([1, 2, 3]), np.float32([4, 5]))
+    np.testing.assert_array_equal(outs[0].asnumpy(), ex_np)
+    np.testing.assert_array_equal(outs[1].asnumpy(), ey_np)
