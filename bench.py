@@ -134,17 +134,23 @@ def bench_bert_base(iters=10, warmup=3, batch=8, seq=256,
 
     if dtype == "bfloat16":
         amp.init("bfloat16")
-    prev = os.environ.get("MXNET_USE_FLASH_ATTENTION")
-    os.environ["MXNET_USE_FLASH_ATTENTION"] = \
-        "1" if attention == "flash" else "0"
+    # pin the kernel per row (auto-select would otherwise give both rows
+    # the same kernel on TPU and make the comparison vacuous); the legacy
+    # force-on/off var outranks the policy var, so clear it too
+    prev = {k: os.environ.get(k)
+            for k in ("MXNET_ATTENTION_KERNEL", "MXNET_USE_FLASH_ATTENTION")}
+    os.environ["MXNET_ATTENTION_KERNEL"] = \
+        "flash" if attention == "flash" else "xla"
+    os.environ.pop("MXNET_USE_FLASH_ATTENTION", None)
     try:
         return _bench_bert_inner(iters, warmup, batch, seq, attention)
     finally:
         amp.disable()
-        if prev is None:
-            os.environ.pop("MXNET_USE_FLASH_ATTENTION", None)
-        else:
-            os.environ["MXNET_USE_FLASH_ATTENTION"] = prev
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
 
 
 def _bench_bert_inner(iters, warmup, batch, seq, attention):
@@ -199,6 +205,7 @@ def _bench_bert_inner(iters, warmup, batch, seq, attention):
     assert np.isfinite(lval), "non-finite BERT loss in benchmark"
     return {"step_ms": round(dt / iters * 1e3, 2), "batch": batch,
             "seq_len": seq, "attention": attention,
+            "kernel": os.environ.get("MXNET_ATTENTION_KERNEL", "auto"),
             "masked_positions": int(weights.sum()),
             "loss": round(lval, 3),
             "sequences_per_sec": round(batch * iters / dt, 1)}

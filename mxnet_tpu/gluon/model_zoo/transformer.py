@@ -138,29 +138,47 @@ class MultiHeadAttention(HybridBlock):
         return self.proj(self._merge_heads(F, out, b, sq))
 
     def _flash_eligible(self, F, mask, valid_len) -> bool:
-        # env-gated (MXNET_USE_FLASH_ATTENTION=1), imperative mode only.
-        # Masks: none always works; explicit ``valid_len`` lengths ride
-        # the kernel's per-row masking.  An arbitrary (B*H,Sq,Sk) mask
-        # WITHOUT lengths falls back to the XLA path — a 2-D mask cannot
-        # be proven to be a prefix mask under trace, and collapsing a
-        # non-prefix mask to a length silently corrupts attention (caught
-        # in round-4 review).  The kernel is differentiable (custom VJP
-        # over the chunked formulation), so training may ride it too —
-        # EXCEPT when this block has attention dropout and dropout is
-        # live (train_mode/record), since the flash path has no probs
-        # tensor to drop.
+        # Kernel selection policy (auto by default on TPU):
+        #   MXNET_ATTENTION_KERNEL=flash  force the Pallas kernel
+        #   MXNET_ATTENTION_KERNEL=xla    force the full-softmax XLA path
+        #   unset/auto                    flash on the TPU backend when the
+        #                                 mask is expressible, XLA otherwise
+        # (MXNET_USE_FLASH_ATTENTION=1 is honored as a legacy force-on.)
+        # Eligibility regardless of policy: none-mask always works;
+        # explicit ``valid_len`` lengths ride the kernel's per-row
+        # masking.  An arbitrary (B*H,Sq,Sk) mask WITHOUT lengths falls
+        # back to the XLA path — a 2-D mask cannot be proven to be a
+        # prefix mask under trace, and collapsing a non-prefix mask to a
+        # length silently corrupts attention (caught in round-4 review).
+        # The kernel is differentiable (custom VJP over the chunked
+        # formulation), so training may ride it too — EXCEPT when this
+        # block has attention dropout and dropout is live (train_mode/
+        # record), since the flash path has no probs tensor to drop.
         import os
-        if os.environ.get("MXNET_USE_FLASH_ATTENTION", "0") != "1":
+        mode = os.environ.get("MXNET_ATTENTION_KERNEL", "auto").lower()
+        legacy = os.environ.get("MXNET_USE_FLASH_ATTENTION")
+        if legacy == "1":
+            mode = "flash"              # legacy force-on
+        elif legacy == "0":
+            mode = "xla"                # legacy explicit force-off
+        if mode in ("xla", "off", "0"):
             return False
         if mask is not None and valid_len is None:
             return False
-        if not hasattr(F, "flash_attention") or \
-                not hasattr(F, "NDArray"):
+        if not hasattr(F, "flash_attention"):
             return False
-        if self.drop is None:
+        if self.drop is not None:
+            from ... import autograd
+            if autograd.is_recording() or autograd.is_training():
+                return False
+        if mode == "flash":
             return True
-        from ... import autograd
-        return not (autograd.is_recording() or autograd.is_training())
+        # auto: default to flash only where Mosaic actually compiles — on
+        # the TPU backend (eager or under whole-graph jit).  Off-TPU the
+        # kernel would run in interpret mode, orders of magnitude slower
+        # than XLA's fused softmax.
+        import jax
+        return jax.default_backend() == "tpu"
 
 
 class PositionwiseFFN(HybridBlock):

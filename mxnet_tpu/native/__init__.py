@@ -277,5 +277,31 @@ def load_ndarray():
         lib.MXKVStoreGetGroupSize.argtypes = [vp, pint]
         lib.MXKVStoreBarrier.restype = ctypes.c_int
         lib.MXKVStoreBarrier.argtypes = [vp]
+        # training slice: autograd + CachedOp (same .so — handles shared)
+        lib.MXAutogradSetIsRecording.restype = ctypes.c_int
+        lib.MXAutogradSetIsRecording.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.MXAutogradSetIsTraining.restype = ctypes.c_int
+        lib.MXAutogradSetIsTraining.argtypes = [
+            ctypes.c_int, ctypes.POINTER(ctypes.c_int)]
+        lib.MXAutogradMarkVariables.restype = ctypes.c_int
+        lib.MXAutogradMarkVariables.argtypes = [
+            u32, ctypes.POINTER(vp), ctypes.POINTER(u32),
+            ctypes.POINTER(vp)]
+        lib.MXAutogradBackward.restype = ctypes.c_int
+        lib.MXAutogradBackward.argtypes = [
+            u32, ctypes.POINTER(vp), ctypes.POINTER(vp), ctypes.c_int]
+        lib.MXCreateCachedOp.restype = ctypes.c_int
+        lib.MXCreateCachedOp.argtypes = [vp, ctypes.POINTER(vp)]
+        lib.MXCreateCachedOpFromJSON.restype = ctypes.c_int
+        lib.MXCreateCachedOpFromJSON.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(vp)]
+        lib.MXFreeCachedOp.restype = ctypes.c_int
+        lib.MXFreeCachedOp.argtypes = [vp]
+        lib.MXInvokeCachedOp.restype = ctypes.c_int
+        lib.MXInvokeCachedOp.argtypes = [
+            vp, ctypes.c_int, ctypes.POINTER(vp),
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.POINTER(vp))]
         _NDC["lib"] = lib
         return lib

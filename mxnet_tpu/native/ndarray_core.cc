@@ -72,6 +72,56 @@ _DT = {0: "float32", 1: "float64", 2: "float16", 3: "uint8", 4: "int32",
        5: "int8", 6: "int64"}
 _DT_REV = {v: k for k, v in _DT.items()}
 
+# reference OpReqType codes (include/mxnet/op_attr_types.h): null /
+# write / write-inplace (same buffer semantics here) / add
+_GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}
+
+
+class _CCachedOp:
+    """The C-ABI CachedOp (reference: src/imperative/cached_op.cc).
+
+    Holds a composed Symbol; ``invoke`` walks the graph in topo order and
+    dispatches every node through the registry's imperative invoke — the
+    same cached-jit path MXImperativeInvoke rides — so autograd recording,
+    RNG key threading, and the per-(op, shape) XLA compile cache all come
+    for free, and MXAutogradBackward sees an ordinary tape.  (The
+    whole-graph-jit CachedOp lives in gluon/block.py behind hybridize();
+    this slice favors tape interop, the property the C training loop
+    needs.)  Inputs bind to ``list_inputs()`` order — the reference
+    contract for MXInvokeCachedOp's argument array."""
+
+    def __init__(self, sym):
+        if not hasattr(sym, "_heads"):
+            raise TypeError("CachedOp requires a composed Symbol")
+        self.sym = sym
+        self.input_names = sym.list_inputs()
+
+    def invoke(self, arrays):
+        from mxnet_tpu import autograd as _ag
+        from mxnet_tpu.ndarray.register import invoke_by_name
+        from mxnet_tpu.symbol.symbol import _op_kwargs, _scalar_extra
+        if len(arrays) != len(self.input_names):
+            raise ValueError(
+                f"CachedOp expects {len(self.input_names)} inputs "
+                f"({self.input_names}), got {len(arrays)}")
+        feed = dict(zip(self.input_names, arrays))
+        vals = {}
+        for node in self.sym._topo():
+            if node.is_var:
+                vals[(id(node), 0)] = feed[node.name]
+                continue
+            kwargs = _op_kwargs(node.attrs)
+            if node.op in ("BatchNorm", "BatchNorm_v1", "Custom",
+                           "_foreach", "_while_loop", "_cond", "Dropout"):
+                kwargs.setdefault("_training", _ag.is_training())
+            ins = [vals[(id(p), i)] for p, i in node.inputs]
+            ins += _scalar_extra(node.op, kwargs)
+            out = invoke_by_name(node.op, ins, kwargs)
+            outs = out if isinstance(out, list) else [out]
+            for i, o in enumerate(outs):
+                vals[(id(node), i)] = o
+        return [vals[(id(n), i)] for n, i in self.sym._heads]
+
 
 class _NDCore:
     @staticmethod
@@ -161,6 +211,80 @@ class _NDCore:
     @staticmethod
     def kv_barrier(kv):
         kv.barrier()
+
+    # ---- autograd (reference c_api_ndarray.cc MXAutograd* entry points):
+    # with MXImperativeInvoke/MXInvokeCachedOp these complete the C
+    # training loop ------------------------------------------------------
+    @staticmethod
+    def ag_set_recording(flag):
+        from mxnet_tpu import autograd as _ag
+        st = _ag._st()
+        prev, st.recording = st.recording, bool(flag)
+        return int(prev)
+
+    @staticmethod
+    def ag_set_training(flag):
+        from mxnet_tpu import autograd as _ag
+        st = _ag._st()
+        prev, st.training = st.training, bool(flag)
+        return int(prev)
+
+    # variables marked through the C ABI: their AGInfo's write-freshness
+    # must be re-armed per MXAutogradBackward call (below).  Weak refs
+    # keyed by array identity: re-marking replaces (never accumulates),
+    # and freed arrays prune themselves — a long-lived C host's per-step
+    # cost stays proportional to the LIVE marked set.
+    _c_marked = {}
+
+    @classmethod
+    def ag_mark_variables(cls, arrs, reqs, grads):
+        # the caller's grad handles ARE the accumulation buffers:
+        # backward writes them in place (autograd._accum_var), so the C
+        # host reads gradients back through its own MXNDArray* handles
+        import weakref
+        from mxnet_tpu import autograd as _ag
+        arrs = list(arrs)
+        _ag.mark_variables(arrs, list(grads),
+                           [_GRAD_REQ[int(r)] for r in reqs])
+        for a in arrs:
+            cls._c_marked[id(a)] = weakref.ref(a)
+
+    @classmethod
+    def ag_backward(cls, heads, ograds, retain_graph):
+        from mxnet_tpu import autograd as _ag
+        # reference OpReqType contract: kWriteTo OVERWRITES on every
+        # backward.  Internally 'write' uses a one-shot freshness flag
+        # (the gluon Trainer re-arms it after consuming the grad); a C
+        # host has no trainer, so re-arm here to keep the ABI's write
+        # semantics identical to the reference's per-backward overwrite.
+        dead = []
+        for k, ref in cls._c_marked.items():
+            a = ref()
+            if a is None:
+                dead.append(k)
+                continue
+            info = getattr(a, "_ag", None)
+            if info is not None and info.grad_req == "write":
+                info.fresh = True
+        for k in dead:
+            del cls._c_marked[k]
+        _ag.backward(list(heads),
+                     list(ograds) if ograds else None,
+                     retain_graph=bool(retain_graph))
+
+    # ---- CachedOp ------------------------------------------------------
+    @staticmethod
+    def cachedop_create(sym_obj):
+        return _CCachedOp(sym_obj)
+
+    @staticmethod
+    def cachedop_create_json(js):
+        from mxnet_tpu.symbol.symbol import load_json
+        return _CCachedOp(load_json(js))
+
+    @staticmethod
+    def cachedop_invoke(cop, arrays):
+        return cop.invoke(list(arrays))
 )PY";
 
 PyObject* g_ndcore_cls = nullptr;
@@ -698,6 +822,251 @@ int MXKVStoreBarrier(void* handle) {
   } else {
     nd_set_err_from_python();
   }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// MXAutograd* + MXCreateCachedOp/MXInvokeCachedOp: the TRAINING slice of the
+// C ABI (reference: src/c_api/c_api_ndarray.cc autograd entry points +
+// src/imperative/cached_op.cc).  With the MXNDArray*/MXImperativeInvoke
+// surface above, a pure-C host can run a full training step: create arrays,
+// mark variables with gradient buffers, record a forward (imperative ops or
+// a CachedOp over a symbol), call backward, and apply sgd_update — the loop
+// the reference's Scala/Horovod integrations drive through libmxnet.so.
+//
+// Symbol interop: MXCreateCachedOp accepts a SymbolHandle from the
+// symbol-slice library.  Both libraries embed the SAME CPython interpreter
+// (one process), and every handle type in this ABI family starts with its
+// PyObject* — the shared-layout contract that lets the slices exchange
+// handles the way the reference's single libmxnet.so shares nnvm pointers
+// across c_api files.  MXCreateCachedOpFromJSON needs only THIS library.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct CachedOpHandle {
+  PyObject* obj = nullptr;     // bootstrap _CCachedOp
+};
+
+// any ABI handle whose first member is its PyObject* (NDHandle, SymHandle)
+struct AnyPyHandle {
+  PyObject* obj;
+};
+
+int ag_set_flag(const char* method, int value, int* prev) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  if (nd_ensure_bootstrap()) {
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, method, "i", value);
+    if (r) {
+      if (prev) *prev = static_cast<int>(PyLong_AsLong(r));
+      Py_DECREF(r);
+      rc = 0;
+    } else {
+      nd_set_err_from_python();
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+PyObject* handle_list(void** handles, uint32_t n) {
+  PyObject* lst = PyList_New(n);
+  for (uint32_t i = 0; i < n; ++i) {
+    auto* h = static_cast<AnyPyHandle*>(handles[i]);
+    if (!h || !h->obj) {
+      Py_DECREF(lst);
+      return nullptr;
+    }
+    Py_INCREF(h->obj);
+    PyList_SET_ITEM(lst, i, h->obj);
+  }
+  return lst;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXAutogradSetIsRecording(int is_recording, int* prev) {
+  return ag_set_flag("ag_set_recording", is_recording, prev);
+}
+
+int MXAutogradSetIsTraining(int is_training, int* prev) {
+  return ag_set_flag("ag_set_training", is_training, prev);
+}
+
+int MXAutogradMarkVariables(uint32_t num_var, void** var_handles,
+                            uint32_t* reqs_array, void** grad_handles) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* vars = handle_list(var_handles, num_var);
+    PyObject* grads = handle_list(grad_handles, num_var);
+    if (!vars || !grads) {
+      Py_XDECREF(vars);
+      Py_XDECREF(grads);
+      nd_set_err("null NDArray handle in MXAutogradMarkVariables");
+      break;
+    }
+    PyObject* reqs = PyList_New(num_var);
+    for (uint32_t i = 0; i < num_var; ++i)
+      PyList_SET_ITEM(reqs, i, PyLong_FromUnsignedLong(reqs_array[i]));
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "ag_mark_variables",
+                                      "OOO", vars, reqs, grads);
+    Py_DECREF(vars);
+    Py_DECREF(reqs);
+    Py_DECREF(grads);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    Py_DECREF(r);
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+// NOTE: deliberately no MXAutogradBackwardEx export — the reference's Ex
+// variant has a 10-parameter signature (num_variables/create_graph/
+// is_train/grad_stypes...); exporting the name with THIS 4-arg layout
+// would silently misparse a header-conformant caller's arguments.
+int MXAutogradBackward(uint32_t num_output, void** output_handles,
+                       void** ograd_handles, int retain_graph) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* heads = handle_list(output_handles, num_output);
+    if (!heads) {
+      nd_set_err("null NDArray handle in MXAutogradBackward");
+      break;
+    }
+    PyObject* ograds;
+    if (ograd_handles) {
+      ograds = handle_list(ograd_handles, num_output);
+      if (!ograds) {
+        Py_DECREF(heads);
+        nd_set_err("null ograd handle in MXAutogradBackward");
+        break;
+      }
+    } else {
+      ograds = Py_None;
+      Py_INCREF(ograds);
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "ag_backward", "OOi",
+                                      heads, ograds, retain_graph);
+    Py_DECREF(heads);
+    Py_DECREF(ograds);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    Py_DECREF(r);
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXCreateCachedOp(void* sym_handle, void** out) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    auto* sh = static_cast<AnyPyHandle*>(sym_handle);
+    if (!sh || !sh->obj) {
+      nd_set_err("null symbol handle");
+      break;
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "cachedop_create", "O",
+                                      sh->obj);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    auto* h = new CachedOpHandle();
+    h->obj = r;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXCreateCachedOpFromJSON(const char* json, void** out) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "cachedop_create_json",
+                                      "s", json);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    auto* h = new CachedOpHandle();
+    h->obj = r;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXFreeCachedOp(void* handle) {
+  auto* h = static_cast<CachedOpHandle*>(handle);
+  if (!h) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
+}
+
+int MXInvokeCachedOp(void* handle, int num_inputs, void** inputs,
+                     int* num_outputs, void*** outputs) {
+  auto* h = static_cast<CachedOpHandle*>(handle);
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* ins = handle_list(inputs, static_cast<uint32_t>(num_inputs));
+    if (!ins) {
+      nd_set_err("null NDArray handle in MXInvokeCachedOp");
+      break;
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "cachedop_invoke",
+                                      "OO", h->obj, ins);
+    Py_DECREF(ins);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    Py_ssize_t n = PyList_Size(r);
+    g_ret_handles.clear();
+    for (Py_ssize_t i = 0; i < n; ++i) {
+      auto* nh = new NDHandle();
+      nh->obj = PyList_GET_ITEM(r, i);
+      Py_INCREF(nh->obj);
+      g_ret_handles.push_back(nh);
+    }
+    Py_DECREF(r);
+    *num_outputs = static_cast<int>(n);
+    *outputs = g_ret_handles.data();
+    rc = 0;
+  } while (false);
   PyGILState_Release(gil);
   return rc;
 }

@@ -84,7 +84,9 @@ def _register_np_tail():
     simple_op("logaddexp", jnp.logaddexp)
     simple_op("heaviside", jnp.heaviside)
     simple_op("copysign", jnp.copysign)
-    simple_op("ldexp", lambda x, e: jnp.ldexp(x, e.astype(jnp.int32)))
+    # reference mshadow_op ldexp is x * 2^e with a FLOAT exponent (and a
+    # well-defined gradient through e); jnp.ldexp would truncate to int
+    simple_op("ldexp", lambda x, e: x * 2.0 ** e)
     for name, fn in {"lcm": jnp.lcm, "gcd": jnp.gcd}.items():
         simple_op(name, fn, differentiable=False)
 

@@ -470,3 +470,39 @@ def test_flash_env_non_prefix_mask_falls_back_exact(monkeypatch):
     monkeypatch.setenv("MXNET_USE_FLASH_ATTENTION", "1")
     flashed = enc(x, holes).asnumpy()
     np.testing.assert_allclose(flashed, base, atol=1e-6)
+
+
+def test_attention_kernel_policy(monkeypatch):
+    """MXNET_ATTENTION_KERNEL policy: 'flash'/'xla' force the path;
+    'auto' (the default) picks flash only on the TPU backend, so on this
+    CPU-backed suite auto must resolve to the XLA softmax path.  The
+    legacy MXNET_USE_FLASH_ATTENTION var keeps force-on ('1') and
+    force-off ('0') meanings."""
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu.gluon.model_zoo.transformer import MultiHeadAttention
+
+    att = MultiHeadAttention(units=16, num_heads=2)
+    att.initialize()
+    F = mx.nd
+
+    monkeypatch.delenv("MXNET_ATTENTION_KERNEL", raising=False)
+    monkeypatch.delenv("MXNET_USE_FLASH_ATTENTION", raising=False)
+    on_tpu = jax.default_backend() == "tpu"
+    assert att._flash_eligible(F, None, None) == on_tpu
+
+    monkeypatch.setenv("MXNET_ATTENTION_KERNEL", "flash")
+    assert att._flash_eligible(F, None, None)
+    # an arbitrary 2-D mask without lengths can never ride the kernel
+    assert not att._flash_eligible(F, object(), None)
+
+    monkeypatch.setenv("MXNET_ATTENTION_KERNEL", "xla")
+    assert not att._flash_eligible(F, None, None)
+
+    # legacy spellings override the new policy var
+    monkeypatch.setenv("MXNET_ATTENTION_KERNEL", "xla")
+    monkeypatch.setenv("MXNET_USE_FLASH_ATTENTION", "1")
+    assert att._flash_eligible(F, None, None)
+    monkeypatch.setenv("MXNET_ATTENTION_KERNEL", "flash")
+    monkeypatch.setenv("MXNET_USE_FLASH_ATTENTION", "0")
+    assert not att._flash_eligible(F, None, None)

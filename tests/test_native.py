@@ -715,3 +715,303 @@ def test_kvstore_abi_init_push_pull():
     for h in (init, a, b, out):
         lib.MXNDArrayFree(h)
     lib.MXKVStoreFree(kv)
+
+
+def _train_symbol_json():
+    """Least-squares regression graph for the C training slice: inputs in
+    list_inputs() order (the MXInvokeCachedOp binding contract) must be
+    [x, w, y]."""
+    import mxnet_tpu.symbol as sym
+    x = sym.Variable("x")
+    w = sym.Variable("w")
+    y = sym.Variable("y")
+    fc = sym.FullyConnected(x, w, num_hidden=1, no_bias=True)
+    loss = sym.mean(sym.square(fc - y))
+    assert loss.list_inputs() == ["x", "w", "y"]
+    return loss.tojson()
+
+
+def test_autograd_cachedop_abi_in_process():
+    """The C training loop through ctypes: MXCreateCachedOpFromJSON +
+    MXAutogradMarkVariables/SetIsRecording/Backward + in-place sgd_update
+    via MXImperativeInvoke — loss must decrease and the gradient must land
+    in the caller's grad buffer."""
+    lib = native.load_ndarray()
+    u32, vp = ctypes.c_uint32, ctypes.c_void_p
+
+    def make(shape_t, values):
+        sh = (u32 * len(shape_t))(*shape_t)
+        h = vp()
+        assert lib.MXNDArrayCreate(sh, len(shape_t), 1, 0, 0,
+                                   ctypes.byref(h)) == 0, \
+            lib.MXNDGetLastError()
+        arr = np.ascontiguousarray(values, np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(vp), arr.size) == 0
+        return h
+
+    def read(h, shape_t):
+        buf = np.empty(shape_t, np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            h, buf.ctypes.data_as(vp), buf.size) == 0
+        return buf
+
+    cop = vp()
+    assert lib.MXCreateCachedOpFromJSON(
+        _train_symbol_json().encode(), ctypes.byref(cop)) == 0, \
+        lib.MXNDGetLastError()
+
+    rng = np.random.default_rng(5)
+    x_np = rng.standard_normal((8, 3)).astype(np.float32)
+    w_true = np.array([[1.5, -2.0, 0.5]], np.float32)
+    y_np = x_np @ w_true.T
+    hx = make((8, 3), x_np)
+    hw = make((1, 3), np.zeros((1, 3), np.float32))
+    hy = make((8, 1), y_np)
+    hg = make((1, 3), np.zeros((1, 3), np.float32))
+    hlr = make((1,), np.array([0.4], np.float32))
+
+    mark_vars = (vp * 1)(hw)
+    reqs = (u32 * 1)(1)                       # write
+    grads = (vp * 1)(hg)
+    assert lib.MXAutogradMarkVariables(1, mark_vars, reqs, grads) == 0, \
+        lib.MXNDGetLastError()
+
+    prev = ctypes.c_int(-1)
+    assert lib.MXAutogradSetIsRecording(1, ctypes.byref(prev)) == 0
+    assert prev.value == 0
+
+    op = vp()
+    assert lib.NNGetOpHandle(b"sgd_update", ctypes.byref(op)) == 0
+
+    losses = []
+    for step in range(12):
+        ins = (vp * 3)(hx, hw, hy)
+        n_out = ctypes.c_int(0)
+        outs = ctypes.POINTER(vp)()
+        assert lib.MXInvokeCachedOp(cop, 3, ins, ctypes.byref(n_out),
+                                    ctypes.byref(outs)) == 0, \
+            lib.MXNDGetLastError()
+        assert n_out.value == 1
+        h_loss = outs[0]
+        heads = (vp * 1)(h_loss)
+        assert lib.MXAutogradBackward(1, heads, None, 0) == 0, \
+            lib.MXNDGetLastError()
+        losses.append(float(read(h_loss, ())))
+        if step == 0:
+            # analytic dL/dW for the first step (W=0): -2/N * (y^T x)
+            expect = -2.0 / 8.0 * (y_np.T @ x_np)
+            np.testing.assert_allclose(read(hg, (1, 3)), expect,
+                                       rtol=1e-4, atol=1e-5)
+        lib.MXNDArrayFree(h_loss)
+        # in-place sgd_update(w, grad, lr, out=w)
+        uins = (vp * 3)(hw, hg, hlr)
+        uouts_arr = (vp * 1)(hw)
+        uouts = ctypes.cast(uouts_arr, ctypes.POINTER(vp))
+        un = ctypes.c_int(1)
+        assert lib.MXImperativeInvoke(op, 3, uins, ctypes.byref(un),
+                                      ctypes.byref(uouts), 0, None,
+                                      None) == 0, lib.MXNDGetLastError()
+    assert lib.MXAutogradSetIsRecording(0, ctypes.byref(prev)) == 0
+    assert prev.value == 1
+    assert losses[-1] < 0.05 * losses[0], losses
+    # the trained weight approached the generator
+    np.testing.assert_allclose(read(hw, (1, 3)), w_true, atol=0.2)
+    lib.MXFreeCachedOp(cop)
+    for h in (hx, hw, hy, hg, hlr):
+        lib.MXNDArrayFree(h)
+
+
+def test_cachedop_abi_accepts_symbol_handle():
+    """MXCreateCachedOp consumes a SymbolHandle minted by the SYMBOL-slice
+    library — the shared PyObject*-first handle-layout contract between
+    the ABI .so files (one embedded interpreter per process)."""
+    libs = native.load_symbol()
+    libn = native.load_ndarray()
+    vp = ctypes.c_void_p
+    sh = vp()
+    assert libs.MXSymbolCreateFromJSON(
+        _train_symbol_json().encode(), ctypes.byref(sh)) == 0, \
+        libs.MXSymGetLastError()
+    cop = vp()
+    assert libn.MXCreateCachedOp(sh, ctypes.byref(cop)) == 0, \
+        libn.MXNDGetLastError()
+    # drive one forward to prove the graph is live
+    u32 = ctypes.c_uint32
+
+    def make(shape_t, values):
+        shp = (u32 * len(shape_t))(*shape_t)
+        h = vp()
+        assert libn.MXNDArrayCreate(shp, len(shape_t), 1, 0, 0,
+                                    ctypes.byref(h)) == 0
+        arr = np.ascontiguousarray(values, np.float32)
+        assert libn.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(vp), arr.size) == 0
+        return h
+
+    hx = make((2, 3), np.ones((2, 3), np.float32))
+    hw = make((1, 3), np.full((1, 3), 2.0, np.float32))
+    hy = make((2, 1), np.zeros((2, 1), np.float32))
+    ins = (vp * 3)(hx, hw, hy)
+    n_out = ctypes.c_int(0)
+    outs = ctypes.POINTER(vp)()
+    assert libn.MXInvokeCachedOp(cop, 3, ins, ctypes.byref(n_out),
+                                 ctypes.byref(outs)) == 0, \
+        libn.MXNDGetLastError()
+    buf = np.empty((), np.float32)
+    assert libn.MXNDArraySyncCopyToCPU(
+        outs[0], buf.ctypes.data_as(vp), 1) == 0
+    # mean(square(1·[2,2,2] - 0)) = 36
+    assert abs(float(buf) - 36.0) < 1e-4
+    libn.MXFreeCachedOp(cop)
+    libs.MXSymbolFree(sh)
+
+
+TRAIN_C_HOST = r"""
+/* Pure-C training loop: no Python linkage.  argv[1] = libmxtpu_ndarray.so,
+   argv[2] = symbol JSON file (least-squares graph, inputs x/w/y).
+   create arrays -> CachedOp forward -> autograd backward -> in-place
+   sgd_update -> assert the loss decreased. */
+#include <dlfcn.h>
+#include <stdint.h>
+#include <stdio.h>
+#include <stdlib.h>
+#include <string.h>
+typedef int (*create_fn)(const uint32_t*, uint32_t, int, int, int, void**);
+typedef int (*copyfrom_fn)(void*, const void*, size_t);
+typedef int (*copyto_fn)(void*, void*, size_t);
+typedef int (*ophandle_fn)(const char*, void**);
+typedef int (*invoke_fn)(void*, int, void**, int*, void***, int,
+                         const char**, const char**);
+typedef int (*free_fn)(void*);
+typedef const char* (*err_fn)(void);
+typedef int (*setflag_fn)(int, int*);
+typedef int (*mark_fn)(uint32_t, void**, uint32_t*, void**);
+typedef int (*backward_fn)(uint32_t, void**, void**, int);
+typedef int (*cop_json_fn)(const char*, void**);
+typedef int (*cop_invoke_fn)(void*, int, void**, int*, void***);
+int main(int argc, char** argv) {
+  if (argc < 3) return 2;
+  void* so = dlopen(argv[1], RTLD_NOW | RTLD_GLOBAL);
+  if (!so) { fprintf(stderr, "%s\n", dlerror()); return 2; }
+  create_fn nd_create = (create_fn)dlsym(so, "MXNDArrayCreate");
+  copyfrom_fn nd_from = (copyfrom_fn)dlsym(so, "MXNDArraySyncCopyFromCPU");
+  copyto_fn nd_to = (copyto_fn)dlsym(so, "MXNDArraySyncCopyToCPU");
+  ophandle_fn op_get = (ophandle_fn)dlsym(so, "NNGetOpHandle");
+  invoke_fn invoke = (invoke_fn)dlsym(so, "MXImperativeInvoke");
+  free_fn nd_free = (free_fn)dlsym(so, "MXNDArrayFree");
+  err_fn lasterr = (err_fn)dlsym(so, "MXNDGetLastError");
+  setflag_fn set_rec = (setflag_fn)dlsym(so, "MXAutogradSetIsRecording");
+  mark_fn mark = (mark_fn)dlsym(so, "MXAutogradMarkVariables");
+  backward_fn backward = (backward_fn)dlsym(so, "MXAutogradBackward");
+  cop_json_fn cop_create = (cop_json_fn)dlsym(so, "MXCreateCachedOpFromJSON");
+  cop_invoke_fn cop_invoke = (cop_invoke_fn)dlsym(so, "MXInvokeCachedOp");
+  free_fn cop_free = (free_fn)dlsym(so, "MXFreeCachedOp");
+  if (!set_rec || !mark || !backward || !cop_create || !cop_invoke) {
+    fprintf(stderr, "training symbols missing\n"); return 2; }
+
+  /* read the symbol JSON */
+  FILE* f = fopen(argv[2], "rb");
+  if (!f) return 2;
+  fseek(f, 0, SEEK_END); long sz = ftell(f); fseek(f, 0, SEEK_SET);
+  char* json = (char*)malloc(sz + 1);
+  if (fread(json, 1, sz, f) != (size_t)sz) return 2;
+  json[sz] = 0; fclose(f);
+
+  void* cop = NULL;
+  if (cop_create(json, &cop)) {
+    fprintf(stderr, "cachedop: %s\n", lasterr()); return 1; }
+
+  /* y = x * 3 - 1ish data; fit w (1x2) from zero */
+  uint32_t sx[2] = {4, 2}, sw[2] = {1, 2}, sy[2] = {4, 1}, sl[1] = {1};
+  void *hx = NULL, *hw = NULL, *hy = NULL, *hg = NULL, *hlr = NULL;
+  if (nd_create(sx, 2, 1, 0, 0, &hx) || nd_create(sw, 2, 1, 0, 0, &hw) ||
+      nd_create(sy, 2, 1, 0, 0, &hy) || nd_create(sw, 2, 1, 0, 0, &hg) ||
+      nd_create(sl, 1, 1, 0, 0, &hlr)) {
+    fprintf(stderr, "create: %s\n", lasterr()); return 1; }
+  float x[8] = {1, 0, 0, 1, 1, 1, -1, 2};
+  float w0[2] = {0, 0};
+  float y[4] = {3, -1, 2, -5};  /* generated by w* = [3, -1] */
+  float lr[1] = {0.2f};
+  if (nd_from(hx, x, 8) || nd_from(hw, w0, 2) || nd_from(hy, y, 4) ||
+      nd_from(hg, w0, 2) || nd_from(hlr, lr, 1)) return 1;
+
+  void* vars[1]; vars[0] = hw;
+  uint32_t reqs[1] = {1};             /* kWriteTo */
+  void* grads[1]; grads[0] = hg;
+  if (mark(1, vars, reqs, grads)) {
+    fprintf(stderr, "mark: %s\n", lasterr()); return 1; }
+  int prev = -1;
+  if (set_rec(1, &prev)) return 1;
+
+  void* sgd = NULL;
+  if (op_get("sgd_update", &sgd)) return 1;
+
+  float first = -1, last = -1;
+  for (int step = 0; step < 60; ++step) {
+    void* ins[3]; ins[0] = hx; ins[1] = hw; ins[2] = hy;
+    int n_out = 0; void** outs = NULL;
+    if (cop_invoke(cop, 3, ins, &n_out, &outs) || n_out != 1) {
+      fprintf(stderr, "forward: %s\n", lasterr()); return 1; }
+    void* hloss = outs[0];
+    void* heads[1]; heads[0] = hloss;
+    if (backward(1, heads, NULL, 0)) {
+      fprintf(stderr, "backward: %s\n", lasterr()); return 1; }
+    float lv = 0;
+    if (nd_to(hloss, &lv, 1)) return 1;
+    if (step == 0) first = lv;
+    last = lv;
+    nd_free(hloss);
+    /* in-place sgd_update(w, grad, lr) -> w */
+    void* uins[3]; uins[0] = hw; uins[1] = hg; uins[2] = hlr;
+    void* uouts_store[1]; uouts_store[0] = hw;
+    void** uouts = uouts_store;
+    int un = 1;
+    if (invoke(sgd, 3, uins, &un, &uouts, 0, NULL, NULL)) {
+      fprintf(stderr, "sgd: %s\n", lasterr()); return 1; }
+  }
+  if (set_rec(0, &prev) || prev != 1) return 1;
+  if (!(last < 0.05f * first)) {
+    fprintf(stderr, "loss did not decrease: %f -> %f\n", first, last);
+    return 1;
+  }
+  float wfit[2];
+  if (nd_to(hw, wfit, 2)) return 1;
+  if (!(wfit[0] > 2.0f && wfit[0] < 4.0f && wfit[1] > -2.0f
+        && wfit[1] < 0.0f)) {
+    fprintf(stderr, "weights off: %f %f\n", wfit[0], wfit[1]);
+    return 1;
+  }
+  cop_free(cop);
+  nd_free(hx); nd_free(hw); nd_free(hy); nd_free(hg); nd_free(hlr);
+  printf("TRAIN-C-HOST-OK loss %f -> %f w=[%f,%f]\n",
+         first, last, wfit[0], wfit[1]);
+  return 0;
+}
+"""
+
+
+def test_training_abi_from_pure_c_host(tmp_path):
+    """A C binary with no Python linkage runs a COMPLETE training step
+    loop through the ABI — the reference's Scala/Horovod integration
+    story (create arrays -> CachedOp forward -> MXAutogradBackward ->
+    in-place sgd_update) — and the loss decreases."""
+    if shutil.which("gcc") is None:
+        pytest.skip("no C compiler")
+    native.load_ndarray()
+    so = os.path.join(os.path.dirname(native.__file__),
+                      "libmxtpu_ndarray.so")
+    jpath = tmp_path / "train_sym.json"
+    jpath.write_text(_train_symbol_json())
+    csrc = tmp_path / "train_host.c"
+    csrc.write_text(TRAIN_C_HOST)
+    exe = str(tmp_path / "train_host")
+    subprocess.run(["gcc", "-O2", "-o", exe, str(csrc), "-ldl"],
+                   check=True)
+    env = dict(os.environ,
+               PALLAS_AXON_POOL_IPS="",   # standalone host: force CPU jax
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([exe, so, str(jpath)], capture_output=True,
+                       text=True, timeout=300, env=env)
+    assert r.returncode == 0, r.stderr + r.stdout
+    assert "TRAIN-C-HOST-OK" in r.stdout

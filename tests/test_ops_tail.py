@@ -58,6 +58,11 @@ def test_ldexp_lcm_gcd():
     np.testing.assert_allclose(
         nd.ldexp(_arr(np.float32([1.5, 2.0])),
                  _arr(np.float32([2, 3]))).asnumpy(), [6.0, 16.0])
+    # reference semantics: x * 2^e for FLOAT e (no truncation)
+    np.testing.assert_allclose(
+        nd.ldexp(_arr(np.float32([1.5])),
+                 _arr(np.float32([0.5]))).asnumpy(),
+        [1.5 * 2 ** 0.5], rtol=1e-6)
     np.testing.assert_array_equal(
         nd.lcm(_arr(np.int32([4, 6])), _arr(np.int32([6, 4]))).asnumpy(),
         [12, 12])
