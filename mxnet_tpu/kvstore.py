@@ -195,8 +195,79 @@ class KVStore:
         import numpy as np
         from . import ndarray as _nd
         from .parallel import dist as _dist
+        from .sparse import RowSparseNDArray
 
-        gs = [r.asnumpy() for r in reduced_list]
+        # row_sparse gradients cross DCN as (indices, rows), NEVER the
+        # dense matrix (reference: kvstore_dist sparse push, the
+        # large-vocab embedding flagship).  Row counts differ per worker,
+        # so: one allgather of per-key row counts, then one padded
+        # allgather each for indices and rows; workers union-reduce.
+        # Compression never applies to sparse keys (the reference's 2-bit
+        # path is dense-only) — they ride this path regardless.
+        sparse_pos = [i for i, r in enumerate(reduced_list)
+                      if isinstance(r, RowSparseNDArray)]
+        dense_pos = [i for i, r in enumerate(reduced_list)
+                     if not isinstance(r, RowSparseNDArray)]
+        sparse_out = {}
+        if sparse_pos:
+            counts = np.asarray([reduced_list[i].indices.size
+                                 for i in sparse_pos], np.int64)
+            all_counts = _dist.allgather_host(counts)      # (W, K)
+            max_n = all_counts.max(axis=0)                 # per-key pad
+            idx_parts, row_parts = [], []
+            for j, i in enumerate(sparse_pos):
+                rs = reduced_list[i]
+                pad = int(max_n[j]) - rs.indices.size
+                idx_parts.append(np.pad(rs.indices, (0, pad),
+                                        constant_values=-1))
+                # explicit row width: reshape(0, -1) is invalid numpy, and
+                # an empty push (batch touched no rows of this key) must
+                # still reach the collective or peers hang
+                width = int(np.prod(rs.shape[1:]))
+                rows = rs.data.reshape(rs.indices.size, width)
+                row_parts.append(np.pad(rows, ((0, pad), (0, 0))))
+            all_idx = _dist.allgather_host(
+                np.concatenate(idx_parts) if idx_parts
+                else np.zeros(0, np.int64))
+            flat_rows = np.concatenate(
+                [p.ravel() for p in row_parts]) if row_parts \
+                else np.zeros(0, np.float32)
+            all_rows = _dist.allgather_host(flat_rows)
+            offs_i = np.cumsum([0] + [int(m) for m in max_n])
+            row_widths = [int(np.prod(reduced_list[i].shape[1:]))
+                          for i in sparse_pos]
+            offs_r = np.cumsum(
+                [0] + [int(m) * w for m, w in zip(max_n, row_widths)])
+            for j, i in enumerate(sparse_pos):
+                rs = reduced_list[i]
+                w = row_widths[j]
+                cat_idx, cat_rows = [], []
+                for wk in range(all_idx.shape[0]):
+                    n = int(all_counts[wk, j])
+                    cat_idx.append(
+                        all_idx[wk, offs_i[j]:offs_i[j] + n])
+                    cat_rows.append(
+                        all_rows[wk, offs_r[j]:offs_r[j] + n * w]
+                        .reshape(n, w))
+                idx = np.concatenate(cat_idx)
+                rows = np.concatenate(cat_rows, axis=0)
+                uniq, inv = np.unique(idx, return_inverse=True)
+                summed = np.zeros((uniq.size, w), rows.dtype)
+                np.add.at(summed, inv, rows)
+                # the shared transit buffer may have promoted (e.g. a f16
+                # key next to a f32 key); the caller's dtype wins
+                summed = summed.astype(rs.data.dtype, copy=False)
+                sparse_out[i] = RowSparseNDArray(
+                    summed.reshape((uniq.size,) + tuple(rs.shape[1:])),
+                    uniq, rs.shape, ctx=rs.context)
+            if not dense_pos:
+                return [sparse_out[i] for i in range(len(reduced_list))]
+            keys = [keys[i] for i in dense_pos]
+            reduced_list_dense = [reduced_list[i] for i in dense_pos]
+        else:
+            reduced_list_dense = reduced_list
+
+        gs = [r.asnumpy() for r in reduced_list_dense]
         out = [None] * len(gs)
         if self._compression is not None:
             # deterministic 2-bit threshold compression with error
@@ -241,8 +312,13 @@ class KVStore:
                     n = gs[i].size
                     out[i] = summed[off:off + n].reshape(gs[i].shape)
                     off += n
-        return [_nd.array(g, ctx=r.context, dtype=r.dtype)
-                for g, r in zip(out, reduced_list)]
+        dense_res = [_nd.array(g, ctx=r.context, dtype=r.dtype)
+                     for g, r in zip(out, reduced_list_dense)]
+        if not sparse_pos:
+            return dense_res
+        dense_by_pos = dict(zip(dense_pos, dense_res))
+        return [sparse_out.get(i, dense_by_pos.get(i))
+                for i in range(len(reduced_list))]
 
     def pull(self, key, out=None, priority: int = 0,
              ignore_sparse: bool = True):

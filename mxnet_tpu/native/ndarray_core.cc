@@ -952,11 +952,14 @@ int MXAutogradBackward(uint32_t num_output, void** output_handles,
     }
     PyObject* ograds;
     if (ograd_handles) {
-      ograds = handle_list(ograd_handles, num_output);
-      if (!ograds) {
-        Py_DECREF(heads);
-        nd_set_err("null ograd handle in MXAutogradBackward");
-        break;
+      // reference contract: a NULL ENTRY inside the array means "default
+      // (ones-like) head gradient for this head" — map it to None
+      ograds = PyList_New(num_output);
+      for (uint32_t i = 0; i < num_output; ++i) {
+        auto* h = static_cast<AnyPyHandle*>(ograd_handles[i]);
+        PyObject* o = (h && h->obj) ? h->obj : Py_None;
+        Py_INCREF(o);
+        PyList_SET_ITEM(ograds, i, o);
       }
     } else {
       ograds = Py_None;

@@ -104,6 +104,55 @@ WORKER = textwrap.dedent("""
         (rank, g_local.sum(), g_summed.sum())
     tr2.update(1)
 
+    # --- invariant 7: row_sparse push crosses DCN sparse and reduces over
+    # the UNION of row sets (kvstore_dist sparse path) ----------------------
+    from mxnet_tpu.sparse import RowSparseNDArray
+    store4 = kv.KVStore("dist_sync")
+    VOCAB, DIM = 50, 4
+    # worker r touches rows {r, r+1, 40}: pairwise overlap + one shared row
+    my_rows = np.array([rank, rank + 1, 40], np.int64)
+    g_sp = RowSparseNDArray(
+        np.full((3, DIM), float(rank + 1), np.float32), my_rows,
+        (VOCAB, DIM))
+    store4.push(11, g_sp)             # aggregation mode: no stored weight
+    agg = store4._store[11]
+    assert isinstance(agg, RowSparseNDArray), type(agg)   # never densified
+    union = sorted(set(int(r) for w in range(nw)
+                       for r in (w, w + 1, 40)))
+    assert list(agg.indices) == union, (rank, agg.indices)
+    dense = agg.todense().asnumpy()
+    expect_d = np.zeros((VOCAB, DIM), np.float32)
+    for w in range(nw):
+        for r in (w, w + 1, 40):
+            expect_d[r] += w + 1
+    assert np.allclose(dense, expect_d), (rank, dense[:5])
+    # a worker whose batch touched NO rows pushes an EMPTY row_sparse —
+    # it must still join the collective (peers would hang otherwise)
+    if rank == 0:
+        g_empty = RowSparseNDArray(np.zeros((0, DIM), np.float32),
+                                   np.zeros((0,), np.int64), (VOCAB, DIM))
+    else:
+        g_empty = RowSparseNDArray(
+            np.full((1, DIM), 5.0, np.float32),
+            np.array([2], np.int64), (VOCAB, DIM))
+    store4.push(13, g_empty)
+    agg13 = store4._store[13]
+    assert isinstance(agg13, RowSparseNDArray)
+    assert list(agg13.indices) == ([2] if nw > 1 else []), agg13.indices
+    if nw > 1:
+        assert np.allclose(agg13.data, 5.0 * (nw - 1)), agg13.data
+
+    # sparse pull of selected rows from a DENSE stored weight
+    store4.init(12, mx.nd.array(np.arange(VOCAB * DIM, dtype=np.float32)
+                                .reshape(VOCAB, DIM)))
+    out_sp = RowSparseNDArray(np.zeros((2, DIM), np.float32),
+                              np.array([0, 0], np.int64), (VOCAB, DIM))
+    store4.row_sparse_pull(12, out=out_sp,
+                           row_ids=mx.nd.array(np.array([3, 7]),
+                                               dtype="int64"))
+    assert np.allclose(out_sp.data[0], np.arange(12, 16)), out_sp.data
+    assert np.allclose(out_sp.data[1], np.arange(28, 32)), out_sp.data
+
     store.barrier()
     print(f"WORKER_{rank}_OK")
 """)

@@ -305,3 +305,73 @@ def test_sharded_trainer_tuple_labels():
         loss = tr.step(x, (lab, w), batch_size=1)
     l1 = float(loss.asnumpy())
     assert l1 < 0.2 * l0, (l0, l1)
+
+
+def test_sharded_embedding_large_vocab():
+    """The reference's sparse flagship shape, TPU-first: a large-vocab
+    Embedding trained under ShardedTrainer with the table ROW-SHARDED over
+    the mesh (vocab dim split over 'tp'), dp over the batch.  XLA turns
+    the gather/scatter-add into collectives; no step densifies a
+    (vocab, dim) gradient on any single device.  Trained weights must
+    match single-device training and only touched rows may change."""
+    np.random.seed(11)
+    VOCAB, DIM, CLASSES = 512, 16, 4
+
+    def build(prefix):
+        net = mx.gluon.nn.Sequential(prefix=prefix)
+        with net.name_scope():
+            net.add(mx.gluon.nn.Embedding(VOCAB, DIM),
+                    mx.gluon.nn.HybridLambda(
+                        lambda F, t: F.mean(t, axis=1)),
+                    mx.gluon.nn.Dense(CLASSES))
+        return net
+
+    mesh = par.make_mesh({"dp": 4, "tp": 2})
+    rules = par.ShardingRules([
+        # row-shard the embedding table over tp: each device holds
+        # VOCAB/2 rows; XLA inserts the gather collective
+        (r".*embedding0_weight$", ("tp", None)),
+    ])
+    net_ref = build("embref_")
+    net_par = build("embpar_")
+    net_ref.initialize(mx.init.Xavier())
+    x0 = mx.nd.array(np.zeros((8, 6), np.int64), dtype="int64")
+    net_ref(x0)                               # materialize shapes
+    net_par.initialize(mx.init.Xavier())
+    net_par(x0)
+    for p_ref, p_par in zip(net_ref.collect_params().values(),
+                            net_par.collect_params().values()):
+        p_par.set_data(p_ref.data().copy())
+
+    loss_fn = gloss.SoftmaxCrossEntropyLoss()
+    tr_ref = Trainer(net_ref.collect_params(), "sgd",
+                     {"learning_rate": 0.5})
+    tr_par = par.ShardedTrainer(net_par, loss_fn, "sgd",
+                                {"learning_rate": 0.5},
+                                mesh=mesh, rules=rules)
+
+    # batch touches a SMALL subset of the vocab (the sparse regime)
+    tokens = np.random.randint(0, 40, (8, 6)).astype(np.int64)
+    labels = np.random.randint(0, CLASSES, (8,))
+    w_before = net_par.collect_params()[
+        "embpar_embedding0_weight"].data().asnumpy().copy()
+    for _ in range(3):
+        with mx.autograd.record():
+            l = loss_fn(net_ref(mx.nd.array(tokens, dtype="int64")),
+                        mx.nd.array(labels))
+        l.backward()
+        tr_ref.step(8)
+        tr_par.step(tokens, labels)
+    tr_par.sync_params()
+    for p_ref, p_par in zip(net_ref.collect_params().values(),
+                            net_par.collect_params().values()):
+        np.testing.assert_allclose(
+            p_ref.data().asnumpy(), p_par.data().asnumpy(),
+            rtol=3e-5, atol=3e-5, err_msg=p_ref.name)
+    w_after = net_par.collect_params()[
+        "embpar_embedding0_weight"].data().asnumpy()
+    untouched = np.setdiff1d(np.arange(VOCAB), np.unique(tokens))
+    np.testing.assert_array_equal(w_after[untouched],
+                                  w_before[untouched])
+    assert not np.allclose(w_after[np.unique(tokens)],
+                           w_before[np.unique(tokens)])
