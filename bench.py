@@ -500,9 +500,14 @@ def main():
         dt = args.dtype or ("bfloat16" if args.only == "resnet_bf16"
                             else "float32")
         key = f"resnet50_{'bf16' if dt == 'bfloat16' else 'fp32'}"
+        # a profiled run traces a SHORT window: 3 steps are plenty for an
+        # XPlane/MFU analysis, and the r5 attempt showed a 35-step trace
+        # over the remote tunnel never completed (trace data volume)
+        iters = min(args.iters, 3) if args.profile else args.iters
+        warmup = min(args.warmup, 1) if args.profile else args.warmup
         with profiled():
-            rows[key] = bench_resnet50(dt, args.batch, args.iters,
-                                       args.warmup, args.size,
+            rows[key] = bench_resnet50(dt, args.batch, iters,
+                                       warmup, args.size,
                                        args.layout)
     else:
         # FULL suite: every row runs in its OWN subprocess (`--only ROW`)

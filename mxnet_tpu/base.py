@@ -202,6 +202,21 @@ def dtype_np(dtype: Any) -> "_np.dtype":
     return _np.dtype(dtype)
 
 
+def jax_compute_dtype(dtype: Any) -> "_np.dtype":
+    """The dtype jax will actually store: under the int32 default
+    (``runtime.enable_large_tensor()`` off), 64-bit requests map to their
+    32-bit duals — the DOCUMENTED large-tensor truncation contract
+    (runtime.py), applied explicitly here so jax never emits its
+    truncation UserWarning on the library's own paths."""
+    d = dtype_np(dtype)
+    import jax
+    if not jax.config.jax_enable_x64 and d.itemsize == 8 \
+            and d.kind in "iuf":
+        return _np.dtype({"i": _np.int32, "u": _np.uint32,
+                          "f": _np.float32}[d.kind])
+    return d
+
+
 def dtype_name(dtype: Any) -> str:
     """Canonical string name for a dtype."""
     d = _np.dtype(dtype) if not isinstance(dtype, str) else dtype_np(dtype)

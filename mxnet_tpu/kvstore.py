@@ -188,9 +188,13 @@ class KVStore:
 
         Reference parity: the reference batches and overlaps per-key
         pushes through the engine + ps-lite (SURVEY.md §2.3, §3.4); the
-        TPU-native analog is flat-buffer coalescing — all keys concat into
-        one allreduce (or, compressed, one allgather of packed codes), so
-        a 161-param ResNet pays one DCN round-trip per step, not 161.
+        TPU-native analog is flat-buffer coalescing — all DENSE keys
+        concat into one allreduce (or, compressed, one allgather of
+        packed codes), so a 161-param ResNet pays one DCN round-trip per
+        step, not 161.  row_sparse keys add three fixed collectives
+        (counts, then padded indices and rows — the counts must land
+        before the payload can be sized), independent of the number of
+        sparse keys.
         """
         import numpy as np
         from . import ndarray as _nd

@@ -955,6 +955,11 @@ int MXAutogradBackward(uint32_t num_output, void** output_handles,
       // reference contract: a NULL ENTRY inside the array means "default
       // (ones-like) head gradient for this head" — map it to None
       ograds = PyList_New(num_output);
+      if (!ograds) {
+        Py_DECREF(heads);
+        nd_set_err("ograd list allocation failed");
+        break;
+      }
       for (uint32_t i = 0; i < num_output; ++i) {
         auto* h = static_cast<AnyPyHandle*>(ograd_handles[i]);
         PyObject* o = (h && h->obj) ? h->obj : Py_None;

@@ -22,7 +22,7 @@ from typing import Any, Optional, Sequence, Tuple
 
 import numpy as _np
 
-from ..base import MXNetError, dtype_np, default_dtype
+from ..base import MXNetError, dtype_np, jax_compute_dtype, default_dtype
 from ..context import Context, current_context
 from .. import autograd as _autograd
 
@@ -255,7 +255,8 @@ class NDArray:
         return NDArray(self._read(), ctx=self._ctx)
 
     def astype(self, dtype, copy: bool = True) -> "NDArray":
-        npdt = dtype_np(dtype)
+        from ..base import jax_compute_dtype
+        npdt = jax_compute_dtype(dtype)   # documented int64->int32 contract
         if not copy and npdt == self.dtype:
             return self
         return NDArray(self._read().astype(npdt), ctx=self._ctx)
@@ -561,7 +562,7 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
     if isinstance(source, NDArray):
         val = source._read()
         if dtype is not None:
-            val = val.astype(dtype_np(dtype))
+            val = val.astype(jax_compute_dtype(dtype))
         return NDArray(jax.device_put(val, ctx.device), ctx=ctx)
     if dtype is None:
         if isinstance(source, _np.ndarray):
@@ -574,7 +575,7 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
             if npv.dtype.kind in "ifu" and npv.dtype != _np.float32:
                 npv = npv.astype(_np.float32)
     else:
-        npv = _np.asarray(source, dtype=dtype_np(dtype))
+        npv = _np.asarray(source, dtype=jax_compute_dtype(dtype))
     return NDArray(jax.device_put(npv, ctx.device), ctx=ctx)
 
 
@@ -587,7 +588,7 @@ def zeros(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
     ctx = ctx if ctx is not None else current_context()
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
     with jax.default_device(ctx.device):
-        val = _jnp().zeros(shape, dtype=dtype_np(dtype))
+        val = _jnp().zeros(shape, dtype=jax_compute_dtype(dtype))
     return NDArray(val, ctx=ctx)
 
 
@@ -596,7 +597,7 @@ def ones(shape, ctx=None, dtype=None, **kwargs) -> NDArray:
     ctx = ctx if ctx is not None else current_context()
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
     with jax.default_device(ctx.device):
-        val = _jnp().ones(shape, dtype=dtype_np(dtype))
+        val = _jnp().ones(shape, dtype=jax_compute_dtype(dtype))
     return NDArray(val, ctx=ctx)
 
 
@@ -605,7 +606,7 @@ def full(shape, val, ctx=None, dtype=None) -> NDArray:
     ctx = ctx if ctx is not None else current_context()
     shape = (shape,) if isinstance(shape, int) else tuple(shape)
     with jax.default_device(ctx.device):
-        out = _jnp().full(shape, val, dtype=dtype_np(dtype))
+        out = _jnp().full(shape, val, dtype=jax_compute_dtype(dtype))
     return NDArray(out, ctx=ctx)
 
 
@@ -613,7 +614,7 @@ def arange(start, stop=None, step=1.0, repeat=1, ctx=None, dtype=None) -> NDArra
     import jax
     ctx = ctx if ctx is not None else current_context()
     with jax.default_device(ctx.device):
-        val = _jnp().arange(start, stop, step, dtype=dtype_np(dtype))
+        val = _jnp().arange(start, stop, step, dtype=jax_compute_dtype(dtype))
         if repeat != 1:
             val = _jnp().repeat(val, repeat)
     return NDArray(val, ctx=ctx)
@@ -625,7 +626,7 @@ def eye(N, M=0, k=0, ctx=None, dtype=None) -> NDArray:
     ctx = ctx if ctx is not None else current_context()
     with jax.default_device(ctx.device):
         val = _jnp().eye(int(N), int(M) if M else int(N), k=int(k),
-                         dtype=dtype_np(dtype))
+                         dtype=jax_compute_dtype(dtype))
     return NDArray(val, ctx=ctx)
 
 
@@ -641,7 +642,7 @@ def linspace(start, stop, num, endpoint=True, ctx=None,
     ctx = ctx if ctx is not None else current_context()
     with jax.default_device(ctx.device):
         val = _jnp().linspace(start, stop, int(num), endpoint=endpoint,
-                              dtype=dtype_np(dtype))
+                              dtype=jax_compute_dtype(dtype))
     return NDArray(val, ctx=ctx)
 
 

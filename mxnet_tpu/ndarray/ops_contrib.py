@@ -901,8 +901,13 @@ def _register_round3b():
     # ---- getnnz (src/operator/contrib/nnz.cc; csr there, storage-generic
     # here: the count is the same question on any layout) ------------------
     def getnnz_maker(axis=None):
+        from ..base import jax_compute_dtype
+
         def fn(data):
-            return jnp.sum((data != 0).astype(jnp.int64), axis=axis)
+            # int64 counts under enable_large_tensor(), int32 otherwise
+            # (the documented contract, applied without jax's warning)
+            return jnp.sum((data != 0).astype(jax_compute_dtype("int64")),
+                           axis=axis)
         return fn
     register_op("_contrib_getnnz", getnnz_maker, differentiable=False)
 

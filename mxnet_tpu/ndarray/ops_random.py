@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import numpy as _np
 
-from ..base import dtype_np
+from ..base import jax_compute_dtype
 from .register import register_op
 
 
@@ -70,7 +70,7 @@ def _register():
     # compilation anyway, where use_jit is irrelevant.
 
     def uniform_maker(low=0.0, high=1.0, shape=None, dtype=None, ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             return jr.uniform(key, shp, dt, float(low), float(high))
@@ -79,7 +79,7 @@ def _register():
                 differentiable=False, use_jit=False)
 
     def normal_maker(loc=0.0, scale=1.0, shape=None, dtype=None, ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             return (jr.normal(key, shp, dt) * scale + loc).astype(dt)
@@ -88,7 +88,7 @@ def _register():
                 differentiable=False, use_jit=False)
 
     def gamma_maker(alpha=1.0, beta=1.0, shape=None, dtype=None, ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             a = jnp.asarray(float(alpha), dt)
@@ -102,7 +102,7 @@ def _register():
         # reference parameterizes by rate lambda; the eager frontend's
         # historical `scale` (=1/lambda) is accepted too
         sc = float(scale) if scale is not None else 1.0 / float(lam)
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             return (jr.exponential(key, shp, dt) * sc).astype(dt)
@@ -111,7 +111,7 @@ def _register():
                 differentiable=False, use_jit=False)
 
     def poisson_maker(lam=1.0, shape=None, dtype=None, ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             return jr.poisson(key, float(lam), shp).astype(dt)
@@ -121,7 +121,7 @@ def _register():
 
     def negative_binomial_maker(k=1, p=1.0, shape=None, dtype=None,
                                 ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             kg, kp = jr.split(key)
@@ -134,7 +134,7 @@ def _register():
                 needs_rng=True, differentiable=False, use_jit=False)
 
     def gnb_maker(mu=1.0, alpha=1.0, shape=None, dtype=None, ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
         k = 1.0 / float(alpha)
         p = k / (k + float(mu))
 
@@ -148,7 +148,7 @@ def _register():
                 needs_rng=True, differentiable=False, use_jit=False)
 
     def randint_maker(low=0, high=1, shape=None, dtype="int32", ctx=None):
-        shp, dt = _canon_shape(shape), dtype_np(dtype)
+        shp, dt = _canon_shape(shape), jax_compute_dtype(dtype)
 
         def fn(key):
             return jr.randint(key, shp, int(low), int(high), dt)
@@ -161,7 +161,7 @@ def _register():
     def _like(drawer):
         def like_maker(dtype=None, **params):
             def fn(data, key):
-                dt = data.dtype if dtype is None else dtype_np(dtype)
+                dt = data.dtype if dtype is None else jax_compute_dtype(dtype)
                 return drawer(key, data.shape, dt, params)
             return fn
         return like_maker
@@ -200,7 +200,7 @@ def _register():
 
     def sample_uniform_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(low, high, key):
             low, high = _bcast([low, high])
@@ -214,7 +214,7 @@ def _register():
 
     def sample_normal_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(mu, sigma, key):
             mu, sigma = _bcast([mu, sigma])
@@ -228,7 +228,7 @@ def _register():
 
     def sample_gamma_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(alpha, beta, key):
             alpha, beta = _bcast([alpha, beta])
@@ -242,7 +242,7 @@ def _register():
 
     def sample_exponential_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(lam, key):
             out_shape = tuple(lam.shape) + draw
@@ -254,7 +254,7 @@ def _register():
 
     def sample_poisson_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(lam, key):
             out_shape = tuple(lam.shape) + draw
@@ -267,7 +267,7 @@ def _register():
 
     def sample_nb_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(k, p, key):
             k, p = _bcast([k, p])
@@ -284,7 +284,7 @@ def _register():
 
     def sample_gnb_maker(shape=None, dtype=None, ctx=None):
         draw = _draw_shape(shape)
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def fn(mu, alpha, key):
             mu, alpha = _bcast([mu, alpha])
@@ -308,7 +308,7 @@ def _register():
             int(shape) if isinstance(shape, (int, _np.integer))
             else int(_np.prod(shape)))
         squeeze = shape in (None, ())
-        dt = dtype_np(dtype)
+        dt = jax_compute_dtype(dtype)
 
         def draw(p, key):
             logits = jnp.log(jnp.maximum(p, 1e-30))
