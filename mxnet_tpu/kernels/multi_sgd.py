@@ -21,6 +21,7 @@ carries a second state buffer through the same grid.
 from __future__ import annotations
 
 import functools
+import os
 from typing import Sequence, Tuple
 
 # one grid step processes this many elements: a full fp32 VREG tile
@@ -43,8 +44,54 @@ def _size(shape) -> int:
 
 
 @functools.lru_cache(maxsize=None)
+def _jnp_dual(clip: float, dtype_name: str, momentum: float | None):
+    """The kernel's jnp twin over the same packed (rows, 128) buffers.
+
+    Off-TPU production path: interpret-mode Pallas executes the kernel
+    grid step-by-step in Python (~30 ms per trainer step measured on the
+    host bench — 10x the whole rest of the step), which is a TESTING
+    vehicle, not a CPU backend.  XLA:CPU compiles this dual to the same
+    math.  Kernel-semantics tests opt back into real interpret mode with
+    MXNET_PALLAS_INTERPRET=1."""
+    import jax
+    import jax.numpy as jnp
+
+    def _rowwise(lr_c, wd_c, like):
+        lr = jnp.repeat(lr_c, _SUBLANES)[:, None].astype(like.dtype)
+        wd = jnp.repeat(wd_c, _SUBLANES)[:, None].astype(like.dtype)
+        return lr, wd
+
+    if momentum is None:
+        @jax.jit
+        def sgd(lr_c, wd_c, w, g):
+            lr, wd = _rowwise(lr_c, wd_c, w)
+            if clip > 0:
+                g = jnp.clip(g, -clip, clip)
+            return w - lr * (g + wd * w)
+        return sgd
+
+    @jax.jit
+    def sgd_mom(lr_c, wd_c, w, g, m):
+        lr, wd = _rowwise(lr_c, wd_c, w)
+        if clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        mom_new = momentum * m - lr * (g + wd * w)
+        return w + mom_new, mom_new
+    return sgd_mom
+
+
 def _build_call(n_chunks: int, clip: float, dtype_name: str,
                 momentum: float | None, interpret: bool):
+    # env resolved OUTSIDE the cache so a test's monkeypatched
+    # MXNET_PALLAS_INTERPRET takes effect regardless of call order
+    if interpret and os.environ.get("MXNET_PALLAS_INTERPRET", "0") != "1":
+        return _jnp_dual(clip, dtype_name, momentum)
+    return _build_pallas(n_chunks, clip, dtype_name, momentum, interpret)
+
+
+@functools.lru_cache(maxsize=None)
+def _build_pallas(n_chunks: int, clip: float, dtype_name: str,
+                  momentum: float | None, interpret: bool):
     # rescale_grad is deliberately NOT part of this key: it changes with
     # batch size, and each new key would mean a fresh Mosaic compile.
     # The caller pre-scales the gradient instead (XLA fuses that multiply

@@ -255,7 +255,6 @@ class NDArray:
         return NDArray(self._read(), ctx=self._ctx)
 
     def astype(self, dtype, copy: bool = True) -> "NDArray":
-        from ..base import jax_compute_dtype
         npdt = jax_compute_dtype(dtype)   # documented int64->int32 contract
         if not copy and npdt == self.dtype:
             return self
@@ -575,7 +574,14 @@ def array(source, ctx: Optional[Context] = None, dtype=None) -> NDArray:
             if npv.dtype.kind in "ifu" and npv.dtype != _np.float32:
                 npv = npv.astype(_np.float32)
     else:
-        npv = _np.asarray(source, dtype=jax_compute_dtype(dtype))
+        # build at the REQUESTED width first, then cast to the jax
+        # compute dtype: asarray(python_ints, int32) raises OverflowError
+        # past 2^31, while the documented large-tensor contract is
+        # wraparound truncation (what jax's own canonicalization did)
+        npv = _np.asarray(source, dtype=dtype_np(dtype))
+        jcd = jax_compute_dtype(dtype)
+        if jcd != npv.dtype:
+            npv = npv.astype(jcd)
     return NDArray(jax.device_put(npv, ctx.device), ctx=ctx)
 
 

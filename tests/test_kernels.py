@@ -3,13 +3,31 @@
 Reference parity: src/operator/optimizer_op.cc multi_sgd_update family
 (SURVEY.md §2.2 optimizer_op row; §7 M9 native hardening).  On the CPU
 test mesh the kernels run under the Pallas interpreter — the same code
-Mosaic compiles on TPU.
+Mosaic compiles on TPU.  The fixture below opts THIS module into real
+interpret mode (production off-TPU dispatch uses the kernels' jnp duals;
+these tests exist to execute the kernel bodies themselves).
 """
 import numpy as np
 import pytest
 
 import mxnet_tpu as mx
 from mxnet_tpu import autograd, gluon, nd
+
+
+@pytest.fixture(autouse=True)
+def _real_interpret_mode(monkeypatch):
+    # the op-dispatch compile caches key on (op, kwargs), not on this env
+    # var — drop them on BOTH sides of the test: before, so a jnp-dual
+    # entry traced by an earlier module cannot satisfy a kernel test
+    # without executing the kernel body; after, so interpret-mode entries
+    # can't leak into (and slow down) later modules
+    from mxnet_tpu.ndarray.register import Operator
+    Operator._fn_cached.cache_clear()
+    Operator._vjp_cached.cache_clear()
+    monkeypatch.setenv("MXNET_PALLAS_INTERPRET", "1")
+    yield
+    Operator._fn_cached.cache_clear()
+    Operator._vjp_cached.cache_clear()
 
 
 SHAPES = [(3, 5), (1000,), (17, 9, 2), (1,), (128, 128)]
