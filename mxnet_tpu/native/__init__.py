@@ -303,5 +303,27 @@ def load_ndarray():
             vp, ctypes.c_int, ctypes.POINTER(vp),
             ctypes.POINTER(ctypes.c_int),
             ctypes.POINTER(ctypes.POINTER(vp))]
+        # data-iterator slice (same .so — GetData/GetLabel mint shared
+        # NDArray handles owned by the iterator)
+        lib.MXListDataIters.restype = ctypes.c_int
+        lib.MXListDataIters.argtypes = [
+            ctypes.POINTER(u32), ctypes.POINTER(ctypes.POINTER(vp))]
+        lib.MXDataIterCreateIter.restype = ctypes.c_int
+        lib.MXDataIterCreateIter.argtypes = [
+            vp, u32, ctypes.POINTER(ctypes.c_char_p),
+            ctypes.POINTER(ctypes.c_char_p), ctypes.POINTER(vp)]
+        for fname in ("MXDataIterBeforeFirst", "MXDataIterFree"):
+            f = getattr(lib, fname)
+            f.restype = ctypes.c_int
+            f.argtypes = [vp]
+        lib.MXDataIterNext.restype = ctypes.c_int
+        lib.MXDataIterNext.argtypes = [vp, ctypes.POINTER(ctypes.c_int)]
+        for fname in ("MXDataIterGetData", "MXDataIterGetLabel"):
+            f = getattr(lib, fname)
+            f.restype = ctypes.c_int
+            f.argtypes = [vp, ctypes.POINTER(vp)]
+        lib.MXDataIterGetPadNum.restype = ctypes.c_int
+        lib.MXDataIterGetPadNum.argtypes = [vp,
+                                            ctypes.POINTER(ctypes.c_int)]
         _NDC["lib"] = lib
         return lib

@@ -77,6 +77,19 @@ _DT_REV = {v: k for k, v in _DT.items()}
 _GRAD_REQ = {0: "null", 1: "write", 2: "write", 3: "add"}
 
 
+def _parse_attr(v):
+    """String -> typed param (the reference's dmlc::Parameter parser
+    accepts lowercase booleans, which are not Python literals)."""
+    if v in ("true", "True"):
+        return True
+    if v in ("false", "False"):
+        return False
+    try:
+        return _ast.literal_eval(v)
+    except (ValueError, SyntaxError):
+        return v
+
+
 class _CCachedOp:
     """The C-ABI CachedOp (reference: src/imperative/cached_op.cc).
 
@@ -123,6 +136,42 @@ class _CCachedOp:
         return [vals[(id(n), i)] for n, i in self.sym._heads]
 
 
+class _CIter:
+    """C-side data-iterator state.  Reference contract (c_api.cc
+    MXDataIterGetData): each Get* call returns a NEW NDArray handle the
+    CALLER frees with MXNDArrayFree — the iterator owns only itself."""
+
+    def __init__(self, it):
+        self.it = it
+        self.batch = None
+
+    def before_first(self):
+        self.it.reset()
+        self.batch = None
+
+    def next(self):
+        try:
+            self.batch = self.it.next()
+            return 1
+        except StopIteration:
+            self.batch = None
+            return 0
+
+    def current(self, field):
+        if self.batch is None:
+            raise RuntimeError("no current batch: call MXDataIterNext "
+                               "(and check its return) first")
+        v = getattr(self.batch, field)
+        if isinstance(v, (list, tuple)):
+            v = v[0] if v else None
+        if v is None:
+            raise RuntimeError(f"batch carries no {field}")
+        return v
+
+    def pad(self):
+        return int(self.batch.pad or 0) if self.batch is not None else 0
+
+
 class _NDCore:
     @staticmethod
     def create(shape, dev_type, dev_id, dtype):
@@ -148,12 +197,7 @@ class _NDCore:
 
     @staticmethod
     def invoke(op_name, inputs, keys, vals, out=None):
-        kwargs = {}
-        for k, v in zip(keys, vals):
-            try:
-                kwargs[k] = _ast.literal_eval(v)
-            except (ValueError, SyntaxError):
-                kwargs[k] = v            # plain string attr
+        kwargs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
         res = _invoke(op_name, list(inputs), kwargs, out=out)
         return list(res) if isinstance(res, (list, tuple)) else [res]
 
@@ -271,6 +315,44 @@ class _NDCore:
         _ag.backward(list(heads),
                      list(ograds) if ograds else None,
                      retain_graph=bool(retain_graph))
+
+    # ---- data iterators (reference c_api.cc MXDataIter* over
+    # src/io/iter_*.cc): creators are the string-constructible io
+    # iterators; a created handle owns its current batch --------------
+    _ITER_CREATORS = ("ImageRecordIter", "CSVIter", "MNISTIter",
+                      "LibSVMIter", "NDArrayIter")
+
+    @staticmethod
+    def list_data_iters():
+        return list(_NDCore._ITER_CREATORS)
+
+    @staticmethod
+    def iter_create(name, keys, vals):
+        if name not in _NDCore._ITER_CREATORS:
+            raise ValueError(f"unknown data iter creator {name!r}")
+        import mxnet_tpu.io as _io
+        kwargs = {k: _parse_attr(v) for k, v in zip(keys, vals)}
+        return _CIter(getattr(_io, name)(**kwargs))
+
+    @staticmethod
+    def iter_before_first(it):
+        it.before_first()
+
+    @staticmethod
+    def iter_next(it):
+        return it.next()
+
+    @staticmethod
+    def iter_getdata(it):
+        return it.current("data")
+
+    @staticmethod
+    def iter_getlabel(it):
+        return it.current("label")
+
+    @staticmethod
+    def iter_getpad(it):
+        return it.pad()
 
     # ---- CachedOp ------------------------------------------------------
     @staticmethod
@@ -1077,6 +1159,187 @@ int MXInvokeCachedOp(void* handle, int num_inputs, void** inputs,
   } while (false);
   PyGILState_Release(gil);
   return rc;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// MXDataIter*: the data-iterator C ABI (reference: src/c_api/c_api.cc
+// MXDataIter slice over src/io/iter_*.cc).  Creator handles are interned
+// name pointers (the MXListAllOpNames discipline).  Ownership follows the
+// reference contract exactly: every MXDataIterGetData/GetLabel call
+// returns a NEW NDArray handle that the CALLER releases with
+// MXNDArrayFree (upstream language bindings wrap it in an NDArray whose
+// destructor does so); the iterator handle owns only itself.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+struct IterHandle {
+  PyObject* obj = nullptr;                 // bootstrap _CIter
+};
+
+std::vector<std::string>* g_iter_names = nullptr;
+std::vector<const char*>* g_iter_name_ptrs = nullptr;
+
+int iter_simple_call(void* handle, const char* method, PyObject** out) {
+  auto* h = static_cast<IterHandle*>(handle);
+  if (!nd_ensure_bootstrap()) return -1;
+  PyObject* r = PyObject_CallMethod(g_ndcore_cls, method, "O", h->obj);
+  if (!r) {
+    nd_set_err_from_python();
+    return -1;
+  }
+  *out = r;
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int MXListDataIters(uint32_t* out_size, void*** out_array) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    if (!g_iter_names) {
+      PyObject* r = PyObject_CallMethod(g_ndcore_cls, "list_data_iters",
+                                        nullptr);
+      if (!r) {
+        nd_set_err_from_python();
+        break;
+      }
+      g_iter_names = new std::vector<std::string>();
+      g_iter_name_ptrs = new std::vector<const char*>();
+      for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+        const char* u = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+        if (u) g_iter_names->emplace_back(u);
+        else PyErr_Clear();
+      }
+      for (auto& s : *g_iter_names)
+        g_iter_name_ptrs->push_back(s.c_str());
+      Py_DECREF(r);
+    }
+    *out_size = static_cast<uint32_t>(g_iter_name_ptrs->size());
+    *out_array = reinterpret_cast<void**>(
+        const_cast<char**>(g_iter_name_ptrs->data()));
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXDataIterCreateIter(void* creator, uint32_t num_param,
+                         const char** keys, const char** vals, void** out) {
+  const char* name = static_cast<const char*>(creator);
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* klist = PyList_New(num_param);
+    PyObject* vlist = PyList_New(num_param);
+    if (!klist || !vlist) {
+      Py_XDECREF(klist);
+      Py_XDECREF(vlist);
+      nd_set_err("param list allocation failed");
+      break;
+    }
+    for (uint32_t i = 0; i < num_param; ++i) {
+      PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+      PyList_SET_ITEM(vlist, i, PyUnicode_FromString(vals[i]));
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "iter_create", "sOO",
+                                      name, klist, vlist);
+    Py_DECREF(klist);
+    Py_DECREF(vlist);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    auto* h = new IterHandle();
+    h->obj = r;
+    *out = h;
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXDataIterBeforeFirst(void* handle) {
+  auto* h = static_cast<IterHandle*>(handle);
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = nullptr;
+  int rc = iter_simple_call(handle, "iter_before_first", &r);
+  if (rc == 0) Py_DECREF(r);
+  (void)h;
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXDataIterNext(void* handle, int* out) {
+  auto* h = static_cast<IterHandle*>(handle);
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = nullptr;
+  int rc = iter_simple_call(handle, "iter_next", &r);
+  if (rc == 0) {
+    *out = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+  }
+  (void)h;
+  PyGILState_Release(gil);
+  return rc;
+}
+
+static int iter_get_field(void* handle, const char* method, void** out_nd) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = nullptr;
+  int rc = iter_simple_call(handle, method, &r);
+  if (rc == 0) {
+    // a NEW caller-owned handle per call (reference contract): release
+    // with MXNDArrayFree like any other MXNDArray* handle
+    auto* nh = new NDHandle();
+    nh->obj = r;                 // steal the reference
+    *out_nd = nh;
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXDataIterGetData(void* handle, void** out_nd) {
+  return iter_get_field(handle, "iter_getdata", out_nd);
+}
+
+int MXDataIterGetLabel(void* handle, void** out_nd) {
+  return iter_get_field(handle, "iter_getlabel", out_nd);
+}
+
+int MXDataIterGetPadNum(void* handle, int* pad) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* r = nullptr;
+  int rc = iter_simple_call(handle, "iter_getpad", &r);
+  if (rc == 0) {
+    *pad = static_cast<int>(PyLong_AsLong(r));
+    Py_DECREF(r);
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXDataIterFree(void* handle) {
+  auto* h = static_cast<IterHandle*>(handle);
+  if (!h) return 0;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  Py_XDECREF(h->obj);
+  PyGILState_Release(gil);
+  delete h;
+  return 0;
 }
 
 }  // extern "C"
