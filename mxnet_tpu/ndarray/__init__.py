@@ -32,6 +32,7 @@ from . import ops_control_flow as _ops_cf        # noqa: F401
 from . import ops_custom as _ops_custom          # noqa: F401
 from . import ops_image as _ops_image            # noqa: F401
 from . import ops_tail as _ops_tail              # noqa: F401
+from . import ops_sldwin as _ops_sldwin          # noqa: F401
 from . import random                              # noqa: F401
 from . import contrib                             # noqa: F401
 from . import image                               # noqa: F401

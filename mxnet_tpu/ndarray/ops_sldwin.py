@@ -1,0 +1,103 @@
+"""Sliding-window (banded) attention operators.
+
+Reference parity: src/operator/contrib/transformer.cc
+``_sldwin_atten_score`` / ``_sldwin_atten_mask_like`` /
+``_sldwin_atten_context`` — the Longformer-style banded attention
+primitives: the (L, L) score matrix never materializes; only the
+``2w+1`` (symmetric) or ``w+1`` (one-sided) band per query does.
+
+Layouts follow the reference: query/key/value are (B, L, H, D);
+``dilation`` is an (H,)-shaped integer TENSOR input (per-head dilation);
+scores/masks are (B, L, H, W) with W = 2w+1 or w+1.
+
+TPU-first design: the band is a static offset enumeration — a gather of
+the W dilated key/value rows per position followed by one einsum, so XLA
+sees fixed-shape batched matmuls for the MXU and the band tensors
+(B, H, L, W, D) stay O(L·W·D), never O(L²).  Gradients come from
+autodiff through gather+einsum (the reference hand-writes backward
+kernels).  Out-of-range band slots are exact zeros in the score op and
+0 in the mask — matching the reference's zero-filled band convention.
+"""
+from __future__ import annotations
+
+from .register import register_op
+
+
+def _offsets(w: int, symmetric: bool):
+    import numpy as np
+    return (np.arange(2 * w + 1) - w) if symmetric else \
+        (np.arange(w + 1) - w)
+
+
+def _band_gather(jnp, x_blhd, idx_hlw, valid_hlw):
+    """Gather (B, L, H, D) rows into the (B, H, L, W, D) band."""
+    b, l, h, d = x_blhd.shape
+    w = idx_hlw.shape[-1]
+    xt = jnp.transpose(x_blhd, (0, 2, 1, 3))          # (B, H, L, D)
+    idx = jnp.broadcast_to(
+        idx_hlw.reshape(1, h, l * w, 1), (b, h, l * w, d))
+    g = jnp.take_along_axis(xt, idx, axis=2).reshape(b, h, l, w, d)
+    return g * valid_hlw.reshape(1, h, l, w, 1).astype(g.dtype)
+
+
+def _band_index(jnp, l, dilation, w: int, symmetric: bool):
+    """(H, L, W) absolute key index per band slot + in-range validity."""
+    offs = jnp.asarray(_offsets(w, symmetric))         # (W,)
+    dil = dilation.astype(jnp.int32).reshape(-1, 1, 1)  # (H, 1, 1)
+    pos = jnp.arange(l).reshape(1, -1, 1)               # (1, L, 1)
+    idx = pos + offs.reshape(1, 1, -1) * dil            # (H, L, W)
+    valid = (idx >= 0) & (idx < l)
+    return jnp.clip(idx, 0, l - 1), valid
+
+
+def _register():
+    import jax.numpy as jnp
+
+    def score_maker(w=1, symmetric=True):
+        w = int(w)
+
+        def fn(query, key, dilation):
+            b, l, h, d = query.shape
+            idx, valid = _band_index(jnp, l, dilation, w, bool(symmetric))
+            kband = _band_gather(jnp, key, idx, valid)   # (B,H,L,W,D)
+            qt = jnp.transpose(query, (0, 2, 1, 3))      # (B,H,L,D)
+            s = jnp.einsum("bhld,bhlwd->bhlw", qt, kband)
+            return jnp.transpose(s, (0, 2, 1, 3))        # (B,L,H,W)
+        return fn
+    register_op("_contrib_sldwin_atten_score", score_maker,
+                aliases=("_sldwin_atten_score",))
+
+    def mask_like_maker(w=1, symmetric=True):
+        w = int(w)
+
+        def fn(score, dilation, valid_length):
+            b, l, h, _ = score.shape
+            idx, valid = _band_index(jnp, l, dilation, w, bool(symmetric))
+            vl = valid_length.reshape(-1, 1, 1, 1).astype(jnp.int32)
+            # a slot is live when the KEY row is in range and unpadded
+            # AND the query row itself is unpadded
+            key_ok = valid[None] & (idx[None] < vl)
+            q_ok = (jnp.arange(l).reshape(1, 1, -1, 1) < vl)
+            m = (key_ok & q_ok).astype(score.dtype)      # (B,H,L,W)
+            return jnp.transpose(m, (0, 2, 1, 3))        # (B,L,H,W)
+        return fn
+    register_op("_contrib_sldwin_atten_mask_like", mask_like_maker,
+                aliases=("_sldwin_atten_mask_like",),
+                differentiable=False)
+
+    def context_maker(w=1, symmetric=True):
+        w = int(w)
+
+        def fn(score, value, dilation):
+            b, l, h, _ = score.shape
+            idx, valid = _band_index(jnp, l, dilation, w, bool(symmetric))
+            vband = _band_gather(jnp, value, idx, valid)  # (B,H,L,W,D)
+            st = jnp.transpose(score, (0, 2, 1, 3))       # (B,H,L,W)
+            c = jnp.einsum("bhlw,bhlwd->bhld", st, vband)
+            return jnp.transpose(c, (0, 2, 1, 3))         # (B,L,H,D)
+        return fn
+    register_op("_contrib_sldwin_atten_context", context_maker,
+                aliases=("_sldwin_atten_context",))
+
+
+_register()

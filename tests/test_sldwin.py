@@ -1,0 +1,158 @@
+"""Sliding-window attention ops vs a dense banded reference.
+
+Reference model: the contrib transformer op tests of
+tests/python/unittest/test_operator.py for _sldwin_atten_* (SURVEY.md
+§4.2) — band extraction must match the dense QK^T restricted to the
+band, the mask must mark exactly the in-range unpadded slots, and the
+context must equal the dense masked attention when scores ride through
+the mask.
+"""
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd, nd
+
+
+def _dense_band(q, k, dil, w, symmetric):
+    """Numpy reference: score[b,i,h,j] over offsets j, zero out of range."""
+    B, L, H, D = q.shape
+    offs = (np.arange(2 * w + 1) - w) if symmetric else \
+        (np.arange(w + 1) - w)
+    out = np.zeros((B, L, H, offs.size), np.float32)
+    for b in range(B):
+        for i in range(L):
+            for h in range(H):
+                for j, o in enumerate(offs):
+                    t = i + int(o) * int(dil[h])
+                    if 0 <= t < L:
+                        out[b, i, h, j] = q[b, i, h] @ k[b, t, h]
+    return out
+
+
+@pytest.mark.parametrize("symmetric", [True, False])
+@pytest.mark.parametrize("dil", [[1, 1], [1, 2]])
+def test_sldwin_score_matches_dense(symmetric, dil):
+    rng = np.random.default_rng(0)
+    B, L, H, D, w = 2, 9, 2, 4, 2
+    q = rng.standard_normal((B, L, H, D)).astype(np.float32)
+    k = rng.standard_normal((B, L, H, D)).astype(np.float32)
+    got = nd._sldwin_atten_score(
+        nd.array(q), nd.array(k), nd.array(np.int32(dil)),
+        w=w, symmetric=symmetric).asnumpy()
+    ref = _dense_band(q, k, dil, w, symmetric)
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+
+def test_sldwin_mask_like():
+    B, L, H, w = 2, 7, 2, 2
+    dil = np.int32([1, 2])
+    score = nd.zeros((B, L, H, 2 * w + 1))
+    vlen = np.int32([7, 4])
+    m = nd._sldwin_atten_mask_like(
+        score, nd.array(dil), nd.array(vlen), w=w,
+        symmetric=True).asnumpy()
+    offs = np.arange(2 * w + 1) - w
+    for b in range(B):
+        for i in range(L):
+            for h in range(H):
+                for j, o in enumerate(offs):
+                    t = i + int(o) * int(dil[h])
+                    expect = (0 <= t < L) and t < vlen[b] and i < vlen[b]
+                    assert m[b, i, h, j] == float(expect), (b, i, h, j)
+
+
+def test_sldwin_context_equals_dense_attention():
+    """softmax(masked band scores) @ V through the band ops == dense
+    attention with the equivalent band mask."""
+    rng = np.random.default_rng(3)
+    B, L, H, D, w = 1, 8, 2, 4, 2
+    q = rng.standard_normal((B, L, H, D)).astype(np.float32) / 2
+    k = rng.standard_normal((B, L, H, D)).astype(np.float32) / 2
+    v = rng.standard_normal((B, L, H, D)).astype(np.float32)
+    dil = np.int32([1, 1])
+    vlen = np.int32([L])
+
+    s = nd._sldwin_atten_score(nd.array(q), nd.array(k),
+                               nd.array(dil), w=w, symmetric=True)
+    m = nd._sldwin_atten_mask_like(s, nd.array(dil), nd.array(vlen),
+                                   w=w, symmetric=True)
+    neg = (1.0 - m) * -1e9
+    att = nd.softmax(s + neg, axis=-1) * m
+    ctx = nd._sldwin_atten_context(att, nd.array(v), nd.array(dil),
+                                   w=w, symmetric=True).asnumpy()
+
+    # dense reference
+    scores = np.einsum("bihd,bjhd->bhij", q, k)
+    band = np.abs(np.arange(L)[:, None] - np.arange(L)[None, :]) <= w
+    scores = np.where(band[None, None], scores, -1e9)
+    attn = np.exp(scores - scores.max(-1, keepdims=True))
+    attn = attn / attn.sum(-1, keepdims=True)
+    ref = np.einsum("bhij,bjhd->bihd", attn, v)
+    np.testing.assert_allclose(ctx, ref, rtol=1e-4, atol=1e-5)
+
+
+def test_sldwin_gradients():
+    """FD check through score -> masked softmax -> context."""
+    rng = np.random.default_rng(5)
+    B, L, H, D, w = 1, 6, 1, 3, 1
+    qn = rng.standard_normal((B, L, H, D)).astype(np.float32) / 2
+    kn = rng.standard_normal((B, L, H, D)).astype(np.float32) / 2
+    vn = rng.standard_normal((B, L, H, D)).astype(np.float32)
+    dil = nd.array(np.int32([1]))
+
+    def loss_np(qx):
+        s = _dense_band(qx, kn, [1], w, True)
+        # in-range mask
+        offs = np.arange(2 * w + 1) - w
+        m = np.zeros_like(s)
+        for i in range(L):
+            for j, o in enumerate(offs):
+                if 0 <= i + o < L:
+                    m[:, i, :, j] = 1.0
+        e = np.exp(np.where(m > 0, s, -1e9))
+        a = e / e.sum(-1, keepdims=True) * m
+        ctx = np.zeros((B, L, H, D), np.float64)
+        for i in range(L):
+            for j, o in enumerate(offs):
+                t = i + o
+                if 0 <= t < L:
+                    ctx[:, i] += a[:, i, :, j][..., None] * vn[:, t]
+        return float((ctx ** 2).sum())
+
+    q = nd.array(qn)
+    q.attach_grad()
+    with autograd.record():
+        s = nd._sldwin_atten_score(q, nd.array(kn), dil, w=w,
+                                   symmetric=True)
+        m = nd._sldwin_atten_mask_like(s, dil,
+                                       nd.array(np.int32([L])), w=w,
+                                       symmetric=True)
+        att = nd.softmax(s + (1.0 - m) * -1e9, axis=-1) * m
+        ctx = nd._sldwin_atten_context(att, nd.array(vn), dil, w=w,
+                                       symmetric=True)
+        L_ = nd.sum(ctx * ctx)
+    L_.backward()
+    g = q.grad.asnumpy()
+    eps = 1e-3
+    for pos in ((0, 0, 0, 0), (0, 3, 0, 1), (0, 5, 0, 2)):
+        qp, qm = qn.copy(), qn.copy()
+        qp[pos] += eps
+        qm[pos] -= eps
+        fd = (loss_np(qp) - loss_np(qm)) / (2 * eps)
+        np.testing.assert_allclose(g[pos], fd, rtol=3e-2, atol=3e-3,
+                                   err_msg=str(pos))
+
+
+def test_sldwin_through_symbol():
+    import mxnet_tpu.symbol as sym
+    rng = np.random.default_rng(7)
+    q = rng.standard_normal((1, 5, 1, 2)).astype(np.float32)
+    k = rng.standard_normal((1, 5, 1, 2)).astype(np.float32)
+    sq, sk, sd = sym.Variable("q"), sym.Variable("k"), sym.Variable("d")
+    y = sym._sldwin_atten_score(sq, sk, sd, w=1, symmetric=True)
+    ex = y.bind(mx.cpu(), {"q": nd.array(q), "k": nd.array(k),
+                           "d": nd.array(np.int32([1]))})
+    out = ex.forward()[0].asnumpy()
+    np.testing.assert_allclose(out, _dense_band(q, k, [1], 1, True),
+                               rtol=1e-5, atol=1e-6)
