@@ -276,3 +276,11 @@ def cond(pred, then_func, else_func):
     out = jax.lax.cond(jnp.asarray(p).reshape(()).astype(bool),
                        mk(then_func), mk(else_func), operand=None)
     return _to_nds(out, ctx)
+
+
+# ---- DGL graph-preparation family (host-side CSR ops; see dgl.py) -------
+from .dgl import (                                          # noqa: E402
+    edge_id, dgl_adjacency, dgl_subgraph, dgl_graph_compact,
+    csr_neighbor_uniform_sample as dgl_csr_neighbor_uniform_sample,
+    csr_neighbor_non_uniform_sample as dgl_csr_neighbor_non_uniform_sample,
+)

@@ -1,0 +1,248 @@
+"""DGL graph-preparation operators over CSR graphs.
+
+Reference parity: src/operator/contrib/dgl_graph.cc — the graph-sampling
+family the DGL integration drives (``edge_id``, ``dgl_adjacency``,
+``dgl_subgraph``, ``dgl_graph_compact``, the CSR neighbor samplers).
+These are HOST-side prep ops in the reference too (CPU kernels feeding
+minibatches to the accelerator); here they run on numpy over
+``CSRNDArray`` — the same sparse host plane as sparse.py — because their
+output shapes are value-dependent (sampled subgraphs), which XLA cannot
+trace.  Exposed as ``mx.nd.contrib.dgl_*`` / ``mx.nd.contrib.edge_id``,
+the reference's user-facing surface.
+
+Graph convention (reference dgl_graph.cc): a graph is a square CSR
+adjacency whose DATA entries are edge ids; vertices are row/column
+indices.
+"""
+from __future__ import annotations
+
+import numpy as _np
+
+from ..base import MXNetError
+from ..sparse import CSRNDArray
+from .ndarray import NDArray, array as nd_array
+
+__all__ = ["edge_id", "dgl_adjacency", "dgl_subgraph",
+           "dgl_graph_compact", "csr_neighbor_uniform_sample",
+           "csr_neighbor_non_uniform_sample"]
+
+
+def _host_seed() -> int:
+    """Fold the framework RNG stream into a host numpy seed, so
+    mx.random.seed() reproduces sampling like every other draw."""
+    import jax.random as jr
+    import numpy as np
+    from .. import random as _grandom
+    return int(np.asarray(jr.randint(_grandom.next_key(), (), 0,
+                                     _np.int32(2 ** 31 - 1))))
+
+
+def _check_graph(g) -> CSRNDArray:
+    if not isinstance(g, CSRNDArray):
+        raise MXNetError("DGL graph ops take a CSRNDArray adjacency")
+    if g.shape[0] != g.shape[1]:
+        raise MXNetError(f"graph CSR must be square, got {g.shape}")
+    return g
+
+
+def edge_id(g, u, v):
+    """Edge ids for vertex pairs (u[i], v[i]); -1 where no edge exists
+    (reference: _contrib_edge_id)."""
+    g = _check_graph(g)
+    uu = _np.asarray(u.asnumpy() if hasattr(u, "asnumpy") else u,
+                     _np.int64).ravel()
+    vv = _np.asarray(v.asnumpy() if hasattr(v, "asnumpy") else v,
+                     _np.int64).ravel()
+    out = _np.full(uu.shape, -1.0, _np.float32)
+    for i, (a, b) in enumerate(zip(uu, vv)):
+        lo, hi = g.indptr[a], g.indptr[a + 1]
+        cols = g.indices[lo:hi]
+        hit = _np.nonzero(cols == b)[0]
+        if hit.size:
+            out[i] = float(g.data[lo + hit[0]])
+    return nd_array(out)
+
+
+def dgl_adjacency(g):
+    """Adjacency with unit edge weights from an edge-id CSR (reference:
+    _contrib_dgl_adjacency — used to build the normalized propagation
+    matrix; structure is kept, data becomes 1.0)."""
+    g = _check_graph(g)
+    return CSRNDArray(_np.ones_like(g.data, _np.float32),
+                      g.indices.copy(), g.indptr.copy(), g.shape)
+
+
+def _induced_subgraph(g: CSRNDArray, vids: _np.ndarray,
+                      return_mapping: bool, n_rows: int = None):
+    """Rows/cols restricted to ``vids`` (order-preserving relabel),
+    vectorized (one membership test over the gathered row block — the
+    sparse.py host-pass style; this sits on the sampling hot path).
+    ``n_rows`` pads the output CSR to a FIXED square size (the
+    reference's max_num_vertices layout for sampler outputs)."""
+    n = vids.size
+    out_n = n if n_rows is None else int(n_rows)
+    # gather all selected rows' column/data spans in one pass
+    lo, hi = g.indptr[vids], g.indptr[vids + 1]
+    counts = hi - lo
+    gather = _np.concatenate(
+        [_np.arange(a, b) for a, b in zip(lo, hi)]) if n else \
+        _np.zeros(0, _np.int64)
+    cols_old = g.indices[gather]
+    eids_old = g.data[gather]
+    row_of = _np.repeat(_np.arange(n), counts)
+    # relabel: membership + new index via a parent-sized lookup table
+    lut = _np.full(g.shape[1], -1, _np.int64)
+    lut[vids] = _np.arange(n)
+    new_cols = lut[cols_old]
+    keep = new_cols >= 0
+    cols = new_cols[keep]
+    eids = eids_old[keep]
+    rows = row_of[keep]
+    indptr = _np.zeros(out_n + 1, _np.int64)
+    _np.cumsum(_np.bincount(rows, minlength=out_n), out=indptr[1:])
+    sub = CSRNDArray(
+        _np.arange(1, cols.size + 1, dtype=_np.float32),
+        cols.astype(_np.int64), indptr, (out_n, out_n))
+    if not return_mapping:
+        return sub, None
+    mapping = CSRNDArray(eids.astype(_np.float32),
+                         sub.indices.copy(), sub.indptr.copy(),
+                         (out_n, out_n))
+    return sub, mapping
+
+
+def dgl_subgraph(g, *vid_arrays, return_mapping: bool = False):
+    """Induced subgraph per vertex-id array (reference:
+    _contrib_dgl_subgraph).  Returns one relabeled subgraph CSR per
+    input array (edge ids renumbered 1..nnz), followed — when
+    ``return_mapping`` — by one mapping CSR per array whose data are the
+    PARENT edge ids in the same positions."""
+    g = _check_graph(g)
+    subs, maps = [], []
+    for va in vid_arrays:
+        vids = _np.asarray(
+            va.asnumpy() if hasattr(va, "asnumpy") else va,
+            _np.int64).ravel()
+        sub, mapping = _induced_subgraph(g, vids, return_mapping)
+        subs.append(sub)
+        if return_mapping:
+            maps.append(mapping)
+    return subs + maps
+
+
+def dgl_graph_compact(*args, return_mapping: bool = False,
+                      graph_sizes=None):
+    """Remove never-referenced trailing vertex slots from sampled
+    subgraphs (reference: _contrib_dgl_graph_compact).  ``graph_sizes``
+    gives each input's live vertex count; rows/cols beyond it are
+    dropped and edge ids renumbered."""
+    if graph_sizes is None:
+        raise MXNetError("dgl_graph_compact requires graph_sizes")
+    sizes = [int(s) for s in _np.asarray(
+        graph_sizes.asnumpy() if hasattr(graph_sizes, "asnumpy")
+        else graph_sizes).ravel()]
+    if len(sizes) != len(args):
+        raise MXNetError("graph_sizes must name one size per graph")
+    outs, maps = [], []
+    for g, n in zip(args, sizes):
+        g = _check_graph(g)
+        keep = _np.arange(n, dtype=_np.int64)
+        sub, mapping = _induced_subgraph(g, keep, return_mapping)
+        outs.append(sub)
+        if return_mapping:
+            maps.append(mapping)
+    return outs + maps
+
+
+def _neighbor_sample(g: CSRNDArray, seeds, num_hops: int,
+                     num_neighbor: int, max_num_vertices: int,
+                     probability=None, rng=None):
+    rng = rng or _np.random.default_rng()
+    seeds = _np.asarray(
+        seeds.asnumpy() if hasattr(seeds, "asnumpy") else seeds,
+        _np.int64).ravel()
+    # the vertex BUDGET covers seeds too: excess seeds are dropped (the
+    # caller sized the minibatch; overflowing the fixed layout instead
+    # would corrupt the count slot)
+    frontier = list(dict.fromkeys(int(s) for s in seeds))[
+        :max_num_vertices]
+    visited = list(frontier)
+    seen = set(frontier)
+    for _ in range(num_hops):
+        nxt = []
+        for u in frontier:
+            lo, hi = g.indptr[u], g.indptr[u + 1]
+            nbrs = g.indices[lo:hi]
+            if nbrs.size == 0:
+                continue
+            if probability is not None:
+                p = probability[nbrs]
+                tot = p.sum()
+                if tot <= 0:
+                    continue
+                # cannot draw more distinct neighbors than have mass
+                k = min(num_neighbor, int(_np.count_nonzero(p)))
+                take = rng.choice(nbrs, size=k, replace=False,
+                                  p=p / tot)
+            else:
+                take = rng.choice(nbrs,
+                                  size=min(num_neighbor, nbrs.size),
+                                  replace=False)
+            for v in take:
+                v = int(v)
+                if v not in seen and \
+                        len(visited) < max_num_vertices:
+                    seen.add(v)
+                    visited.append(v)
+                    nxt.append(v)
+        frontier = nxt
+        if not frontier:
+            break
+    vids = _np.asarray(visited, _np.int64)
+    # reference layout: the subgraph CSR is FIXED max_num_vertices-square
+    # (trailing slots empty — dgl_graph_compact removes them), and the
+    # vertex vector is max_num_vertices+1 with the live count LAST
+    sub, _ = _induced_subgraph(g, vids, return_mapping=False,
+                               n_rows=max_num_vertices)
+    padded = _np.full(max_num_vertices + 1, -1, _np.int64)
+    padded[:vids.size] = vids
+    padded[-1] = vids.size
+    return nd_array(padded), sub
+
+
+def csr_neighbor_uniform_sample(g, *seed_arrays, num_hops: int = 1,
+                                num_neighbor: int = 2,
+                                max_num_vertices: int = 100):
+    """Uniform neighborhood sampling per seed array (reference:
+    _contrib_dgl_csr_neighbor_uniform_sample).  Per input: a padded
+    vertex vector (live count in the last slot) and the induced sampled
+    subgraph CSR."""
+    g = _check_graph(g)
+    rng = _np.random.default_rng(_host_seed())
+    outs = []
+    for s in seed_arrays:
+        outs.extend(_neighbor_sample(g, s, num_hops, num_neighbor,
+                                     max_num_vertices, rng=rng))
+    return outs
+
+
+def csr_neighbor_non_uniform_sample(g, probability, *seed_arrays,
+                                    num_hops: int = 1,
+                                    num_neighbor: int = 2,
+                                    max_num_vertices: int = 100):
+    """Importance-weighted variant (reference:
+    _contrib_dgl_csr_neighbor_non_uniform_sample): per-vertex
+    ``probability`` biases neighbor choice."""
+    g = _check_graph(g)
+    p = _np.asarray(probability.asnumpy()
+                    if hasattr(probability, "asnumpy") else probability,
+                    _np.float64).ravel()
+    if p.size != g.shape[0]:
+        raise MXNetError("probability must have one entry per vertex")
+    rng = _np.random.default_rng(_host_seed())
+    outs = []
+    for s in seed_arrays:
+        outs.extend(_neighbor_sample(g, s, num_hops, num_neighbor,
+                                     max_num_vertices, probability=p,
+                                     rng=rng))
+    return outs

@@ -1,0 +1,137 @@
+"""DGL graph-op family (mx.nd.contrib.dgl_* over CSR adjacencies).
+
+Reference model: tests/python/unittest/test_dgl_graph.py semantics for
+src/operator/contrib/dgl_graph.cc — edge-id lookup, induced subgraphs
+with renumbered edges + parent mappings, compaction, and neighbor
+sampling invariants (seed inclusion, vertex budget, edge closure).
+"""
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import nd
+from mxnet_tpu.sparse import CSRNDArray
+
+
+def _ring(n=6):
+    """Directed ring + self loops; edge ids 1..nnz in row-major order."""
+    rows = []
+    for i in range(n):
+        rows.append(sorted({i, (i + 1) % n, (i - 1) % n}))
+    indptr = np.cumsum([0] + [len(r) for r in rows])
+    indices = np.concatenate(rows)
+    data = np.arange(1, indices.size + 1, dtype=np.float32)
+    return CSRNDArray(data, indices, indptr, (n, n))
+
+
+def test_edge_id():
+    g = _ring()
+    out = nd.contrib.edge_id(g, nd.array(np.int64([0, 0, 2])),
+                             nd.array(np.int64([1, 3, 1]))).asnumpy()
+    assert out[1] == -1.0                     # 0->3 absent
+    # present edges return their stored ids
+    lo, hi = g.indptr[0], g.indptr[0 + 1]
+    expect01 = g.data[lo:hi][list(g.indices[lo:hi]).index(1)]
+    assert out[0] == expect01
+    assert out[2] > 0
+
+
+def test_dgl_adjacency():
+    g = _ring()
+    adj = nd.contrib.dgl_adjacency(g)
+    assert isinstance(adj, CSRNDArray)
+    np.testing.assert_array_equal(adj.indices, g.indices)
+    np.testing.assert_array_equal(adj.indptr, g.indptr)
+    assert (adj.data == 1.0).all()
+
+
+def test_dgl_subgraph_and_mapping():
+    g = _ring(6)
+    (sub, mapping) = nd.contrib.dgl_subgraph(
+        g, nd.array(np.int64([0, 1, 2])), return_mapping=True)
+    assert sub.shape == (3, 3)
+    # edges renumbered 1..nnz
+    np.testing.assert_array_equal(sub.data,
+                                  np.arange(1, sub.nnz + 1))
+    # mapping holds parent edge ids at identical positions
+    assert mapping.nnz == sub.nnz
+    d = sub.todense().asnumpy()
+    # induced ring segment: 0<->1<->2 plus self loops
+    assert d[0, 1] > 0 and d[1, 0] > 0 and d[1, 2] > 0 and d[2, 1] > 0
+    assert d[0, 2] == 0               # 0->2 not an edge in the parent?
+    # verify every mapped id matches a parent edge_id lookup
+    rows = np.repeat(np.arange(3), np.diff(sub.indptr))
+    par = nd.contrib.edge_id(
+        g, nd.array(rows.astype(np.int64)),
+        nd.array(sub.indices.astype(np.int64))).asnumpy()
+    np.testing.assert_allclose(mapping.data, par)
+
+
+def test_dgl_graph_compact():
+    g = _ring(6)
+    (sub,) = nd.contrib.dgl_subgraph(g, nd.array(np.int64([0, 1, 2, 3])))
+    (comp,) = nd.contrib.dgl_graph_compact(
+        sub, graph_sizes=nd.array(np.int64([3])))
+    assert comp.shape == (3, 3)
+    np.testing.assert_array_equal(
+        comp.todense().asnumpy() > 0,
+        sub.todense().asnumpy()[:3, :3] > 0)
+
+
+def test_neighbor_uniform_sample():
+    mx.random.seed(5)
+    g = _ring(8)
+    verts, sub = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.int64([0, 4])), num_hops=1, num_neighbor=2,
+        max_num_vertices=6)
+    v = verts.asnumpy()
+    n_live = int(v[-1])
+    assert 2 <= n_live <= 6
+    live = v[:n_live]
+    assert 0 in live and 4 in live            # seeds always sampled
+    assert (v[n_live:-1] == -1).all()         # padding contract
+    # reference layout: sampler subgraphs are FIXED max_num_vertices
+    # square; rows past the live count are empty
+    assert sub.shape == (6, 6)
+    assert sub.indptr[n_live] == sub.indptr[-1]
+    # every sampled edge connects sampled vertices (closure)
+    assert sub.indices.max(initial=-1) < n_live
+    # determinism under the framework seed
+    mx.random.seed(5)
+    v2, _ = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.int64([0, 4])), num_hops=1, num_neighbor=2,
+        max_num_vertices=6)
+    np.testing.assert_array_equal(v, v2.asnumpy())
+
+
+def test_neighbor_non_uniform_sample():
+    mx.random.seed(9)
+    g = _ring(8)
+    prob = np.zeros(8, np.float64)
+    prob[[1, 7]] = 1.0                        # only 1 and 7 samplable
+    verts, sub = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, nd.array(prob), nd.array(np.int64([0])), num_hops=1,
+        num_neighbor=2, max_num_vertices=6)
+    v = verts.asnumpy()
+    live = set(v[:int(v[-1])].tolist())
+    assert live <= {0, 1, 7}
+
+
+def test_neighbor_sample_budget_and_sparse_probability():
+    """Seeds beyond max_num_vertices are dropped (never corrupt the
+    count slot); a vertex with fewer nonzero-probability neighbors than
+    num_neighbor samples what mass exists instead of raising."""
+    mx.random.seed(2)
+    g = _ring(8)
+    verts, sub = nd.contrib.dgl_csr_neighbor_uniform_sample(
+        g, nd.array(np.int64([0, 1, 2, 3, 4, 5])), num_hops=1,
+        num_neighbor=2, max_num_vertices=3)
+    v = verts.asnumpy()
+    assert int(v[-1]) == 3 and set(v[:3]) == {0, 1, 2}
+    assert sub.shape == (3, 3)
+    prob = np.zeros(8, np.float64)
+    prob[1] = 1.0                             # exactly one massy neighbor
+    verts2, _ = nd.contrib.dgl_csr_neighbor_non_uniform_sample(
+        g, nd.array(prob), nd.array(np.int64([0])), num_hops=1,
+        num_neighbor=3, max_num_vertices=4)
+    v2 = verts2.asnumpy()
+    assert set(v2[:int(v2[-1])].tolist()) <= {0, 1}
