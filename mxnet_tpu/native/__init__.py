@@ -61,6 +61,11 @@ def load_io():
         so = os.path.join(_DIR, "libmxtpu_io.so")
         try:
             if _stale(src, so):
+                # portable flags only: the .so is cached by mtime next to
+                # the source, so a host-tuned build (-march=native) could
+                # be loaded on a different microarchitecture and SIGILL
+                # (and measured no win here anyway - libjpeg-turbo's SIMD
+                # dominates the runtime)
                 _build(src, so, ["-ljpeg", "-lpthread"])
             lib = ctypes.CDLL(so)
         except (MXNetError, OSError, subprocess.SubprocessError) as e:
@@ -75,7 +80,7 @@ def load_io():
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
             ctypes.c_int, ctypes.c_uint64, c_float_p, c_float_p,
             ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
-            ctypes.c_char_p, ctypes.c_int]
+            ctypes.c_int, ctypes.c_char_p, ctypes.c_int]
         lib.MXTPUIONext.restype = ctypes.c_int
         lib.MXTPUIONext.argtypes = [ctypes.c_void_p, c_float_p, c_float_p]
         lib.MXTPUIONumSamples.restype = ctypes.c_int64

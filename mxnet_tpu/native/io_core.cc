@@ -65,8 +65,13 @@ void jpeg_err_exit(j_common_ptr cinfo) {
 }
 
 // Decode a JPEG stream to RGB u8 (h, w, 3).  Returns false on corrupt data.
+// ``min_side_hint`` > 0 engages libjpeg's DCT-domain scaled decode: the
+// largest 1/2^k scale whose output still keeps a 2x oversampling margin
+// over the hint (the downstream bilinear resize needs headroom to stay
+// visually equivalent to a full-resolution decode).  Decoding 1/2-scale
+// reads ~1/4 of the DCT work — the big per-image cost on the host.
 bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
-                int* oh, int* ow) {
+                int* oh, int* ow, int min_side_hint = 0) {
   jpeg_decompress_struct cinfo;
   JpegErr jerr;
   cinfo.err = jpeg_std_error(&jerr.pub);
@@ -82,6 +87,19 @@ bool DecodeJpeg(const uint8_t* buf, size_t len, std::vector<uint8_t>* out,
     return false;
   }
   cinfo.out_color_space = JCS_RGB;   // libjpeg upsamples grayscale for us
+  if (min_side_hint > 0) {
+    const int min_side = std::min(static_cast<int>(cinfo.image_height),
+                                  static_cast<int>(cinfo.image_width));
+    int denom = 1;
+    while (denom < 8 && min_side / (denom * 2) >= min_side_hint * 2)
+      denom *= 2;
+    cinfo.scale_num = 1;
+    cinfo.scale_denom = static_cast<unsigned>(denom);
+    // the fast-path decode also takes the fast IDCT: ~1-2 LSB pixel
+    // difference, meaningful decode-time cut; the exact path (hint==0,
+    // the parity-tested configuration) keeps ISLOW
+    cinfo.dct_method = JDCT_IFAST;
+  }
   jpeg_start_decompress(&cinfo);
   const int h = cinfo.output_height, w = cinfo.output_width;
   out->resize(static_cast<size_t>(h) * w * 3);
@@ -135,6 +153,7 @@ void ResizeBilinear(const uint8_t* src, int ih, int iw,
 struct Iter {
   // config
   int batch, c, h, w, resize, label_width, nthreads;
+  int decode_hint = 0;   // >0: DCT-scaled decode floor (min output side)
   bool rand_crop, rand_mirror, shuffle, round_batch;
   uint64_t seed;
   float mean[3], stdv[3];
@@ -257,7 +276,8 @@ bool Iter::DecodeOne(int i, uint64_t sample_seed) {
       for (int cc = 0; cc < 3; ++cc)
         rgb[p * 3 + cc] = px[p * ch + (cc < ch ? cc : ch - 1)];
   } else {
-    if (!DecodeJpeg(img, img_len, &rgb, &ih, &iw)) return false;
+    if (!DecodeJpeg(img, img_len, &rgb, &ih, &iw, decode_hint))
+      return false;
   }
 
   // resize shorter side
@@ -375,7 +395,7 @@ void* MXTPUIOCreate(const char* rec_path, const char* idx_path,
                     int round_batch, uint64_t seed,
                     const float* mean, const float* stdv, int label_width,
                     int part_index, int num_parts, int nthreads,
-                    char* err, int err_len) {
+                    int decode_hint, char* err, int err_len) {
   auto fail = [&](const std::string& msg) -> void* {
     std::snprintf(err, err_len, "%s", msg.c_str());
     return nullptr;
@@ -392,6 +412,7 @@ void* MXTPUIOCreate(const char* rec_path, const char* idx_path,
   it->shuffle = shuffle;
   it->round_batch = round_batch;
   it->seed = seed;
+  it->decode_hint = decode_hint;
   for (int k = 0; k < 3; ++k) {
     it->mean[k] = mean ? mean[k] : 0.f;
     it->stdv[k] = stdv ? stdv[k] : 1.f;

@@ -42,7 +42,8 @@ def test_native_matches_python_path(tmp_path):
     _make_rec(path)
     kw = dict(data_shape=(3, 224, 224), batch_size=16,
               preprocess_threads=4)
-    bn = next(iter(ImageRecordIter(path, use_native=True, **kw)))
+    bn = next(iter(ImageRecordIter(path, use_native=True,
+                                   scaled_decode=False, **kw)))
     bp = next(iter(ImageRecordIter(path, use_native=False, **kw)))
     # same libjpeg underneath → identical decode, identical center crop
     np.testing.assert_array_equal(bn.label[0].asnumpy(),
