@@ -330,5 +330,27 @@ def load_ndarray():
         lib.MXDataIterGetPadNum.restype = ctypes.c_int
         lib.MXDataIterGetPadNum.argtypes = [vp,
                                             ctypes.POINTER(ctypes.c_int)]
+        # misc runtime slice
+        lib.MXGetVersion.restype = ctypes.c_int
+        lib.MXGetVersion.argtypes = [ctypes.POINTER(ctypes.c_int)]
+        lib.MXRandomSeed.restype = ctypes.c_int
+        lib.MXRandomSeed.argtypes = [ctypes.c_int]
+        lib.MXNDArrayAt.restype = ctypes.c_int
+        lib.MXNDArrayAt.argtypes = [vp, u32, ctypes.POINTER(vp)]
+        lib.MXNDArraySlice.restype = ctypes.c_int
+        lib.MXNDArraySlice.argtypes = [vp, u32, u32, ctypes.POINTER(vp)]
+        lib.MXNDArrayReshape.restype = ctypes.c_int
+        lib.MXNDArrayReshape.argtypes = [
+            vp, ctypes.c_int, ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(vp)]
+        lib.MXNDArraySave.restype = ctypes.c_int
+        lib.MXNDArraySave.argtypes = [
+            ctypes.c_char_p, u32, ctypes.POINTER(vp),
+            ctypes.POINTER(ctypes.c_char_p)]
+        lib.MXNDArrayLoad.restype = ctypes.c_int
+        lib.MXNDArrayLoad.argtypes = [
+            ctypes.c_char_p, ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(vp)), ctypes.POINTER(u32),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
         _NDC["lib"] = lib
         return lib

@@ -354,6 +354,51 @@ class _NDCore:
     def iter_getpad(it):
         return it.pad()
 
+    # ---- misc runtime (reference c_api.cc): version / seed / views /
+    # .params-format save+load over shared handles ------------------------
+    @staticmethod
+    def version():
+        # reference encoding: major*10000 + minor*100 + patch
+        parts = (_mx.__version__.split("+")[0].split(".") + ["0", "0"])[:3]
+        nums = [int("".join(ch for ch in p if ch.isdigit()) or 0)
+                for p in parts]
+        return nums[0] * 10000 + nums[1] * 100 + nums[2]
+
+    @staticmethod
+    def random_seed(s):
+        _mx.random.seed(int(s))
+
+    @staticmethod
+    def nd_at(arr, idx):
+        return arr[int(idx)]
+
+    @staticmethod
+    def nd_slice(arr, lo, hi):
+        return arr[int(lo):int(hi)]
+
+    @staticmethod
+    def nd_reshape(arr, shape):
+        return arr.reshape(tuple(int(s) for s in shape))
+
+    @staticmethod
+    def nd_save(fname, arrs, keys):
+        if keys:
+            if len(set(keys)) != len(keys):
+                # a dict would silently drop arrays; the reference
+                # preserves every (key, array) pair
+                raise ValueError("duplicate keys in MXNDArraySave")
+            _mx.nd.save(fname, dict(zip(keys, arrs)))
+        else:
+            _mx.nd.save(fname, list(arrs))
+
+    @staticmethod
+    def nd_load(fname):
+        got = _mx.nd.load(fname)
+        if isinstance(got, dict):
+            ks = list(got.keys())
+            return ks, [got[k] for k in ks]
+        return [], list(got)
+
     # ---- CachedOp ------------------------------------------------------
     @staticmethod
     def cachedop_create(sym_obj):
@@ -1340,6 +1385,184 @@ int MXDataIterFree(void* handle) {
   PyGILState_Release(gil);
   delete h;
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Misc runtime slice (reference c_api.cc): MXGetVersion / MXRandomSeed /
+// NDArray views (At / Slice / Reshape — new handles over the SAME
+// write-through view machinery the Python frontend uses) and the
+// .params-format MXNDArraySave / MXNDArrayLoad.
+// ---------------------------------------------------------------------------
+
+extern "C" {
+
+int MXGetVersion(int* out) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  if (nd_ensure_bootstrap()) {
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "version", nullptr);
+    if (r) {
+      *out = static_cast<int>(PyLong_AsLong(r));
+      Py_DECREF(r);
+      rc = 0;
+    } else {
+      nd_set_err_from_python();
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXRandomSeed(int seed) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  if (nd_ensure_bootstrap()) {
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "random_seed", "i",
+                                      seed);
+    if (r) {
+      Py_DECREF(r);
+      rc = 0;
+    } else {
+      nd_set_err_from_python();
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+namespace {
+
+int nd_view_call(PyObject* r, void** out) {
+  if (!r) {
+    nd_set_err_from_python();
+    return -1;
+  }
+  auto* h = new NDHandle();
+  h->obj = r;
+  *out = h;
+  return 0;
+}
+
+}  // namespace
+
+int MXNDArrayAt(void* handle, uint32_t idx, void** out) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = nd_view_call(PyObject_CallMethod(
+      g_ndcore_cls, "nd_at", "OI", h->obj, idx), out);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySlice(void* handle, uint32_t lo, uint32_t hi, void** out) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = nd_view_call(PyObject_CallMethod(
+      g_ndcore_cls, "nd_slice", "OII", h->obj, lo, hi), out);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArrayReshape(void* handle, int ndim, const int* dims, void** out) {
+  auto* h = static_cast<NDHandle*>(handle);
+  PyGILState_STATE gil = PyGILState_Ensure();
+  PyObject* shp = PyTuple_New(ndim);
+  for (int i = 0; i < ndim; ++i)
+    PyTuple_SET_ITEM(shp, i, PyLong_FromLong(dims[i]));
+  int rc = nd_view_call(PyObject_CallMethod(
+      g_ndcore_cls, "nd_reshape", "OO", h->obj, shp), out);
+  Py_DECREF(shp);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXNDArraySave(const char* fname, uint32_t num_args, void** args,
+                  const char** keys) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* arrs = handle_list(args, num_args);
+    if (!arrs) {
+      nd_set_err("null NDArray handle in MXNDArraySave");
+      break;
+    }
+    PyObject* klist;
+    if (keys) {
+      klist = PyList_New(num_args);
+      for (uint32_t i = 0; i < num_args; ++i)
+        PyList_SET_ITEM(klist, i, PyUnicode_FromString(keys[i]));
+    } else {
+      klist = Py_None;
+      Py_INCREF(klist);
+    }
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "nd_save", "sOO",
+                                      fname, arrs, klist);
+    Py_DECREF(arrs);
+    Py_DECREF(klist);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    Py_DECREF(r);
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+namespace {
+// MXNDArrayLoad output scratch (reference MXAPIThreadLocalEntry): valid
+// until the thread's next Load
+thread_local std::vector<void*> g_load_handles;
+thread_local std::vector<std::string> g_load_names;
+thread_local std::vector<const char*> g_load_name_ptrs;
+}  // namespace
+
+int MXNDArrayLoad(const char* fname, uint32_t* out_size, void*** out_arr,
+                  uint32_t* out_name_size, const char*** out_names) {
+  nd_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!nd_ensure_bootstrap()) break;
+    PyObject* r = PyObject_CallMethod(g_ndcore_cls, "nd_load", "s",
+                                      fname);
+    if (!r) {
+      nd_set_err_from_python();
+      break;
+    }
+    PyObject* ks = PyTuple_GET_ITEM(r, 0);
+    PyObject* vs = PyTuple_GET_ITEM(r, 1);
+    g_load_handles.clear();
+    g_load_names.clear();
+    g_load_name_ptrs.clear();
+    for (Py_ssize_t i = 0; i < PyList_Size(vs); ++i) {
+      auto* h = new NDHandle();
+      h->obj = PyList_GET_ITEM(vs, i);
+      Py_INCREF(h->obj);
+      g_load_handles.push_back(h);
+    }
+    for (Py_ssize_t i = 0; i < PyList_Size(ks); ++i) {
+      const char* u = PyUnicode_AsUTF8(PyList_GET_ITEM(ks, i));
+      g_load_names.emplace_back(u ? u : "");
+      if (!u) PyErr_Clear();
+    }
+    for (auto& s : g_load_names) g_load_name_ptrs.push_back(s.c_str());
+    Py_DECREF(r);
+    *out_size = static_cast<uint32_t>(g_load_handles.size());
+    *out_arr = g_load_handles.data();
+    *out_name_size = static_cast<uint32_t>(g_load_name_ptrs.size());
+    *out_names = g_load_name_ptrs.data();
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
 }
 
 }  // extern "C"

@@ -1131,3 +1131,88 @@ def test_dataiter_abi_imagerecord(tmp_path):
     assert np.isfinite(buf).all() and buf.std() > 0
     lib.MXNDArrayFree(hd)          # caller-owned per reference contract
     assert lib.MXDataIterFree(it) == 0
+
+
+def test_misc_runtime_abi(tmp_path):
+    """MXGetVersion / MXRandomSeed / views (At/Slice/Reshape write
+    through to the base) / MXNDArraySave+Load .params round-trip."""
+    lib = native.load_ndarray()
+    u32, vp = ctypes.c_uint32, ctypes.c_void_p
+
+    ver = ctypes.c_int(0)
+    assert lib.MXGetVersion(ctypes.byref(ver)) == 0
+    assert ver.value >= 100                    # 0.1.0 -> 100
+
+    assert lib.MXRandomSeed(42) == 0
+
+    def make(shape_t, values):
+        sh = (u32 * len(shape_t))(*shape_t)
+        h = vp()
+        assert lib.MXNDArrayCreate(sh, len(shape_t), 1, 0, 0,
+                                   ctypes.byref(h)) == 0
+        arr = np.ascontiguousarray(values, np.float32)
+        assert lib.MXNDArraySyncCopyFromCPU(
+            h, arr.ctypes.data_as(vp), arr.size) == 0
+        return h
+
+    def read(h, shape_t):
+        buf = np.empty(shape_t, np.float32)
+        assert lib.MXNDArraySyncCopyToCPU(
+            h, buf.ctypes.data_as(vp), buf.size) == 0
+        return buf
+
+    base_np = np.arange(12, dtype=np.float32).reshape(3, 4)
+    hb = make((3, 4), base_np)
+
+    # At: row view shares storage — write through it, base sees it
+    hrow = vp()
+    assert lib.MXNDArrayAt(hb, 1, ctypes.byref(hrow)) == 0, \
+        lib.MXNDGetLastError()
+    np.testing.assert_array_equal(read(hrow, (4,)), base_np[1])
+    new_row = np.full(4, 99.0, np.float32)
+    assert lib.MXNDArraySyncCopyFromCPU(
+        hrow, new_row.ctypes.data_as(vp), 4) == 0
+    assert (read(hb, (3, 4))[1] == 99.0).all()
+
+    # Slice
+    hs = vp()
+    assert lib.MXNDArraySlice(hb, 1, 3, ctypes.byref(hs)) == 0
+    got = read(hs, (2, 4))
+    assert (got[0] == 99.0).all()
+
+    # Reshape view
+    hr = vp()
+    dims = (ctypes.c_int * 2)(4, 3)
+    assert lib.MXNDArrayReshape(hb, 2, dims, ctypes.byref(hr)) == 0
+    assert read(hr, (4, 3)).shape == (4, 3)
+
+    # Save + Load round trip (named)
+    fname = str(tmp_path / "arrs.params").encode()
+    handles = (vp * 2)(hb, hs)
+    keys = (ctypes.c_char_p * 2)(b"base", b"slice")
+    assert lib.MXNDArraySave(fname, 2, handles, keys) == 0, \
+        lib.MXNDGetLastError()
+    n_out, n_names = u32(), u32()
+    arrs = ctypes.POINTER(vp)()
+    names = ctypes.POINTER(ctypes.c_char_p)()
+    assert lib.MXNDArrayLoad(fname, ctypes.byref(n_out),
+                             ctypes.byref(arrs), ctypes.byref(n_names),
+                             ctypes.byref(names)) == 0, \
+        lib.MXNDGetLastError()
+    assert n_out.value == 2 and n_names.value == 2
+    loaded = {names[i]: arrs[i] for i in range(2)}
+    np.testing.assert_array_equal(read(loaded[b"base"], (3, 4)),
+                                  read(hb, (3, 4)))
+    # the loaded .params round-trips through the PYTHON loader too
+    import mxnet_tpu as mx2
+    d = mx2.nd.load(fname.decode())
+    assert set(d) == {"base", "slice"}
+    # loaded handles are CALLER-owned (reference contract) — free them
+    for i in range(2):
+        lib.MXNDArrayFree(arrs[i])
+    # duplicate keys must error, not silently drop arrays
+    dup = (ctypes.c_char_p * 2)(b"w", b"w")
+    assert lib.MXNDArraySave(fname, 2, handles, dup) != 0
+    assert b"duplicate" in lib.MXNDGetLastError()
+    for h in (hrow, hs, hr, hb):
+        lib.MXNDArrayFree(h)
