@@ -336,6 +336,117 @@ def _register():
     register_op("_contrib_ROIAlign", roi_align_maker,
                 aliases=("ROIAlign", "roi_align"))
 
+    # ---- DeformablePSROIPooling (Deformable ConvNets; reference:
+    # src/operator/contrib/deformable_psroi_pooling.cc).  Position-
+    # sensitive score maps (C = output_dim*group_size^2) pooled per roi
+    # bin with learned per-part offsets.  TPU-first: one vmapped
+    # gather+bilinear over a static (pooled, pooled, samples) grid —
+    # the same shape discipline as ROIAlign above; gradients (data,
+    # rois-stop, trans) come from autodiff. ------------------------------
+    def deformable_psroi_maker(spatial_scale=1.0, output_dim=1,
+                               group_size=1, pooled_size=7,
+                               part_size=0, sample_per_part=1,
+                               trans_std=0.0, no_trans=False):
+        ps = int(pooled_size)
+        gs = int(group_size)
+        pt = int(part_size) or ps
+        sp = max(int(sample_per_part), 1)
+        d_out = int(output_dim)
+
+        def fn(data, rois, *trans_opt):
+            b, c, h, w = data.shape
+            trans = trans_opt[0] if trans_opt and not no_trans else None
+
+            # bin -> position-sensitive group / offset-part index (static)
+            gi = jnp.clip((jnp.arange(ps) * gs) // ps, 0, gs - 1)
+            pi = jnp.clip((jnp.arange(ps) * pt) // ps, 0, pt - 1)
+
+            def one(roi, tr):
+                bidx = roi[0].astype(jnp.int32)
+                # reference rounding: rois snap to the input grid, 0.5
+                # border (deformable_psroi_pooling.cc coordinate setup)
+                x1 = jnp.round(roi[1]) * spatial_scale - 0.5
+                y1 = jnp.round(roi[2]) * spatial_scale - 0.5
+                x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
+                y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+                rw = jnp.maximum(x2 - x1, 0.1)
+                rh = jnp.maximum(y2 - y1, 0.1)
+                bin_h, bin_w = rh / ps, rw / ps
+                sub_h, sub_w = bin_h / sp, bin_w / sp
+
+                if trans is not None:
+                    # offset channel PAIRS are (x, y) per class (the
+                    # reference reads trans_x at 2*class, trans_y at
+                    # 2*class+1); output channel c belongs to class
+                    # c // (output_dim / num_classes)
+                    n_cls = tr.shape[0] // 2
+                    per_cls = max(d_out // max(n_cls, 1), 1)
+                    cls_of = jnp.arange(d_out) // per_cls     # (D,)
+                    dx_all = tr[0::2][:, pi[:, None], pi[None, :]] \
+                        * trans_std * rw                      # (ncls,ps,ps)
+                    dy_all = tr[1::2][:, pi[:, None], pi[None, :]] \
+                        * trans_std * rh
+                    dx = dx_all[cls_of]                       # (D,ps,ps)
+                    dy = dy_all[cls_of]
+                else:
+                    dy = jnp.zeros((d_out, ps, ps), data.dtype)
+                    dx = jnp.zeros((d_out, ps, ps), data.dtype)
+
+                iy = jnp.arange(ps, dtype=jnp.float32)
+                off = jnp.arange(sp, dtype=jnp.float32)
+                # (D, ps, ps, sp) sample coordinates per class and bin —
+                # reference grid: wstart + iw*sub_bin (no half-sample
+                # centering, unlike ROIAlign)
+                ys = (y1 + iy[None, :, None, None] * bin_h
+                      + dy[:, :, :, None]
+                      + off[None, None, None, :] * sub_h)
+                xs = (x1 + iy[None, None, :, None] * bin_w
+                      + dx[:, :, :, None]
+                      + off[None, None, None, :] * sub_w)
+                full = (d_out, ps, ps, sp, sp)
+                ysb = jnp.broadcast_to(ys[..., :, None], full)
+                xsb = jnp.broadcast_to(xs[..., None, :], full)
+                valid = ((ysb > -0.5) & (ysb < h - 0.5) &
+                         (xsb > -0.5) & (xsb < w - 0.5))
+                yc = jnp.clip(ysb, 0.0, h - 1.0)
+                xc = jnp.clip(xsb, 0.0, w - 1.0)
+                y0 = jnp.floor(yc)
+                x0 = jnp.floor(xc)
+                y0i = y0.astype(jnp.int32)
+                x0i = x0.astype(jnp.int32)
+                y1i = jnp.clip(y0i + 1, 0, h - 1)
+                x1i = jnp.clip(x0i + 1, 0, w - 1)
+                ly = (yc - y0)
+                lx = (xc - x0)
+
+                # per-bin feature map: channel (c*gs + gi)*gs + gj
+                img = data[bidx].reshape(d_out, gs, gs, h, w)
+                maps = img[:, gi[:, None], gi[None, :]]  # (D,ps,ps,h,w)
+                K = jnp.arange(d_out)[:, None, None, None, None]
+                I = jnp.arange(ps)[None, :, None, None, None]
+                J = jnp.arange(ps)[None, None, :, None, None]
+                v00 = maps[K, I, J, y0i, x0i]
+                v01 = maps[K, I, J, y0i, x1i]
+                v10 = maps[K, I, J, y1i, x0i]
+                v11 = maps[K, I, J, y1i, x1i]
+                vals = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
+                        v10 * ly * (1 - lx) + v11 * ly * lx)
+                vmask = valid.astype(vals.dtype)
+                count = jnp.maximum(vmask.sum((-1, -2)), 1.0)
+                pooled = (vals * vmask).sum((-1, -2)) / count
+                any_valid = (vmask.sum((-1, -2)) > 0).astype(vals.dtype)
+                return pooled * any_valid          # (D, ps, ps)
+
+            if trans is not None:
+                return jax.vmap(one)(rois, trans)
+            dummy = jnp.zeros((rois.shape[0],), data.dtype)
+            return jax.vmap(lambda r, _:
+                            one(r, None))(rois, dummy)
+        return fn
+    register_op("_contrib_DeformablePSROIPooling",
+                deformable_psroi_maker,
+                aliases=("DeformablePSROIPooling",))
+
     # ---- ROIPooling (legacy top-level op) --------------------------------
     def roi_pooling_maker(pooled_size=(7, 7), spatial_scale=1.0):
         ph, pw = _astuple(pooled_size)

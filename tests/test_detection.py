@@ -361,3 +361,103 @@ def test_faster_rcnn_resnet_backbone_trains():
     # the BACKBONE itself must receive gradient, not just the heads
     first_conv_w = list(net.features._children.values())[0].weight
     assert np.abs(first_conv_w.grad().asnumpy()).sum() > 0
+
+
+def test_deformable_psroi_pooling():
+    """DeformablePSROIPooling vs a direct numpy reference: zero offsets
+    reduce to position-sensitive ROI pooling; nonzero offsets shift the
+    sampling window by trans_std * roi extent."""
+    import numpy as np
+    from mxnet_tpu import nd
+
+    rng = np.random.default_rng(0)
+    D, GS, PS, SP = 2, 2, 2, 2            # C = D*GS*GS = 8
+    H = W = 8
+    data = rng.standard_normal((1, D * GS * GS, H, W)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 6, 6]], np.float32)
+
+    def ref(trans, trans_std):
+        x1 = round(1) * 1.0 - 0.5
+        y1 = round(1) * 1.0 - 0.5
+        x2 = (round(6) + 1) * 1.0 - 0.5
+        y2 = (round(6) + 1) * 1.0 - 0.5
+        rw, rh = max(x2 - x1, .1), max(y2 - y1, .1)
+        bh, bw = rh / PS, rw / PS
+        out = np.zeros((1, D, PS, PS), np.float32)
+        for c in range(D):
+            for i in range(PS):
+                for j in range(PS):
+                    gi = min(i * GS // PS, GS - 1)
+                    gj = min(j * GS // PS, GS - 1)
+                    ch = (c * GS + gi) * GS + gj
+                    pi_ = min(i * PS // PS, PS - 1)
+                    pj_ = min(j * PS // PS, PS - 1)
+                    # reference channel order: trans_x at 2*cls,
+                    # trans_y at 2*cls+1 (class-agnostic: cls=0)
+                    dx = trans[0, 0, pi_, pj_] * trans_std * rw
+                    dy = trans[0, 1, pi_, pj_] * trans_std * rh
+                    acc, cnt = 0.0, 0
+                    for sy in range(SP):
+                        for sx in range(SP):
+                            # reference grid: no half-sample centering
+                            yy = y1 + i * bh + dy + sy * bh / SP
+                            xx = x1 + j * bw + dx + sx * bw / SP
+                            if yy <= -0.5 or yy >= H - 0.5 or \
+                                    xx <= -0.5 or xx >= W - 0.5:
+                                continue
+                            yy2 = min(max(yy, 0.0), H - 1.0)
+                            xx2 = min(max(xx, 0.0), W - 1.0)
+                            y0, x0 = int(yy2), int(xx2)
+                            y1_, x1_ = min(y0 + 1, H - 1), min(x0 + 1, W - 1)
+                            ly, lx = yy2 - y0, xx2 - x0
+                            v = (data[0, ch, y0, x0] * (1 - ly) * (1 - lx)
+                                 + data[0, ch, y0, x1_] * (1 - ly) * lx
+                                 + data[0, ch, y1_, x0] * ly * (1 - lx)
+                                 + data[0, ch, y1_, x1_] * ly * lx)
+                            acc += v
+                            cnt += 1
+                    out[0, c, i, j] = acc / cnt if cnt else 0.0
+        return out
+
+    # no_trans path == zero-offset reference
+    got0 = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0, output_dim=D,
+        group_size=GS, pooled_size=PS, sample_per_part=SP,
+        no_trans=True).asnumpy()
+    np.testing.assert_allclose(
+        got0, ref(np.zeros((1, 2, PS, PS), np.float32), 0.0),
+        rtol=1e-5, atol=1e-6)
+
+    # learned offsets shift the window
+    trans = rng.uniform(-1, 1, (1, 2, PS, PS)).astype(np.float32)
+    got = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans),
+        spatial_scale=1.0, output_dim=D, group_size=GS, pooled_size=PS,
+        sample_per_part=SP, trans_std=0.1).asnumpy()
+    np.testing.assert_allclose(got, ref(trans, 0.1), rtol=1e-5,
+                               atol=1e-6)
+    assert not np.allclose(got, got0)
+
+
+def test_deformable_psroi_class_aware_offsets():
+    """Per-class offset pairs: trans (R, 2*num_classes, P, P) applies
+    class c's (x, y) pair to the output channels of class c."""
+    import numpy as np
+    from mxnet_tpu import nd
+    rng = np.random.default_rng(4)
+    D, GS, PS = 2, 1, 1                    # 2 classes, 1 channel each
+    H = W = 6
+    data = rng.standard_normal((1, D, H, W)).astype(np.float32)
+    rois = np.array([[0, 1, 1, 4, 4]], np.float32)
+    # class 0: zero offset; class 1: large +x shift
+    trans = np.zeros((1, 4, PS, PS), np.float32)
+    trans[0, 2] = 5.0                      # class 1 trans_x
+    base = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), spatial_scale=1.0, output_dim=D,
+        group_size=GS, pooled_size=PS, no_trans=True).asnumpy()
+    got = nd.contrib.DeformablePSROIPooling(
+        nd.array(data), nd.array(rois), nd.array(trans),
+        spatial_scale=1.0, output_dim=D, group_size=GS, pooled_size=PS,
+        trans_std=0.1).asnumpy()
+    np.testing.assert_allclose(got[0, 0], base[0, 0], rtol=1e-6)
+    assert not np.allclose(got[0, 1], base[0, 1])
