@@ -213,6 +213,7 @@ def load_symbol():
         lib.MXSymbolFree.restype = ctypes.c_int
         lib.MXSymbolFree.argtypes = [vp]
         lib.MXSymGetLastError.restype = ctypes.c_char_p
+        _register_symbol_introspection(lib)
         _SYMC["lib"] = lib
         return lib
 
@@ -354,3 +355,20 @@ def load_ndarray():
             ctypes.POINTER(ctypes.POINTER(ctypes.c_char_p))]
         _NDC["lib"] = lib
         return lib
+
+
+def _register_symbol_introspection(lib):
+    import ctypes as ct
+    u32, vp = ct.c_uint32, ct.c_void_p
+    strs = ct.POINTER(ct.c_char_p)
+    lib.MXSymbolListAtomicSymbolCreators.restype = ct.c_int
+    lib.MXSymbolListAtomicSymbolCreators.argtypes = [
+        ct.POINTER(u32), ct.POINTER(ct.POINTER(vp))]
+    lib.MXSymbolGetAtomicSymbolName.restype = ct.c_int
+    lib.MXSymbolGetAtomicSymbolName.argtypes = [vp,
+                                                ct.POINTER(ct.c_char_p)]
+    lib.MXSymbolGetAtomicSymbolInfo.restype = ct.c_int
+    lib.MXSymbolGetAtomicSymbolInfo.argtypes = [
+        vp, ct.POINTER(ct.c_char_p), ct.POINTER(ct.c_char_p),
+        ct.POINTER(u32), ct.POINTER(strs), ct.POINTER(strs),
+        ct.POINTER(strs), ct.POINTER(ct.c_char_p)]

@@ -79,6 +79,58 @@ class _SymCore:
     def variable(name):
         return _mx.sym.Variable(name)
 
+    # ---- operator introspection (reference c_api_symbolic.cc
+    # MXSymbolListAtomicSymbolCreators / MXSymbolGetAtomicSymbolInfo):
+    # the surface the reference's language bindings read at build time
+    # to GENERATE their typed wrappers ---------------------------------
+    @staticmethod
+    def list_atomic():
+        from mxnet_tpu.ndarray.register import list_ops
+        return list_ops()
+
+    # variadic ops whose leading inputs are counted by a parameter —
+    # the reference's key_var_num_args contract (nnvm op attr)
+    _KEY_VAR_NUM_ARGS = {
+        "Concat": "num_args", "concat": "num_args",
+        "add_n": "num_args", "ElementWiseSum": "num_args",
+        "stack": "num_args",
+        "multi_sgd_update": "num_weights",
+        "multi_sgd_mom_update": "num_weights",
+        "multi_mp_sgd_mom_update": "num_weights",
+        "multi_all_finite": "num_arrays",
+    }
+
+    @staticmethod
+    def atomic_info(name):
+        import inspect
+        from mxnet_tpu.ndarray.register import get_op
+        from mxnet_tpu.symbol.register import _OP_INPUTS
+        op = get_op(name)
+        names, types = [], []
+        # tensor inputs first (reference arguments list leads with
+        # them); known arities come from the symbol-side input table,
+        # everything else is the single-"data" convention
+        for in_name in _OP_INPUTS.get(op.name, ("data",)):
+            names.append(in_name)
+            types.append("NDArray-or-Symbol")
+        try:
+            sig = inspect.signature(op.maker)
+        except (TypeError, ValueError):
+            sig = None
+        if sig is not None:
+            for p in sig.parameters.values():
+                if p.kind not in (p.POSITIONAL_OR_KEYWORD, p.KEYWORD_ONLY):
+                    continue
+                names.append(p.name)
+                if p.default is p.empty:
+                    types.append("any, required")
+                else:
+                    types.append(
+                        f"{type(p.default).__name__}, optional, "
+                        f"default={p.default!r}")
+        kv = _SymCore._KEY_VAR_NUM_ARGS.get(op.name, "")
+        return op.name, (op.doc or ""), names, types, kv
+
     @staticmethod
     def from_json(js):
         return _mx.sym.load_json(js)
@@ -517,6 +569,139 @@ int MXSymbolFree(void* handle) {
   PyGILState_Release(gil);
   delete h;
   return 0;
+}
+
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Operator introspection (reference: c_api_symbolic.cc
+// MXSymbolListAtomicSymbolCreators / GetAtomicSymbolName /
+// GetAtomicSymbolInfo) — the build-time surface language bindings read
+// to generate typed wrappers.  Creator handles are interned name
+// pointers (the op-handle discipline of the ndarray library); the info
+// call's string storage is thread-local, valid until the thread's next
+// info call.
+// ---------------------------------------------------------------------------
+
+namespace {
+
+std::vector<std::string>* g_atomic_names = nullptr;
+std::vector<const char*>* g_atomic_ptrs = nullptr;
+
+struct AtomicInfoScratch {
+  std::string name, desc, key_var;
+  std::vector<std::string> arg_names, arg_types, arg_descs;
+  std::vector<const char*> argn_ptrs, argt_ptrs, argd_ptrs;
+};
+thread_local AtomicInfoScratch g_atomic_info;
+
+}  // namespace
+
+extern "C" {
+
+int MXSymbolListAtomicSymbolCreators(uint32_t* out_size,
+                                     void*** out_array) {
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    if (!g_atomic_names) {
+      PyObject* r = PyObject_CallMethod(g_symcore_cls, "list_atomic",
+                                        nullptr);
+      if (!r) {
+        sym_set_err_from_python();
+        break;
+      }
+      g_atomic_names = new std::vector<std::string>();
+      g_atomic_ptrs = new std::vector<const char*>();
+      for (Py_ssize_t i = 0; i < PyList_Size(r); ++i) {
+        const char* u = PyUnicode_AsUTF8(PyList_GET_ITEM(r, i));
+        if (u) g_atomic_names->emplace_back(u);
+        else PyErr_Clear();
+      }
+      for (auto& s : *g_atomic_names)
+        g_atomic_ptrs->push_back(s.c_str());
+      Py_DECREF(r);
+    }
+    *out_size = static_cast<uint32_t>(g_atomic_ptrs->size());
+    *out_array = reinterpret_cast<void**>(
+        const_cast<char**>(g_atomic_ptrs->data()));
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
+}
+
+int MXSymbolGetAtomicSymbolName(void* creator, const char** name) {
+  if (!creator) {
+    sym_set_err("null creator handle");
+    return -1;
+  }
+  *name = static_cast<const char*>(creator);
+  return 0;
+}
+
+int MXSymbolGetAtomicSymbolInfo(
+    void* creator, const char** name, const char** description,
+    uint32_t* num_args, const char*** arg_names, const char*** arg_types,
+    const char*** arg_descriptions, const char** key_var_num_args) {
+  sym_ensure_python();
+  PyGILState_STATE gil = PyGILState_Ensure();
+  int rc = -1;
+  do {
+    if (!sym_ensure_bootstrap()) break;
+    PyObject* r = PyObject_CallMethod(
+        g_symcore_cls, "atomic_info", "s",
+        static_cast<const char*>(creator));
+    if (!r) {
+      sym_set_err_from_python();
+      break;
+    }
+    auto& sc = g_atomic_info;
+    sc.arg_names.clear();
+    sc.arg_types.clear();
+    sc.arg_descs.clear();
+    const char* u = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 0));
+    sc.name = u ? u : "";
+    u = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 1));
+    sc.desc = u ? u : "";
+    if (PyErr_Occurred()) PyErr_Clear();
+    const char* kvs = PyUnicode_AsUTF8(PyTuple_GET_ITEM(r, 4));
+    sc.key_var = kvs ? kvs : "";
+    if (PyErr_Occurred()) PyErr_Clear();
+    PyObject* ns = PyTuple_GET_ITEM(r, 2);
+    PyObject* ts = PyTuple_GET_ITEM(r, 3);
+    for (Py_ssize_t i = 0; i < PyList_Size(ns); ++i) {
+      const char* a = PyUnicode_AsUTF8(PyList_GET_ITEM(ns, i));
+      const char* t = PyUnicode_AsUTF8(PyList_GET_ITEM(ts, i));
+      if (PyErr_Occurred()) {
+        PyErr_Clear();
+        continue;
+      }
+      sc.arg_names.emplace_back(a ? a : "");
+      sc.arg_types.emplace_back(t ? t : "");
+      sc.arg_descs.emplace_back("");
+    }
+    Py_DECREF(r);
+    sc.argn_ptrs.clear();
+    sc.argt_ptrs.clear();
+    sc.argd_ptrs.clear();
+    for (auto& s : sc.arg_names) sc.argn_ptrs.push_back(s.c_str());
+    for (auto& s : sc.arg_types) sc.argt_ptrs.push_back(s.c_str());
+    for (auto& s : sc.arg_descs) sc.argd_ptrs.push_back(s.c_str());
+    if (name) *name = sc.name.c_str();
+    if (description) *description = sc.desc.c_str();
+    if (num_args)
+      *num_args = static_cast<uint32_t>(sc.arg_names.size());
+    if (arg_names) *arg_names = sc.argn_ptrs.data();
+    if (arg_types) *arg_types = sc.argt_ptrs.data();
+    if (arg_descriptions) *arg_descriptions = sc.argd_ptrs.data();
+    if (key_var_num_args) *key_var_num_args = sc.key_var.c_str();
+    rc = 0;
+  } while (false);
+  PyGILState_Release(gil);
+  return rc;
 }
 
 }  // extern "C"

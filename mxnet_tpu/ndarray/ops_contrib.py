@@ -363,12 +363,16 @@ def _register():
 
             def one(roi, tr):
                 bidx = roi[0].astype(jnp.int32)
-                # reference rounding: rois snap to the input grid, 0.5
-                # border (deformable_psroi_pooling.cc coordinate setup)
-                x1 = jnp.round(roi[1]) * spatial_scale - 0.5
-                y1 = jnp.round(roi[2]) * spatial_scale - 0.5
-                x2 = (jnp.round(roi[3]) + 1.0) * spatial_scale - 0.5
-                y2 = (jnp.round(roi[4]) + 1.0) * spatial_scale - 0.5
+                # reference rounding: rois snap to the input grid with C
+                # round() semantics — half-away-from-zero, which for the
+                # non-negative roi coords is floor(x + 0.5); jnp.round's
+                # half-to-even would shift .5-coordinate windows a pixel
+                def c_round(v):
+                    return jnp.floor(v + 0.5)
+                x1 = c_round(roi[1]) * spatial_scale - 0.5
+                y1 = c_round(roi[2]) * spatial_scale - 0.5
+                x2 = (c_round(roi[3]) + 1.0) * spatial_scale - 0.5
+                y2 = (c_round(roi[4]) + 1.0) * spatial_scale - 0.5
                 rw = jnp.maximum(x2 - x1, 0.1)
                 rh = jnp.maximum(y2 - y1, 0.1)
                 bin_h, bin_w = rh / ps, rw / ps
@@ -419,23 +423,25 @@ def _register():
                 ly = (yc - y0)
                 lx = (xc - x0)
 
-                # per-bin feature map: channel (c*gs + gi)*gs + gj
-                img = data[bidx].reshape(d_out, gs, gs, h, w)
-                maps = img[:, gi[:, None], gi[None, :]]  # (D,ps,ps,h,w)
-                K = jnp.arange(d_out)[:, None, None, None, None]
-                I = jnp.arange(ps)[None, :, None, None, None]
-                J = jnp.arange(ps)[None, None, :, None, None]
-                v00 = maps[K, I, J, y0i, x0i]
-                v01 = maps[K, I, J, y0i, x1i]
-                v10 = maps[K, I, J, y1i, x0i]
-                v11 = maps[K, I, J, y1i, x1i]
+                # flat channel index per (class, bin): (c*gs+gi)*gs+gj
+                # — gathered DIRECTLY from (C, H, W), never materializing
+                # the (D, ps, ps, H, W) per-bin map stack (which at
+                # R-FCN scale would be gigabytes per roi batch)
+                imgC = data[bidx]                  # (C, H, W)
+                ch = ((jnp.arange(d_out)[:, None, None] * gs
+                       + gi[None, :, None]) * gs
+                      + gi[None, None, :])         # (D, ps, ps)
+                chb = ch[:, :, :, None, None]      # (D,ps,ps,1,1)
+                v00 = imgC[chb, y0i, x0i]
+                v01 = imgC[chb, y0i, x1i]
+                v10 = imgC[chb, y1i, x0i]
+                v11 = imgC[chb, y1i, x1i]
                 vals = (v00 * (1 - ly) * (1 - lx) + v01 * (1 - ly) * lx +
                         v10 * ly * (1 - lx) + v11 * ly * lx)
                 vmask = valid.astype(vals.dtype)
+                # count clamp makes empty bins exact zeros already
                 count = jnp.maximum(vmask.sum((-1, -2)), 1.0)
-                pooled = (vals * vmask).sum((-1, -2)) / count
-                any_valid = (vmask.sum((-1, -2)) > 0).astype(vals.dtype)
-                return pooled * any_valid          # (D, ps, ps)
+                return (vals * vmask).sum((-1, -2)) / count  # (D,ps,ps)
 
             if trans is not None:
                 return jax.vmap(one)(rois, trans)

@@ -1216,3 +1216,55 @@ def test_misc_runtime_abi(tmp_path):
     assert b"duplicate" in lib.MXNDGetLastError()
     for h in (hrow, hs, hr, hb):
         lib.MXNDArrayFree(h)
+
+
+def test_symbol_introspection_abi():
+    """MXSymbolListAtomicSymbolCreators / GetAtomicSymbolName /
+    GetAtomicSymbolInfo — the wrapper-generation surface the reference's
+    language bindings read at build time."""
+    lib = native.load_symbol()
+    u32, vp = ctypes.c_uint32, ctypes.c_void_p
+    n = u32()
+    creators = ctypes.POINTER(vp)()
+    assert lib.MXSymbolListAtomicSymbolCreators(
+        ctypes.byref(n), ctypes.byref(creators)) == 0, \
+        lib.MXSymGetLastError()
+    assert n.value >= 400
+    names = [ctypes.cast(creators[i], ctypes.c_char_p).value
+             for i in range(n.value)]
+    assert b"Convolution" in names and b"sgd_update" in names
+
+    idx = names.index(b"Convolution")
+    got = ctypes.c_char_p()
+    assert lib.MXSymbolGetAtomicSymbolName(creators[idx],
+                                           ctypes.byref(got)) == 0
+    assert got.value == b"Convolution"
+
+    name = ctypes.c_char_p()
+    desc = ctypes.c_char_p()
+    num_args = u32()
+    strs = ctypes.POINTER(ctypes.c_char_p)
+    argn, argt, argd = strs(), strs(), strs()
+    kv = ctypes.c_char_p()
+    assert lib.MXSymbolGetAtomicSymbolInfo(
+        creators[idx], ctypes.byref(name), ctypes.byref(desc),
+        ctypes.byref(num_args), ctypes.byref(argn), ctypes.byref(argt),
+        ctypes.byref(argd), ctypes.byref(kv)) == 0, \
+        lib.MXSymGetLastError()
+    assert name.value == b"Convolution"
+    args = [argn[i] for i in range(num_args.value)]
+    types = [argt[i] for i in range(num_args.value)]
+    # tensor inputs lead (reference arguments convention), then params
+    assert args[:3] == [b"data", b"weight", b"bias"]
+    assert types[0] == b"NDArray-or-Symbol"
+    assert b"kernel" in args and b"num_filter" in args
+    # required/optional annotations derived from maker defaults
+    assert any(t.startswith(b"any, required") or b"optional" in t
+               for t in types)
+    # variadic marker (reference key_var_num_args contract)
+    idx_c = names.index(b"concat")
+    assert lib.MXSymbolGetAtomicSymbolInfo(
+        creators[idx_c], ctypes.byref(name), ctypes.byref(desc),
+        ctypes.byref(num_args), ctypes.byref(argn), ctypes.byref(argt),
+        ctypes.byref(argd), ctypes.byref(kv)) == 0
+    assert kv.value == b"num_args"
