@@ -24,7 +24,9 @@ from ...base import MXNetError
 from ..block import HybridBlock
 from ..nn import Dense, Dropout, Embedding, HybridSequential, LayerNorm
 
-__all__ = ["MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
+__all__ = ["SlidingWindowSelfAttention", "LongformerEncoderCell",
+           "LongformerEncoder",
+           "MultiHeadAttention", "PositionwiseFFN", "TransformerEncoderCell",
            "TransformerDecoderCell", "TransformerEncoder",
            "TransformerDecoder", "TransformerNMT", "BERTEncoder",
            "BERTModel", "bert_base", "bert_small", "transformer_nmt_base",
@@ -547,3 +549,130 @@ def bert_small(vocab_size=1000, **kwargs):
 def transformer_nmt_base(vocab_size=32000, **kwargs):
     return TransformerNMT(vocab_size, num_layers=6, units=512,
                           hidden_size=2048, num_heads=8, **kwargs)
+
+
+class SlidingWindowSelfAttention(HybridBlock):
+    """Longformer-style banded self-attention over the sliding-window op
+    trio (reference family: src/operator/contrib/transformer.cc
+    _sldwin_atten_*).
+
+    Memory is O(L·W) per head instead of O(L²): scores, mask, and
+    context all live in the (B, L, H, 2w+1) band, so sequence length
+    scales linearly — the single-chip long-context complement to the
+    ring/sequence-parallel path in ``parallel/ring.py``.  Layout
+    follows the reference ops: (B, L, H, D) head tensors, per-head
+    dilation, symmetric window of one-sided width ``w``."""
+
+    def __init__(self, units, num_heads, w, dilation=None, dropout=0.0,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        self._w = int(w)
+        self._dilation = tuple(dilation) if dilation is not None else \
+            (1,) * num_heads
+        if len(self._dilation) != num_heads:
+            raise MXNetError("dilation needs one entry per head")
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, in_units=units,
+                             prefix="qkv_")
+            self.proj = Dense(units, flatten=False, in_units=units,
+                              prefix="proj_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, valid_len=None):
+        b, l = x.shape[0], x.shape[1]
+        d = self._units // self._heads
+        qkv = self.qkv(x)
+        q, k, v = F.split(qkv, num_outputs=3, axis=-1)
+        # (B, L, H, D) — the sldwin op layout
+        q = F.reshape(q, shape=(b, l, self._heads, d))
+        k = F.reshape(k, shape=(b, l, self._heads, d))
+        v = F.reshape(v, shape=(b, l, self._heads, d))
+        scale = 1.0 / math.sqrt(d)
+        if not hasattr(F, "array"):
+            raise MXNetError(
+                "SlidingWindowSelfAttention supports the imperative/"
+                "hybridize path; compose the _sldwin_atten_* ops "
+                "directly for hand-built Symbol graphs")
+        import numpy as _np
+        dil = F.array(_np.asarray(self._dilation, _np.int32))
+        if valid_len is None:
+            valid_len = F.full((b,), l)
+        s = F._sldwin_atten_score(q, k, dil, w=self._w,
+                                  symmetric=True) * scale
+        m = F._sldwin_atten_mask_like(s, dil, valid_len, w=self._w,
+                                      symmetric=True)
+        att = F.softmax(s + (1.0 - m) * -1e9, axis=-1) * m
+        if self.drop is not None:
+            att = self.drop(att)
+        ctx = F._sldwin_atten_context(att, v, dil, w=self._w,
+                                      symmetric=True)
+        return self.proj(F.reshape(ctx, shape=(b, l, self._units)))
+
+
+class LongformerEncoderCell(HybridBlock):
+    """Post-LN encoder layer with banded self-attention."""
+
+    def __init__(self, units, hidden_size, num_heads, w, dilation=None,
+                 dropout=0.0, activation="gelu", prefix=None,
+                 params=None):
+        super().__init__(prefix=prefix, params=params)
+        with self.name_scope():
+            self.attn = SlidingWindowSelfAttention(
+                units, num_heads, w, dilation, dropout, prefix="attn_")
+            self.ln1 = LayerNorm(in_channels=units, prefix="ln1_")
+            self.ffn = PositionwiseFFN(units, hidden_size, dropout,
+                                       activation, prefix="ffn_")
+            self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
+            self.drop = Dropout(dropout) if dropout else None
+
+    def hybrid_forward(self, F, x, valid_len=None):
+        a = self.attn(x, valid_len)
+        if self.drop is not None:
+            a = self.drop(a)
+        x = self.ln1(x + a)
+        f = self.ffn(x)
+        if self.drop is not None:
+            f = self.drop(f)
+        return self.ln2(x + f)
+
+
+class LongformerEncoder(HybridBlock):
+    """Token+position embedding over N banded encoder layers — the
+    long-sequence encoder family (Longformer): O(L·w) attention admits
+    sequence lengths the dense BERT encoder cannot hold."""
+
+    def __init__(self, vocab_size, num_layers=2, units=64,
+                 hidden_size=128, num_heads=4, w=32, dilation=None,
+                 max_length=4096, dropout=0.0, prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        with self.name_scope():
+            self.tok = Embedding(vocab_size, units, prefix="tok_")
+            self.pos = Embedding(max_length, units, prefix="pos_")
+            self.layers = HybridSequential(prefix="layers_")
+            with self.layers.name_scope():
+                for _ in range(num_layers):
+                    self.layers.add(LongformerEncoderCell(
+                        units, hidden_size, num_heads, w, dilation,
+                        dropout))
+            self.ln = LayerNorm(in_channels=units, prefix="ln_")
+        # same cell objects, public iteration order: valid_len must
+        # thread through each cell, which Sequential's own __call__
+        # cannot do
+        self._cells = [c for c in self.layers]
+
+    def hybrid_forward(self, F, tokens, valid_len=None):
+        b, l = tokens.shape[0], tokens.shape[1]
+        import numpy as _np
+        pos_ids = F.array(_np.arange(l, dtype=_np.int64))
+        h = self.tok(tokens) + F.reshape(
+            self.pos(pos_ids), shape=(1, l, self._units))
+        h = self.ln(h)
+        for cell in self._cells:
+            h = cell(h, valid_len)
+        return h

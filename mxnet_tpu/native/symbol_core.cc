@@ -108,9 +108,27 @@ class _SymCore:
         op = get_op(name)
         names, types = [], []
         # tensor inputs first (reference arguments list leads with
-        # them); known arities come from the symbol-side input table,
-        # everything else is the single-"data" convention
-        for in_name in _OP_INPUTS.get(op.name, ("data",)):
+        # them).  Structured ops come from the symbol-side input table;
+        # for the rest the REAL arity is read off the maker's returned
+        # fn signature (this registry's single source of truth) — never
+        # fabricated.  Ops whose maker needs required params yield no
+        # input metadata rather than a guess; *args fns report the
+        # variadic marker.
+        inputs = _OP_INPUTS.get(op.name)
+        if inputs is None:
+            try:
+                fn = op.maker()
+                fps = list(inspect.signature(fn).parameters.values())
+                if any(p.kind == p.VAR_POSITIONAL for p in fps):
+                    inputs = ("*data",)
+                else:
+                    inputs = tuple(
+                        p.name for p in fps
+                        if p.kind in (p.POSITIONAL_ONLY,
+                                      p.POSITIONAL_OR_KEYWORD))
+            except Exception:
+                inputs = ()
+        for in_name in inputs:
             names.append(in_name)
             types.append("NDArray-or-Symbol")
         try:
