@@ -30,6 +30,11 @@ FP32_OPS = [
     "gamma", "gammaln", "erf", "erfinv",
     "square", "sqrt", "rsqrt", "cbrt", "rcbrt", "reciprocal",
     "smooth_l1", "make_loss", "power", "broadcast_power",
+    # round-5 tail: ops whose math runs through exp/log ladders where
+    # bf16's 8-bit mantissa visibly degrades (same rationale as softmax)
+    "logsumexp", "masked_log_softmax", "masked_softmax",
+    "erfc", "erfcinv", "gammainc", "gammaincc", "zeta", "polygamma",
+    "bessel_i0", "bessel_i1", "bessel_i0e", "bessel_i1e",
 ]
 
 # note: LP16 takes precedence over WIDEST in both the hook and
