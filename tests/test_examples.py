@@ -55,3 +55,10 @@ def test_example_bert_pretrain_runs():
     first = float(lines[0].split()[-1])
     last = float(lines[-1].split()[-1])
     assert last < first, (first, last)
+
+
+def test_example_longformer_longctx_runs():
+    r = _run(["examples/train_longformer_longctx.py", "--steps", "6",
+              "--seq", "256"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "done" in r.stdout
