@@ -23,6 +23,32 @@ _F32, _I64 = 1, 7
 _ACT = {"relu": "Relu", "sigmoid": "Sigmoid", "tanh": "Tanh",
         "softrelu": "Softplus", "softsign": "Softsign"}
 
+# standalone elementwise ops with 1:1 ONNX duals (opset 12)
+_UNARY_EXPORT = {
+    "exp": "Exp", "log": "Log", "sqrt": "Sqrt", "tanh": "Tanh",
+    "sigmoid": "Sigmoid", "abs": "Abs", "negative": "Neg",
+    "floor": "Floor", "ceil": "Ceil", "round": "Round", "erf": "Erf",
+    "relu": "Relu", "softsign": "Softsign", "sign": "Sign",
+    "reciprocal": "Reciprocal",
+}
+_BINARY_EXPORT = {
+    "broadcast_add": "Add", "broadcast_sub": "Sub",
+    "broadcast_mul": "Mul", "broadcast_div": "Div",
+    "broadcast_maximum": "Max", "broadcast_minimum": "Min",
+    "broadcast_power": "Pow",
+    # same-shape alias spellings (graphs built via the elemwise names)
+    "elemwise_add": "Add", "_plus": "Add", "_add": "Add",
+    "elemwise_sub": "Sub", "_sub": "Sub",
+    "elemwise_mul": "Mul", "_mul": "Mul",
+}
+# LeakyReLU act_type -> (ONNX op, alpha-attr default); gelu needs opset
+# >= 20 and is rejected explicitly rather than silently mistranslated
+# alpha defaults MUST match the executor's slope defaults (ops_nn.py
+# leaky_maker): exporting ONNX's usual 1.0 for an attr-less elu node
+# would silently change numerics
+_LEAKY_EXPORT = {"leaky": ("LeakyRelu", 0.25), "elu": ("Elu", 0.25),
+                 "selu": ("Selu", None)}
+
 
 def _attr(node_attrs, key, default=None):
     v = node_attrs.get(key, default)
@@ -198,12 +224,6 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
         elif op in ("Flatten", "flatten"):
             nodes_pb.append(_node("Flatten", ins, outs, node.name,
                                   _a_int("axis", 1)))
-        elif op in ("elemwise_add", "broadcast_add", "_plus", "_add"):
-            nodes_pb.append(_node("Add", ins, outs, node.name))
-        elif op in ("elemwise_mul", "broadcast_mul", "_mul"):
-            nodes_pb.append(_node("Mul", ins, outs, node.name))
-        elif op in ("elemwise_sub", "broadcast_sub", "_sub"):
-            nodes_pb.append(_node("Sub", ins, outs, node.name))
         elif op in ("Concat", "concat"):
             nodes_pb.append(_node("Concat", ins, outs, node.name,
                                   _a_int("axis", _attr(a, "dim", 1))))
@@ -231,6 +251,29 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
             attrs += _a_ints("shape", _attr(a, "shape", (1,))) + \
                 _a_int("dtype", _RAND_DT[dt])
             nodes_pb.append(_node(onnx_op, [], outs, node.name, attrs))
+        elif op in _UNARY_EXPORT:
+            nodes_pb.append(_node(_UNARY_EXPORT[op], ins, outs,
+                                  node.name))
+        elif op in _BINARY_EXPORT:
+            nodes_pb.append(_node(_BINARY_EXPORT[op], ins, outs,
+                                  node.name))
+        elif op == "transpose":
+            axes = _attr(a, "axes", None)
+            attrs = _a_ints("perm", axes) if axes else b""
+            nodes_pb.append(_node("Transpose", ins, outs, node.name,
+                                  attrs))
+        elif op == "LeakyReLU":
+            act = _attr(a, "act_type", "leaky")
+            if act not in _LEAKY_EXPORT:
+                raise MXNetError(
+                    f"ONNX export: LeakyReLU act_type {act!r} has no "
+                    f"opset-{_OPSET} translation")
+            onnx_op, alpha_dflt = _LEAKY_EXPORT[act]
+            attrs = b""
+            if alpha_dflt is not None:
+                attrs = _a_float("alpha",
+                                 float(_attr(a, "slope", alpha_dflt)))
+            nodes_pb.append(_node(onnx_op, ins, outs, node.name, attrs))
         elif op in ("Reshape", "reshape"):
             shp = _np.asarray(_attr(a, "shape"), _np.int64)
             sname = f"{node.name}_shape{extra[0]}"

@@ -11,6 +11,14 @@ import numpy as _np
 from ...base import MXNetError
 from . import _proto as P
 
+_UNARY_REV = {
+    "Exp": "exp", "Log": "log", "Sqrt": "sqrt", "Abs": "abs",
+    "Neg": "negative", "Floor": "floor", "Ceil": "ceil",
+    "Round": "round", "Erf": "erf", "Sign": "sign",
+    "Reciprocal": "reciprocal",
+}
+_BINARY_REV = {"Div": "broadcast_div", "Max": "broadcast_maximum",
+               "Min": "broadcast_minimum", "Pow": "broadcast_power"}
 _ACT_REV = {"Relu": "relu", "Sigmoid": "sigmoid", "Tanh": "tanh",
             "Softplus": "softrelu", "Softsign": "softsign"}
 
@@ -203,6 +211,35 @@ def import_model(model_file: str):
                 node = emit("_random_normal", name,
                             dict(common, loc=a.get("mean", 0.0),
                                  scale=a.get("scale", 1.0)), [])
+        elif op_type in _UNARY_REV:
+            node = emit(_UNARY_REV[op_type], name, {}, in_names)
+        elif op_type in _BINARY_REV:
+            node = emit(_BINARY_REV[op_type], name, {}, in_names)
+        elif op_type == "Transpose":
+            attrs = {}
+            if "perm" in a:
+                attrs["axes"] = tuple(a["perm"])
+            node = emit("transpose", name, attrs, in_names)
+        elif op_type == "LeakyRelu":
+            node = emit("LeakyReLU", name,
+                        {"act_type": "leaky",
+                         "slope": a.get("alpha", 0.01)}, in_names)
+        elif op_type == "Elu":
+            node = emit("LeakyReLU", name,
+                        {"act_type": "elu",
+                         "slope": a.get("alpha", 1.0)}, in_names)
+        elif op_type == "Selu":
+            # the executor's selu uses the fixed paper constants; a
+            # third-party node with DIFFERENT attrs must not be silently
+            # reinterpreted
+            al = a.get("alpha", 1.67326319)
+            gm = a.get("gamma", 1.05070102)
+            if abs(al - 1.67326319) > 1e-5 or abs(gm - 1.05070102) > 1e-5:
+                raise MXNetError(
+                    f"ONNX import: Selu with non-default alpha/gamma "
+                    f"({al}, {gm}) has no executor translation")
+            node = emit("LeakyReLU", name, {"act_type": "selu"},
+                        in_names)
         elif op_type == "Softmax":
             node = emit("softmax", name, {"axis": a.get("axis", -1)},
                         in_names)

@@ -121,3 +121,71 @@ def test_onnx_random_ops_roundtrip(tmp_path):
     sym3, _, _ = mxonnx.import_model(f2)
     v = sym3.simple_bind().forward(is_train=False)[0].asnumpy()
     assert v.min() >= 2.0 and v.max() <= 3.0
+
+
+def test_onnx_elementwise_tail_roundtrip(tmp_path):
+    """The round-5 map: standalone unary duals, broadcast binary duals,
+    transpose/concat, and the LeakyReLU family translate 1:1 and
+    round-trip through the symbolic executor."""
+
+    class Tail(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc = nn.Dense(12, flatten=False)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            a = F.broadcast_add(F.exp(F.negative(F.abs(h))),
+                                F.sqrt(F.sigmoid(h)))
+            b = F.broadcast_div(a, F.broadcast_maximum(a, F.erf(a)))
+            c = F.LeakyReLU(b, act_type="elu", slope=0.7)
+            d = F.broadcast_minimum(c, a)
+            e = F.concat(F.sign(d), F.floor(F.broadcast_mul(d, d)),
+                         dim=-1)
+            return F.transpose(e, axes=(1, 0, 2))
+
+    _roundtrip(Tail(), (3, 5, 8), tmp_path)
+
+
+def test_onnx_leaky_selu_roundtrip(tmp_path):
+    class S(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc = nn.Dense(6)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            return F.LeakyReLU(h, act_type="selu") + \
+                F.LeakyReLU(h, act_type="leaky", slope=0.1) + \
+                F.LeakyReLU(h, act_type="elu")
+
+    _roundtrip(S(), (4, 7), tmp_path)
+
+
+def test_onnx_gelu_rejected_with_clear_error(tmp_path):
+    """gelu has no opset-12 dual: export must refuse loudly, not
+    mistranslate."""
+    class G(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc = nn.Dense(4)
+
+        def hybrid_forward(self, F, x):
+            return F.LeakyReLU(self.fc(x), act_type="gelu")
+
+    net = G()
+    net.initialize()
+    x = mx.nd.array(np.random.randn(2, 3).astype(np.float32))
+    net.hybridize()
+    net(x)
+    prefix = str(tmp_path / "g")
+    net.export(prefix)
+    from mxnet_tpu.base import MXNetError
+    from mxnet_tpu.contrib.onnx import export_model
+    with pytest.raises(MXNetError, match="gelu"):
+        export_model(f"{prefix}-symbol.json", f"{prefix}-0000.params",
+                     input_shape=(2, 3),
+                     onnx_file_path=str(tmp_path / "g.onnx"))
