@@ -48,6 +48,16 @@ _BINARY_EXPORT = {
 # would silently change numerics
 _LEAKY_EXPORT = {"leaky": ("LeakyRelu", 0.25), "elu": ("Elu", 0.25),
                  "selu": ("Selu", None)}
+# scalar-operand arithmetic: the scalar attr becomes a 0-d initializer
+# feeding the binary ONNX node; (op, reversed) — reversed puts the
+# scalar on the LEFT (rminus/rdiv)
+_SCALAR_EXPORT = {
+    "_plus_scalar": ("Add", False), "_minus_scalar": ("Sub", False),
+    "_rminus_scalar": ("Sub", True), "_mul_scalar": ("Mul", False),
+    "_div_scalar": ("Div", False), "_rdiv_scalar": ("Div", True),
+    "_power_scalar": ("Pow", False), "_rpower_scalar": ("Pow", True),
+    "_maximum_scalar": ("Max", False), "_minimum_scalar": ("Min", False),
+}
 
 
 def _attr(node_attrs, key, default=None):
@@ -257,6 +267,14 @@ def export_model(sym, params, input_shape=None, input_type=_np.float32,
         elif op in _BINARY_EXPORT:
             nodes_pb.append(_node(_BINARY_EXPORT[op], ins, outs,
                                   node.name))
+        elif op in _SCALAR_EXPORT:
+            onnx_op, rev = _SCALAR_EXPORT[op]
+            sval = _np.asarray(_attr(a, "scalar", 0.0), _np.float32)
+            sname = f"{node.name}_scalar{extra[0]}"
+            extra[0] += 1
+            add_init(sname, sval)
+            pair = [sname, ins[0]] if rev else [ins[0], sname]
+            nodes_pb.append(_node(onnx_op, pair, outs, node.name))
         elif op == "transpose":
             axes = _attr(a, "axes", None)
             attrs = _a_ints("perm", axes) if axes else b""

@@ -189,3 +189,20 @@ def test_onnx_gelu_rejected_with_clear_error(tmp_path):
         export_model(f"{prefix}-symbol.json", f"{prefix}-0000.params",
                      input_shape=(2, 3),
                      onnx_file_path=str(tmp_path / "g.onnx"))
+
+
+def test_onnx_scalar_ops_roundtrip(tmp_path):
+    """_*_scalar arithmetic exports as a binary node over a 0-d
+    initializer (including the reversed rminus/rdiv placements)."""
+    class Sc(mx.gluon.HybridBlock):
+        def __init__(self):
+            super().__init__()
+            with self.name_scope():
+                self.fc = nn.Dense(5)
+
+        def hybrid_forward(self, F, x):
+            h = self.fc(x)
+            return (2.0 - (h * 3.0 + 1.0)) / 4.0 + \
+                F.sqrt(F.abs(1.0 / (F.sigmoid(h) + 0.5)))
+
+    _roundtrip(Sc(), (4, 6), tmp_path)
