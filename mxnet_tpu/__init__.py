@@ -60,6 +60,7 @@ from . import gluon
 from . import parallel
 from . import symbol
 from . import symbol as sym
+from . import numpy as np          # the numpy-compatible frontend (mx.np)
 from . import module
 from . import module as mod
 from . import contrib

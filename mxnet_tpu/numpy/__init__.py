@@ -1,0 +1,283 @@
+"""``mxnet_tpu.numpy`` (mx.np): the numpy-compatible frontend.
+
+Reference parity: python/mxnet/numpy/ — the 2.x-era interface that lets
+numpy-written code run on the accelerator unchanged (SURVEY.md §2.5
+frontend tail).  Arrays ARE the framework's NDArray (autograd, device
+placement, and the op registry all apply); this module adds numpy's
+NAMES and numpy's CONVENTIONS where the legacy nd namespace deliberately
+differs:
+
+- comparisons and predicates return BOOL arrays (nd returns 0/1 in the
+  input dtype — the 1.x legacy convention);
+- ``np.random`` draws ride the same key-threading discipline as
+  ``mx.nd.random`` (seeded by ``mx.random.seed``);
+- reductions accept ``axis`` tuples and ``keepdims`` with numpy
+  defaults.
+
+Everything not wrapped here is reachable via ``mx.nd`` — the two
+frontends share the registry, so there is exactly one implementation
+per operator (the reference keeps a parallel _npi_* registry; one
+registry with two naming surfaces is the TPU-first simplification).
+"""
+from __future__ import annotations
+
+import numpy as _onp
+
+from ..ndarray import NDArray
+from ..ndarray import ndarray as _ndmod
+from .. import ndarray as _nd
+
+ndarray = NDArray
+
+__all__ = [
+    "ndarray", "array", "zeros", "ones", "full", "empty", "arange",
+    "linspace", "eye", "reshape", "transpose", "concatenate", "stack",
+    "split", "expand_dims", "squeeze", "where", "add", "subtract",
+    "multiply", "divide", "power", "mod", "dot", "matmul", "tensordot",
+    "exp", "log", "sqrt", "abs", "absolute", "sign", "maximum",
+    "minimum", "clip", "tanh", "sin", "cos", "sum", "mean", "max",
+    "min", "prod", "argmax", "argmin", "cumsum", "equal", "not_equal",
+    "greater", "greater_equal", "less", "less_equal", "logical_and",
+    "logical_or", "logical_not", "isnan", "isinf", "isfinite", "random",
+]
+
+
+def _bool(x: NDArray) -> NDArray:
+    return x.astype(_onp.bool_)
+
+
+# -- creation ---------------------------------------------------------------
+
+def array(obj, dtype=None, ctx=None):
+    return _nd.array(obj, dtype=dtype, ctx=ctx)
+
+
+def zeros(shape, dtype=None, ctx=None):
+    return _nd.zeros(shape, dtype=dtype or "float32", ctx=ctx)
+
+
+def ones(shape, dtype=None, ctx=None):
+    return _nd.ones(shape, dtype=dtype or "float32", ctx=ctx)
+
+
+def full(shape, fill_value, dtype=None, ctx=None):
+    return _nd.full(shape, fill_value, dtype=dtype, ctx=ctx)
+
+
+def empty(shape, dtype=None, ctx=None):
+    return _nd.empty(shape, dtype=dtype, ctx=ctx)
+
+
+def arange(start, stop=None, step=1, dtype=None, ctx=None):
+    return _nd.arange(start, stop, step, dtype=dtype, ctx=ctx)
+
+
+def linspace(start, stop, num=50, endpoint=True, dtype=None, ctx=None):
+    vals = _onp.linspace(start, stop, num, endpoint=endpoint,
+                         dtype=dtype or _onp.float32)
+    return _nd.array(vals, ctx=ctx)
+
+
+def eye(N, M=None, k=0, dtype=None, ctx=None):
+    return _nd.array(_onp.eye(N, M, k, dtype=dtype or _onp.float32),
+                     ctx=ctx)
+
+
+# -- manipulation -----------------------------------------------------------
+
+def reshape(a, newshape, order="C"):
+    if order != "C":
+        raise NotImplementedError(
+            "mx.np.reshape supports C order only (XLA row-major); "
+            "transpose explicitly for Fortran-order views")
+    return _nd.reshape(a, shape=newshape)
+
+
+def transpose(a, axes=None):
+    return _nd.transpose(a) if axes is None else \
+        _nd.transpose(a, axes=tuple(axes))
+
+
+def concatenate(seq, axis=0):
+    return _nd.concat(*seq, dim=axis)
+
+
+def stack(seq, axis=0):
+    return _nd.stack(*seq, axis=axis, num_args=len(seq))
+
+
+def split(a, indices_or_sections, axis=0):
+    out = _nd.split_v2(a, indices_or_sections, axis=axis)
+    return list(out) if isinstance(out, (list, tuple)) else [out]
+
+
+def expand_dims(a, axis):
+    return _nd.expand_dims(a, axis=axis)
+
+
+def squeeze(a, axis=None):
+    return _nd.squeeze(a) if axis is None else _nd.squeeze(a, axis=axis)
+
+
+def where(condition, x=None, y=None):
+    if x is None and y is None:
+        # nonzero form: host-side (value-dependent shape)
+        idx = _onp.nonzero(condition.asnumpy())
+        # int64 under enable_large_tensor(), int32 otherwise (the
+        # documented dtype contract — jax_compute_dtype applies)
+        return tuple(_nd.array(i, dtype="int64") for i in idx)
+    return _nd.where(condition.astype(x.dtype), x, y)
+
+
+# -- math -------------------------------------------------------------------
+
+add = _nd.broadcast_add
+subtract = _nd.broadcast_sub
+multiply = _nd.broadcast_mul
+divide = _nd.broadcast_div
+power = _nd.broadcast_power
+mod = _nd.broadcast_mod
+maximum = _nd.broadcast_maximum
+minimum = _nd.broadcast_minimum
+dot = _nd.dot
+tensordot = _nd.tensordot
+exp = _nd.exp
+log = _nd.log
+sqrt = _nd.sqrt
+abs = _nd.abs                                       # noqa: A001
+absolute = _nd.abs
+sign = _nd.sign
+tanh = _nd.tanh
+sin = _nd.sin
+cos = _nd.cos
+
+
+def matmul(a, b):
+    # numpy semantics: stacked matmul with BROADCAST batch dims
+    if a.ndim <= 2 and b.ndim <= 2:
+        return _nd.dot(a, b)
+    batch = _onp.broadcast_shapes(a.shape[:-2], b.shape[:-2])
+
+    def _expand(t):
+        lead = len(batch) - (t.ndim - 2)
+        if lead:
+            t = t.reshape((1,) * lead + t.shape)
+        if t.shape[:-2] != batch:
+            t = _nd.broadcast_to(t, shape=batch + t.shape[-2:])
+        return t
+
+    ae, be = _expand(a), _expand(b)
+    out = _nd.batch_dot(ae.reshape((-1,) + ae.shape[-2:]),
+                        be.reshape((-1,) + be.shape[-2:]))
+    return out.reshape(batch + (a.shape[-2], b.shape[-1]))
+
+
+def clip(a, a_min, a_max):
+    # numpy allows one-sided clipping via None bounds
+    lo = float("-inf") if a_min is None else float(a_min)
+    hi = float("inf") if a_max is None else float(a_max)
+    return _nd.clip(a, a_min=lo, a_max=hi)
+
+
+# -- reductions (numpy defaults: axis tuples, keepdims) ---------------------
+
+def _reduce(fn):
+    def f(a, axis=None, keepdims=False):
+        if axis is None:
+            return fn(a, keepdims=keepdims)
+        ax = axis if isinstance(axis, int) else tuple(axis)
+        return fn(a, axis=ax, keepdims=keepdims)
+    return f
+
+
+sum = _reduce(_nd.sum)                              # noqa: A001
+mean = _reduce(_nd.mean)
+max = _reduce(_nd.max)                              # noqa: A001
+min = _reduce(_nd.min)                              # noqa: A001
+prod = _reduce(_nd.prod)
+
+
+def argmax(a, axis=None):
+    if axis is None:
+        return _nd.argmax(_nd.reshape(a, shape=(-1,)), axis=0) \
+            .astype(_onp.int64)
+    return _nd.argmax(a, axis=axis).astype(_onp.int64)
+
+
+def argmin(a, axis=None):
+    if axis is None:
+        return _nd.argmin(_nd.reshape(a, shape=(-1,)), axis=0) \
+            .astype(_onp.int64)
+    return _nd.argmin(a, axis=axis).astype(_onp.int64)
+
+
+def cumsum(a, axis=None, dtype=None):
+    out = _nd.cumsum(a) if axis is None else _nd.cumsum(a, axis=axis)
+    return out.astype(dtype) if dtype is not None else out
+
+
+# -- comparisons / predicates (numpy: BOOL dtype) ---------------------------
+
+def _cmp(fn):
+    def f(a, b):
+        return _bool(fn(a, b))
+    return f
+
+
+equal = _cmp(_nd.broadcast_equal)
+not_equal = _cmp(_nd.broadcast_not_equal)
+greater = _cmp(_nd.broadcast_greater)
+greater_equal = _cmp(_nd.broadcast_greater_equal)
+less = _cmp(_nd.broadcast_lesser)
+less_equal = _cmp(_nd.broadcast_lesser_equal)
+logical_and = _cmp(_nd.broadcast_logical_and)
+logical_or = _cmp(_nd.broadcast_logical_or)
+
+
+def logical_not(a):
+    return _bool(_nd.logical_not(a))
+
+
+def isnan(a):
+    return _bool(_nd.isnan(a))
+
+
+def isinf(a):
+    return _bool(_nd.isinf(a))
+
+
+def isfinite(a):
+    return _bool(_nd.isfinite(a))
+
+
+# -- random -----------------------------------------------------------------
+
+class _Random:
+    """np.random over the framework key stream (mx.random.seed)."""
+
+    @staticmethod
+    def uniform(low=0.0, high=1.0, size=None, ctx=None):
+        return _nd.random.uniform(low, high,
+                                  shape=size if size is not None else (),
+                                  ctx=ctx)
+
+    @staticmethod
+    def normal(loc=0.0, scale=1.0, size=None, ctx=None):
+        return _nd.random.normal(loc, scale,
+                                 shape=size if size is not None else (),
+                                 ctx=ctx)
+
+    @staticmethod
+    def randint(low, high=None, size=None, dtype="int32", ctx=None):
+        lo, hi = (0, low) if high is None else (low, high)
+        return _nd.random.randint(lo, hi,
+                                  shape=size if size is not None else (),
+                                  dtype=dtype, ctx=ctx)
+
+    @staticmethod
+    def shuffle(a):
+        # numpy contract: in-place along axis 0
+        a[:] = _nd.random.shuffle(a)
+
+
+random = _Random()

@@ -1,0 +1,160 @@
+"""mx.np: numpy-compatible frontend parity sweep.
+
+Reference model: the python/mxnet/numpy interface's op tests — numpy
+NAMES and numpy CONVENTIONS (bool comparisons, axis-tuple reductions)
+over the shared registry.
+"""
+import numpy as onp
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import np as mnp
+
+
+def _r(seed=0, shape=(3, 4)):
+    return onp.random.default_rng(seed).standard_normal(shape) \
+        .astype(onp.float32)
+
+
+def test_creation_and_manipulation():
+    a = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    assert isinstance(a, mnp.ndarray)
+    onp.testing.assert_array_equal(mnp.zeros((2, 3)).asnumpy(),
+                                   onp.zeros((2, 3), onp.float32))
+    onp.testing.assert_array_equal(mnp.eye(3).asnumpy(), onp.eye(3))
+    onp.testing.assert_allclose(
+        mnp.linspace(0, 1, 5).asnumpy(), onp.linspace(0, 1, 5),
+        rtol=1e-6)
+    onp.testing.assert_array_equal(
+        mnp.arange(2, 10, 2).asnumpy(), onp.arange(2, 10, 2))
+    x = _r()
+    onp.testing.assert_array_equal(
+        mnp.transpose(mnp.array(x)).asnumpy(), x.T)
+    onp.testing.assert_array_equal(
+        mnp.reshape(mnp.array(x), (4, 3)).asnumpy(), x.reshape(4, 3))
+    onp.testing.assert_array_equal(
+        mnp.concatenate([mnp.array(x), mnp.array(x)], axis=1).asnumpy(),
+        onp.concatenate([x, x], axis=1))
+    onp.testing.assert_array_equal(
+        mnp.stack([mnp.array(x), mnp.array(x)], axis=0).asnumpy(),
+        onp.stack([x, x]))
+    parts = mnp.split(mnp.array(x), 2, axis=1)
+    assert len(parts) == 2 and parts[0].shape == (3, 2)
+    onp.testing.assert_array_equal(
+        mnp.expand_dims(mnp.array(x), 0).asnumpy(),
+        onp.expand_dims(x, 0))
+
+
+def test_math_and_matmul():
+    x, y = _r(1), _r(2)
+    for m, o in ((mnp.add, onp.add), (mnp.subtract, onp.subtract),
+                 (mnp.multiply, onp.multiply),
+                 (mnp.maximum, onp.maximum)):
+        onp.testing.assert_allclose(
+            m(mnp.array(x), mnp.array(y)).asnumpy(), o(x, y), rtol=1e-6)
+    onp.testing.assert_allclose(
+        mnp.dot(mnp.array(x), mnp.array(y.T)).asnumpy(), x @ y.T,
+        rtol=1e-5)
+    a = onp.random.default_rng(3).standard_normal((2, 3, 4)) \
+        .astype(onp.float32)
+    b = onp.random.default_rng(4).standard_normal((2, 4, 5)) \
+        .astype(onp.float32)
+    onp.testing.assert_allclose(
+        mnp.matmul(mnp.array(a), mnp.array(b)).asnumpy(), a @ b,
+        rtol=1e-5)
+    onp.testing.assert_allclose(
+        mnp.clip(mnp.array(x), -0.5, 0.5).asnumpy(),
+        onp.clip(x, -0.5, 0.5))
+
+
+def test_reductions_numpy_defaults():
+    x = _r(5, (2, 3, 4))
+    a = mnp.array(x)
+    onp.testing.assert_allclose(mnp.sum(a).asnumpy(), x.sum(),
+                                rtol=1e-5)
+    onp.testing.assert_allclose(
+        mnp.mean(a, axis=(0, 2)).asnumpy(), x.mean(axis=(0, 2)),
+        rtol=1e-5)
+    onp.testing.assert_allclose(
+        mnp.max(a, axis=1, keepdims=True).asnumpy(),
+        x.max(axis=1, keepdims=True))
+    onp.testing.assert_array_equal(
+        mnp.argmax(a, axis=2).asnumpy(), x.argmax(axis=2))
+    assert int(mnp.argmax(a).asnumpy()) == int(x.argmax())
+    onp.testing.assert_allclose(
+        mnp.cumsum(a, axis=1).asnumpy(), x.cumsum(axis=1), rtol=1e-5)
+
+
+def test_comparisons_return_bool():
+    x, y = _r(6), _r(7)
+    got = mnp.greater(mnp.array(x), mnp.array(y))
+    assert got.dtype == onp.bool_          # numpy convention, not 0/1
+    onp.testing.assert_array_equal(got.asnumpy(), x > y)
+    assert mnp.isnan(mnp.array(x)).dtype == onp.bool_
+    nan = mnp.array(onp.float32([1.0, onp.nan]))
+    onp.testing.assert_array_equal(mnp.isnan(nan).asnumpy(),
+                                   [False, True])
+    onp.testing.assert_array_equal(
+        mnp.logical_not(mnp.array(onp.float32([0.0, 2.0]))).asnumpy(),
+        [True, False])
+
+
+def test_where_both_forms():
+    x, y = _r(8), _r(9)
+    c = x > y
+    onp.testing.assert_array_equal(
+        mnp.where(mnp.array(c.astype(onp.float32)), mnp.array(x),
+                  mnp.array(y)).asnumpy(),
+        onp.where(c, x, y))
+    idx = mnp.where(mnp.array(c.astype(onp.float32)))
+    ref = onp.nonzero(c)
+    for g, r in zip(idx, ref):
+        onp.testing.assert_array_equal(g.asnumpy(), r)
+
+
+def test_random_rides_framework_seed():
+    mx.random.seed(3)
+    a = mnp.random.uniform(size=(4,)).asnumpy()
+    mx.random.seed(3)
+    b = mnp.random.uniform(size=(4,)).asnumpy()
+    onp.testing.assert_array_equal(a, b)
+    r = mnp.random.randint(0, 5, size=(100,)).asnumpy()
+    assert r.min() >= 0 and r.max() < 5
+
+
+def test_autograd_flows_through_np_frontend():
+    from mxnet_tpu import autograd
+    x = mnp.array([[1.0, 2.0], [3.0, 4.0]])
+    x.attach_grad()
+    with autograd.record():
+        L = mnp.sum(mnp.multiply(x, x))
+    L.backward()
+    onp.testing.assert_allclose(x.grad.asnumpy(),
+                                2 * x.asnumpy(), rtol=1e-6)
+
+
+def test_matmul_broadcast_and_clip_none():
+    a = onp.random.default_rng(1).standard_normal((3, 4)) \
+        .astype(onp.float32)
+    b = onp.random.default_rng(2).standard_normal((2, 4, 5)) \
+        .astype(onp.float32)
+    onp.testing.assert_allclose(
+        mnp.matmul(mnp.array(a), mnp.array(b)).asnumpy(), a @ b,
+        rtol=1e-5)
+    d = onp.random.default_rng(4).standard_normal((5, 4)) \
+        .astype(onp.float32)
+    onp.testing.assert_allclose(
+        mnp.matmul(mnp.array(b), mnp.array(d)).asnumpy(), b @ d,
+        rtol=1e-5)
+    c = onp.random.default_rng(3).standard_normal((1, 3, 4)) \
+        .astype(onp.float32)
+    onp.testing.assert_allclose(
+        mnp.matmul(mnp.array(c), mnp.array(b)).asnumpy(), c @ b,
+        rtol=1e-5)
+    x = mnp.array(onp.float32([-2.0, 0.0, 2.0]))
+    onp.testing.assert_array_equal(
+        mnp.clip(x, None, 1.0).asnumpy(), [-2.0, 0.0, 1.0])
+    onp.testing.assert_array_equal(
+        mnp.clip(x, -1.0, None).asnumpy(), [-1.0, 0.0, 2.0])
+    with pytest.raises(NotImplementedError):
+        mnp.reshape(x, (3, 1), order="F")
