@@ -61,6 +61,7 @@ from . import parallel
 from . import symbol
 from . import symbol as sym
 from . import numpy as np          # the numpy-compatible frontend (mx.np)
+from . import numpy_extension as npx  # DL ops for numpy-frontend code
 from . import module
 from . import module as mod
 from . import contrib

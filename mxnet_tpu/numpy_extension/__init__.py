@@ -1,0 +1,79 @@
+"""``mxnet_tpu.numpy_extension`` (mx.npx): operators beyond the numpy
+standard, surfaced for numpy-frontend code.
+
+Reference parity: python/mxnet/numpy_extension/ — the companion
+namespace holding the DEEP-LEARNING ops (softmax, activations, the NN
+layer ops, sampling) that `mx.np` deliberately keeps out of the
+numpy-named surface.  Everything here IS the registry frontend under
+its registry name; this module is the reference's naming convention,
+not a second implementation.
+
+``set_np()`` / ``reset_np()`` / ``is_np_array()`` mirror the reference
+switches.  They gate nothing here — the two frontends coexist without a
+global mode because arrays are one type — but numpy-interface code
+written against the reference calls them, so they are accepted and
+tracked.
+"""
+from __future__ import annotations
+
+import threading as _threading
+
+from .. import ndarray as _nd
+
+__all__ = ["set_np", "reset_np", "is_np_array", "softmax",
+           "log_softmax", "masked_softmax", "relu", "sigmoid",
+           "gelu", "leaky_relu", "activation", "batch_norm",
+           "layer_norm", "fully_connected", "convolution", "pooling",
+           "dropout", "embedding", "topk", "pick", "one_hot",
+           "gamma", "erf", "erfinv", "seed"]
+
+_state = _threading.local()
+
+
+def set_np(shape=True, array=True, dtype=False):
+    """Accepted for reference compatibility (numpy semantics are always
+    on for mx.np arrays here; there is no global array-type switch)."""
+    _state.np_array = bool(array)
+    _state.np_shape = bool(shape)
+    _state.np_dtype = bool(dtype)
+
+
+def reset_np():
+    set_np(False, False, False)
+
+
+def is_np_array() -> bool:
+    return getattr(_state, "np_array", False)
+
+
+# -- deep-learning ops under their reference npx names ----------------------
+
+softmax = _nd.softmax
+log_softmax = _nd.log_softmax
+masked_softmax = _nd.masked_softmax
+relu = _nd.relu
+sigmoid = _nd.sigmoid
+erf = _nd.erf
+erfinv = _nd.erfinv
+gamma = _nd.gamma
+topk = _nd.topk
+pick = _nd.pick
+one_hot = _nd.one_hot
+activation = _nd.Activation
+batch_norm = _nd.BatchNorm
+layer_norm = _nd.LayerNorm
+fully_connected = _nd.FullyConnected
+convolution = _nd.Convolution
+pooling = _nd.Pooling
+dropout = _nd.Dropout
+embedding = _nd.Embedding
+leaky_relu = _nd.LeakyReLU
+
+
+def gelu(data):
+    return _nd.gelu(data)
+
+
+def seed(s):
+    from .. import random as _r
+    _r.seed(s)

@@ -158,3 +158,22 @@ def test_matmul_broadcast_and_clip_none():
         mnp.clip(x, -1.0, None).asnumpy(), [-1.0, 0.0, 2.0])
     with pytest.raises(NotImplementedError):
         mnp.reshape(x, (3, 1), order="F")
+
+
+def test_npx_surface():
+    from mxnet_tpu import npx
+    x = mnp.array(_r(11, (4, 6)))
+    onp.testing.assert_allclose(
+        npx.softmax(x, axis=-1).asnumpy().sum(-1), onp.ones(4),
+        rtol=1e-6)
+    assert npx.relu(x).asnumpy().min() >= 0
+    g = npx.gelu(x).asnumpy()
+    assert g.shape == x.shape and onp.isfinite(g).all()
+    w = mnp.array(_r(12, (3, 6)))
+    out = npx.fully_connected(x, w, num_hidden=3, no_bias=True)
+    onp.testing.assert_allclose(out.asnumpy(),
+                                x.asnumpy() @ w.asnumpy().T, rtol=1e-5)
+    npx.set_np()
+    assert npx.is_np_array()
+    npx.reset_np()
+    assert not npx.is_np_array()
