@@ -23,6 +23,7 @@ from tests.test_kernels_tpu import *      # noqa: F401,F403
 from tests.test_ops_tail import *         # noqa: F401,F403
 from tests.test_sldwin import *           # noqa: F401,F403
 from tests.test_dgl import *              # noqa: F401,F403
+from tests.test_numpy_frontend import *   # noqa: F401,F403
 
 # test_kernels_tpu's module-level skipif mark rode in with the star
 # import; the conftest's TPU gate already covers the no-chip case, and
