@@ -4,3 +4,4 @@ here but are re-exported under the reference's import path."""
 from . import nn  # noqa: F401
 from . import cnn  # noqa: F401
 from . import rnn  # noqa: F401
+from . import estimator  # noqa: F401

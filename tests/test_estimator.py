@@ -1,0 +1,113 @@
+"""Gluon Estimator API (reference: gluon/contrib/estimator, 1.6+).
+
+fit/evaluate with the stock handler set: metric bookkeeping, logging,
+validation scheduling, checkpointing (periodic + save-best), early
+stopping, and batch/epoch stop limits.
+"""
+import logging
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon, nd
+from mxnet_tpu.gluon.contrib.estimator import (
+    CheckpointHandler, EarlyStoppingHandler, Estimator, LoggingHandler,
+    StoppingHandler)
+
+
+def _data(n=64, d=8, classes=3, seed=0):
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal((d, classes))
+    x = rng.standard_normal((n, d)).astype(np.float32)
+    y = (x @ w).argmax(-1).astype(np.float32)
+    return [(x[i:i + 16], y[i:i + 16]) for i in range(0, n, 16)]
+
+
+def _net():
+    net = gluon.nn.Sequential()
+    net.add(gluon.nn.Dense(16, activation="relu"), gluon.nn.Dense(3))
+    net.initialize(mx.init.Xavier())
+    return net
+
+
+def test_estimator_fit_and_evaluate():
+    mx.random.seed(0)
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}))
+    batches = _data()
+    est.fit(batches, epochs=15)
+    (name, acc) = est.train_metrics[0].get()
+    assert name == "accuracy" and acc > 0.75, acc
+    lname, lval = est.loss_metric.get()
+    assert lname == "loss" and np.isfinite(lval)
+    res = est.evaluate(batches)
+    assert res[0][1] > 0.75
+
+
+def test_estimator_stopping_and_logging(caplog):
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    with caplog.at_level(logging.INFO, "mxnet_tpu.estimator"):
+        est.fit(_data(), epochs=50,
+                event_handlers=[StoppingHandler(max_batch=5),
+                                LoggingHandler()])
+    assert est.processed_batches == 5
+    assert any("Training begin" in r.message for r in caplog.records)
+
+
+def test_estimator_checkpoint_best_and_early_stop(tmp_path):
+    mx.random.seed(1)
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}))
+    ck = CheckpointHandler(str(tmp_path), monitor=est.loss_metric,
+                           save_best=True, mode="min")
+    es = EarlyStoppingHandler(monitor=est.loss_metric, mode="min",
+                              patience=2, min_delta=5e-3)
+    est.fit(_data(), epochs=40, event_handlers=[ck, es])
+    assert (tmp_path / "model-best.params").exists()
+    # early stopping fired well before 40 epochs on a converged problem
+    assert est.current_epoch < 39
+    # the saved best loads back
+    net2 = _net()
+    net2.load_parameters(str(tmp_path / "model-best.params"))
+
+
+def test_estimator_rejects_non_loss():
+    with pytest.raises(mx.MXNetError):
+        Estimator(_net(), loss=lambda a, b: a)
+
+
+def test_validation_does_not_clobber_train_metrics():
+    mx.random.seed(2)
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss(),
+                    train_metrics=mx.metric.Accuracy(),
+                    trainer=gluon.Trainer(net.collect_params(), "adam",
+                                          {"learning_rate": 0.01}))
+    train = _data(seed=0)
+    val = _data(seed=99)                   # different distribution
+    est.fit(train, val_data=val, epochs=4)
+    # train metric holds the TRAIN epoch value; val clone holds val
+    t = est.train_metrics[0].get()[1]
+    v = est.val_metrics[0].get()[1]
+    assert est.train_metrics[0].num_inst == 64     # one epoch of train
+    assert est.val_metrics[0].num_inst == 64
+    assert np.isfinite(t) and np.isfinite(v)
+
+
+def test_batch_interval_logging(caplog):
+    net = _net()
+    est = Estimator(net, gluon.loss.SoftmaxCrossEntropyLoss())
+    with caplog.at_level(logging.INFO, "mxnet_tpu.estimator"):
+        est.fit(_data(), epochs=1,
+                event_handlers=[LoggingHandler(log_interval=2)])
+    assert any("[batch 2]" in r.message for r in caplog.records)
+    with pytest.raises(mx.MXNetError):
+        LoggingHandler(log_interval=0)
