@@ -139,6 +139,12 @@ def _register_np_tail():
 def _register_shape_tail():
     import jax.numpy as jnp
 
+    def einsum_maker(subscripts=""):
+        if not subscripts:
+            raise ValueError("einsum requires a subscripts string")
+        return lambda *ops: jnp.einsum(subscripts, *ops)
+    register_op("einsum", einsum_maker)
+
     def roll_maker(shift=None, axis=None):
         sh = shift if shift is None or isinstance(shift, int) \
             else tuple(shift)

@@ -30,6 +30,7 @@ from .. import ndarray as _nd
 ndarray = NDArray
 
 __all__ = [
+    "einsum", "take", "sort", "argsort", "unique",
     "ndarray", "array", "zeros", "ones", "full", "empty", "arange",
     "linspace", "eye", "reshape", "transpose", "concatenate", "stack",
     "split", "expand_dims", "squeeze", "where", "add", "subtract",
@@ -281,3 +282,29 @@ class _Random:
 
 
 random = _Random()
+
+
+def einsum(subscripts, *operands):
+    return _nd.einsum(*operands, subscripts=subscripts)
+
+
+def take(a, indices, axis=None):
+    if axis is None:
+        a = _nd.reshape(a, shape=(-1,))
+        axis = 0
+    idx = indices if isinstance(indices, NDArray) else _nd.array(indices)
+    return _nd.take(a, idx.astype(_onp.int32), axis=axis)
+
+
+def sort(a, axis=-1):
+    return _nd.sort(a, axis=axis)
+
+
+def argsort(a, axis=-1):
+    return _nd.argsort(a, axis=axis).astype(_onp.int64)
+
+
+def unique(ar):
+    # value-dependent output shape: host-side, like np.where's nonzero
+    vals = _onp.unique(ar.asnumpy())
+    return _nd.array(vals)

@@ -177,3 +177,25 @@ def test_npx_surface():
     assert npx.is_np_array()
     npx.reset_np()
     assert not npx.is_np_array()
+
+
+def test_einsum_take_sort_unique():
+    a = _r(21, (3, 4))
+    b = _r(22, (4, 5))
+    onp.testing.assert_allclose(
+        mnp.einsum("ij,jk->ik", mnp.array(a), mnp.array(b)).asnumpy(),
+        onp.einsum("ij,jk->ik", a, b), rtol=1e-5)
+    onp.testing.assert_allclose(
+        mnp.einsum("ij->j", mnp.array(a)).asnumpy(),
+        a.sum(0), rtol=1e-5)
+    onp.testing.assert_array_equal(
+        mnp.take(mnp.array(a), [2, 0], axis=1).asnumpy(),
+        onp.take(a, [2, 0], axis=1))
+    onp.testing.assert_array_equal(
+        mnp.take(mnp.array(a), [5, 1]).asnumpy(), onp.take(a, [5, 1]))
+    onp.testing.assert_array_equal(
+        mnp.sort(mnp.array(a), axis=0).asnumpy(), onp.sort(a, 0))
+    onp.testing.assert_array_equal(
+        mnp.argsort(mnp.array(a)).asnumpy(), onp.argsort(a, -1))
+    u = mnp.unique(mnp.array(onp.float32([3, 1, 3, 2, 1])))
+    onp.testing.assert_array_equal(u.asnumpy(), [1, 2, 3])
