@@ -26,6 +26,7 @@ if _prec:
     _jax.config.update("jax_default_matmul_precision", _prec)
 
 from .base import MXNetError, register_env, get_env, list_env
+from . import faults
 from .context import Context, cpu, gpu, tpu, cpu_pinned, num_gpus, num_tpus, \
     current_context
 from . import context

@@ -1,0 +1,264 @@
+"""Failure-handling primitives shared across the stack.
+
+Reference role: the reference's fault story lived in ps-lite (server-side
+replication, van retries — SURVEY.md §2.3); the TPU-native stack replaces
+the parameter server entirely, so resilience moves into the training
+supervisor (`parallel/resilience.py`) and the host-side plumbing here:
+bounded retry with exponential backoff + jitter, wall-clock deadlines, and
+a **deterministic fault-injection plan** so every recovery path is
+exercisable on CPU in tier-1 tests.
+
+Fault-plan grammar (env var ``MXTPU_FAULT_PLAN`` or :class:`FaultPlan`):
+
+    plan  := entry (';' entry)*
+    entry := kind '@' index ['x' count] [':' arg]
+
+``kind`` names an instrumented site (an open set — current sites:
+``step_error``, ``nan``, ``ckpt_fail``, ``loader_stall``, ``loader_error``),
+``index`` is the 1-based step / save / batch counter at that site,
+``xN`` repeats the entry for N consecutive indices, and ``arg`` is an
+optional float payload (e.g. stall seconds).  Each entry fires exactly
+once and is then consumed — a retried step therefore sees the fault on
+the first attempt only, which is what makes injected faults *transient*.
+
+Example::
+
+    MXTPU_FAULT_PLAN="step_error@3;nan@5;ckpt_fail@2;loader_stall@4:1.5"
+
+makes training step 3 raise :class:`TransientFault`, poisons the inputs
+of step 5 with NaN, breaks the 2nd checkpoint write, and stalls the
+dataloader worker building batch 4 for 1.5 s.
+"""
+from __future__ import annotations
+
+import os
+import random as _pyrandom
+import re
+import threading
+import time
+from typing import Callable, List, NamedTuple, Optional, Tuple, Type
+
+from .base import MXNetError
+
+__all__ = ["TransientFault", "DeadlineExceeded", "retry_call", "Deadline",
+           "call_with_deadline", "FaultSpec", "FaultPlan", "active_plan",
+           "set_fault_plan"]
+
+FAULT_PLAN_ENV = "MXTPU_FAULT_PLAN"
+
+
+class TransientFault(MXNetError):
+    """A failure that is expected to succeed on retry (injected faults,
+    flaky I/O, a coordinator that has not come up yet)."""
+
+
+class DeadlineExceeded(MXNetError):
+    """A wall-clock deadline expired before the wrapped work finished."""
+
+
+# -- retry / deadline utilities ---------------------------------------------
+
+def retry_call(fn: Callable, *args,
+               retries: int = 3,
+               base_delay: float = 0.05,
+               max_delay: float = 2.0,
+               jitter: float = 0.25,
+               retry_on: Tuple[Type[BaseException], ...] = (TransientFault,),
+               on_retry: Optional[Callable] = None,
+               deadline: Optional["Deadline"] = None,
+               sleep: Callable[[float], None] = time.sleep,
+               **kwargs):
+    """Call ``fn(*args, **kwargs)``, retrying ``retry_on`` failures with
+    exponential backoff (``base_delay * 2**attempt``, capped at
+    ``max_delay``) plus up to ``jitter`` fractional random spread so
+    co-failing workers don't stampede in lock-step.
+
+    ``on_retry(attempt, exc, delay)`` is invoked before each sleep;
+    ``deadline`` (a :class:`Deadline`) turns remaining retries off once it
+    expires.  The final failure re-raises the original exception.
+    """
+    if retries < 0:
+        raise MXNetError(f"retry_call: retries must be >= 0, got {retries}")
+    attempt = 0
+    while True:
+        try:
+            return fn(*args, **kwargs)
+        except retry_on as exc:
+            attempt += 1
+            if attempt > retries or (deadline is not None and
+                                     deadline.expired):
+                raise
+            delay = min(max_delay, base_delay * (2.0 ** (attempt - 1)))
+            if jitter:
+                delay *= 1.0 + jitter * _pyrandom.random()
+            if deadline is not None:
+                delay = min(delay, max(0.0, deadline.remaining()))
+            if on_retry is not None:
+                on_retry(attempt, exc, delay)
+            sleep(delay)
+
+
+class Deadline:
+    """A wall-clock budget shared across a sequence of operations."""
+
+    def __init__(self, timeout: float):
+        self.timeout = float(timeout)
+        self._start = time.monotonic()
+
+    def remaining(self) -> float:
+        return self.timeout - (time.monotonic() - self._start)
+
+    @property
+    def expired(self) -> bool:
+        return self.remaining() <= 0.0
+
+    def check(self, what: str = "operation") -> None:
+        if self.expired:
+            raise DeadlineExceeded(
+                f"{what} exceeded its {self.timeout:.1f}s deadline")
+
+
+def call_with_deadline(fn: Callable, timeout: float, *args, **kwargs):
+    """Run ``fn`` in a worker thread and give up after ``timeout`` seconds
+    with :class:`DeadlineExceeded`.  The abandoned thread is a daemon and
+    keeps running to completion — use this only around idempotent,
+    side-effect-light calls (connects, metadata reads), never around
+    mutation of shared state.
+    """
+    box: List = []
+
+    def _run():
+        try:
+            box.append(("ok", fn(*args, **kwargs)))
+        except BaseException as exc:   # noqa: BLE001 — re-raised below
+            box.append(("err", exc))
+
+    t = threading.Thread(target=_run, daemon=True,
+                         name="mxtpu-deadline-worker")
+    t.start()
+    t.join(timeout)
+    if not box:
+        raise DeadlineExceeded(
+            f"{getattr(fn, '__name__', fn)!r} did not finish within "
+            f"{timeout:.1f}s")
+    tag, val = box[0]
+    if tag == "err":
+        raise val
+    return val
+
+
+# -- deterministic fault injection ------------------------------------------
+
+class FaultSpec(NamedTuple):
+    kind: str
+    index: int
+    arg: Optional[float]
+
+
+_ENTRY_RE = re.compile(
+    r"^(?P<kind>[a-z][a-z0-9_]*)@(?P<idx>\d+)"
+    r"(?:x(?P<count>\d+))?(?::(?P<arg>[-+0-9.eE]+))?$")
+
+
+class FaultPlan:
+    """A deterministic schedule of injected faults.
+
+    Instrumented sites call :meth:`scheduled(kind, index)` with their
+    1-based counter; a matching entry is consumed (fires once) and
+    returned as a :class:`FaultSpec`, else ``None``.  Thread-safe — the
+    dataloader consults the plan from worker threads.
+    """
+
+    def __init__(self, spec: str = ""):
+        self._lock = threading.Lock()
+        self._entries: List[FaultSpec] = []
+        spec = (spec or "").strip()
+        if not spec:
+            return
+        for raw in re.split(r"[;,]", spec):
+            raw = raw.strip()
+            if not raw:
+                continue
+            m = _ENTRY_RE.match(raw)
+            if not m:
+                raise MXNetError(
+                    f"bad {FAULT_PLAN_ENV} entry {raw!r}: expected "
+                    f"'kind@index[xcount][:arg]' "
+                    f"(e.g. 'nan@5', 'step_error@3x2', 'loader_stall@4:1.5')")
+            kind = m.group("kind")
+            idx = int(m.group("idx"))
+            count = int(m.group("count") or 1)
+            arg = float(m.group("arg")) if m.group("arg") is not None \
+                else None
+            for k in range(count):
+                self._entries.append(FaultSpec(kind, idx + k, arg))
+
+    @classmethod
+    def from_env(cls) -> "FaultPlan":
+        return cls(os.environ.get(FAULT_PLAN_ENV, ""))
+
+    @property
+    def empty(self) -> bool:
+        with self._lock:
+            return not self._entries
+
+    def pending(self) -> List[FaultSpec]:
+        """Entries not yet fired (diagnostics / test assertions)."""
+        with self._lock:
+            return list(self._entries)
+
+    def scheduled(self, kind: str, index: int) -> Optional[FaultSpec]:
+        """Consume and return the fault scheduled for (kind, index), if
+        any.  Multiple entries at the same site fire one per call — that
+        is how 'fail N consecutive attempts' is expressed."""
+        with self._lock:
+            for i, e in enumerate(self._entries):
+                if e.kind == kind and e.index == index:
+                    return self._entries.pop(i)
+            return None
+
+    def fire(self, kind: str, index: int) -> Optional[FaultSpec]:
+        """Like :meth:`scheduled`, but raises :class:`TransientFault` when
+        a fault is due — for sites whose failure mode IS an exception."""
+        spec = self.scheduled(kind, index)
+        if spec is not None:
+            raise TransientFault(
+                f"injected fault {kind}@{index} (MXTPU_FAULT_PLAN)")
+        return None
+
+    def __repr__(self) -> str:
+        with self._lock:
+            return f"FaultPlan({self._entries!r})"
+
+
+_active_lock = threading.Lock()
+_active: Optional[FaultPlan] = None
+_active_loaded = False
+
+
+def active_plan() -> Optional[FaultPlan]:
+    """The process-global plan: explicitly set via :func:`set_fault_plan`,
+    else lazily parsed from ``MXTPU_FAULT_PLAN`` (once — consumed entries
+    must stay consumed), else ``None``."""
+    global _active, _active_loaded
+    with _active_lock:
+        if not _active_loaded:
+            _active_loaded = True
+            spec = os.environ.get(FAULT_PLAN_ENV, "").strip()
+            if spec:
+                _active = FaultPlan(spec)
+        return _active
+
+
+def set_fault_plan(plan) -> None:
+    """Install (or clear, with ``None``) the process-global fault plan.
+    Accepts a :class:`FaultPlan` or a grammar string."""
+    global _active, _active_loaded
+    if isinstance(plan, str):
+        plan = FaultPlan(plan)
+    if plan is not None and not isinstance(plan, FaultPlan):
+        raise MXNetError(f"set_fault_plan: expected FaultPlan, str or None, "
+                         f"got {type(plan).__name__}")
+    with _active_lock:
+        _active = plan
+        _active_loaded = True

@@ -6,18 +6,33 @@ TPU-native design: the consumer is one fat chip fed over PCIe, not 8 GPU
 queues, so the pipeline is a thread pool (numpy batching releases the GIL in
 decode/augment) + a bounded prefetch queue that overlaps host batching with
 device steps; batches land on device asynchronously via the NDArray layer.
+
+Failure handling: a worker exception re-raises in the consumer as an
+MXNetError naming the worker thread and batch index (never a silent epoch
+truncation), transient worker failures are retried ``worker_retries``
+times per batch, and a stalled pipeline raises after ``timeout`` seconds
+with the stuck worker→batch map instead of blocking forever.  The
+``loader_stall`` / ``loader_error`` sites of the deterministic fault plan
+(``MXTPU_FAULT_PLAN`` — see mxnet_tpu.faults) exercise both paths on CPU.
 """
 from __future__ import annotations
 
 import queue
 import threading
+import time
 from typing import Optional
 
 import numpy as _np
 
 from ...base import MXNetError
+from ...faults import TransientFault, active_plan, retry_call
 from ...ndarray import NDArray, array as nd_array
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
+
+# worker failures worth retrying: injected faults and flaky I/O — a broken
+# dataset (IndexError, bad shapes) surfaces immediately instead of N times
+_RETRYABLE_WORKER_ERRORS = (TransientFault, OSError, TimeoutError,
+                            ConnectionError)
 
 __all__ = ["DataLoader", "default_batchify_fn"]
 
@@ -48,7 +63,7 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120):
+                 thread_pool=False, timeout=120, worker_retries=0):
         self._dataset = dataset
         if batch_sampler is None:
             if batch_size is None:
@@ -63,6 +78,8 @@ class DataLoader:
         self._batch_sampler = batch_sampler
         self._batchify_fn = batchify_fn or default_batchify_fn
         self._num_workers = num_workers
+        self._timeout = timeout
+        self._worker_retries = max(0, int(worker_retries))
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * num_workers)
 
@@ -72,6 +89,38 @@ class DataLoader:
     def _make_batch(self, indices):
         samples = [self._dataset[i] for i in indices]
         return self._batchify_fn(samples)
+
+    def _worker_batch(self, batch_idx, indices, active):
+        """Build one batch in a worker thread: fault-plan hooks, bounded
+        retry on transient failures, and an error that names this worker
+        and batch on final failure."""
+        worker = threading.current_thread().name
+        active[worker] = batch_idx
+        attempts = [0]
+        try:
+            plan = active_plan()
+            if plan is not None:
+                stall = plan.scheduled("loader_stall", batch_idx + 1)
+                if stall is not None:
+                    time.sleep(stall.arg if stall.arg is not None else 30.0)
+
+            def attempt():
+                attempts[0] += 1
+                if plan is not None:
+                    plan.fire("loader_error", batch_idx + 1)
+                return self._make_batch(indices)
+
+            try:
+                return retry_call(attempt, retries=self._worker_retries,
+                                  base_delay=0.02, max_delay=1.0,
+                                  retry_on=_RETRYABLE_WORKER_ERRORS)
+            except Exception as exc:
+                raise MXNetError(
+                    f"DataLoader worker {worker!r} failed on batch "
+                    f"{batch_idx} after {attempts[0]} attempt(s): "
+                    f"{exc!r}") from exc
+        finally:
+            active.pop(worker, None)
 
     def __iter__(self):
         if self._num_workers == 0:
@@ -85,14 +134,16 @@ class DataLoader:
         q: "queue.Queue" = queue.Queue(maxsize=self._prefetch or 2)
         sentinel = object()
         window = self._num_workers + (self._prefetch or 2)
+        active: dict = {}   # worker thread name -> batch index in progress
 
         def producer():
             try:
                 with ThreadPoolExecutor(self._num_workers) as pool:
                     it = iter(self._batch_sampler)
                     inflight = collections.deque()
-                    for idx in it:
-                        inflight.append(pool.submit(self._make_batch, idx))
+                    for i, idx in enumerate(it):
+                        inflight.append(pool.submit(
+                            self._worker_batch, i, idx, active))
                         if len(inflight) >= window:
                             q.put(inflight.popleft().result())
                     while inflight:
@@ -104,10 +155,21 @@ class DataLoader:
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
+        expected = 0
         while True:
-            item = q.get()
+            try:
+                item = q.get(timeout=self._timeout)
+            except queue.Empty:
+                stuck = dict(active)
+                raise MXNetError(
+                    f"DataLoader prefetch timed out after "
+                    f"{self._timeout}s waiting for batch {expected}"
+                    + (f"; stalled workers (worker -> batch): {stuck}"
+                       if stuck else "; no worker is active — the "
+                       "producer thread may have died")) from None
             if item is sentinel:
                 break
             if isinstance(item, _WorkerError):
                 raise item.exc
             yield item
+            expected += 1

@@ -10,9 +10,10 @@ from .mesh import (MESH_AXES, ShardingRules, default_mesh, make_mesh,
 from .optim import FunctionalOptimizer, make_functional_optimizer
 from .ring import ring_attention
 from .trainer import ShardedTrainer
+from .resilience import ResilientTrainer, TrainingPreempted
 from . import dist
 
 __all__ = ["MESH_AXES", "ShardingRules", "default_mesh", "make_mesh",
            "replicated", "shard", "FunctionalOptimizer",
            "make_functional_optimizer", "ring_attention", "ShardedTrainer",
-           "dist"]
+           "ResilientTrainer", "TrainingPreempted", "dist"]

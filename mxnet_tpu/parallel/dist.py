@@ -42,12 +42,23 @@ def is_initialized() -> bool:
 
 def init_process_group(coordinator: Optional[str] = None,
                        num_processes: Optional[int] = None,
-                       process_id: Optional[int] = None) -> None:
+                       process_id: Optional[int] = None,
+                       timeout: Optional[float] = None,
+                       retries: int = 2,
+                       backoff: float = 1.0) -> None:
     """Join the multi-process runtime (idempotent).
 
     Arguments default to the reference's launcher env vars
     (``DMLC_PS_ROOT_URI:DMLC_PS_ROOT_PORT``, ``DMLC_NUM_WORKER``,
     ``DMLC_WORKER_ID``).  Raises if neither arguments nor env are present.
+
+    Failure handling (this used to hang forever on an unreachable
+    coordinator): each join attempt waits at most ``timeout`` seconds
+    (default: ``MXTPU_DIST_TIMEOUT`` env or 300), and is retried up to
+    ``retries`` times with exponential backoff starting at ``backoff``
+    seconds — under a real launcher the coordinator routinely comes up
+    AFTER the workers.  The final failure is wrapped in an
+    :class:`MXNetError` naming the coordinator and rank.
     """
     if is_initialized():
         return
@@ -64,18 +75,53 @@ def init_process_group(coordinator: Optional[str] = None,
     if num_processes == 1:
         return  # single worker: nothing to join
     if coordinator is None or num_processes is None or process_id is None:
+        missing = []
+        if coordinator is None:
+            missing.append("DMLC_PS_ROOT_URI (+ optional DMLC_PS_ROOT_PORT)")
+        if num_processes is None:
+            missing.append("DMLC_NUM_WORKER")
+        if process_id is None:
+            missing.append("DMLC_WORKER_ID")
         raise MXNetError(
             "multi-process kvstore requires the process group to be "
-            "initialized: set DMLC_PS_ROOT_URI/DMLC_PS_ROOT_PORT/"
-            "DMLC_NUM_WORKER/DMLC_WORKER_ID (reference launcher env vars) "
-            "or call mxnet_tpu.parallel.dist.init_process_group("
-            "coordinator, num_processes, process_id) before "
-            "kv.create('dist_sync')")
+            "initialized, but these launcher env vars are unset: "
+            + ", ".join(missing) +
+            " — set them (reference launcher env vars) or call "
+            "mxnet_tpu.parallel.dist.init_process_group(coordinator, "
+            "num_processes, process_id) before kv.create('dist_sync')")
+    if timeout is None:
+        timeout = float(os.environ.get("MXTPU_DIST_TIMEOUT", "300"))
     import jax
-    jax.distributed.initialize(
-        coordinator_address=coordinator,
-        num_processes=num_processes,
-        process_id=process_id)
+    from ..faults import retry_call
+
+    def _join():
+        try:
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=num_processes,
+                process_id=process_id,
+                initialization_timeout=max(1, int(timeout)))
+        except Exception:
+            # a failed connect leaves jax's global client/service assigned
+            # (State.initialize sets them BEFORE connect()), and a retry
+            # would then die on 'initialize should only be called once' —
+            # reset so the next attempt is a real join
+            try:
+                jax.distributed.shutdown()
+            except Exception:   # noqa: BLE001 — best-effort state reset
+                pass
+            raise
+
+    try:
+        retry_call(_join, retries=retries, base_delay=backoff,
+                   max_delay=30.0,
+                   retry_on=(RuntimeError, ConnectionError, TimeoutError,
+                             OSError))
+    except Exception as exc:
+        raise MXNetError(
+            f"could not join the process group at {coordinator!r} as rank "
+            f"{process_id}/{num_processes} after {retries + 1} attempt(s) "
+            f"({timeout:.0f}s connect timeout each): {exc}") from exc
 
 
 def rank() -> int:

@@ -1,0 +1,391 @@
+"""ResilientTrainer: a fault-tolerant supervisor around ShardedTrainer.
+
+Reference parity: the reference delegated fault tolerance to the parameter
+server (ps-lite server replication + the dmlc tracker restarting dead
+workers — SURVEY.md §2.3).  The TPU-native stack has no parameter server,
+so resilience moves into the training loop itself, the way large SPMD jobs
+actually survive preemptible TPU pods:
+
+- a **jitted all-finite guard** over loss+grads that skips the optimizer
+  update (params/momenta/aux pass through bit-identical) instead of
+  corrupting the replicated state with NaN/Inf, optionally decaying a
+  dynamic loss scale (trainer.py surgery — the guard lives inside the one
+  XLA step so it costs no extra host sync);
+- **bounded retry with backoff** on transient step failures;
+- **periodic async checkpoints** every N steps with keep-last-K retention
+  that only ever prunes *committed* checkpoints (§5.4 "async-writes
+  internally");
+- **auto-resume** from the newest committed checkpoint — torn dirs left by
+  a crash mid-async-write are skipped;
+- **SIGTERM/SIGINT preemption handling**: the handler only sets a flag;
+  the next step boundary writes a checkpoint, flushes it, and raises
+  :class:`TrainingPreempted` (an ``atexit`` hook additionally flushes any
+  in-flight async write on interpreter exit);
+- **counters** (``steps_skipped``, ``steps_retried``, ``steps_failed``,
+  ``checkpoints_written/pruned/failed``, ``resumes``) for the future
+  observability layer.
+
+Every failure path is exercisable on CPU through the deterministic fault
+plan in :mod:`mxnet_tpu.faults` (``MXTPU_FAULT_PLAN``).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import signal
+import threading
+from typing import Optional, Tuple, Type
+
+from ..base import MXNetError
+from ..faults import FaultPlan, TransientFault, active_plan, retry_call
+from .trainer import ShardedTrainer
+
+__all__ = ["ResilientTrainer", "TrainingPreempted"]
+
+
+class TrainingPreempted(MXNetError):
+    """Raised at a step boundary after SIGTERM/SIGINT, once the
+    preemption checkpoint has been written and flushed."""
+
+
+_exit_flush_trainers = None   # WeakSet, created on first registration
+
+
+def _register_exit_flush(trainer) -> None:
+    """Flush in-flight async checkpoint writes at interpreter exit.
+
+    Plain ``atexit`` is too late: since py3.9, ``concurrent.futures``
+    executors are torn down by ``threading._register_atexit`` hooks which
+    run BEFORE atexit callbacks — orbax's commit thread then cannot
+    schedule its metadata write and the final checkpoint stays torn.
+    ``threading._register_atexit`` runs hooks in REVERSE registration
+    order, so ours must register AFTER concurrent.futures' — which is
+    imported lazily (first orbax save), hence the explicit import below —
+    to flush while the writer executors are still alive.  Fall back to
+    atexit on interpreters without the private hook.
+
+    ONE process-wide hook over a WeakSet: trainers stay collectable (no
+    pinned closures), and repeated ResilientTrainer construction doesn't
+    accumulate hooks."""
+    global _exit_flush_trainers
+    import concurrent.futures.thread   # noqa: F401 — ordering, see above
+    import weakref
+    if _exit_flush_trainers is None:
+        _exit_flush_trainers = weakref.WeakSet()
+
+        def _flush_all():
+            for tr in list(_exit_flush_trainers):
+                try:
+                    tr.wait_checkpoint()
+                except Exception:   # noqa: BLE001 — interpreter is
+                    # tearing down; a failed flush just leaves an
+                    # uncommitted dir, which the committed-checkpoint
+                    # filter ignores on resume
+                    pass
+
+        try:
+            threading._register_atexit(_flush_all)
+        except (AttributeError, RuntimeError):
+            atexit.register(_flush_all)
+    _exit_flush_trainers.add(trainer)
+
+
+def _poison_first_float(x):
+    """Replace the first floating-point input with an all-NaN array of the
+    same shape/dtype (the 'nan' fault: a poisoned batch makes loss and
+    every gradient non-finite, exercising the skip path end to end)."""
+    import numpy as np
+
+    def to_np(v):
+        if hasattr(v, "asnumpy"):
+            return v.asnumpy()
+        return np.asarray(v)
+
+    xs = list(x) if isinstance(x, (tuple, list)) else [x]
+    for i, v in enumerate(xs):
+        a = to_np(v)
+        if np.issubdtype(a.dtype, np.floating):
+            xs[i] = np.full(a.shape, np.nan, dtype=a.dtype)
+            return tuple(xs) if isinstance(x, (tuple, list)) else xs[0]
+    raise MXNetError("fault 'nan': no floating-point input to poison "
+                     "(all inputs are integer typed)")
+
+
+class ResilientTrainer:
+    """Wrap a :class:`ShardedTrainer` with failure handling.
+
+    Parameters
+    ----------
+    trainer : ShardedTrainer — must not be built yet if ``skip_nonfinite``
+        needs to switch the guard on (the guard changes the jitted step).
+    checkpoint_dir : str — where periodic/preemption checkpoints land.
+    checkpoint_every : int — save every N supervisor steps (0 = only on
+        preemption / explicit :meth:`checkpoint` calls).
+    keep_last : int — retention: prune committed checkpoints beyond the
+        newest K (clamped to >= 1; the newest committed one is never
+        deleted).
+    max_retries : int — bounded retries per step on ``retry_on`` failures.
+    retry_on : tuple of exception types treated as transient.
+    fault_plan : FaultPlan | str | None — deterministic fault injection;
+        ``None`` uses the process-global plan (``MXTPU_FAULT_PLAN``).
+    auto_resume : bool — on the first step, restore the newest committed
+        checkpoint under ``checkpoint_dir`` if one exists.
+    skip_nonfinite : bool — enable the in-graph all-finite guard.
+    dynamic_loss_scale : bool — carry a loss scale in the step (decayed on
+        skipped steps, grown after ``scale_growth_interval`` clean steps).
+    """
+
+    def __init__(self, trainer: ShardedTrainer, *,
+                 checkpoint_dir: Optional[str] = None,
+                 checkpoint_every: int = 0,
+                 keep_last: int = 3,
+                 max_retries: int = 3,
+                 retry_base_delay: float = 0.05,
+                 retry_max_delay: float = 2.0,
+                 retry_on: Tuple[Type[BaseException], ...] =
+                 (TransientFault,),
+                 fault_plan=None,
+                 auto_resume: bool = True,
+                 skip_nonfinite: bool = True,
+                 dynamic_loss_scale: bool = False,
+                 init_loss_scale: float = 2.0 ** 15,
+                 scale_growth_interval: int = 2000,
+                 scale_backoff: float = 0.5):
+        if not isinstance(trainer, ShardedTrainer):
+            raise MXNetError(
+                f"ResilientTrainer wraps a ShardedTrainer, got "
+                f"{type(trainer).__name__}")
+        self._trainer = trainer
+        self._ckpt_dir = os.path.abspath(checkpoint_dir) \
+            if checkpoint_dir else None
+        self._every = int(checkpoint_every)
+        self._keep_last = max(1, int(keep_last))
+        self._max_retries = int(max_retries)
+        self._retry_base = float(retry_base_delay)
+        self._retry_max = float(retry_max_delay)
+        self._retry_on = tuple(retry_on)
+        if isinstance(fault_plan, str):
+            fault_plan = FaultPlan(fault_plan)
+        self._plan = fault_plan if fault_plan is not None else active_plan()
+        self._auto_resume = bool(auto_resume)
+        if skip_nonfinite and not trainer.guard_enabled:
+            trainer.enable_nonfinite_guard(
+                dynamic_loss_scale=dynamic_loss_scale,
+                init_loss_scale=init_loss_scale,
+                scale_growth_interval=scale_growth_interval,
+                scale_backoff=scale_backoff)
+        self._counters = {"steps_skipped": 0, "steps_retried": 0,
+                          "steps_failed": 0, "checkpoints_written": 0,
+                          "checkpoints_pruned": 0, "checkpoints_failed": 0,
+                          "resumes": 0}
+        self._pending_finite: list = []
+        self._step_index = 0          # supervisor step counter (fault site)
+        self._save_index = 0          # checkpoint-write counter (fault site)
+        self._last_saved_t = None
+        self._preempt_signum: Optional[int] = None
+        self._prev_handlers: dict = {}
+        self._resume_checked = False
+        self.resumed_t: Optional[int] = None
+        # interpreter-exit fallback: an in-flight async write must commit
+        # even if the loop never reaches another step boundary
+        _register_exit_flush(trainer)
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def trainer(self) -> ShardedTrainer:
+        return self._trainer
+
+    @property
+    def loss_scale(self) -> float:
+        return self._trainer.loss_scale
+
+    @property
+    def preempted(self) -> bool:
+        return self._preempt_signum is not None
+
+    def _drain_finite(self) -> None:
+        if not self._pending_finite:
+            return
+        import jax
+        flags = jax.device_get(self._pending_finite)
+        self._pending_finite = []
+        self._counters["steps_skipped"] += \
+            sum(1 for f in flags if not bool(f))
+
+    @property
+    def counters(self) -> dict:
+        """Snapshot of the resilience counters (resolves any pending
+        device-side skip flags — may sync)."""
+        self._drain_finite()
+        return dict(self._counters)
+
+    # -- signals -----------------------------------------------------------
+    def install_signal_handlers(
+            self, signals=(signal.SIGTERM, signal.SIGINT)) -> None:
+        """Route SIGTERM/SIGINT through checkpoint-and-raise at the next
+        step boundary.  Main thread only (a CPython constraint)."""
+        if threading.current_thread() is not threading.main_thread():
+            raise MXNetError("signal handlers can only be installed from "
+                             "the main thread")
+        for s in signals:
+            self._prev_handlers[s] = signal.signal(s, self._on_signal)
+
+    def uninstall_signal_handlers(self) -> None:
+        for s, h in self._prev_handlers.items():
+            signal.signal(s, h)
+        self._prev_handlers.clear()
+
+    def _on_signal(self, signum, frame) -> None:
+        # async-signal-safe: only set a flag; all real work happens at the
+        # next step boundary on the main thread
+        self._preempt_signum = signum
+
+    def _flush_and_raise(self) -> None:
+        signum = self._preempt_signum
+        save_err = None
+        try:
+            if self._ckpt_dir is not None and self._trainer.built and \
+                    self._last_saved_t != self._trainer.num_update:
+                self.checkpoint(wait=True)
+        except Exception as exc:   # noqa: BLE001 — reported below; the
+            # preemption signal must NEVER escape as a retryable fault
+            save_err = exc
+        try:
+            self._trainer.wait_checkpoint()
+        except Exception as exc:   # noqa: BLE001 — same: report, not mask
+            save_err = save_err or exc
+        where = f" (flushed to {self._ckpt_dir})" if self._ckpt_dir else ""
+        if save_err is not None:
+            raise TrainingPreempted(
+                f"training preempted by signal {signum}; the preemption "
+                f"checkpoint FAILED ({save_err!r}) — resume will use the "
+                f"last committed checkpoint") from save_err
+        raise TrainingPreempted(
+            f"training preempted by signal {signum}{where}")
+
+    # -- resume ------------------------------------------------------------
+    def maybe_resume(self, x, y, batch_size: Optional[int] = None):
+        """Restore the newest *committed* checkpoint under
+        ``checkpoint_dir`` if one exists.  Returns the restored update
+        counter, or None.  An unbuilt trainer is built first with one
+        probe step on (x, y) — its effect is entirely overwritten by the
+        restore (params, optimizer state, update counter, RNG stream)."""
+        self._resume_checked = True
+        if self._ckpt_dir is None:
+            return None
+        path = ShardedTrainer.latest_checkpoint(self._ckpt_dir)
+        if path is None:
+            return None
+        if not self._trainer.built:
+            self._trainer.step(x, y, batch_size)
+        self._trainer.load_checkpoint(self._ckpt_dir)
+        self.resumed_t = self._trainer.num_update
+        self._last_saved_t = self.resumed_t
+        self._counters["resumes"] += 1
+        return self.resumed_t
+
+    # -- the supervised step ----------------------------------------------
+    def step(self, x, y, batch_size: Optional[int] = None):
+        """One supervised train step: auto-resume (first call), fault
+        injection, bounded retry, skip accounting, preemption handling,
+        periodic checkpointing.  Returns the (device) mean loss —
+        NaN on a skipped step, with params untouched."""
+        if self.preempted:
+            self._flush_and_raise()
+        if self._auto_resume and not self._resume_checked:
+            self.maybe_resume(x, y, batch_size)
+        self._step_index += 1
+        i = self._step_index
+        plan = self._plan
+
+        def one_attempt():
+            if plan is not None:
+                plan.fire("step_error", i)
+            xi = x
+            if plan is not None and \
+                    plan.scheduled("nan", i) is not None:
+                xi = _poison_first_float(x)
+            return self._trainer.step(xi, y, batch_size)
+
+        def on_retry(attempt, exc, delay):
+            self._counters["steps_retried"] += 1
+
+        try:
+            loss = retry_call(one_attempt, retries=self._max_retries,
+                              base_delay=self._retry_base,
+                              max_delay=self._retry_max,
+                              retry_on=self._retry_on, on_retry=on_retry)
+        except self._retry_on:
+            self._counters["steps_failed"] += 1
+            raise
+        if self._trainer.guard_enabled:
+            self._pending_finite.append(self._trainer.last_step_finite)
+            if len(self._pending_finite) >= 128:
+                self._drain_finite()
+        if self.preempted:
+            self._flush_and_raise()
+        if self._ckpt_dir is not None and self._every > 0 and \
+                self._trainer.num_update % self._every == 0:
+            try:
+                self.checkpoint()
+            except TransientFault:
+                pass   # counted in checkpoints_failed; the next periodic
+                # save (or the preemption path) covers the gap
+        return loss
+
+    # -- checkpointing -----------------------------------------------------
+    def checkpoint(self, wait: bool = False) -> None:
+        """Write an async checkpoint now and prune per retention.  With
+        ``wait=True``, block until the write commits."""
+        if self._ckpt_dir is None:
+            raise MXNetError("ResilientTrainer has no checkpoint_dir")
+        t = self._trainer.num_update
+        self._save_index += 1
+        if self._plan is not None and \
+                self._plan.scheduled("ckpt_fail", self._save_index) \
+                is not None:
+            # simulate a crash mid-async-write: a torn step dir with data
+            # but no orbax commit marker — resume must skip it
+            torn = os.path.join(self._ckpt_dir, f"state-{t:08d}")
+            os.makedirs(torn, exist_ok=True)
+            with open(os.path.join(torn, "_TORN_WRITE"), "w") as f:
+                f.write("injected by MXTPU_FAULT_PLAN\n")
+            self._counters["checkpoints_failed"] += 1
+            raise TransientFault(
+                f"injected checkpoint write failure "
+                f"(save #{self._save_index}, step {t})")
+        self._trainer.save_checkpoint(self._ckpt_dir)
+        self._last_saved_t = t
+        self._counters["checkpoints_written"] += 1
+        if wait:
+            self._trainer.wait_checkpoint()
+        self._gc()
+
+    def flush(self) -> None:
+        """Block until any in-flight async write commits, then apply
+        retention to the now-complete committed set."""
+        self._trainer.wait_checkpoint()
+        if self._ckpt_dir is not None:
+            self._gc()
+
+    def _gc(self) -> None:
+        """keep-last-K over COMMITTED checkpoints only.  An in-flight
+        async write is invisible here (not yet committed) and torn dirs
+        are never counted, so the newest committed checkpoint always
+        survives; torn partials older than it are swept as garbage."""
+        committed = ShardedTrainer.committed_checkpoints(self._ckpt_dir)
+        for path in committed[:-self._keep_last]:
+            shutil.rmtree(path, ignore_errors=True)
+            self._counters["checkpoints_pruned"] += 1
+        if not committed:
+            return
+        newest = os.path.basename(committed[-1])
+        for d in sorted(os.listdir(self._ckpt_dir)):
+            full = os.path.join(self._ckpt_dir, d)
+            if full in committed or not d.startswith("state-"):
+                continue
+            # uncommitted (torn or tmp) and strictly older than the newest
+            # committed step -> dead weight from a crashed write
+            if d.split(".")[0] < newest:
+                shutil.rmtree(full, ignore_errors=True)

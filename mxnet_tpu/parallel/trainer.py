@@ -19,6 +19,7 @@ update at the HBM level).  Numerics match the imperative Trainer exactly
 """
 from __future__ import annotations
 
+import re as _re
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as _np
@@ -35,6 +36,13 @@ from .mesh import ShardingRules, default_mesh, replicated, shard
 from .optim import make_functional_optimizer
 
 __all__ = ["ShardedTrainer"]
+
+# a committed orbax checkpoint dir is exactly `state-<8 digits>` AND carries
+# the commit marker; anything else under the root (orbax's
+# `*.orbax-checkpoint-tmp-*` rename staging, a dir torn by a crash
+# mid-async-write) is an uncommitted partial and must never be restored
+_STEP_DIR_RE = _re.compile(r"^state-(\d+)$")
+_COMMIT_MARKER = "_CHECKPOINT_METADATA"
 
 
 class ShardedTrainer:
@@ -57,7 +65,14 @@ class ShardedTrainer:
                  optimizer_params: Optional[dict] = None, mesh=None,
                  rules: Optional[ShardingRules] = None,
                  data_spec: Sequence = ("dp",),
-                 label_spec: Optional[Sequence] = None):
+                 label_spec: Optional[Sequence] = None,
+                 guard_nonfinite: bool = False,
+                 dynamic_loss_scale: bool = False,
+                 init_loss_scale: float = 2.0 ** 15,
+                 scale_growth_interval: int = 2000,
+                 scale_backoff: float = 0.5,
+                 min_loss_scale: float = 1.0,
+                 max_loss_scale: float = 2.0 ** 24):
         self._block = block
         self._loss = loss
         self._mesh = mesh if mesh is not None else default_mesh()
@@ -71,6 +86,33 @@ class ShardedTrainer:
         self._built = False
         self._t = 0
         self._ctx = current_context()
+        self._guard = bool(guard_nonfinite)
+        self._dyn_scale = bool(dynamic_loss_scale)
+        self._init_ls = float(init_loss_scale) if dynamic_loss_scale else 1.0
+        self._growth_interval = int(scale_growth_interval)
+        self._scale_backoff = float(scale_backoff)
+        self._min_ls = float(min_loss_scale)
+        self._max_ls = float(max_loss_scale)
+        self._gstate = None          # (loss_scale, clean_step_count) arrays
+        self._last_finite = None     # device bool from the last guarded step
+
+    def enable_nonfinite_guard(self, dynamic_loss_scale: bool = False,
+                               init_loss_scale: float = 2.0 ** 15,
+                               scale_growth_interval: int = 2000,
+                               scale_backoff: float = 0.5) -> None:
+        """Turn on the in-graph all-finite guard (see step_fn): a step
+        whose loss or any gradient is non-finite leaves params, optimizer
+        state and aux bit-identical instead of applying the update.  Must
+        be called before the first step — the guard changes the jitted
+        step function."""
+        if self._built:
+            raise MXNetError("enable_nonfinite_guard() must be called "
+                             "before the first step() builds the jit")
+        self._guard = True
+        self._dyn_scale = bool(dynamic_loss_scale)
+        self._init_ls = float(init_loss_scale) if dynamic_loss_scale else 1.0
+        self._growth_interval = int(scale_growth_interval)
+        self._scale_backoff = float(scale_backoff)
 
     # -- lazy build --------------------------------------------------------
     def _ensure_built(self, xs, y: _np.ndarray) -> None:
@@ -152,29 +194,99 @@ class ShardedTrainer:
                          for w, v in zip(aw, avals)]
             return out, l_nd, new_avals
 
-        def step_fn(pvals, avals, state, key, t, lr, rescale, xv, yv):
-            def loss_of(pv):
-                _, l_nd, new_avals = apply_fn(pv, avals, key, xv, True, yv)
-                lraw = l_nd._read()
-                # reference semantics: loss.backward() seeds ones (sum), and
-                # Trainer.step(batch_size) folds the 1/batch rescale into the
-                # optimizer — so differentiate the SUM and apply `rescale`
-                # in the update; the MEAN is what we report
-                return jnp.sum(lraw), (jnp.mean(lraw), new_avals)
+        if not self._guard:
+            def step_fn(pvals, avals, state, key, t, lr, rescale, xv, yv):
+                def loss_of(pv):
+                    _, l_nd, new_avals = apply_fn(pv, avals, key, xv, True,
+                                                  yv)
+                    lraw = l_nd._read()
+                    # reference semantics: loss.backward() seeds ones (sum),
+                    # and Trainer.step(batch_size) folds the 1/batch rescale
+                    # into the optimizer — so differentiate the SUM and
+                    # apply `rescale` in the update; the MEAN is what we
+                    # report
+                    return jnp.sum(lraw), (jnp.mean(lraw), new_avals)
 
-            (_, (lval, new_avals)), grads = \
-                jax.value_and_grad(loss_of, has_aux=True)(pvals)
-            new_pvals, new_state = fopt.update(
-                pvals, grads, state, t, lr, rescale)
-            return new_pvals, new_avals, new_state, lval
+                (_, (lval, new_avals)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(pvals)
+                new_pvals, new_state = fopt.update(
+                    pvals, grads, state, t, lr, rescale)
+                return new_pvals, new_avals, new_state, lval
 
-        self._jit_step = jax.jit(
-            step_fn,
-            in_shardings=(self._p_sh, self._a_sh, self._s_sh,
-                          self._r_sh, self._r_sh, self._r_sh, self._r_sh,
-                          self._x_sh, self._y_sh),
-            out_shardings=(self._p_sh, self._a_sh, self._s_sh, self._r_sh),
-            donate_argnums=(0, 1, 2))
+            self._jit_step = jax.jit(
+                step_fn,
+                in_shardings=(self._p_sh, self._a_sh, self._s_sh,
+                              self._r_sh, self._r_sh, self._r_sh,
+                              self._r_sh, self._x_sh, self._y_sh),
+                out_shardings=(self._p_sh, self._a_sh, self._s_sh,
+                               self._r_sh),
+                donate_argnums=(0, 1, 2))
+        else:
+            # guarded step: differentiate loss * loss_scale, unscale inside
+            # the optimizer rescale, and gate the WHOLE update on an
+            # all-finite reduction over loss+grads — a poisoned step passes
+            # params/momenta/aux through bit-identical.  The gate is a
+            # jnp.where inside the one XLA computation, so skipping costs
+            # no extra host sync or dispatch.
+            dyn = self._dyn_scale
+            growth_n = self._growth_interval
+            backoff = self._scale_backoff
+            min_ls, max_ls = self._min_ls, self._max_ls
+
+            def step_fn(pvals, avals, state, key, t, lr, rescale, gstate,
+                        xv, yv):
+                ls, good = gstate
+
+                def loss_of(pv):
+                    _, l_nd, new_avals = apply_fn(pv, avals, key, xv, True,
+                                                  yv)
+                    lraw = l_nd._read()
+                    return jnp.sum(lraw) * ls, (jnp.mean(lraw), new_avals)
+
+                (_, (lval, new_avals)), grads = \
+                    jax.value_and_grad(loss_of, has_aux=True)(pvals)
+                finite = jnp.isfinite(lval)
+                for g in jax.tree.leaves(grads):
+                    finite = jnp.logical_and(finite,
+                                             jnp.all(jnp.isfinite(g)))
+                new_pvals, new_state = fopt.update(
+                    pvals, grads, state, t, lr, rescale / ls)
+
+                def keep(new, old):
+                    return jnp.where(finite, new, old)
+
+                new_pvals = [keep(n, o) for n, o in zip(new_pvals, pvals)]
+                new_state = jax.tree.map(keep, new_state, state)
+                new_avals = [keep(n, o) for n, o in zip(new_avals, avals)]
+                if dyn:
+                    good = jnp.where(finite, good + 1, 0)
+                    grow = jnp.logical_and(finite, good >= growth_n)
+                    new_ls = jnp.where(
+                        grow, jnp.minimum(ls * 2.0, max_ls),
+                        jnp.where(finite, ls,
+                                  jnp.maximum(ls * backoff, min_ls)))
+                    good = jnp.where(grow, jnp.zeros_like(good), good)
+                else:
+                    new_ls = ls
+                    good = jnp.where(finite, good + 1, 0)
+                return (new_pvals, new_avals, new_state, lval,
+                        (new_ls, good), finite)
+
+            self._jit_step = jax.jit(
+                step_fn,
+                in_shardings=(self._p_sh, self._a_sh, self._s_sh,
+                              self._r_sh, self._r_sh, self._r_sh,
+                              self._r_sh, (self._r_sh, self._r_sh),
+                              self._x_sh, self._y_sh),
+                out_shardings=(self._p_sh, self._a_sh, self._s_sh,
+                               self._r_sh, (self._r_sh, self._r_sh),
+                               self._r_sh),
+                donate_argnums=(0, 1, 2))
+            if self._gstate is None:
+                self._gstate = (
+                    jax.device_put(jnp.asarray(self._init_ls, jnp.float32),
+                                   self._r_sh),
+                    jax.device_put(jnp.asarray(0, jnp.int32), self._r_sh))
 
         def fwd_fn(pvals, avals, key, xv):
             out, _, _ = apply_fn(pvals, avals, key, xv, False)
@@ -195,6 +307,38 @@ class ShardedTrainer:
     @property
     def optimizer(self):
         return self._optimizer
+
+    @property
+    def built(self) -> bool:
+        """True once the first step() has built the jit and taken
+        ownership of the weights."""
+        return self._built
+
+    @property
+    def num_update(self) -> int:
+        """The optimizer update counter (steps taken / restored)."""
+        return self._t
+
+    @property
+    def guard_enabled(self) -> bool:
+        return self._guard
+
+    @property
+    def last_step_finite(self):
+        """Device bool from the last guarded step: False means the update
+        was skipped (non-finite loss/grads).  None before the first
+        guarded step or with the guard off.  Reading it with bool()/
+        device_get syncs — the resilience layer batches these."""
+        return self._last_finite
+
+    @property
+    def loss_scale(self) -> float:
+        """Current (dynamic) loss scale; 1.0 unless the guard was enabled
+        with dynamic_loss_scale.  Syncs the device scalar."""
+        if self._gstate is None:
+            return self._init_ls if self._guard else 1.0
+        import jax
+        return float(jax.device_get(self._gstate[0]))
 
     @property
     def learning_rate(self) -> float:
@@ -254,9 +398,15 @@ class ShardedTrainer:
         t = jnp.asarray(self._t, dtype=jnp.int32)
         lr = jnp.asarray(self._optimizer.learning_rate, dtype=jnp.float32)
         rescale = jnp.asarray(self._scale / batch_size, dtype=jnp.float32)
-        self._pvals, self._avals, self._state, lval = self._jit_step(
-            self._pvals, self._avals, self._state, key, t, lr, rescale,
-            xv, yv)
+        if self._guard:
+            (self._pvals, self._avals, self._state, lval, self._gstate,
+             self._last_finite) = self._jit_step(
+                self._pvals, self._avals, self._state, key, t, lr,
+                rescale, self._gstate, xv, yv)
+        else:
+            self._pvals, self._avals, self._state, lval = self._jit_step(
+                self._pvals, self._avals, self._state, key, t, lr, rescale,
+                xv, yv)
         return NDArray(lval, ctx=self._ctx)
 
     def forward(self, x):
@@ -309,19 +459,43 @@ class ShardedTrainer:
                 "opt_state": self._state,
                 "rng": _grandom.get_state(),
                 "t": self._t}
+        if self._guard and self._gstate is not None:
+            # loss scale + clean-step counter ride along so a resumed run
+            # replays the dynamic-scale trajectory bit-for-bit
+            tree["guard"] = list(self._gstate)
         self._checkpointer().save(
             os.path.join(directory, f"state-{self._t:08d}"), tree,
             force=True)
 
     @staticmethod
-    def latest_checkpoint(directory: str):
-        """Newest committed step dir under ``directory`` (or None)."""
+    def committed_checkpoints(directory: str) -> List[str]:
+        """Sorted (oldest → newest) step dirs under ``directory`` that
+        orbax fully COMMITTED.  Two filters, both load-bearing for crash
+        safety: the name must be exactly ``state-<digits>`` (orbax's
+        ``*.orbax-checkpoint-tmp-*`` rename staging also starts with
+        ``state-`` and sorts NEWER than its target), and the commit
+        marker file must exist (covers torn writes on filesystems where
+        the rename is not atomic)."""
         import os
         if not os.path.isdir(directory):
-            return None
-        steps = sorted(d for d in os.listdir(directory)
-                       if d.startswith("state-"))
-        return os.path.join(directory, steps[-1]) if steps else None
+            return []
+        steps = []
+        for d in os.listdir(directory):
+            if not _STEP_DIR_RE.match(d):
+                continue
+            if not os.path.exists(os.path.join(directory, d,
+                                               _COMMIT_MARKER)):
+                continue
+            steps.append(d)
+        return [os.path.join(directory, d) for d in sorted(steps)]
+
+    @staticmethod
+    def latest_checkpoint(directory: str):
+        """Newest COMMITTED step dir under ``directory`` (or None).  A
+        crash mid-async-write leaves a partial dir behind; it is skipped
+        and the next-older committed checkpoint wins."""
+        steps = ShardedTrainer.committed_checkpoints(directory)
+        return steps[-1] if steps else None
 
     def load_checkpoint(self, directory: str) -> None:
         """Restore the NEWEST checkpoint under ``directory`` directly
@@ -354,6 +528,24 @@ class ShardedTrainer:
             "rng": rng_now,
             "t": 0,
         }
+        # the template must match the SAVED tree exactly (orbax rejects
+        # both extra and missing keys), so ask the checkpoint whether it
+        # carries guard state rather than assuming this trainer's config:
+        # guard-on trainers must restore guard-less checkpoints and vice
+        # versa
+        try:
+            saved_has_guard = \
+                "guard" in self._checkpointer().metadata(path)
+        except Exception:   # noqa: BLE001 — metadata unavailable: fall
+            # back to mirroring this trainer's own configuration
+            saved_has_guard = self._guard and self._gstate is not None
+        if saved_has_guard:
+            import jax.numpy as jnp
+            gs = self._gstate if self._gstate is not None else \
+                (jnp.float32(1.0), jnp.int32(0))
+            template["guard"] = [
+                jax.ShapeDtypeStruct(v.shape, v.dtype, sharding=self._r_sh)
+                for v in gs]
         tree = self._checkpointer().restore(path, template)
         self._pvals = list(tree["params"])
         self._avals = list(tree["aux"])
@@ -361,6 +553,8 @@ class ShardedTrainer:
         _grandom.set_state(tree["rng"])
         self._t = int(tree["t"])
         self._optimizer.num_update = self._t
+        if "guard" in tree and self._guard:
+            self._gstate = tuple(tree["guard"])
 
     def sync_params(self) -> None:
         """Copy trainer-owned (sharded) weights back into the block's
