@@ -141,6 +141,83 @@ def bench_mnist_mlp(iters=200, warmup=30, batch=64):
             "host_cores": _host_cores()}
 
 
+def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
+    """Config: eager small-op dispatch — a chain of small elementwise ops
+    with NO reads inside, the dispatch-overhead workload bulking
+    (MXNET_EXEC_BULK_EXEC_TRAIN lazy fusion segments) exists for.
+    NaiveEngine (per-op synchronous dispatch, the reference's debug
+    engine) pays a jit dispatch + threadpool sync PER OP; bulked mode
+    pays one dispatch per MXNET_ENGINE_BULK_SIZE segment.  Both fuse
+    modes are measured: 'exact' (the default — per-op kernels inside one
+    dispatch, bitwise identical to unbulked) and 'aggressive' (full XLA
+    fusion).  16KB vectors: big enough that the per-op dispatch/sync
+    cost is the real-world one, small enough to stay a "small op"."""
+    import mxnet_tpu as mx
+    from mxnet_tpu.engine import engine
+
+    eng = engine()
+    rng = np.random.default_rng(0)
+    x0 = mx.nd.array(rng.standard_normal((size,), dtype=np.float32))
+    a = mx.nd.array(rng.standard_normal((size,), dtype=np.float32))
+    b = mx.nd.array(rng.standard_normal((size,), dtype=np.float32))
+    ops_per_iter = 3 * chain
+
+    def run(n):
+        y = x0
+        for _ in range(n):
+            for _ in range(chain):
+                y = y * a + b
+                y = mx.nd.tanh(y)
+        y.wait_to_read()
+        return y
+
+    prev_type = eng.engine_type
+    prev = {k: os.environ.get(k) for k in
+            ("MXNET_EXEC_BULK_EXEC_TRAIN", "MXNET_ENGINE_BULK_FUSE")}
+    results = {}
+    try:
+        for mode, etype, bulk, fuse in (
+                ("bulk", "ThreadedEnginePerDevice", "1", "exact"),
+                ("bulk_aggressive", "ThreadedEnginePerDevice", "1",
+                 "aggressive"),
+                ("naive", "NaiveEngine", "0", "exact")):
+            eng.set_engine_type(etype)
+            os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = bulk
+            os.environ["MXNET_ENGINE_BULK_FUSE"] = fuse
+            run(warmup)
+            eng.reset_stats()
+            # best-of-3: same shared-host rationale as the mnist row
+            passes = []
+            for _ in range(3):
+                t0 = time.perf_counter()
+                run(iters)
+                passes.append(time.perf_counter() - t0)
+            results[mode] = ops_per_iter * iters / min(passes)
+            if mode == "bulk":
+                stats = eng.stats()
+    finally:
+        eng.set_engine_type(prev_type)
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    return {"ops_per_sec_bulk": round(results["bulk"], 1),
+            "ops_per_sec_bulk_aggressive": round(
+                results["bulk_aggressive"], 1),
+            "ops_per_sec_naive": round(results["naive"], 1),
+            "bulk_speedup": round(results["bulk"] / results["naive"], 2),
+            "aggressive_speedup": round(
+                results["bulk_aggressive"] / results["naive"], 2),
+            "chain_len": chain, "vector_size": size,
+            "mean_segment_length": stats["mean_segment_length"],
+            "segment_cache_hit_rate": round(
+                stats["segment_cache_hits"] /
+                max(1, stats["segment_cache_hits"]
+                    + stats["segment_cache_misses"]), 3),
+            "host_cores": _host_cores()}
+
+
 def bench_bert_base(iters=10, warmup=3, batch=8, seq=256,
                     dtype="float32", attention="xla"):
     """Config #3: BERT-base pretraining whole-step time on the dp mesh
@@ -463,7 +540,8 @@ def main():
     ap.add_argument("--warmup", type=int, default=5)
     ap.add_argument("--size", type=int, default=224)
     ap.add_argument("--only", choices=["resnet_bf16", "resnet_fp32",
-                                       "mnist_mlp", "bert", "bert_bf16",
+                                       "mnist_mlp", "eager_dispatch",
+                                       "bert", "bert_bf16",
                                        "nmt", "ssd", "pipeline"],
                     help="run a single row (default: the full suite)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
@@ -530,6 +608,8 @@ def main():
     rows = {}
     if args.only == "mnist_mlp":
         rows["mnist_mlp_imperative"] = bench_mnist_mlp()
+    elif args.only == "eager_dispatch":
+        rows["eager_dispatch"] = bench_eager_dispatch()
     elif args.only == "bert":
         small = _small(iters=2, warmup=1, batch=2, seq=256)
         rows["bert_base"] = bench_bert_base(**small)
@@ -662,6 +742,7 @@ def main():
             sub_row("resnet_bf16", ["resnet50_bf16"], row_budget)
         sub_row("resnet_fp32", ["resnet50_fp32"], row_budget)
         sub_row("mnist_mlp", ["mnist_mlp_imperative"], 900)
+        sub_row("eager_dispatch", ["eager_dispatch"], 900)
         sub_row("bert", ["bert_base", "bert_base_flash"], row_budget)
         if not cpu_ci:
             # the MXU-native BERT pair (cpu CI covers the fp32 pair only)
@@ -677,6 +758,7 @@ def main():
         "resnet50_bf16": ("images_per_sec_per_chip", "images/sec/chip"),
         "resnet50_fp32": ("images_per_sec_per_chip", "images/sec/chip"),
         "mnist_mlp_imperative": ("images_per_sec", "images/sec"),
+        "eager_dispatch": ("ops_per_sec_bulk", "ops/sec"),
         "bert_base": ("step_ms", "ms/step"),
         "bert_base_flash": ("step_ms", "ms/step"),
         "bert_base_bf16": ("step_ms", "ms/step"),

@@ -196,7 +196,14 @@ def backward(heads: Sequence, head_grads=None, retain_graph: bool = False,
     graph from the tape and executes it through the engine; here each tape
     node's ``jax.vjp`` closure is invoked in reverse topological order and the
     resulting ops dispatch asynchronously through XLA.
+
+    Backward is a sync point for bulked dispatch: any ops still parked in
+    the thread's lazy segment flush first, which also populates the
+    segment's tape node (one ``jax.vjp`` over the fused forward) — only
+    then is the tape complete enough to walk.
     """
+    from .engine import flush_pending
+    flush_pending()
     heads = list(heads)
     if head_grads is None:
         head_grads = [None] * len(heads)

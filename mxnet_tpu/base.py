@@ -161,6 +161,13 @@ register_env("MXNET_ENGINE_TYPE", "ThreadedEnginePerDevice", str,
              "Engine type: NaiveEngine (sync, debug) or ThreadedEnginePerDevice (async).")
 register_env("MXNET_EXEC_BULK_EXEC_TRAIN", True, bool,
              "Fuse op sequences into bulked dispatch segments (maps to jit).")
+register_env("MXNET_ENGINE_BULK_SIZE", 15, int,
+             "Max ops per bulked dispatch segment before a forced flush.")
+register_env("MXNET_ENGINE_BULK_FUSE", "exact", str,
+             "Bulk segment codegen: 'exact' (one dispatch, per-op kernels, "
+             "bitwise-identical to unbulked) or 'aggressive' (full XLA "
+             "fusion incl. taped segments; FMA contraction may shift "
+             "results by ~1 ulp).")
 register_env("MXNET_ENFORCE_DETERMINISM", False, bool,
              "Request deterministic kernel selection (XLA default is deterministic).")
 register_env("MXNET_GPU_MEM_POOL_RESERVE", 5, int,

@@ -14,8 +14,16 @@ version, so conflicting writes are serialized by the GIL-ordered version
 update rather than by a scheduler (see ndarray.py).  This module therefore
 carries the *interface*: engine-type selection (NaiveEngine = force-sync for
 debugging, exactly the reference's MXNET_ENGINE_TYPE escape hatch), sync
-points (wait_for_var / wait_all), and a bulk/dispatch-statistics hook used by
-the profiler.
+points (wait_for_var / wait_all), and the bulk/dispatch-statistics surface.
+
+Bulked dispatch (reference: MXNET_EXEC_BULK_EXEC_TRAIN, the "bulking" of
+consecutive engine pushes into one dispatch): the imperative invoke path in
+ndarray/register.py defers fusable ops into a lazy segment instead of
+executing them one XLA dispatch at a time, and flushes the whole segment as
+ONE jitted fused executable at a sync point.  This module owns the knobs
+(bulk on/off, MXNET_ENGINE_BULK_SIZE cap, NaiveEngine forces flush-per-op),
+the counters (``Engine.stats()``), and the flush hook the sync points call
+— the segment builder itself lives next to the invoke path it serves.
 """
 from __future__ import annotations
 
@@ -25,7 +33,57 @@ from typing import Any
 
 from .base import get_env
 
-__all__ = ["Engine", "engine", "is_naive", "wait_all"]
+__all__ = ["Engine", "engine", "is_naive", "wait_all", "PendingValue"]
+
+
+class PendingValue:
+    """Placeholder living in ``NDArray._data`` while the producing op sits
+    in an unflushed bulk segment (the 'pending write var' of the reference
+    engine).  ``segment`` is the owning segment (duck-typed: needs only
+    ``.flush()`` and ``.error``), ``index`` its slot in the segment's flat
+    output tuple.  NDArray._read() treats this type as the barrier: any
+    read materializes the whole segment first."""
+
+    __slots__ = ("segment", "index")
+
+    def __init__(self, segment, index: int):
+        self.segment = segment
+        self.index = index
+
+
+# Installed by ndarray.register at import time; called by the sync points
+# below so `engine` never has to import the frontend layer (which imports
+# this module).  The hook flushes the CALLING thread's pending segment.
+_flush_hook = None
+
+
+def _install_flush_hook(fn) -> None:
+    global _flush_hook
+    _flush_hook = fn
+
+
+def flush_pending() -> None:
+    """Flush the calling thread's pending bulk segment, if any."""
+    if _flush_hook is not None:
+        _flush_hook()
+
+
+# os.environ's decoded-bytes dict, when the platform exposes it: the bulk
+# knobs are re-read on EVERY op dispatch (live toggling is part of the
+# env-var contract), and os.environ.get's key encode costs ~1µs — real
+# money on a ~6µs defer path.  Falls back to os.environ.get elsewhere.
+# posix-only: on Windows os.environ._data is str-keyed (and upper-cased),
+# so bytes lookups would silently always miss.
+_ENV_DATA = getattr(os.environ, "_data", None) if os.name == "posix" \
+    else None
+if not isinstance(_ENV_DATA, dict):
+    _ENV_DATA = None
+
+
+def _raw_env(key_bytes: bytes, key_str: str):
+    if _ENV_DATA is not None:
+        return _ENV_DATA.get(key_bytes)
+    return os.environ.get(key_str)
 
 
 class Engine:
@@ -41,6 +99,19 @@ class Engine:
         self._num_ops = 0
         # profiler hooks: fn(op_name, outputs, dispatch_us)
         self._listeners = []
+        # bulk_enabled memo: (raw env string, parsed bool) — the invoke
+        # hot path asks once per op, so a full get_env parse each time
+        # showed up in profiles; os.environ.get + string compare doesn't
+        self._bulk_raw = object()
+        self._bulk_parsed = True
+        self._fuse_raw = object()
+        self._fuse_parsed = "exact"
+        # bulking counters (see stats())
+        self._ops_bulked = 0
+        self._segments_flushed = 0
+        self._bulked_ops_flushed = 0
+        self._segment_cache_hits = 0
+        self._segment_cache_misses = 0
 
     @classmethod
     def get(cls) -> "Engine":
@@ -55,11 +126,52 @@ class Engine:
         return self._type
 
     def set_engine_type(self, name: str) -> None:
+        # NaiveEngine must observe every op synchronously from the moment
+        # it is selected — anything still parked in a segment flushes now
+        flush_pending()
         self._type = name
 
     @property
     def is_naive(self) -> bool:
         return self._type == "NaiveEngine"
+
+    # -- bulking config ----------------------------------------------------
+    @property
+    def bulk_enabled(self) -> bool:
+        """Whether the invoke path may defer ops into fused segments.
+        NaiveEngine forces flush-per-op (the reference's behavior: the
+        debug engine never bulks); the env var is read live so tests and
+        users can toggle at runtime, as with the reference's knobs.
+        The raw value is memoized against the environ entry itself —
+        this property runs once per op dispatch."""
+        if self._type == "NaiveEngine":
+            return False
+        raw = _raw_env(b"MXNET_EXEC_BULK_EXEC_TRAIN",
+                       "MXNET_EXEC_BULK_EXEC_TRAIN")
+        if raw != self._bulk_raw:
+            self._bulk_parsed = bool(get_env("MXNET_EXEC_BULK_EXEC_TRAIN"))
+            self._bulk_raw = raw
+        return self._bulk_parsed
+
+    @property
+    def bulk_size(self) -> int:
+        """Max ops per segment (reference: MXNET_ENGINE_BULK_SIZE)."""
+        n = get_env("MXNET_ENGINE_BULK_SIZE")
+        return max(1, int(n))
+
+    @property
+    def bulk_fuse_mode(self) -> str:
+        """Segment codegen mode: 'exact' (default — one dispatch per
+        segment but per-op kernels, BITWISE identical to the unbulked
+        path) or 'aggressive' (full XLA fusion: fastest, enables taped
+        segments, allows FMA contraction ⇒ ~1-ulp drift)."""
+        raw = _raw_env(b"MXNET_ENGINE_BULK_FUSE", "MXNET_ENGINE_BULK_FUSE")
+        if raw != self._fuse_raw:
+            v = (raw or b"exact").strip().lower()
+            self._fuse_parsed = "aggressive" \
+                if v in (b"aggressive", "aggressive") else "exact"
+            self._fuse_raw = raw
+        return self._fuse_parsed
 
     # -- dispatch hooks ----------------------------------------------------
     def on_push(self, op_name: str, outputs: Any,
@@ -79,7 +191,28 @@ class Engine:
             import jax
             jax.block_until_ready(outputs)
 
+    def on_bulk_flush(self, n_ops: int, cache_hit,
+                      flush_us: float = 0.0) -> None:
+        """A segment of ``n_ops`` deferred ops executed as one fused
+        dispatch.  cache_hit: True/False = the fused-executable cache was
+        consulted; None = it never was (fully-dead segment, nothing ran)
+        — counted in neither hits nor misses."""
+        self._segments_flushed += 1
+        self._bulked_ops_flushed += n_ops
+        if cache_hit is not None:
+            if cache_hit:
+                self._segment_cache_hits += 1
+            else:
+                self._segment_cache_misses += 1
+        for fn in self._listeners:
+            fn(f"_BulkFlush[{n_ops}]", (), flush_us)
+
     def add_listener(self, fn) -> None:
+        """Install a dispatch listener (profiler/monitor).  Listeners
+        need REAL per-op outputs, so bulking suspends while any listener
+        is installed — the invoke path checks ``_listeners`` directly;
+        anything already deferred flushes on its usual sync points (the
+        listener then sees the ``_BulkFlush[n]`` event)."""
         self._listeners.append(fn)
 
     def remove_listener(self, fn) -> None:
@@ -90,10 +223,40 @@ class Engine:
     def num_ops_dispatched(self) -> int:
         return self._num_ops
 
+    # -- statistics (the "bulk/dispatch-statistics hook") ------------------
+    def stats(self) -> dict:
+        """Dispatch/bulking counters.  ``ops_dispatched`` counts per-op XLA
+        dispatches (unbulked path), ``ops_bulked`` ops deferred into
+        segments; their sum is every op that entered the invoke path.
+        Mean segment length is over FLUSHED segments."""
+        flushed = self._segments_flushed
+        return {
+            "ops_dispatched": self._num_ops,
+            "ops_bulked": self._ops_bulked,
+            "segments_flushed": flushed,
+            "mean_segment_length": (
+                round(self._bulked_ops_flushed / flushed, 3) if flushed
+                else 0.0),
+            "segment_cache_hits": self._segment_cache_hits,
+            "segment_cache_misses": self._segment_cache_misses,
+        }
+
+    def reset_stats(self) -> None:
+        self._num_ops = 0
+        self._ops_bulked = 0
+        self._segments_flushed = 0
+        self._bulked_ops_flushed = 0
+        self._segment_cache_hits = 0
+        self._segment_cache_misses = 0
+
     # -- sync points -------------------------------------------------------
     def wait_for_var(self, data) -> None:
-        """Block until a value is computed (reference: Engine::WaitForVar)."""
+        """Block until a value is computed (reference: Engine::WaitForVar).
+        A pending bulk segment flushes first — WaitForVar is a sync point."""
+        flush_pending()
         import jax
+        if hasattr(data, "_read"):       # NDArray accepted for convenience
+            data = data._read()
         jax.block_until_ready(data)
 
     def wait_all(self) -> None:
@@ -105,6 +268,7 @@ class Engine:
         longer exists" (deleted/donated while we iterate the live list —
         an expected race) are suppressed.
         """
+        flush_pending()
         import jax
         for arr in jax.live_arrays():
             try:
@@ -117,7 +281,10 @@ class Engine:
 
 
 def engine() -> Engine:
-    return Engine.get()
+    # lock-free fast path: the singleton never changes once created, and
+    # the invoke hot path calls this per op
+    inst = Engine._inst
+    return inst if inst is not None else Engine.get()
 
 
 def is_naive() -> bool:

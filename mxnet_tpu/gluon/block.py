@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import re
 import threading
+import warnings
 from typing import Any, Dict, List, Optional, Tuple
 
 from ..base import MXNetError
@@ -28,6 +29,7 @@ from ..context import Context, current_context
 from .. import autograd as _autograd
 from .. import random as _grandom
 from ..ndarray import NDArray
+from ..ndarray.register import _BoundedCache
 from .. import ndarray as nd_mod
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
@@ -282,11 +284,19 @@ def _param_data_maybe_traced(param: Parameter, ctx) -> NDArray:
 class HybridBlock(Block):
     """A Block whose forward can be lowered to one XLA computation."""
 
+    #: max cached compiled graphs per block (distinct shape/dtype/mode
+    #: signatures).  LRU-evicted beyond this — each entry pins a full XLA
+    #: executable, so an unbounded dict under shape-diverse inputs (the
+    #: recompile storm) was a process-lifetime memory leak.  Raise it for
+    #: genuinely many-bucket workloads (BucketingModule-style).
+    CACHED_GRAPH_LIMIT = 32
+
     def __init__(self, prefix=None, params=None):
         super().__init__(prefix=prefix, params=params)
         self._active = False
-        self._cached_graph: Dict[Tuple, Any] = {}
+        self._cached_graph = _BoundedCache(self.CACHED_GRAPH_LIMIT)
         self._flags: Dict[str, Any] = {}
+        self._recompile_warned = False
 
     def hybridize(self, active: bool = True, static_alloc: bool = False,
                   static_shape: bool = False, inline_limit: int = 2,
@@ -294,11 +304,11 @@ class HybridBlock(Block):
         self._active = active
         self._flags = dict(static_alloc=static_alloc,
                            static_shape=static_shape, **kwargs)
-        self._cached_graph = {}
+        self._cached_graph = _BoundedCache(self.CACHED_GRAPH_LIMIT)
         super().hybridize(False, **kwargs)  # children run inside our trace
 
     def cast(self, dtype):
-        self._cached_graph = {}
+        self._cached_graph = _BoundedCache(self.CACHED_GRAPH_LIMIT)
         super().cast(dtype)
 
     def infer_shape(self, *args) -> None:
@@ -354,7 +364,18 @@ class HybridBlock(Block):
         entry = self._cached_graph.get(sig)
         if entry is None:
             entry = self._build_cached(inputs, training, ctx)
-            self._cached_graph[sig] = entry
+            evicting = (self._cached_graph.cache_info()["currsize"]
+                        >= self.CACHED_GRAPH_LIMIT)
+            self._cached_graph.put(sig, entry)
+            if evicting and not self._recompile_warned:
+                self._recompile_warned = True
+                warnings.warn(
+                    f"HybridBlock {self.name!r} compiled more than "
+                    f"{self.CACHED_GRAPH_LIMIT} distinct input "
+                    "signatures; oldest executables are now LRU-"
+                    "evicted (recompile storm — consider bucketing "
+                    "input shapes or raising CACHED_GRAPH_LIMIT)",
+                    RuntimeWarning, stacklevel=3)
         jitted, jitted_vjp, params, meta = entry
         n_outs_cell, write_idx_cell = meta
 

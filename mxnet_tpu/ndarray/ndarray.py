@@ -24,6 +24,7 @@ import numpy as _np
 
 from ..base import MXNetError, dtype_np, jax_compute_dtype, default_dtype
 from ..context import Context, current_context
+from ..engine import PendingValue as _PendingValue
 from .. import autograd as _autograd
 
 __all__ = ["NDArray", "array", "from_jax", "zeros", "ones", "empty", "full",
@@ -97,9 +98,22 @@ class NDArray:
         return nd
 
     def _read(self):
-        """Current jax value (possibly an in-flight future)."""
+        """Current jax value (possibly an in-flight future).
+
+        The pending-value barrier: if this array's producer sits in an
+        unflushed bulk segment (register.py), the whole segment executes
+        as one fused dispatch before the value is returned — reads are
+        sync points exactly as in the reference engine."""
         if self._base is None:
-            return self._data
+            d = self._data
+            if type(d) is _PendingValue:
+                d.segment.flush()
+                d = self._data
+                if type(d) is _PendingValue:
+                    raise MXNetError(
+                        "bulked segment failed at an earlier sync point: "
+                        f"{d.segment.error!r}")
+            return d
         rootver = self._root()._version
         if self._cache is not None and self._cache[0] == rootver:
             return self._cache[1]

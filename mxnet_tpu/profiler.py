@@ -82,6 +82,12 @@ class Profiler:
             except RuntimeError:
                 pass
         self._running = False
+        # drop the engine tap: an installed listener makes every invoke
+        # pay dispatch timing AND suspends bulked dispatch — a stopped
+        # profiler must cost nothing (start() re-installs)
+        if self._listener_installed:
+            engine().remove_listener(self._on_op)
+            self._listener_installed = False
 
     # -- output ------------------------------------------------------------
     def dump(self, finished: bool = True) -> None:
@@ -106,6 +112,17 @@ class Profiler:
         for name, calls, total, avg, mn, mx in rows:
             lines.append(f"{name:<32}{calls:>8}{total:>14.1f}"
                          f"{avg:>12.1f}{mn:>12.1f}{mx:>12.1f}\n")
+        # the engine's bulk/dispatch counters ride along.  While the
+        # profiler is installed, bulking suspends (listeners need real
+        # per-op outputs), so the rows above are true per-op dispatch
+        # costs; this footer still reports what bulking did around the
+        # profiled window (segments, mean length, fused-exec cache rate)
+        s = engine().stats()
+        lines.append("\nengine dispatch/bulking stats:\n")
+        for k in ("ops_dispatched", "ops_bulked", "segments_flushed",
+                  "mean_segment_length", "segment_cache_hits",
+                  "segment_cache_misses"):
+            lines.append(f"  {k:<24}{s[k]}\n")
         return "".join(lines)
 
     def reset(self) -> None:

@@ -1,0 +1,370 @@
+"""Bulked eager dispatch (lazy op-fusion segments, register.py/engine.py):
+bitwise equivalence of bulked vs naive execution for op chains (including
+in-place ops, autograd, random ops forcing flush), flush-on-read semantics,
+env-var gating, MXNET_ENGINE_BULK_SIZE cap, and the engine stats counters."""
+import os
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import autograd
+from mxnet_tpu.base import MXNetError
+from mxnet_tpu.engine import PendingValue, engine
+from mxnet_tpu.ndarray import register as ndreg
+
+
+def _is_pending(arr) -> bool:
+    return type(arr._data) is PendingValue
+
+
+@pytest.fixture(autouse=True)
+def _bulk_env(monkeypatch):
+    """Each test starts bulked (the default), threaded, with fresh stats;
+    whatever it toggles is restored afterwards."""
+    eng = engine()
+    monkeypatch.setenv("MXNET_EXEC_BULK_EXEC_TRAIN", "1")
+    monkeypatch.delenv("MXNET_ENGINE_BULK_SIZE", raising=False)
+    prev = eng.engine_type
+    saved_listeners = list(eng._listeners)
+    eng._listeners.clear()            # a leaked listener suspends bulking
+    eng.set_engine_type("ThreadedEnginePerDevice")
+    eng.reset_stats()
+    yield eng
+    ndreg.flush_segment()
+    eng.set_engine_type(prev)
+    eng._listeners[:] = saved_listeners
+
+
+def _chain(x, a, b):
+    """A representative fusable chain: elementwise, broadcast, matmul,
+    reduction, reshape/transpose, in-place mutation, scalar dunders."""
+    y = x * a + b
+    y = mx.nd.tanh(y) * 0.5 + x
+    z = mx.nd.dot(y, y.T)
+    z = z + mx.nd.sum(y, axis=1, keepdims=True)
+    w = z.reshape((-1,))
+    m = mx.nd.max(w)
+    y += 1.0                       # in-place bump (a flush point)
+    q = y * y - mx.nd.mean(y)
+    return [z, w, m, q]
+
+
+def _run_both(fn):
+    """Run fn() bulked and under NaiveEngine, return both output lists."""
+    eng = engine()
+    os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "1"
+    eng.set_engine_type("ThreadedEnginePerDevice")
+    bulked = [o.asnumpy() for o in fn()]
+    eng.set_engine_type("NaiveEngine")
+    naive = [o.asnumpy() for o in fn()]
+    eng.set_engine_type("ThreadedEnginePerDevice")
+    return bulked, naive
+
+
+# -- bitwise equivalence ----------------------------------------------------
+
+def test_bitwise_equivalence_chain():
+    rng = np.random.default_rng(0)
+    xv = rng.standard_normal((8, 6)).astype(np.float32)
+    av = rng.standard_normal((8, 6)).astype(np.float32)
+    bv = rng.standard_normal((6,)).astype(np.float32)
+
+    def run():
+        return _chain(mx.nd.array(xv), mx.nd.array(av), mx.nd.array(bv))
+
+    bulked, naive = _run_both(run)
+    for got, want in zip(bulked, naive):
+        np.testing.assert_array_equal(got, want)   # BITWISE
+
+
+def test_bitwise_equivalence_autograd():
+    rng = np.random.default_rng(1)
+    xv = rng.standard_normal((5, 4)).astype(np.float32)
+    wv = rng.standard_normal((5, 4)).astype(np.float32)
+
+    def run():
+        x = mx.nd.array(xv)
+        w = mx.nd.array(wv)
+        w.attach_grad()
+        with autograd.record():
+            h = mx.nd.tanh(w * x + 1.0)
+            h = h * h + x
+            loss = mx.nd.sum(h * 0.25)
+        loss.backward()
+        return [loss, w.grad]
+
+    bulked, naive = _run_both(run)
+    for got, want in zip(bulked, naive):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_bitwise_equivalence_random_forces_flush(_bulk_env):
+    """Random ops consume the seeded stream, so they force a flush and run
+    eagerly; with equal seeds the bulked and naive runs must still agree."""
+    def run():
+        mx.random.seed(77)
+        x = mx.nd.ones((4, 3)) * 2.0
+        r = mx.nd.random.uniform(shape=(4, 3))
+        return [x * r + 1.0, r]
+
+    bulked, naive = _run_both(run)
+    for got, want in zip(bulked, naive):
+        np.testing.assert_array_equal(got, want)
+
+
+def test_inplace_write_not_observed_by_deferred_op(_bulk_env):
+    """A deferred op reads its inputs AS OF defer time (the unbulked
+    path's ordering): mutating an input before the flush must not change
+    the deferred result."""
+    x = mx.nd.array(np.arange(6, dtype=np.float32))
+    y = x * 2.0                       # deferred, captures x@v0
+    x += 100.0                        # version bump (flush point for x)
+    np.testing.assert_array_equal(
+        y.asnumpy(), np.arange(6, dtype=np.float32) * 2.0)
+    np.testing.assert_array_equal(
+        x.asnumpy(), np.arange(6, dtype=np.float32) + 100.0)
+
+
+# -- flush-on-read / sync-point semantics -----------------------------------
+
+def test_flush_on_read(_bulk_env):
+    x = mx.nd.ones((3, 3))
+    y = x * 3.0
+    assert _is_pending(y)
+    before = _bulk_env.stats()["segments_flushed"]
+    np.testing.assert_array_equal(y.asnumpy(), np.full((3, 3), 3.0,
+                                                       np.float32))
+    after = _bulk_env.stats()
+    assert after["segments_flushed"] == before + 1
+    assert not _is_pending(y)
+
+
+def test_flush_on_wait_to_read_and_wait_all(_bulk_env):
+    y = mx.nd.ones((2,)) + 1.0
+    assert _is_pending(y)
+    y.wait_to_read()
+    assert not _is_pending(y)
+    z = mx.nd.ones((2,)) * 4.0
+    assert _is_pending(z)
+    mx.nd.waitall()
+    assert not _is_pending(z)
+    np.testing.assert_array_equal(z.asnumpy(), [4.0, 4.0])
+
+
+def test_view_of_pending_flushes_root(_bulk_env):
+    x = mx.nd.ones((2, 4))
+    y = x * 5.0
+    assert _is_pending(y)
+    v = y.reshape((4, 2))             # view read materializes the root
+    np.testing.assert_array_equal(v.asnumpy(),
+                                  np.full((4, 2), 5.0, np.float32))
+    assert not _is_pending(y)
+
+
+def test_nonfusable_op_flushes(_bulk_env):
+    y = mx.nd.ones((3,)) * 2.0
+    assert _is_pending(y)
+    r = mx.nd.random.uniform(shape=(3,))   # sampling op: flush point
+    assert not _is_pending(y)
+    assert r.shape == (3,)
+
+
+def test_out_kwarg_flushes_and_writes(_bulk_env):
+    x = mx.nd.ones((3,))
+    tgt = mx.nd.zeros((3,))
+    y = x + 2.0
+    assert _is_pending(y)
+    mx.nd.broadcast_mul(y, x, out=tgt)     # out= is a flush point
+    np.testing.assert_array_equal(tgt.asnumpy(), [3.0, 3.0, 3.0])
+    assert not _is_pending(y)
+
+
+def test_multi_output_op_in_segment(_bulk_env):
+    x = mx.nd.array(np.arange(8, dtype=np.float32).reshape(2, 4))
+    parts = mx.nd.split(x + 1.0, num_outputs=2, axis=1)
+    got = np.concatenate([p.asnumpy() for p in parts], axis=1)
+    np.testing.assert_array_equal(
+        got, np.arange(8, dtype=np.float32).reshape(2, 4) + 1.0)
+
+
+# -- gating -----------------------------------------------------------------
+
+def test_env_var_gating(_bulk_env):
+    os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "0"
+    y = mx.nd.ones((2,)) + 1.0
+    assert not _is_pending(y)              # dispatched eagerly
+    os.environ["MXNET_EXEC_BULK_EXEC_TRAIN"] = "1"
+    z = mx.nd.ones((2,)) + 1.0
+    assert _is_pending(z)
+    z.wait_to_read()
+
+
+def test_naive_engine_forces_per_op_sync(_bulk_env):
+    _bulk_env.set_engine_type("NaiveEngine")
+    y = mx.nd.ones((2,)) + 1.0
+    assert not _is_pending(y)
+    s = _bulk_env.stats()
+    assert s["ops_bulked"] == 0 and s["ops_dispatched"] >= 1
+
+
+def test_engine_type_switch_flushes(_bulk_env):
+    y = mx.nd.ones((2,)) * 7.0
+    assert _is_pending(y)
+    _bulk_env.set_engine_type("NaiveEngine")   # switch is a sync point
+    assert not _is_pending(y)
+
+
+def test_bulk_size_cap(_bulk_env, monkeypatch):
+    monkeypatch.setenv("MXNET_ENGINE_BULK_SIZE", "4")
+    x = mx.nd.ones((2,))
+    y = x
+    for _ in range(8):
+        y = y + 1.0
+    # 8 ops, cap 4 → two full segments flushed by the cap alone
+    s = _bulk_env.stats()
+    assert s["segments_flushed"] == 2
+    assert s["mean_segment_length"] == 4.0
+    np.testing.assert_array_equal(y.asnumpy(), [9.0, 9.0])
+
+
+# -- stats / cache ----------------------------------------------------------
+
+def test_stats_counters_and_segment_cache(_bulk_env):
+    xv = np.ones((3, 3), np.float32)
+
+    def run():
+        y = mx.nd.array(xv) * 2.0 + 1.0
+        return mx.nd.sum(y)
+
+    run().asnumpy()
+    s1 = _bulk_env.stats()
+    assert s1["ops_bulked"] == 3 and s1["segments_flushed"] == 1
+    assert s1["mean_segment_length"] == 3.0
+    run().asnumpy()                      # identical signature → cache hit
+    s2 = _bulk_env.stats()
+    assert s2["segments_flushed"] == 2
+    assert s2["segment_cache_hits"] >= s1["segment_cache_hits"] + 1
+
+
+def test_operator_cache_info_surface():
+    op = ndreg.get_op("broadcast_add")
+    info = op.cache_info()
+    assert set(info) == {"fn", "vjp"}
+    for half in info.values():
+        assert half["maxsize"] == ndreg.OP_FN_CACHE_SIZE
+        assert half["currsize"] <= half["maxsize"]
+    assert "maxsize" in ndreg.segment_cache_info()
+
+
+def test_autograd_taped_segment_shares_one_tape_node(_bulk_env,
+                                                     monkeypatch):
+    """Aggressive fusion mode: a whole recorded run becomes ONE tape node
+    via one jax.vjp over the fused forward.  (The default exact mode
+    keeps the tape per-op — trivially bitwise — and is covered by
+    test_bitwise_equivalence_autograd.)"""
+    monkeypatch.setenv("MXNET_ENGINE_BULK_FUSE", "aggressive")
+    x = mx.nd.ones((2, 2))
+    x.attach_grad()
+    with autograd.record():
+        a = x * 2.0
+        b = a + 1.0
+        c = mx.nd.sum(b * a)
+    assert _is_pending(c)
+    assert a._ag is not None and b._ag is not None
+    assert a._ag.node is b._ag.node is c._ag.node   # ONE fused tape node
+    c.backward()
+    # d/dx sum((2x+1)*2x) = 8x + 2
+    np.testing.assert_array_equal(x.grad.asnumpy(),
+                                  np.full((2, 2), 10.0, np.float32))
+
+
+def test_aggressive_mode_close_and_counted(_bulk_env, monkeypatch):
+    """Aggressive fusion trades the bitwise guarantee for full XLA fusion
+    (FMA contraction ⇒ ≤ ~1 ulp drift): results must stay allclose to
+    the unbulked path at float32 epsilon tightness, and training through
+    a fused taped segment must produce correct gradients."""
+    monkeypatch.setenv("MXNET_ENGINE_BULK_FUSE", "aggressive")
+    rng = np.random.default_rng(3)
+    xv = rng.standard_normal((6, 5)).astype(np.float32)
+    wv = rng.standard_normal((6, 5)).astype(np.float32)
+
+    def run():
+        x = mx.nd.array(xv)
+        w = mx.nd.array(wv)
+        w.attach_grad()
+        with autograd.record():
+            h = mx.nd.tanh(w * x + 1.0) * x + w
+            loss = mx.nd.sum(h * h)
+        loss.backward()
+        return [loss, w.grad]
+
+    bulked = [o.asnumpy() for o in run()]
+    _bulk_env.set_engine_type("NaiveEngine")
+    naive = [o.asnumpy() for o in run()]
+    _bulk_env.set_engine_type("ThreadedEnginePerDevice")
+    for got, want in zip(bulked, naive):
+        np.testing.assert_allclose(got, want, rtol=3e-7, atol=1e-6)
+
+
+def test_recording_toggle_splits_segments(_bulk_env):
+    x = mx.nd.ones((2,))
+    y = x * 2.0                        # untaped segment
+    x.attach_grad()
+    with autograd.record():
+        z = x * 3.0                    # recording flipped → new segment
+        loss = mx.nd.sum(z * y)
+    loss.backward()
+    np.testing.assert_array_equal(x.grad.asnumpy(), [6.0, 6.0])
+    np.testing.assert_array_equal(y.asnumpy(), [2.0, 2.0])
+
+
+def test_segment_error_surfaces_at_sync_point(_bulk_env):
+    a = mx.nd.ones((2, 3))
+    b = mx.nd.ones((4, 5))
+    # shape mismatch raises AT INVOKE (aval inference runs the op's real
+    # shape rules eagerly), exactly like the unbulked path
+    with pytest.raises(Exception):
+        mx.nd.dot(a, b)
+
+
+def test_cross_thread_read_flushes(_bulk_env):
+    """Reading a pending array from ANOTHER thread flushes the owning
+    segment (flush is on the segment object, not thread state), and the
+    owning thread starts a fresh segment afterwards."""
+    import threading
+    y = mx.nd.ones((3,)) * 4.0
+    assert _is_pending(y)
+    got = {}
+
+    def reader():
+        got["val"] = y.asnumpy()
+    t = threading.Thread(target=reader)
+    t.start()
+    t.join()
+    np.testing.assert_array_equal(got["val"], [4.0, 4.0, 4.0])
+    z = mx.nd.ones((3,)) + 1.0       # must land in a FRESH segment
+    np.testing.assert_array_equal(z.asnumpy(), [2.0, 2.0, 2.0])
+
+
+def test_listeners_suspend_bulking(_bulk_env):
+    """Profiler/monitor listeners need real per-op outputs, so bulking
+    suspends while one is installed (true per-op events, values
+    attached); a segment pending from BEFORE the install still flushes
+    visibly as a _BulkFlush event."""
+    pending = mx.nd.ones((2,)) * 3.0
+    assert _is_pending(pending)
+    events = []
+    _bulk_env.add_listener(
+        lambda name, outs, us: events.append((name, outs)))
+    try:
+        y = mx.nd.ones((2,)) + 1.0           # dispatched eagerly now
+        assert not _is_pending(y)
+        pending.wait_to_read()               # old segment flush -> event
+    finally:
+        _bulk_env._listeners.clear()
+    names = [n for n, _ in events]
+    assert "_plus_scalar" in names           # NDArray + scalar dispatch
+    outs = dict(events)["_plus_scalar"]
+    assert len(outs) == 1 and outs[0].shape == (2,)   # REAL outputs
+    assert any(n.startswith("_BulkFlush") for n in names)
