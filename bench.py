@@ -175,6 +175,7 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
     prev = {k: os.environ.get(k) for k in
             ("MXNET_EXEC_BULK_EXEC_TRAIN", "MXNET_ENGINE_BULK_FUSE")}
     results = {}
+    per_op_us = None
     try:
         for mode, etype, bulk, fuse in (
                 ("bulk", "ThreadedEnginePerDevice", "1", "exact"),
@@ -195,6 +196,7 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
             results[mode] = ops_per_iter * iters / min(passes)
             if mode == "bulk":
                 stats = eng.stats()
+                per_op_us = min(passes) / (ops_per_iter * iters) * 1e6
     finally:
         eng.set_engine_type(prev_type)
         for k, v in prev.items():
@@ -202,6 +204,8 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
                 os.environ.pop(k, None)
             else:
                 os.environ[k] = v
+    overhead = _metrics_overhead_pct(per_op_us,
+                                     stats["mean_segment_length"] or 15)
     return {"ops_per_sec_bulk": round(results["bulk"], 1),
             "ops_per_sec_bulk_aggressive": round(
                 results["bulk_aggressive"], 1),
@@ -215,7 +219,50 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
                 stats["segment_cache_hits"] /
                 max(1, stats["segment_cache_hits"]
                     + stats["segment_cache_misses"]), 3),
+            # per-flush latency distribution (engine.flush_us histogram)
+            # — the MXNET_ENGINE_BULK_SIZE auto-tune groundwork: p50 is
+            # the steady-state (cache-hit) flush, p99 catches compiles
+            "flush_us_p50": stats["flush_us_p50"],
+            "flush_us_p99": stats["flush_us_p99"],
+            # observability tax on the bulk row (measured, see helper) —
+            # the <3% overhead guard reported honestly
+            "metrics_overhead_pct": overhead,
             "host_cores": _host_cores()}
+
+
+def _metrics_overhead_pct(per_op_us, mean_segment_len,
+                          reps=200_000) -> float:
+    """Measured cost of the registry instrumentation on the bulked
+    dispatch path, as a percentage of the measured per-op dispatch time.
+
+    Per deferred op the path pays ONE counter bump (`eng._c_bulked.n`);
+    per flushed segment it pays three counter bumps, one histogram
+    observe, and one perf_counter() pair.  Time those primitives
+    directly and amortize the per-segment part over the mean segment
+    length — an in-run measurement rather than a cross-run diff, so a
+    shared CI host's load spikes can't masquerade as regression."""
+    # unregistered instances: probe metrics must not pollute the global
+    # registry (they would ride every later scrape/JSONL line)
+    from mxnet_tpu.observability.registry import Counter, Histogram
+    c = Counter("bench.overhead_probe")
+    h = Histogram("bench.overhead_probe_us")
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        c.n += 1
+    bump_us = (time.perf_counter() - t0) / reps * 1e6
+    t0 = time.perf_counter()
+    for _ in range(reps // 10):
+        h.observe(7.3)
+    observe_us = (time.perf_counter() - t0) / (reps // 10) * 1e6
+    t0 = time.perf_counter()
+    for _ in range(reps // 10):
+        time.perf_counter()
+    clock_us = (time.perf_counter() - t0) / (reps // 10) * 1e6
+    per_op = bump_us + (3 * bump_us + observe_us + 2 * clock_us) \
+        / max(1.0, mean_segment_len)
+    if not per_op_us:
+        return 0.0
+    return round(per_op / per_op_us * 100.0, 3)
 
 
 def bench_bert_base(iters=10, warmup=3, batch=8, seq=256,

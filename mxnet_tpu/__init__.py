@@ -74,6 +74,14 @@ from . import operator
 ndarray.Custom = operator.Custom     # reference surface: mx.nd.Custom
 from . import rtc
 from . import test_utils
+from . import observability
+# opt-in exporters: a Prometheus /metrics endpoint when
+# MXTPU_METRICS_PORT is set, a periodic JSONL snapshot writer when
+# MXTPU_METRICS_JSONL is set; no cost (export never even imports)
+# otherwise
+if _os.environ.get("MXTPU_METRICS_PORT") \
+        or _os.environ.get("MXTPU_METRICS_JSONL"):
+    observability.export.maybe_start_from_env()
 
 
 def waitall() -> None:

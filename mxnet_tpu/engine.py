@@ -32,6 +32,7 @@ import threading
 from typing import Any
 
 from .base import get_env
+from .observability.registry import registry as _metrics_registry
 
 __all__ = ["Engine", "engine", "is_naive", "wait_all", "PendingValue"]
 
@@ -96,7 +97,6 @@ class Engine:
     def __init__(self):
         self._type = os.environ.get("MXNET_ENGINE_TYPE",
                                     "ThreadedEnginePerDevice")
-        self._num_ops = 0
         # profiler hooks: fn(op_name, outputs, dispatch_us)
         self._listeners = []
         # bulk_enabled memo: (raw env string, parsed bool) — the invoke
@@ -106,12 +106,18 @@ class Engine:
         self._bulk_parsed = True
         self._fuse_raw = object()
         self._fuse_parsed = "exact"
-        # bulking counters (see stats())
-        self._ops_bulked = 0
-        self._segments_flushed = 0
-        self._bulked_ops_flushed = 0
-        self._segment_cache_hits = 0
-        self._segment_cache_misses = 0
+        # dispatch/bulking counters live in the process-global metrics
+        # registry (mxnet_tpu.observability) under `engine.*`; stats()
+        # below is a thin back-compat view.  Hot paths bump `.n` directly
+        # — the same plain int add the former attributes were.
+        reg = _metrics_registry()
+        self._c_dispatched = reg.counter("engine.ops_dispatched")
+        self._c_bulked = reg.counter("engine.ops_bulked")
+        self._c_segments = reg.counter("engine.segments_flushed")
+        self._c_bulked_flushed = reg.counter("engine.bulked_ops_flushed")
+        self._c_cache_hits = reg.counter("engine.segment_cache_hits")
+        self._c_cache_misses = reg.counter("engine.segment_cache_misses")
+        self._h_flush = reg.histogram("engine.flush_us")
 
     @classmethod
     def get(cls) -> "Engine":
@@ -184,7 +190,7 @@ class Engine:
         In NaiveEngine mode, block until the results are ready — the direct
         analog of the reference's synchronous debug engine.
         """
-        self._num_ops += 1
+        self._c_dispatched.n += 1
         for fn in self._listeners:
             fn(op_name, outputs, dispatch_us)
         if self.is_naive:
@@ -196,14 +202,17 @@ class Engine:
         """A segment of ``n_ops`` deferred ops executed as one fused
         dispatch.  cache_hit: True/False = the fused-executable cache was
         consulted; None = it never was (fully-dead segment, nothing ran)
-        — counted in neither hits nor misses."""
-        self._segments_flushed += 1
-        self._bulked_ops_flushed += n_ops
+        — counted in neither hits nor misses.  ``flush_us`` (measured by
+        the segment builder) lands in the ``engine.flush_us`` histogram —
+        the signal the MXNET_ENGINE_BULK_SIZE auto-tune follow-up needs."""
+        self._c_segments.n += 1
+        self._c_bulked_flushed.n += n_ops
         if cache_hit is not None:
             if cache_hit:
-                self._segment_cache_hits += 1
+                self._c_cache_hits.n += 1
             else:
-                self._segment_cache_misses += 1
+                self._c_cache_misses.n += 1
+        self._h_flush.observe(flush_us)
         for fn in self._listeners:
             fn(f"_BulkFlush[{n_ops}]", (), flush_us)
 
@@ -221,33 +230,38 @@ class Engine:
 
     @property
     def num_ops_dispatched(self) -> int:
-        return self._num_ops
+        return self._c_dispatched.n
 
     # -- statistics (the "bulk/dispatch-statistics hook") ------------------
     def stats(self) -> dict:
-        """Dispatch/bulking counters.  ``ops_dispatched`` counts per-op XLA
-        dispatches (unbulked path), ``ops_bulked`` ops deferred into
-        segments; their sum is every op that entered the invoke path.
-        Mean segment length is over FLUSHED segments."""
-        flushed = self._segments_flushed
+        """Dispatch/bulking counters — a back-compat VIEW over the
+        ``engine.*`` metrics in the observability registry (one
+        ``registry().snapshot()`` returns these plus every other
+        subsystem's).  ``ops_dispatched`` counts per-op XLA dispatches
+        (unbulked path), ``ops_bulked`` ops deferred into segments; their
+        sum is every op that entered the invoke path.  Mean segment
+        length is over FLUSHED segments; flush latency percentiles come
+        from the ``engine.flush_us`` histogram."""
+        flushed = self._c_segments.n
+        flush_h = self._h_flush.read()
         return {
-            "ops_dispatched": self._num_ops,
-            "ops_bulked": self._ops_bulked,
+            "ops_dispatched": self._c_dispatched.n,
+            "ops_bulked": self._c_bulked.n,
             "segments_flushed": flushed,
             "mean_segment_length": (
-                round(self._bulked_ops_flushed / flushed, 3) if flushed
+                round(self._c_bulked_flushed.n / flushed, 3) if flushed
                 else 0.0),
-            "segment_cache_hits": self._segment_cache_hits,
-            "segment_cache_misses": self._segment_cache_misses,
+            "segment_cache_hits": self._c_cache_hits.n,
+            "segment_cache_misses": self._c_cache_misses.n,
+            "flush_us_p50": flush_h["p50"],
+            "flush_us_p99": flush_h["p99"],
         }
 
     def reset_stats(self) -> None:
-        self._num_ops = 0
-        self._ops_bulked = 0
-        self._segments_flushed = 0
-        self._bulked_ops_flushed = 0
-        self._segment_cache_hits = 0
-        self._segment_cache_misses = 0
+        for m in (self._c_dispatched, self._c_bulked, self._c_segments,
+                  self._c_bulked_flushed, self._c_cache_hits,
+                  self._c_cache_misses, self._h_flush):
+            m.reset()
 
     # -- sync points -------------------------------------------------------
     def wait_for_var(self, data) -> None:

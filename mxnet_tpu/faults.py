@@ -58,6 +58,20 @@ class DeadlineExceeded(MXNetError):
 
 # -- retry / deadline utilities ---------------------------------------------
 
+_c_retries = None
+
+
+def _retry_counter():
+    """Process-wide `faults.retries` counter, created on first retry.
+    Lazy so this module (imported at package init, before the
+    observability package) never races the import order."""
+    global _c_retries
+    if _c_retries is None:
+        from .observability.registry import registry
+        _c_retries = registry().counter("faults.retries")
+    return _c_retries
+
+
 def retry_call(fn: Callable, *args,
                retries: int = 3,
                base_delay: float = 0.05,
@@ -88,6 +102,7 @@ def retry_call(fn: Callable, *args,
             if attempt > retries or (deadline is not None and
                                      deadline.expired):
                 raise
+            _retry_counter().inc()   # every retry anywhere in the stack
             delay = min(max_delay, base_delay * (2.0 ** (attempt - 1)))
             if jitter:
                 delay *= 1.0 + jitter * _pyrandom.random()

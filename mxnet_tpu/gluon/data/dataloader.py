@@ -27,6 +27,8 @@ import numpy as _np
 from ...base import MXNetError
 from ...faults import TransientFault, active_plan, retry_call
 from ...ndarray import NDArray, array as nd_array
+from ...observability.registry import registry as _metrics_registry
+from ...observability.trace import span as _span
 from .sampler import BatchSampler, RandomSampler, SequentialSampler
 
 # worker failures worth retrying: injected faults and flaky I/O — a broken
@@ -82,13 +84,22 @@ class DataLoader:
         self._worker_retries = max(0, int(worker_retries))
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * num_workers)
+        # `loader.*` observability metrics (process-global; see
+        # mxnet_tpu.observability): batches built, per-batch build time,
+        # transient worker retries
+        reg = _metrics_registry()
+        self._c_batches = reg.counter("loader.batches")
+        self._c_retries = reg.counter("loader.worker_retries")
 
     def __len__(self):
         return len(self._batch_sampler)
 
     def _make_batch(self, indices):
-        samples = [self._dataset[i] for i in indices]
-        return self._batchify_fn(samples)
+        with _span("loader.batch_build_us"):
+            samples = [self._dataset[i] for i in indices]
+            batch = self._batchify_fn(samples)
+        self._c_batches.inc()         # lock-exact: workers race this
+        return batch
 
     def _worker_batch(self, batch_idx, indices, active):
         """Build one batch in a worker thread: fault-plan hooks, bounded
@@ -110,10 +121,14 @@ class DataLoader:
                     plan.fire("loader_error", batch_idx + 1)
                 return self._make_batch(indices)
 
+            def on_retry(attempt_no, exc, delay):
+                self._c_retries.inc()
+
             try:
                 return retry_call(attempt, retries=self._worker_retries,
                                   base_delay=0.02, max_delay=1.0,
-                                  retry_on=_RETRYABLE_WORKER_ERRORS)
+                                  retry_on=_RETRYABLE_WORKER_ERRORS,
+                                  on_retry=on_retry)
             except Exception as exc:
                 raise MXNetError(
                     f"DataLoader worker {worker!r} failed on batch "

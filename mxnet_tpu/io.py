@@ -29,6 +29,8 @@ import numpy as _np
 from .base import MXNetError
 from .context import cpu
 from .ndarray import NDArray, array as nd_array
+from .observability.registry import registry as _metrics_registry
+from .observability.trace import span as _span
 
 __all__ = ["DataDesc", "DataBatch", "DataIter", "NDArrayIter", "CSVIter",
            "MNISTIter", "ImageRecordIter", "PrefetchingIter", "ResizeIter",
@@ -679,7 +681,12 @@ class ImageRecordIter(DataIter):
     def next(self) -> DataBatch:
         if self._consumed >= self._n_batches:
             raise StopIteration
-        item = self._out.get()
+        # consumer-side wait = prefetch-health signal: near-zero means
+        # decode keeps ahead of training; large means the pipeline is the
+        # bottleneck (more preprocess_threads / deeper prefetch_buffer)
+        with _span("io.record_batch_wait_us"):
+            item = self._out.get()
+        _metrics_registry().counter("io.record_batches").inc()
         if isinstance(item[0], str) and item[0] == "__error__":
             raise MXNetError(
                 f"ImageRecordIter pipeline failed: {item[1]!r}") \

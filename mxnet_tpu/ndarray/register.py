@@ -602,8 +602,11 @@ class _BulkSegment:
         if not self.nodes:
             return                    # nothing was deferred
         eng = engine()
-        _timed = bool(eng._listeners)
-        _t0 = _perf_counter() if _timed else 0.0
+        # always timed: the per-flush latency histogram (engine.flush_us)
+        # is the auto-tune signal for MXNET_ENGINE_BULK_SIZE — two
+        # perf_counter() calls per SEGMENT (not per op) is noise next to
+        # the dispatch they bracket
+        _t0 = _perf_counter()
         taped = self.tapenode is not None
         # liveness: outputs whose NDArray died (or was overwritten by an
         # in-place write) before the flush need no buffer at all
@@ -617,8 +620,7 @@ class _BulkSegment:
             # nothing observable: the whole segment is dead code — the
             # executable cache was never consulted (cache_hit=None)
             eng.on_bulk_flush(len(self.nodes), None,
-                              (_perf_counter() - _t0) * 1e6
-                              if _timed else 0.0)
+                              (_perf_counter() - _t0) * 1e6)
             return
         # device id in the key: an exact-mode executable is PINNED to its
         # device (DeviceAssignment); same-signature segments on another
@@ -658,7 +660,7 @@ class _BulkSegment:
             self.error = e
             raise
         eng.on_bulk_flush(len(self.nodes), hit,
-                          (_perf_counter() - _t0) * 1e6 if _timed else 0.0)
+                          (_perf_counter() - _t0) * 1e6)
 
 
 def flush_segment() -> None:
@@ -821,7 +823,9 @@ def _try_defer(op: Operator, nd_inputs: Sequence, kwargs: Dict[str, Any],
                                           index=node_base + i)
             outs.append(nd)
 
-        eng._ops_bulked += 1          # inlined on_bulk_push
+        eng._c_bulked.n += 1          # inlined on_bulk_push (hot-path
+        # idiom: a registry Counter's .n is a plain int — same cost as
+        # the former private attribute add)
         if len(seg.nodes) >= seg.cap:
             seg._flush_locked()       # MXNET_ENGINE_BULK_SIZE cap
         return outs if multi else outs[0]

@@ -1,0 +1,46 @@
+"""Unified observability: ONE pull-based metrics surface for the stack.
+
+PR 1 (resilience) and PR 2 (bulked dispatch) each grew an ad-hoc counter
+dict (``ResilientTrainer.counters``, ``engine().stats()``); this package
+merges them — and every future metric — into a single process-global
+registry (ROADMAP follow-up for both PRs):
+
+- :mod:`.registry` — thread-safe ``Counter`` / ``Gauge`` / ``Histogram``
+  primitives under namespaced names (``engine.ops_dispatched``,
+  ``resilience.steps_skipped``, ``loader.batches``) with one
+  ``registry().snapshot()`` returning every metric in one dict.
+- :mod:`.trace` — lightweight ``span(name)`` context managers recording
+  wall-time into histograms (and echoing to engine profiler listeners
+  when installed).
+- :mod:`.export` — a Prometheus-text-format HTTP endpoint (opt-in via
+  ``MXTPU_METRICS_PORT``) and a JSONL periodic writer for headless runs
+  (``MXTPU_METRICS_JSONL``).
+
+The legacy surfaces stay as thin back-compat views: ``engine().stats()``
+and ``ResilientTrainer.counters`` read the same registry metrics.
+
+Import discipline: this ``__init__`` eagerly exposes only the
+dependency-free :mod:`.registry` (the engine imports it at module load);
+:mod:`.trace` and :mod:`.export` load lazily because they import the
+engine back — eager imports here would cycle.
+"""
+from __future__ import annotations
+
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       registry)
+
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+           "trace", "export", "span"]
+
+
+def __getattr__(name):
+    # importlib, not `from . import X`: the latter re-enters this
+    # __getattr__ while the attribute is still unbound and recurses
+    import importlib
+    if name in ("trace", "span"):
+        mod = importlib.import_module(".trace", __name__)
+        return mod if name == "trace" else mod.span
+    if name == "export":
+        return importlib.import_module(".export", __name__)
+    raise AttributeError(
+        f"module 'mxnet_tpu.observability' has no attribute {name!r}")

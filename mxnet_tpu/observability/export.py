@@ -1,0 +1,255 @@
+"""Exporters: Prometheus text endpoint + JSONL periodic writer.
+
+Two consumption shapes for the one registry:
+
+- **Pull** (:class:`MetricsServer`): a stdlib ``http.server`` endpoint
+  serving ``/metrics`` in the Prometheus text exposition format (and
+  ``/metrics.json`` for humans/scripts).  Opt-in: nothing listens unless
+  the server is started explicitly or ``MXTPU_METRICS_PORT`` is set —
+  the formatting cost exists only per scrape.
+- **Push-to-disk** (:class:`JsonlWriter`): one JSON object per line,
+  appended every ``interval`` seconds (or on explicit ``write_now()``),
+  with size-based rotation — the headless-run story where nothing can
+  scrape (batch jobs writing into a log pipeline).  Env:
+  ``MXTPU_METRICS_JSONL=<path>`` (+ ``MXTPU_METRICS_INTERVAL`` seconds,
+  default 60).
+
+``maybe_start_from_env()`` wires both from the environment; the package
+``__init__`` calls it once at import, so setting the env vars is the
+whole deployment step.
+"""
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+from ..base import MXNetError
+from .registry import Counter, Gauge, Histogram, MetricsRegistry, registry
+
+__all__ = ["prometheus_text", "MetricsServer", "JsonlWriter",
+           "maybe_start_from_env"]
+
+METRICS_PORT_ENV = "MXTPU_METRICS_PORT"
+METRICS_JSONL_ENV = "MXTPU_METRICS_JSONL"
+METRICS_INTERVAL_ENV = "MXTPU_METRICS_INTERVAL"
+
+#: every exported sample is prefixed so dashboards can scope on it
+PROM_PREFIX = "mxtpu_"
+
+_SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
+
+
+def _prom_name(name: str) -> str:
+    return PROM_PREFIX + _SANITIZE_RE.sub("_", name)
+
+
+def _fmt(v: float) -> str:
+    """Prometheus sample values: integers render bare, floats with
+    repr-precision, +Inf spelled the Prometheus way."""
+    if v == float("inf"):
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
+    """The registry in Prometheus text exposition format (version 0.0.4):
+    counters/gauges as single samples, histograms as cumulative
+    ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    reg = reg if reg is not None else registry()
+    lines = []
+    for name in reg.names():
+        m = reg.get(name)
+        if m is None:                     # raced an (hypothetical) removal
+            continue
+        pname = _prom_name(name)
+        if isinstance(m, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            lines.append(f"{pname} {_fmt(m.n)}")
+        elif isinstance(m, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            lines.append(f"{pname} {_fmt(m.value)}")
+        elif isinstance(m, Histogram):
+            lines.append(f"# TYPE {pname} histogram")
+            for bound, cum in m.cumulative_buckets():
+                lines.append(
+                    f'{pname}_bucket{{le="{_fmt(bound)}"}} {cum}')
+            lines.append(f"{pname}_sum {_fmt(m.total)}")
+            lines.append(f"{pname}_count {m.count}")
+    return "\n".join(lines) + "\n"
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxtpu-metrics"
+
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        if self.path.split("?")[0] == "/metrics":
+            body = prometheus_text().encode()
+            ctype = "text/plain; version=0.0.4; charset=utf-8"
+        elif self.path.split("?")[0] == "/metrics.json":
+            body = json.dumps(registry().snapshot(), sort_keys=True,
+                              indent=1).encode()
+            ctype = "application/json"
+        else:
+            self.send_error(404, "try /metrics or /metrics.json")
+            return
+        self.send_response(200)
+        self.send_header("Content-Type", ctype)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+    def log_message(self, fmt, *args):   # no stderr chatter per scrape
+        pass
+
+
+class MetricsServer:
+    """Serve the registry over HTTP on a daemon thread.
+
+    ``port=0`` binds an ephemeral port (tests); the bound port is
+    ``server.port``.  ``stop()`` shuts the listener down; the server also
+    dies with the process (daemon thread) — scrape targets need no
+    shutdown ceremony.
+    """
+
+    def __init__(self, port: int, addr: str = "0.0.0.0",
+                 start: bool = True):
+        self._httpd = ThreadingHTTPServer((addr, int(port)), _Handler)
+        self._httpd.daemon_threads = True
+        self._thread: Optional[threading.Thread] = None
+        if start:
+            self.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, kwargs={"poll_interval": 0.5},
+            daemon=True, name="mxtpu-metrics-server")
+        self._thread.start()
+
+    def stop(self) -> None:
+        if self._thread is None:
+            return
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+        self._thread = None
+
+
+class JsonlWriter:
+    """Append registry snapshots as JSON lines, with size-based rotation.
+
+    Each line is ``{"ts": <unix seconds>, "metrics": {...snapshot...}}``.
+    When the file would exceed ``max_bytes`` the current file rotates to
+    ``<path>.1`` (one generation — the consumer is a log shipper, not an
+    archive).  ``start()`` spawns a daemon thread writing every
+    ``interval`` seconds; ``write_now()`` is the synchronous path (tests,
+    end-of-run flushes).
+    """
+
+    def __init__(self, path: str, interval: float = 60.0,
+                 max_bytes: int = 16 * 1024 * 1024):
+        if not path:
+            raise MXNetError("JsonlWriter needs a path")
+        self.path = path
+        self.interval = float(interval)
+        self.max_bytes = int(max_bytes)
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self._lock = threading.Lock()
+
+    def write_now(self) -> None:
+        line = json.dumps({"ts": round(time.time(), 3),
+                           "metrics": registry().snapshot()},
+                          sort_keys=True) + "\n"
+        with self._lock:
+            try:
+                if os.path.exists(self.path) and \
+                        os.path.getsize(self.path) + len(line) > \
+                        self.max_bytes:
+                    os.replace(self.path, self.path + ".1")
+            except OSError:
+                pass                      # rotation is best-effort
+            with open(self.path, "a") as f:
+                f.write(line)
+
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def run():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.write_now()
+                except OSError:
+                    # disk-full/unlinked-dir must not kill the writer —
+                    # the next tick retries; training never depends on it
+                    pass
+
+        self._thread = threading.Thread(target=run, daemon=True,
+                                        name="mxtpu-metrics-jsonl")
+        self._thread.start()
+
+    def stop(self, final_write: bool = True) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+        if final_write:
+            try:
+                self.write_now()
+            except OSError:
+                pass
+
+
+_env_server: Optional[MetricsServer] = None
+_env_writer: Optional[JsonlWriter] = None
+_env_lock = threading.Lock()
+
+
+def maybe_start_from_env() -> None:
+    """Start the HTTP endpoint and/or the JSONL writer if the opt-in env
+    vars are set.  Idempotent; failures (port in use, unwritable path)
+    warn instead of raising — observability must never take down the
+    training job it observes."""
+    global _env_server, _env_writer
+    with _env_lock:
+        port = os.environ.get(METRICS_PORT_ENV, "").strip()
+        jsonl = os.environ.get(METRICS_JSONL_ENV, "").strip()
+        if port or jsonl:
+            # materialize the engine singleton so its metric families
+            # exist from the first scrape/write, not from the first op
+            from ..engine import engine
+            engine()
+        if port and _env_server is None:
+            try:
+                _env_server = MetricsServer(int(port))
+            except (OSError, ValueError) as e:
+                import warnings
+                warnings.warn(
+                    f"{METRICS_PORT_ENV}={port!r}: metrics endpoint not "
+                    f"started ({e})", RuntimeWarning, stacklevel=2)
+        if jsonl and _env_writer is None:
+            try:
+                interval = float(
+                    os.environ.get(METRICS_INTERVAL_ENV, "60"))
+                _env_writer = JsonlWriter(jsonl, interval=interval)
+                _env_writer.start()
+            except (OSError, ValueError) as e:
+                import warnings
+                warnings.warn(
+                    f"{METRICS_JSONL_ENV}={jsonl!r}: JSONL metrics "
+                    f"writer not started ({e})", RuntimeWarning,
+                    stacklevel=2)

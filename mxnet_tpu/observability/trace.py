@@ -1,0 +1,99 @@
+"""Lightweight trace spans over the metrics registry.
+
+``span(name)`` is a context manager that records the wall-time of its
+body (in µs) into the histogram ``name`` — the per-step / per-flush
+timing surface the ROADMAP's observability follow-up asks for.  Two
+integration points:
+
+- **Registry**: every exit observes the duration into
+  ``registry().histogram(name)``, so percentiles surface through
+  ``snapshot()`` / the Prometheus endpoint with zero extra plumbing.
+- **Profiler**: when engine dispatch listeners are installed (i.e. the
+  profiler is running), the span additionally emits a ``span:<name>``
+  event through the same listener hook op dispatches use, so spans
+  appear in the chrome trace next to the ops they contain.
+
+Spans nest: a thread-local stack tracks the active chain (``current()``
+returns the innermost name, ``stack()`` the whole chain outermost-first).
+The stack is maintained exception-safely — a span body that raises still
+pops and still records its duration.
+
+Cost discipline: entering a span is a perf_counter() call and a list
+append; exiting is a perf_counter(), a list pop, and one histogram
+observe (bisect + int adds under a lock).  No allocation beyond the span
+object, no formatting.  Spans guard paths that run per step / per flush
+/ per batch — not per op; the op hot path keeps its existing
+listener-gated timing.
+"""
+from __future__ import annotations
+
+import threading
+from time import perf_counter
+from typing import List, Optional
+
+from ..engine import engine
+from .registry import registry
+
+__all__ = ["span", "current", "stack"]
+
+_tls = threading.local()
+
+
+def _stack() -> List[str]:
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
+
+
+def current() -> Optional[str]:
+    """Innermost active span name on this thread, or None."""
+    s = getattr(_tls, "stack", None)
+    return s[-1] if s else None
+
+
+def stack() -> List[str]:
+    """The active span chain on this thread, outermost first (a copy)."""
+    return list(getattr(_tls, "stack", ()))
+
+
+class span:
+    """``with span("resilience.step_us"): ...`` — record the body's
+    wall-time into the histogram of that name.
+
+    ``histogram=False`` keeps the nesting/bookkeeping (and the profiler
+    event) without creating a registry metric — for ad-hoc scoping.
+    The measured duration is available afterwards as ``.duration_us``.
+    """
+
+    __slots__ = ("name", "duration_us", "_t0", "_record")
+
+    def __init__(self, name: str, histogram: bool = True):
+        self.name = name
+        self.duration_us = 0.0
+        self._record = histogram
+        # create (or fetch) the histogram at construction, not exit —
+        # name errors surface where the span is written, and __exit__
+        # stays allocation-free
+        if histogram:
+            registry().histogram(name)
+
+    def __enter__(self) -> "span":
+        _stack().append(self.name)
+        self._t0 = perf_counter()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.duration_us = (perf_counter() - self._t0) * 1e6
+        s = getattr(_tls, "stack", None)
+        if s:
+            s.pop()
+        if self._record:
+            registry().get(self.name).observe(self.duration_us)
+        eng = engine()
+        if eng._listeners:
+            # profiler running: surface the span in the same event stream
+            # as op dispatches (the chrome trace groups them by name)
+            for fn in eng._listeners:
+                fn(f"span:{self.name}", (), self.duration_us)
+        return None

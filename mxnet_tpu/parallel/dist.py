@@ -112,11 +112,16 @@ def init_process_group(coordinator: Optional[str] = None,
                 pass
             raise
 
+    from ..observability.registry import registry as _metrics_registry
+
+    def _count_retry(attempt, exc, delay):
+        _metrics_registry().counter("dist.init_retries").inc()
+
     try:
         retry_call(_join, retries=retries, base_delay=backoff,
                    max_delay=30.0,
                    retry_on=(RuntimeError, ConnectionError, TimeoutError,
-                             OSError))
+                             OSError), on_retry=_count_retry)
     except Exception as exc:
         raise MXNetError(
             f"could not join the process group at {coordinator!r} as rank "

@@ -22,8 +22,11 @@ actually survive preemptible TPU pods:
   :class:`TrainingPreempted` (an ``atexit`` hook additionally flushes any
   in-flight async write on interpreter exit);
 - **counters** (``steps_skipped``, ``steps_retried``, ``steps_failed``,
-  ``checkpoints_written/pruned/failed``, ``resumes``) for the future
-  observability layer.
+  ``rollbacks``, ``checkpoints_written/pruned/failed``, ``resumes``)
+  registered in the observability layer as ``resilience.*`` metrics —
+  ``ResilientTrainer.counters`` is a back-compat per-instance view;
+  step/checkpoint/resume wall-times record as ``resilience.*_us``
+  histograms via trace spans.
 
 Every failure path is exercisable on CPU through the deterministic fault
 plan in :mod:`mxnet_tpu.faults` (``MXTPU_FAULT_PLAN``).
@@ -39,6 +42,8 @@ from typing import Optional, Tuple, Type
 
 from ..base import MXNetError
 from ..faults import FaultPlan, TransientFault, active_plan, retry_call
+from ..observability.registry import registry as _metrics_registry
+from ..observability.trace import span as _span
 from .trainer import ShardedTrainer
 
 __all__ = ["ResilientTrainer", "TrainingPreempted"]
@@ -112,6 +117,30 @@ def _poison_first_float(x):
                      "(all inputs are integer typed)")
 
 
+class _InstanceCounters:
+    """Per-trainer tallies mirrored into the process-global registry.
+
+    ``inc()`` bumps both this instance's own count and the
+    ``resilience.<key>`` registry Counter; ``view()`` returns the
+    instance's dict.  The double-write keeps the old
+    ``ResilientTrainer.counters`` contract exact (strictly per-instance,
+    immune to other trainers and to ``registry().reset()``) while the
+    registry carries the process-wide totals for exporters."""
+
+    __slots__ = ("_local", "_global")
+
+    def __init__(self, reg, keys):
+        self._local = dict.fromkeys(keys, 0)
+        self._global = {k: reg.counter(f"resilience.{k}") for k in keys}
+
+    def inc(self, key: str, n: int = 1) -> None:
+        self._local[key] += n
+        self._global[key].inc(n)
+
+    def view(self) -> dict:
+        return dict(self._local)
+
+
 class ResilientTrainer:
     """Wrap a :class:`ShardedTrainer` with failure handling.
 
@@ -175,10 +204,20 @@ class ResilientTrainer:
                 init_loss_scale=init_loss_scale,
                 scale_growth_interval=scale_growth_interval,
                 scale_backoff=scale_backoff)
-        self._counters = {"steps_skipped": 0, "steps_retried": 0,
-                          "steps_failed": 0, "checkpoints_written": 0,
-                          "checkpoints_pruned": 0, "checkpoints_failed": 0,
-                          "resumes": 0}
+        # counters live in the process-global observability registry
+        # under `resilience.*` (the PR-1 follow-up: one surface with the
+        # engine's dispatch counters).  Each instance ALSO keeps its own
+        # tallies: `counters` must stay genuinely per-instance (two
+        # trainers in one process must not see each other's skips, and a
+        # registry reset must not send a view negative), so every bump
+        # writes both.
+        self._metrics = _InstanceCounters(
+            _metrics_registry(),
+            ("steps_skipped", "steps_retried", "steps_failed",
+             "rollbacks", "checkpoints_written", "checkpoints_pruned",
+             "checkpoints_failed", "resumes"))
+        self._step_unsafe = False     # set once a failed attempt consumed
+        # its donated buffers: every later step refuses fast
         self._pending_finite: list = []
         self._step_index = 0          # supervisor step counter (fault site)
         self._save_index = 0          # checkpoint-write counter (fault site)
@@ -210,15 +249,20 @@ class ResilientTrainer:
         import jax
         flags = jax.device_get(self._pending_finite)
         self._pending_finite = []
-        self._counters["steps_skipped"] += \
-            sum(1 for f in flags if not bool(f))
+        skipped = sum(1 for f in flags if not bool(f))
+        if skipped:
+            self._metrics.inc("steps_skipped", skipped)
 
     @property
     def counters(self) -> dict:
-        """Snapshot of the resilience counters (resolves any pending
-        device-side skip flags — may sync)."""
+        """Snapshot of THIS trainer's resilience counters (resolves any
+        pending device-side skip flags — may sync).  Strictly
+        per-instance, as before the observability subsystem; every bump
+        is mirrored into the process-global `resilience.*` registry
+        counters (``observability.registry().snapshot()`` has the
+        totals)."""
         self._drain_finite()
-        return dict(self._counters)
+        return self._metrics.view()
 
     # -- signals -----------------------------------------------------------
     def install_signal_handlers(
@@ -277,12 +321,13 @@ class ResilientTrainer:
         path = ShardedTrainer.latest_checkpoint(self._ckpt_dir)
         if path is None:
             return None
-        if not self._trainer.built:
-            self._trainer.step(x, y, batch_size)
-        self._trainer.load_checkpoint(self._ckpt_dir)
+        with _span("resilience.resume_us"):
+            if not self._trainer.built:
+                self._trainer.step(x, y, batch_size)
+            self._trainer.load_checkpoint(self._ckpt_dir)
         self.resumed_t = self._trainer.num_update
         self._last_saved_t = self.resumed_t
-        self._counters["resumes"] += 1
+        self._metrics.inc("resumes")
         return self.resumed_t
 
     # -- the supervised step ----------------------------------------------
@@ -300,24 +345,68 @@ class ResilientTrainer:
         plan = self._plan
 
         def one_attempt():
+            if self._step_unsafe:
+                # a previous attempt died AFTER its donated buffers were
+                # consumed: params/opt state no longer exist on device —
+                # retrying would crash on deleted arrays, so refuse with
+                # the recovery path spelled out (ROADMAP 'Known gap').
+                # A flag, not a per-attempt donation_consumed scan — the
+                # happy path must not pay an O(n_params) check.
+                raise MXNetError(
+                    "ResilientTrainer: a failed step consumed its donated "
+                    "parameter buffers — the live training state is gone "
+                    "and the step cannot be retried; restore from the "
+                    "newest committed checkpoint (auto_resume / "
+                    "maybe_resume) instead")
             if plan is not None:
                 plan.fire("step_error", i)
             xi = x
             if plan is not None and \
                     plan.scheduled("nan", i) is not None:
                 xi = _poison_first_float(x)
-            return self._trainer.step(xi, y, batch_size)
+            # ShardedTrainer.step is NOT idempotent: it advances `_t` and
+            # the RNG stream before dispatch.  Snapshot both so a failure
+            # from INSIDE the step rolls back and the retry replays the
+            # attempt bit-for-bit instead of desyncing.
+            snap = self._trainer.step_state()
+            try:
+                return self._trainer.step(xi, y, batch_size)
+            except self._retry_on as exc:
+                if self._trainer.donation_consumed:
+                    self._step_unsafe = True
+                    raise MXNetError(
+                        "ResilientTrainer: a failed step consumed its "
+                        "donated parameter buffers — the live training "
+                        "state is gone and the step cannot be retried; "
+                        "restore from the newest committed checkpoint "
+                        "(auto_resume / maybe_resume) instead") from exc
+                self._trainer.rollback_step(snap)
+                self._metrics.inc("rollbacks")
+                raise
+            except Exception:
+                # NON-retryable failure from inside the step: still roll
+                # back `_t`/RNG (when the device state survived) so a
+                # caller that catches and continues is not silently
+                # desynced; never mask the original error
+                if self._trainer.donation_consumed:
+                    self._step_unsafe = True
+                else:
+                    self._trainer.rollback_step(snap)
+                    self._metrics.inc("rollbacks")
+                raise
 
         def on_retry(attempt, exc, delay):
-            self._counters["steps_retried"] += 1
+            self._metrics.inc("steps_retried")
 
         try:
-            loss = retry_call(one_attempt, retries=self._max_retries,
-                              base_delay=self._retry_base,
-                              max_delay=self._retry_max,
-                              retry_on=self._retry_on, on_retry=on_retry)
+            with _span("resilience.step_us"):
+                loss = retry_call(one_attempt, retries=self._max_retries,
+                                  base_delay=self._retry_base,
+                                  max_delay=self._retry_max,
+                                  retry_on=self._retry_on,
+                                  on_retry=on_retry)
         except self._retry_on:
-            self._counters["steps_failed"] += 1
+            self._metrics.inc("steps_failed")
             raise
         if self._trainer.guard_enabled:
             self._pending_finite.append(self._trainer.last_step_finite)
@@ -351,15 +440,19 @@ class ResilientTrainer:
             os.makedirs(torn, exist_ok=True)
             with open(os.path.join(torn, "_TORN_WRITE"), "w") as f:
                 f.write("injected by MXTPU_FAULT_PLAN\n")
-            self._counters["checkpoints_failed"] += 1
+            self._metrics.inc("checkpoints_failed")
             raise TransientFault(
                 f"injected checkpoint write failure "
                 f"(save #{self._save_index}, step {t})")
-        self._trainer.save_checkpoint(self._ckpt_dir)
-        self._last_saved_t = t
-        self._counters["checkpoints_written"] += 1
-        if wait:
-            self._trainer.wait_checkpoint()
+        with _span("resilience.checkpoint_us"):
+            # spans the ASYNC save enqueue (+ optional commit wait), not
+            # the background write — host-side stall is what this costs
+            # the training loop
+            self._trainer.save_checkpoint(self._ckpt_dir)
+            self._last_saved_t = t
+            self._metrics.inc("checkpoints_written")
+            if wait:
+                self._trainer.wait_checkpoint()
         self._gc()
 
     def flush(self) -> None:
@@ -377,7 +470,7 @@ class ResilientTrainer:
         committed = ShardedTrainer.committed_checkpoints(self._ckpt_dir)
         for path in committed[:-self._keep_last]:
             shutil.rmtree(path, ignore_errors=True)
-            self._counters["checkpoints_pruned"] += 1
+            self._metrics.inc("checkpoints_pruned")
         if not committed:
             return
         newest = os.path.basename(committed[-1])

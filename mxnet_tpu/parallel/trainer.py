@@ -409,6 +409,49 @@ class ShardedTrainer:
                 xv, yv)
         return NDArray(lval, ctx=self._ctx)
 
+    # -- supervised-retry support (ResilientTrainer) -----------------------
+    def step_state(self):
+        """Host-side snapshot of everything a FAILED step() attempt may
+        have advanced before dying: the update counter and the global RNG
+        stream key.  Cheap (two references); taken by the resilience
+        layer before every supervised attempt so a mid-step failure can
+        be rolled back instead of desyncing the retry (ROADMAP 'Known
+        gap' from PR 1)."""
+        return (self._t, _grandom.get_state())
+
+    @property
+    def donation_consumed(self) -> bool:
+        """True once a failed jitted step has consumed (deleted) the
+        donated parameter buffers: the training state no longer exists on
+        device, so a retry cannot run — restore from a checkpoint
+        instead.  Always False before the first build and on backends
+        that ignore donation (CPU)."""
+        if not self._built:
+            return False
+        for v in self._pvals:
+            is_deleted = getattr(v, "is_deleted", None)
+            if is_deleted is not None and is_deleted():
+                return True
+        return False
+
+    def rollback_step(self, state) -> None:
+        """Undo the host-side effects of a failed step() attempt —
+        restore the update counter and RNG stream from a
+        :meth:`step_state` snapshot so the retry replays the attempt
+        bit-for-bit.  Refuses (clear error, not a crash later) when the
+        failed attempt already consumed its donated buffers."""
+        if self.donation_consumed:
+            raise MXNetError(
+                "cannot roll back this step: the failed attempt already "
+                "consumed (donated) the parameter buffers — the training "
+                "state is gone; restore from the newest committed "
+                "checkpoint (ResilientTrainer auto_resume) instead of "
+                "retrying")
+        t, key = state
+        self._t = t
+        self._optimizer.num_update = t
+        _grandom.set_state(key)
+
     def forward(self, x):
         """Sharded inference forward with the trainer-owned weights."""
         import jax

@@ -121,7 +121,7 @@ class Profiler:
         lines.append("\nengine dispatch/bulking stats:\n")
         for k in ("ops_dispatched", "ops_bulked", "segments_flushed",
                   "mean_segment_length", "segment_cache_hits",
-                  "segment_cache_misses"):
+                  "segment_cache_misses", "flush_us_p50", "flush_us_p99"):
             lines.append(f"  {k:<24}{s[k]}\n")
         return "".join(lines)
 

@@ -28,6 +28,13 @@ import pytest  # noqa: E402
 from tests._seedutil import attach_replay_section, test_seed  # noqa: E402
 
 
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "slow: excluded from tier-1 (`-m 'not slow'`) — perf guards and "
+        "long-haul checks")
+
+
 @pytest.fixture(autouse=True)
 def _seed_everything(request):
     """Reference parity: tests/python/unittest/common.py @with_seed —

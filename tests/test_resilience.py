@@ -209,6 +209,108 @@ def test_transient_step_failure_exhausts_retries():
     assert c["steps_retried"] == 1 and c["steps_failed"] == 1
 
 
+# -- mid-step failure rollback (ROADMAP 'Known gap' from PR 1) --------------
+
+def test_midstep_failure_rolls_back_t_and_rng():
+    """A failure raised from INSIDE ShardedTrainer.step leaves `_t` and
+    the RNG stream advanced; the supervisor must roll both back per
+    attempt so the retried trajectory is bit-identical to an
+    uninterrupted run (the model has Dropout, so a desynced stream WOULD
+    change the losses)."""
+    bs = _batches(4)
+    rt0 = ResilientTrainer(_build_trainer(), auto_resume=False)
+    want = [float(rt0.step(x, y).asnumpy()) for x, y in bs]
+
+    rt = ResilientTrainer(_build_trainer(), auto_resume=False,
+                          retry_on=(ValueError,), retry_base_delay=0.001)
+    rt.step(*bs[0])                      # builds the jit
+    st = rt.trainer
+    orig, state = st._jit_step, {"fail": True}
+
+    def flaky_jit(*a, **kw):
+        # dies AFTER step() advanced _t and consumed the RNG key — the
+        # exact non-idempotence the rollback exists for
+        if state["fail"]:
+            state["fail"] = False
+            raise ValueError("injected mid-step failure")
+        return orig(*a, **kw)
+
+    st._jit_step = flaky_jit
+    got = [float(rt.step(x, y).asnumpy()) for x, y in bs[1:]]
+    assert want == [want[0]] + got       # bit-identical trajectory
+    c = rt.counters
+    assert c["rollbacks"] == 1 and c["steps_retried"] == 1
+    assert rt.trainer.num_update == 4
+
+
+def test_midstep_failure_without_retry_still_rolls_back():
+    """Even when retries are exhausted, the rollback leaves the trainer
+    consistent: `_t` matches the number of APPLIED updates."""
+    rt = ResilientTrainer(_build_trainer(), auto_resume=False,
+                          retry_on=(ValueError,), max_retries=0)
+    bs = _batches(2)
+    rt.step(*bs[0])
+    st = rt.trainer
+
+    def dead_jit(*a, **kw):
+        raise ValueError("boom")
+
+    st._jit_step = dead_jit
+    with pytest.raises(ValueError):
+        rt.step(*bs[1])
+    assert st.num_update == 1            # rolled back, not desynced
+    assert rt.counters["rollbacks"] == 1
+    assert rt.counters["steps_failed"] == 1
+
+
+def test_midstep_nonretryable_failure_also_rolls_back():
+    """A failure type NOT in retry_on still must not desync `_t`/RNG: the
+    supervisor rolls back before re-raising, so a caller that catches and
+    continues sees a consistent trainer."""
+    rt = ResilientTrainer(_build_trainer(), auto_resume=False)  # default
+    bs = _batches(2)                         # retry_on=(TransientFault,)
+    rt.step(*bs[0])
+    st = rt.trainer
+    rng_before = mx.random.get_state()
+
+    def dead_jit(*a, **kw):
+        raise ValueError("not transient")
+
+    orig, st._jit_step = st._jit_step, dead_jit
+    with pytest.raises(ValueError):
+        rt.step(*bs[1])
+    assert st.num_update == 1                # rolled back
+    assert mx.random.get_state() is rng_before
+    assert rt.counters["rollbacks"] == 1
+    # the trainer is still usable after restoring the real step
+    st._jit_step = orig
+    rt.step(*bs[1])
+    assert st.num_update == 2
+
+
+def test_refuse_retry_after_donation_consumed():
+    """A step that dies AFTER its donated buffers were consumed cannot be
+    retried (the live training state is gone): the supervisor raises a
+    clear error pointing at checkpoint restore instead of crashing later
+    on deleted arrays."""
+    rt = ResilientTrainer(_build_trainer(), auto_resume=False,
+                          retry_on=(ValueError,), retry_base_delay=0.001)
+    bs = _batches(2)
+    rt.step(*bs[0])
+    st = rt.trainer
+
+    def donated_then_dead(*a, **kw):
+        for v in st._pvals:
+            v.delete()                   # what real donation leaves
+        raise ValueError("dies after donation")
+
+    st._jit_step = donated_then_dead
+    with pytest.raises(MXNetError, match="donated"):
+        rt.step(*bs[1])
+    assert st.donation_consumed
+    assert rt.counters["rollbacks"] == 0  # refused, never rolled back
+
+
 # -- committed-checkpoint filtering (satellite 1) ---------------------------
 
 def test_latest_checkpoint_skips_uncommitted(tmp_path):
