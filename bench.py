@@ -206,6 +206,7 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
                 os.environ[k] = v
     overhead = _metrics_overhead_pct(per_op_us,
                                      stats["mean_segment_length"] or 15)
+    snapshot_us, flight_record_us = _observability_costs()
     return {"ops_per_sec_bulk": round(results["bulk"], 1),
             "ops_per_sec_bulk_aggressive": round(
                 results["bulk_aggressive"], 1),
@@ -227,6 +228,11 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
             # observability tax on the bulk row (measured, see helper) —
             # the <3% overhead guard reported honestly
             "metrics_overhead_pct": overhead,
+            # consumer-side costs (scrape/supervisor cadence, not per
+            # op): one full registry snapshot, one flight-recorder
+            # per-step record
+            "snapshot_us": snapshot_us,
+            "flight_record_us": flight_record_us,
             "host_cores": _host_cores()}
 
 
@@ -263,6 +269,31 @@ def _metrics_overhead_pct(per_op_us, mean_segment_len,
     if not per_op_us:
         return 0.0
     return round(per_op / per_op_us * 100.0, 3)
+
+
+def _observability_costs(reps=2_000):
+    """Measured per-call cost of the two consumer-side observability
+    surfaces: a full ``registry().snapshot()`` (what a scrape or JSONL
+    tick pays) and one flight-recorder ``record()`` (what the resilience
+    supervisor pays per step).  Neither is on the dispatch hot path —
+    reported so the step-cadence tax is a number, not a guess."""
+    from mxnet_tpu.observability.flight import FlightRecorder
+    from mxnet_tpu.observability.registry import registry
+    reg = registry()
+    t0 = time.perf_counter()
+    for _ in range(reps // 20):
+        reg.snapshot()
+    snapshot_us = (time.perf_counter() - t0) / (reps // 20) * 1e6
+    fr = FlightRecorder(capacity=256)     # unregistered probe instance
+    rec = {"step": 1, "t": 1, "step_us": 1234.5, "loss": 0.7,
+           "loss_scale": 1.0, "flush_us_p99": 99.0, "flush_count": 10,
+           "steps_skipped": 0, "rollbacks": 0, "loader_depth": 2.0,
+           "failed": False}
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        fr.record(**rec)
+    flight_record_us = (time.perf_counter() - t0) / reps * 1e6
+    return round(snapshot_us, 2), round(flight_record_us, 3)
 
 
 def bench_bert_base(iters=10, warmup=3, batch=8, seq=256,

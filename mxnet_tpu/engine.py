@@ -111,13 +111,27 @@ class Engine:
         # below is a thin back-compat view.  Hot paths bump `.n` directly
         # — the same plain int add the former attributes were.
         reg = _metrics_registry()
-        self._c_dispatched = reg.counter("engine.ops_dispatched")
-        self._c_bulked = reg.counter("engine.ops_bulked")
-        self._c_segments = reg.counter("engine.segments_flushed")
-        self._c_bulked_flushed = reg.counter("engine.bulked_ops_flushed")
-        self._c_cache_hits = reg.counter("engine.segment_cache_hits")
-        self._c_cache_misses = reg.counter("engine.segment_cache_misses")
-        self._h_flush = reg.histogram("engine.flush_us")
+        self._c_dispatched = reg.counter(
+            "engine.ops_dispatched",
+            help="per-op XLA dispatches (unbulked path)")
+        self._c_bulked = reg.counter(
+            "engine.ops_bulked",
+            help="ops deferred into fused bulk segments")
+        self._c_segments = reg.counter(
+            "engine.segments_flushed",
+            help="bulk segments executed as one fused dispatch")
+        self._c_bulked_flushed = reg.counter(
+            "engine.bulked_ops_flushed",
+            help="ops carried by flushed segments")
+        self._c_cache_hits = reg.counter(
+            "engine.segment_cache_hits",
+            help="fused-executable cache hits")
+        self._c_cache_misses = reg.counter(
+            "engine.segment_cache_misses",
+            help="fused-executable cache misses (compiles)")
+        self._h_flush = reg.histogram(
+            "engine.flush_us",
+            help="per-segment flush latency in microseconds")
 
     @classmethod
     def get(cls) -> "Engine":

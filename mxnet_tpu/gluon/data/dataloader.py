@@ -88,8 +88,16 @@ class DataLoader:
         # mxnet_tpu.observability): batches built, per-batch build time,
         # transient worker retries
         reg = _metrics_registry()
-        self._c_batches = reg.counter("loader.batches")
-        self._c_retries = reg.counter("loader.worker_retries")
+        self._c_batches = reg.counter(
+            "loader.batches", help="batches built by the DataLoader")
+        self._c_retries = reg.counter(
+            "loader.worker_retries",
+            help="transient worker failures retried")
+        self._g_depth = reg.gauge(
+            "loader.prefetch_depth",
+            help="prefetch queue depth sampled at each batch handoff — "
+                 "near-capacity means workers keep ahead of the device; "
+                 "near-zero means the pipeline is starving the step")
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -186,5 +194,9 @@ class DataLoader:
                 break
             if isinstance(item, _WorkerError):
                 raise item.exc
+            # queue depth AFTER taking our batch: what the consumer
+            # would find if it came back immediately (the ROADMAP's
+            # prefetch-health gauge; also in flight-recorder records)
+            self._g_depth.set(q.qsize())
             yield item
             expected += 1

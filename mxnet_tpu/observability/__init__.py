@@ -13,8 +13,18 @@ registry (ROADMAP follow-up for both PRs):
   wall-time into histograms (and echoing to engine profiler listeners
   when installed).
 - :mod:`.export` — a Prometheus-text-format HTTP endpoint (opt-in via
-  ``MXTPU_METRICS_PORT``) and a JSONL periodic writer for headless runs
-  (``MXTPU_METRICS_JSONL``).
+  ``MXTPU_METRICS_PORT``; ``MXTPU_METRICS_AGGREGATE`` serves the
+  host-labeled fleet view) and a JSONL periodic writer for headless
+  runs (``MXTPU_METRICS_JSONL``).
+- :mod:`.flight` — a crash flight recorder: a bounded ring of per-step
+  records dumped (with a full snapshot) to JSON on unhandled exception
+  / preemption / retry exhaustion (``MXTPU_FLIGHT_STEPS`` /
+  ``MXTPU_FLIGHT_PATH``).
+
+The fleet view: ``registry().snapshot(all_hosts=True)`` gathers every
+host's metrics over the DCN ``allgather_host`` path and merges them
+with ``host=<process_index>`` labels (local-only fallback when the
+process group is not initialized).
 
 The legacy surfaces stay as thin back-compat views: ``engine().stats()``
 and ``ResilientTrainer.counters`` read the same registry metrics.
@@ -30,7 +40,7 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        registry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
-           "trace", "export", "span"]
+           "trace", "export", "span", "flight"]
 
 
 def __getattr__(name):
@@ -40,7 +50,7 @@ def __getattr__(name):
     if name in ("trace", "span"):
         mod = importlib.import_module(".trace", __name__)
         return mod if name == "trace" else mod.span
-    if name == "export":
-        return importlib.import_module(".export", __name__)
+    if name in ("export", "flight"):
+        return importlib.import_module("." + name, __name__)
     raise AttributeError(
         f"module 'mxnet_tpu.observability' has no attribute {name!r}")

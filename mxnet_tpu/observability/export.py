@@ -29,14 +29,22 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
 from ..base import MXNetError
-from .registry import Counter, Gauge, Histogram, MetricsRegistry, registry
+from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
+                       group_host_entries, last_host_states, registry,
+                       state_cumulative_buckets)
 
-__all__ = ["prometheus_text", "MetricsServer", "JsonlWriter",
+__all__ = ["prometheus_text", "prometheus_text_aggregate",
+           "aggregate_mode", "MetricsServer", "JsonlWriter",
            "maybe_start_from_env"]
 
 METRICS_PORT_ENV = "MXTPU_METRICS_PORT"
 METRICS_JSONL_ENV = "MXTPU_METRICS_JSONL"
 METRICS_INTERVAL_ENV = "MXTPU_METRICS_INTERVAL"
+#: serve the FLEET view (merged multi-host states, every series labeled
+#: host="<process_index>") instead of the local registry.  Read live per
+#: scrape; point Prometheus at host 0, whose gathered view covers the
+#: whole fleet.
+METRICS_AGGREGATE_ENV = "MXTPU_METRICS_AGGREGATE"
 
 #: every exported sample is prefixed so dashboards can scope on it
 PROM_PREFIX = "mxtpu_"
@@ -58,10 +66,18 @@ def _fmt(v: float) -> str:
     return repr(float(v))
 
 
+def _help_line(pname: str, help_text: str) -> Optional[str]:
+    if not help_text:
+        return None
+    escaped = help_text.replace("\\", "\\\\").replace("\n", "\\n")
+    return f"# HELP {pname} {escaped}"
+
+
 def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
     """The registry in Prometheus text exposition format (version 0.0.4):
     counters/gauges as single samples, histograms as cumulative
-    ``_bucket{le=...}`` series plus ``_sum``/``_count``."""
+    ``_bucket{le=...}`` series plus ``_sum``/``_count``; ``# HELP``
+    lines for metrics registered with a description."""
     reg = reg if reg is not None else registry()
     lines = []
     for name in reg.names():
@@ -69,6 +85,9 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
         if m is None:                     # raced an (hypothetical) removal
             continue
         pname = _prom_name(name)
+        hl = _help_line(pname, m.help)
+        if hl:
+            lines.append(hl)
         if isinstance(m, Counter):
             lines.append(f"# TYPE {pname} counter")
             lines.append(f"{pname} {_fmt(m.n)}")
@@ -85,12 +104,56 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+def aggregate_mode() -> bool:
+    """Live read of the ``MXTPU_METRICS_AGGREGATE`` opt-in."""
+    return os.environ.get(METRICS_AGGREGATE_ENV, "").strip().lower() \
+        in ("1", "true", "yes", "on")
+
+
+def prometheus_text_aggregate(
+        reg: Optional[MetricsRegistry] = None) -> str:
+    """The FLEET view in Prometheus text format: every series from the
+    most recently gathered per-host states (``snapshot(all_hosts=True)``
+    refreshes them — a collective, so it runs at fleet sync points like
+    checkpoint boundaries, never from the scrape handler), each labeled
+    ``host="<process_index>"``.  Cross-host aggregation (sums, merged
+    quantiles) is PromQL's job — ``sum by (le)`` etc.  Before the first
+    gather (or single-process) this serves the local host's series under
+    its own host label."""
+    lines = []
+    for name, kind, entries in group_host_entries(last_host_states(reg)):
+        pname = _prom_name(name)
+        help_text = next((e["help"] for _, e in entries
+                          if e.get("help")), "")
+        hl = _help_line(pname, help_text)
+        if hl:
+            lines.append(hl)
+        lines.append(f"# TYPE {pname} {kind}")
+        for h, e in entries:
+            if kind == "counter":
+                lines.append(f'{pname}{{host="{h}"}} {_fmt(e["n"])}')
+            elif kind == "gauge":
+                lines.append(f'{pname}{{host="{h}"}} {_fmt(e["v"])}')
+            elif kind == "histogram":
+                for bound, cum in state_cumulative_buckets(e):
+                    lines.append(
+                        f'{pname}_bucket{{host="{h}",'
+                        f'le="{_fmt(bound)}"}} {cum}')
+                lines.append(
+                    f'{pname}_sum{{host="{h}"}} {_fmt(e["total"])}')
+                lines.append(
+                    f'{pname}_count{{host="{h}"}} {e["count"]}')
+    return "\n".join(lines) + "\n"
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "mxtpu-metrics"
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         if self.path.split("?")[0] == "/metrics":
-            body = prometheus_text().encode()
+            text = prometheus_text_aggregate() if aggregate_mode() \
+                else prometheus_text()
+            body = text.encode()
             ctype = "text/plain; version=0.0.4; charset=utf-8"
         elif self.path.split("?")[0] == "/metrics.json":
             body = json.dumps(registry().snapshot(), sort_keys=True,

@@ -1,0 +1,210 @@
+"""Crash flight recorder: the last N step records + a full snapshot,
+dumped to JSON when a run dies.
+
+When a training job crashes, the metrics die with it: the Prometheus
+endpoint goes away, the JSONL writer's last tick may be a minute stale,
+and the per-step trajectory (was the loss already NaN? was the loader
+starving? had the loss scale collapsed?) is gone.  The flight recorder
+keeps a bounded ring of per-step records — step wall-time, loss, loss
+scale, engine flush p99, skip/rollback counts, loader prefetch depth —
+and writes the ring plus a complete ``registry().snapshot()`` to one
+JSON file at death, turning postmortems from "rerun and hope" into
+"read the dump".  Dump triggers:
+
+- **unhandled exception** — ``install()`` chains ``sys.excepthook``;
+- **preemption** (SIGTERM/SIGINT) and **retry exhaustion** —
+  :class:`~mxnet_tpu.parallel.resilience.ResilientTrainer` feeds the
+  ring every supervised step and dumps from its existing
+  checkpoint-and-flush and step-failure paths;
+- **explicitly** — ``recorder().dump("why")`` from any shutdown path.
+
+Cost discipline: ``record()`` is a dict build and a deque append — no
+formatting, no I/O, no device sync.  Device-backed values (the step
+loss) are stored as live references and materialized only at dump time,
+best-effort (a crashed runtime that refuses ``device_get`` degrades that
+field to ``None``, never blocks the dump).
+
+Env knobs: ``MXTPU_FLIGHT_STEPS`` — ring capacity (default 256; 0
+disables recording and dumping entirely); ``MXTPU_FLIGHT_PATH`` — dump
+file (default ``<tmpdir>/mxtpu_flight_<pid>.json``; multi-host runs
+should point each host at a distinct path or rely on the default's pid
+suffix — the dump also carries its ``host`` index).
+"""
+from __future__ import annotations
+
+import collections
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+from typing import Deque, List, Optional
+
+from .registry import host_id, registry
+
+__all__ = ["FlightRecorder", "recorder", "FLIGHT_STEPS_ENV",
+           "FLIGHT_PATH_ENV"]
+
+FLIGHT_STEPS_ENV = "MXTPU_FLIGHT_STEPS"
+FLIGHT_PATH_ENV = "MXTPU_FLIGHT_PATH"
+_DEFAULT_CAPACITY = 256
+
+
+def _env_capacity() -> int:
+    raw = os.environ.get(FLIGHT_STEPS_ENV, "").strip()
+    if not raw:
+        return _DEFAULT_CAPACITY
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return _DEFAULT_CAPACITY
+
+
+def _materialize(v):
+    """Best-effort JSON-friendly conversion at dump time.  Device values
+    (NDArray / jax scalars) sync HERE, not at record time; a runtime too
+    broken to read them yields None instead of blocking the dump."""
+    if v is None or isinstance(v, (bool, int, str)):
+        return v
+    try:
+        if hasattr(v, "asnumpy"):
+            return float(v.asnumpy())
+        return float(v)
+    except Exception:   # noqa: BLE001 — a crashed backend must not
+        return None     # take the dump down with it
+
+
+class FlightRecorder:
+    """Bounded ring of per-step records with crash-time JSON dump.
+
+    ``capacity=None`` / ``path=None`` defer to the env knobs (capacity
+    is resolved at construction, the path at each dump — so a test can
+    redirect dumps without rebuilding the recorder).
+    """
+
+    def __init__(self, capacity: Optional[int] = None,
+                 path: Optional[str] = None):
+        self.capacity = _env_capacity() if capacity is None \
+            else max(0, int(capacity))
+        self.path = path
+        self._ring: Deque[dict] = collections.deque(
+            maxlen=max(1, self.capacity))
+        self._lock = threading.Lock()
+        self._installed = False
+        self._prev_hook = None
+
+    @property
+    def enabled(self) -> bool:
+        return self.capacity > 0
+
+    def record(self, **fields) -> None:
+        """Append one step record.  Cheap: no I/O, no sync — device
+        values may be passed as-is and are materialized at dump time."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._ring.append(fields)
+
+    def records(self) -> List[dict]:
+        with self._lock:
+            return list(self._ring)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._ring.clear()
+
+    def _resolve_path(self, path: Optional[str]) -> str:
+        if path:
+            return path
+        if self.path:
+            return self.path
+        env = os.environ.get(FLIGHT_PATH_ENV, "").strip()
+        if env:
+            return env
+        return os.path.join(tempfile.gettempdir(),
+                            f"mxtpu_flight_{os.getpid()}.json")
+
+    def dump(self, reason: str, path: Optional[str] = None
+             ) -> Optional[str]:
+        """Write the ring + a full registry snapshot to JSON (atomic
+        tmp-then-rename); returns the path, or None when disabled or the
+        write itself failed — a dump runs on dying processes and must
+        never raise."""
+        if not self.enabled:
+            return None
+        path = self._resolve_path(path)
+        with self._lock:
+            steps = [{k: _materialize(v) for k, v in rec.items()}
+                     for rec in self._ring]
+        try:
+            snapshot = registry().snapshot()
+        except Exception:   # noqa: BLE001 — a half-torn registry still
+            snapshot = {}   # leaves the step ring worth dumping
+        payload = {
+            "reason": reason,
+            "ts": round(time.time(), 3),
+            "host": host_id(),
+            "pid": os.getpid(),
+            "capacity": self.capacity,
+            "n_steps": len(steps),
+            "steps": steps,
+            "snapshot": snapshot,
+        }
+        try:
+            tmp = f"{path}.tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        except OSError:
+            return None
+        try:
+            registry().counter(
+                "flight.dumps",
+                help="flight-recorder dumps written").inc()
+            print(f"mxnet_tpu flight recorder: wrote {len(steps)} step "
+                  f"record(s) to {path} ({reason})", file=sys.stderr)
+        except Exception:   # noqa: BLE001 — bookkeeping only
+            pass
+        return path
+
+    # -- crash hook --------------------------------------------------------
+    def install(self) -> None:
+        """Chain ``sys.excepthook`` so any unhandled exception dumps the
+        ring before the traceback prints.  Idempotent; the previous hook
+        always runs."""
+        if self._installed or not self.enabled:
+            return
+        self._installed = True
+        self._prev_hook = prev = sys.excepthook
+
+        def hook(etype, value, tb):
+            try:
+                self.dump(f"unhandled {etype.__name__}: {value}")
+            except Exception:   # noqa: BLE001 — never mask the real crash
+                pass
+            prev(etype, value, tb)
+
+        sys.excepthook = hook
+
+    def uninstall(self) -> None:
+        if self._installed and self._prev_hook is not None:
+            sys.excepthook = self._prev_hook
+            self._installed = False
+            self._prev_hook = None
+
+
+_recorder_lock = threading.Lock()
+_recorder_inst: Optional[FlightRecorder] = None
+
+
+def recorder() -> FlightRecorder:
+    """THE process-global flight recorder (capacity from the env)."""
+    global _recorder_inst
+    inst = _recorder_inst
+    if inst is not None:
+        return inst
+    with _recorder_lock:
+        if _recorder_inst is None:
+            _recorder_inst = FlightRecorder()
+        return _recorder_inst

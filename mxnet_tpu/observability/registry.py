@@ -27,6 +27,7 @@ allocation-free and O(log n_buckets).
 """
 from __future__ import annotations
 
+import json
 import re
 import threading
 from bisect import bisect_left
@@ -34,7 +35,10 @@ from typing import Dict, List, Optional, Tuple, Union
 
 from ..base import MXNetError
 
-__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry"]
+__all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
+           "host_id", "gather_host_states", "last_host_states",
+           "merge_host_states", "group_host_entries", "state_bounds",
+           "state_cumulative_buckets"]
 
 # namespaced dotted names: `engine.ops_dispatched`, `loader.batches`, ...
 _NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*(\.[a-z0-9_]+)+$")
@@ -44,12 +48,13 @@ class Counter:
     """Monotonic event count.  ``inc()`` is the lock-exact path; hot
     loops may bump ``.n`` directly (see module docstring)."""
 
-    __slots__ = ("name", "n", "_lock")
+    __slots__ = ("name", "n", "help", "_lock")
     kind = "counter"
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, help: str = ""):
         self.name = name
         self.n = 0
+        self.help = help
         self._lock = threading.Lock()
 
     def inc(self, amount: int = 1) -> None:
@@ -74,12 +79,13 @@ class Counter:
 class Gauge:
     """Last-write-wins instantaneous value (queue depth, loss scale)."""
 
-    __slots__ = ("name", "_v", "_lock")
+    __slots__ = ("name", "_v", "help", "_lock")
     kind = "gauge"
 
-    def __init__(self, name: str):
+    def __init__(self, name: str, help: str = ""):
         self.name = name
         self._v = 0.0
+        self.help = help
         self._lock = threading.Lock()
 
     def set(self, value: float) -> None:
@@ -99,6 +105,42 @@ class Gauge:
         return f"Gauge({self.name}={self._v})"
 
 
+def _percentile_from(bounds, counts, count, vmin, vmax, q: float) -> float:
+    """Bucket-percentile math shared by live Histograms and merged
+    multi-host states: the containing bucket's upper bound, clamped to
+    the observed min/max so edge buckets don't overstate."""
+    if not count:
+        return 0.0
+    rank = max(1, int(round(q / 100.0 * count)))
+    acc = 0
+    for i, c in enumerate(counts):
+        acc += c
+        if acc >= rank:
+            if i >= len(bounds):              # overflow bucket
+                return float(vmax)
+            hi = bounds[i]
+            lo = vmin if vmin is not None else hi
+            return float(min(max(hi, lo), vmax))
+    return float(vmax)
+
+
+def _aggregate_from(bounds, counts, count, total, vmin, vmax) -> dict:
+    """The ``read()``-style aggregate dict from raw bucket state."""
+    return {
+        "count": count,
+        "sum": round(total, 3),
+        "mean": round(total / count, 3) if count else 0.0,
+        "min": round(vmin, 3) if vmin is not None else 0.0,
+        "max": round(vmax, 3) if vmax is not None else 0.0,
+        "p50": round(_percentile_from(bounds, counts, count, vmin, vmax,
+                                      50), 3),
+        "p90": round(_percentile_from(bounds, counts, count, vmin, vmax,
+                                      90), 3),
+        "p99": round(_percentile_from(bounds, counts, count, vmin, vmax,
+                                      99), 3),
+    }
+
+
 class Histogram:
     """Fixed log-scale-bucket histogram (see module docstring).
 
@@ -107,17 +149,20 @@ class Histogram:
     happen under one lock — a handful of int/float adds, no formatting.
     """
 
-    __slots__ = ("name", "bounds", "counts", "count", "total", "vmin",
-                 "vmax", "_lock")
+    __slots__ = ("name", "base", "growth", "bounds", "counts", "count",
+                 "total", "vmin", "vmax", "help", "_lock")
     kind = "histogram"
 
     def __init__(self, name: str, base: float = 1.0,
-                 growth: float = 10.0 ** 0.1, buckets: int = 120):
+                 growth: float = 10.0 ** 0.1, buckets: int = 120,
+                 help: str = ""):
         if base <= 0 or growth <= 1.0 or buckets < 1:
             raise MXNetError(
                 f"Histogram {name!r}: need base > 0, growth > 1, "
                 f"buckets >= 1 (got {base}, {growth}, {buckets})")
         self.name = name
+        self.base = float(base)
+        self.growth = float(growth)
         self.bounds: Tuple[float, ...] = tuple(
             base * growth ** i for i in range(buckets))
         self.counts: List[int] = [0] * (buckets + 1)
@@ -125,6 +170,7 @@ class Histogram:
         self.total = 0.0
         self.vmin: Optional[float] = None
         self.vmax: Optional[float] = None
+        self.help = help
         self._lock = threading.Lock()
 
     def observe(self, value: float) -> None:
@@ -139,24 +185,11 @@ class Histogram:
                 self.vmax = value
 
     def percentile(self, q: float) -> float:
-        """Approximate q-th percentile (q in [0, 100]) from the buckets:
-        the containing bucket's upper bound, clamped to the observed
-        min/max so edge buckets don't overstate.  Resolution = one bucket
-        (±(growth-1)/2 relative)."""
+        """Approximate q-th percentile (q in [0, 100]) from the buckets.
+        Resolution = one bucket (±(growth-1)/2 relative)."""
         with self._lock:
-            if self.count == 0:
-                return 0.0
-            rank = max(1, int(round(q / 100.0 * self.count)))
-            acc = 0
-            for i, c in enumerate(self.counts):
-                acc += c
-                if acc >= rank:
-                    if i >= len(self.bounds):    # overflow bucket
-                        return float(self.vmax)
-                    hi = self.bounds[i]
-                    lo = self.vmin if self.vmin is not None else hi
-                    return float(min(max(hi, lo), self.vmax))
-            return float(self.vmax)
+            return _percentile_from(self.bounds, self.counts, self.count,
+                                    self.vmin, self.vmax, q)
 
     @property
     def mean(self) -> float:
@@ -173,18 +206,22 @@ class Histogram:
     def read(self) -> dict:
         """Aggregate view (the snapshot() value for histograms)."""
         with self._lock:
+            counts = list(self.counts)
             count, total = self.count, self.total
             vmin, vmax = self.vmin, self.vmax
-        return {
-            "count": count,
-            "sum": round(total, 3),
-            "mean": round(total / count, 3) if count else 0.0,
-            "min": round(vmin, 3) if vmin is not None else 0.0,
-            "max": round(vmax, 3) if vmax is not None else 0.0,
-            "p50": round(self.percentile(50), 3),
-            "p90": round(self.percentile(90), 3),
-            "p99": round(self.percentile(99), 3),
-        }
+        return _aggregate_from(self.bounds, counts, count, total, vmin,
+                               vmax)
+
+    def state(self) -> dict:
+        """Raw, merge-able state (JSON-serializable) — the unit the
+        multi-host gather ships over DCN.  ``base``/``growth`` travel
+        along so a peer can rebuild the bounds and refuse to merge a
+        histogram whose bucketing differs."""
+        with self._lock:
+            return {"kind": "histogram", "base": self.base,
+                    "growth": self.growth, "counts": list(self.counts),
+                    "count": self.count, "total": self.total,
+                    "min": self.vmin, "max": self.vmax, "help": self.help}
 
     def cumulative_buckets(self) -> List[Tuple[float, int]]:
         """(upper_bound, cumulative_count) pairs for Prometheus-style
@@ -225,6 +262,10 @@ class MetricsRegistry:
                 raise MXNetError(
                     f"metric {name!r} is already registered as a "
                     f"{type(m).__name__}, not a {cls.__name__}")
+            if kwargs.get("help") and not m.help:
+                # a later call site may carry the description the first
+                # (hot-path) registration omitted
+                m.help = kwargs["help"]
             return m
         if not _NAME_RE.match(name):
             raise MXNetError(
@@ -241,11 +282,11 @@ class MetricsRegistry:
                     f"{type(m).__name__}, not a {cls.__name__}")
             return m
 
-    def counter(self, name: str) -> Counter:
-        return self._get_or_create(name, Counter)
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(name, Counter, help=help)
 
-    def gauge(self, name: str) -> Gauge:
-        return self._get_or_create(name, Gauge)
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(name, Gauge, help=help)
 
     def histogram(self, name: str, **kwargs) -> Histogram:
         return self._get_or_create(name, Histogram, **kwargs)
@@ -256,13 +297,40 @@ class MetricsRegistry:
     def names(self) -> List[str]:
         return sorted(self._metrics)
 
-    def snapshot(self) -> dict:
+    def snapshot(self, all_hosts: bool = False) -> dict:
         """Every metric in ONE dict: counters → int, gauges → float,
         histograms → their aggregate sub-dict.  The single pull surface
-        the exporters, tests, and the back-compat views read."""
+        the exporters, tests, and the back-compat views read.
+
+        ``all_hosts=True`` is the FLEET view: every host's raw metric
+        state is gathered over the DCN ``allgather_host`` path (a
+        collective — all processes must call it together, e.g. at a
+        checkpoint boundary) and merged: counters sum, histogram buckets
+        add, and every series carries a ``host`` map keyed by process
+        index.  Falls back to the local host (labeled ``host=0``) when
+        the process group is not initialized, so single-process code
+        paths need no guard."""
+        if all_hosts:
+            return merge_host_states(gather_host_states(self))
         with self._lock:
             items = sorted(self._metrics.items())
         return {name: m.read() for name, m in items}
+
+    def export_state(self) -> dict:
+        """Raw per-metric state (JSON-serializable) — what one host
+        contributes to the multi-host gather."""
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = {}
+        for name, m in items:
+            if isinstance(m, Counter):
+                out[name] = {"kind": "counter", "n": m.n, "help": m.help}
+            elif isinstance(m, Gauge):
+                out[name] = {"kind": "gauge", "v": m.value,
+                             "help": m.help}
+            else:
+                out[name] = m.state()
+        return out
 
     def reset(self, prefix: str = "") -> None:
         """Zero every metric under ``prefix`` ('' = all) — test harness /
@@ -288,3 +356,167 @@ def registry() -> MetricsRegistry:
         if _registry_inst is None:
             _registry_inst = MetricsRegistry()
         return _registry_inst
+
+
+# -- multi-host aggregation (the fleet view) --------------------------------
+#
+# Per-host registries stay strictly local (producers never pay a network
+# cost); the fleet view is assembled on demand by gathering every host's
+# export_state() as one JSON blob over parallel.dist's allgather_host DCN
+# path.  The gather is a COLLECTIVE — all processes must reach it together
+# (checkpoint boundaries are the natural sync point) — so nothing here
+# runs implicitly from a scrape handler; the Prometheus AGGREGATE mode
+# serves the most recently gathered states instead (see export.py).
+
+_last_host_states: Optional[List[Tuple[int, dict]]] = None
+
+
+def host_id() -> int:
+    """This process's index in the fleet (0 when single-process)."""
+    try:
+        from ..parallel import dist
+        if dist.is_initialized():
+            return dist.rank()
+    except Exception:   # noqa: BLE001 — jax state probing must not
+        pass            # break local-only metrics
+    return 0
+
+
+def gather_host_states(reg: Optional[MetricsRegistry] = None
+                       ) -> List[Tuple[int, dict]]:
+    """Gather ``(host_index, export_state())`` from every process.
+    Collective when the process group is initialized; local-only
+    fallback otherwise.  The result is memoized so the Prometheus
+    AGGREGATE endpoint can serve the fleet view between gathers."""
+    global _last_host_states
+    reg = reg if reg is not None else registry()
+    local = reg.export_state()
+    states = [(host_id(), local)]
+    try:
+        from ..parallel import dist
+        if dist.is_initialized():
+            blobs = dist.allgather_bytes(
+                json.dumps(local).encode("utf-8"))
+            states = [(i, json.loads(b.decode("utf-8")))
+                      for i, b in enumerate(blobs)]
+            # memoize ONLY a successful fleet gather: a transient
+            # failure must not evict the last good remote view the
+            # AGGREGATE endpoint is serving (last_host_states always
+            # reads the LOCAL host live regardless)
+            _last_host_states = states
+    except Exception as e:   # noqa: BLE001 — a failed gather degrades to
+        # the local view instead of taking down the caller (observability
+        # must never kill the job it observes)
+        import warnings
+        warnings.warn(f"multi-host metric gather failed; serving the "
+                      f"local view only ({e})", RuntimeWarning,
+                      stacklevel=2)
+    return states
+
+
+def last_host_states(reg: Optional[MetricsRegistry] = None
+                     ) -> List[Tuple[int, dict]]:
+    """Per-host states for the serving path: THIS host's state is read
+    live from the registry; remote hosts are as-of the most recent
+    gather (scrapes must not run collectives — see gather_host_states).
+    Before any gather (or single-process) this is just the local host."""
+    reg = reg if reg is not None else registry()
+    me = host_id()
+    states = [(me, reg.export_state())]
+    if _last_host_states is not None:
+        states += [(h, st) for h, st in _last_host_states if h != me]
+        states.sort(key=lambda hs: hs[0])
+    return states
+
+
+def state_bounds(state: dict) -> Tuple[float, ...]:
+    """Rebuild a histogram state's bucket upper bounds from its
+    ``base``/``growth`` (the overflow bucket carries no bound)."""
+    n = len(state["counts"]) - 1
+    base, growth = state["base"], state["growth"]
+    return tuple(base * growth ** i for i in range(n))
+
+
+def state_cumulative_buckets(state: dict) -> List[Tuple[float, int]]:
+    """(upper_bound, cumulative_count) pairs from a raw histogram state
+    — the state-dict twin of :meth:`Histogram.cumulative_buckets`, with
+    the same elision of empty buckets and final (inf, count) pair."""
+    bounds = state_bounds(state)
+    out: List[Tuple[float, int]] = []
+    acc = 0
+    for i, c in enumerate(state["counts"][:-1]):
+        acc += c
+        if c:
+            out.append((bounds[i], acc))
+    out.append((float("inf"), state["count"]))
+    return out
+
+
+def group_host_entries(states: List[Tuple[int, dict]]):
+    """Iterate the union of metric names across per-host states as
+    ``(name, kind, [(host, entry), ...])``, keeping only entries whose
+    kind matches the first host reporting that name (a disagreeing host
+    is dropped from that series rather than corrupting it).  Shared by
+    the merge and the host-labeled Prometheus text format so the two
+    views can't drift."""
+    names = sorted({n for _, st in states for n in st})
+    for name in names:
+        entries = [(h, st[name]) for h, st in states if name in st]
+        kind = entries[0][1].get("kind")
+        yield name, kind, [(h, e) for h, e in entries
+                           if e.get("kind") == kind]
+
+
+def merge_host_states(states: List[Tuple[int, dict]]) -> dict:
+    """Merge per-host raw states into one host-labeled fleet snapshot:
+
+    - counter → ``{"kind", "total", "host": {"<i>": n}}``
+    - gauge → ``{"kind", "host": {"<i>": v}}`` (no cross-host sum — a
+      queue depth summed over hosts is meaningless; PromQL aggregates)
+    - histogram → merged aggregate (buckets added elementwise across
+      hosts with identical bucketing) plus a per-host aggregate map
+
+    A host whose metric kind or bucketing disagrees with the first
+    host's is reported under its host label but left out of the merged
+    totals rather than silently corrupting them."""
+    merged: Dict[str, dict] = {}
+    for name, kind, entries in group_host_entries(states):
+        if kind == "counter":
+            merged[name] = {
+                "kind": "counter",
+                "total": sum(e["n"] for _, e in entries),
+                "host": {str(h): e["n"] for h, e in entries}}
+        elif kind == "gauge":
+            merged[name] = {
+                "kind": "gauge",
+                "host": {str(h): e["v"] for h, e in entries}}
+        elif kind == "histogram":
+            ref = entries[0][1]
+            bounds = state_bounds(ref)
+            counts = [0] * len(ref["counts"])
+            count, total = 0, 0.0
+            vmin, vmax = None, None
+            per_host = {}
+            for h, e in entries:
+                per_host[str(h)] = _aggregate_from(
+                    state_bounds(e), e["counts"], e["count"], e["total"],
+                    e["min"], e["max"])
+                if (e["base"], e["growth"], len(e["counts"])) != \
+                        (ref["base"], ref["growth"], len(ref["counts"])):
+                    continue     # incompatible bucketing: labeled only
+                for i, c in enumerate(e["counts"]):
+                    counts[i] += c
+                count += e["count"]
+                total += e["total"]
+                if e["min"] is not None and \
+                        (vmin is None or e["min"] < vmin):
+                    vmin = e["min"]
+                if e["max"] is not None and \
+                        (vmax is None or e["max"] > vmax):
+                    vmax = e["max"]
+            agg = _aggregate_from(bounds, counts, count, total, vmin,
+                                  vmax)
+            agg["kind"] = "histogram"
+            agg["host"] = per_host
+            merged[name] = agg
+    return merged

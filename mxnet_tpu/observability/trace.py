@@ -34,9 +34,30 @@ from typing import List, Optional
 from ..engine import engine
 from .registry import registry
 
-__all__ = ["span", "current", "stack"]
+__all__ = ["span", "current", "stack", "add_span_listener",
+           "remove_span_listener"]
 
 _tls = threading.local()
+
+# span sinks: fn(name, t_end_seconds, duration_us) called on every span
+# exit.  The profiler installs one so spans land on its chrome-trace
+# timeline as PROPER duration events (pid=host, tid=thread) next to op
+# events — unlike the engine-listener echo below, installing a span
+# listener does NOT suspend bulked dispatch (spans wrap steps/flushes,
+# not ops, so they need no per-op outputs).
+_span_listeners: List = []
+
+
+def add_span_listener(fn) -> None:
+    """Install a span sink: ``fn(name, t_end, duration_us)`` with
+    ``t_end`` in ``time.perf_counter()`` seconds."""
+    if fn not in _span_listeners:
+        _span_listeners.append(fn)
+
+
+def remove_span_listener(fn) -> None:
+    if fn in _span_listeners:
+        _span_listeners.remove(fn)
 
 
 def _stack() -> List[str]:
@@ -84,16 +105,22 @@ class span:
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
-        self.duration_us = (perf_counter() - self._t0) * 1e6
+        t_end = perf_counter()
+        self.duration_us = (t_end - self._t0) * 1e6
         s = getattr(_tls, "stack", None)
         if s:
             s.pop()
         if self._record:
             registry().get(self.name).observe(self.duration_us)
+        for fn in _span_listeners:
+            # the profiler's timeline sink: proper duration events with
+            # real start/end timestamps on the host/thread lanes
+            fn(self.name, t_end, self.duration_us)
         eng = engine()
         if eng._listeners:
-            # profiler running: surface the span in the same event stream
-            # as op dispatches (the chrome trace groups them by name)
+            # monitors tapping raw engine dispatches still see the span
+            # in the same event stream (the profiler ignores this echo —
+            # it gets the real event through the span listener above)
             for fn in eng._listeners:
                 fn(f"span:{self.name}", (), self.duration_us)
         return None

@@ -20,12 +20,14 @@ stale parameter-server updates (SURVEY.md §5.8).
 from __future__ import annotations
 
 import os
+import threading
 from typing import Optional
 
 from ..base import MXNetError
 
 __all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
-           "allreduce_host", "allgather_host", "broadcast_host", "barrier"]
+           "allreduce_host", "allgather_host", "allgather_bytes",
+           "broadcast_host", "barrier"]
 
 
 def is_initialized() -> bool:
@@ -139,6 +141,24 @@ def num_workers() -> int:
     return jax.process_count()
 
 
+def _gather_arrays_kv(arr, timeout: Optional[float] = None):
+    """KV-store transport for the host collectives: each rank ships its
+    numpy array (npy-serialized) through :func:`_allgather_bytes_kv` and
+    stacks the fleet's contributions.  Same contract as
+    ``process_allgather`` with equal shapes; exists because device
+    collectives don't span processes on every backend (multi-process
+    CPU), while the coordination service always does."""
+    import io
+    import numpy as np
+    if timeout is None:
+        timeout = float(os.environ.get("MXTPU_DIST_TIMEOUT", "300"))
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    blobs = _allgather_bytes_kv(buf.getvalue(), timeout)
+    return np.stack([np.load(io.BytesIO(b), allow_pickle=False)
+                     for b in blobs])
+
+
 def allreduce_host(x):
     """Sum a host-local numpy array across all processes.
 
@@ -146,26 +166,148 @@ def allreduce_host(x):
     path uses in-graph psum over the device mesh instead).
     """
     import numpy as np
-    from jax.experimental import multihost_utils
-    gathered = multihost_utils.process_allgather(np.asarray(x))
-    return np.sum(gathered, axis=0)
+    return np.sum(allgather_host(x), axis=0)
 
 
 def allgather_host(x):
     """Gather each process's host-local numpy array; returns an array with
-    a leading num_workers axis (this process's slot included)."""
+    a leading num_workers axis (this process's slot included).
+
+    Transport is tiered like :func:`allgather_bytes`: the XLA device
+    collective where the backend spans processes (TPU pods), else the
+    coordination-service KV store — so the object plane works on the
+    multi-process CPU backend too (where XLA reports 'Multiprocess
+    computations aren't implemented')."""
     import numpy as np
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.process_allgather(np.asarray(x)))
+    arr = np.asarray(x)
+    try:
+        return np.asarray(multihost_utils.process_allgather(arr))
+    except Exception:   # noqa: BLE001 — backend capability, determinis-
+        # tic per backend: every rank takes the same branch
+        if not is_initialized():
+            raise
+        return _gather_arrays_kv(arr)
+
+
+def _allgather_bytes_device(data: bytes):
+    """Byte gather over the raw ``process_allgather`` device collective
+    (deliberately NOT :func:`allgather_host`, whose KV fallback would
+    turn one logical gather into two — an unsupported backend should
+    fail fast here so :func:`allgather_bytes` takes its single-gather
+    KV path instead).  Variable lengths need two collectives (equal
+    shapes are required): gather the lengths, then gather payloads
+    padded to the fleet maximum and trim each back to its sender's
+    true length."""
+    import numpy as np
+    from jax.experimental import multihost_utils
+    sizes = np.asarray(multihost_utils.process_allgather(
+        np.asarray([len(data)], dtype=np.int64)))[:, 0]
+    cap = int(sizes.max())
+    if cap == 0:
+        return [b""] * len(sizes)
+    buf = np.zeros((cap,), dtype=np.uint8)
+    buf[:len(data)] = np.frombuffer(data, dtype=np.uint8)
+    gathered = np.asarray(multihost_utils.process_allgather(buf))
+    return [gathered[i, :int(sizes[i])].tobytes()
+            for i in range(len(sizes))]
+
+
+# generation counters for the KV-store fallbacks below.  Every KV-path
+# entry point is a COLLECTIVE (each process calls it the same number of
+# times in the same order), so per-process counters stay in lockstep
+# across the fleet and key/barrier names never collide across calls.
+_gen_lock = threading.Lock()
+_agb_gen = 0
+
+
+def _allgather_bytes_kv(data: bytes, timeout: float):
+    """Byte gather over the coordination-service KV store (the same
+    coordinator TCP fabric ``jax.distributed.initialize`` joined): each
+    rank publishes its payload under a generation-unique key and blocks
+    reading every peer's.  No device round-trip and no padding — and it
+    works on backends whose device collectives don't span processes
+    (the multi-process CPU backend used in tests)."""
+    import base64
+    from jax._src import distributed
+    global _agb_gen
+    client = distributed.global_state.client
+    r, nw = rank(), num_workers()
+    with _gen_lock:
+        gen = _agb_gen
+        _agb_gen += 1
+    key = f"mxtpu/agb/{gen}"
+    timeout_ms = max(1000, int(timeout * 1000))
+    client.key_value_set(f"{key}/{r}",
+                         base64.b64encode(data).decode("ascii"))
+    out = [base64.b64decode(
+        client.blocking_key_value_get(f"{key}/{i}", timeout_ms))
+        for i in range(nw)]
+    try:
+        # only safe to delete our key once EVERY rank has read it
+        client.wait_at_barrier(f"mxtpu_agb_{gen}", timeout_ms)
+        client.key_value_delete(f"{key}/{r}")
+    except Exception:   # noqa: BLE001 — cleanup is best-effort; a few
+        pass            # stale keys beat a wedged gather
+    return out
+
+
+def allgather_bytes(data: bytes, timeout: Optional[float] = None):
+    """Gather one variable-length byte payload from every process;
+    returns a list of ``num_workers`` byte strings indexed by rank.
+
+    The DCN object plane for non-array payloads (the multi-host metrics
+    gather ships JSON snapshots through here).  Transport is tiered:
+    the ``allgather_host`` device collective when the backend spans
+    processes (TPU pods — the efficient DCN path), else the
+    coordination-service KV store (always available once the process
+    group is up).  Local-only fallback: a single-element list when the
+    process group is not initialized."""
+    data = bytes(data)
+    if not is_initialized():
+        return [data]
+    if timeout is None:
+        timeout = float(os.environ.get("MXTPU_DIST_TIMEOUT", "300"))
+    try:
+        return _allgather_bytes_device(data)
+    except Exception:   # noqa: BLE001 — backend-dependent capability
+        # (e.g. CPU: "Multiprocess computations aren't implemented");
+        # deterministic per backend, so every rank takes the same branch
+        return _allgather_bytes_kv(data, timeout)
 
 
 def broadcast_host(x):
     """Broadcast rank 0's host-local numpy array to all processes."""
     import numpy as np
     from jax.experimental import multihost_utils
-    return np.asarray(multihost_utils.broadcast_one_to_all(np.asarray(x)))
+    arr = np.asarray(x)
+    try:
+        return np.asarray(multihost_utils.broadcast_one_to_all(arr))
+    except Exception:   # noqa: BLE001 — same tiering as allgather_host
+        if not is_initialized():
+            raise
+        return _gather_arrays_kv(arr)[0]
+
+
+_barrier_gen = 0
 
 
 def barrier(name: str = "mxnet_tpu_barrier") -> None:
+    global _barrier_gen   # noqa: PLW0603 — lockstep generation counter
     from jax.experimental import multihost_utils
-    multihost_utils.sync_global_devices(name)
+    try:
+        multihost_utils.sync_global_devices(name)
+    except Exception:   # noqa: BLE001 — same tiering: the coordination
+        # service's own barrier when device collectives can't span
+        # processes.  Barrier ids must be unique per use; the generation
+        # counter stays in lockstep because barrier() is a collective.
+        if not is_initialized():
+            raise
+        from jax._src import distributed
+        with _gen_lock:
+            gen = _barrier_gen
+            _barrier_gen += 1
+        timeout_ms = max(1000, int(float(
+            os.environ.get("MXTPU_DIST_TIMEOUT", "300")) * 1000))
+        distributed.global_state.client.wait_at_barrier(
+            f"mxtpu_barrier_{name}_{gen}", timeout_ms)

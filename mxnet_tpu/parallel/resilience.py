@@ -42,6 +42,7 @@ from typing import Optional, Tuple, Type
 
 from ..base import MXNetError
 from ..faults import FaultPlan, TransientFault, active_plan, retry_call
+from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry as _metrics_registry
 from ..observability.trace import span as _span
 from .trainer import ShardedTrainer
@@ -216,6 +217,23 @@ class ResilientTrainer:
             ("steps_skipped", "steps_retried", "steps_failed",
              "rollbacks", "checkpoints_written", "checkpoints_pruned",
              "checkpoints_failed", "resumes"))
+        reg = _metrics_registry()
+        self._g_loss_scale = reg.gauge(
+            "resilience.loss_scale",
+            help="current (dynamic) loss scale — refreshed at sync "
+                 "points (skip-flag drains, checkpoints), not per step")
+        self._g_loss_scale.set(trainer.loss_scale if trainer.built
+                               else (init_loss_scale if dynamic_loss_scale
+                                     else 1.0))
+        # flight-recorder plumbing: per-step records ride the supervised
+        # step; dumps fire from the preemption and retry-exhaustion
+        # paths below (plus the process-wide excepthook installed here)
+        self._flight = _flight_recorder()
+        self._flight.install()
+        self._h_flush = reg.histogram("engine.flush_us")
+        self._c_skipped = reg.counter("resilience.steps_skipped")
+        self._c_rollbacks = reg.counter("resilience.rollbacks")
+        self._g_loader_depth = reg.gauge("loader.prefetch_depth")
         self._step_unsafe = False     # set once a failed attempt consumed
         # its donated buffers: every later step refuses fast
         self._pending_finite: list = []
@@ -252,6 +270,11 @@ class ResilientTrainer:
         skipped = sum(1 for f in flags if not bool(f))
         if skipped:
             self._metrics.inc("steps_skipped", skipped)
+        # already syncing the device here — refresh the loss-scale gauge
+        # on the same boundary so exporters/flight records see a value
+        # at most one drain stale, without a per-step device_get
+        if self._trainer.guard_enabled:
+            self._g_loss_scale.set(self._trainer.loss_scale)
 
     @property
     def counters(self) -> dict:
@@ -287,6 +310,9 @@ class ResilientTrainer:
 
     def _flush_and_raise(self) -> None:
         signum = self._preempt_signum
+        # the run is about to end: leave the postmortem dump next to the
+        # preemption checkpoint BEFORE the (fallible) save below
+        self._flight.dump(f"preempted by signal {signum}")
         save_err = None
         try:
             if self._ckpt_dir is not None and self._trainer.built and \
@@ -399,7 +425,7 @@ class ResilientTrainer:
             self._metrics.inc("steps_retried")
 
         try:
-            with _span("resilience.step_us"):
+            with _span("resilience.step_us") as sp:
                 loss = retry_call(one_attempt, retries=self._max_retries,
                                   base_delay=self._retry_base,
                                   max_delay=self._retry_max,
@@ -407,7 +433,15 @@ class ResilientTrainer:
                                   on_retry=on_retry)
         except self._retry_on:
             self._metrics.inc("steps_failed")
+            # retries exhausted: the caller may catch and abandon the
+            # run, so the postmortem ring dumps NOW, not only from the
+            # excepthook
+            self._record_step(i, None, sp.duration_us, failed=True)
+            self._flight.dump(
+                f"step {i} failed after {self._max_retries + 1} "
+                f"attempt(s)")
             raise
+        self._record_step(i, loss, sp.duration_us)
         if self._trainer.guard_enabled:
             self._pending_finite.append(self._trainer.last_step_finite)
             if len(self._pending_finite) >= 128:
@@ -422,6 +456,30 @@ class ResilientTrainer:
                 pass   # counted in checkpoints_failed; the next periodic
                 # save (or the preemption path) covers the gap
         return loss
+
+    def _record_step(self, i: int, loss, step_us: float,
+                     failed: bool = False) -> None:
+        """One flight-recorder record per supervised step.  Cheap by
+        construction: counter/gauge reads, one bucket-percentile pass
+        over the flush histogram, and a deque append — the loss is
+        stored as its live device reference and only materialized if a
+        dump ever happens."""
+        if not self._flight.enabled:
+            return
+        flush = self._h_flush
+        self._flight.record(
+            step=i,
+            t=self._trainer.num_update if self._trainer.built else 0,
+            step_us=round(step_us, 1),
+            loss=loss,
+            loss_scale=self._g_loss_scale.value,
+            flush_us_p99=round(flush.percentile(99), 1),
+            flush_count=flush.count,
+            steps_skipped=self._c_skipped.n,
+            rollbacks=self._c_rollbacks.n,
+            loader_depth=self._g_loader_depth.value,
+            failed=failed,
+        )
 
     # -- checkpointing -----------------------------------------------------
     def checkpoint(self, wait: bool = False) -> None:
@@ -454,6 +512,24 @@ class ResilientTrainer:
             if wait:
                 self._trainer.wait_checkpoint()
         self._gc()
+        if self._trainer.guard_enabled:
+            self._g_loss_scale.set(self._trainer.loss_scale)
+        # checkpoint boundaries are the fleet's natural sync point: every
+        # host checkpoints the same step (SPMD lockstep; the sharded
+        # orbax save is itself fleet-synchronized), so the multi-host
+        # metric gather (a collective) lines up here.  Refreshes the
+        # merged view the MXTPU_METRICS_AGGREGATE endpoint serves.
+        # Deliberately NOT gated on that env var: the gate would be a
+        # per-host env read, and hosts disagreeing on it would leave the
+        # opted-in host blocked in a collective its peers never enter.
+        # The gather is a few KB of JSON over DCN — noise next to the
+        # checkpoint write it rides.
+        try:
+            from . import dist
+            if dist.is_initialized():
+                _metrics_registry().snapshot(all_hosts=True)
+        except Exception:   # noqa: BLE001 — the fleet view is
+            pass            # best-effort; checkpointing must win
 
     def flush(self) -> None:
         """Block until any in-flight async write commits, then apply

@@ -32,6 +32,7 @@ from .. import random as _grandom
 from ..ndarray import NDArray
 from ..gluon.block import _TraceCtx, _KeyScope
 from ..gluon.parameter import Parameter
+from ..observability.registry import registry as _metrics_registry
 from .mesh import ShardingRules, default_mesh, replicated, shard
 from .optim import make_functional_optimizer
 
@@ -480,10 +481,18 @@ class ShardedTrainer:
             self._ckptr = ocp.StandardCheckpointer()
         return self._ckptr
 
+    def _ckpt_inflight_gauge(self):
+        return _metrics_registry().gauge(
+            "resilience.ckpt_inflight",
+            help="async checkpoint writes enqueued but not yet "
+                 "committed (0 or 1 — one orbax checkpointer per "
+                 "trainer process)")
+
     def wait_checkpoint(self) -> None:
         """Block until any in-flight async checkpoint write commits."""
         if getattr(self, "_ckptr", None) is not None:
             self._ckptr.wait_until_finished()
+            self._ckpt_inflight_gauge().set(0)
 
     def save_checkpoint(self, directory: str) -> None:
         """Write the trainer-owned SHARDED state (params, aux, optimizer
@@ -509,6 +518,9 @@ class ShardedTrainer:
         self._checkpointer().save(
             os.path.join(directory, f"state-{self._t:08d}"), tree,
             force=True)
+        # the write overlaps training from here until the next
+        # wait_checkpoint() — the ROADMAP's checkpoint-in-flight gauge
+        self._ckpt_inflight_gauge().set(1)
 
     @staticmethod
     def committed_checkpoints(directory: str) -> List[str]:

@@ -42,6 +42,41 @@ class Profiler:
         self._lock = threading.Lock()
         self._listener_installed = False
         self._t0 = time.perf_counter()
+        # ONE timeline for the whole fleet: pid = this process's host
+        # index (resolved lazily — profiling may start before the
+        # process group), tid = a small per-thread lane so supervisor
+        # steps, loader workers, and engine flushes land on separate
+        # rows of the same chrome trace
+        self._pid: Optional[int] = None
+        self._tids: Dict[int, int] = {}      # thread ident -> lane
+        self._tnames: Dict[int, str] = {}    # lane -> thread name
+
+    def _host_pid(self) -> int:
+        # cached so the per-event path never probes; start() clears the
+        # cache, so each profiling session re-resolves — a session begun
+        # AFTER init_process_group gets the real host index even if an
+        # earlier pre-init session cached the single-process fallback
+        if self._pid is None:
+            try:
+                from .parallel.dist import is_initialized
+                if is_initialized():
+                    import jax
+                    self._pid = jax.process_index()
+                else:
+                    self._pid = 0
+            except Exception:   # noqa: BLE001 — a broken dist probe must
+                self._pid = 0   # not break profiling
+        return self._pid
+
+    def _lane(self) -> int:
+        """Small stable per-thread tid (call under self._lock)."""
+        ident = threading.get_ident()
+        lane = self._tids.get(ident)
+        if lane is None:
+            lane = len(self._tids)
+            self._tids[ident] = lane
+            self._tnames[lane] = threading.current_thread().name
+        return lane
 
     @classmethod
     def get(cls) -> "Profiler":
@@ -53,17 +88,47 @@ class Profiler:
     def _on_op(self, op_name: str, outputs, dispatch_us: float = 0.0) -> None:
         if not self._running or self._paused:
             return
+        if op_name.startswith("span:"):
+            # the engine-listener echo of a trace span — the real event
+            # (correct start timestamp, host pid, thread lane) arrives
+            # through _on_span; counting this too would double it
+            return
         now = (time.perf_counter() - self._t0) * 1e6   # µs
         dur = max(dispatch_us, 0.1)                    # measured, not gap
+        pid = self._host_pid()
         with self._lock:
             self._events.append({
-                "name": op_name, "ph": "X", "pid": 0, "tid": 0,
-                "ts": now - dur, "dur": dur, "cat": "operator"})
+                "name": op_name, "ph": "X", "pid": pid,
+                "tid": self._lane(), "ts": now - dur, "dur": dur,
+                "cat": "operator"})
             self._agg.setdefault(op_name, []).append(dur)
 
+    # -- span listener (trace.span -> unified timeline) --------------------
+    def _on_span(self, name: str, t_end: float, dur_us: float) -> None:
+        """``trace.span`` exits land here as PROPER duration events:
+        supervisor steps, engine flushes, and loader batches appear on
+        the same timeline as per-op events, with pid = host index and
+        tid = thread lane (nested spans render stacked, chrome-trace
+        semantics)."""
+        if not self._running or self._paused:
+            return
+        ts_end = (t_end - self._t0) * 1e6              # µs
+        dur = max(dur_us, 0.1)
+        pid = self._host_pid()
+        with self._lock:
+            self._events.append({
+                "name": name, "ph": "X", "pid": pid,
+                "tid": self._lane(), "ts": ts_end - dur, "dur": dur,
+                "cat": "span"})
+            self._agg.setdefault(f"span:{name}", []).append(dur)
+
     def start(self) -> None:
+        self._pid = None               # re-resolve host index per session
+        self._host_pid()
         if not self._listener_installed:
             engine().add_listener(self._on_op)
+            from .observability.trace import add_span_listener
+            add_span_listener(self._on_span)
             self._listener_installed = True
         self._running = True
         if self.profile_all and not self.trace_dir:
@@ -87,12 +152,23 @@ class Profiler:
         # profiler must cost nothing (start() re-installs)
         if self._listener_installed:
             engine().remove_listener(self._on_op)
+            from .observability.trace import remove_span_listener
+            remove_span_listener(self._on_span)
             self._listener_installed = False
 
     # -- output ------------------------------------------------------------
     def dump(self, finished: bool = True) -> None:
+        pid = self._host_pid()
         with self._lock:
-            payload = {"traceEvents": list(self._events),
+            # chrome-trace metadata names the lanes: the process row is
+            # the host, each tid row the thread that emitted its events
+            meta = [{"name": "process_name", "ph": "M", "pid": pid,
+                     "args": {"name": f"host {pid}"}}]
+            for lane, tname in sorted(self._tnames.items()):
+                meta.append({"name": "thread_name", "ph": "M",
+                             "pid": pid, "tid": lane,
+                             "args": {"name": tname}})
+            payload = {"traceEvents": meta + list(self._events),
                        "displayTimeUnit": "ms"}
         with open(self.filename, "w") as f:
             json.dump(payload, f)

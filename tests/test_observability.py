@@ -1,12 +1,21 @@
 """Unified observability subsystem (mxnet_tpu/observability/): registry
 thread-safety, histogram bucket math, span nesting, Prometheus endpoint
 round-trip, JSONL writer rotation, back-compat of the legacy
-``engine().stats()`` / ``ResilientTrainer.counters`` views — plus the
-AST lint gate rejecting new ad-hoc module-level counter dicts."""
+``engine().stats()`` / ``ResilientTrainer.counters`` views; the fleet
+layer — multi-host snapshot merging (single-process fallback AND a real
+multi-process group), host-labeled aggregate text format, the unified
+chrome-trace timeline (op + span events), and the crash flight recorder
+— plus two AST lint gates: no new ad-hoc module-level counter dicts,
+and no new ad-hoc ``time.time()``/``perf_counter()`` timing pairs
+outside the observability layer."""
 import ast
 import json
 import os
 import re
+import socket
+import subprocess
+import sys
+import textwrap
 import threading
 import urllib.request
 
@@ -287,6 +296,8 @@ def test_prometheus_text_wellformed():
     text = export.prometheus_text()
     typed = set()
     for line in text.strip().splitlines():
+        if line.startswith("# HELP "):
+            continue
         if line.startswith("# TYPE "):
             _, _, name, kind = line.split(" ")
             assert kind in ("counter", "gauge", "histogram")
@@ -428,6 +439,468 @@ def test_no_adhoc_counter_dicts_in_package():
     assert not offenders, \
         f"ad-hoc counter dicts (use observability.registry() instead " \
         f"of growing another disconnected metrics surface): {offenders}"
+
+
+# -- help lines -------------------------------------------------------------
+
+def test_help_lines_in_prometheus_text():
+    reg = registry()
+    reg.counter("t.helped_total", help="a helped counter").inc(2)
+    reg.gauge("t.helped_gauge", help="a helped gauge").set(1.0)
+    reg.histogram("t.helped_us", help="a helped histogram").observe(5.0)
+    text = export.prometheus_text()
+    assert "# HELP mxtpu_t_helped_total a helped counter" in text
+    assert "# HELP mxtpu_t_helped_gauge a helped gauge" in text
+    assert "# HELP mxtpu_t_helped_us a helped histogram" in text
+    # HELP precedes TYPE for the same family (exposition-format order)
+    lines = text.splitlines()
+    i_help = lines.index("# HELP mxtpu_t_helped_total a helped counter")
+    assert lines[i_help + 1] == "# TYPE mxtpu_t_helped_total counter"
+    # a later registration back-fills a missing description
+    reg.counter("t.late_help")
+    reg.counter("t.late_help", help="arrived later")
+    assert "# HELP mxtpu_t_late_help arrived later" in \
+        export.prometheus_text()
+    # engine metrics ship descriptions out of the box
+    from mxnet_tpu.engine import engine
+    engine()
+    assert "# HELP mxtpu_engine_ops_dispatched " in \
+        export.prometheus_text()
+
+
+# -- multi-host aggregation -------------------------------------------------
+
+def test_snapshot_all_hosts_single_process_fallback():
+    """Without a process group, snapshot(all_hosts=True) serves the
+    local registry as host 0 — same shape as the fleet view, no guard
+    needed in calling code."""
+    reg = registry()
+    reg.counter("t.sh_events").inc(4)
+    reg.gauge("t.sh_depth").set(3.0)
+    h = reg.histogram("t.sh_us")
+    for v in (10.0, 20.0, 30.0):
+        h.observe(v)
+    snap = reg.snapshot(all_hosts=True)
+    c = snap["t.sh_events"]
+    assert c["kind"] == "counter" and c["total"] == 4
+    assert c["host"] == {"0": 4}
+    assert snap["t.sh_depth"]["host"] == {"0": 3.0}
+    hh = snap["t.sh_us"]
+    assert hh["count"] == 3 and hh["host"]["0"]["count"] == 3
+    # merged-bucket aggregates match the local read exactly (one host)
+    assert hh["p50"] == h.read()["p50"]
+
+
+def test_merge_host_states_math():
+    """Merging is pure bucket/count arithmetic — simulate three hosts
+    without any process group."""
+    from mxnet_tpu.observability.registry import (MetricsRegistry,
+                                                  merge_host_states)
+    states = []
+    for host in range(3):
+        reg = MetricsRegistry()
+        reg.counter("t.m_events").inc(host + 1)
+        reg.gauge("t.m_depth").set(float(host))
+        h = reg.histogram("t.m_us", base=1.0, growth=2.0, buckets=8)
+        for _ in range(host + 1):
+            h.observe(2.0 ** host)
+        if host == 2:          # a host-local-only metric stays labeled
+            reg.counter("t.m_only_host2").inc(7)
+        states.append((host, reg.export_state()))
+    merged = merge_host_states(states)
+    assert merged["t.m_events"]["total"] == 6
+    assert merged["t.m_events"]["host"] == {"0": 1, "1": 2, "2": 3}
+    assert merged["t.m_depth"]["host"] == {"0": 0.0, "1": 1.0, "2": 2.0}
+    hh = merged["t.m_us"]
+    assert hh["count"] == 6
+    assert hh["min"] == 1.0 and hh["max"] == 4.0
+    assert hh["host"]["2"]["count"] == 3
+    only = merged["t.m_only_host2"]
+    assert only["total"] == 7 and only["host"] == {"2": 7}
+
+
+def test_prometheus_aggregate_text_host_labels(monkeypatch):
+    """The AGGREGATE endpoint serves every series with a host label;
+    single-process it serves the local host's series as host 0."""
+    registry().counter("t.agg_probe").inc(9)
+    registry().histogram("t.agg_probe_us").observe(3.0)
+    text = export.prometheus_text_aggregate()
+    assert 'mxtpu_t_agg_probe{host="0"} 9' in text
+    assert 'mxtpu_t_agg_probe_us_bucket{host="0",le=' in text
+    assert 'mxtpu_t_agg_probe_us_count{host="0"}' in text
+    # the endpoint switches on the env var, read live per scrape
+    monkeypatch.setenv("MXTPU_METRICS_AGGREGATE", "1")
+    srv = export.MetricsServer(port=0, addr="127.0.0.1")
+    try:
+        body = urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics",
+            timeout=10).read().decode()
+        assert 'mxtpu_t_agg_probe{host="0"} 9' in body
+    finally:
+        srv.stop()
+
+
+_MH_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["MXNET_TEST_ROOT"])
+    from mxnet_tpu.base import force_cpu_mesh
+    force_cpu_mesh(1, verify=False)  # distributed init must precede the
+    import numpy as np               # first backend query
+    import mxnet_tpu as mx
+    from mxnet_tpu.parallel import dist
+
+    dist.init_process_group()        # joins from DMLC_* env
+    rank, nw = dist.rank(), dist.num_workers()
+
+    from mxnet_tpu.observability import export, registry
+    reg = registry()
+    reg.counter("t.mh_events", help="multi-host probe").inc(rank + 1)
+    reg.gauge("t.mh_depth").set(float(rank) * 2.0)
+    h = reg.histogram("t.mh_us")
+    for _ in range(rank + 2):
+        h.observe(10.0 * (rank + 1))
+
+    # raw byte-plane round-trip under unequal payload sizes
+    blobs = dist.allgather_bytes(b"host" * (rank + 1))
+    assert blobs == [b"host" * (r + 1) for r in range(nw)], blobs
+
+    from mxnet_tpu.engine import engine
+    engine()                     # materialize engine.* metric families
+
+    snap = reg.snapshot(all_hosts=True)   # the collective gather
+    c = snap["t.mh_events"]
+    assert c["total"] == sum(r + 1 for r in range(nw)), c
+    assert c["host"] == {str(r): r + 1 for r in range(nw)}, c
+    g = snap["t.mh_depth"]
+    assert g["host"] == {str(r): float(r) * 2.0 for r in range(nw)}, g
+    hh = snap["t.mh_us"]
+    assert hh["count"] == sum(r + 2 for r in range(nw)), hh
+    assert hh["max"] == 10.0 * nw and hh["min"] == 10.0, hh
+    assert set(hh["host"]) == {str(r) for r in range(nw)}, hh
+    # every host's engine counters ride the same gather
+    assert snap["engine.ops_dispatched"]["total"] >= 0
+
+    # the gathered states feed the host-labeled text format on EVERY
+    # host (MXTPU_METRICS_AGGREGATE mode serves this from host 0)
+    txt = export.prometheus_text_aggregate()
+    for r in range(nw):
+        line = 'mxtpu_t_mh_events{host="%d"} %d' % (r, r + 1)
+        assert line in txt, txt[:800]
+    assert 'mxtpu_t_mh_us_bucket{host="1",le=' in txt
+    print(f"WORKER_{rank}_OK")
+""")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def test_snapshot_all_hosts_multiprocess(tmp_path):
+    """Acceptance: host-labeled merged metrics under a REAL (simulated
+    localhost) multi-process group over the allgather_host DCN path."""
+    n_workers = 2
+    port = _free_port()
+    script = tmp_path / "mh_worker.py"
+    script.write_text(_MH_WORKER)
+    procs = []
+    for r in range(n_workers):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)  # no TPU contention
+        env.update({
+            "MXNET_TEST_ROOT": REPO,
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(n_workers),
+            "DMLC_WORKER_ID": str(r),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((r, p.returncode, out))
+    for r, rc, out in outs:
+        assert rc == 0, f"worker {r} failed:\n{out}"
+        assert f"WORKER_{r}_OK" in out, f"worker {r} output:\n{out}"
+
+
+# -- unified trace timeline -------------------------------------------------
+
+def test_chrome_trace_contains_op_and_span_events(tmp_path):
+    """Acceptance: trace.span events land in the profiler's chrome-trace
+    JSON as PROPER duration events (pid=host, tid=thread lane) on the
+    same timeline as per-op dispatch events."""
+    from mxnet_tpu import profiler
+    fn = str(tmp_path / "trace.json")
+    p = profiler.Profiler.get()
+    p.reset()
+    profiler.set_config(filename=fn)
+    profiler.set_state("run")
+    try:
+        with trace.span("t.timeline_step_us"):
+            y = mx.nd.ones((16,))
+            for _ in range(3):
+                y = mx.nd.tanh(y * 2.0)
+            y.wait_to_read()
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    events = json.load(open(fn))["traceEvents"]
+    ops = [e for e in events if e.get("cat") == "operator"]
+    spans = [e for e in events if e.get("cat") == "span"]
+    meta = [e for e in events if e.get("ph") == "M"]
+    assert ops, "no operator events on the timeline"
+    assert any(e["name"] == "t.timeline_step_us" for e in spans)
+    # spans are duration events with real geometry, not instants
+    sp = next(e for e in spans if e["name"] == "t.timeline_step_us")
+    assert sp["ph"] == "X" and sp["dur"] > 0 and sp["ts"] >= 0
+    # one process lane per host, named thread lanes
+    assert sp["pid"] == 0 and isinstance(sp["tid"], int)
+    assert any(m["name"] == "process_name" and
+               m["args"]["name"] == "host 0" for m in meta)
+    assert any(m["name"] == "thread_name" for m in meta)
+    # ops within the span sit inside its time range (same clock/epoch)
+    inside = [e for e in ops if e["ts"] >= sp["ts"] - 1 and
+              e["ts"] + e["dur"] <= sp["ts"] + sp["dur"] + 1]
+    assert inside, "op events do not overlap their enclosing span"
+    # the listener echo is NOT double-counted as an operator event
+    assert not any(e["name"].startswith("span:") for e in ops)
+
+
+# -- crash flight recorder --------------------------------------------------
+
+def test_flight_recorder_ring_and_dump(tmp_path):
+    from mxnet_tpu.observability.flight import FlightRecorder
+    path = str(tmp_path / "flight.json")
+    fr = FlightRecorder(capacity=4, path=path)
+    for i in range(10):
+        fr.record(step=i, loss=float(i))
+    assert [r["step"] for r in fr.records()] == [6, 7, 8, 9]
+    registry().counter("t.flight_probe").inc(3)
+    out = fr.dump("unit test")
+    assert out == path
+    d = json.load(open(path))
+    assert d["reason"] == "unit test"
+    assert d["n_steps"] == 4
+    assert [r["step"] for r in d["steps"]] == [6, 7, 8, 9]
+    assert d["steps"][-1]["loss"] == 9.0
+    assert d["snapshot"]["t.flight_probe"] == 3
+    assert d["host"] == 0 and d["capacity"] == 4
+    # capacity 0 disables both recording and dumping
+    off = FlightRecorder(capacity=0, path=str(tmp_path / "off.json"))
+    off.record(step=1)
+    assert off.dump("nope") is None
+    assert not os.path.exists(str(tmp_path / "off.json"))
+
+
+def test_flight_recorder_dump_on_injected_crash(tmp_path, monkeypatch):
+    """Acceptance: an injected mid-step crash (MXTPU_FAULT_PLAN
+    step_error site) leaves a flight-recorder JSON with the last steps
+    and a full snapshot."""
+    from mxnet_tpu.faults import TransientFault
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.observability.flight import recorder
+    from mxnet_tpu.parallel import ResilientTrainer, ShardedTrainer
+    path = str(tmp_path / "crash_flight.json")
+    monkeypatch.setenv("MXTPU_FLIGHT_PATH", path)
+    recorder().clear()      # the ring is process-global; earlier tests
+    # in this file may have run supervised steps
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(8, activation="relu", in_units=4))
+        net.add(nn.Dense(2, in_units=8))
+    net.initialize()
+    tr = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1})
+    # two entries at the same step index = both attempts of step 2 fail
+    rt = ResilientTrainer(tr, auto_resume=False, max_retries=1,
+                          fault_plan="step_error@2,step_error@2")
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randint(0, 2, (8,))
+    rt.step(x, y)
+    with pytest.raises(TransientFault):
+        rt.step(x, y)
+    d = json.load(open(path))
+    assert "step 2 failed" in d["reason"]
+    assert d["n_steps"] == 2
+    ok, crashed = d["steps"]
+    assert ok["step"] == 1 and ok["failed"] is False
+    assert isinstance(ok["loss"], float)          # device value, synced
+    assert ok["step_us"] > 0                      # at dump time only
+    assert crashed["step"] == 2 and crashed["failed"] is True
+    assert crashed["loss"] is None
+    for k in ("loss_scale", "flush_us_p99", "flush_count",
+              "steps_skipped", "rollbacks", "loader_depth", "t"):
+        assert k in ok, k
+    assert d["snapshot"]["resilience.steps_retried"] >= 1
+
+
+def test_flight_recorder_excepthook_dump(tmp_path):
+    """An UNHANDLED exception dumps through the chained sys.excepthook
+    — exercised in a subprocess (pytest swallows in-process ones)."""
+    path = str(tmp_path / "hook_flight.json")
+    script = tmp_path / "crash.py"
+    script.write_text(textwrap.dedent(f"""
+        import os, sys
+        sys.path.insert(0, {REPO!r})
+        from mxnet_tpu.observability import flight
+        r = flight.recorder()
+        r.install()
+        r.record(step=1, loss=0.5)
+        r.record(step=2, loss=0.25)
+        raise RuntimeError("boom")
+    """))
+    env = dict(os.environ, MXTPU_FLIGHT_PATH=path, JAX_PLATFORMS="cpu")
+    env.pop("PALLAS_AXON_POOL_IPS", None)
+    r = subprocess.run([sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=180)
+    assert r.returncode != 0
+    assert "RuntimeError: boom" in r.stderr     # original traceback kept
+    d = json.load(open(path))
+    assert d["reason"].startswith("unhandled RuntimeError: boom")
+    assert [s["step"] for s in d["steps"]] == [1, 2]
+    assert "snapshot" in d
+
+
+def test_resilience_gauges(tmp_path):
+    """ROADMAP gauges: resilience.ckpt_inflight tracks the async write
+    window; resilience.loss_scale refreshes at sync points."""
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.parallel import ResilientTrainer, ShardedTrainer
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(4, in_units=4))
+    net.initialize()
+    tr = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1})
+    rt = ResilientTrainer(tr, checkpoint_dir=str(tmp_path),
+                          auto_resume=False, dynamic_loss_scale=True,
+                          init_loss_scale=1024.0)
+    assert registry().gauge("resilience.loss_scale").value == 1024.0
+    rng = np.random.RandomState(0)
+    x = rng.randn(8, 4).astype(np.float32)
+    y = rng.randint(0, 2, (8,))
+    rt.step(x, y)
+    rt.checkpoint()             # async enqueue: write now in flight
+    g = registry().gauge("resilience.ckpt_inflight")
+    assert g.value == 1.0
+    rt.flush()                  # committed: window closed
+    assert g.value == 0.0
+    _ = rt.counters             # drains skip flags -> refreshes scale
+    assert registry().gauge("resilience.loss_scale").value == \
+        rt.loss_scale
+
+
+def test_loader_prefetch_depth_gauge():
+    from mxnet_tpu.gluon.data import DataLoader
+    from mxnet_tpu.gluon.data.dataset import ArrayDataset
+    data = np.arange(64, dtype=np.float32).reshape(32, 2)
+    label = np.arange(32, dtype=np.float32)
+    loader = DataLoader(ArrayDataset(mx.nd.array(data),
+                                     mx.nd.array(label)),
+                        batch_size=4, num_workers=2, prefetch=4)
+    for _ in loader:
+        pass
+    g = registry().get("loader.prefetch_depth")
+    assert g is not None and g.kind == "gauge"
+    assert 0.0 <= g.value <= 4.0        # sampled inside queue bounds
+    assert g.help                       # ships a description
+
+
+# -- lint gate: no new ad-hoc timing pairs ----------------------------------
+
+# Pre-existing time.time()/perf_counter() start/stop pairs, grandfathered.
+# Do NOT add to this list: new wall-time measurements go through
+# observability.trace.span (one histogram + the unified chrome-trace
+# timeline for free).  observability/ and profiler.py ARE the metrics
+# layer — the clocks have to live somewhere.
+_TIMING_PAIR_ALLOWED = (
+    os.path.join("mxnet_tpu", "observability") + os.sep,
+    os.path.join("mxnet_tpu", "profiler.py"),
+    os.path.join("mxnet_tpu", "ndarray", "register.py"),   # feeds
+    # engine.flush_us on the per-segment hot path (span would add a
+    # registry lookup per flush)
+    os.path.join("mxnet_tpu", "gluon", "contrib", "estimator.py"),
+    os.path.join("mxnet_tpu", "module", "base_module.py"),
+    os.path.join("mxnet_tpu", "callback.py"),              # Speedometer
+)
+
+
+def _is_clock_call(node) -> bool:
+    """A call to time.time / time.perf_counter (incl. aliased imports
+    like ``from time import perf_counter as _perf_counter``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in ("time", "perf_counter") and \
+            isinstance(fn.value, ast.Name) and fn.value.id == "time"
+    if isinstance(fn, ast.Name):
+        return "perf_counter" in fn.id
+    return False
+
+
+def _target_key(node):
+    """A comparable key for `t0 = ...` / `self._t0 = ...` targets."""
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if isinstance(node, ast.Attribute):
+        return ("a", node.attr)
+    return None
+
+
+def test_no_adhoc_timing_pairs_in_package():
+    """New wall-clock start/stop measurement outside the observability
+    layer must go through ``trace.span`` — it lands in a histogram, the
+    snapshot, the exporters, AND the unified chrome-trace timeline.
+    Gate: a ``t0 = time.time()/perf_counter()`` assignment whose name is
+    later subtracted from another clock call, anywhere under mxnet_tpu/
+    except the allowlist above (which must only ever shrink)."""
+    offenders = []
+    for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
+        for fn in files:
+            if not fn.endswith(".py"):
+                continue
+            path = os.path.join(root, fn)
+            rel = os.path.relpath(path, REPO)
+            if any(rel.startswith(a) for a in _TIMING_PAIR_ALLOWED):
+                continue
+            with open(path, "r", encoding="utf-8") as f:
+                tree = ast.parse(f.read(), filename=path)
+            started = {}          # target key -> lineno of t0 = clock()
+            for node in ast.walk(tree):
+                if isinstance(node, ast.Assign) and \
+                        _is_clock_call(node.value):
+                    for t in node.targets:
+                        key = _target_key(t)
+                        if key is not None:
+                            started[key] = node.lineno
+            if not started:
+                continue
+            for node in ast.walk(tree):
+                if isinstance(node, ast.BinOp) and \
+                        isinstance(node.op, ast.Sub) and \
+                        _is_clock_call(node.left):
+                    key = _target_key(node.right)
+                    if key is not None and key in started:
+                        offenders.append(
+                            f"{rel}:{started[key]}+{node.lineno}")
+    assert not offenders, \
+        f"ad-hoc timing pairs (use observability.trace.span instead — " \
+        f"histogram + unified timeline for free): {offenders}"
 
 
 # -- overhead guard (non-tier-1: -m slow only) ------------------------------
