@@ -15,17 +15,16 @@ from __future__ import annotations
 
 __version__ = "0.1.0"
 
-import os as _os
+from .base import MXNetError, register_env, get_env, list_env
 
 # numerics-parity escape hatch: TPU matmuls default to bf16-precision
 # accumulation (the MXU fast path); set MXNET_MATMUL_PRECISION=highest to
-# force full fp32 (reference-exact numerics, ~3x slower matmuls)
-_prec = _os.environ.get("MXNET_MATMUL_PRECISION")
+# force full fp32 (reference-exact numerics, ~3x slower matmuls).
+# Resolved through the knob table BEFORE the first jax import below.
+_prec = get_env("MXNET_MATMUL_PRECISION")
 if _prec:
     import jax as _jax
     _jax.config.update("jax_default_matmul_precision", _prec)
-
-from .base import MXNetError, register_env, get_env, list_env
 from . import faults
 from .context import Context, cpu, gpu, tpu, cpu_pinned, num_gpus, num_tpus, \
     current_context
@@ -79,8 +78,7 @@ from . import observability
 # MXTPU_METRICS_PORT is set, a periodic JSONL snapshot writer when
 # MXTPU_METRICS_JSONL is set; no cost (export never even imports)
 # otherwise
-if _os.environ.get("MXTPU_METRICS_PORT") \
-        or _os.environ.get("MXTPU_METRICS_JSONL"):
+if get_env("MXTPU_METRICS_PORT") or get_env("MXTPU_METRICS_JSONL"):
     observability.export.maybe_start_from_env()
 
 

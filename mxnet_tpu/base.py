@@ -177,6 +177,44 @@ register_env("MXNET_SAFE_ACCUMULATION", True, bool,
              "Accumulate fp16/bf16 reductions in fp32.")
 register_env("MXNET_DEFAULT_DTYPE", "float32", str,
              "Default dtype for new arrays (float32; set bfloat16 for TPU-native).")
+register_env("MXNET_MATMUL_PRECISION", "", str,
+             "jax matmul precision override; 'highest' forces full fp32 "
+             "accumulation (reference-exact numerics, ~3x slower matmuls).")
+register_env("MXNET_OPTIMIZER_AGGREGATION_SIZE", 4, int,
+             "Max weights updated per fused multi-tensor optimizer call.")
+register_env("MXNET_TEST_DEFAULT_CTX", "", str,
+             "Context the test harness runs in, e.g. 'tpu(0)' "
+             "(the import-and-rerun TPU suite sets it).")
+register_env("MXNET_PALLAS_INTERPRET", False, bool,
+             "Run Pallas kernels in interpret mode (CPU-testable kernels).")
+register_env("MXNET_ATTENTION_KERNEL", "auto", str,
+             "Attention path: 'auto' (flash when eligible), 'flash' "
+             "(force the Pallas kernel), or 'xla' (full-softmax XLA path).")
+register_env("MXNET_USE_FLASH_ATTENTION", "", str,
+             "Legacy tri-state attention override: '1' forces flash, "
+             "'0' forces XLA, unset defers to MXNET_ATTENTION_KERNEL.")
+register_env("MXTPU_DIST_TIMEOUT", 300.0, float,
+             "Per-attempt timeout (seconds) for joining the process group "
+             "and for the coordination-service KV/barrier collectives.")
+register_env("MXTPU_FAULT_PLAN", "", str,
+             "Deterministic fault-injection schedule, e.g. "
+             "'step_error@3;nan@5;ckpt_fail@2;loader_stall@4:1.5'.")
+register_env("MXTPU_METRICS_PORT", "", str,
+             "Serve the Prometheus /metrics endpoint on this port "
+             "(unset = no HTTP server).")
+register_env("MXTPU_METRICS_JSONL", "", str,
+             "Append periodic registry snapshots to this JSONL path "
+             "(unset = no writer).")
+register_env("MXTPU_METRICS_INTERVAL", 60.0, float,
+             "Seconds between JSONL metric snapshots.")
+register_env("MXTPU_METRICS_AGGREGATE", False, bool,
+             "Serve the fleet (all-hosts) view from /metrics, every "
+             "series host-labeled; refreshed at checkpoint boundaries.")
+register_env("MXTPU_FLIGHT_STEPS", 256, int,
+             "Crash flight-recorder ring capacity in steps (0 disables).")
+register_env("MXTPU_FLIGHT_PATH", "", str,
+             "Crash flight-recorder dump file "
+             "(default <tmpdir>/mxtpu_flight_<pid>.json).")
 
 
 # ---------------------------------------------------------------------------
@@ -231,7 +269,7 @@ def dtype_name(dtype: Any) -> str:
 
 
 def default_dtype() -> str:
-    return os.environ.get("MXNET_DEFAULT_DTYPE", "float32")
+    return get_env("MXNET_DEFAULT_DTYPE")
 
 
 def resolve_reshape_spec(in_dims, spec, reverse=False):

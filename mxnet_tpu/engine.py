@@ -95,8 +95,7 @@ class Engine:
     _lock = threading.Lock()
 
     def __init__(self):
-        self._type = os.environ.get("MXNET_ENGINE_TYPE",
-                                    "ThreadedEnginePerDevice")
+        self._type = get_env("MXNET_ENGINE_TYPE")
         # profiler hooks: fn(op_name, outputs, dispatch_us)
         self._listeners = []
         # bulk_enabled memo: (raw env string, parsed bool) — the invoke

@@ -31,14 +31,13 @@ dataloader worker building batch 4 for 1.5 s.
 """
 from __future__ import annotations
 
-import os
 import random as _pyrandom
 import re
 import threading
 import time
 from typing import Callable, List, NamedTuple, Optional, Tuple, Type
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 
 __all__ = ["TransientFault", "DeadlineExceeded", "retry_call", "Deadline",
            "call_with_deadline", "FaultSpec", "FaultPlan", "active_plan",
@@ -210,7 +209,7 @@ class FaultPlan:
 
     @classmethod
     def from_env(cls) -> "FaultPlan":
-        return cls(os.environ.get(FAULT_PLAN_ENV, ""))
+        return cls(get_env(FAULT_PLAN_ENV))
 
     @property
     def empty(self) -> bool:
@@ -259,7 +258,7 @@ def active_plan() -> Optional[FaultPlan]:
     with _active_lock:
         if not _active_loaded:
             _active_loaded = True
-            spec = os.environ.get(FAULT_PLAN_ENV, "").strip()
+            spec = get_env(FAULT_PLAN_ENV).strip()
             if spec:
                 _active = FaultPlan(spec)
         return _active

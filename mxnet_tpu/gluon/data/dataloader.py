@@ -102,8 +102,11 @@ class DataLoader:
     def __len__(self):
         return len(self._batch_sampler)
 
-    def _make_batch(self, indices):
-        with _span("loader.batch_build_us"):
+    def _make_batch(self, indices, batch_idx=None):
+        # the batch id rides to the chrome-trace timeline as event args
+        with _span("loader.batch_build_us",
+                   args=None if batch_idx is None
+                   else {"batch": batch_idx}):
             samples = [self._dataset[i] for i in indices]
             batch = self._batchify_fn(samples)
         self._c_batches.inc()         # lock-exact: workers race this
@@ -127,7 +130,7 @@ class DataLoader:
                 attempts[0] += 1
                 if plan is not None:
                     plan.fire("loader_error", batch_idx + 1)
-                return self._make_batch(indices)
+                return self._make_batch(indices, batch_idx)
 
             def on_retry(attempt_no, exc, delay):
                 self._c_retries.inc()
@@ -147,8 +150,8 @@ class DataLoader:
 
     def __iter__(self):
         if self._num_workers == 0:
-            for indices in self._batch_sampler:
-                yield self._make_batch(indices)
+            for bi, indices in enumerate(self._batch_sampler):
+                yield self._make_batch(indices, bi)
             return
         # threaded prefetch pipeline with a bounded in-flight window so a
         # slow consumer never materializes more than window batches

@@ -156,9 +156,9 @@ class MultiHeadAttention(HybridBlock):
         # formulation), so training may ride it too — EXCEPT when this
         # block has attention dropout and dropout is live (train_mode/
         # record), since the flash path has no probs tensor to drop.
-        import os
-        mode = os.environ.get("MXNET_ATTENTION_KERNEL", "auto").lower()
-        legacy = os.environ.get("MXNET_USE_FLASH_ATTENTION")
+        from ...base import get_env
+        mode = get_env("MXNET_ATTENTION_KERNEL").lower()
+        legacy = get_env("MXNET_USE_FLASH_ATTENTION")
         if legacy == "1":
             mode = "flash"              # legacy force-on
         elif legacy == "0":

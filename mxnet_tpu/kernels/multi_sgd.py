@@ -21,7 +21,6 @@ carries a second state buffer through the same grid.
 from __future__ import annotations
 
 import functools
-import os
 from typing import Sequence, Tuple
 
 # one grid step processes this many elements: a full fp32 VREG tile
@@ -84,7 +83,8 @@ def _build_call(n_chunks: int, clip: float, dtype_name: str,
                 momentum: float | None, interpret: bool):
     # env resolved OUTSIDE the cache so a test's monkeypatched
     # MXNET_PALLAS_INTERPRET takes effect regardless of call order
-    if interpret and os.environ.get("MXNET_PALLAS_INTERPRET", "0") != "1":
+    from ..base import get_env
+    if interpret and not get_env("MXNET_PALLAS_INTERPRET"):
         return _jnp_dual(clip, dtype_name, momentum)
     return _build_pallas(n_chunks, clip, dtype_name, momentum, interpret)
 

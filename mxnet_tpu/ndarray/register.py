@@ -579,7 +579,9 @@ class _BulkSegment:
         self.flushed = False
         self.error = None
 
-    def add_ext(self, val, parent) -> int:
+    def add_ext_locked(self, val, parent) -> int:
+        # callers (_try_defer's argument-collection loop) hold self._lock
+        # — the ``_locked`` suffix is the lint-checked convention
         key = (id(val), id(parent))
         idx = self._ext_ids.get(key)
         if idx is None:
@@ -606,7 +608,9 @@ class _BulkSegment:
         # is the auto-tune signal for MXNET_ENGINE_BULK_SIZE — two
         # perf_counter() calls per SEGMENT (not per op) is noise next to
         # the dispatch they bracket
-        _t0 = _perf_counter()
+        _t0 = _perf_counter()   # mxlint: disable=timing-pair — feeds
+        # engine.flush_us on the per-segment hot path (a span would add
+        # a registry lookup per flush)
         taped = self.tapenode is not None
         # liveness: outputs whose NDArray died (or was overwritten by an
         # in-place write) before the flush need no buffer at all
@@ -782,7 +786,7 @@ def _try_defer(op: Operator, nd_inputs: Sequence, kwargs: Dict[str, Any],
                     if sh is not None and type(sh) is not sds \
                             and len(sh.device_set) > 1:
                         return _NOT_FUSABLE  # multi-chip global arrays
-                    refs.append((_EXT, seg.add_ext(
+                    refs.append((_EXT, seg.add_ext_locked(
                         v, x._ag if rec else None)))
                     # jax arrays already expose tuple shapes + np dtypes
                     in_avals.append((v.shape, v.dtype))

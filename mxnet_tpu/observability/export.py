@@ -28,7 +28,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        group_host_entries, last_host_states, registry,
                        state_cumulative_buckets)
@@ -106,8 +106,7 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
 
 def aggregate_mode() -> bool:
     """Live read of the ``MXTPU_METRICS_AGGREGATE`` opt-in."""
-    return os.environ.get(METRICS_AGGREGATE_ENV, "").strip().lower() \
-        in ("1", "true", "yes", "on")
+    return bool(get_env(METRICS_AGGREGATE_ENV))
 
 
 def prometheus_text_aggregate(
@@ -289,8 +288,8 @@ def maybe_start_from_env() -> None:
     training job it observes."""
     global _env_server, _env_writer
     with _env_lock:
-        port = os.environ.get(METRICS_PORT_ENV, "").strip()
-        jsonl = os.environ.get(METRICS_JSONL_ENV, "").strip()
+        port = get_env(METRICS_PORT_ENV).strip()
+        jsonl = get_env(METRICS_JSONL_ENV).strip()
         if port or jsonl:
             # materialize the engine singleton so its metric families
             # exist from the first scrape/write, not from the first op
@@ -306,8 +305,7 @@ def maybe_start_from_env() -> None:
                     f"started ({e})", RuntimeWarning, stacklevel=2)
         if jsonl and _env_writer is None:
             try:
-                interval = float(
-                    os.environ.get(METRICS_INTERVAL_ENV, "60"))
+                interval = float(get_env(METRICS_INTERVAL_ENV))
                 _env_writer = JsonlWriter(jsonl, interval=interval)
                 _env_writer.start()
             except (OSError, ValueError) as e:

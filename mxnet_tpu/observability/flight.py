@@ -41,6 +41,7 @@ import threading
 import time
 from typing import Deque, List, Optional
 
+from ..base import get_env
 from .registry import host_id, registry
 
 __all__ = ["FlightRecorder", "recorder", "FLIGHT_STEPS_ENV",
@@ -48,17 +49,11 @@ __all__ = ["FlightRecorder", "recorder", "FLIGHT_STEPS_ENV",
 
 FLIGHT_STEPS_ENV = "MXTPU_FLIGHT_STEPS"
 FLIGHT_PATH_ENV = "MXTPU_FLIGHT_PATH"
-_DEFAULT_CAPACITY = 256
 
 
 def _env_capacity() -> int:
-    raw = os.environ.get(FLIGHT_STEPS_ENV, "").strip()
-    if not raw:
-        return _DEFAULT_CAPACITY
-    try:
-        return max(0, int(raw))
-    except ValueError:
-        return _DEFAULT_CAPACITY
+    # the registered default (256) covers unset AND unparsable values
+    return max(0, int(get_env(FLIGHT_STEPS_ENV)))
 
 
 def _materialize(v):
@@ -119,7 +114,7 @@ class FlightRecorder:
             return path
         if self.path:
             return self.path
-        env = os.environ.get(FLIGHT_PATH_ENV, "").strip()
+        env = get_env(FLIGHT_PATH_ENV).strip()
         if env:
             return env
         return os.path.join(tempfile.gettempdir(),

@@ -39,18 +39,21 @@ __all__ = ["span", "current", "stack", "add_span_listener",
 
 _tls = threading.local()
 
-# span sinks: fn(name, t_end_seconds, duration_us) called on every span
-# exit.  The profiler installs one so spans land on its chrome-trace
-# timeline as PROPER duration events (pid=host, tid=thread) next to op
-# events — unlike the engine-listener echo below, installing a span
-# listener does NOT suspend bulked dispatch (spans wrap steps/flushes,
-# not ops, so they need no per-op outputs).
+# span sinks: fn(name, t_end_seconds, duration_us, args) called on
+# every span exit (``args`` is the span's metadata dict or None).  The
+# profiler installs one so spans land on its chrome-trace timeline as
+# PROPER duration events (pid=host, tid=thread, chrome-trace ``args``
+# carrying step/batch ids) next to op events — unlike the
+# engine-listener echo below, installing a span listener does NOT
+# suspend bulked dispatch (spans wrap steps/flushes, not ops, so they
+# need no per-op outputs).
 _span_listeners: List = []
 
 
 def add_span_listener(fn) -> None:
-    """Install a span sink: ``fn(name, t_end, duration_us)`` with
-    ``t_end`` in ``time.perf_counter()`` seconds."""
+    """Install a span sink: ``fn(name, t_end, duration_us, args)`` with
+    ``t_end`` in ``time.perf_counter()`` seconds and ``args`` the
+    span's metadata dict (or None)."""
     if fn not in _span_listeners:
         _span_listeners.append(fn)
 
@@ -85,13 +88,21 @@ class span:
     ``histogram=False`` keeps the nesting/bookkeeping (and the profiler
     event) without creating a registry metric — for ad-hoc scoping.
     The measured duration is available afterwards as ``.duration_us``.
+
+    ``args`` is an optional metadata dict (step number, batch id, ...):
+    it never touches the histogram (labels would explode cardinality)
+    but rides to span listeners, so the profiler surfaces it as the
+    chrome-trace event's ``args`` — hover a step span in the timeline
+    and see WHICH step it was.  Cost: one attribute store when unused.
     """
 
-    __slots__ = ("name", "duration_us", "_t0", "_record")
+    __slots__ = ("name", "duration_us", "args", "_t0", "_record")
 
-    def __init__(self, name: str, histogram: bool = True):
+    def __init__(self, name: str, histogram: bool = True,
+                 args: Optional[dict] = None):
         self.name = name
         self.duration_us = 0.0
+        self.args = args
         self._record = histogram
         # create (or fetch) the histogram at construction, not exit —
         # name errors surface where the span is written, and __exit__
@@ -114,8 +125,9 @@ class span:
             registry().get(self.name).observe(self.duration_us)
         for fn in _span_listeners:
             # the profiler's timeline sink: proper duration events with
-            # real start/end timestamps on the host/thread lanes
-            fn(self.name, t_end, self.duration_us)
+            # real start/end timestamps on the host/thread lanes (and
+            # the span's args as chrome-trace event args)
+            fn(self.name, t_end, self.duration_us, self.args)
         eng = engine()
         if eng._listeners:
             # monitors tapping raw engine dispatches still see the span

@@ -181,9 +181,9 @@ class SGD(Optimizer):
         super().__init__(**kwargs)
         self.momentum = momentum
         self.lazy_update = lazy_update
-        import os
-        self.aggregate_num = int(os.environ.get(
-            "MXNET_OPTIMIZER_AGGREGATION_SIZE", 4))
+        from .base import get_env
+        self.aggregate_num = int(get_env(
+            "MXNET_OPTIMIZER_AGGREGATION_SIZE"))
 
     def create_state(self, index, weight):
         if self.momentum == 0.0:

@@ -23,7 +23,7 @@ import os
 import threading
 from typing import Optional
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env
 
 __all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
            "allreduce_host", "allgather_host", "allgather_bytes",
@@ -92,7 +92,7 @@ def init_process_group(coordinator: Optional[str] = None,
             "mxnet_tpu.parallel.dist.init_process_group(coordinator, "
             "num_processes, process_id) before kv.create('dist_sync')")
     if timeout is None:
-        timeout = float(os.environ.get("MXTPU_DIST_TIMEOUT", "300"))
+        timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
     import jax
     from ..faults import retry_call
 
@@ -151,7 +151,7 @@ def _gather_arrays_kv(arr, timeout: Optional[float] = None):
     import io
     import numpy as np
     if timeout is None:
-        timeout = float(os.environ.get("MXTPU_DIST_TIMEOUT", "300"))
+        timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
     buf = io.BytesIO()
     np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
     blobs = _allgather_bytes_kv(buf.getvalue(), timeout)
@@ -267,7 +267,7 @@ def allgather_bytes(data: bytes, timeout: Optional[float] = None):
     if not is_initialized():
         return [data]
     if timeout is None:
-        timeout = float(os.environ.get("MXTPU_DIST_TIMEOUT", "300"))
+        timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
     try:
         return _allgather_bytes_device(data)
     except Exception:   # noqa: BLE001 — backend-dependent capability
@@ -308,6 +308,6 @@ def barrier(name: str = "mxnet_tpu_barrier") -> None:
             gen = _barrier_gen
             _barrier_gen += 1
         timeout_ms = max(1000, int(float(
-            os.environ.get("MXTPU_DIST_TIMEOUT", "300")) * 1000))
+            get_env("MXTPU_DIST_TIMEOUT")) * 1000))
         distributed.global_state.client.wait_at_barrier(
             f"mxtpu_barrier_{name}_{gen}", timeout_ms)

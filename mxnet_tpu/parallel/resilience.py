@@ -425,7 +425,11 @@ class ResilientTrainer:
             self._metrics.inc("steps_retried")
 
         try:
-            with _span("resilience.step_us") as sp:
+            # step/update ids ride to the chrome-trace timeline as event
+            # args (the histogram never sees them — no label explosion)
+            with _span("resilience.step_us",
+                       args={"step": i,
+                             "t": self._trainer.num_update}) as sp:
                 loss = retry_call(one_attempt, retries=self._max_retries,
                                   base_delay=self._retry_base,
                                   max_delay=self._retry_max,
@@ -502,7 +506,7 @@ class ResilientTrainer:
             raise TransientFault(
                 f"injected checkpoint write failure "
                 f"(save #{self._save_index}, step {t})")
-        with _span("resilience.checkpoint_us"):
+        with _span("resilience.checkpoint_us", args={"step": t}):
             # spans the ASYNC save enqueue (+ optional commit wait), not
             # the background write — host-side stall is what this costs
             # the training loop

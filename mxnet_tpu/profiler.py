@@ -68,8 +68,9 @@ class Profiler:
                 self._pid = 0   # not break profiling
         return self._pid
 
-    def _lane(self) -> int:
-        """Small stable per-thread tid (call under self._lock)."""
+    def _lane_locked(self) -> int:
+        """Small stable per-thread tid; callers hold self._lock (the
+        ``_locked`` suffix is the lint-checked convention for that)."""
         ident = threading.get_ident()
         lane = self._tids.get(ident)
         if lane is None:
@@ -99,27 +100,32 @@ class Profiler:
         with self._lock:
             self._events.append({
                 "name": op_name, "ph": "X", "pid": pid,
-                "tid": self._lane(), "ts": now - dur, "dur": dur,
+                "tid": self._lane_locked(), "ts": now - dur, "dur": dur,
                 "cat": "operator"})
             self._agg.setdefault(op_name, []).append(dur)
 
     # -- span listener (trace.span -> unified timeline) --------------------
-    def _on_span(self, name: str, t_end: float, dur_us: float) -> None:
+    def _on_span(self, name: str, t_end: float, dur_us: float,
+                 args: Optional[dict] = None) -> None:
         """``trace.span`` exits land here as PROPER duration events:
         supervisor steps, engine flushes, and loader batches appear on
         the same timeline as per-op events, with pid = host index and
         tid = thread lane (nested spans render stacked, chrome-trace
-        semantics)."""
+        semantics).  Span ``args`` (step number, batch id, ...) become
+        the chrome-trace event's ``args``, so the timeline answers
+        "which step was this?" on hover."""
         if not self._running or self._paused:
             return
         ts_end = (t_end - self._t0) * 1e6              # µs
         dur = max(dur_us, 0.1)
         pid = self._host_pid()
+        ev = {"name": name, "ph": "X", "pid": pid, "ts": ts_end - dur,
+              "dur": dur, "cat": "span"}
+        if args:
+            ev["args"] = dict(args)
         with self._lock:
-            self._events.append({
-                "name": name, "ph": "X", "pid": pid,
-                "tid": self._lane(), "ts": ts_end - dur, "dur": dur,
-                "cat": "span"})
+            ev["tid"] = self._lane_locked()
+            self._events.append(ev)
             self._agg.setdefault(f"span:{name}", []).append(dur)
 
     def start(self) -> None:

@@ -14,7 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence, Union
 
 import numpy as np
 
-from .base import MXNetError
+from .base import MXNetError, get_env
 from .context import Context, cpu, current_context
 from .ndarray import NDArray, array as nd_array
 
@@ -49,7 +49,7 @@ def default_context() -> Context:
     MXNET_TEST_DEFAULT_CTX → the import-and-rerun TPU suite sets tpu(0)."""
     if _default_ctx is not None:
         return _default_ctx
-    name = os.environ.get("MXNET_TEST_DEFAULT_CTX", "")
+    name = get_env("MXNET_TEST_DEFAULT_CTX")
     if name:
         from . import context as ctx_mod
         dev, _, idx = name.partition("(")

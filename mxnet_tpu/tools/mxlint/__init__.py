@@ -1,0 +1,367 @@
+"""mxlint — the repo's own static-analysis subsystem.
+
+One single-pass, pluggable analysis framework replacing the AST walkers
+that used to be copy-pasted across three test files: one ``ast.parse``
+per file, every rule visiting the same tree (:mod:`.core`), per-line
+``# mxlint: disable=<rule>`` pragmas for intentional exceptions, and ONE
+frozen JSON baseline (``baseline.json``) for grandfathered debt —
+replacing the per-test grandfather lists.
+
+Rules (:mod:`.rules`) encode the codebase's actual contracts:
+
+========================  ===================================================
+``bare-except``           no bare ``except:`` under mxnet_tpu/
+``unbounded-lru-method``  no ``lru_cache(maxsize=None)`` on methods
+``counter-dict``          metrics go through ``observability.registry()``
+``timing-pair``           wall-clock pairs go through ``trace.span``
+``lock-discipline``       lock-guarded state is written under its lock
+``collective-safety``     no collectives under host-divergent branches
+``env-knob``              ``MXNET_*``/``MXTPU_*`` reads go through the
+                          declared knob table (``base.register_env``)
+========================  ===================================================
+
+CLI::
+
+    python -m mxnet_tpu.tools.mxlint [--json] [--changed] [paths...]
+
+exits nonzero on any NEW finding (not pragma-suppressed, not in the
+baseline).  ``--changed`` lints only git-touched files (quick local
+runs); ``--write-baseline`` refreezes the baseline (deliberate act —
+the lint test guards the baseline against silent growth);
+``--knobs-md`` prints the generated env-knob reference table the README
+embeds.
+
+Pytest entry point: ``tests/test_lint.py`` calls :func:`check_repo`,
+which memoizes ONE full-repo run per process — the thin per-rule
+assertions in other test modules (:func:`rule_findings`) reuse it, so
+the whole suite pays a single parse pass where it used to pay four.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FileContext, Finding, is_suppressed, pragma_map, \
+    run_rules
+from .rules import ALL_RULES, BASE_RELPATH, declared_knobs, make_rules
+
+__all__ = ["Finding", "lint_paths", "lint_source", "check_repo",
+           "rule_findings", "load_baseline", "knob_table_markdown",
+           "main", "ALL_RULES", "REPO_ROOT", "DEFAULT_TARGET",
+           "BASELINE_PATH"]
+
+_PKG_DIR = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(_PKG_DIR)))
+DEFAULT_TARGET = os.path.join(REPO_ROOT, "mxnet_tpu")
+BASELINE_PATH = os.path.join(_PKG_DIR, "baseline.json")
+
+_SKIP_DIRS = {"__pycache__", ".git", "node_modules"}
+
+
+def _relpath(path: str) -> str:
+    rel = os.path.relpath(os.path.abspath(path), REPO_ROOT)
+    return rel.replace(os.sep, "/")
+
+
+def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
+    for p in paths:
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                yield p
+        elif os.path.isdir(p):
+            for root, dirs, files in os.walk(p):
+                dirs[:] = sorted(d for d in dirs if d not in _SKIP_DIRS)
+                for fn in sorted(files):
+                    if fn.endswith(".py"):
+                        yield os.path.join(root, fn)
+
+
+def lint_source(source: str, relpath: str = "mxnet_tpu/<snippet>.py",
+                rules=None) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one source string → (new_findings, suppressed_findings).
+    The fixture/test entry point; ``relpath`` participates in rule
+    ``skip_paths`` policy, so pass something realistic."""
+    rules = [r for r in (rules if rules is not None
+                         else make_rules(REPO_ROOT))
+             if r.applies_to(relpath)]
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return ([Finding("parse-error", relpath, e.lineno or 0,
+                         f"syntax error: {e.msg}")], [])
+    ctx = FileContext(relpath, tree, source)
+    findings = run_rules(ctx, rules)
+    pragmas = pragma_map(source)
+    lines = source.splitlines()
+    new, suppressed = [], []
+    for f in findings:
+        (suppressed if is_suppressed(f, pragmas, lines) else new).append(f)
+    return new, suppressed
+
+
+def lint_paths(paths: Optional[Sequence[str]] = None
+               ) -> Tuple[List[Finding], List[Finding]]:
+    """Lint files/directories → (findings, suppressed), pragma-filtered
+    but NOT baseline-filtered (the caller splits new vs. grandfathered
+    so ``--json`` can show both)."""
+    paths = list(paths) if paths else [DEFAULT_TARGET]
+    all_new: List[Finding] = []
+    all_sup: List[Finding] = []
+    rules = make_rules(REPO_ROOT)
+    for path in iter_py_files(paths):
+        rel = _relpath(path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError as e:
+            all_new.append(Finding("parse-error", rel, 0,
+                                   f"unreadable: {e}"))
+            continue
+        new, sup = lint_source(source, relpath=rel, rules=rules)
+        all_new.extend(new)
+        all_sup.extend(sup)
+    return all_new, all_sup
+
+
+# -- baseline ---------------------------------------------------------------
+
+def load_baseline(path: Optional[str] = None) -> Set[Tuple[str, str]]:
+    """Frozen grandfather entries as ``{(rule, relpath)}`` — file-level,
+    so line drift in a grandfathered file never breaks the build while
+    the SAME debt in a new file always does."""
+    path = path or BASELINE_PATH
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return set()
+    return {(e["rule"], e["path"]) for e in data.get("entries", ())}
+
+
+def write_baseline(findings: Sequence[Finding],
+                   path: Optional[str] = None) -> int:
+    path = path or BASELINE_PATH
+    entries = sorted({(f.rule, f.path) for f in findings})
+    payload = {
+        "comment": "mxlint grandfathered debt — file-level (rule, path) "
+                   "entries.  FROZEN: tests/test_lint.py guards this "
+                   "list; shrink it by fixing debt, never grow it for "
+                   "new code (use the rule or a justified pragma).",
+        "entries": [{"rule": r, "path": p} for r, p in entries],
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return len(entries)
+
+
+def split_baselined(findings: Sequence[Finding],
+                    baseline: Set[Tuple[str, str]]
+                    ) -> Tuple[List[Finding], List[Finding]]:
+    new, old = [], []
+    for f in findings:
+        (old if (f.rule, f.path) in baseline else new).append(f)
+    return new, old
+
+
+# -- cached whole-repo run (the pytest entry point) -------------------------
+
+_cached_run: Optional[Tuple[List[Finding], List[Finding]]] = None
+
+
+def check_repo(refresh: bool = False
+               ) -> Tuple[List[Finding], List[Finding]]:
+    """ONE memoized lint of ``mxnet_tpu/`` per process → (new_findings,
+    baselined_findings).  Every thin test assertion shares this run."""
+    global _cached_run
+    if _cached_run is None or refresh:
+        findings, _sup = lint_paths([DEFAULT_TARGET])
+        _cached_run = split_baselined(findings, load_baseline())
+    return _cached_run
+
+
+def rule_findings(rule: str) -> List[Finding]:
+    """NEW findings of one rule from the cached repo run — the thin
+    assertion the old per-test AST walkers collapse into:
+    ``assert mxlint.rule_findings("bare-except") == []``."""
+    new, _old = check_repo()
+    return [f for f in new if f.rule == rule]
+
+
+# -- env-knob reference (README generation) ---------------------------------
+
+def knob_rows(repo_root: Optional[str] = None) -> List[dict]:
+    """Statically extract every ``register_env(name, default, typ,
+    help)`` row from the knob table in ``mxnet_tpu/base.py`` — no
+    package import, so doc generation costs no jax startup."""
+    root = repo_root or REPO_ROOT
+    path = os.path.join(root, *BASE_RELPATH.split("/"))
+    with open(path, "r", encoding="utf-8") as f:
+        tree = ast.parse(f.read(), filename=path)
+    rows = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "register_env" and node.args
+                and isinstance(node.args[0], ast.Constant)):
+            continue
+        args = list(node.args) + [kw.value for kw in node.keywords]
+        name = node.args[0].value
+        try:
+            default = ast.literal_eval(args[1]) if len(args) > 1 else None
+        except ValueError:
+            default = ast.unparse(args[1])
+        typ = args[2].id if len(args) > 2 and \
+            isinstance(args[2], ast.Name) else "str"
+        help_text = ""
+        for a in args[3:]:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                help_text = a.value
+        for kw in node.keywords:
+            if kw.arg == "help" and isinstance(kw.value, ast.Constant):
+                help_text = kw.value.value
+        rows.append({"name": name, "default": default, "type": typ,
+                     "help": " ".join(help_text.split())})
+    rows.sort(key=lambda r: r["name"])
+    return rows
+
+
+def knob_table_markdown(repo_root: Optional[str] = None) -> str:
+    """The generated env-knob reference the README embeds between
+    ``<!-- mxlint-knobs:begin -->`` / ``:end`` markers (test-enforced in
+    sync)."""
+    lines = ["| Variable | Type | Default | Description |",
+             "|---|---|---|---|"]
+    for r in knob_rows(repo_root):
+        default = "_unset_" if r["default"] is None else \
+            f"`{r['default']!r}`" if isinstance(r["default"], str) \
+            else f"`{r['default']}`"
+        lines.append(f"| `{r['name']}` | {r['type']} | {default} | "
+                     f"{r['help']} |")
+    return "\n".join(lines) + "\n"
+
+
+# -- CLI --------------------------------------------------------------------
+
+def _changed_files() -> List[str]:
+    """git-touched .py files (diff vs HEAD + untracked) for --changed."""
+    out: List[str] = []
+    for cmd in (["git", "-C", REPO_ROOT, "diff", "--name-only", "HEAD"],
+                ["git", "-C", REPO_ROOT, "ls-files", "--others",
+                 "--exclude-standard"]):
+        try:
+            res = subprocess.run(cmd, capture_output=True, text=True,
+                                 timeout=30, check=True)
+        except (OSError, subprocess.SubprocessError):
+            return []
+        out.extend(line.strip() for line in res.stdout.splitlines()
+                   if line.strip().endswith(".py"))
+    seen, files = set(), []
+    for rel in out:
+        full = os.path.join(REPO_ROOT, rel)
+        if rel not in seen and os.path.isfile(full):
+            seen.add(rel)
+            files.append(full)
+    return files
+
+
+_USAGE = """\
+usage: python -m mxnet_tpu.tools.mxlint [options] [paths...]
+
+Lint mxnet_tpu/ (default) or the given files/directories.
+
+options:
+  --json            machine-readable output (findings + baselined)
+  --changed         lint only git-touched .py files (quick local runs)
+  --baseline PATH   use a different baseline file
+  --write-baseline  refreeze the baseline from the current findings
+  --knobs-md        print the generated env-knob reference table
+  --list-rules      print rule names and one-line descriptions
+  -h, --help        this message
+"""
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    as_json = changed = write_bl = False
+    baseline_path = None
+    paths: List[str] = []
+    i = 0
+    while i < len(argv):
+        a = argv[i]
+        if a in ("-h", "--help"):
+            print(_USAGE, end="")
+            return 0
+        if a == "--json":
+            as_json = True
+        elif a == "--changed":
+            changed = True
+        elif a == "--write-baseline":
+            write_bl = True
+        elif a == "--baseline":
+            i += 1
+            if i >= len(argv):
+                print("--baseline needs a path", file=sys.stderr)
+                return 2
+            baseline_path = argv[i]
+        elif a == "--knobs-md":
+            print(knob_table_markdown(), end="")
+            return 0
+        elif a == "--list-rules":
+            for r in make_rules(REPO_ROOT):
+                print(f"{r.name:<22} {r.description}")
+            return 0
+        elif a.startswith("-"):
+            print(f"unknown option {a!r}\n{_USAGE}", file=sys.stderr,
+                  end="")
+            return 2
+        else:
+            paths.append(a)
+        i += 1
+
+    if write_bl:
+        # a baseline frozen from a partial scope would silently drop
+        # the grandfather entries for everything outside it — always
+        # refreeze from the full default target
+        if paths or changed:
+            print("mxlint: --write-baseline always freezes from the "
+                  "full default target; ignoring the path/--changed "
+                  "scope", file=sys.stderr)
+        findings, _suppressed = lint_paths(None)
+        n = write_baseline(findings, baseline_path)
+        print(f"mxlint: froze {n} baseline entr"
+              f"{'y' if n == 1 else 'ies'} -> "
+              f"{baseline_path or BASELINE_PATH}")
+        return 0
+    if changed:
+        paths = _changed_files()
+        if not paths:
+            if not as_json:
+                print("mxlint: no changed .py files")
+            return 0
+    findings, suppressed = lint_paths(paths or None)
+    baseline = load_baseline(baseline_path)
+    new, old = split_baselined(findings, baseline)
+
+    if as_json:
+        print(json.dumps({
+            "new": [f.as_dict() for f in new],
+            "baselined": [f.as_dict() for f in old],
+            "suppressed": [f.as_dict() for f in suppressed],
+        }, indent=1))
+    else:
+        for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
+            print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+        tail = []
+        if old:
+            tail.append(f"{len(old)} baselined")
+        if suppressed:
+            tail.append(f"{len(suppressed)} pragma-suppressed")
+        extra = f" ({', '.join(tail)})" if tail else ""
+        print(f"mxlint: {len(new)} new finding"
+              f"{'' if len(new) == 1 else 's'}{extra}")
+    return 1 if new else 0

@@ -1,0 +1,7 @@
+"""``python -m mxnet_tpu.tools.mxlint`` — see the package docstring."""
+import sys
+
+from . import main
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,239 @@
+"""mxlint core: one parse, one walk, many rules.
+
+The framework contract (what makes this cheaper AND stronger than the
+four copy-pasted AST walkers it replaces):
+
+- **One ``ast.parse`` per file, one tree walk per file.**  Every rule
+  subscribes to the node types it cares about (``interests``); the
+  walker dispatches each node to each subscribed rule as it descends.
+  Adding a rule costs a dict lookup per node, not another pass.
+- **Shared lexical context.**  The walker maintains the stacks the
+  interesting rules all need — enclosing classes, enclosing functions,
+  held locks (``with self._lock:`` blocks), and enclosing ``if`` tests —
+  so rules stay small and cannot disagree about scoping.
+- **Per-line pragmas.**  ``# mxlint: disable=<rule>[,<rule>]`` on the
+  finding's line (or on a standalone comment line directly above it)
+  suppresses that rule there; ``disable=all`` suppresses everything.
+  Pragmas are for *intentional* exceptions and should carry a
+  justification comment; grandfathered debt goes in the baseline
+  instead (see ``mxlint.baseline``).
+
+Rules live in :mod:`.rules`; the runner, baseline handling, and CLI in
+the package ``__init__``.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+__all__ = ["Finding", "Rule", "FileContext", "run_rules", "pragma_map",
+           "is_suppressed", "FUNC_TYPES"]
+
+FUNC_TYPES = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+_PRAGMA_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\- ]+)")
+
+
+class Finding:
+    """One rule violation at one source line."""
+
+    __slots__ = ("rule", "path", "line", "message")
+
+    def __init__(self, rule: str, path: str, line: int, message: str):
+        self.rule = rule
+        self.path = path          # repo-relative, forward slashes
+        self.line = line
+        self.message = message
+
+    def as_dict(self) -> dict:
+        return {"rule": self.rule, "path": self.path, "line": self.line,
+                "message": self.message}
+
+    def __repr__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Finding) and \
+            (self.rule, self.path, self.line, self.message) == \
+            (other.rule, other.path, other.line, other.message)
+
+    def __hash__(self) -> int:
+        return hash((self.rule, self.path, self.line, self.message))
+
+
+class FileContext:
+    """Per-file walk state shared by every rule.
+
+    ``lock_stack`` holds one token per lock-ish context manager currently
+    entered (``with self._lock:`` → ``("self", "_lock")``, ``with
+    _env_lock:`` → ``("mod", "_env_lock")``); ``holds_lock()`` is the
+    guard predicate the concurrency rules use.  ``if_stack`` holds the
+    test expression of every enclosing ``if``/ternary branch (both arms
+    — divergence is divergence).
+    """
+
+    def __init__(self, relpath: str, tree: ast.AST, source: str):
+        self.relpath = relpath
+        self.tree = tree
+        self.source = source
+        self.class_stack: List[ast.ClassDef] = []
+        self.func_stack: List[ast.AST] = []
+        self.lock_stack: List[Tuple[str, str]] = []
+        self.if_stack: List[ast.expr] = []
+        self.findings: List[Finding] = []
+
+    # -- rule-facing helpers -------------------------------------------------
+    def report(self, rule: "Rule", line: int, message: str) -> None:
+        self.findings.append(Finding(rule.name, self.relpath, line, message))
+
+    def current_class(self) -> Optional[ast.ClassDef]:
+        return self.class_stack[-1] if self.class_stack else None
+
+    def current_func(self) -> Optional[ast.AST]:
+        return self.func_stack[-1] if self.func_stack else None
+
+    def holds_lock(self) -> bool:
+        return bool(self.lock_stack)
+
+    def at_body_level(self) -> bool:
+        """True at module or class body level (not inside a function)."""
+        return not self.func_stack
+
+
+class Rule:
+    """Base class for one lint rule.
+
+    ``interests`` is the tuple of node types ``visit`` wants;
+    ``skip_paths`` are repo-relative prefixes where the rule does not
+    apply *by policy* (e.g. the metrics layer may own raw clocks) — as
+    opposed to the baseline, which records grandfathered *debt*.
+    """
+
+    name = ""
+    description = ""
+    interests: Tuple = ()
+    skip_paths: Tuple[str, ...] = ()
+
+    def applies_to(self, relpath: str) -> bool:
+        return not any(relpath.startswith(p) for p in self.skip_paths)
+
+    def begin_file(self, ctx: FileContext) -> None:   # noqa: B027
+        pass
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:  # noqa: B027
+        pass
+
+    def end_file(self, ctx: FileContext) -> None:     # noqa: B027
+        pass
+
+
+def _lock_token(expr: ast.expr) -> Optional[Tuple[str, str]]:
+    """Lock token for a with-item context expression, or None.
+
+    Anything named lock-ish counts: ``self._lock`` / ``cls._lock`` →
+    scoped to the instance/class; a bare ``_some_lock`` name or a
+    foreign attribute (``Engine._lock``) → ``("mod", name)``.
+    """
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        base = expr.value
+        if isinstance(base, ast.Name) and base.id in ("self", "cls"):
+            return (base.id, expr.attr)
+        return ("mod", expr.attr)
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return ("mod", expr.id)
+    return None
+
+
+def run_rules(ctx: FileContext, rules: Sequence[Rule]) -> List[Finding]:
+    """Walk ``ctx.tree`` ONCE, dispatching nodes to every rule; returns
+    the raw findings (pragma/baseline filtering is the runner's job)."""
+    handlers: Dict[type, List[Rule]] = {}
+    for r in rules:
+        for t in r.interests:
+            handlers.setdefault(t, []).append(r)
+    for r in rules:
+        r.begin_file(ctx)
+    _visit(ctx, ctx.tree, handlers)
+    for r in rules:
+        r.end_file(ctx)
+    return ctx.findings
+
+
+def _visit(ctx: FileContext, node: ast.AST,
+           handlers: Dict[type, List[Rule]]) -> None:
+    for r in handlers.get(type(node), ()):
+        r.visit(node, ctx)
+    t = type(node)
+    if t is ast.ClassDef:
+        ctx.class_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            _visit(ctx, child, handlers)
+        ctx.class_stack.pop()
+    elif t in FUNC_TYPES:
+        ctx.func_stack.append(node)
+        for child in ast.iter_child_nodes(node):
+            _visit(ctx, child, handlers)
+        ctx.func_stack.pop()
+    elif t in (ast.With, ast.AsyncWith):
+        tokens = []
+        for item in node.items:
+            _visit(ctx, item.context_expr, handlers)
+            if item.optional_vars is not None:
+                _visit(ctx, item.optional_vars, handlers)
+            tok = _lock_token(item.context_expr)
+            if tok is not None:
+                tokens.append(tok)
+        ctx.lock_stack.extend(tokens)
+        for stmt in node.body:
+            _visit(ctx, stmt, handlers)
+        if tokens:
+            del ctx.lock_stack[-len(tokens):]
+    elif t is ast.If:
+        _visit(ctx, node.test, handlers)
+        ctx.if_stack.append(node.test)
+        for stmt in node.body:
+            _visit(ctx, stmt, handlers)
+        for stmt in node.orelse:
+            _visit(ctx, stmt, handlers)
+        ctx.if_stack.pop()
+    elif t is ast.IfExp:
+        _visit(ctx, node.test, handlers)
+        ctx.if_stack.append(node.test)
+        _visit(ctx, node.body, handlers)
+        _visit(ctx, node.orelse, handlers)
+        ctx.if_stack.pop()
+    else:
+        for child in ast.iter_child_nodes(node):
+            _visit(ctx, child, handlers)
+
+
+# -- pragmas ----------------------------------------------------------------
+
+def pragma_map(source: str) -> Dict[int, Set[str]]:
+    """line number (1-based) → set of rule names disabled on that line."""
+    out: Dict[int, Set[str]] = {}
+    for i, line in enumerate(source.splitlines(), 1):
+        m = _PRAGMA_RE.search(line)
+        if m:
+            names = {p.strip() for p in m.group(1).split(",") if p.strip()}
+            if names:
+                out[i] = names
+    return out
+
+
+def is_suppressed(finding: Finding, pragmas: Dict[int, Set[str]],
+                  lines: Sequence[str]) -> bool:
+    """Same-line pragma always counts; a pragma on the line directly
+    above counts only when that line is a standalone comment (so a
+    pragma for line N's statement can't leak onto line N+1's)."""
+    names = pragmas.get(finding.line)
+    if names and ("all" in names or finding.rule in names):
+        return True
+    prev = finding.line - 1
+    names = pragmas.get(prev)
+    if names and 1 <= prev <= len(lines) and \
+            lines[prev - 1].lstrip().startswith("#") and \
+            ("all" in names or finding.rule in names):
+        return True
+    return False

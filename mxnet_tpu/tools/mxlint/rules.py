@@ -1,0 +1,637 @@
+"""The repo's lint rules: four ported gates + three concurrency/config
+contracts.
+
+Every rule encodes an invariant this codebase actually relies on — see
+each rule's docstring for the failure mode it prevents.  All rules run
+in the ONE walk :func:`mxlint.core.run_rules` makes per file.
+"""
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, List, Optional, Set, Tuple
+
+from .core import FUNC_TYPES, FileContext, Rule
+
+__all__ = ["ALL_RULES", "make_rules", "declared_knobs", "BASE_RELPATH"]
+
+BASE_RELPATH = "mxnet_tpu/base.py"
+
+
+def _call_name(node: ast.expr) -> Optional[str]:
+    """Trailing identifier of a call target: ``f(...)`` → ``f``,
+    ``m.f(...)`` → ``f``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+# -- ported gate 1: bare except ---------------------------------------------
+
+class BareExceptRule(Rule):
+    """``except:`` swallows SystemExit/KeyboardInterrupt and hides real
+    faults — exactly what a resilience layer must never do.  Catch
+    ``Exception`` (or narrower) and say why."""
+
+    name = "bare-except"
+    description = "no bare 'except:' clauses"
+    interests = (ast.ExceptHandler,)
+
+    def visit(self, node, ctx):
+        if node.type is None:
+            ctx.report(self, node.lineno,
+                       "bare 'except:' swallows SystemExit/"
+                       "KeyboardInterrupt and hides real faults; catch "
+                       "Exception (or narrower)")
+
+
+# -- ported gate 2: unbounded lru_cache on methods --------------------------
+
+def _is_unbounded_lru(deco: ast.expr) -> bool:
+    """``@lru_cache(maxsize=None)`` (bare ``@lru_cache`` or an int
+    maxsize is bounded: fine)."""
+    if not isinstance(deco, ast.Call):
+        return False
+    if _call_name(deco.func) != "lru_cache":
+        return False
+    return any(kw.arg == "maxsize" and isinstance(kw.value, ast.Constant)
+               and kw.value.value is None for kw in deco.keywords)
+
+
+class UnboundedLruRule(Rule):
+    """``lru_cache(maxsize=None)`` on a METHOD keys every entry on
+    ``self``: it pins each instance (and everything its entries close
+    over — compiled XLA executables, in the Operator case this gate was
+    written for) for the life of the process.  Module-level functions on
+    immortal singletons are exempt; per-instance caches must be bounded
+    (see ndarray.register._BoundedCache)."""
+
+    name = "unbounded-lru-method"
+    description = "no lru_cache(maxsize=None) on methods"
+    interests = (ast.ClassDef,)
+
+    def visit(self, node, ctx):
+        # direct body items of ANY class — including classes defined
+        # inside functions (factory-built classes leak the same way)
+        for item in node.body:
+            if not isinstance(item, FUNC_TYPES):
+                continue
+            for deco in item.decorator_list:
+                if _is_unbounded_lru(deco):
+                    ctx.report(
+                        self, item.lineno,
+                        f"unbounded lru_cache on method "
+                        f"{node.name}.{item.name} pins instances (and "
+                        f"their compiled executables) forever; use a "
+                        f"bounded per-instance cache")
+
+
+# -- ported gate 3: ad-hoc counter dicts ------------------------------------
+
+_COUNTERISH_NAME = re.compile(r"(counters?|stats|metrics)$")
+
+
+def _is_int_const(node) -> bool:
+    return isinstance(node, ast.Constant) and type(node.value) is int
+
+
+def _is_counter_dict_value(node) -> bool:
+    """A NON-EMPTY dict literal with string keys and int-constant values
+    (``{"steps_skipped": 0, ...}`` — the ad-hoc counter-surface shape PR 1
+    and PR 2 each grew), or ``defaultdict(int)`` /
+    ``collections.Counter()``.  Empty dicts stay legal: name-dedup
+    counters (gluon.block, symbol) are keyed maps, not metric surfaces."""
+    if isinstance(node, ast.Dict):
+        return bool(node.values) and \
+            all(isinstance(k, ast.Constant) and type(k.value) is str
+                for k in node.keys) and \
+            all(_is_int_const(v) for v in node.values)
+    if isinstance(node, ast.Call):
+        name = _call_name(node.func)
+        if name == "defaultdict" and node.args and \
+                isinstance(node.args[0], ast.Name) and \
+                node.args[0].id == "int":
+            return True
+        if name == "Counter" and not node.args and not node.keywords:
+            return True
+    return False
+
+
+class CounterDictRule(Rule):
+    """Metrics go through ``observability.registry()`` — a third ad-hoc
+    counter surface (module-level ``X_counters = {...: 0}`` dicts, the
+    shape PR 1 and PR 2 each grew) must not come back.  Gate:
+    module-level (or class-body-level) assignments of int-valued dict
+    literals / ``defaultdict(int)`` to counter-ish names."""
+
+    name = "counter-dict"
+    description = "no ad-hoc module/class-level counter dicts"
+    interests = (ast.Assign, ast.AnnAssign)
+    # the registry IS the one sanctioned counter surface
+    skip_paths = ("mxnet_tpu/observability/registry.py",)
+
+    def visit(self, node, ctx):
+        if ctx.func_stack:
+            return                    # function-local dicts are fine
+        if isinstance(node, ast.Assign):
+            targets, value = node.targets, node.value
+        elif node.value is not None:  # AnnAssign
+            targets, value = [node.target], node.value
+        else:
+            return
+        names = [t.id.lower() for t in targets if isinstance(t, ast.Name)]
+        if not any(_COUNTERISH_NAME.search(n) for n in names):
+            return
+        if _is_counter_dict_value(value):
+            ctx.report(self, node.lineno,
+                       "ad-hoc counter dict: use observability."
+                       "registry() instead of growing another "
+                       "disconnected metrics surface")
+
+
+# -- ported gate 4: ad-hoc timing pairs -------------------------------------
+
+def _is_clock_call(node) -> bool:
+    """``time.time()`` / ``time.perf_counter()`` (incl. aliased imports
+    like ``from time import perf_counter as _perf_counter``)."""
+    if not isinstance(node, ast.Call):
+        return False
+    fn = node.func
+    if isinstance(fn, ast.Attribute):
+        return fn.attr in ("time", "perf_counter") and \
+            isinstance(fn.value, ast.Name) and fn.value.id == "time"
+    if isinstance(fn, ast.Name):
+        return "perf_counter" in fn.id
+    return False
+
+
+def _target_key(node):
+    """Comparable key for ``t0 = ...`` / ``self._t0 = ...`` targets."""
+    if isinstance(node, ast.Name):
+        return ("n", node.id)
+    if isinstance(node, ast.Attribute):
+        return ("a", node.attr)
+    return None
+
+
+class TimingPairRule(Rule):
+    """New wall-clock start/stop measurement outside the observability
+    layer must go through ``trace.span`` — it lands in a histogram, the
+    snapshot, the exporters, AND the unified chrome-trace timeline.
+    Gate: a ``t0 = time.time()/perf_counter()`` assignment whose target
+    is later subtracted from another clock call.  Findings anchor at the
+    assignment line (one pragma there covers every paired stop)."""
+
+    name = "timing-pair"
+    description = "no ad-hoc clock pairs outside the metrics layer"
+    interests = (ast.Assign, ast.BinOp)
+    # observability/ and profiler.py ARE the metrics layer — the clocks
+    # have to live somewhere
+    skip_paths = ("mxnet_tpu/observability/", "mxnet_tpu/profiler.py")
+
+    def begin_file(self, ctx):
+        self._started: Dict[tuple, int] = {}
+        self._stops: List[Tuple[tuple, int]] = []
+
+    def visit(self, node, ctx):
+        if isinstance(node, ast.Assign):
+            if _is_clock_call(node.value):
+                for t in node.targets:
+                    key = _target_key(t)
+                    if key is not None:
+                        self._started.setdefault(key, node.lineno)
+            return
+        # BinOp: clock() - t0
+        if isinstance(node.op, ast.Sub) and _is_clock_call(node.left):
+            key = _target_key(node.right)
+            if key is not None:
+                self._stops.append((key, node.lineno))
+
+    def end_file(self, ctx):
+        reported: Set[int] = set()
+        for key, stop_line in self._stops:
+            line = self._started.get(key)
+            if line is not None and line not in reported:
+                reported.add(line)
+                ctx.report(self, line,
+                           f"ad-hoc timing pair (stopped at line "
+                           f"{stop_line}): use observability.trace.span "
+                           f"— histogram + unified timeline for free")
+
+
+# -- new rule 1: lock discipline --------------------------------------------
+
+_LOCK_FACTORIES = ("Lock", "RLock")
+# method calls that mutate their receiver — counted as writes
+_MUTATORS = frozenset((
+    "append", "extend", "insert", "pop", "popitem", "remove", "clear",
+    "add", "discard", "update", "setdefault", "appendleft", "popleft",
+    "sort", "reverse"))
+_INIT_METHODS = ("__init__", "__new__")
+
+
+def _is_lock_factory(node) -> bool:
+    return isinstance(node, ast.Call) and \
+        _call_name(node.func) in _LOCK_FACTORIES and not node.args
+
+
+def _self_attr(node) -> Optional[str]:
+    """``self.X`` / ``cls.X`` (or a subscript of one) → ``X``."""
+    if isinstance(node, ast.Subscript):
+        node = node.value
+    if isinstance(node, ast.Attribute) and \
+            isinstance(node.value, ast.Name) and \
+            node.value.id in ("self", "cls"):
+        return node.attr
+    return None
+
+
+class LockDisciplineRule(Rule):
+    """Static race detector for the codebase's lock convention.
+
+    For any class holding a ``threading.Lock``/``RLock`` attribute (or a
+    module holding one at top level): an attribute/global that is
+    accessed under ``with <the lock>:`` in one place and *written*
+    outside it in another is a race waiting for a free-threaded build —
+    or an initialization-order bug today.  Writes include mutating
+    method calls (``.append``/``.pop``/...) and subscript stores.
+
+    Not flagged (by design, to stay useful):
+
+    - writes in ``__init__``/``__new__`` (no concurrency before the
+      object escapes) and module top-level assignments (import lock);
+    - attributes never touched under the lock (plain unshared state);
+    - writes inside methods whose name ends in ``_locked`` — the
+      documented callers-hold-the-lock convention.
+
+    Intentionally unlocked writes get ``# mxlint: disable=lock-discipline``
+    with a justification, not a baseline entry.
+    """
+
+    name = "lock-discipline"
+    description = "attributes guarded by a lock must be written under it"
+    interests = (ast.Assign, ast.AugAssign, ast.AnnAssign, ast.Call,
+                 ast.Attribute, ast.Name, ast.Global)
+
+    def begin_file(self, ctx):
+        # per-class: id(ClassDef) -> state
+        self._classes: Dict[int, dict] = {}
+        # module scope: locks, top-level global names, lock-guarded
+        # evidence, and candidate write events (filtered at end_file
+        # once the full top-level name set is known)
+        self._mod_locks: Set[str] = set()
+        self._mod_globals: Set[str] = set()
+        self._mod_evidence: Set[str] = set()
+        self._mod_writes: List[Tuple[str, int, bool]] = []
+        self._fn_globals: Dict[int, Set[str]] = {}
+
+    # -- helpers -----------------------------------------------------------
+    def _cls(self, ctx) -> Optional[dict]:
+        node = ctx.current_class()
+        if node is None:
+            return None
+        st = self._classes.get(id(node))
+        if st is None:
+            st = self._classes[id(node)] = {
+                "node": node, "locks": set(), "evidence": set(),
+                "writes": []}
+        return st
+
+    def _guarded(self, ctx) -> bool:
+        if ctx.holds_lock():
+            return True
+        fn = ctx.current_func()
+        return fn is not None and fn.name.endswith("_locked")
+
+    def _in_init(self, ctx) -> bool:
+        fn = ctx.current_func()
+        return fn is not None and fn.name in _INIT_METHODS
+
+    def _declared_global(self, ctx, name: str) -> bool:
+        fn = ctx.current_func()
+        return fn is not None and \
+            name in self._fn_globals.get(id(fn), ())
+
+    def _class_write(self, ctx, attr: str, line: int) -> None:
+        st = self._cls(ctx)
+        if st is None:
+            return
+        guarded = self._guarded(ctx)
+        if guarded:
+            st["evidence"].add(attr)
+        st["writes"].append((attr, line, guarded, self._in_init(ctx)))
+
+    def _module_write(self, ctx, name: str, line: int) -> None:
+        guarded = self._guarded(ctx)
+        if guarded:
+            self._mod_evidence.add(name)
+        self._mod_writes.append((name, line, guarded))
+
+    # -- walk --------------------------------------------------------------
+    def visit(self, node, ctx):
+        t = type(node)
+        if t is ast.Global:
+            fn = ctx.current_func()
+            if fn is not None:
+                self._fn_globals.setdefault(id(fn), set()).update(
+                    node.names)
+            return
+        if t in (ast.Assign, ast.AugAssign, ast.AnnAssign):
+            if t is ast.AnnAssign and node.value is None:
+                return                # bare annotation: not a store
+            targets = node.targets if t is ast.Assign else [node.target]
+            value = node.value
+            for tgt in targets:
+                attr = _self_attr(tgt)
+                if attr is not None and ctx.class_stack:
+                    if _is_lock_factory(value) and \
+                            not isinstance(tgt, ast.Subscript):
+                        st = self._cls(ctx)
+                        st["locks"].add(attr)
+                    elif ctx.func_stack:
+                        self._class_write(ctx, attr, tgt.lineno)
+                    continue
+                if isinstance(tgt, ast.Name):
+                    if ctx.at_body_level() and ctx.class_stack and \
+                            _is_lock_factory(value):
+                        self._cls(ctx)["locks"].add(tgt.id)
+                    elif not ctx.class_stack and ctx.at_body_level():
+                        # module top level
+                        if _is_lock_factory(value):
+                            self._mod_locks.add(tgt.id)
+                        else:
+                            self._mod_globals.add(tgt.id)
+                    elif ctx.func_stack and not ctx.class_stack and \
+                            self._declared_global(ctx, tgt.id):
+                        self._module_write(ctx, tgt.id, tgt.lineno)
+                elif isinstance(tgt, ast.Subscript) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        ctx.func_stack and not ctx.class_stack:
+                    # X[...] = v on a module global needs no `global`
+                    self._module_write(ctx, tgt.value.id, tgt.lineno)
+            return
+        if t is ast.Call:
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr in _MUTATORS:
+                attr = _self_attr(fn.value)
+                if attr is not None and ctx.class_stack and \
+                        ctx.func_stack:
+                    self._class_write(ctx, attr, node.lineno)
+                elif isinstance(fn.value, ast.Name) and ctx.func_stack \
+                        and not ctx.class_stack:
+                    self._module_write(ctx, fn.value.id, node.lineno)
+            return
+        # loads under a held lock are evidence the lock guards that name
+        if not self._guarded(ctx):
+            return
+        if t is ast.Attribute:
+            attr = _self_attr(node)
+            if attr is not None and ctx.class_stack:
+                st = self._cls(ctx)
+                st["evidence"].add(attr)
+        elif t is ast.Name and isinstance(node.ctx, ast.Load) and \
+                not ctx.class_stack and ctx.func_stack:
+            self._mod_evidence.add(node.id)
+
+    def end_file(self, ctx):
+        for st in self._classes.values():
+            if not st["locks"]:
+                continue
+            lock = sorted(st["locks"])[0]
+            cls = st["node"].name
+            seen: Set[Tuple[str, int]] = set()
+            for attr, line, guarded, in_init in st["writes"]:
+                if guarded or in_init or attr in st["locks"]:
+                    continue
+                if attr not in st["evidence"]:
+                    continue          # never lock-guarded: not its state
+                if (attr, line) in seen:
+                    continue
+                seen.add((attr, line))
+                ctx.report(
+                    self, line,
+                    f"'{cls}.{attr}' is written here without holding "
+                    f"'{lock}', but is accessed under it elsewhere in "
+                    f"the class — take the lock, rename the method "
+                    f"'*_locked' if callers hold it, or pragma with a "
+                    f"justification")
+        if self._mod_locks:
+            lock = sorted(self._mod_locks)[0]
+            seen = set()
+            for name, line, guarded in self._mod_writes:
+                if guarded or name in self._mod_locks or \
+                        name not in self._mod_globals:
+                    continue
+                if name not in self._mod_evidence:
+                    continue
+                if (name, line) in seen:
+                    continue
+                seen.add((name, line))
+                ctx.report(
+                    self, line,
+                    f"module global '{name}' is written here without "
+                    f"holding '{lock}', but is accessed under it "
+                    f"elsewhere in this module — take the lock or "
+                    f"pragma with a justification")
+
+
+# -- new rule 2: collective safety ------------------------------------------
+
+_COLLECTIVES = frozenset((
+    "allgather_bytes", "allgather_host", "allreduce_host",
+    "broadcast_host", "barrier"))
+# identifiers whose value DIVERGES across hosts: a collective lexically
+# under a branch conditioned on one of these can deadlock the fleet
+_HOST_TOKENS = frozenset((
+    "process_index", "process_id", "host_id", "rank", "worker_id",
+    "local_rank", "host"))
+
+
+def _host_conditioned(test: ast.expr) -> Optional[str]:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in _HOST_TOKENS:
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in _HOST_TOKENS:
+            return n.attr
+    return None
+
+
+class CollectiveSafetyRule(Rule):
+    """Collectives must be reached by EVERY host or by none: a call to
+    ``allgather_*``/``allreduce_host``/``broadcast_host``/``barrier``
+    lexically nested under an ``if`` conditioned on the process index
+    (``rank``, ``process_index``, ``host_id``, ...) means some hosts
+    enter the collective and the rest never will — the whole fleet then
+    blocks until the DCN timeout.  This is the exact bug class the PR 4
+    checkpoint-boundary metric gather was designed around.  Hoist the
+    collective above the branch, or branch on fleet-uniform state only
+    (``is_initialized()``, ``num_workers``)."""
+
+    name = "collective-safety"
+    description = "no collectives under host-divergent branches"
+    interests = (ast.Call,)
+
+    def visit(self, node, ctx):
+        name = _call_name(node.func)
+        if name not in _COLLECTIVES:
+            return
+        for test in ctx.if_stack:
+            tok = _host_conditioned(test)
+            if tok is not None:
+                ctx.report(
+                    self, node.lineno,
+                    f"collective '{name}()' under a branch conditioned "
+                    f"on host-divergent '{tok}': hosts taking the other "
+                    f"arm never reach it and the fleet deadlocks — "
+                    f"hoist it out of the branch")
+                return
+
+
+# -- new rule 3: env-knob registry ------------------------------------------
+
+_KNOB_PREFIXES = ("MXNET_", "MXTPU_")
+
+_declared_cache: Optional[Set[str]] = None
+
+
+def declared_knobs(repo_root: str, refresh: bool = False) -> Set[str]:
+    """The knob table: every name registered via ``register_env(...)``
+    in ``mxnet_tpu/base.py``, extracted statically (no package import —
+    linting must not pay a jax import)."""
+    global _declared_cache
+    if _declared_cache is not None and not refresh:
+        return _declared_cache
+    names: Set[str] = set()
+    path = os.path.join(repo_root, *BASE_RELPATH.split("/"))
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return names                  # no table: nothing is declared
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and \
+                _call_name(node.func) == "register_env" and node.args and \
+                isinstance(node.args[0], ast.Constant) and \
+                isinstance(node.args[0].value, str):
+            names.add(node.args[0].value)
+    _declared_cache = names
+    return names
+
+
+class EnvKnobRule(Rule):
+    """Every ``MXNET_*``/``MXTPU_*`` environment read goes through the
+    declared knob table (``register_env``/``get_env`` in
+    ``mxnet_tpu/base.py`` — name, typed default, description), from
+    which the README knob reference is generated.  A raw
+    ``os.environ.get("MXNET_X", ...)`` silently forks the default from
+    the documented one; an undeclared name read via ``get_env`` is a
+    knob the docs don't know exists.  Module-level ``X_ENV = "MXTPU_Y"``
+    name constants are resolved."""
+
+    name = "env-knob"
+    description = "MXNET_*/MXTPU_* reads go through base.get_env"
+    interests = (ast.Assign, ast.Call, ast.Subscript)
+    skip_paths = (BASE_RELPATH,)      # the table itself reads os.environ
+
+    def __init__(self, repo_root: str):
+        self._repo_root = repo_root
+
+    def begin_file(self, ctx):
+        self._consts: Dict[str, str] = {}
+        # (kind, key_expr, lineno): resolved at end_file so constants
+        # defined later in the module still resolve
+        self._events: List[Tuple[str, ast.expr, int]] = []
+
+    def _knob_name(self, expr: ast.expr) -> Optional[str]:
+        if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+            v = expr.value
+        elif isinstance(expr, ast.Name):
+            v = self._consts.get(expr.id)
+        else:
+            return None
+        if v is not None and v.startswith(_KNOB_PREFIXES):
+            return v
+        return None
+
+    def visit(self, node, ctx):
+        t = type(node)
+        if t is ast.Assign:
+            if ctx.at_body_level() and not ctx.class_stack and \
+                    isinstance(node.value, ast.Constant) and \
+                    isinstance(node.value.value, str):
+                for tgt in node.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._consts[tgt.id] = node.value.value
+            return
+        if t is ast.Subscript:
+            base = node.value
+            if isinstance(node.ctx, ast.Load) and (
+                    (isinstance(base, ast.Attribute)
+                     and base.attr == "environ")
+                    or (isinstance(base, ast.Name)
+                        and base.id == "environ")):
+                self._events.append(("read", node.slice, node.lineno))
+            return
+        # Call
+        fn = node.func
+        name = _call_name(fn)
+        if name == "get" and isinstance(fn, ast.Attribute) and (
+                (isinstance(fn.value, ast.Attribute)
+                 and fn.value.attr == "environ")
+                or (isinstance(fn.value, ast.Name)
+                    and fn.value.id == "environ")):
+            if node.args:
+                self._events.append(("read", node.args[0], node.lineno))
+        elif name == "getenv" and node.args:
+            self._events.append(("read", node.args[0], node.lineno))
+        elif name == "get_env" and node.args:
+            self._events.append(("declared", node.args[0], node.lineno))
+        elif name == "register_env":
+            self._events.append(("register", fn, node.lineno))
+        elif name == "_raw_env":
+            for a in node.args:
+                self._events.append(("declared", a, node.lineno))
+
+    def end_file(self, ctx):
+        declared = declared_knobs(self._repo_root)
+        for kind, expr, line in self._events:
+            if kind == "register":
+                ctx.report(self, line,
+                           f"register_env() outside {BASE_RELPATH}: "
+                           f"knobs are declared in ONE table so the "
+                           f"README reference can be generated from it")
+                continue
+            knob = self._knob_name(expr)
+            if knob is None:
+                continue
+            if kind == "read":
+                ctx.report(self, line,
+                           f"direct environ read of '{knob}': route it "
+                           f"through mxnet_tpu.base.get_env so the "
+                           f"declared default/type applies (register_env"
+                           f" in {BASE_RELPATH})")
+            elif kind == "declared" and knob not in declared:
+                ctx.report(self, line,
+                           f"env knob '{knob}' is not declared: add "
+                           f"register_env('{knob}', <default>, <type>, "
+                           f"<help>) in {BASE_RELPATH}")
+
+
+def make_rules(repo_root: str) -> List[Rule]:
+    """Fresh rule instances for one lint run (rules carry per-file
+    scratch state, so runs must not share them across threads)."""
+    return [
+        BareExceptRule(),
+        UnboundedLruRule(),
+        CounterDictRule(),
+        TimingPairRule(),
+        LockDisciplineRule(),
+        CollectiveSafetyRule(),
+        EnvKnobRule(repo_root),
+    ]
+
+
+ALL_RULES = tuple(r.name for r in make_rules("."))

@@ -1,0 +1,8 @@
+"""mxlint fixture: must trip bare-except (and nothing else)."""
+
+
+def swallow_everything():
+    try:
+        return 1 / 0
+    except:
+        return None

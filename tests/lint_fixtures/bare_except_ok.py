@@ -1,0 +1,8 @@
+"""mxlint fixture: narrow except clauses lint clean."""
+
+
+def swallow_narrowly():
+    try:
+        return 1 / 0
+    except ZeroDivisionError:
+        return None

@@ -1,0 +1,7 @@
+"""mxlint fixture: must trip collective-safety (and nothing else)."""
+
+
+def gather_from_coordinator(dist, rank):
+    if rank == 0:
+        return dist.allgather_host([1])   # peers never reach this
+    return None

@@ -1,0 +1,9 @@
+"""mxlint fixture: collectives under fleet-UNIFORM conditions lint
+clean (every host takes the same branch)."""
+
+
+def gather_everywhere(dist):
+    if dist.is_initialized():
+        dist.barrier()
+        return dist.allgather_host([1])
+    return None

@@ -1,0 +1,3 @@
+"""mxlint fixture: must trip counter-dict (and nothing else)."""
+
+engine_counters = {"segments_flushed": 0, "ops_dispatched": 0}

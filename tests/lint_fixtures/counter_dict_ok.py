@@ -1,0 +1,8 @@
+"""mxlint fixture: keyed maps and function-local dicts lint clean."""
+
+_name_counters = {}               # name-dedup map, not a metric surface
+
+
+def local_stats():
+    stats = {"hits": 0}           # function-local: fine
+    return stats

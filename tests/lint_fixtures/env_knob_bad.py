@@ -1,0 +1,10 @@
+"""mxlint fixture: must trip env-knob (and nothing else)."""
+import os
+
+PLAN_ENV = "MXTPU_FIXTURE_ONLY_PLAN"
+
+
+def read_raw_knobs():
+    a = os.environ.get("MXNET_FIXTURE_ONLY_KNOB", "0")
+    b = os.environ.get(PLAN_ENV)          # resolved via the constant
+    return a, b

@@ -1,0 +1,11 @@
+"""mxlint fixture: declared-knob reads via get_env (and non-knob env
+vars) lint clean."""
+import os
+
+from mxnet_tpu.base import get_env
+
+
+def read_declared_knobs():
+    bulk = int(get_env("MXNET_ENGINE_BULK_SIZE"))
+    home = os.environ.get("HOME", "")     # not an MXNET_*/MXTPU_* knob
+    return bulk, home

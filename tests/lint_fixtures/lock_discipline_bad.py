@@ -1,0 +1,15 @@
+"""mxlint fixture: must trip lock-discipline (and nothing else)."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+
+    def add(self, item):
+        with self._lock:
+            self._items.append(item)
+
+    def clear_unsafely(self):
+        self._items = []          # racing add(): written outside the lock

@@ -1,0 +1,20 @@
+"""mxlint fixture: consistent locking (incl. the ``_locked``-suffix
+callers-hold-the-lock convention) lints clean."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._items = []
+        self.label = ""           # never lock-guarded: plain state
+
+    def add(self, item):
+        with self._lock:
+            self._append_locked(item)
+
+    def _append_locked(self, item):
+        self._items.append(item)
+
+    def rename(self, label):
+        self.label = label

@@ -1,0 +1,8 @@
+"""mxlint fixture: must trip unbounded-lru-method (and nothing else)."""
+import functools
+
+
+class Compiler:
+    @functools.lru_cache(maxsize=None)
+    def compile(self, key):
+        return key * 2

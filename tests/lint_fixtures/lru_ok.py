@@ -1,0 +1,18 @@
+"""mxlint fixture: bounded method caches and unbounded MODULE-level
+functions (immortal singletons) lint clean."""
+import functools
+
+
+@functools.lru_cache(maxsize=None)
+def module_level_is_fine(key):
+    return key * 2
+
+
+class Compiler:
+    @functools.lru_cache(maxsize=64)
+    def compile(self, key):
+        return key * 2
+
+    @functools.lru_cache
+    def bare_decorator_is_bounded(self, key):
+        return key * 3

@@ -1,0 +1,8 @@
+"""mxlint fixture: must trip timing-pair (and nothing else)."""
+import time
+
+
+def measure():
+    t0 = time.perf_counter()
+    total = sum(range(64))
+    return total, (time.perf_counter() - t0) * 1e6

@@ -1,0 +1,6 @@
+"""mxlint fixture: a lone timestamp (no start/stop pair) lints clean."""
+import time
+
+
+def stamp():
+    return time.time()

@@ -368,3 +368,14 @@ def test_listeners_suspend_bulking(_bulk_env):
     outs = dict(events)["_plus_scalar"]
     assert len(outs) == 1 and outs[0].shape == (2,)   # REAL outputs
     assert any(n.startswith("_BulkFlush") for n in names)
+
+
+# -- lint gate: no unbounded lru_cache on methods ---------------------------
+# The PR-2 AST walker for this gate (Operator._fn/_vjp caches must stay
+# bounded) lives in the mxlint subsystem now (mxnet_tpu/tools/mxlint —
+# the 'unbounded-lru-method' rule); this thin assertion rides the
+# suite's single cached lint pass.
+
+def test_no_unbounded_lru_cache_on_methods():
+    from mxnet_tpu.tools import mxlint
+    assert mxlint.rule_findings("unbounded-lru-method") == []

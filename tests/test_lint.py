@@ -1,0 +1,373 @@
+"""mxlint: the consolidated static-analysis gate (tier-1) plus tests of
+the framework itself — fixtures per rule, pragma suppression, baseline
+freezing, knob-table/README sync, and the single-parse-pass guarantee.
+
+The whole suite shares ONE memoized repo lint (``mxlint.check_repo``);
+the thin per-rule assertions that replaced the old copy-pasted AST
+walkers in test_resilience / test_engine_bulk / test_observability
+reuse the same run."""
+import ast
+import os
+
+import pytest
+
+from mxnet_tpu.tools import mxlint
+from mxnet_tpu.tools.mxlint import core as mxcore
+from mxnet_tpu.tools.mxlint import rules as mxrules
+
+REPO = mxlint.REPO_ROOT
+FIXTURES = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "lint_fixtures")
+
+RULE_FOR_FIXTURE = {
+    "bare_except": "bare-except",
+    "lru": "unbounded-lru-method",
+    "counter_dict": "counter-dict",
+    "timing_pair": "timing-pair",
+    "lock_discipline": "lock-discipline",
+    "collective_safety": "collective-safety",
+    "env_knob": "env-knob",
+}
+
+
+def _fixture(name: str) -> str:
+    path = os.path.join(FIXTURES, name)
+    with open(path, "r", encoding="utf-8") as f:
+        return f.read()
+
+
+# -- THE gate: the tree is clean against the frozen baseline ----------------
+
+def test_package_tree_is_clean():
+    """Tier-1 acceptance: ``python -m mxnet_tpu.tools.mxlint`` exits 0
+    on this tree — zero new findings across all seven rules."""
+    new, _baselined = mxlint.check_repo()
+    assert new == [], "new mxlint findings:\n" + \
+        "\n".join(repr(f) for f in new)
+
+
+def test_all_seven_rules_registered():
+    assert set(mxlint.ALL_RULES) == set(RULE_FOR_FIXTURE.values())
+
+
+# -- per-rule fixtures: positive must trip, negative must pass --------------
+
+@pytest.mark.parametrize("stem", sorted(RULE_FOR_FIXTURE))
+def test_rule_trips_on_bad_fixture(stem):
+    rule = RULE_FOR_FIXTURE[stem]
+    new, _sup = mxlint.lint_source(
+        _fixture(f"{stem}_bad.py"),
+        relpath=f"tests/lint_fixtures/{stem}_bad.py")
+    assert new, f"{rule} did not trip on its positive fixture"
+    # purity: a fixture exercises exactly its own rule
+    assert {f.rule for f in new} == {rule}, new
+
+
+@pytest.mark.parametrize("stem", sorted(RULE_FOR_FIXTURE))
+def test_rule_passes_on_ok_fixture(stem):
+    new, _sup = mxlint.lint_source(
+        _fixture(f"{stem}_ok.py"),
+        relpath=f"tests/lint_fixtures/{stem}_ok.py")
+    assert new == [], new
+
+
+def test_cli_exits_nonzero_on_each_bad_fixture(capsys):
+    """Acceptance: the CLI exits nonzero on every rule's positive
+    fixture (run in-process — same code path as ``python -m``)."""
+    for stem in RULE_FOR_FIXTURE:
+        rc = mxlint.main([os.path.join(FIXTURES, f"{stem}_bad.py")])
+        assert rc != 0, f"CLI exited 0 on {stem}_bad.py"
+        rc = mxlint.main([os.path.join(FIXTURES, f"{stem}_ok.py")])
+        assert rc == 0, f"CLI exited nonzero on {stem}_ok.py"
+    capsys.readouterr()
+
+
+def test_cli_json_output(capsys):
+    import json as _json
+    rc = mxlint.main(["--json",
+                      os.path.join(FIXTURES, "bare_except_bad.py")])
+    out = capsys.readouterr().out
+    assert rc == 1
+    payload = _json.loads(out)
+    assert payload["new"] and \
+        payload["new"][0]["rule"] == "bare-except"
+    assert "baselined" in payload and "suppressed" in payload
+
+
+# -- pragmas ----------------------------------------------------------------
+
+def test_pragma_suppresses_on_same_line():
+    src = ("def f():\n"
+           "    try:\n"
+           "        return 1\n"
+           "    except:  # mxlint: disable=bare-except — fixture\n"
+           "        return None\n")
+    new, sup = mxlint.lint_source(src)
+    assert new == [] and len(sup) == 1 and sup[0].rule == "bare-except"
+
+
+def test_pragma_suppresses_from_comment_line_above():
+    src = ("def f():\n"
+           "    try:\n"
+           "        return 1\n"
+           "    # mxlint: disable=bare-except — justified in fixture\n"
+           "    except:\n"
+           "        return None\n")
+    new, sup = mxlint.lint_source(src)
+    assert new == [] and len(sup) == 1
+
+
+def test_pragma_on_code_line_does_not_leak_to_next_line():
+    # the pragma sits on the CODE line directly above the finding: only
+    # standalone comment lines carry over, so this must still trip
+    src = ("import time\n"
+           "def f():\n"
+           "    x = 1  # mxlint: disable=timing-pair\n"
+           "    t0 = time.time()\n"
+           "    return x, time.time() - t0\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["timing-pair"]
+
+
+def test_pragma_disable_all():
+    src = ("import time\n"
+           "def f():\n"
+           "    t0 = time.time()  # mxlint: disable=all\n"
+           "    return time.time() - t0\n")
+    new, sup = mxlint.lint_source(src)
+    assert new == [] and len(sup) == 1
+
+
+def test_pragma_wrong_rule_does_not_suppress():
+    src = ("def f():\n"
+           "    try:\n"
+           "        return 1\n"
+           "    except:  # mxlint: disable=timing-pair\n"
+           "        return None\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["bare-except"]
+
+
+# -- baseline ---------------------------------------------------------------
+
+# The debt frozen by THIS PR.  Do not add entries: new code satisfies
+# the rule or carries a justified pragma; this set only ever SHRINKS
+# (delete an entry when its file's debt is paid).
+_FROZEN_BASELINE = {
+    ("timing-pair", "mxnet_tpu/callback.py"),
+    ("timing-pair", "mxnet_tpu/gluon/contrib/estimator.py"),
+    ("timing-pair", "mxnet_tpu/module/base_module.py"),
+}
+
+
+def test_shipped_baseline_is_frozen():
+    """The baseline may only shrink: every shipped entry must be in the
+    PR-5 freeze above, so debt in files added later can never hide."""
+    baseline = mxlint.load_baseline()
+    assert baseline <= _FROZEN_BASELINE, \
+        f"baseline grew beyond the freeze: {baseline - _FROZEN_BASELINE}"
+
+
+def test_baselined_file_is_not_a_new_finding(capsys):
+    """File-level baseline semantics: the grandfathered timing pair in
+    module/base_module.py lints as 'baselined', not 'new' (CLI exit 0)."""
+    rc = mxlint.main([os.path.join(REPO, "mxnet_tpu", "module",
+                                   "base_module.py")])
+    capsys.readouterr()
+    assert rc == 0
+    findings, _sup = mxlint.lint_paths(
+        [os.path.join(REPO, "mxnet_tpu", "module", "base_module.py")])
+    new, old = mxlint.split_baselined(findings, mxlint.load_baseline())
+    assert new == [] and len(old) >= 1
+
+
+def test_register_py_pragma_is_exercised():
+    """The deliberate hot-path clock pair in ndarray/register.py is
+    pragma-suppressed (justified inline), NOT baselined."""
+    findings, sup = mxlint.lint_paths(
+        [os.path.join(REPO, "mxnet_tpu", "ndarray", "register.py")])
+    assert not any(f.rule == "timing-pair" for f in findings)
+    assert any(f.rule == "timing-pair" for f in sup)
+
+
+# -- framework guarantees ---------------------------------------------------
+
+def test_single_parse_pass_per_file(tmp_path, monkeypatch):
+    """All seven rules ride ONE ast.parse per file (the reason the four
+    walkers were consolidated)."""
+    mxrules.declared_knobs(REPO)          # prime the knob-table cache
+    files = []
+    for i in range(3):
+        p = tmp_path / f"m{i}.py"
+        p.write_text("import time\nx = 1\n", encoding="utf-8")
+        files.append(str(p))
+    calls = []
+    real_parse = ast.parse
+
+    def counting_parse(*a, **k):
+        calls.append(1)
+        return real_parse(*a, **k)
+
+    monkeypatch.setattr(ast, "parse", counting_parse)
+    findings, _sup = mxlint.lint_paths(files)
+    assert findings == []
+    assert len(calls) == len(files), \
+        f"{len(calls)} parses for {len(files)} files"
+
+
+def test_parse_error_is_a_finding(tmp_path):
+    p = tmp_path / "broken.py"
+    p.write_text("def f(:\n", encoding="utf-8")
+    findings, _sup = mxlint.lint_paths([str(p)])
+    assert len(findings) == 1 and findings[0].rule == "parse-error"
+
+
+def test_changed_mode_lists_python_files_only():
+    files = mxlint._changed_files()
+    assert isinstance(files, list)
+    assert all(f.endswith(".py") for f in files)
+
+
+# -- rule-specific unit coverage beyond the fixtures ------------------------
+
+def test_env_knob_rule_catches_undeclared_get_env():
+    src = ("from mxnet_tpu.base import get_env\n"
+           "v = get_env('MXTPU_BOGUS_KNOB')\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["env-knob"]
+    assert "MXTPU_BOGUS_KNOB" in new[0].message
+
+
+def test_env_knob_rule_rejects_register_env_outside_base():
+    src = ("from mxnet_tpu.base import register_env\n"
+           "register_env('MXTPU_ROGUE', 1, int, 'rogue table entry')\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["env-knob"]
+
+
+def test_collective_safety_flags_else_branch():
+    src = ("def f(rank, dist):\n"
+           "    if rank == 0:\n"
+           "        pass\n"
+           "    else:\n"
+           "        dist.barrier()\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["collective-safety"]
+
+
+def test_collective_safety_allows_uniform_conditions():
+    src = ("def f(dist, num_workers):\n"
+           "    if num_workers > 1:\n"
+           "        dist.barrier()\n")
+    new, _sup = mxlint.lint_source(src)
+    assert new == []
+
+
+def test_lock_discipline_module_scope():
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_inst = None\n"
+           "def get():\n"
+           "    global _inst\n"
+           "    with _lock:\n"
+           "        if _inst is None:\n"
+           "            _inst = object()\n"
+           "    return _inst\n"
+           "def reset_unsafely():\n"
+           "    global _inst\n"
+           "    _inst = None\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["lock-discipline"]
+    assert "_inst" in new[0].message
+
+
+def test_lru_rule_catches_classes_defined_inside_functions():
+    # factory-built classes leak instances the same way (the old
+    # test-suite walker covered this; regression from the port)
+    src = ("import functools\n"
+           "def make_op():\n"
+           "    class Op:\n"
+           "        @functools.lru_cache(maxsize=None)\n"
+           "        def compile(self, key):\n"
+           "            return key\n"
+           "    return Op\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["unbounded-lru-method"]
+
+
+def test_lock_discipline_ignores_bare_annotations():
+    # `self.x: int` (no value) is not a store and must not trip
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._n = 0\n"
+           "    def read(self):\n"
+           "        with self._lock:\n"
+           "            return self._n\n"
+           "    def annotate(self):\n"
+           "        self._n: int\n")
+    new, _sup = mxlint.lint_source(src)
+    assert new == []
+
+
+def test_env_knob_catches_bare_environ_subscript():
+    src = ("from os import environ\n"
+           "v = environ['MXNET_BARE_SUBSCRIPT_KNOB']\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["env-knob"]
+
+
+def test_write_baseline_ignores_partial_scope(tmp_path, capsys):
+    # freezing from a narrowed scope must not drop the grandfather
+    # entries for everything outside it
+    bl = str(tmp_path / "bl.json")
+    rc = mxlint.main(["--baseline", bl, "--write-baseline",
+                      os.path.join(REPO, "mxnet_tpu", "observability")])
+    capsys.readouterr()
+    assert rc == 0
+    assert mxlint.load_baseline(bl) == _FROZEN_BASELINE
+
+
+def test_lock_discipline_ignores_unguarded_only_attributes():
+    # a lock that guards ONE attribute must not implicate the others
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "        self._guarded = 0\n"
+           "        self._free = 0\n"
+           "    def bump(self):\n"
+           "        with self._lock:\n"
+           "            self._guarded += 1\n"
+           "    def poke(self):\n"
+           "        self._free += 1\n")
+    new, _sup = mxlint.lint_source(src)
+    assert new == []
+
+
+# -- env-knob table / README sync -------------------------------------------
+
+def test_knob_table_covers_all_declared_knobs():
+    rows = mxlint.knob_rows()
+    names = [r["name"] for r in rows]
+    assert len(names) == len(set(names))
+    assert "MXNET_ENGINE_BULK_SIZE" in names
+    assert "MXTPU_DIST_TIMEOUT" in names
+    assert "MXTPU_FLIGHT_STEPS" in names
+    # every row documents itself
+    assert all(r["help"] for r in rows), \
+        [r["name"] for r in rows if not r["help"]]
+
+
+def test_readme_knob_table_in_sync():
+    """The README's env-knob reference is GENERATED
+    (``python -m mxnet_tpu.tools.mxlint --knobs-md``) — drift fails."""
+    with open(os.path.join(REPO, "README.md"), encoding="utf-8") as f:
+        readme = f.read()
+    begin, end = "<!-- mxlint-knobs:begin -->", "<!-- mxlint-knobs:end -->"
+    assert begin in readme and end in readme
+    block = readme.split(begin)[1].split(end)[0]
+    assert block.strip() == mxlint.knob_table_markdown().strip(), \
+        "README knob table is stale: regenerate with " \
+        "`python -m mxnet_tpu.tools.mxlint --knobs-md`"

@@ -5,10 +5,8 @@ round-trip, JSONL writer rotation, back-compat of the legacy
 layer — multi-host snapshot merging (single-process fallback AND a real
 multi-process group), host-labeled aggregate text format, the unified
 chrome-trace timeline (op + span events), and the crash flight recorder
-— plus two AST lint gates: no new ad-hoc module-level counter dicts,
-and no new ad-hoc ``time.time()``/``perf_counter()`` timing pairs
-outside the observability layer."""
-import ast
+— plus the thin 'counter-dict' and 'timing-pair' mxlint gates (the
+walkers themselves live in mxnet_tpu/tools/mxlint)."""
 import json
 import os
 import re
@@ -367,78 +365,13 @@ def test_jsonl_writer_periodic_thread(tmp_path):
 
 
 # -- lint gate: no new ad-hoc counter dicts ---------------------------------
-
-_COUNTERISH_NAME = re.compile(r"(counters?|stats|metrics)$")
-
-
-def _is_int_const(node) -> bool:
-    return isinstance(node, ast.Constant) and type(node.value) is int
-
-
-def _is_counter_dict_value(node) -> bool:
-    """A NON-EMPTY dict literal with string keys and int-constant values
-    (``{"steps_skipped": 0, ...}`` — the ad-hoc counter-surface shape PR 1
-    and PR 2 each grew), or a ``defaultdict(int)`` /
-    ``collections.Counter()`` call.  Empty dicts stay legal: name-dedup
-    counters (gluon.block, symbol) are keyed maps, not metric surfaces."""
-    if isinstance(node, ast.Dict):
-        return bool(node.values) and \
-            all(isinstance(k, ast.Constant) and type(k.value) is str
-                for k in node.keys) and \
-            all(_is_int_const(v) for v in node.values)
-    if isinstance(node, ast.Call):
-        fn = node.func
-        name = fn.attr if isinstance(fn, ast.Attribute) else \
-            fn.id if isinstance(fn, ast.Name) else None
-        if name == "defaultdict" and node.args and \
-                isinstance(node.args[0], ast.Name) and \
-                node.args[0].id == "int":
-            return True
-        if name == "Counter" and not node.args and not node.keywords:
-            return True
-    return False
-
+# The AST walker that used to live here moved into the mxlint subsystem
+# (mxnet_tpu/tools/mxlint — the 'counter-dict' rule); this thin
+# assertion rides the suite's single cached lint pass.
 
 def test_no_adhoc_counter_dicts_in_package():
-    """Metrics go through observability.registry — a third ad-hoc counter
-    surface (module-level ``X_counters = {...: 0}`` dicts, the shape PR 1
-    and PR 2 each grew) must not come back.  Gate: module-level (or
-    class-body-level) assignments of int-valued dict literals /
-    defaultdict(int) to counter-ish names, anywhere under mxnet_tpu/
-    except the registry itself."""
-    allowed = {os.path.join(REPO, "mxnet_tpu", "observability",
-                            "registry.py")}
-    offenders = []
-    for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            if path in allowed:
-                continue
-            with open(path, "r", encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-            scopes = [tree.body] + \
-                [n.body for n in ast.walk(tree)
-                 if isinstance(n, ast.ClassDef)]
-            for body in scopes:
-                for stmt in body:
-                    if isinstance(stmt, ast.Assign):
-                        targets, value = stmt.targets, stmt.value
-                    elif isinstance(stmt, ast.AnnAssign) and stmt.value:
-                        targets, value = [stmt.target], stmt.value
-                    else:
-                        continue
-                    names = [t.id.lower() for t in targets
-                             if isinstance(t, ast.Name)]
-                    if not any(_COUNTERISH_NAME.search(n)
-                               for n in names):
-                        continue
-                    if _is_counter_dict_value(value):
-                        offenders.append(f"{path}:{stmt.lineno}")
-    assert not offenders, \
-        f"ad-hoc counter dicts (use observability.registry() instead " \
-        f"of growing another disconnected metrics surface): {offenders}"
+    from mxnet_tpu.tools import mxlint
+    assert mxlint.rule_findings("counter-dict") == []
 
 
 # -- help lines -------------------------------------------------------------
@@ -677,6 +610,35 @@ def test_chrome_trace_contains_op_and_span_events(tmp_path):
     assert not any(e["name"].startswith("span:") for e in ops)
 
 
+def test_span_args_surface_as_chrome_trace_event_args(tmp_path):
+    """PR-4 follow-up: ``span(name, args={...})`` metadata (step number,
+    batch id) lands as the chrome-trace event's ``args`` — and never as
+    histogram labels (the registry metric stays unlabeled)."""
+    from mxnet_tpu import profiler
+    fn = str(tmp_path / "trace_args.json")
+    p = profiler.Profiler.get()
+    p.reset()
+    profiler.set_config(filename=fn)
+    profiler.set_state("run")
+    try:
+        with trace.span("t.argstep_us", args={"step": 41, "batch": 7}):
+            pass
+        with trace.span("t.argstep_us"):     # args are per-instance
+            pass
+    finally:
+        profiler.set_state("stop")
+    profiler.dump()
+    events = json.load(open(fn))["traceEvents"]
+    spans = [e for e in events if e.get("cat") == "span"
+             and e["name"] == "t.argstep_us"]
+    assert len(spans) == 2
+    with_args = [e for e in spans if "args" in e]
+    assert len(with_args) == 1
+    assert with_args[0]["args"] == {"step": 41, "batch": 7}
+    # the histogram is shared and label-free regardless of args
+    assert registry().get("t.argstep_us").read()["count"] >= 2
+
+
 # -- crash flight recorder --------------------------------------------------
 
 def test_flight_recorder_ring_and_dump(tmp_path):
@@ -821,86 +783,15 @@ def test_loader_prefetch_depth_gauge():
 
 
 # -- lint gate: no new ad-hoc timing pairs ----------------------------------
-
-# Pre-existing time.time()/perf_counter() start/stop pairs, grandfathered.
-# Do NOT add to this list: new wall-time measurements go through
-# observability.trace.span (one histogram + the unified chrome-trace
-# timeline for free).  observability/ and profiler.py ARE the metrics
-# layer — the clocks have to live somewhere.
-_TIMING_PAIR_ALLOWED = (
-    os.path.join("mxnet_tpu", "observability") + os.sep,
-    os.path.join("mxnet_tpu", "profiler.py"),
-    os.path.join("mxnet_tpu", "ndarray", "register.py"),   # feeds
-    # engine.flush_us on the per-segment hot path (span would add a
-    # registry lookup per flush)
-    os.path.join("mxnet_tpu", "gluon", "contrib", "estimator.py"),
-    os.path.join("mxnet_tpu", "module", "base_module.py"),
-    os.path.join("mxnet_tpu", "callback.py"),              # Speedometer
-)
-
-
-def _is_clock_call(node) -> bool:
-    """A call to time.time / time.perf_counter (incl. aliased imports
-    like ``from time import perf_counter as _perf_counter``)."""
-    if not isinstance(node, ast.Call):
-        return False
-    fn = node.func
-    if isinstance(fn, ast.Attribute):
-        return fn.attr in ("time", "perf_counter") and \
-            isinstance(fn.value, ast.Name) and fn.value.id == "time"
-    if isinstance(fn, ast.Name):
-        return "perf_counter" in fn.id
-    return False
-
-
-def _target_key(node):
-    """A comparable key for `t0 = ...` / `self._t0 = ...` targets."""
-    if isinstance(node, ast.Name):
-        return ("n", node.id)
-    if isinstance(node, ast.Attribute):
-        return ("a", node.attr)
-    return None
-
+# The AST walker (and its grandfather list) that used to live here moved
+# into the mxlint subsystem (mxnet_tpu/tools/mxlint — the 'timing-pair'
+# rule; legacy debt is frozen in mxlint's baseline.json, the deliberate
+# hot-path pair in ndarray/register.py carries an inline pragma); this
+# thin assertion rides the suite's single cached lint pass.
 
 def test_no_adhoc_timing_pairs_in_package():
-    """New wall-clock start/stop measurement outside the observability
-    layer must go through ``trace.span`` — it lands in a histogram, the
-    snapshot, the exporters, AND the unified chrome-trace timeline.
-    Gate: a ``t0 = time.time()/perf_counter()`` assignment whose name is
-    later subtracted from another clock call, anywhere under mxnet_tpu/
-    except the allowlist above (which must only ever shrink)."""
-    offenders = []
-    for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, REPO)
-            if any(rel.startswith(a) for a in _TIMING_PAIR_ALLOWED):
-                continue
-            with open(path, "r", encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-            started = {}          # target key -> lineno of t0 = clock()
-            for node in ast.walk(tree):
-                if isinstance(node, ast.Assign) and \
-                        _is_clock_call(node.value):
-                    for t in node.targets:
-                        key = _target_key(t)
-                        if key is not None:
-                            started[key] = node.lineno
-            if not started:
-                continue
-            for node in ast.walk(tree):
-                if isinstance(node, ast.BinOp) and \
-                        isinstance(node.op, ast.Sub) and \
-                        _is_clock_call(node.left):
-                    key = _target_key(node.right)
-                    if key is not None and key in started:
-                        offenders.append(
-                            f"{rel}:{started[key]}+{node.lineno}")
-    assert not offenders, \
-        f"ad-hoc timing pairs (use observability.trace.span instead — " \
-        f"histogram + unified timeline for free): {offenders}"
+    from mxnet_tpu.tools import mxlint
+    assert mxlint.rule_findings("timing-pair") == []
 
 
 # -- overhead guard (non-tier-1: -m slow only) ------------------------------

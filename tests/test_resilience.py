@@ -6,8 +6,8 @@ step failure is retried and recovers, (c) a simulated crash between
 checkpoints resumes from the newest COMMITTED checkpoint and reproduces
 the uninterrupted run bit-for-bit, (d) SIGTERM triggers a flushed
 checkpoint before exit — plus retention, dataloader and dist failure
-paths, and a lint gate (no bare ``except:`` under mxnet_tpu/)."""
-import ast
+paths, and the thin 'bare-except' mxlint gate (the walker itself lives
+in mxnet_tpu/tools/mxlint)."""
 import os
 import signal
 
@@ -25,8 +25,6 @@ from mxnet_tpu.gluon import nn, loss as gloss
 from mxnet_tpu.gluon.data import DataLoader
 from mxnet_tpu.parallel import ResilientTrainer, ShardedTrainer, \
     TrainingPreempted
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
 
 # -- helpers ----------------------------------------------------------------
@@ -651,65 +649,10 @@ def test_init_process_group_retries_then_clear_error(monkeypatch):
 
 
 # -- lint gate: no bare except under mxnet_tpu/ (satellite 6) ---------------
+# The AST walker that used to live here moved into the mxlint subsystem
+# (mxnet_tpu/tools/mxlint — the 'bare-except' rule); this thin assertion
+# rides the suite's single cached lint pass.
 
 def test_no_bare_except_in_package():
-    offenders = []
-    for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            with open(path, "r", encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-            for node in ast.walk(tree):
-                if isinstance(node, ast.ExceptHandler) and node.type is None:
-                    offenders.append(f"{path}:{node.lineno}")
-    assert not offenders, \
-        f"bare 'except:' clauses (swallow SystemExit/KeyboardInterrupt " \
-        f"and hide real faults): {offenders}"
-
-
-def _is_unbounded_lru(deco: ast.expr) -> bool:
-    """``@lru_cache(maxsize=None)`` / ``@functools.lru_cache(maxsize=None)``
-    (a bare ``@lru_cache`` or positional/int maxsize is bounded: fine)."""
-    if not isinstance(deco, ast.Call):
-        return False
-    fn = deco.func
-    name = fn.attr if isinstance(fn, ast.Attribute) else \
-        fn.id if isinstance(fn, ast.Name) else None
-    if name != "lru_cache":
-        return False
-    return any(kw.arg == "maxsize" and isinstance(kw.value, ast.Constant)
-               and kw.value.value is None for kw in deco.keywords)
-
-
-def test_no_unbounded_lru_cache_on_methods():
-    """lru_cache(maxsize=None) on a METHOD keys every entry on ``self``:
-    it pins each instance (and everything its entries close over —
-    compiled XLA executables, in the Operator case this gate was written
-    for) for the life of the process.  Module-level functions keyed on
-    immortal singletons are exempt; per-instance caches must be bounded
-    (see register._BoundedCache)."""
-    offenders = []
-    for root, _dirs, files in os.walk(os.path.join(REPO, "mxnet_tpu")):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            with open(path, "r", encoding="utf-8") as f:
-                tree = ast.parse(f.read(), filename=path)
-            for node in ast.walk(tree):
-                if not isinstance(node, ast.ClassDef):
-                    continue
-                for item in node.body:
-                    if not isinstance(item, (ast.FunctionDef,
-                                             ast.AsyncFunctionDef)):
-                        continue
-                    if any(_is_unbounded_lru(d)
-                           for d in item.decorator_list):
-                        offenders.append(
-                            f"{path}:{item.lineno} "
-                            f"{node.name}.{item.name}")
-    assert not offenders, \
-        f"unbounded lru_cache on methods (pins instances + their " \
-        f"compiled executables forever): {offenders}"
+    from mxnet_tpu.tools import mxlint
+    assert mxlint.rule_findings("bare-except") == []
