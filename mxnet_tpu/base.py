@@ -20,6 +20,7 @@ __all__ = [
     "register_env",
     "get_env",
     "list_env",
+    "hot_path",
     "string_types",
     "numeric_types",
     "integer_types",
@@ -39,6 +40,32 @@ class MXNetError(RuntimeError):
     Mirrors the reference's ``mxnet.base.MXNetError`` which surfaces C-side
     ``dmlc::Error``; here errors originate in Python/JAX directly.
     """
+
+
+def hot_path(kind: str) -> Callable:
+    """Marker decorator: this function is a hot-path ROOT for mxlint's
+    interprocedural rules.  Zero runtime cost (returns the function
+    unchanged, tagged); the lint reads the decoration statically.
+
+    ``kind``:
+      - ``"dispatch"`` — the per-op dispatch/flush path (engine push,
+        bulk-segment defer/flush).  Code reachable from here must stay
+        PURE: no allocation, env reads, lock creation, or logging
+        (rule ``hot-path-purity``), and must not hide host syncs
+        (rule ``hidden-host-sync``).
+      - ``"step"`` — the per-step training/serving path.  Allocation is
+        fine here (checkpointing etc.), but hidden host syncs
+        (``.asnumpy()``/``.item()``/value casts on device arrays) still
+        serialize the async engine and are flagged.
+    """
+    if kind not in ("dispatch", "step"):
+        raise ValueError(f"hot_path kind must be 'dispatch' or 'step', "
+                         f"got {kind!r}")
+
+    def mark(fn):
+        fn.__mxlint_hot_path__ = kind
+        return fn
+    return mark
 
 
 _CHANNELS_LAST = {"NWC": 1, "NHWC": 2, "NDHWC": 3}

@@ -31,7 +31,7 @@ import os
 import threading
 from typing import Any
 
-from .base import get_env
+from .base import get_env, hot_path
 from .observability.registry import registry as _metrics_registry
 
 __all__ = ["Engine", "engine", "is_naive", "wait_all", "PendingValue"]
@@ -63,6 +63,7 @@ def _install_flush_hook(fn) -> None:
     _flush_hook = fn
 
 
+@hot_path("dispatch")
 def flush_pending() -> None:
     """Flush the calling thread's pending bulk segment, if any."""
     if _flush_hook is not None:
@@ -95,6 +96,9 @@ class Engine:
     _lock = threading.Lock()
 
     def __init__(self):
+        # singleton __init__: runs once per process, after which
+        # engine() is a plain attribute read
+        # mxlint: disable=hot-path-purity — one-time singleton init
         self._type = get_env("MXNET_ENGINE_TYPE")
         # profiler hooks: fn(op_name, outputs, dispatch_us)
         self._listeners = []
@@ -193,6 +197,7 @@ class Engine:
         return self._fuse_parsed
 
     # -- dispatch hooks ----------------------------------------------------
+    @hot_path("dispatch")
     def on_push(self, op_name: str, outputs: Any,
                 dispatch_us: float = 0.0) -> None:
         """Called by the invoke path after dispatching an op; dispatch_us
@@ -210,6 +215,7 @@ class Engine:
             import jax
             jax.block_until_ready(outputs)
 
+    @hot_path("dispatch")
     def on_bulk_flush(self, n_ops: int, cache_hit,
                       flush_us: float = 0.0) -> None:
         """A segment of ``n_ops`` deferred ops executed as one fused

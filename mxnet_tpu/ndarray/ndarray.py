@@ -192,6 +192,9 @@ class NDArray:
         return transpose(self)
 
     def __repr__(self):
+        # repr is an interactive/debug surface — materializing IS
+        # the point
+        # mxlint: disable=hidden-host-sync — interactive repr
         return (f"\n{self.asnumpy()}\n<NDArray {'x'.join(map(str, self._shape))}"
                 f" @{self._ctx}>")
 
@@ -211,6 +214,9 @@ class NDArray:
     def asscalar(self):
         if self.size != 1:
             raise ValueError("the array is not scalar-sized")
+        # THE documented sync point for scalars (reference
+        # NDArray::SyncCopyToCPU semantics) — callers opt in
+        # mxlint: disable=hidden-host-sync — the sanctioned sync API
         return self.asnumpy().reshape(()).item()
 
     def item(self):
@@ -235,6 +241,8 @@ class NDArray:
         jax.block_until_ready(self._read())
 
     def __array__(self, dtype=None):
+        # np-protocol boundary: numpy asked for host memory
+        # mxlint: disable=hidden-host-sync — numpy protocol hook
         a = self.asnumpy()
         return a.astype(dtype) if dtype is not None else a
 

@@ -64,6 +64,9 @@ def _materialize(v):
         return v
     try:
         if hasattr(v, "asnumpy"):
+            # crash-dump materialization: the process is dying and
+            # the ring must land on disk (see docstring)
+            # mxlint: disable=hidden-host-sync — crash-dump path
             return float(v.asnumpy())
         return float(v)
     except Exception:   # noqa: BLE001 — a crashed backend must not

@@ -252,6 +252,9 @@ class MetricsRegistry:
     type is always a bug and raises."""
 
     def __init__(self):
+        # process-global registry, constructed once (reached from
+        # dispatch only via the one-time Engine singleton __init__)
+        # mxlint: disable=hot-path-purity — one-time singleton init
         self._lock = threading.Lock()
         self._metrics: Dict[str, _Metric] = {}
 

@@ -838,6 +838,8 @@ def _states_to_np(state):
         return None
     if isinstance(state, tuple):
         return tuple(_states_to_np(s) for s in state)
+    # checkpoint serialization boundary (set_states/get_states)
+    # mxlint: disable=hidden-host-sync — checkpoint serialization
     return state.asnumpy()
 
 

@@ -16,6 +16,21 @@ carry over:
 
 ``dist_async`` has no analog here by design: synchronous SPMD replaces
 stale parameter-server updates (SURVEY.md §5.8).
+
+**The collectivity contract is machine-checked.**  Every public entry
+point here (``allgather_*``, ``allreduce_host``, ``broadcast_host``,
+``barrier``) must be reached by EVERY process or by none — the KV-path
+generation counters below depend on per-process call counts staying in
+lockstep, and a rank that skips a collective wedges the fleet until
+the DCN timeout.  mxlint's ``collective-safety`` rule enforces this
+repo-wide and *interprocedurally*: a call to one of these functions —
+or to any helper that transitively reaches one, resolved through the
+project call graph — from under a branch conditioned on
+``rank``/``process_index``/``host_id``/... is a lint failure carrying
+the call chain as evidence.  Branch on fleet-uniform state only
+(``is_initialized()``, ``num_workers()``); the deterministic
+backend-capability fallbacks inside this module (every rank takes the
+same branch) are the sanctioned pattern.
 """
 from __future__ import annotations
 

@@ -40,7 +40,7 @@ import signal
 import threading
 from typing import Optional, Tuple, Type
 
-from ..base import MXNetError
+from ..base import MXNetError, hot_path
 from ..faults import FaultPlan, TransientFault, active_plan, retry_call
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry as _metrics_registry
@@ -105,7 +105,11 @@ def _poison_first_float(x):
 
     def to_np(v):
         if hasattr(v, "asnumpy"):
+            # fault injection: runs only when a 'nan' fault is
+            # armed for this exact step — never on the clean path
+            # mxlint: disable=hidden-host-sync — fault-only path
             return v.asnumpy()
+        # mxlint: disable=hidden-host-sync — same fault-only path
         return np.asarray(v)
 
     xs = list(x) if isinstance(x, (tuple, list)) else [x]
@@ -357,6 +361,7 @@ class ResilientTrainer:
         return self.resumed_t
 
     # -- the supervised step ----------------------------------------------
+    @hot_path("step")
     def step(self, x, y, batch_size: Optional[int] = None):
         """One supervised train step: auto-resume (first call), fault
         injection, bounded retry, skip accounting, preemption handling,

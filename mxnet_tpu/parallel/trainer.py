@@ -24,7 +24,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as _np
 
-from ..base import MXNetError
+from ..base import MXNetError, hot_path
 from ..context import current_context
 from .. import autograd as _autograd
 from .. import optimizer as opt_mod
@@ -365,6 +365,7 @@ class ShardedTrainer:
             ys = jax.device_put(yv, self._y_sh)
         return (xs if len(xs) > 1 else xs[0], ys)
 
+    @hot_path("step")
     def step(self, x, y, batch_size: Optional[int] = None):
         """Run one sharded train step; returns the (device) mean loss.
         `x` may be a single array or a tuple of inputs."""
@@ -644,6 +645,9 @@ def _to_val(y):
             return v._read()
         if isinstance(v, jax.Array):
             return v
+        # ingestion boundary: reached only for host data (lists /
+        # np arrays); NDArray and jax.Array pass through above
+        # mxlint: disable=hidden-host-sync — host-data ingestion
         return _np.asarray(v)
 
     if isinstance(y, tuple):
@@ -660,5 +664,7 @@ def _to_vals(x):
     xs = x if isinstance(x, (tuple, list)) else (x,)
     return tuple(
         v._read() if isinstance(v, NDArray)
+        # ingestion boundary: _np.asarray reached only for host data
+        # mxlint: disable=hidden-host-sync — host-data ingestion
         else v if isinstance(v, jax.Array) else _np.asarray(v)
         for v in xs)

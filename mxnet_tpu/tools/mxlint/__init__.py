@@ -1,11 +1,16 @@
 """mxlint — the repo's own static-analysis subsystem.
 
-One single-pass, pluggable analysis framework replacing the AST walkers
-that used to be copy-pasted across three test files: one ``ast.parse``
-per file, every rule visiting the same tree (:mod:`.core`), per-line
-``# mxlint: disable=<rule>`` pragmas for intentional exceptions, and ONE
-frozen JSON baseline (``baseline.json``) for grandfathered debt —
-replacing the per-test grandfather lists.
+A TWO-PASS, repo-wide analysis engine (grown from PR-5's per-file
+walker): pass 1 (:mod:`.graph`) builds a project symbol table and a
+conservative call graph — module functions, methods resolved through
+``self``/class attrs, known-alias imports — from the same trees pass 2
+walks for the lexical rules (still ONE ``ast.parse`` per file).  Rules
+then run with interprocedural context via ``project_check``: findings
+reached through the call graph carry a ``reason`` chain naming every
+hop, and a stable ``id`` (rule + path + enclosing symbol, not line).
+Per-line ``# mxlint: disable=<rule>`` pragmas cover intentional
+exceptions; ONE frozen JSON baseline (``baseline.json``) holds
+grandfathered debt, file-level.
 
 Rules (:mod:`.rules`) encode the codebase's actual contracts:
 
@@ -14,27 +19,39 @@ Rules (:mod:`.rules`) encode the codebase's actual contracts:
 ``unbounded-lru-method``  no ``lru_cache(maxsize=None)`` on methods
 ``counter-dict``          metrics go through ``observability.registry()``
 ``timing-pair``           wall-clock pairs go through ``trace.span``
-``lock-discipline``       lock-guarded state is written under its lock
-``collective-safety``     no collectives under host-divergent branches
+``lock-discipline``       lock-guarded state is written under its lock;
+                          plus (interprocedural) lock-order inversions
+                          and re-acquisition of a held non-reentrant Lock
+``collective-safety``     no collectives — even via helpers — reached
+                          from host-divergent branches
+``hot-path-purity``       nothing reachable from ``@hot_path("dispatch")``
+                          allocates, reads env, creates locks, or logs
+``hidden-host-sync``      no ``.asnumpy()``/``.item()``/cast syncs on or
+                          near ``@hot_path`` roots
 ``env-knob``              ``MXNET_*``/``MXTPU_*`` reads go through the
                           declared knob table (``base.register_env``)
 ========================  ===================================================
 
 CLI::
 
-    python -m mxnet_tpu.tools.mxlint [--json] [--changed] [paths...]
+    python -m mxnet_tpu.tools.mxlint [--json] [--changed] [--fix
+        [--dry-run]] [paths...]
 
 exits nonzero on any NEW finding (not pragma-suppressed, not in the
 baseline).  ``--changed`` lints only git-touched files (quick local
-runs); ``--write-baseline`` refreezes the baseline (deliberate act —
-the lint test guards the baseline against silent growth);
-``--knobs-md`` prints the generated env-knob reference table the README
-embeds.
+runs); ``--fix`` applies the mechanical rewriters (:mod:`.fix` — raw
+environ read → ``get_env``, same-block ``acquire()/release()`` pair →
+``with lock:``), idempotent and validated by re-linting; with
+``--dry-run`` it prints the diff and exits 1 if anything WOULD change
+(the precommit hook mode — see ``tools/precommit.py``);
+``--write-baseline`` refreezes the baseline (deliberate act — the lint
+test guards the baseline against silent growth); ``--knobs-md`` prints
+the generated env-knob reference table the README embeds.
 
 Pytest entry point: ``tests/test_lint.py`` calls :func:`check_repo`,
-which memoizes ONE full-repo run per process — the thin per-rule
-assertions in other test modules (:func:`rule_findings`) reuse it, so
-the whole suite pays a single parse pass where it used to pay four.
+which memoizes ONE full-repo two-pass run per process — the thin
+per-rule assertions in other test modules (:func:`rule_findings`)
+reuse it, so the whole suite pays a single analysis pass.
 """
 from __future__ import annotations
 
@@ -47,12 +64,13 @@ from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from .core import FileContext, Finding, is_suppressed, pragma_map, \
     run_rules
+from .graph import Project, build_project
 from .rules import ALL_RULES, BASE_RELPATH, declared_knobs, make_rules
 
-__all__ = ["Finding", "lint_paths", "lint_source", "check_repo",
-           "rule_findings", "load_baseline", "knob_table_markdown",
-           "main", "ALL_RULES", "REPO_ROOT", "DEFAULT_TARGET",
-           "BASELINE_PATH"]
+__all__ = ["Finding", "Project", "build_project", "lint_paths",
+           "lint_source", "check_repo", "rule_findings", "load_baseline",
+           "knob_table_markdown", "fix_paths", "main", "ALL_RULES",
+           "REPO_ROOT", "DEFAULT_TARGET", "BASELINE_PATH"]
 
 _PKG_DIR = os.path.dirname(os.path.abspath(__file__))
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(_PKG_DIR)))
@@ -80,21 +98,8 @@ def iter_py_files(paths: Sequence[str]) -> Iterable[str]:
                         yield os.path.join(root, fn)
 
 
-def lint_source(source: str, relpath: str = "mxnet_tpu/<snippet>.py",
-                rules=None) -> Tuple[List[Finding], List[Finding]]:
-    """Lint one source string → (new_findings, suppressed_findings).
-    The fixture/test entry point; ``relpath`` participates in rule
-    ``skip_paths`` policy, so pass something realistic."""
-    rules = [r for r in (rules if rules is not None
-                         else make_rules(REPO_ROOT))
-             if r.applies_to(relpath)]
-    try:
-        tree = ast.parse(source, filename=relpath)
-    except SyntaxError as e:
-        return ([Finding("parse-error", relpath, e.lineno or 0,
-                         f"syntax error: {e.msg}")], [])
-    ctx = FileContext(relpath, tree, source)
-    findings = run_rules(ctx, rules)
+def _split_suppressed(findings: Sequence[Finding], source: str
+                      ) -> Tuple[List[Finding], List[Finding]]:
     pragmas = pragma_map(source)
     lines = source.splitlines()
     new, suppressed = [], []
@@ -103,14 +108,62 @@ def lint_source(source: str, relpath: str = "mxnet_tpu/<snippet>.py",
     return new, suppressed
 
 
+def _lint_items(items: Sequence[Tuple[str, str, "ast.AST"]], rules
+                ) -> Tuple[List[Finding], List[Finding]]:
+    """The two-pass core over already-parsed files.
+
+    Pass 1 (:func:`mxlint.graph.build_project`) builds the repo-wide
+    symbol table + call graph; pass 2 walks each file once for the
+    lexical rules, then runs every rule's ``project_check`` with the
+    full interprocedural context.  Project findings are pragma-filtered
+    against the source of the file they land in, exactly like lexical
+    ones."""
+    project = build_project([(rel, tree) for rel, _src, tree in items])
+    sources = {rel: src for rel, src, _tree in items}
+    by_file: Dict[str, List[Finding]] = {rel: [] for rel, _s, _t in items}
+    for rel, source, tree in items:
+        ctx = FileContext(rel, tree, source, project=project)
+        file_rules = [r for r in rules if r.applies_to(rel)]
+        by_file[rel].extend(run_rules(ctx, file_rules))
+    for r in rules:
+        for f in r.project_check(project):
+            if f.path in by_file and r.applies_to(f.path):
+                by_file[f.path].append(f)
+    all_new: List[Finding] = []
+    all_sup: List[Finding] = []
+    for rel in sorted(by_file):
+        new, sup = _split_suppressed(by_file[rel], sources[rel])
+        all_new.extend(new)
+        all_sup.extend(sup)
+    return all_new, all_sup
+
+
+def lint_source(source: str, relpath: str = "mxnet_tpu/<snippet>.py",
+                rules=None) -> Tuple[List[Finding], List[Finding]]:
+    """Lint one source string → (new_findings, suppressed_findings).
+    The fixture/test entry point; ``relpath`` participates in rule
+    ``skip_paths`` policy, so pass something realistic.  The
+    interprocedural rules see a one-file project (helpers defined in
+    the same source resolve; anything else conservatively doesn't)."""
+    rules = rules if rules is not None else make_rules(REPO_ROOT)
+    try:
+        tree = ast.parse(source, filename=relpath)
+    except SyntaxError as e:
+        return ([Finding("parse-error", relpath, e.lineno or 0,
+                         f"syntax error: {e.msg}")], [])
+    return _lint_items([(relpath, source, tree)], rules)
+
+
 def lint_paths(paths: Optional[Sequence[str]] = None
                ) -> Tuple[List[Finding], List[Finding]]:
     """Lint files/directories → (findings, suppressed), pragma-filtered
     but NOT baseline-filtered (the caller splits new vs. grandfathered
-    so ``--json`` can show both)."""
+    so ``--json`` can show both).  The call graph spans exactly the
+    linted set: a full-tree run (the default, and the pytest gate) gets
+    repo-wide reachability; a narrowed scope resolves what it can see."""
     paths = list(paths) if paths else [DEFAULT_TARGET]
     all_new: List[Finding] = []
-    all_sup: List[Finding] = []
+    items: List[Tuple[str, str, "ast.AST"]] = []
     rules = make_rules(REPO_ROOT)
     for path in iter_py_files(paths):
         rel = _relpath(path)
@@ -121,10 +174,16 @@ def lint_paths(paths: Optional[Sequence[str]] = None
             all_new.append(Finding("parse-error", rel, 0,
                                    f"unreadable: {e}"))
             continue
-        new, sup = lint_source(source, relpath=rel, rules=rules)
-        all_new.extend(new)
-        all_sup.extend(sup)
-    return all_new, all_sup
+        try:
+            tree = ast.parse(source, filename=rel)
+        except SyntaxError as e:
+            all_new.append(Finding("parse-error", rel, e.lineno or 0,
+                                   f"syntax error: {e.msg}"))
+            continue
+        items.append((rel, source, tree))
+    new, sup = _lint_items(items, rules)
+    all_new.extend(new)
+    return all_new, sup
 
 
 # -- baseline ---------------------------------------------------------------
@@ -245,10 +304,57 @@ def knob_table_markdown(repo_root: Optional[str] = None) -> str:
     return "\n".join(lines) + "\n"
 
 
+# -- --fix ------------------------------------------------------------------
+
+def fix_paths(paths: Optional[Sequence[str]] = None,
+              dry_run: bool = False,
+              out=sys.stdout) -> Tuple[int, int]:
+    """Run the mechanical fixers over the target files → (files changed,
+    fixes applied).  ``dry_run`` prints a unified diff instead of
+    writing.  Idempotent: a second run changes nothing."""
+    import difflib
+
+    from .fix import fix_source
+    declared = declared_knobs(REPO_ROOT)
+    paths = list(paths) if paths else [DEFAULT_TARGET]
+    n_files = n_fixes = 0
+    for path in iter_py_files(paths):
+        rel = _relpath(path)
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                source = f.read()
+        except OSError:
+            continue
+        fixed, fixes = fix_source(source, rel, declared)
+        if not fixes or fixed == source:
+            continue
+        n_files += 1
+        n_fixes += len(fixes)
+        if dry_run:
+            diff = difflib.unified_diff(
+                source.splitlines(keepends=True),
+                fixed.splitlines(keepends=True),
+                fromfile=f"a/{rel}", tofile=f"b/{rel}")
+            out.write("".join(diff))
+        else:
+            with open(path, "w", encoding="utf-8") as f:
+                f.write(fixed)
+        for fx in fixes:
+            out.write(f"mxlint --fix{' (dry-run)' if dry_run else ''}: "
+                      f"{rel}:{fx.line}: {fx.detail}\n")
+    return n_files, n_fixes
+
+
 # -- CLI --------------------------------------------------------------------
 
+_FIXTURE_DIR = "tests/lint_fixtures/"
+
+
 def _changed_files() -> List[str]:
-    """git-touched .py files (diff vs HEAD + untracked) for --changed."""
+    """git-touched .py files (diff vs HEAD + untracked) for --changed.
+    The lint-fixture vectors are excluded: the ``*_bad`` ones trip their
+    rules BY DESIGN, and ``tests/test_lint.py`` already locks their
+    behavior down."""
     out: List[str] = []
     for cmd in (["git", "-C", REPO_ROOT, "diff", "--name-only", "HEAD"],
                 ["git", "-C", REPO_ROOT, "ls-files", "--others",
@@ -259,7 +365,8 @@ def _changed_files() -> List[str]:
         except (OSError, subprocess.SubprocessError):
             return []
         out.extend(line.strip() for line in res.stdout.splitlines()
-                   if line.strip().endswith(".py"))
+                   if line.strip().endswith(".py")
+                   and not line.strip().startswith(_FIXTURE_DIR))
     seen, files = set(), []
     for rel in out:
         full = os.path.join(REPO_ROOT, rel)
@@ -275,8 +382,14 @@ usage: python -m mxnet_tpu.tools.mxlint [options] [paths...]
 Lint mxnet_tpu/ (default) or the given files/directories.
 
 options:
-  --json            machine-readable output (findings + baselined)
+  --json            machine-readable output (findings + baselined),
+                    each finding with its stable id and reason chain
   --changed         lint only git-touched .py files (quick local runs)
+  --fix             apply mechanical rewrites (environ read -> get_env,
+                    same-block acquire/release pair -> with lock:),
+                    then re-lint the fixed tree
+  --dry-run         with --fix: print the diff, write nothing; exits 1
+                    if anything would change (precommit-hook mode)
   --baseline PATH   use a different baseline file
   --write-baseline  refreeze the baseline from the current findings
   --knobs-md        print the generated env-knob reference table
@@ -287,7 +400,7 @@ options:
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
     argv = list(sys.argv[1:] if argv is None else argv)
-    as_json = changed = write_bl = False
+    as_json = changed = write_bl = do_fix = dry_run = False
     baseline_path = None
     paths: List[str] = []
     i = 0
@@ -300,6 +413,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
             as_json = True
         elif a == "--changed":
             changed = True
+        elif a == "--fix":
+            do_fix = True
+        elif a == "--dry-run":
+            dry_run = True
         elif a == "--write-baseline":
             write_bl = True
         elif a == "--baseline":
@@ -337,12 +454,35 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
               f"{'y' if n == 1 else 'ies'} -> "
               f"{baseline_path or BASELINE_PATH}")
         return 0
+    if dry_run and not do_fix:
+        print("--dry-run only means something with --fix",
+              file=sys.stderr)
+        return 2
     if changed:
         paths = _changed_files()
         if not paths:
             if not as_json:
                 print("mxlint: no changed .py files")
             return 0
+    if do_fix:
+        # with --json, stdout must stay ONE parseable document — route
+        # the fixer chatter to stderr
+        fix_out = sys.stderr if as_json else sys.stdout
+        n_files, n_fixes = fix_paths(paths or None, dry_run=dry_run,
+                                     out=fix_out)
+        if dry_run:
+            print(f"mxlint --fix --dry-run: {n_fixes} fix"
+                  f"{'' if n_fixes == 1 else 'es'} pending in {n_files} "
+                  f"file{'' if n_files == 1 else 's'}", file=fix_out)
+            if n_fixes:
+                return 1
+            # fall through to the normal lint so the hook still gates
+            # on findings the fixers can't touch
+        else:
+            print(f"mxlint --fix: applied {n_fixes} fix"
+                  f"{'' if n_fixes == 1 else 'es'} in {n_files} "
+                  f"file{'' if n_files == 1 else 's'}; re-linting",
+                  file=fix_out)
     findings, suppressed = lint_paths(paths or None)
     baseline = load_baseline(baseline_path)
     new, old = split_baselined(findings, baseline)

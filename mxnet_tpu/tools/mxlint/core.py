@@ -36,22 +36,45 @@ _PRAGMA_RE = re.compile(r"#\s*mxlint:\s*disable=([A-Za-z0-9_,\- ]+)")
 
 
 class Finding:
-    """One rule violation at one source line."""
+    """One rule violation at one source line.
 
-    __slots__ = ("rule", "path", "line", "message")
+    ``symbol`` is the enclosing function's qualified name (``Class.meth``
+    or ``func``; ``None`` at module level) — it anchors the stable
+    finding ``id`` (rule + path + symbol, deliberately NOT the line, so
+    unrelated edits above a finding don't change its identity).
+    ``reason`` is the interprocedural evidence chain: for a finding the
+    analysis reached through the call graph, each entry is one hop
+    (``"a.py::f -> b.py::g"`` style), ending at the fact that fired."""
 
-    def __init__(self, rule: str, path: str, line: int, message: str):
+    __slots__ = ("rule", "path", "line", "message", "symbol", "reason")
+
+    def __init__(self, rule: str, path: str, line: int, message: str,
+                 symbol: Optional[str] = None,
+                 reason: Tuple[str, ...] = ()):
         self.rule = rule
         self.path = path          # repo-relative, forward slashes
         self.line = line
         self.message = message
+        self.symbol = symbol
+        self.reason = tuple(reason)
+
+    @property
+    def id(self) -> str:
+        return f"{self.rule}:{self.path}:{self.symbol or '<module>'}"
 
     def as_dict(self) -> dict:
-        return {"rule": self.rule, "path": self.path, "line": self.line,
-                "message": self.message}
+        d = {"id": self.id, "rule": self.rule, "path": self.path,
+             "line": self.line, "symbol": self.symbol,
+             "message": self.message}
+        if self.reason:
+            d["reason"] = list(self.reason)
+        return d
 
     def __repr__(self) -> str:
-        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        base = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+        if self.reason:
+            base += "\n    reason: " + " | ".join(self.reason)
+        return base
 
     def __eq__(self, other) -> bool:
         return isinstance(other, Finding) and \
@@ -73,10 +96,12 @@ class FileContext:
     — divergence is divergence).
     """
 
-    def __init__(self, relpath: str, tree: ast.AST, source: str):
+    def __init__(self, relpath: str, tree: ast.AST, source: str,
+                 project=None):
         self.relpath = relpath
         self.tree = tree
         self.source = source
+        self.project = project        # mxlint.graph.Project or None
         self.class_stack: List[ast.ClassDef] = []
         self.func_stack: List[ast.AST] = []
         self.lock_stack: List[Tuple[str, str]] = []
@@ -84,8 +109,23 @@ class FileContext:
         self.findings: List[Finding] = []
 
     # -- rule-facing helpers -------------------------------------------------
-    def report(self, rule: "Rule", line: int, message: str) -> None:
-        self.findings.append(Finding(rule.name, self.relpath, line, message))
+    def qualname(self) -> Optional[str]:
+        """``Class.meth`` / ``func`` for the innermost enclosing def, or
+        None at module level — the finding ``symbol`` anchor."""
+        if not self.func_stack:
+            return None
+        name = self.func_stack[-1].name
+        if self.class_stack:
+            return f"{self.class_stack[-1].name}.{name}"
+        return name
+
+    def report(self, rule: "Rule", line: int, message: str,
+               symbol: Optional[str] = None,
+               reason: Tuple[str, ...] = ()) -> None:
+        self.findings.append(Finding(
+            rule.name, self.relpath, line, message,
+            symbol=symbol if symbol is not None else self.qualname(),
+            reason=reason))
 
     def current_class(self) -> Optional[ast.ClassDef]:
         return self.class_stack[-1] if self.class_stack else None
@@ -127,6 +167,13 @@ class Rule:
     def end_file(self, ctx: FileContext) -> None:     # noqa: B027
         pass
 
+    def project_check(self, project) -> List[Finding]:
+        """Interprocedural phase: called ONCE per lint run after every
+        file has been walked, with the full :class:`mxlint.graph.Project`
+        (symbol table + call graph).  Findings returned here go through
+        the same pragma/baseline filtering as per-file findings."""
+        return []
+
 
 def _lock_token(expr: ast.expr) -> Optional[Tuple[str, str]]:
     """Lock token for a with-item context expression, or None.
@@ -143,6 +190,24 @@ def _lock_token(expr: ast.expr) -> Optional[Tuple[str, str]]:
     if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
         return ("mod", expr.id)
     return None
+
+
+def _acquire_release(stmt: ast.stmt) -> Optional[Tuple[str, Tuple[str, str]]]:
+    """``lock.acquire()`` / ``lock.release()`` as a bare statement →
+    ("acquire"|"release", lock token).  The explicit-pair form of a held
+    region: the walker treats everything between the pair (including a
+    ``try`` body whose ``finally`` releases) as lock-guarded, the same
+    as a ``with`` block."""
+    if not isinstance(stmt, ast.Expr) or not isinstance(stmt.value, ast.Call):
+        return None
+    fn = stmt.value.func
+    if not isinstance(fn, ast.Attribute) or \
+            fn.attr not in ("acquire", "release"):
+        return None
+    tok = _lock_token(fn.value)
+    if tok is None:
+        return None
+    return fn.attr, tok
 
 
 def run_rules(ctx: FileContext, rules: Sequence[Rule]) -> List[Finding]:
@@ -167,14 +232,38 @@ def _visit(ctx: FileContext, node: ast.AST,
     t = type(node)
     if t is ast.ClassDef:
         ctx.class_stack.append(node)
+        depth = len(ctx.lock_stack)
         for child in ast.iter_child_nodes(node):
             _visit(ctx, child, handlers)
+        del ctx.lock_stack[depth:]
         ctx.class_stack.pop()
     elif t in FUNC_TYPES:
         ctx.func_stack.append(node)
+        # an unbalanced acquire() inside must not leak a held region
+        # into the functions that follow
+        depth = len(ctx.lock_stack)
         for child in ast.iter_child_nodes(node):
             _visit(ctx, child, handlers)
+        del ctx.lock_stack[depth:]
         ctx.func_stack.pop()
+    elif t is ast.Expr:
+        # explicit lock.acquire()/lock.release() statements open/close a
+        # held region exactly like a `with` block: statements between the
+        # pair (sibling order — including a try body whose finally
+        # releases) see the token on the lock stack
+        ar = _acquire_release(node)
+        for child in ast.iter_child_nodes(node):
+            _visit(ctx, child, handlers)
+        if ar is not None:
+            kind, tok = ar
+            if kind == "acquire":
+                ctx.lock_stack.append(tok)
+            elif tok in ctx.lock_stack:
+                # remove the innermost matching hold
+                for i in range(len(ctx.lock_stack) - 1, -1, -1):
+                    if ctx.lock_stack[i] == tok:
+                        del ctx.lock_stack[i]
+                        break
     elif t in (ast.With, ast.AsyncWith):
         tokens = []
         for item in node.items:
@@ -192,10 +281,15 @@ def _visit(ctx: FileContext, node: ast.AST,
     elif t is ast.If:
         _visit(ctx, node.test, handlers)
         ctx.if_stack.append(node.test)
+        # an acquire() inside one arm must not look held in the other
+        # arm or after the If (the arms are mutually exclusive)
+        depth = len(ctx.lock_stack)
         for stmt in node.body:
             _visit(ctx, stmt, handlers)
+        del ctx.lock_stack[depth:]
         for stmt in node.orelse:
             _visit(ctx, stmt, handlers)
+        del ctx.lock_stack[depth:]
         ctx.if_stack.pop()
     elif t is ast.IfExp:
         _visit(ctx, node.test, handlers)

@@ -1,0 +1,321 @@
+"""mxlint ``--fix``: mechanical, behavior-preserving rewrites.
+
+Two fixers, both deliberately narrow — a fixer that guesses is worse
+than a finding the author resolves by hand:
+
+- **env-read**: a raw ``os.environ.get("MXNET_X", ...)`` /
+  ``os.environ["MXNET_X"]`` / ``os.getenv("MXNET_X", ...)`` read of a
+  knob that IS declared in the ``base.py`` table becomes
+  ``get_env("MXNET_X")`` (the declared default/type applies — which is
+  the point: a raw read silently forks the default from the documented
+  one).  Undeclared names are left alone: rewriting them would change
+  behavior without a table entry to define it.  The ``get_env`` import
+  is added if the module doesn't already bind the name.
+- **with-lock**: a same-block ``X.acquire()`` … ``X.release()``
+  statement pair becomes ``with X:`` around the statements between
+  them.  Only when the region is provably equivalent: no
+  return/break/continue (the original pair leaks the lock on those
+  paths — rewriting would CHANGE behavior, and the leak deserves a
+  human look, which lock-discipline now gives it), and no other
+  acquire/release of the same lock inside (the release/re-acquire
+  dance in ``register.py::_try_defer`` must never be "simplified").
+
+Both fixers are idempotent: running ``--fix`` on already-fixed source
+is a no-op, and the CLI validates by re-linting the fixed tree.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import pragma_map
+
+__all__ = ["fix_source", "Fix"]
+
+_KNOB_PREFIXES = ("MXNET_", "MXTPU_")
+
+
+def _pragma_opts_out(pragmas: Dict[int, Set[str]], lines: Sequence[str],
+                     line: int, rule: str) -> bool:
+    """A ``# mxlint: disable=<rule>`` pragma covering ``line`` opts the
+    site out of fixing too — the author already declared the raw form
+    intentional (same same-line / standalone-comment-above contract as
+    finding suppression)."""
+    names = pragmas.get(line)
+    if names and ("all" in names or rule in names):
+        return True
+    prev = line - 1
+    names = pragmas.get(prev)
+    return bool(names and 1 <= prev <= len(lines)
+                and lines[prev - 1].lstrip().startswith("#")
+                and ("all" in names or rule in names))
+
+
+class Fix:
+    """One applied (or proposed) rewrite."""
+
+    __slots__ = ("kind", "line", "detail")
+
+    def __init__(self, kind: str, line: int, detail: str):
+        self.kind = kind
+        self.line = line
+        self.detail = detail
+
+    def __repr__(self) -> str:
+        return f"[fix:{self.kind}] line {self.line}: {self.detail}"
+
+
+# -- fixer 1: raw environ reads -> get_env ----------------------------------
+
+def _environ_read_span(node: ast.AST) -> Optional[Tuple[str, ast.AST]]:
+    """(knob name, call/subscript node) for a raw environ read of a
+    string-literal MXNET_*/MXTPU_* name, else None."""
+    if isinstance(node, ast.Subscript):
+        base = node.value
+        is_env = (isinstance(base, ast.Attribute) and base.attr == "environ") \
+            or (isinstance(base, ast.Name) and base.id == "environ")
+        if is_env and isinstance(node.ctx, ast.Load) and \
+                isinstance(node.slice, ast.Constant) and \
+                isinstance(node.slice.value, str):
+            return node.slice.value, node
+        return None
+    if not isinstance(node, ast.Call):
+        return None
+    fn = node.func
+    name = fn.attr if isinstance(fn, ast.Attribute) else \
+        fn.id if isinstance(fn, ast.Name) else None
+    if name == "get" and isinstance(fn, ast.Attribute):
+        recv = fn.value
+        is_env = (isinstance(recv, ast.Attribute) and recv.attr == "environ")\
+            or (isinstance(recv, ast.Name) and recv.id == "environ")
+        if not is_env:
+            return None
+    elif name != "getenv":
+        return None
+    if node.args and isinstance(node.args[0], ast.Constant) and \
+            isinstance(node.args[0].value, str):
+        return node.args[0].value, node
+    return None
+
+
+def _binds_get_env(tree: ast.AST) -> bool:
+    for n in ast.walk(tree):
+        if isinstance(n, ast.ImportFrom):
+            if any(a.name == "get_env" and a.asname is None
+                   for a in n.names):
+                return True
+        elif isinstance(n, ast.FunctionDef) and n.name == "get_env":
+            return True
+    return False
+
+
+def _get_env_import_line(relpath: str) -> str:
+    """Repo-idiomatic import for ``get_env`` given the module location."""
+    parts = relpath.replace("\\", "/").split("/")
+    if parts[0] == "mxnet_tpu" and len(parts) > 1:
+        depth = len(parts) - 1          # mxnet_tpu/x.py -> 1 -> .base
+        return f"from {'.' * depth}base import get_env"
+    return "from mxnet_tpu.base import get_env"
+
+
+def _fix_env_reads(source: str, relpath: str, declared: Set[str]
+                   ) -> Tuple[str, List[Fix]]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source, []
+    pragmas = pragma_map(source)
+    plain_lines = source.splitlines()
+    targets = []                        # (lineno, col, end_col, knob)
+    for node in ast.walk(tree):
+        hit = _environ_read_span(node)
+        if hit is None:
+            continue
+        knob, span = hit
+        if not knob.startswith(_KNOB_PREFIXES) or knob not in declared:
+            continue
+        if span.lineno != span.end_lineno:
+            continue                    # multi-line call: hand-fix
+        if _pragma_opts_out(pragmas, plain_lines, span.lineno, "env-knob"):
+            continue                    # author declared the raw read
+        targets.append((span.lineno, span.col_offset, span.end_col_offset,
+                        knob))
+    # nested reads (a read as another read's default arg): keep only the
+    # OUTERMOST span — rewriting it replaces the whole expression in one
+    # shot, while rewriting the inner one first would shift the line and
+    # leave the outer span pointing past the call (silent corruption)
+    targets = [t for t in targets
+               if not any(o is not t and o[0] == t[0]
+                          and o[1] <= t[1] and t[2] <= o[2]
+                          for o in targets)]
+    if not targets:
+        return source, []
+    lines = source.splitlines(keepends=True)
+    fixes: List[Fix] = []
+    # bottom-up, right-to-left so earlier spans stay valid
+    for lineno, col, end_col, knob in sorted(targets, reverse=True):
+        line = lines[lineno - 1]
+        lines[lineno - 1] = (line[:col] + f'get_env("{knob}")'
+                            + line[end_col:])
+        fixes.append(Fix("env-read", lineno,
+                         f"raw environ read of {knob} -> get_env({knob!r})"))
+    fixes.reverse()
+    new_source = "".join(lines)
+    if not _binds_get_env(tree):
+        new_source = _insert_import(new_source,
+                                    _get_env_import_line(relpath))
+        fixes.append(Fix("env-read", 0, "added get_env import"))
+    return new_source, fixes
+
+
+def _insert_import(source: str, import_line: str) -> str:
+    """Insert after the last top-level import (or the module docstring)."""
+    tree = ast.parse(source)
+    last = 0
+    for node in tree.body:
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            last = node.end_lineno or node.lineno
+        elif last == 0 and isinstance(node, ast.Expr) and \
+                isinstance(node.value, ast.Constant) and \
+                isinstance(node.value.value, str):
+            last = node.end_lineno or node.lineno    # docstring
+    lines = source.splitlines(keepends=True)
+    lines.insert(last, import_line + "\n")
+    return "".join(lines)
+
+
+# -- fixer 2: same-block acquire()/release() pair -> with -------------------
+
+def _lockish(expr: ast.expr) -> Optional[str]:
+    """Source text of a lock-ish receiver (name contains 'lock')."""
+    if isinstance(expr, ast.Attribute) and "lock" in expr.attr.lower():
+        return ast.unparse(expr)
+    if isinstance(expr, ast.Name) and "lock" in expr.id.lower():
+        return expr.id
+    return None
+
+
+def _acq_rel_stmt(stmt: ast.stmt) -> Optional[Tuple[str, str]]:
+    """("acquire"|"release", receiver source) for a bare
+    ``X.acquire()``/``X.release()`` statement."""
+    if not isinstance(stmt, ast.Expr) or \
+            not isinstance(stmt.value, ast.Call) or stmt.value.args or \
+            stmt.value.keywords:
+        return None
+    fn = stmt.value.func
+    if not isinstance(fn, ast.Attribute) or \
+            fn.attr not in ("acquire", "release"):
+        return None
+    recv = _lockish(fn.value)
+    if recv is None:
+        return None
+    return fn.attr, recv
+
+
+def _region_is_safe(stmts: Sequence[ast.stmt], recv: str) -> bool:
+    """No early exits — return/break/continue/raise all leave the pair's
+    region with the lock still HELD; rewriting to ``with`` would release
+    it there, changing behavior — no other acquire/release of the SAME
+    lock, and no multi-line string literals (the rewrite re-indents raw
+    lines, which would change the string's VALUE)."""
+    for stmt in stmts:
+        for n in ast.walk(stmt):
+            if isinstance(n, (ast.Return, ast.Break, ast.Continue,
+                              ast.Raise)):
+                return False
+            if isinstance(n, (ast.Constant, ast.JoinedStr)) and \
+                    getattr(n, "end_lineno", n.lineno) != n.lineno and \
+                    (isinstance(n, ast.JoinedStr)
+                     or isinstance(n.value, (str, bytes))):
+                return False
+            if isinstance(n, ast.Call) and \
+                    isinstance(n.func, ast.Attribute) and \
+                    n.func.attr in ("acquire", "release"):
+                r = _lockish(n.func.value)
+                if r == recv:
+                    return False
+    return True
+
+
+def _find_pair(body: Sequence[ast.stmt], pragmas: Dict[int, Set[str]],
+               lines: Sequence[str]) -> Optional[Tuple[int, int, str]]:
+    """First same-block (acquire_idx, release_idx, receiver) pair whose
+    region qualifies, else None."""
+    for i, stmt in enumerate(body):
+        ar = _acq_rel_stmt(stmt)
+        if ar is None or ar[0] != "acquire":
+            continue
+        if _pragma_opts_out(pragmas, lines, stmt.lineno,
+                            "lock-discipline"):
+            continue                    # author declared the raw pair
+        recv = ar[1]
+        for j in range(i + 1, len(body)):
+            ar2 = _acq_rel_stmt(body[j])
+            if ar2 is not None and ar2[0] == "release" and ar2[1] == recv:
+                if _region_is_safe(body[i + 1:j], recv):
+                    return i, j, recv
+                break                   # unsafe region: leave this pair
+            # a nested acquire/release of the same lock anywhere between
+            # disqualifies via _region_is_safe at match time
+    return None
+
+
+def _iter_bodies(tree: ast.AST):
+    for node in ast.walk(tree):
+        for field in ("body", "orelse", "finalbody"):
+            body = getattr(node, field, None)
+            if isinstance(body, list) and body and \
+                    isinstance(body[0], ast.stmt):
+                yield body
+
+
+def _fix_one_pair(source: str) -> Tuple[str, Optional[Fix]]:
+    try:
+        tree = ast.parse(source)
+    except SyntaxError:
+        return source, None
+    pragmas = pragma_map(source)
+    plain_lines = source.splitlines()
+    for body in _iter_bodies(tree):
+        pair = _find_pair(body, pragmas, plain_lines)
+        if pair is None:
+            continue
+        i, j, recv = pair
+        acq, rel = body[i], body[j]
+        lines = source.splitlines(keepends=True)
+        indent = lines[acq.lineno - 1][:acq.col_offset]
+        # region lines: everything between the acquire and release stmts
+        region_start = acq.end_lineno           # 0-based index of line after
+        region_end = rel.lineno - 1             # 0-based index of release
+        region = [("    " + ln if ln.strip() else ln)
+                  for ln in lines[region_start:region_end]]
+        if not region:
+            region = [indent + "    pass\n"]
+        new = (lines[:acq.lineno - 1]
+               + [f"{indent}with {recv}:\n"]
+               + region
+               + lines[rel.end_lineno:])
+        return "".join(new), Fix(
+            "with-lock", acq.lineno,
+            f"{recv}.acquire()/.release() pair -> 'with {recv}:'")
+    return source, None
+
+
+def _fix_lock_pairs(source: str) -> Tuple[str, List[Fix]]:
+    fixes: List[Fix] = []
+    while True:
+        source, fix = _fix_one_pair(source)
+        if fix is None:
+            return source, fixes
+        fixes.append(fix)
+
+
+# -- entry point ------------------------------------------------------------
+
+def fix_source(source: str, relpath: str, declared: Set[str]
+               ) -> Tuple[str, List[Fix]]:
+    """Apply every mechanical fixer → (fixed source, applied fixes).
+    ``declared`` is the env-knob table (``mxlint.declared_knobs``)."""
+    out, fixes = _fix_env_reads(source, relpath, declared)
+    out, lock_fixes = _fix_lock_pairs(out)
+    return out, fixes + lock_fixes

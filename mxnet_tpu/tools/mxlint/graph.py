@@ -1,0 +1,725 @@
+"""mxlint pass 1: project symbol table + conservative call graph.
+
+The interprocedural context the lexical rules were blind to.  One extra
+walk per file (the trees are already parsed — still ONE ``ast.parse``
+per file) extracts per-function **facts**:
+
+- call sites, with the resolution hints the conservative resolver
+  understands (bare names, ``self.meth``/``cls.meth``, ``alias.f`` via
+  known imports) plus the lexical context at the site — the innermost
+  host-divergent ``if`` token and the set of locks held;
+- collective calls (``allgather_*``/``allreduce_host``/…), with their
+  own host-branch context;
+- host-sync events (``.asnumpy()``/``.item()``/value casts/np coercion);
+- hot-path impurities (lock creation, env reads, logging, host-array
+  allocation);
+- lock acquisitions (``with``/``acquire()``), each with the locks
+  already held — the raw material for lock-order analysis.
+
+:class:`Project` then answers the interprocedural questions the rules
+ask (``find_collective``, ``find_acquires``, ``reachable``), every
+search **call-depth-bounded** (:data:`MAX_CALL_DEPTH`) and cycle-safe,
+returning the call chain so findings can carry a ``reason`` the reader
+can audit.
+
+Resolution is deliberately conservative: a call the resolver cannot
+attribute (``obj.method()`` on an arbitrary value, higher-order calls,
+anything imported from outside the linted set) contributes no edge.
+Missed edges mean missed findings, never false ones.
+"""
+from __future__ import annotations
+
+import ast
+from collections import deque
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from .core import FUNC_TYPES, _lock_token
+
+__all__ = ["Project", "FuncFacts", "ModuleFacts", "build_project",
+           "MAX_CALL_DEPTH", "COLLECTIVES", "HOST_TOKENS", "HOT_PATH_MARK"]
+
+#: BFS bound for every interprocedural search: deep enough to see
+#: through the wrapper layers this codebase actually has (dispatch →
+#: segment → engine → registry is 4), small enough that a conservative
+#: over-approximation cannot walk the whole repo from one call site.
+MAX_CALL_DEPTH = 6
+
+#: fleet collectives: every host must reach these or none may
+COLLECTIVES = frozenset((
+    "allgather_bytes", "allgather_host", "allreduce_host",
+    "broadcast_host", "barrier"))
+
+#: identifiers whose value DIVERGES across hosts
+HOST_TOKENS = frozenset((
+    "process_index", "process_id", "host_id", "rank", "worker_id",
+    "local_rank", "host"))
+
+#: the decorator name marking hot-path roots (mxnet_tpu.base.hot_path)
+HOT_PATH_MARK = "hot_path"
+
+_LOCK_FACTORIES = ("Lock", "RLock")
+
+# numpy-ish module aliases + the array-materializing calls on them
+_NP_ALIASES = frozenset(("np", "_np", "numpy", "onp"))
+_NP_ALLOC = frozenset(("array", "asarray", "zeros", "ones", "empty",
+                       "full", "arange", "copy", "ascontiguousarray"))
+_LOG_METHODS = frozenset(("debug", "info", "warning", "warn", "error",
+                          "exception", "critical", "log"))
+# value casts that force a device round-trip when fed an NDArray-valued
+# expression; only method-call results count (float(x.sum())) — casting
+# a plain name is overwhelmingly a host scalar already
+_CAST_NAMES = frozenset(("float", "int", "bool"))
+# ...but not results of dict/host accessors: bool(kwargs.get(...)) and
+# friends never touch the device
+_CAST_EXEMPT_METHODS = frozenset(("get", "pop", "setdefault", "decode",
+                                  "encode", "strip", "split", "read"))
+
+
+def _trailing_name(node: ast.expr) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _host_conditioned(test: ast.expr) -> Optional[str]:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in HOST_TOKENS:
+            return n.id
+        if isinstance(n, ast.Attribute) and n.attr in HOST_TOKENS:
+            return n.attr
+    return None
+
+
+def _is_lock_factory(node: ast.expr) -> bool:
+    return isinstance(node, ast.Call) and \
+        _trailing_name(node.func) in _LOCK_FACTORIES and not node.args
+
+
+def _hot_kind(decorators: Sequence[ast.expr]) -> Optional[str]:
+    """``@hot_path("dispatch")`` / ``@base.hot_path("step")`` → kind."""
+    for d in decorators:
+        if isinstance(d, ast.Call) and \
+                _trailing_name(d.func) == HOT_PATH_MARK and d.args and \
+                isinstance(d.args[0], ast.Constant) and \
+                isinstance(d.args[0].value, str):
+            return d.args[0].value
+    return None
+
+
+class CallSite:
+    """One call with the lexical context the interprocedural rules need."""
+
+    __slots__ = ("desc", "line", "host_tok", "held")
+
+    def __init__(self, desc: Tuple, line: int, host_tok: Optional[str],
+                 held: Tuple):
+        self.desc = desc          # ("name", f) | ("self", m) | ("attr", b, m)
+        self.line = line
+        self.host_tok = host_tok  # host-divergent branch token at the site
+        self.held = held          # scoped lock tokens held at the site
+
+
+class FuncFacts:
+    """Everything pass 1 learned about one function/method.  Nested
+    ``def``s and lambdas are inlined into their enclosing function —
+    closures run (or not) on the enclosing frame's path, and the
+    conservative direction is to attribute their effects upward."""
+
+    __slots__ = ("key", "relpath", "qualname", "class_name", "line",
+                 "hot_kind", "calls", "collectives", "syncs", "impure",
+                 "acquires")
+
+    def __init__(self, key: str, relpath: str, qualname: str,
+                 class_name: Optional[str], line: int):
+        self.key = key
+        self.relpath = relpath
+        self.qualname = qualname
+        self.class_name = class_name
+        self.line = line
+        self.hot_kind: Optional[str] = None
+        self.calls: List[CallSite] = []
+        self.collectives: List[Tuple[str, int, Optional[str]]] = []
+        self.syncs: List[Tuple[str, int, str]] = []    # (kind, line, what)
+        self.impure: List[Tuple[str, int, str]] = []   # (kind, line, what)
+        self.acquires: List[Tuple[Tuple, int, Tuple]] = []  # (tok, ln, held)
+
+    def __repr__(self) -> str:
+        return f"<FuncFacts {self.key}>"
+
+
+class ClassFacts:
+    __slots__ = ("name", "methods", "bases")
+
+    def __init__(self, name: str, bases: Sequence[str]):
+        self.name = name
+        self.methods: Dict[str, str] = {}     # method name -> func key
+        self.bases = tuple(bases)             # base-class NAMES (resolved
+        # lazily through the same module's symbol table)
+
+
+class ModuleFacts:
+    __slots__ = ("relpath", "func_defs", "classes", "import_mods",
+                 "import_syms", "lock_kinds")
+
+    def __init__(self, relpath: str):
+        self.relpath = relpath
+        self.func_defs: Dict[str, str] = {}          # name -> func key
+        self.classes: Dict[str, ClassFacts] = {}
+        self.import_mods: Dict[str, str] = {}        # alias -> module relpath
+        self.import_syms: Dict[str, Tuple[str, str]] = {}  # alias -> (rp, sym)
+        self.lock_kinds: Dict[Tuple, str] = {}       # token -> Lock | RLock
+
+
+def _module_pkg_parts(relpath: str) -> List[str]:
+    """Package-path parts for relative-import resolution."""
+    parts = relpath.split("/")
+    if parts[-1] == "__init__.py":
+        return parts[:-1]
+    return parts[:-1]
+
+
+class _FactWalker:
+    """One recursive, order-preserving walk of one module tree."""
+
+    def __init__(self, relpath: str, project: "Project"):
+        self.rp = relpath
+        self.proj = project
+        self.mf = ModuleFacts(relpath)
+        project.modules[relpath] = self.mf
+        self.cur_func: Optional[FuncFacts] = None
+        self.cur_class: Optional[str] = None
+        self.if_hosts: List[Optional[str]] = []
+        self.held: List[Tuple] = []
+        # module-level statements get their own pseudo-function so e.g.
+        # a collective at import time still has somewhere to land; no
+        # call ever resolves TO it, so it can't pollute reachability
+        self.mod_func = FuncFacts(f"{relpath}::<module>", relpath,
+                                  "<module>", None, 0)
+        project.functions[self.mod_func.key] = self.mod_func
+
+    # -- token scoping ------------------------------------------------------
+    def _scoped_token(self, expr: ast.expr) -> Optional[Tuple]:
+        tok = _lock_token(expr)
+        if tok is None:
+            return None
+        scope, name = tok
+        if scope in ("self", "cls"):
+            if self.cur_class is None:
+                return ("obj", scope, name)
+            return ("cls", f"{self.rp}::{self.cur_class}", name)
+        if isinstance(expr, ast.Name):
+            # module identity ONLY for names assigned a Lock at module
+            # top level (pre-scanned); a function-LOCAL lock variable
+            # must not share identity with unrelated same-named locals
+            # in other functions — that invents deadlock findings
+            mod_tok = ("mod", self.rp, name)
+            if mod_tok in self.mf.lock_kinds:
+                return mod_tok
+            return ("obj", "<local>", name)
+        base = expr.value if isinstance(expr, ast.Attribute) else None
+        base_name = base.id if isinstance(base, ast.Name) else "?"
+        return ("obj", base_name, name)
+
+    def _scoped_held(self) -> Tuple:
+        """Locks held at this point that have a cross-function identity
+        (class- or module-scoped; ``obj.attr`` locks on local values
+        cannot be matched reliably across functions)."""
+        return tuple(t for t in self.held if t[0] in ("cls", "mod"))
+
+    def _host_tok(self) -> Optional[str]:
+        for tok in reversed(self.if_hosts):
+            if tok is not None:
+                return tok
+        return None
+
+    # -- facts helpers ------------------------------------------------------
+    def _func_key(self, name: str) -> str:
+        if self.cur_class is not None:
+            return f"{self.rp}::{self.cur_class}.{name}"
+        return f"{self.rp}::{name}"
+
+    def _record_lock_kind(self, token: Tuple, value: ast.expr) -> None:
+        kind = _trailing_name(value.func)  # Lock | RLock
+        self.mf.lock_kinds[token] = kind
+        self.proj.lock_kinds[token] = kind
+
+    # -- walk ---------------------------------------------------------------
+    def walk(self, tree: ast.AST) -> None:
+        # pre-scan module-level lock assignments so a `with _lock:` in a
+        # function defined ABOVE the assignment still gets module scope
+        for stmt in getattr(tree, "body", ()):
+            if isinstance(stmt, ast.Assign) and \
+                    _is_lock_factory(stmt.value):
+                for tgt in stmt.targets:
+                    if isinstance(tgt, ast.Name):
+                        self._record_lock_kind(
+                            ("mod", self.rp, tgt.id), stmt.value)
+        for child in ast.iter_child_nodes(tree):
+            self._go(child)
+
+    def _go(self, node: ast.AST) -> None:  # noqa: C901 — one dispatch hub
+        t = type(node)
+        if t in FUNC_TYPES:
+            self._enter_func(node)
+            return
+        if t is ast.ClassDef:
+            self._enter_class(node)
+            return
+        if t is ast.Import:
+            self._do_import(node)
+            return
+        if t is ast.ImportFrom:
+            self._do_import_from(node)
+            return
+        if t in (ast.With, ast.AsyncWith):
+            toks = []
+            for item in node.items:
+                self._go(item.context_expr)
+                if item.optional_vars is not None:
+                    self._go(item.optional_vars)
+                tok = self._scoped_token(item.context_expr)
+                if tok is not None:
+                    # push immediately so a later item in the same
+                    # `with a, b:` sees `a` already held
+                    self._note_acquire(tok, item.context_expr.lineno)
+                    self.held.append(tok)
+                    toks.append(tok)
+            for stmt in node.body:
+                self._go(stmt)
+            if toks:
+                del self.held[-len(toks):]
+            return
+        if t is ast.If or t is ast.IfExp:
+            self._go(node.test)
+            self.if_hosts.append(_host_conditioned(node.test))
+            # an explicit acquire() inside ONE arm must not look held in
+            # the other arm or after the If — the arms are mutually
+            # exclusive, and inventing a hold there invents deadlock
+            # findings (conservative = fewer held locks, never more)
+            depth = len(self.held)
+            if t is ast.If:
+                for stmt in node.body:
+                    self._go(stmt)
+                del self.held[depth:]
+                for stmt in node.orelse:
+                    self._go(stmt)
+                del self.held[depth:]
+            else:
+                self._go(node.body)
+                del self.held[depth:]
+                self._go(node.orelse)
+                del self.held[depth:]
+            self.if_hosts.pop()
+            return
+        if t is ast.Assign:
+            self._do_assign(node)
+            return
+        if t is ast.Expr and isinstance(node.value, ast.Call):
+            fn = node.value.func
+            if isinstance(fn, ast.Attribute) and \
+                    fn.attr in ("acquire", "release"):
+                tok = self._scoped_token(fn.value)
+                if tok is not None:
+                    self._go(node.value)   # the call itself (events/edges)
+                    if fn.attr == "acquire":
+                        self._note_acquire(tok, node.lineno)
+                        self.held.append(tok)
+                    else:
+                        for i in range(len(self.held) - 1, -1, -1):
+                            if self.held[i] == tok:
+                                del self.held[i]
+                                break
+                    return
+        if t is ast.Call:
+            self._do_call(node)
+            # fall through: walk arguments too
+        for child in ast.iter_child_nodes(node):
+            self._go(child)
+
+    def _enter_func(self, node) -> None:
+        for d in node.decorator_list:
+            self._go(d)
+        if self.cur_func is not None:
+            # nested def/closure: inline its body into the parent
+            held_depth = len(self.held)
+            for stmt in node.body:
+                self._go(stmt)
+            del self.held[held_depth:]
+            return
+        if self.cur_class is not None:
+            qual = f"{self.cur_class}.{node.name}"
+        else:
+            qual = node.name
+        key = self._func_key(node.name)
+        ff = FuncFacts(key, self.rp, qual, self.cur_class, node.lineno)
+        ff.hot_kind = _hot_kind(node.decorator_list)
+        self.proj.functions[key] = ff
+        if self.cur_class is not None:
+            self.mf.classes[self.cur_class].methods[node.name] = key
+        else:
+            self.mf.func_defs.setdefault(node.name, key)
+        self.cur_func = ff
+        held, ifs = self.held, self.if_hosts
+        self.held, self.if_hosts = [], []
+        for stmt in node.body:
+            self._go(stmt)
+        self.held, self.if_hosts = held, ifs
+        self.cur_func = None
+
+    def _enter_class(self, node: ast.ClassDef) -> None:
+        if self.cur_func is not None:
+            # class inside a function: its methods inline into the
+            # enclosing function like any nested def
+            for stmt in node.body:
+                self._go(stmt)
+            return
+        bases = [b for b in (_trailing_name(x) for x in node.bases)
+                 if b is not None]
+        if self.cur_class is not None:
+            # class nested in a class body: index its methods under a
+            # dotted sentinel ("Outer.Inner" — can't collide with a
+            # top-level class name) so `self.meth()` in OUTER methods
+            # cannot resolve to the inner class's methods (a fabricated
+            # edge), while calls WITHIN the inner class still resolve
+            name = f"{self.cur_class}.{node.name}"
+        else:
+            name = node.name
+        self.mf.classes[name] = ClassFacts(name, bases)
+        outer, self.cur_class = self.cur_class, name
+        for stmt in node.body:
+            self._go(stmt)
+        self.cur_class = outer
+
+    # -- imports ------------------------------------------------------------
+    def _resolve_module(self, dotted: str, level: int) -> Optional[str]:
+        if level == 0:
+            parts = dotted.split(".") if dotted else []
+        else:
+            base = _module_pkg_parts(self.rp)
+            if level - 1 > len(base):
+                return None
+            base = base[:len(base) - (level - 1)]
+            parts = base + (dotted.split(".") if dotted else [])
+        if not parts:
+            return None
+        for cand in ("/".join(parts) + ".py",
+                     "/".join(parts) + "/__init__.py"):
+            if cand in self.proj.known_paths:
+                return cand
+        return None
+
+    def _do_import(self, node: ast.Import) -> None:
+        for alias in node.names:
+            rp = self._resolve_module(alias.name, 0)
+            if rp is None:
+                continue
+            if alias.asname is not None:
+                self.mf.import_mods[alias.asname] = rp
+            elif "." not in alias.name:
+                # `import a.b.c` with no asname binds only `a`
+                self.mf.import_mods[alias.name] = rp
+
+    def _do_import_from(self, node: ast.ImportFrom) -> None:
+        base_rp = self._resolve_module(node.module or "", node.level)
+        if base_rp is None:
+            return
+        pkg_dir = base_rp[:-len("/__init__.py")] \
+            if base_rp.endswith("/__init__.py") else None
+        for alias in node.names:
+            if alias.name == "*":
+                continue
+            local = alias.asname or alias.name
+            # submodule of a package beats a symbol of the module
+            if pkg_dir is not None:
+                for cand in (f"{pkg_dir}/{alias.name}.py",
+                             f"{pkg_dir}/{alias.name}/__init__.py"):
+                    if cand in self.proj.known_paths:
+                        self.mf.import_mods[local] = cand
+                        break
+                else:
+                    self.mf.import_syms[local] = (base_rp, alias.name)
+            else:
+                self.mf.import_syms[local] = (base_rp, alias.name)
+
+    # -- assignments (lock kinds) -------------------------------------------
+    def _do_assign(self, node: ast.Assign) -> None:
+        if _is_lock_factory(node.value):
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id in ("self", "cls") and \
+                        self.cur_class is not None:
+                    self._record_lock_kind(
+                        ("cls", f"{self.rp}::{self.cur_class}", tgt.attr),
+                        node.value)
+                elif isinstance(tgt, ast.Name):
+                    if self.cur_class is not None and self.cur_func is None:
+                        self._record_lock_kind(
+                            ("cls", f"{self.rp}::{self.cur_class}",
+                             tgt.id), node.value)
+                    elif self.cur_func is None:
+                        self._record_lock_kind(
+                            ("mod", self.rp, tgt.id), node.value)
+        for child in ast.iter_child_nodes(node):
+            self._go(child)
+
+    # -- events -------------------------------------------------------------
+    def _note_acquire(self, tok: Tuple, line: int) -> None:
+        ff = self.cur_func if self.cur_func is not None else self.mod_func
+        ff.acquires.append((tok, line, self._scoped_held()))
+
+    def _do_call(self, node: ast.Call) -> None:
+        ff = self.cur_func if self.cur_func is not None else self.mod_func
+        fn = node.func
+        name = _trailing_name(fn)
+        # collectives
+        if name in COLLECTIVES:
+            ff.collectives.append((name, node.lineno, self._host_tok()))
+        # host syncs
+        if name == "asnumpy" and isinstance(fn, ast.Attribute) and \
+                not node.args:
+            ff.syncs.append(("asnumpy", node.lineno, ".asnumpy()"))
+        elif name == "item" and isinstance(fn, ast.Attribute) and \
+                not node.args:
+            ff.syncs.append(("item", node.lineno, ".item()"))
+        elif isinstance(fn, ast.Name) and fn.id in _CAST_NAMES and \
+                len(node.args) == 1 and \
+                isinstance(node.args[0], ast.Call) and \
+                isinstance(node.args[0].func, ast.Attribute) and \
+                node.args[0].func.attr not in _CAST_EXEMPT_METHODS:
+            ff.syncs.append(("cast", node.lineno,
+                             f"{fn.id}(<.{node.args[0].func.attr}()>)"))
+        elif isinstance(fn, ast.Attribute) and \
+                fn.attr in ("asarray", "array") and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in _NP_ALIASES and node.args and \
+                isinstance(node.args[0], (ast.Name, ast.Attribute)):
+            ff.syncs.append(("np-coerce", node.lineno,
+                             f"{fn.value.id}.{fn.attr}(...)"))
+        # hot-path impurities
+        if name in _LOCK_FACTORIES and not node.args:
+            ff.impure.append(("lock-creation", node.lineno, f"{name}()"))
+        elif name in ("get_env", "getenv", "_raw_env"):
+            # _raw_env counts too: it IS an environ read (its own body is
+            # policy-sanctioned, but a hot CALLER still pays the dict
+            # lookup and must justify it)
+            ff.impure.append(("env-read", node.lineno, f"{name}(...)"))
+        elif name == "get" and isinstance(fn, ast.Attribute) and \
+                _trailing_name(fn.value) == "environ":
+            ff.impure.append(("env-read", node.lineno, "os.environ.get"))
+        elif name in _LOG_METHODS and isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                "log" in fn.value.id.lower():
+            ff.impure.append(("logging", node.lineno,
+                              f"{fn.value.id}.{name}(...)"))
+        elif name == "warn" and isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id == "warnings":
+            ff.impure.append(("logging", node.lineno, "warnings.warn"))
+        elif name == "print" and isinstance(fn, ast.Name):
+            ff.impure.append(("logging", node.lineno, "print(...)"))
+        elif isinstance(fn, ast.Attribute) and fn.attr in _NP_ALLOC and \
+                isinstance(fn.value, ast.Name) and \
+                fn.value.id in _NP_ALIASES:
+            ff.impure.append(("allocation", node.lineno,
+                              f"{fn.value.id}.{fn.attr}(...)"))
+        # call edge
+        desc = None
+        if isinstance(fn, ast.Name):
+            desc = ("name", fn.id)
+        elif isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name):
+            if fn.value.id in ("self", "cls"):
+                desc = ("self", fn.attr)
+            else:
+                desc = ("attr", fn.value.id, fn.attr)
+        if desc is not None:
+            ff.calls.append(CallSite(desc, node.lineno, self._host_tok(),
+                                     self._scoped_held()))
+
+
+class Project:
+    """The repo-wide symbol table + call graph (pass-1 output)."""
+
+    def __init__(self):
+        self.modules: Dict[str, ModuleFacts] = {}
+        self.functions: Dict[str, FuncFacts] = {}
+        self.known_paths: Set[str] = set()
+        self.lock_kinds: Dict[Tuple, str] = {}
+        self._callee_cache: Dict[str, Tuple] = {}
+
+    # -- resolution ---------------------------------------------------------
+    def _class_method(self, rp: str, cls_name: str, meth: str,
+                      depth: int = 0) -> Optional[str]:
+        mod = self.modules.get(rp)
+        if mod is None or depth > 3:
+            return None
+        cf = mod.classes.get(cls_name)
+        if cf is None:
+            # maybe the class name is an imported symbol
+            tgt = mod.import_syms.get(cls_name)
+            if tgt is not None:
+                return self._class_method(tgt[0], tgt[1], meth, depth + 1)
+            return None
+        key = cf.methods.get(meth)
+        if key is not None:
+            return key
+        for base in cf.bases:
+            key = self._class_method(rp, base, meth, depth + 1)
+            if key is not None:
+                return key
+        return None
+
+    def _module_symbol(self, rp: str, name: str,
+                       depth: int = 0) -> Optional[str]:
+        """A callable symbol of module ``rp``: function key, or a class's
+        ``__init__`` (constructor call).  ``depth`` bounds re-export
+        chains — a two-module re-export CYCLE (a imports f from b, b
+        from a) must dead-end, not recurse forever."""
+        mod = self.modules.get(rp)
+        if mod is None or depth > 3:
+            return None
+        key = mod.func_defs.get(name)
+        if key is not None:
+            return key
+        cf = mod.classes.get(name)
+        if cf is not None:
+            return cf.methods.get("__init__")
+        tgt = mod.import_syms.get(name)
+        if tgt is not None and tgt[0] != rp:
+            return self._module_symbol(tgt[0], tgt[1], depth + 1)
+        return None
+
+    def resolve(self, caller: FuncFacts, desc: Tuple) -> Optional[str]:
+        """Conservative call-target resolution; None = no edge."""
+        mod = self.modules.get(caller.relpath)
+        if mod is None:
+            return None
+        kind = desc[0]
+        if kind == "name":
+            return self._module_symbol(caller.relpath, desc[1])
+        if kind == "self":
+            if caller.class_name is None:
+                return None
+            return self._class_method(caller.relpath, caller.class_name,
+                                      desc[1])
+        # ("attr", base, meth)
+        base, meth = desc[1], desc[2]
+        rp = mod.import_mods.get(base)
+        if rp is not None:
+            return self._module_symbol(rp, meth)
+        if base in mod.classes:
+            return self._class_method(caller.relpath, base, meth)
+        tgt = mod.import_syms.get(base)
+        if tgt is not None:
+            # `from .engine import Engine; Engine.get()`
+            return self._class_method(tgt[0], tgt[1], meth)
+        return None
+
+    def callees(self, key: str) -> Tuple:
+        """Resolved ``(callee_key, CallSite)`` edges of one function."""
+        cached = self._callee_cache.get(key)
+        if cached is not None:
+            return cached
+        ff = self.functions.get(key)
+        out: List[Tuple[str, CallSite]] = []
+        if ff is not None:
+            for cs in ff.calls:
+                ck = self.resolve(ff, cs.desc)
+                if ck is not None and ck in self.functions:
+                    out.append((ck, cs))
+        result = tuple(out)
+        self._callee_cache[key] = result
+        return result
+
+    # -- bounded searches ---------------------------------------------------
+    def find_collective(self, start: str, max_depth: int = MAX_CALL_DEPTH
+                        ) -> Optional[Tuple[Tuple[str, ...], Tuple]]:
+        """Shortest call chain from ``start`` to a function containing a
+        collective call → (chain of keys incl. start, (name, line)), or
+        None.  Cycle-safe, depth-bounded."""
+        q = deque([(start, (start,))])
+        seen = {start}
+        while q:
+            key, chain = q.popleft()
+            ff = self.functions.get(key)
+            if ff is not None and ff.collectives:
+                name, line, _tok = ff.collectives[0]
+                return chain, (name, line)
+            if len(chain) > max_depth:
+                continue
+            for ck, _cs in self.callees(key):
+                if ck not in seen:
+                    seen.add(ck)
+                    q.append((ck, chain + (ck,)))
+        return None
+
+    def find_acquires(self, start: str, max_depth: int = MAX_CALL_DEPTH
+                      ) -> Dict[Tuple, Tuple[Tuple[str, ...], int]]:
+        """Every class-/module-scoped lock token acquired in functions
+        reachable from ``start`` (inclusive) within the depth bound →
+        {token: (chain, line)} with the shortest chain per token."""
+        out: Dict[Tuple, Tuple[Tuple[str, ...], int]] = {}
+        q = deque([(start, (start,))])
+        seen = {start}
+        while q:
+            key, chain = q.popleft()
+            ff = self.functions.get(key)
+            if ff is not None:
+                for tok, line, _held in ff.acquires:
+                    if tok[0] in ("cls", "mod") and tok not in out:
+                        out[tok] = (chain, line)
+            if len(chain) > max_depth:
+                continue
+            for ck, _cs in self.callees(key):
+                if ck not in seen:
+                    seen.add(ck)
+                    q.append((ck, chain + (ck,)))
+        return out
+
+    def reachable(self, roots: Iterable[str],
+                  max_depth: int = MAX_CALL_DEPTH + 2
+                  ) -> Dict[str, Tuple[str, ...]]:
+        """{key: shortest chain from a root} for every function reachable
+        from ``roots`` (roots included, chain = (root,))."""
+        out: Dict[str, Tuple[str, ...]] = {}
+        q = deque()
+        for r in roots:
+            if r in self.functions and r not in out:
+                out[r] = (r,)
+                q.append((r, (r,)))
+        while q:
+            key, chain = q.popleft()
+            if len(chain) > max_depth:
+                continue
+            for ck, _cs in self.callees(key):
+                if ck not in out:
+                    out[ck] = chain + (ck,)
+                    q.append((ck, chain + (ck,)))
+        return out
+
+    def hot_roots(self, kinds: Tuple[str, ...]) -> List[str]:
+        return sorted(k for k, f in self.functions.items()
+                      if f.hot_kind in kinds)
+
+    # -- display ------------------------------------------------------------
+    def pretty(self, key: str) -> str:
+        ff = self.functions.get(key)
+        if ff is None:
+            return key
+        return f"{ff.relpath}::{ff.qualname}"
+
+    def chain_str(self, chain: Sequence[str]) -> str:
+        return " -> ".join(self.pretty(k) for k in chain)
+
+
+def build_project(items: Sequence[Tuple[str, ast.AST]]) -> Project:
+    """Pass 1 over already-parsed trees: ``items`` is ``[(relpath,
+    tree)]`` for every file in the lint scope."""
+    proj = Project()
+    proj.known_paths = {rp for rp, _tree in items}
+    for rp, tree in items:
+        _FactWalker(rp, proj).walk(tree)
+    return proj

@@ -437,57 +437,303 @@ class LockDisciplineRule(Rule):
                     f"elsewhere in this module — take the lock or "
                     f"pragma with a justification")
 
+    # -- interprocedural phase: deadlock classes ----------------------------
 
-# -- new rule 2: collective safety ------------------------------------------
+    @staticmethod
+    def _tok_str(tok) -> str:
+        scope, owner, name = tok
+        if scope == "cls":
+            return f"{owner.split('::')[-1]}.{name}"
+        if scope == "mod":
+            return f"{owner}::{name}"
+        return f"{owner}.{name}"
 
-_COLLECTIVES = frozenset((
-    "allgather_bytes", "allgather_host", "allreduce_host",
-    "broadcast_host", "barrier"))
-# identifiers whose value DIVERGES across hosts: a collective lexically
-# under a branch conditioned on one of these can deadlock the fleet
-_HOST_TOKENS = frozenset((
-    "process_index", "process_id", "host_id", "rank", "worker_id",
-    "local_rank", "host"))
+    def project_check(self, project):
+        """Held-lock propagation over the call graph:
+
+        - **re-acquire**: a call made while holding a non-reentrant
+          ``threading.Lock`` that (transitively) acquires the SAME lock
+          self-deadlocks on first contention-free run — ``Lock`` is not
+          re-entrant.
+        - **lock-order inversion**: lock A taken while holding B in one
+          code path and B while holding A in another is the classic
+          two-thread deadlock; every edge carries the call chain that
+          produced it."""
+        from .core import Finding
+        out = []
+        seen_reacq = set()
+        # (A, B) -> (relpath, line, symbol, reason)
+        edges: Dict[tuple, tuple] = {}
+        for key in sorted(project.functions):
+            ff = project.functions[key]
+            sym = None if ff.qualname == "<module>" else ff.qualname
+            # intra-function evidence (with-nesting and acquire() calls)
+            for tok, line, held in ff.acquires:
+                if tok[0] not in ("cls", "mod"):
+                    continue
+                for h in held:
+                    if h == tok:
+                        if project.lock_kinds.get(tok) == "Lock" and \
+                                (ff.relpath, line) not in seen_reacq:
+                            seen_reacq.add((ff.relpath, line))
+                            out.append(Finding(
+                                self.name, ff.relpath, line,
+                                f"re-acquires non-reentrant "
+                                f"'{self._tok_str(tok)}' already held in "
+                                f"this function — threading.Lock "
+                                f"self-deadlocks; use RLock or split a "
+                                f"'*_locked' helper", symbol=sym))
+                    elif (h, tok) not in edges:
+                        edges[(h, tok)] = (
+                            ff.relpath, line, sym,
+                            (f"{project.pretty(key)} acquires "
+                             f"'{self._tok_str(tok)}' while holding "
+                             f"'{self._tok_str(h)}' "
+                             f"({ff.relpath}:{line})",))
+            # interprocedural: calls made with locks held
+            for cs in ff.calls:
+                if not cs.held:
+                    continue
+                ck = project.resolve(ff, cs.desc)
+                if ck is None:
+                    continue
+                for tok, (chain, aline) in \
+                        project.find_acquires(ck).items():
+                    tail = project.functions[chain[-1]]
+                    if tok in cs.held:
+                        if project.lock_kinds.get(tok) == "Lock" and \
+                                (ff.relpath, cs.line) not in seen_reacq:
+                            seen_reacq.add((ff.relpath, cs.line))
+                            out.append(Finding(
+                                self.name, ff.relpath, cs.line,
+                                f"this call re-acquires non-reentrant "
+                                f"'{self._tok_str(tok)}' already held "
+                                f"(via {project.chain_str(chain)}) — "
+                                f"threading.Lock self-deadlocks; use "
+                                f"RLock or call a '*_locked' variant",
+                                symbol=sym,
+                                reason=(f"{project.pretty(key)} holds "
+                                        f"'{self._tok_str(tok)}' at the "
+                                        f"call ({ff.relpath}:{cs.line})",
+                                        f"call chain: "
+                                        f"{project.chain_str(chain)}",
+                                        f"{project.pretty(tail.key)} "
+                                        f"acquires it again at "
+                                        f"{tail.relpath}:{aline}")))
+                        continue
+                    for h in cs.held:
+                        if h != tok and (h, tok) not in edges:
+                            edges[(h, tok)] = (
+                                ff.relpath, cs.line, sym,
+                                (f"{project.pretty(key)} holds "
+                                 f"'{self._tok_str(h)}' and calls "
+                                 f"{project.chain_str(chain)}, which "
+                                 f"acquires '{self._tok_str(tok)}' at "
+                                 f"{tail.relpath}:{aline}",))
+        reported = set()
+        for a, b in sorted(edges):
+            if (b, a) not in edges or (b, a) in reported:
+                continue
+            reported.add((a, b))
+            rp, line, sym, why = edges[(a, b)]
+            rp2, line2, _sym2, why2 = edges[(b, a)]
+            out.append(Finding(
+                self.name, rp, line,
+                f"lock-order inversion: '{self._tok_str(a)}' is held "
+                f"while taking '{self._tok_str(b)}' here, but "
+                f"{rp2}:{line2} takes them in the OPPOSITE order — two "
+                f"threads on these paths deadlock; pick one global "
+                f"order", symbol=sym, reason=why + why2))
+        return out
 
 
-def _host_conditioned(test: ast.expr) -> Optional[str]:
-    for n in ast.walk(test):
-        if isinstance(n, ast.Name) and n.id in _HOST_TOKENS:
-            return n.id
-        if isinstance(n, ast.Attribute) and n.attr in _HOST_TOKENS:
-            return n.attr
-    return None
-
+# -- new rule 2: collective safety (interprocedural) ------------------------
 
 class CollectiveSafetyRule(Rule):
     """Collectives must be reached by EVERY host or by none: a call to
     ``allgather_*``/``allreduce_host``/``broadcast_host``/``barrier``
-    lexically nested under an ``if`` conditioned on the process index
-    (``rank``, ``process_index``, ``host_id``, ...) means some hosts
-    enter the collective and the rest never will — the whole fleet then
-    blocks until the DCN timeout.  This is the exact bug class the PR 4
-    checkpoint-boundary metric gather was designed around.  Hoist the
-    collective above the branch, or branch on fleet-uniform state only
-    (``is_initialized()``, ``num_workers``)."""
+    reached from a branch conditioned on the process index (``rank``,
+    ``process_index``, ``host_id``, ...) means some hosts enter the
+    collective and the rest never will — the whole fleet then blocks
+    until the DCN timeout.  This is the exact bug class the PR 4
+    checkpoint-boundary metric gather was designed around.
+
+    Interprocedural since PR 6: the collective no longer has to sit
+    *lexically* under the branch — a helper called under ``if rank ==
+    0:`` that (transitively, call-depth-bounded) reaches a collective is
+    flagged at the call site, with the call chain in the finding's
+    ``reason``.  Hoist the collective above the branch, or branch on
+    fleet-uniform state only (``is_initialized()``, ``num_workers``)."""
 
     name = "collective-safety"
-    description = "no collectives under host-divergent branches"
-    interests = (ast.Call,)
+    description = "no collectives (even via helpers) under host-divergent " \
+                  "branches"
+    interests = ()
 
-    def visit(self, node, ctx):
-        name = _call_name(node.func)
-        if name not in _COLLECTIVES:
-            return
-        for test in ctx.if_stack:
-            tok = _host_conditioned(test)
-            if tok is not None:
-                ctx.report(
-                    self, node.lineno,
+    def project_check(self, project):
+        from .core import Finding
+        out = []
+        flagged = set()                       # (relpath, line) dedup
+        for key in sorted(project.functions):
+            ff = project.functions[key]
+            sym = None if ff.qualname == "<module>" else ff.qualname
+            # direct: the collective itself sits under the branch
+            for name, line, tok in ff.collectives:
+                if tok is None or (ff.relpath, line) in flagged:
+                    continue
+                flagged.add((ff.relpath, line))
+                out.append(Finding(
+                    self.name, ff.relpath, line,
                     f"collective '{name}()' under a branch conditioned "
                     f"on host-divergent '{tok}': hosts taking the other "
                     f"arm never reach it and the fleet deadlocks — "
-                    f"hoist it out of the branch")
-                return
+                    f"hoist it out of the branch", symbol=sym))
+            # transitive: a call under the branch reaches a collective
+            for cs in ff.calls:
+                if cs.host_tok is None or (ff.relpath, cs.line) in flagged:
+                    continue
+                ck = project.resolve(ff, cs.desc)
+                if ck is None:
+                    continue
+                hit = project.find_collective(ck)
+                if hit is None:
+                    continue
+                chain, (cname, cline) = hit
+                tail = project.functions[chain[-1]]
+                flagged.add((ff.relpath, cs.line))
+                out.append(Finding(
+                    self.name, ff.relpath, cs.line,
+                    f"collective '{cname}()' is reached from this call "
+                    f"under a branch conditioned on host-divergent "
+                    f"'{cs.host_tok}' (via {project.chain_str(chain)}): "
+                    f"hosts taking the other arm never enter it and the "
+                    f"fleet deadlocks — hoist the call out of the branch "
+                    f"or make the branch fleet-uniform",
+                    symbol=sym,
+                    reason=(f"{project.pretty(key)} calls "
+                            f"{project.pretty(ck)} under a branch on "
+                            f"'{cs.host_tok}' "
+                            f"({ff.relpath}:{cs.line})",
+                            f"call chain: {project.chain_str(chain)}",
+                            f"{project.pretty(tail.key)} calls "
+                            f"'{cname}()' at {tail.relpath}:{cline}")))
+        return out
+
+
+# -- new rule 4 (PR 6): hot-path purity -------------------------------------
+
+class HotPathPurityRule(Rule):
+    """The per-op dispatch path (engine push, bulk-segment defer/flush —
+    functions marked ``@hot_path("dispatch")``) runs ~10^5 times per
+    second; PR-2 bought its 4.2x by keeping it to plain int adds and
+    dict hits.  Anything reachable from a dispatch root — helpers
+    included, which is why this rule is interprocedural — must not
+    allocate host arrays, read the environment, create locks, or log:
+    each of those is 1-50µs on a ~6µs path, and env reads/logging also
+    take process-wide locks.
+
+    Deliberate cold paths reached from hot roots (one-time singleton
+    init, per-signature compile misses) carry a pragma WITH a
+    justification; the finding's ``reason`` shows the call chain so the
+    reader can judge the claim."""
+
+    name = "hot-path-purity"
+    description = "no alloc/env-read/lock-creation/logging reachable " \
+                  "from @hot_path('dispatch') roots"
+    interests = ()
+    #: sanctioned accessors: ``_raw_env`` IS the memoized env fast path,
+    #: and ``get_env`` is the declared-knob reader — their internal
+    #: environ reads are their job; a HOT caller of either is still
+    #: flagged at its own call site (env-read event)
+    _SANCTIONED = frozenset((("mxnet_tpu/engine.py", "_raw_env"),
+                             ("mxnet_tpu/base.py", "get_env")))
+
+    def project_check(self, project):
+        from .core import Finding
+        out = []
+        roots = project.hot_roots(("dispatch",))
+        reach = project.reachable(roots)
+        for key in sorted(reach):
+            ff = project.functions[key]
+            if (ff.relpath, ff.qualname) in self._SANCTIONED:
+                continue
+            chain = reach[key]
+            sym = None if ff.qualname == "<module>" else ff.qualname
+            via = (f" via {project.chain_str(chain)}"
+                   if len(chain) > 1 else "")
+            for kind, line, what in ff.impure:
+                out.append(Finding(
+                    self.name, ff.relpath, line,
+                    f"{kind} ({what}) on the dispatch hot path — "
+                    f"reachable from @hot_path('dispatch') root "
+                    f"{project.pretty(chain[0])}{via}; hoist it off the "
+                    f"per-op path, or pragma with a justification if "
+                    f"this is a deliberate cold branch",
+                    symbol=sym,
+                    reason=(f"dispatch root: {project.pretty(chain[0])}",
+                            f"call chain: {project.chain_str(chain)}",
+                            f"{kind}: {what} at {ff.relpath}:{line}")))
+        return out
+
+
+# -- new rule 5 (PR 6): hidden host sync ------------------------------------
+
+class HiddenHostSyncRule(Rule):
+    """``.asnumpy()`` / ``.item()`` on an NDArray is a device→host round
+    trip: it blocks on the async engine, flushes any pending bulk
+    segment, and serializes dispatch against compute — the exact stall
+    PAPER.md's dependency engine exists to avoid.  Library code must
+    treat them as *boundaries*, never plumbing.
+
+    Two tiers:
+
+    - every ``.asnumpy()``/``.item()`` call site in the package is
+      flagged (deliberate export boundaries carry a justification
+      pragma; pre-existing debt is baseline-frozen file-by-file);
+    - inside code reachable from a ``@hot_path`` root (training step or
+      dispatch), the finding escalates and additionally covers value
+      casts of method-call results (``float(loss.sum())``) and numpy
+      coercion (``np.asarray(x)``) — the disguised syncs a reviewer
+      misses."""
+
+    name = "hidden-host-sync"
+    description = "no NDArray host syncs (.asnumpy/.item/casts) on or " \
+                  "near hot paths"
+    interests = ()
+
+    def project_check(self, project):
+        from .core import Finding
+        out = []
+        roots = project.hot_roots(("dispatch", "step"))
+        reach = project.reachable(roots)
+        for key in sorted(project.functions):
+            ff = project.functions[key]
+            sym = None if ff.qualname == "<module>" else ff.qualname
+            chain = reach.get(key)
+            for kind, line, what in ff.syncs:
+                if chain is not None:
+                    out.append(Finding(
+                        self.name, ff.relpath, line,
+                        f"host sync {what} on a hot path — reachable "
+                        f"from @hot_path root "
+                        f"{project.pretty(chain[0])}: every call is a "
+                        f"device round-trip that serializes the async "
+                        f"engine; keep the value on device, batch the "
+                        f"transfer, or pragma with a justification",
+                        symbol=sym,
+                        reason=(f"hot root: {project.pretty(chain[0])}",
+                                f"call chain: "
+                                f"{project.chain_str(chain)}",
+                                f"sync: {what} at {ff.relpath}:{line}")))
+                elif kind in ("asnumpy", "item"):
+                    out.append(Finding(
+                        self.name, ff.relpath, line,
+                        f"host sync {what}: device round-trip that "
+                        f"serializes the async engine — if this is a "
+                        f"deliberate data-export boundary, pragma it "
+                        f"with a justification; it must not creep onto "
+                        f"a hot path", symbol=sym))
+        return out
 
 
 # -- new rule 3: env-knob registry ------------------------------------------
@@ -630,6 +876,8 @@ def make_rules(repo_root: str) -> List[Rule]:
         TimingPairRule(),
         LockDisciplineRule(),
         CollectiveSafetyRule(),
+        HotPathPurityRule(),
+        HiddenHostSyncRule(),
         EnvKnobRule(repo_root),
     ]
 

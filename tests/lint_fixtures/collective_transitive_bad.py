@@ -1,0 +1,15 @@
+"""mxlint fixture: must trip collective-safety (and nothing else) —
+the collective hides INSIDE a helper; only the interprocedural pass
+can connect the rank-conditioned branch to it."""
+
+
+def _refresh_fleet_metrics(dist):
+    # looks innocent in isolation: unconditional collective
+    return dist.allgather_host([1])
+
+
+def checkpoint(dist, rank):
+    if rank == 0:
+        # peers never call the helper -> they never enter the gather
+        return _refresh_fleet_metrics(dist)
+    return None

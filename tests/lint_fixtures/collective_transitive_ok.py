@@ -1,0 +1,13 @@
+"""mxlint fixture: helper-wrapped collectives lint clean when every
+host reaches the call (fleet-uniform branch or no branch at all)."""
+
+
+def _refresh_fleet_metrics(dist):
+    return dist.allgather_host([1])
+
+
+def checkpoint(dist, num_workers):
+    if num_workers > 1:
+        # every host evaluates the same condition the same way
+        return _refresh_fleet_metrics(dist)
+    return None

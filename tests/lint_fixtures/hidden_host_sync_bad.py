@@ -1,0 +1,17 @@
+"""mxlint fixture: must trip hidden-host-sync (and nothing else) —
+the ``.asnumpy()`` hides in a logging helper called from the training
+step: every step pays a device round-trip nobody sees at the call
+site."""
+from mxnet_tpu.base import hot_path
+
+
+def _log_loss(history, loss):
+    history.append(loss.asnumpy())   # hidden device round-trip
+    return history
+
+
+@hot_path("step")
+def train_step(trainer, x, y, history):
+    loss = trainer.step(x, y)
+    _log_loss(history, loss)
+    return loss

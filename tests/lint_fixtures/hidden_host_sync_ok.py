@@ -1,0 +1,22 @@
+"""mxlint fixture: the clean shapes — the step keeps the loss on
+device (the caller decides when to pay the sync), and a deliberate
+export boundary carries a justification pragma."""
+from mxnet_tpu.base import hot_path
+
+
+def _log_loss(history, loss):
+    history.append(loss)             # device value: stays async
+    return history
+
+
+@hot_path("step")
+def train_step(trainer, x, y, history):
+    loss = trainer.step(x, y)
+    _log_loss(history, loss)
+    return loss
+
+
+def export_history(history):
+    # deliberate boundary: training is over, materialize for the report
+    # mxlint: disable=hidden-host-sync — post-training export boundary
+    return [v.asnumpy() for v in history]

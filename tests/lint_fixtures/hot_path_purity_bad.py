@@ -1,0 +1,20 @@
+"""mxlint fixture: must trip hot-path-purity (and nothing else) —
+the allocation hides in a helper two frames below the dispatch root;
+only the interprocedural pass connects them."""
+import numpy as np
+
+from mxnet_tpu.base import hot_path
+
+
+def _scratch_buffer(n):
+    return np.zeros((n,))         # host allocation
+
+
+def _prepare(n):
+    return _scratch_buffer(n)
+
+
+@hot_path("dispatch")
+def dispatch_one(x, n):
+    buf = _prepare(n)             # alloc reached from the hot root
+    return x, buf

@@ -1,0 +1,21 @@
+"""mxlint fixture: a pure dispatch path lints clean — buffers come in
+from the caller (allocated off the hot path), helpers only index and
+add."""
+import numpy as np
+
+from mxnet_tpu.base import hot_path
+
+
+def make_scratch(n):
+    # cold path: callers allocate ONCE, outside dispatch
+    return np.zeros((n,))
+
+
+def _accumulate(buf, x):
+    buf[0] += x
+    return buf
+
+
+@hot_path("dispatch")
+def dispatch_one(x, buf):
+    return x, _accumulate(buf, x)

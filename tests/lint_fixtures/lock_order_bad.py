@@ -1,0 +1,20 @@
+"""mxlint fixture: must trip lock-discipline (and nothing else) —
+a lock-order INVERSION across two methods: forward() takes A then B,
+backward() takes B then A.  Two threads on these paths deadlock."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._in_lock = threading.Lock()
+        self._out_lock = threading.Lock()
+
+    def forward(self, item):
+        with self._in_lock:
+            with self._out_lock:
+                return item
+
+    def backward(self, item):
+        with self._out_lock:
+            with self._in_lock:
+                return item

@@ -1,0 +1,19 @@
+"""mxlint fixture: nested locks lint clean when every path agrees on
+ONE global order (in before out, everywhere)."""
+import threading
+
+
+class Pipeline:
+    def __init__(self):
+        self._in_lock = threading.Lock()
+        self._out_lock = threading.Lock()
+
+    def forward(self, item):
+        with self._in_lock:
+            with self._out_lock:
+                return item
+
+    def backward(self, item):
+        with self._in_lock:
+            with self._out_lock:
+                return item

@@ -1,0 +1,18 @@
+"""mxlint fixture: must trip lock-discipline (and nothing else) —
+bump_twice() holds the non-reentrant Lock and calls a helper that
+takes the SAME lock again: threading.Lock self-deadlocks."""
+import threading
+
+
+class Box:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def _bump(self):
+        with self._lock:
+            self._n += 1
+
+    def bump_twice(self):
+        with self._lock:
+            self._bump()          # re-acquires self._lock: deadlock

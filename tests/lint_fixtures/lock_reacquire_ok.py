@@ -1,0 +1,31 @@
+"""mxlint fixture: the two sanctioned shapes lint clean — an RLock
+(re-entrant by contract), and the ``*_locked`` convention (the helper
+documents that callers hold the lock and takes nothing itself)."""
+import threading
+
+
+class ReentrantBox:
+    def __init__(self):
+        self._lock = threading.RLock()
+        self._n = 0
+
+    def _bump(self):
+        with self._lock:
+            self._n += 1
+
+    def bump_twice(self):
+        with self._lock:
+            self._bump()          # RLock: re-entry is the contract
+
+
+class ConventionBox:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._n = 0
+
+    def _bump_locked(self):
+        self._n += 1
+
+    def bump(self):
+        with self._lock:
+            self._bump_locked()   # helper relies on the caller's hold

@@ -1,6 +1,8 @@
 """mxlint: the consolidated static-analysis gate (tier-1) plus tests of
 the framework itself — fixtures per rule, pragma suppression, baseline
-freezing, knob-table/README sync, and the single-parse-pass guarantee.
+freezing, knob-table/README sync, the single-parse-pass guarantee, the
+PR-6 interprocedural engine (call graph, reason chains, hot-path
+roots), ``--fix`` round-trips, and the two-pass perf budget.
 
 The whole suite shares ONE memoized repo lint (``mxlint.check_repo``);
 the thin per-rule assertions that replaced the old copy-pasted AST
@@ -8,11 +10,14 @@ walkers in test_resilience / test_engine_bulk / test_observability
 reuse the same run."""
 import ast
 import os
+import time
 
 import pytest
 
 from mxnet_tpu.tools import mxlint
 from mxnet_tpu.tools.mxlint import core as mxcore
+from mxnet_tpu.tools.mxlint import fix as mxfix
+from mxnet_tpu.tools.mxlint import graph as mxgraph
 from mxnet_tpu.tools.mxlint import rules as mxrules
 
 REPO = mxlint.REPO_ROOT
@@ -25,7 +30,12 @@ RULE_FOR_FIXTURE = {
     "counter_dict": "counter-dict",
     "timing_pair": "timing-pair",
     "lock_discipline": "lock-discipline",
+    "lock_order": "lock-discipline",
+    "lock_reacquire": "lock-discipline",
     "collective_safety": "collective-safety",
+    "collective_transitive": "collective-safety",
+    "hot_path_purity": "hot-path-purity",
+    "hidden_host_sync": "hidden-host-sync",
     "env_knob": "env-knob",
 }
 
@@ -40,14 +50,15 @@ def _fixture(name: str) -> str:
 
 def test_package_tree_is_clean():
     """Tier-1 acceptance: ``python -m mxnet_tpu.tools.mxlint`` exits 0
-    on this tree — zero new findings across all seven rules."""
+    on this tree — zero new findings across all nine rules."""
     new, _baselined = mxlint.check_repo()
     assert new == [], "new mxlint findings:\n" + \
         "\n".join(repr(f) for f in new)
 
 
-def test_all_seven_rules_registered():
+def test_all_nine_rules_registered():
     assert set(mxlint.ALL_RULES) == set(RULE_FOR_FIXTURE.values())
+    assert len(mxlint.ALL_RULES) == 9
 
 
 # -- per-rule fixtures: positive must trip, negative must pass --------------
@@ -153,10 +164,38 @@ def test_pragma_wrong_rule_does_not_suppress():
 # The debt frozen by THIS PR.  Do not add entries: new code satisfies
 # the rule or carries a justified pragma; this set only ever SHRINKS
 # (delete an entry when its file's debt is paid).
+#
+# PR-6 grew it deliberately ONCE: introducing hidden-host-sync flagged
+# every library `.asnumpy()`/`.item()` call site (~75).  The hot-path
+# files (engine, register, resilience, trainer) plus the core API files
+# (ndarray, flight, optimizer) were triaged to fixes/justified pragmas
+# — they are NOT here, so new debt in them always fails — and the cold
+# long tail (image augmenters, test utils, contrib, legacy kvstore/io)
+# was frozen file-by-file below.
 _FROZEN_BASELINE = {
     ("timing-pair", "mxnet_tpu/callback.py"),
     ("timing-pair", "mxnet_tpu/gluon/contrib/estimator.py"),
     ("timing-pair", "mxnet_tpu/module/base_module.py"),
+    ("hidden-host-sync", "mxnet_tpu/contrib/onnx/export.py"),
+    ("hidden-host-sync", "mxnet_tpu/contrib/quantization.py"),
+    ("hidden-host-sync", "mxnet_tpu/contrib/text/embedding.py"),
+    ("hidden-host-sync", "mxnet_tpu/gluon/data/dataloader.py"),
+    ("hidden-host-sync", "mxnet_tpu/gluon/data/vision/transforms.py"),
+    ("hidden-host-sync", "mxnet_tpu/gluon/model_zoo/transformer.py"),
+    ("hidden-host-sync", "mxnet_tpu/gluon/utils.py"),
+    ("hidden-host-sync", "mxnet_tpu/image.py"),
+    ("hidden-host-sync", "mxnet_tpu/io.py"),
+    ("hidden-host-sync", "mxnet_tpu/kvstore.py"),
+    ("hidden-host-sync", "mxnet_tpu/metric.py"),
+    ("hidden-host-sync", "mxnet_tpu/model.py"),
+    ("hidden-host-sync", "mxnet_tpu/ndarray/contrib.py"),
+    ("hidden-host-sync", "mxnet_tpu/ndarray/dgl.py"),
+    ("hidden-host-sync", "mxnet_tpu/ndarray/ops_custom.py"),
+    ("hidden-host-sync", "mxnet_tpu/ndarray/utils.py"),
+    ("hidden-host-sync", "mxnet_tpu/numpy/__init__.py"),
+    ("hidden-host-sync", "mxnet_tpu/rnn/rnn_cell.py"),
+    ("hidden-host-sync", "mxnet_tpu/sparse.py"),
+    ("hidden-host-sync", "mxnet_tpu/test_utils.py"),
 }
 
 
@@ -226,6 +265,9 @@ def test_changed_mode_lists_python_files_only():
     files = mxlint._changed_files()
     assert isinstance(files, list)
     assert all(f.endswith(".py") for f in files)
+    # fixture vectors trip their rules BY DESIGN; --changed (and the
+    # precommit hook built on it) must never lint them
+    assert not any("lint_fixtures" in f for f in files)
 
 
 # -- rule-specific unit coverage beyond the fixtures ------------------------
@@ -344,6 +386,427 @@ def test_lock_discipline_ignores_unguarded_only_attributes():
            "        self._free += 1\n")
     new, _sup = mxlint.lint_source(src)
     assert new == []
+
+
+# -- PR-6: interprocedural engine -------------------------------------------
+
+def _project(*files):
+    """Build a Project from (relpath, source) pairs — the multi-file
+    unit-test entry the fixtures (single-file) can't exercise."""
+    return mxgraph.build_project(
+        [(rp, ast.parse(src)) for rp, src in files])
+
+
+def test_call_graph_resolves_self_methods():
+    p = _project(("pkg/a.py",
+                  "class C:\n"
+                  "    def top(self):\n"
+                  "        return self.helper()\n"
+                  "    def helper(self):\n"
+                  "        return 1\n"))
+    ff = p.functions["pkg/a.py::C.top"]
+    edges = [p.resolve(ff, cs.desc) for cs in ff.calls]
+    assert "pkg/a.py::C.helper" in edges
+
+
+def test_call_graph_resolves_alias_imports_across_files():
+    p = _project(
+        ("pkg/util.py", "def work():\n    return 1\n"),
+        ("pkg/main.py",
+         "from pkg.util import work as w\n"
+         "def run():\n    return w()\n"))
+    ff = p.functions["pkg/main.py::run"]
+    assert [p.resolve(ff, cs.desc) for cs in ff.calls] == \
+        ["pkg/util.py::work"]
+
+
+def test_call_graph_resolves_module_attr_calls():
+    p = _project(
+        ("pkg/__init__.py", ""),
+        ("pkg/dist.py", "def barrier_all():\n    return 0\n"),
+        ("pkg/train.py",
+         "from pkg import dist\n"
+         "def sync():\n    return dist.barrier_all()\n"))
+    ff = p.functions["pkg/train.py::sync"]
+    assert [p.resolve(ff, cs.desc) for cs in ff.calls] == \
+        ["pkg/dist.py::barrier_all"]
+
+
+def test_call_graph_resolves_relative_imports():
+    p = _project(
+        ("pkg/sub/helper.py", "def f():\n    return 1\n"),
+        ("pkg/sub/user.py",
+         "from .helper import f\n"
+         "def g():\n    return f()\n"))
+    ff = p.functions["pkg/sub/user.py::g"]
+    assert [p.resolve(ff, cs.desc) for cs in ff.calls] == \
+        ["pkg/sub/helper.py::f"]
+
+
+def test_call_graph_cycle_is_safe():
+    p = _project(("pkg/a.py",
+                  "def f():\n    return g()\n"
+                  "def g():\n    return f()\n"))
+    # both searches must terminate on the f <-> g cycle
+    assert p.find_collective("pkg/a.py::f") is None
+    reach = p.reachable(["pkg/a.py::f"])
+    assert set(reach) == {"pkg/a.py::f", "pkg/a.py::g"}
+
+
+def test_call_depth_bound_cuts_deep_chains():
+    # f0 -> f1 -> ... -> f9 -> barrier(); the default bound must stop
+    # well before depth 9, so the deep collective stays invisible
+    lines = ["def f9(d):\n    return d.barrier()\n"]
+    for i in range(8, -1, -1):
+        lines.append(f"def f{i}(d):\n    return f{i + 1}(d)\n")
+    p = _project(("pkg/deep.py", "".join(lines)))
+    assert p.find_collective("pkg/deep.py::f9") is not None
+    assert p.find_collective("pkg/deep.py::f0") is None
+
+
+def test_cross_file_transitive_collective_is_flagged():
+    """The repo-wide blind spot PR-5 had: branch in one FILE, collective
+    wrapper in another."""
+    src_a = ("def refresh(dist):\n"
+             "    return dist.allgather_host([1])\n")
+    src_b = ("from pkg.metrics import refresh\n"
+             "def checkpoint(dist, rank):\n"
+             "    if rank == 0:\n"
+             "        refresh(dist)\n")
+    p = _project(("pkg/metrics.py", src_a), ("pkg/train.py", src_b))
+    rule = next(r for r in mxrules.make_rules(REPO)
+                if r.name == "collective-safety")
+    findings = rule.project_check(p)
+    assert [f.path for f in findings] == ["pkg/train.py"]
+    assert findings[0].reason and \
+        "pkg/metrics.py::refresh" in " ".join(findings[0].reason)
+
+
+def test_finding_reason_chain_and_stable_id():
+    new, _sup = mxlint.lint_source(
+        _fixture("hidden_host_sync_bad.py"),
+        relpath="tests/lint_fixtures/hidden_host_sync_bad.py")
+    f = new[0]
+    assert f.reason, "escalated finding must carry its call chain"
+    assert any("train_step" in r for r in f.reason)
+    assert f.id == ("hidden-host-sync:tests/lint_fixtures/"
+                    "hidden_host_sync_bad.py:_log_loss")
+    d = f.as_dict()
+    assert d["id"] == f.id and d["symbol"] == "_log_loss" and d["reason"]
+
+
+def test_lock_discipline_recognizes_acquire_release_regions():
+    # the PR-5 follow-up: an explicit pair (incl. try/finally) is a held
+    # region — the write below is GUARDED, not a violation
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_state = {}\n"
+           "def get(k):\n"
+           "    with _lock:\n"
+           "        return _state.get(k)\n"
+           "def put(k, v):\n"
+           "    _lock.acquire()\n"
+           "    try:\n"
+           "        _state[k] = v\n"
+           "    finally:\n"
+           "        _lock.release()\n")
+    new, _sup = mxlint.lint_source(src)
+    assert new == [], new
+
+
+def test_lock_order_inversion_across_methods():
+    new, _sup = mxlint.lint_source(
+        _fixture("lock_order_bad.py"),
+        relpath="tests/lint_fixtures/lock_order_bad.py")
+    assert len(new) == 1 and "inversion" in new[0].message
+    assert len(new[0].reason) == 2      # one entry per conflicting order
+
+
+def test_call_graph_reexport_cycle_dead_ends():
+    # `from b import f` / `from a import f` re-export cycle: resolution
+    # must dead-end (depth bound), not recurse to a crash
+    p = _project(
+        ("pkg/a.py", "from pkg.b import f\ndef call():\n    return f()\n"),
+        ("pkg/b.py", "from pkg.a import f\n"))
+    ff = p.functions["pkg/a.py::call"]
+    assert p.resolve(ff, ff.calls[0].desc) is None
+
+
+def test_nested_class_methods_do_not_pollute_outer_class():
+    p = _project(("pkg/a.py",
+                  "class Outer:\n"
+                  "    class Inner:\n"
+                  "        def meth(self):\n            return 1\n"
+                  "    def top(self):\n"
+                  "        return self.meth()\n"))
+    ff = p.functions["pkg/a.py::Outer.top"]
+    assert p.resolve(ff, ff.calls[0].desc) is None   # no invented edge
+    # ...while the inner class still resolves its own methods
+    p2 = _project(("pkg/b.py",
+                   "class Outer:\n"
+                   "    class Inner:\n"
+                   "        def a(self):\n            return self.b()\n"
+                   "        def b(self):\n            return 2\n"))
+    ffa = p2.functions["pkg/b.py::Outer.Inner.a"]
+    assert p2.resolve(ffa, ffa.calls[0].desc) == "pkg/b.py::Outer.Inner.b"
+
+
+def test_branch_local_acquire_does_not_leak_to_other_path():
+    # acquire() in one if-arm must not look held in the mutually
+    # exclusive path — that would invent a re-acquire deadlock finding
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self, persist):\n"
+           "        if persist:\n"
+           "            self._lock.acquire()\n"
+           "            return\n"
+           "        with self._lock:\n"
+           "            pass\n")
+    new, _sup = mxlint.lint_source(src)
+    assert not any("re-acquires" in f.message for f in new), new
+
+
+def test_function_local_locks_do_not_alias_across_functions():
+    # two functions each with their OWN local a/b locks in opposite
+    # nesting order: distinct objects, no deadlock, no finding —
+    # module-LEVEL locks in opposite orders must still be flagged
+    local = ("import threading\n"
+             "def f():\n"
+             "    a_lock = threading.Lock(); b_lock = threading.Lock()\n"
+             "    with a_lock:\n        with b_lock:\n            pass\n"
+             "def g():\n"
+             "    a_lock = threading.Lock(); b_lock = threading.Lock()\n"
+             "    with b_lock:\n        with a_lock:\n            pass\n")
+    new, _sup = mxlint.lint_source(local)
+    assert not any("inversion" in f.message for f in new), new
+    glob = ("import threading\n"
+            "_a_lock = threading.Lock()\n_b_lock = threading.Lock()\n"
+            "def f():\n"
+            "    with _a_lock:\n        with _b_lock:\n            pass\n"
+            "def g():\n"
+            "    with _b_lock:\n        with _a_lock:\n            pass\n")
+    new, _sup = mxlint.lint_source(glob)
+    assert any("inversion" in f.message for f in new), new
+
+
+def test_fix_refuses_raise_in_lock_region():
+    # a raise between the pair leaves the lock HELD in the original;
+    # `with` would release it — behavior change, fixer must refuse
+    declared = mxrules.declared_knobs(REPO)
+    src = ("import threading\n_lock = threading.Lock()\n"
+           "def f(x):\n"
+           "    _lock.acquire()\n"
+           "    if x < 0:\n        raise ValueError(x)\n"
+           "    _lock.release()\n")
+    fixed, fixes = mxfix.fix_source(src, "mxnet_tpu/demo.py", declared)
+    assert fixed == src and fixes == []
+
+
+def test_lock_reacquire_within_one_function():
+    src = ("import threading\n"
+           "class C:\n"
+           "    def __init__(self):\n"
+           "        self._lock = threading.Lock()\n"
+           "    def f(self):\n"
+           "        with self._lock:\n"
+           "            with self._lock:\n"
+           "                return 1\n")
+    new, _sup = mxlint.lint_source(src)
+    assert any("re-acquires" in f.message for f in new), new
+
+
+def test_collective_safety_transitive_from_elif_branch():
+    src = ("def inner(dist):\n    return dist.barrier()\n"
+           "def go(dist, rank, mode):\n"
+           "    if mode == 'a':\n        pass\n"
+           "    elif rank == 0:\n"
+           "        inner(dist)\n")
+    new, _sup = mxlint.lint_source(src)
+    assert [f.rule for f in new] == ["collective-safety"]
+    assert new[0].line == 7
+
+
+def test_hot_path_marker_is_runtime_noop():
+    from mxnet_tpu.base import hot_path
+
+    @hot_path("dispatch")
+    def f(x):
+        return x + 1
+
+    assert f(1) == 2 and f.__mxlint_hot_path__ == "dispatch"
+    with pytest.raises(ValueError):
+        hot_path("bogus")
+
+
+def test_repo_hot_roots_are_declared():
+    """The rules are only as good as their roots: the engine dispatch
+    path and both trainer steps must be marked."""
+    new, baselined = mxlint.check_repo()
+    del new, baselined                  # ensure the cached run exists
+    items = []
+    for path in mxlint.iter_py_files([mxlint.DEFAULT_TARGET]):
+        rel = os.path.relpath(path, REPO).replace(os.sep, "/")
+        if rel in ("mxnet_tpu/engine.py", "mxnet_tpu/ndarray/register.py",
+                   "mxnet_tpu/parallel/trainer.py",
+                   "mxnet_tpu/parallel/resilience.py"):
+            with open(path, encoding="utf-8") as f:
+                items.append((rel, ast.parse(f.read())))
+    p = mxgraph.build_project(items)
+    roots = set(p.hot_roots(("dispatch", "step")))
+    assert "mxnet_tpu/engine.py::Engine.on_push" in roots
+    assert "mxnet_tpu/ndarray/register.py::_try_defer" in roots
+    assert "mxnet_tpu/parallel/trainer.py::ShardedTrainer.step" in roots
+    assert "mxnet_tpu/parallel/resilience.py::ResilientTrainer.step" \
+        in roots
+
+
+def test_two_pass_full_repo_under_three_seconds():
+    """Perf gate: the whole two-pass analysis (parse + facts + walk +
+    interprocedural phase, all nine rules) stays under ~3s so the lint
+    keeps earning its place in tier-1."""
+    # mxlint: disable=timing-pair — this test measures the lint itself
+    t0 = time.perf_counter()
+    findings, _sup = mxlint.lint_paths([mxlint.DEFAULT_TARGET])
+    elapsed = time.perf_counter() - t0
+    assert elapsed < 3.0, f"two-pass repo lint took {elapsed:.2f}s"
+    assert findings  # sanity: the run actually analyzed the tree
+
+
+# -- PR-6: --fix ------------------------------------------------------------
+
+_FIXABLE = ('"""doc."""\n'
+            "import os\n"
+            "import threading\n"
+            "_lock = threading.Lock()\n"
+            "_state = {}\n"
+            "def knob():\n"
+            '    return os.environ.get("MXNET_ENGINE_BULK_SIZE", "15")\n'
+            "def put(k, v):\n"
+            "    _lock.acquire()\n"
+            "    _state[k] = v\n"
+            "    _lock.release()\n")
+
+
+def test_fix_rewrites_env_read_and_lock_pair():
+    declared = mxrules.declared_knobs(REPO)
+    fixed, fixes = mxfix.fix_source(_FIXABLE, "mxnet_tpu/demo.py",
+                                    declared)
+    kinds = sorted({f.kind for f in fixes})
+    assert kinds == ["env-read", "with-lock"]
+    assert 'get_env("MXNET_ENGINE_BULK_SIZE")' in fixed
+    assert "from .base import get_env" in fixed
+    assert "with _lock:" in fixed and ".acquire()" not in fixed
+    ast.parse(fixed)                    # the rewrite is valid python
+
+
+def test_fix_is_idempotent_and_validated_by_relint():
+    declared = mxrules.declared_knobs(REPO)
+    fixed, _ = mxfix.fix_source(_FIXABLE, "mxnet_tpu/demo.py", declared)
+    again, fixes2 = mxfix.fix_source(fixed, "mxnet_tpu/demo.py",
+                                     declared)
+    assert again == fixed and fixes2 == []
+    # the fixed tree lints clean where the original tripped env-knob
+    new_before, _ = mxlint.lint_source(_FIXABLE,
+                                       relpath="mxnet_tpu/demo.py")
+    new_after, _ = mxlint.lint_source(fixed, relpath="mxnet_tpu/demo.py")
+    assert any(f.rule == "env-knob" for f in new_before)
+    assert not any(f.rule == "env-knob" for f in new_after)
+
+
+def test_fix_leaves_unsafe_pairs_alone():
+    # early return between the pair: the lock LEAKS there — a rewrite
+    # to `with` would change behavior, so the fixer must refuse
+    # (register.py's release/re-acquire dance hits the same guard)
+    src = ("import threading\n"
+           "_lock = threading.Lock()\n"
+           "_state = {}\n"
+           "def leaky(k):\n"
+           "    _lock.acquire()\n"
+           "    if k in _state:\n"
+           "        return _state[k]\n"
+           "    _lock.release()\n")
+    declared = mxrules.declared_knobs(REPO)
+    fixed, fixes = mxfix.fix_source(src, "mxnet_tpu/demo.py", declared)
+    assert fixed == src and fixes == []
+
+
+def test_fix_handles_nested_same_line_env_reads():
+    # a declared-knob read as another's default arg: the OUTER span is
+    # rewritten in one shot; rewriting inner-first would shift the line
+    # and make the outer span eat trailing code
+    declared = mxrules.declared_knobs(REPO)
+    src = ('import os\n'
+           'v = os.environ.get("MXNET_ENGINE_BULK_SIZE", '
+           'os.environ.get("MXNET_ENGINE_TYPE")) or "x"\n')
+    fixed, _fixes = mxfix.fix_source(src, "mxnet_tpu/demo.py", declared)
+    assert 'or "x"' in fixed and fixed.count("get_env(") == 1
+    ast.parse(fixed)
+
+
+def test_fix_refuses_multiline_strings_in_lock_region():
+    # raw-line re-indent inside a triple-quoted literal would change the
+    # string's VALUE — the fixer must refuse
+    declared = mxrules.declared_knobs(REPO)
+    src = ('import threading\n'
+           '_lock = threading.Lock()\n'
+           'def f():\n'
+           '    _lock.acquire()\n'
+           '    msg = """a\nb"""\n'
+           '    _lock.release()\n'
+           '    return msg\n')
+    fixed, fixes = mxfix.fix_source(src, "mxnet_tpu/demo.py", declared)
+    assert fixed == src and fixes == []
+
+
+def test_fix_honors_disable_pragmas():
+    # a site the author pragma'd as intentionally raw must not be
+    # rewritten (and must not wedge the --fix --dry-run precommit gate)
+    declared = mxrules.declared_knobs(REPO)
+    src = ('import os\n'
+           '# mxlint: disable=env-knob — need the raw string\n'
+           'v = os.environ.get("MXNET_ENGINE_TYPE")\n'
+           'import threading\n'
+           '_lock = threading.Lock()\n'
+           'def g(d, k, v2):\n'
+           '    # mxlint: disable=lock-discipline — measured pair\n'
+           '    _lock.acquire()\n'
+           '    d[k] = v2\n'
+           '    _lock.release()\n')
+    fixed, fixes = mxfix.fix_source(src, "mxnet_tpu/demo.py", declared)
+    assert fixed == src and fixes == []
+
+
+def test_fix_json_stdout_stays_parseable(tmp_path, capsys):
+    import json as _json
+    p = tmp_path / "demo.py"
+    p.write_text(_FIXABLE, encoding="utf-8")
+    rc = mxlint.main(["--json", "--fix", str(p)])
+    del rc
+    out = capsys.readouterr().out
+    _json.loads(out)                    # one clean JSON document
+
+
+def test_fix_dry_run_cli_reports_without_writing(tmp_path, capsys):
+    p = tmp_path / "demo.py"
+    p.write_text(_FIXABLE, encoding="utf-8")
+    rc = mxlint.main(["--fix", "--dry-run", str(p)])
+    out = capsys.readouterr().out
+    assert rc == 1 and "fix" in out and "---" not in p.read_text() \
+        and p.read_text() == _FIXABLE       # nothing written
+    rc = mxlint.main(["--fix", str(p)])
+    capsys.readouterr()
+    assert p.read_text() != _FIXABLE        # now it wrote
+    rc = mxlint.main(["--fix", "--dry-run", str(p)])
+    capsys.readouterr()
+    assert rc == 0                          # idempotent: nothing pending
+
+
+def test_shipped_tree_has_no_pending_fixes(capsys):
+    rc = mxlint.main(["--fix", "--dry-run"])
+    capsys.readouterr()
+    assert rc == 0
 
 
 # -- env-knob table / README sync -------------------------------------------

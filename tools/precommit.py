@@ -1,0 +1,38 @@
+#!/usr/bin/env python3
+"""Repo precommit gate: mxlint over the files this commit touches.
+
+Runs ``mxlint --changed --fix --dry-run`` — lints only git-touched
+``.py`` files against the frozen baseline, and shows (without applying)
+any pending mechanical fixes.  Exit nonzero blocks the commit when
+there are NEW findings or pending fixes; run
+
+    python -m mxnet_tpu.tools.mxlint --changed --fix
+
+to apply the fixes, then re-stage.
+
+Install as a git hook (one line)::
+
+    printf '#!/bin/sh\\nexec python tools/precommit.py\\n' \\
+        > .git/hooks/pre-commit && chmod +x .git/hooks/pre-commit
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from mxnet_tpu.tools import mxlint  # noqa: E402
+
+
+def main() -> int:
+    rc = mxlint.main(["--changed", "--fix", "--dry-run"])
+    if rc != 0:
+        print("precommit: mxlint gate failed — fix the findings above "
+              "(or apply pending rewrites with "
+              "`python -m mxnet_tpu.tools.mxlint --changed --fix`)",
+              file=sys.stderr)
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())
