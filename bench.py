@@ -577,6 +577,187 @@ def bench_pipeline(n_images=1024, batch=128, threads=None,
     return row
 
 
+def _offered_load(server, gen_sample, offered_qps, duration_s):
+    """Fire requests at a fixed offered rate (open-loop client with
+    catch-up arithmetic — the honest overload model: arrivals do NOT
+    slow down because the server is behind), then wait for completions
+    and report the latency distribution and achieved goodput."""
+    from mxnet_tpu.serving import ServingError
+
+    t_start = time.monotonic()
+    t_end = t_start + duration_s
+    futs, rejected, offered = [], 0, 0
+    while True:
+        now = time.monotonic()
+        if now >= t_end:
+            break
+        due = int((now - t_start) * offered_qps) - offered
+        for _ in range(due):
+            offered += 1
+            try:
+                futs.append(server.submit(*gen_sample()))
+            except ServingError:
+                rejected += 1
+        time.sleep(0.002)
+    lats = []
+    for f in futs:
+        try:
+            f.result(timeout=60)
+            lats.append((f.t_done - f.t_enqueue) * 1e3)
+        except Exception:  # noqa: BLE001 — deadline/shed rejections
+            rejected += 1
+    lats.sort()
+    completed = len(lats)
+
+    def pct(q):
+        if not lats:
+            return 0.0
+        return round(lats[min(completed - 1,
+                              int(q / 100.0 * completed))], 2)
+
+    return {"offered": offered, "completed": completed,
+            "rejected": rejected,
+            "achieved_qps": round(completed / duration_s, 1),
+            "p50_ms": pct(50), "p99_ms": pct(99)}
+
+
+def _max_sustainable(server, gen_sample, trial_s=1.2,
+                     p50_budget_ms=250.0):
+    """Geometric ramp search for the highest offered rate the server
+    sustains (>=95% goodput — i.e. the bounded admission queue did not
+    overflow into 429s — and MEDIAN latency within budget; the median,
+    not p99, keeps one scheduler stall on a noisy shared host from
+    reading as a capacity cliff).  Each trial drains fully before the
+    next, so backlog never bleeds across rates."""
+    rate, best_rate, best_row, retried = 25.0, 0.0, None, False
+    while rate < 50000:
+        row = _offered_load(server, gen_sample, rate, trial_s)
+        if row["completed"] < 0.95 * row["offered"] or \
+                row["p50_ms"] > p50_budget_ms:
+            # one retry per rate: a single scheduler stall on a shared
+            # host must not read as the capacity cliff
+            if retried:
+                break
+            retried = True
+            continue
+        retried = False
+        best_rate, best_row = rate, row
+        rate *= 1.7
+    return best_rate, best_row
+
+
+def _serving_pair(make_server, gen_sample, warm_samples, duration_s):
+    """The acceptance comparison, twice over:
+
+    1. **max sustainable QPS** — geometric ramp per mode: the highest
+       offered rate each sustains at >=95% goodput with bounded p99;
+    2. **fixed offered load** — BOTH modes at 1.5x the serial ceiling
+       (overload for serial, headroom for batching): p50/p99, goodput,
+       and 429s, plus the batch-formation efficiency.
+    """
+    serial = make_server(1, 1)
+    serial.warmup(*warm_samples)
+    serial.start()
+    serial.infer(*gen_sample(), timeout=60)      # settle the path
+    serial_max, _ = _max_sustainable(serial, gen_sample)
+    offered_qps = max(40.0, 1.5 * serial_max)
+    serial_row = _offered_load(serial, gen_sample, offered_qps,
+                               duration_s)
+    serial.stop()
+
+    batched = make_server(None, None)        # knob/default batch+workers
+    batched.warmup(*warm_samples)
+    batched.start()
+    batched.infer(*gen_sample(), timeout=60)
+    batched_max, _ = _max_sustainable(batched, gen_sample)
+    t0r, t0p = batched._c_real.n, batched._c_padded.n
+    batched_row = _offered_load(batched, gen_sample, offered_qps,
+                                duration_s)
+    real = batched._c_real.n - t0r
+    padded = batched._c_padded.n - t0p
+    batched_row["batch_efficiency"] = round(real / padded, 3) if padded \
+        else 0.0
+    batched.stop()
+
+    qps_win = round(batched_max / max(serial_max, 0.1), 2)
+    p99_win = round(serial_row["p99_ms"] /
+                    max(batched_row["p99_ms"], 1e-3), 2)
+    return {"offered_qps": round(offered_qps, 1),
+            "max_sustainable_qps_serial": round(serial_max, 1),
+            "max_sustainable_qps_batched": round(batched_max, 1),
+            "batched": batched_row, "serial": serial_row,
+            "qps_win": qps_win, "p99_win": p99_win,
+            "dynamic_batching_wins": bool(qps_win > 1.0 or p99_win > 1.0)}
+
+
+def bench_serving(duration_s=3.0):
+    """Serving row: continuous-batching ModelServer vs batch-size-1
+    serial dispatch at the SAME offered load, on the MNIST-MLP (fixed
+    shape, batch buckets only) and a BERT encoder (padding-length
+    buckets — bert_small on the CPU CI host, bert_base on a real chip).
+    Reports p50/p99 latency, achieved QPS, rejects, and the
+    batch-formation efficiency (real/padded elements)."""
+    import jax
+
+    import mxnet_tpu as mx  # noqa: F401 — backend/session init
+    from mxnet_tpu import gluon
+    from mxnet_tpu.serving import ModelServer
+
+    rng = np.random.default_rng(0)
+    rows = {}
+
+    # --- MNIST-MLP: the dispatch-overhead workload -----------------------
+    net = gluon.nn.HybridSequential()
+    with net.name_scope():
+        net.add(gluon.nn.Dense(128, activation="relu"),
+                gluon.nn.Dense(64, activation="relu"),
+                gluon.nn.Dense(10))
+    net.initialize()
+    net.hybridize()
+
+    def mlp_sample():
+        return (rng.standard_normal((784,)).astype(np.float32),)
+
+    def mlp_server(max_batch, workers):
+        return ModelServer(
+            net, max_batch=max_batch or 16, workers=workers or 2,
+            queue_depth=64, deadline_ms=0, batch_window_us=2000)
+
+    rows["mnist_mlp"] = _serving_pair(mlp_server, mlp_sample,
+                                      [mlp_sample()], duration_s)
+
+    # --- BERT: the padding-length-bucketed workload ----------------------
+    small = jax.default_backend() == "cpu"
+    from mxnet_tpu.gluon.model_zoo.transformer import bert_base, bert_small
+    bert = bert_small(dropout=0.0) if small else bert_base(dropout=0.0)
+    bert.initialize()
+    bert.hybridize()
+    lengths = (32, 64, 128)
+    vocab = 1000 if small else 30522
+
+    def bert_sample():
+        n = int(rng.integers(16, 129))
+        toks = rng.integers(0, vocab, (n,)).astype(np.int32)
+        segs = np.zeros((n,), np.int32)
+        return toks, segs
+
+    def bert_server(max_batch, workers):
+        return ModelServer(
+            bert, max_batch=max_batch or 8, workers=workers or 2,
+            batch_buckets=None if max_batch == 1 else (1, 8),
+            length_buckets=lengths, queue_depth=64, deadline_ms=0,
+            batch_window_us=3000)
+
+    warm = [(np.zeros((n,), np.int32), np.zeros((n,), np.int32))
+            for n in lengths]
+    rows["bert_small" if small else "bert_base"] = _serving_pair(
+        bert_server, bert_sample, warm, duration_s)
+
+    rows["requests_per_sec"] = \
+        rows["mnist_mlp"]["batched"]["achieved_qps"]
+    return rows
+
+
 PROBE_TIMEOUT_S = 2700
 
 
@@ -620,7 +801,8 @@ def main():
     ap.add_argument("--only", choices=["resnet_bf16", "resnet_fp32",
                                        "mnist_mlp", "eager_dispatch",
                                        "bert", "bert_bf16",
-                                       "nmt", "ssd", "pipeline"],
+                                       "nmt", "ssd", "pipeline",
+                                       "serving"],
                     help="run a single row (default: the full suite)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
@@ -706,6 +888,8 @@ def main():
             **_small(iters=2, warmup=1, batch=2))
     elif args.only == "pipeline":
         rows["input_pipeline"] = bench_pipeline()
+    elif args.only == "serving":
+        rows["serving"] = bench_serving()
     elif args.only in ("resnet_bf16", "resnet_fp32") or args.dtype:
         dt = args.dtype or ("bfloat16" if args.only == "resnet_bf16"
                             else "float32")
@@ -830,6 +1014,7 @@ def main():
         sub_row("nmt", ["nmt_transformer"], row_budget)
         sub_row("ssd", ["ssd_detection"], row_budget)
         sub_row("pipeline", ["input_pipeline"], 900)
+        sub_row("serving", ["serving"], 900)
 
     # per-row headline field + unit, so --only rows are labeled honestly
     HEADLINE = {
@@ -844,6 +1029,7 @@ def main():
         "nmt_transformer": ("tokens_per_sec", "tokens/sec"),
         "ssd_detection": ("images_per_sec", "images/sec"),
         "input_pipeline": ("images_per_sec", "images/sec"),
+        "serving": ("requests_per_sec", "req/s"),
     }
     ok = {k: v for k, v in rows.items() if "error" not in v}
     if "resnet50_bf16" in ok:

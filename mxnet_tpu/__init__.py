@@ -74,6 +74,7 @@ ndarray.Custom = operator.Custom     # reference surface: mx.nd.Custom
 from . import rtc
 from . import test_utils
 from . import observability
+from . import serving
 # opt-in exporters: a Prometheus /metrics endpoint when
 # MXTPU_METRICS_PORT is set, a periodic JSONL snapshot writer when
 # MXTPU_METRICS_JSONL is set; no cost (export never even imports)

@@ -242,6 +242,22 @@ register_env("MXTPU_FLIGHT_STEPS", 256, int,
 register_env("MXTPU_FLIGHT_PATH", "", str,
              "Crash flight-recorder dump file "
              "(default <tmpdir>/mxtpu_flight_<pid>.json).")
+register_env("MXTPU_SERVING_MAX_BATCH", 8, int,
+             "Serving: max requests fused into one batched CachedOp "
+             "call; batch buckets are powers of two up to this.")
+register_env("MXTPU_SERVING_QUEUE_DEPTH", 256, int,
+             "Serving: admission-queue bound; submits beyond it are "
+             "rejected with ServerOverloaded (the HTTP-429 analog).")
+register_env("MXTPU_SERVING_DEADLINE_MS", 100.0, float,
+             "Serving: default per-request deadline; requests still "
+             "queued when it expires are rejected at batch assembly "
+             "(429-style). 0 disables.")
+register_env("MXTPU_SERVING_WORKERS", 2, int,
+             "Serving: dispatch worker threads; >1 lets batch "
+             "formation overlap device execution.")
+register_env("MXTPU_SERVING_BATCH_WINDOW_US", 2000.0, float,
+             "Serving: how long the batcher waits for the current "
+             "shape bucket to fill before dispatching a partial batch.")
 
 
 # ---------------------------------------------------------------------------

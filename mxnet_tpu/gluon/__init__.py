@@ -1,7 +1,7 @@
 """Gluon: the imperative/hybrid frontend (reference: python/mxnet/gluon/)."""
 from .parameter import Parameter, Constant, ParameterDict, \
     DeferredInitializationError
-from .block import Block, HybridBlock, SymbolBlock
+from .block import Block, CachedGraph, HybridBlock, SymbolBlock
 from .trainer import Trainer
 from . import nn
 from . import loss

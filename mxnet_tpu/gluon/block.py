@@ -33,7 +33,8 @@ from ..ndarray.register import _BoundedCache
 from .. import ndarray as nd_mod
 from .parameter import Parameter, ParameterDict, DeferredInitializationError
 
-__all__ = ["Block", "HybridBlock", "SymbolBlock", "name_scope"]
+__all__ = ["Block", "HybridBlock", "SymbolBlock", "CachedGraph",
+           "name_scope"]
 
 _naming_counter_lock = threading.Lock()
 _naming_counters: Dict[str, int] = {}
@@ -462,6 +463,49 @@ class HybridBlock(Block):
                   for k, p in self._reg_params.items()}
         return self.hybrid_forward(nd_mod, *inputs, **params)
 
+    def cached_graph(self, *inputs) -> "CachedGraph":
+        """Freeze ONE compiled inference signature into a
+        :class:`CachedGraph` — the direct cached-graph entry the serving
+        subsystem dispatches through (no autograd bookkeeping, no
+        per-call parameter re-read, no aux write-back).
+
+        ``inputs`` is an example batch (NDArrays, or anything
+        ``nd.array`` accepts) whose shapes/dtypes define the signature.
+        The same per-signature cache ``hybridize()`` fills is reused, so
+        a block that already served this signature through ``block(x)``
+        hands back the *identical* executable; the call compiles (and
+        warms) the graph before returning, so the first real request
+        never pays the compile."""
+        inputs = tuple(a if isinstance(a, NDArray) else nd_mod.array(a)
+                       for a in inputs)
+        ctx = inputs[0].context
+        with _autograd.pause():
+            # one eager pass settles every deferred shape (children
+            # included) exactly as the hybridize path's first call does
+            try:
+                self(*inputs)
+            except DeferredInitializationError:
+                self._deferred_infer(*inputs)
+                self(*inputs)
+            sig = (tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+                   False, ctx)
+            entry = self._cached_graph.get(sig)
+            if entry is None:
+                entry = self._build_cached(inputs, False, ctx)
+                self._cached_graph.put(sig, entry)
+            jitted, _jitted_vjp, params, meta = entry
+            n_outs_cell, _write_idx_cell = meta
+            pvals = [p.data(ctx)._read() for p in params]
+            # inference mode disables dropout, so the RNG input is dead:
+            # pin one key now and __call__ stays allocation-free and
+            # deterministic
+            key = _grandom.next_key()
+            import jax
+            flat = jitted(key, *pvals, *[a._read() for a in inputs])
+            jax.block_until_ready(flat)        # compile + warm, here
+        return CachedGraph(jitted, pvals, key, n_outs_cell[0], ctx,
+                           self.name)
+
     def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
         """Reference parity: save -symbol.json + -%04d.params for the
         SymbolBlock / predict path."""
@@ -478,6 +522,56 @@ class HybridBlock(Block):
             arrs[f"arg:{name}"] = p.data()
         nd_utils.save(params_file, arrs)
         return sym_file, params_file
+
+
+class CachedGraph:
+    """Inference-only handle over one compiled cached-graph signature —
+    the CachedOp artifact a model server wants (PAPER.md L6a), with
+    everything the serving hot path must not pay stripped off:
+
+    - **no autograd bookkeeping** — no vjp build, no TapeNode, no
+      parent scan; inference never backprops;
+    - **no per-call parameter re-read** — parameter device values were
+      snapshotted at freeze time (weights are immutable while serving;
+      re-freeze after loading new ones);
+    - **no aux write-back** — the graph was traced in inference mode
+      (``training=False``) and any residual mutation outputs are
+      dropped, never written back: a server must not corrupt running
+      stats;
+    - **pinned RNG key** — dropout is off in inference mode, so the key
+      input is dead; pinning it keeps calls allocation-free and
+      bit-deterministic.
+
+    ``raw(*values)`` is the lean entry (numpy/jax values in, tuple of
+    jax arrays out — what ``serving.ModelServer`` dispatches per
+    batch); ``__call__`` wraps NDArrays for parity with ``block(x)``.
+    """
+
+    __slots__ = ("_jitted", "_pvals", "_key", "_n_outs", "_ctx", "name")
+
+    def __init__(self, jitted, pvals, key, n_outs, ctx, name):
+        self._jitted = jitted
+        self._pvals = tuple(pvals)
+        self._key = key
+        self._n_outs = n_outs
+        self._ctx = ctx
+        self.name = name
+
+    @property
+    def n_outputs(self) -> int:
+        return self._n_outs
+
+    def raw(self, *values):
+        """One compiled call: raw array values in (numpy or jax), tuple
+        of raw jax arrays out.  No NDArray wrappers, no tape, no sync."""
+        flat = self._jitted(self._key, *self._pvals, *values)
+        return flat[:self._n_outs]
+
+    def __call__(self, *inputs):
+        vals = [a._read() if isinstance(a, NDArray) else a
+                for a in inputs]
+        outs = [NDArray(v, ctx=self._ctx) for v in self.raw(*vals)]
+        return outs[0] if len(outs) == 1 else tuple(outs)
 
 
 class _KeyScope:

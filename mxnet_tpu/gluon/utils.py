@@ -53,11 +53,20 @@ def clip_global_norm(arrays: Sequence[NDArray], max_norm: float,
     """Rescale arrays in place so the joint L2 norm is at most max_norm."""
     if not arrays:
         raise MXNetError("no arrays to clip")
-    total = 0.0
+    # accumulate the squared norms ON DEVICE (one bulked dispatch chain),
+    # then read the scalar back once — N arrays cost ONE host sync, not N.
+    # Squares accumulate in f32: bf16's 8-bit mantissa would mis-scale
+    # the global norm for large tensors
+    total_sq = None
     for a in arrays:
-        n = float((a * a).sum().asnumpy())
-        total += n
-    total = float(_np.sqrt(total))
+        af = a if str(a.dtype) in ("float32", "float64") \
+            else a.astype("float32")
+        n = (af * af).sum()
+        total_sq = n if total_sq is None else total_sq + n
+    # single batched readback: the clipped norm is this function's
+    # host-facing return value
+    # mxlint: disable=hidden-host-sync — one batched readback, was N syncs
+    total = float(_np.sqrt(total_sq.asnumpy()))
     if check_isfinite and not _np.isfinite(total):
         import warnings
         warnings.warn("nan or inf in clip_global_norm")

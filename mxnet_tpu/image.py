@@ -6,6 +6,14 @@ The reference built this on OpenCV handles; this build decodes via PIL
 with the same augmenter-class composition surface (``CreateAugmenter``,
 ``ImageIter``).  Heavy batch pipelines should prefer io.ImageRecordIter
 (threaded) — as in the reference.
+
+Transfer discipline (the mxlint ``hidden-host-sync`` cleanup): every
+augmenter works on host numpy through ``apply_np`` and the iterators run
+the WHOLE augmenter chain in numpy, so a pipeline pays exactly ONE
+device→host ingestion per image (``_ensure_np``, the single sanctioned
+sync site in this module) instead of an NDArray↔numpy round trip per
+augmenter.  The public per-augmenter ``__call__`` surface still accepts
+and returns NDArrays, unchanged.
 """
 from __future__ import annotations
 
@@ -41,26 +49,47 @@ def _pil():
         raise MXNetError("image ops need PIL (not installed)") from e
 
 
-def imdecode(buf: bytes, to_rgb: bool = True, flag: int = 1) -> NDArray:
-    """Decode an encoded image buffer to an HWC NDArray
-    (reference: mx.image.imdecode over cv2.imdecode)."""
+def _ensure_np(src) -> _np.ndarray:
+    """THE pipeline host-ingestion point: one device→host transfer per
+    image at chain entry; every downstream stage stays in numpy."""
+    if isinstance(src, NDArray):
+        # single ingestion boundary for the whole augmenter chain
+        # (was one sync PER augmenter stage)
+        # mxlint: disable=hidden-host-sync — the pipeline's ONE ingest
+        return src.asnumpy()
+    return _np.asarray(src)
+
+
+def _imdecode_np(buf: bytes, to_rgb: bool = True, flag: int = 1
+                 ) -> _np.ndarray:
     img = _np.asarray(_pil().open(_io.BytesIO(buf)).convert(
         "RGB" if flag else "L"))
     if img.ndim == 2:
         img = img[:, :, None]
     if not to_rgb and img.shape[2] == 3:
         img = img[:, :, ::-1]
-    return nd_array(img, ctx=cpu())
+    return img
+
+
+def imdecode(buf: bytes, to_rgb: bool = True, flag: int = 1) -> NDArray:
+    """Decode an encoded image buffer to an HWC NDArray
+    (reference: mx.image.imdecode over cv2.imdecode)."""
+    return nd_array(_imdecode_np(buf, to_rgb, flag), ctx=cpu())
+
+
+def _imread_np(filename: str, to_rgb: bool = True, flag: int = 1
+               ) -> _np.ndarray:
+    with open(filename, "rb") as f:
+        return _imdecode_np(f.read(), to_rgb=to_rgb, flag=flag)
 
 
 def imread(filename: str, to_rgb: bool = True, flag: int = 1) -> NDArray:
-    with open(filename, "rb") as f:
-        return imdecode(f.read(), to_rgb=to_rgb, flag=flag)
+    return nd_array(_imread_np(filename, to_rgb, flag), ctx=cpu())
 
 
-def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+def _imresize_np(arr: _np.ndarray, w: int, h: int,
+                 interp: int = 1) -> _np.ndarray:
     Image = _pil()
-    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
     mode = arr.astype(_np.uint8) if arr.dtype != _np.uint8 else arr
     resample = {0: Image.NEAREST, 1: Image.BILINEAR, 2: Image.BICUBIC,
                 3: Image.LANCZOS}.get(interp, Image.BILINEAR)
@@ -68,44 +97,73 @@ def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
                                       else mode).resize((w, h), resample))
     if out.ndim == 2:
         out = out[:, :, None]
-    return nd_array(out.astype(arr.dtype), ctx=cpu())
+    return out.astype(arr.dtype)
 
 
-def resize_short(src, size: int, interp: int = 1) -> NDArray:
-    h, w = src.shape[:2]
+def imresize(src, w: int, h: int, interp: int = 1) -> NDArray:
+    return nd_array(_imresize_np(_ensure_np(src), w, h, interp),
+                    ctx=cpu())
+
+
+def _resize_short_np(arr: _np.ndarray, size: int,
+                     interp: int = 1) -> _np.ndarray:
+    h, w = arr.shape[:2]
     if h > w:
         nw, nh = size, int(h * size / w)
     else:
         nw, nh = int(w * size / h), size
-    return imresize(src, nw, nh, interp)
+    return _imresize_np(arr, nw, nh, interp)
+
+
+def resize_short(src, size: int, interp: int = 1) -> NDArray:
+    return nd_array(_resize_short_np(_ensure_np(src), size, interp),
+                    ctx=cpu())
+
+
+def _fixed_crop_np(arr: _np.ndarray, x0: int, y0: int, w: int, h: int,
+                   size: Optional[Tuple[int, int]] = None,
+                   interp: int = 1) -> _np.ndarray:
+    out = arr[y0:y0 + h, x0:x0 + w]
+    if size is not None and (w, h) != size:
+        return _imresize_np(out, size[0], size[1], interp)
+    return out
 
 
 def fixed_crop(src, x0: int, y0: int, w: int, h: int,
                size: Optional[Tuple[int, int]] = None,
                interp: int = 1) -> NDArray:
-    arr = src.asnumpy() if isinstance(src, NDArray) else _np.asarray(src)
-    out = arr[y0:y0 + h, x0:x0 + w]
-    if size is not None and (w, h) != size:
-        return imresize(out, size[0], size[1], interp)
-    return nd_array(out, ctx=cpu())
+    return nd_array(_fixed_crop_np(_ensure_np(src), x0, y0, w, h, size,
+                                   interp), ctx=cpu())
 
 
-def center_crop(src, size: Tuple[int, int], interp: int = 1):
-    h, w = src.shape[:2]
+def _center_crop_np(arr: _np.ndarray, size: Tuple[int, int],
+                    interp: int = 1):
+    h, w = arr.shape[:2]
     cw, ch = size
     x0 = max((w - cw) // 2, 0)
     y0 = max((h - ch) // 2, 0)
-    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
+    out = _fixed_crop_np(arr, x0, y0, min(cw, w), min(ch, h), size, interp)
+    return out, (x0, y0, cw, ch)
+
+
+def center_crop(src, size: Tuple[int, int], interp: int = 1):
+    out, coords = _center_crop_np(_ensure_np(src), size, interp)
+    return nd_array(out, ctx=cpu()), coords
+
+
+def _random_crop_np(arr: _np.ndarray, size: Tuple[int, int],
+                    interp: int = 1):
+    h, w = arr.shape[:2]
+    cw, ch = size
+    x0 = _pyrandom.randint(0, max(w - cw, 0))
+    y0 = _pyrandom.randint(0, max(h - ch, 0))
+    out = _fixed_crop_np(arr, x0, y0, min(cw, w), min(ch, h), size, interp)
     return out, (x0, y0, cw, ch)
 
 
 def random_crop(src, size: Tuple[int, int], interp: int = 1):
-    h, w = src.shape[:2]
-    cw, ch = size
-    x0 = _pyrandom.randint(0, max(w - cw, 0))
-    y0 = _pyrandom.randint(0, max(h - ch, 0))
-    out = fixed_crop(src, x0, y0, min(cw, w), min(ch, h), size, interp)
-    return out, (x0, y0, cw, ch)
+    out, coords = _random_crop_np(_ensure_np(src), size, interp)
+    return nd_array(out, ctx=cpu()), coords
 
 
 def scale_down(src_size: Tuple[int, int], size: Tuple[int, int]):
@@ -120,11 +178,9 @@ def scale_down(src_size: Tuple[int, int], size: Tuple[int, int]):
     return int(w), int(h)
 
 
-def random_size_crop(src, size: Tuple[int, int], area, ratio,
-                     interp: int = 1, **kwargs):
-    """Random area/aspect crop then resize to `size` (reference
-    mx.image.random_size_crop — the inception-style crop)."""
-    h, w = src.shape[:2]
+def _random_size_crop_np(arr: _np.ndarray, size: Tuple[int, int], area,
+                         ratio, interp: int = 1):
+    h, w = arr.shape[:2]
     src_area = h * w
     if isinstance(area, (int, float)):
         area = (area, 1.0)
@@ -137,18 +193,30 @@ def random_size_crop(src, size: Tuple[int, int], area, ratio,
         if new_w <= w and new_h <= h:
             x0 = _pyrandom.randint(0, w - new_w)
             y0 = _pyrandom.randint(0, h - new_h)
-            out = fixed_crop(src, x0, y0, new_w, new_h, size, interp)
+            out = _fixed_crop_np(arr, x0, y0, new_w, new_h, size, interp)
             return out, (x0, y0, new_w, new_h)
-    return center_crop(src, size, interp)      # fallback
+    return _center_crop_np(arr, size, interp)      # fallback
+
+
+def random_size_crop(src, size: Tuple[int, int], area, ratio,
+                     interp: int = 1, **kwargs):
+    """Random area/aspect crop then resize to `size` (reference
+    mx.image.random_size_crop — the inception-style crop)."""
+    out, coords = _random_size_crop_np(_ensure_np(src), size, area,
+                                       ratio, interp)
+    return nd_array(out, ctx=cpu()), coords
+
+
+def _color_normalize_np(arr: _np.ndarray, mean, std=None) -> _np.ndarray:
+    arr = arr.astype(_np.float32) - _np.asarray(mean, dtype=_np.float32)
+    if std is not None:
+        arr = arr / _np.asarray(std, dtype=_np.float32)
+    return arr
 
 
 def color_normalize(src, mean, std=None) -> NDArray:
-    arr = src.asnumpy().astype(_np.float32) if isinstance(src, NDArray) \
-        else _np.asarray(src, dtype=_np.float32)
-    arr = arr - _np.asarray(mean, dtype=_np.float32)
-    if std is not None:
-        arr = arr / _np.asarray(std, dtype=_np.float32)
-    return nd_array(arr, ctx=cpu())
+    return nd_array(_color_normalize_np(_ensure_np(src), mean, std),
+                    ctx=cpu())
 
 
 # ---------------------------------------------------------------------------
@@ -156,66 +224,87 @@ def color_normalize(src, mean, std=None) -> NDArray:
 # ---------------------------------------------------------------------------
 
 class Augmenter:
+    """Base augmenter.  Subclasses implement ``apply_np`` (host numpy in
+    and out — the whole-chain zero-extra-transfer path the iterators
+    use); ``__call__`` keeps the reference's NDArray-in/NDArray-out
+    surface by wrapping it (a no-op stage hands back ``src`` itself).
+    A legacy user augmenter that overrides only ``__call__`` (the
+    pre-refactor surface) still works: the base ``apply_np`` routes
+    through it."""
+
+    def apply_np(self, arr: _np.ndarray) -> _np.ndarray:
+        if type(self).__call__ is Augmenter.__call__:
+            raise NotImplementedError(
+                f"{type(self).__name__} implements neither apply_np nor "
+                f"__call__")
+        # legacy augmenter: only __call__ overridden — bridge through
+        # the NDArray surface it was written against
+        return _ensure_np(self(nd_array(arr, ctx=cpu())))
+
     def __call__(self, src: NDArray) -> NDArray:
-        raise NotImplementedError
+        arr = _ensure_np(src)
+        out = self.apply_np(arr)
+        if out is arr and isinstance(src, NDArray):
+            return src
+        return nd_array(out, ctx=cpu())
 
 
 class ResizeAug(Augmenter):
     def __init__(self, size: int, interp: int = 1):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return resize_short(src, self.size, self.interp)
+    def apply_np(self, arr):
+        return _resize_short_np(arr, self.size, self.interp)
 
 
 class ForceResizeAug(Augmenter):
     def __init__(self, size: Tuple[int, int], interp: int = 1):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return imresize(src, self.size[0], self.size[1], self.interp)
+    def apply_np(self, arr):
+        return _imresize_np(arr, self.size[0], self.size[1], self.interp)
 
 
 class CenterCropAug(Augmenter):
     def __init__(self, size: Tuple[int, int], interp: int = 1):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return center_crop(src, self.size, self.interp)[0]
+    def apply_np(self, arr):
+        return _center_crop_np(arr, self.size, self.interp)[0]
 
 
 class RandomCropAug(Augmenter):
     def __init__(self, size: Tuple[int, int], interp: int = 1):
         self.size, self.interp = size, interp
 
-    def __call__(self, src):
-        return random_crop(src, self.size, self.interp)[0]
+    def apply_np(self, arr):
+        return _random_crop_np(arr, self.size, self.interp)[0]
 
 
 class HorizontalFlipAug(Augmenter):
     def __init__(self, p: float = 0.5):
         self.p = p
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         if _pyrandom.random() < self.p:
-            return nd_array(src.asnumpy()[:, ::-1].copy(), ctx=cpu())
-        return src
+            return arr[:, ::-1].copy()
+        return arr
 
 
 class CastAug(Augmenter):
     def __init__(self, dtype="float32"):
         self.dtype = dtype
 
-    def __call__(self, src):
-        return src.astype(self.dtype)
+    def apply_np(self, arr):
+        return arr.astype(self.dtype)
 
 
 class ColorNormalizeAug(Augmenter):
     def __init__(self, mean, std):
         self.mean, self.std = mean, std
 
-    def __call__(self, src):
-        return color_normalize(src, self.mean, self.std)
+    def apply_np(self, arr):
+        return _color_normalize_np(arr, self.mean, self.std)
 
 
 class _JitterAug(Augmenter):
@@ -227,24 +316,23 @@ class _JitterAug(Augmenter):
 
 
 class BrightnessJitterAug(_JitterAug):
-    def __call__(self, src):
-        return nd_array(src.asnumpy().astype(_np.float32) * self._coef(),
-                        ctx=cpu())
+    def apply_np(self, arr):
+        return arr.astype(_np.float32) * self._coef()
 
 
 class ContrastJitterAug(_JitterAug):
-    def __call__(self, src):
-        arr = src.asnumpy().astype(_np.float32)
+    def apply_np(self, arr):
+        arr = arr.astype(_np.float32)
         mean = arr.mean()
-        return nd_array((arr - mean) * self._coef() + mean, ctx=cpu())
+        return (arr - mean) * self._coef() + mean
 
 
 class SaturationJitterAug(_JitterAug):
-    def __call__(self, src):
-        arr = src.asnumpy().astype(_np.float32)
+    def apply_np(self, arr):
+        arr = arr.astype(_np.float32)
         gray = arr.mean(axis=2, keepdims=True)
         c = self._coef()
-        return nd_array(arr * c + gray * (1.0 - c), ctx=cpu())
+        return arr * c + gray * (1.0 - c)
 
 
 class RandomSizedCropAug(Augmenter):
@@ -252,9 +340,9 @@ class RandomSizedCropAug(Augmenter):
         self.size, self.area, self.ratio, self.interp = \
             size, area, ratio, interp
 
-    def __call__(self, src):
-        return random_size_crop(src, self.size, self.area, self.ratio,
-                                self.interp)[0]
+    def apply_np(self, arr):
+        return _random_size_crop_np(arr, self.size, self.area, self.ratio,
+                                    self.interp)[0]
 
 
 class HueJitterAug(Augmenter):
@@ -271,15 +359,14 @@ class HueJitterAug(Augmenter):
     def __init__(self, hue: float):
         self.hue = hue
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         alpha = _pyrandom.uniform(-self.hue, self.hue)
         u = _math.cos(alpha * _math.pi)
         w = _math.sin(alpha * _math.pi)
         bt = _np.array([[1.0, 0.0, 0.0], [0.0, u, -w], [0.0, w, u]],
                        _np.float32)
         t = self._ITYIQ @ bt @ self._TYIQ
-        arr = src.asnumpy().astype(_np.float32)
-        return nd_array(arr @ t.T, ctx=cpu())
+        return arr.astype(_np.float32) @ t.T
 
 
 class RandomOrderAug(Augmenter):
@@ -288,12 +375,12 @@ class RandomOrderAug(Augmenter):
     def __init__(self, ts):
         self.ts = list(ts)
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         order = list(self.ts)
         _pyrandom.shuffle(order)
         for t in order:
-            src = t(src)
-        return src
+            arr = t.apply_np(arr)
+        return arr
 
 
 class ColorJitterAug(RandomOrderAug):
@@ -320,11 +407,10 @@ class LightingAug(Augmenter):
         self.eigval = _np.asarray(eigval, _np.float32)
         self.eigvec = _np.asarray(eigvec, _np.float32)
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         alpha = _np.random.normal(0, self.alphastd, size=(3,))
         rgb = (self.eigvec * alpha) @ self.eigval
-        return nd_array(src.asnumpy().astype(_np.float32) + rgb,
-                        ctx=cpu())
+        return arr.astype(_np.float32) + rgb
 
 
 class RandomGrayAug(Augmenter):
@@ -338,11 +424,10 @@ class RandomGrayAug(Augmenter):
     def __init__(self, p: float = 0.5):
         self.p = p
 
-    def __call__(self, src):
+    def apply_np(self, arr):
         if _pyrandom.random() < self.p:
-            arr = src.asnumpy().astype(_np.float32)
-            return nd_array(arr @ self._MAT, ctx=cpu())
-        return src
+            return arr.astype(_np.float32) @ self._MAT
+        return arr
 
 
 def CreateAugmenter(data_shape, resize=0, rand_crop=False, rand_resize=False,
@@ -438,10 +523,12 @@ class ImageIter(DataIter):
         datas, labels = [], []
         for entry in self.imglist[self.cur:self.cur + self.batch_size]:
             *label, path = entry
-            img = imread(os.path.join(self.path_root, path))
+            # the whole chain runs in host numpy: zero device round trips
+            # until the one batched upload below
+            arr = _imread_np(os.path.join(self.path_root, path))
             for aug in self.aug_list:
-                img = aug(img)
-            datas.append(img.asnumpy().transpose(2, 0, 1))
+                arr = aug.apply_np(arr)
+            datas.append(arr.transpose(2, 0, 1))
             labels.append(label if self.label_width > 1 else label[0])
         self.cur += self.batch_size
         return DataBatch(
@@ -460,10 +547,25 @@ class ImageIter(DataIter):
 # plain image augmenters unchanged.
 
 class DetAugmenter:
-    """Base detection augmenter: ``(src, label) -> (src, label)``."""
+    """Base detection augmenter: ``(src, label) -> (src, label)``.
+    Subclasses implement ``apply_np`` (numpy image + label in/out);
+    ``__call__`` keeps the NDArray surface, as with :class:`Augmenter`
+    (including the legacy-``__call__``-only bridge)."""
+
+    def apply_np(self, arr, label):
+        if type(self).__call__ is DetAugmenter.__call__:
+            raise NotImplementedError(
+                f"{type(self).__name__} implements neither apply_np nor "
+                f"__call__")
+        out, label = self(nd_array(arr, ctx=cpu()), label)
+        return _ensure_np(out), label
 
     def __call__(self, src, label):
-        raise NotImplementedError
+        arr = _ensure_np(src)
+        out, label = self.apply_np(arr, label)
+        if out is arr and isinstance(src, NDArray):
+            return src, label
+        return nd_array(out, ctx=cpu()), label
 
 
 class DetBorrowAug(DetAugmenter):
@@ -473,8 +575,8 @@ class DetBorrowAug(DetAugmenter):
     def __init__(self, augmenter: Augmenter):
         self.augmenter = augmenter
 
-    def __call__(self, src, label):
-        return self.augmenter(src), label
+    def apply_np(self, arr, label):
+        return self.augmenter.apply_np(arr), label
 
 
 class DetHorizontalFlipAug(DetAugmenter):
@@ -483,14 +585,14 @@ class DetHorizontalFlipAug(DetAugmenter):
     def __init__(self, p: float = 0.5):
         self.p = p
 
-    def __call__(self, src, label):
+    def apply_np(self, arr, label):
         if _pyrandom.random() < self.p:
-            src = nd_array(src.asnumpy()[:, ::-1].copy(), ctx=cpu())
+            arr = arr[:, ::-1].copy()
             label = label.copy()
             x1 = label[:, 1].copy()
             label[:, 1] = 1.0 - label[:, 3]
             label[:, 3] = 1.0 - x1
-        return src, label
+        return arr, label
 
 
 class DetRandomCropAug(DetAugmenter):
@@ -516,8 +618,8 @@ class DetRandomCropAug(DetAugmenter):
         area = (boxes[:, 3] - boxes[:, 1]) * (boxes[:, 4] - boxes[:, 2])
         return inter / _np.maximum(area, 1e-12)
 
-    def __call__(self, src, label):
-        h, w = src.shape[0], src.shape[1]
+    def apply_np(self, arr, label):
+        h, w = arr.shape[0], arr.shape[1]
         for _ in range(self.max_attempts):
             area_f = _pyrandom.uniform(*self.area_range)
             ar = _pyrandom.uniform(*self.aspect_ratio_range)
@@ -540,7 +642,7 @@ class DetRandomCropAug(DetAugmenter):
                 keep = _np.zeros((0,), bool)
             x0, y0 = int(cx * w), int(cy * h)
             pw, ph = max(1, int(cw * w)), max(1, int(ch * h))
-            img = fixed_crop(src, x0, y0, pw, ph)
+            img = _fixed_crop_np(arr, x0, y0, pw, ph)
             new = label[keep].copy()
             if new.shape[0]:
                 new[:, 1] = _np.clip((new[:, 1] - cx) / cw, 0, 1)
@@ -548,7 +650,7 @@ class DetRandomCropAug(DetAugmenter):
                 new[:, 2] = _np.clip((new[:, 2] - cy) / ch, 0, 1)
                 new[:, 4] = _np.clip((new[:, 4] - cy) / ch, 0, 1)
             return img, new
-        return src, label
+        return arr, label
 
 
 class DetRandomPadAug(DetAugmenter):
@@ -563,8 +665,8 @@ class DetRandomPadAug(DetAugmenter):
         self.max_attempts = max_attempts
         self.pad_val = pad_val
 
-    def __call__(self, src, label):
-        h, w, c = src.shape
+    def apply_np(self, arr, label):
+        h, w, c = arr.shape
         # retry like DetRandomCropAug: keep sampling until the draw
         # actually expands the canvas
         scale = 1.0
@@ -573,14 +675,13 @@ class DetRandomPadAug(DetAugmenter):
             if scale > 1.0:
                 break
         if scale <= 1.0:
-            return src, label
+            return arr, label
         ar = _pyrandom.uniform(*self.aspect_ratio_range)
         nw = int(w * _np.sqrt(scale * ar))
         nh = int(h * scale / max(_np.sqrt(scale * ar), 1e-12))
         nw, nh = max(nw, w), max(nh, h)
         x0 = _pyrandom.randint(0, nw - w)
         y0 = _pyrandom.randint(0, nh - h)
-        arr = src.asnumpy()          # one device->host copy
         canvas = _np.empty((nh, nw, c), arr.dtype)
         canvas[:] = _np.asarray(self.pad_val)[:c]
         canvas[y0:y0 + h, x0:x0 + w] = arr
@@ -590,7 +691,7 @@ class DetRandomPadAug(DetAugmenter):
             new[:, 3] = (new[:, 3] * w + x0) / nw
             new[:, 2] = (new[:, 2] * h + y0) / nh
             new[:, 4] = (new[:, 4] * h + y0) / nh
-        return nd_array(canvas, ctx=cpu()), new
+        return canvas, new
 
 
 class DetRandomSelectAug(DetAugmenter):
@@ -601,12 +702,12 @@ class DetRandomSelectAug(DetAugmenter):
         self.aug_list = aug_list
         self.skip_prob = skip_prob
 
-    def __call__(self, src, label):
+    def apply_np(self, arr, label):
         if _pyrandom.random() < self.skip_prob:
-            return src, label
+            return arr, label
         for aug in _pyrandom.choice(self.aug_list):
-            src, label = aug(src, label)
-        return src, label
+            arr, label = aug.apply_np(arr, label)
+        return arr, label
 
 
 def CreateDetAugmenter(data_shape, resize=0, rand_crop=0.0, rand_pad=0.0,
@@ -707,11 +808,12 @@ class ImageDetIter(DataIter):
             raise StopIteration
         datas, labels = [], []
         for lab, path in self.imglist[self.cur:self.cur + self.batch_size]:
-            img = imread(os.path.join(self.path_root, path))
+            # host-numpy end to end, like ImageIter.next
+            arr = _imread_np(os.path.join(self.path_root, path))
             label = lab.copy()
             for aug in self.aug_list:
-                img, label = aug(img, label)
-            datas.append(img.asnumpy().transpose(2, 0, 1))
+                arr, label = aug.apply_np(arr, label)
+            datas.append(arr.transpose(2, 0, 1))
             pad = _np.full((self.max_objs, 5), -1.0, _np.float32)
             n = min(label.shape[0], self.max_objs)
             if n:

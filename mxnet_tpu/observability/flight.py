@@ -18,6 +18,15 @@ JSON file at death, turning postmortems from "rerun and hope" into
   checkpoint-and-flush and step-failure paths;
 - **explicitly** — ``recorder().dump("why")`` from any shutdown path.
 
+Serving (PR-4 follow-up): the recorder additionally keeps a
+**per-request ring** — ``record_request()`` appends one record per
+served request (``request_id``, enqueue/assemble/dispatch/done
+timestamps, shape bucket, batch size), fed by
+:class:`~mxnet_tpu.serving.ModelServer` at completion time.  A crash
+dump carries both rings side by side (``steps`` + ``requests``), so a
+dying server explains its last ~256 requests the same way a dying
+trainer explains its last steps.
+
 Cost discipline: ``record()`` is a dict build and a deque append — no
 formatting, no I/O, no device sync.  Device-backed values (the step
 loss) are stored as live references and materialized only at dump time,
@@ -88,6 +97,8 @@ class FlightRecorder:
         self.path = path
         self._ring: Deque[dict] = collections.deque(
             maxlen=max(1, self.capacity))
+        self._req_ring: Deque[dict] = collections.deque(
+            maxlen=max(1, self.capacity))
         self._lock = threading.Lock()
         self._installed = False
         self._prev_hook = None
@@ -104,13 +115,27 @@ class FlightRecorder:
         with self._lock:
             self._ring.append(fields)
 
+    def record_request(self, **fields) -> None:
+        """Append one served-request record to the request ring (same
+        cost discipline as :meth:`record` — a dict build and a deque
+        append, no I/O, no sync)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._req_ring.append(fields)
+
     def records(self) -> List[dict]:
         with self._lock:
             return list(self._ring)
 
+    def requests(self) -> List[dict]:
+        with self._lock:
+            return list(self._req_ring)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
+            self._req_ring.clear()
 
     def _resolve_path(self, path: Optional[str]) -> str:
         if path:
@@ -135,6 +160,8 @@ class FlightRecorder:
         with self._lock:
             steps = [{k: _materialize(v) for k, v in rec.items()}
                      for rec in self._ring]
+            requests = [{k: _materialize(v) for k, v in rec.items()}
+                        for rec in self._req_ring]
         try:
             snapshot = registry().snapshot()
         except Exception:   # noqa: BLE001 — a half-torn registry still
@@ -147,6 +174,8 @@ class FlightRecorder:
             "capacity": self.capacity,
             "n_steps": len(steps),
             "steps": steps,
+            "n_requests": len(requests),
+            "requests": requests,
             "snapshot": snapshot,
         }
         try:

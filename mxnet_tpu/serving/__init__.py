@@ -1,0 +1,46 @@
+"""Serving subsystem: continuous-batching inference over CachedOp graphs.
+
+Everything before this package optimized *training*; the north star says
+"serve heavy traffic from millions of users".  This package is the
+inference path: a :class:`ModelServer` that loads a hybridized
+``HybridBlock`` (served through the direct cached-graph entry,
+``HybridBlock.cached_graph`` — no autograd bookkeeping) or an exported
+symbol/params pair (``ModelServer.from_exported``, the
+``examples/serve_c_api.md`` seam), and runs **continuous/dynamic
+batching**:
+
+- :mod:`.batcher` — bounded admission queue (submits past the depth are
+  rejected with :class:`ServerOverloaded`, the 429 analog; requests that
+  out-wait their deadline are rejected with :class:`DeadlineExceeded`),
+  plus the batcher thread and the dispatch handoff queue, so batch
+  formation overlaps device execution;
+- :mod:`.buckets` — shape-bucketed batch assembly: padding-length
+  buckets (the BERT bench's padding machinery) x power-of-two batch
+  buckets, one compiled executable per signature, with
+  real/padded-element accounting for the batch-efficiency metric;
+- :mod:`.server` — the :class:`ModelServer` lifecycle (start / graceful
+  drain on ``stop()`` and SIGTERM), per-request metrics
+  (``serving.request_us``, ``serving.queue_depth``, ``serving.tokens_*``)
+  and flight-recorder request records.
+
+Quick start::
+
+    from mxnet_tpu.serving import ModelServer
+    net.hybridize()
+    with ModelServer(net, max_batch=16) as srv:
+        y = srv.infer(x)            # x: ONE sample, no batch dim
+
+Knobs: ``MXTPU_SERVING_MAX_BATCH``, ``MXTPU_SERVING_QUEUE_DEPTH``,
+``MXTPU_SERVING_DEADLINE_MS``, ``MXTPU_SERVING_WORKERS``,
+``MXTPU_SERVING_BATCH_WINDOW_US`` (see the README knob table).
+"""
+from __future__ import annotations
+
+from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded, Request,
+                      ServerClosed, ServerOverloaded, ServingError)
+from .buckets import Bucketer, NoBucketError
+from .server import ModelServer
+
+__all__ = ["ModelServer", "Bucketer", "Request", "AdmissionQueue",
+           "Batcher", "ServingError", "ServerClosed", "ServerOverloaded",
+           "DeadlineExceeded", "NoBucketError"]

@@ -1,0 +1,267 @@
+"""Admission queue + continuous-batching pump for the serving subsystem.
+
+The request path, end to end::
+
+    client -> submit() -> AdmissionQueue -> [batcher thread] -> _Batch
+           -> dispatch queue -> [worker threads] -> one CachedOp call
+           -> split rows -> Request.result()
+
+Three flow-control behaviors, all measured:
+
+- **backpressure**: the admission queue is depth-bounded; a submit
+  past the bound fails immediately with :class:`ServerOverloaded`
+  (the HTTP-429 analog) instead of growing an unbounded backlog —
+  shedding load at the door is what keeps p99 finite under overload;
+- **deadlines**: a request still queued when its deadline expires is
+  rejected at batch assembly with :class:`DeadlineExceeded` — the chip
+  never spends a batch slot computing an answer nobody is waiting for;
+- **continuous batching**: ONE batcher thread drains the queue
+  head-of-line by shape bucket, waits up to a short window for the
+  bucket to fill, and hands assembled batches to a bounded dispatch
+  queue that N workers drain — so the next batch forms WHILE the
+  current one executes on device, and dispatch-queue pressure
+  propagates back to admission.
+"""
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable, List, Optional, Tuple
+
+from ..base import MXNetError, hot_path
+
+__all__ = ["Request", "AdmissionQueue", "Batcher", "ServingError",
+           "ServerClosed", "ServerOverloaded", "DeadlineExceeded"]
+
+
+class ServingError(MXNetError):
+    """Base class for serving-path request failures."""
+
+
+class ServerClosed(ServingError):
+    """Submit after shutdown (or a request shed by a non-draining
+    stop)."""
+
+
+class ServerOverloaded(ServingError):
+    """Admission queue full — the 429: retry later, ideally with
+    backoff."""
+
+
+class DeadlineExceeded(ServingError):
+    """The request's deadline expired while it was still queued."""
+
+
+class Request:
+    """One in-flight inference request: inputs, lifecycle timestamps
+    (the flight-recorder record), and a one-shot completion event."""
+
+    __slots__ = ("rid", "inputs", "key", "deadline", "batch_size",
+                 "t_enqueue", "t_assemble", "t_dispatch", "t_done",
+                 "_event", "_result", "_error")
+
+    def __init__(self, rid: int, inputs: Tuple, key: Tuple,
+                 deadline: Optional[float]):
+        self.rid = rid
+        self.inputs = inputs
+        self.key = key
+        self.deadline = deadline        # monotonic seconds, None = none
+        self.batch_size = 0
+        self.t_enqueue = time.monotonic()
+        self.t_assemble = 0.0
+        self.t_dispatch = 0.0
+        self.t_done = 0.0
+        self._event = threading.Event()
+        self._result = None
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None):
+        """Block until the request completes; returns the output array
+        (tuple of arrays for multi-output models) or raises the
+        request's error."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"request {self.rid} not completed within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self._result
+
+
+class AdmissionQueue:
+    """Bounded FIFO with shape-bucket-aware batch pops.
+
+    ``submit`` is the backpressure point (raises past ``depth``);
+    ``pop_bucket`` is the batcher's side: take the head request's
+    bucket, collect up to ``max_batch`` peers, waiting at most
+    ``window_s`` for the bucket to fill.  Expired requests are swept
+    out and returned separately so the caller can fail them.
+    """
+
+    def __init__(self, depth: int, gauge=None):
+        self.depth = int(depth)
+        self._q: List[Request] = []
+        self._cond = threading.Condition()
+        self._closed = False
+        self._gauge = gauge
+
+    def __len__(self) -> int:
+        return len(self._q)
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _set_gauge_locked(self) -> None:
+        if self._gauge is not None:
+            self._gauge.set(len(self._q))
+
+    def submit(self, req: Request) -> None:
+        with self._cond:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            if len(self._q) >= self.depth:
+                raise ServerOverloaded(
+                    f"admission queue full ({self.depth} deep) — "
+                    f"retry with backoff (429)")
+            self._q.append(req)
+            self._set_gauge_locked()
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """No further submits; pending requests stay for draining."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def shed(self) -> List[Request]:
+        """Drop every queued request (non-draining stop); returns them
+        so the caller can fail them with ServerClosed."""
+        with self._cond:
+            dropped, self._q = self._q, []
+            self._set_gauge_locked()
+            self._cond.notify_all()
+            return dropped
+
+    def pop_bucket(self, max_batch: int, window_s: float
+                   ) -> Optional[Tuple[List[Request], List[Request]]]:
+        """Next assembled-batch worth of requests: ``(batch, expired)``,
+        or ``None`` when the queue is closed and fully drained."""
+        with self._cond:
+            while True:
+                now = time.monotonic()
+                expired = [r for r in self._q
+                           if r.deadline is not None and r.deadline < now]
+                if expired:
+                    self._q = [r for r in self._q if r not in expired]
+                    self._set_gauge_locked()
+                if self._q:
+                    break
+                if expired:
+                    # deliver the expirations NOW — waiting for fresh
+                    # traffic would strand their waiters
+                    return [], expired
+                if self._closed:
+                    return None
+                self._cond.wait()
+            head_key = self._q[0].key
+            t_limit = None
+            while True:
+                take = [r for r in self._q if r.key == head_key]
+                if len(take) >= max_batch or self._closed or window_s <= 0:
+                    break
+                now = time.monotonic()
+                if t_limit is None:
+                    t_limit = now + window_s
+                remaining = t_limit - now
+                if remaining <= 0:
+                    break
+                self._cond.wait(remaining)
+            take = take[:max_batch]
+            taken = set(id(r) for r in take)
+            self._q = [r for r in self._q if id(r) not in taken]
+            self._set_gauge_locked()
+            return take, expired
+
+
+class _Batch:
+    """One assembled, padded batch headed for a single compiled call."""
+
+    __slots__ = ("key", "batch", "arrays", "requests", "real", "padded")
+
+    def __init__(self, key, batch, arrays, requests, real, padded):
+        self.key = key
+        self.batch = batch
+        self.arrays = arrays
+        self.requests = requests
+        self.real = real
+        self.padded = padded
+
+
+class Batcher:
+    """The continuous-batching pump: one thread that turns the admission
+    queue into a stream of assembled batches on a bounded handoff queue
+    (its ``put`` blocking is how dispatch pressure reaches admission)."""
+
+    def __init__(self, admission: AdmissionQueue, bucketer, out_queue,
+                 max_batch: int, window_s: float,
+                 on_expired: Callable[[Request], None],
+                 on_error: Optional[Callable[[Request, BaseException],
+                                             None]] = None):
+        self._admission = admission
+        self._bucketer = bucketer
+        self._out = out_queue
+        self._max_batch = max_batch
+        self._window = window_s
+        self._on_expired = on_expired
+        self._on_error = on_error
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self._run,
+                                        name="mxtpu-serving-batcher",
+                                        daemon=True)
+        self._thread.start()
+
+    def join(self, timeout: Optional[float] = None) -> None:
+        if self._thread is not None:
+            self._thread.join(timeout)
+
+    def is_alive(self) -> bool:
+        return self._thread is not None and self._thread.is_alive()
+
+    def _run(self) -> None:
+        while True:
+            popped = self._admission.pop_bucket(self._max_batch,
+                                                self._window)
+            if popped is None:
+                break
+            batch_reqs, expired = popped
+            for r in expired:
+                self._on_expired(r)
+            if not batch_reqs:
+                continue
+            try:
+                batch = self._assemble(batch_reqs)
+            except Exception as e:   # a poison batch fails ITS requests;
+                for r in batch_reqs:     # the pump must keep pumping
+                    if self._on_error is not None:
+                        self._on_error(r, e)   # uniform accounting path
+                    else:
+                        r._error = e
+                        r._event.set()
+                continue
+            self._out.put(batch)
+
+    @hot_path("dispatch")
+    def _assemble(self, requests: List[Request]) -> _Batch:
+        """Batch-assembly entry point (serving hot path): stamp the
+        assembly timestamp and pad-and-stack via the bucketer."""
+        t = time.monotonic()
+        for r in requests:
+            r.t_assemble = t
+        arrays, bsz, real, padded = self._bucketer.assemble(requests)
+        return _Batch(requests[0].key, bsz, arrays, requests, real,
+                      padded)

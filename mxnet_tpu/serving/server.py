@@ -1,0 +1,488 @@
+"""ModelServer: continuous-batching inference over CachedOp graphs.
+
+The executable a model server wants already exists in this stack:
+``hybridize()``'s compiled-graph artifact (PAPER.md L6a — the CachedOp
+analog).  This module wraps it in the serving loop the north star's
+"millions of users" traffic shape needs:
+
+    submit() -> AdmissionQueue (bounded, 429 past depth)
+             -> batcher thread: shape-bucketed batch assembly
+                (padding-length buckets, the BERT bench idiom)
+             -> dispatch workers: ONE CachedGraph.raw call per bucket,
+                batch formation overlapping device execution
+             -> per-request results, metrics, flight-recorder records
+
+Observability is wired from day one: ``serving.request_us`` (per-request
+end-to-end latency histogram), ``serving.queue_depth`` (gauge),
+``serving.dispatch_us`` (per-batch device-call histogram), and the
+batch-formation-efficiency counters ``serving.tokens_real`` /
+``serving.tokens_padded`` — all through the process-global registry, so
+the Prometheus endpoint and JSONL writer see the serving path with zero
+extra plumbing.  Every completed request also lands in the flight
+recorder's per-request ring, dumped on crash alongside step records.
+
+Knobs (all through ``base.register_env``): ``MXTPU_SERVING_MAX_BATCH``,
+``MXTPU_SERVING_QUEUE_DEPTH``, ``MXTPU_SERVING_DEADLINE_MS``,
+``MXTPU_SERVING_WORKERS``, ``MXTPU_SERVING_BATCH_WINDOW_US``.
+"""
+from __future__ import annotations
+
+import itertools
+import queue as _queue
+import signal
+import threading
+import time
+from typing import Dict, Optional, Sequence, Tuple
+
+import numpy as _np
+
+from ..base import MXNetError, get_env, hot_path, jax_compute_dtype
+from ..ndarray import NDArray, array as nd_array
+from ..observability.flight import recorder as _flight_recorder
+from ..observability.registry import registry
+from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded, Request,
+                      ServerClosed, ServerOverloaded)
+from .buckets import Bucketer
+
+__all__ = ["ModelServer"]
+
+MAX_BATCH_ENV = "MXTPU_SERVING_MAX_BATCH"
+QUEUE_DEPTH_ENV = "MXTPU_SERVING_QUEUE_DEPTH"
+DEADLINE_MS_ENV = "MXTPU_SERVING_DEADLINE_MS"
+WORKERS_ENV = "MXTPU_SERVING_WORKERS"
+BATCH_WINDOW_US_ENV = "MXTPU_SERVING_BATCH_WINDOW_US"
+
+
+def _key_str(key: Tuple) -> str:
+    """Compact human-readable bucket tag for records/debugging:
+    ``32:int32|32:int32`` — dtype included, so two buckets differing
+    only in dtype stay distinguishable in postmortems."""
+    parts = []
+    for shape, dt in key:
+        parts.append(("x".join(str(s) for s in shape) or "scalar")
+                     + ":" + str(dt))
+    return "|".join(parts)
+
+
+def _freeze_generic(block, examples):
+    """Compile a non-Hybrid block (e.g. a SymbolBlock from the export
+    seam) into one jitted inference callable with the CachedGraph.raw
+    contract: raw values in, tuple of raw jax arrays out.  Parameters
+    are baked as constants — fine for serving, where weights are
+    immutable."""
+    import jax
+
+    from .. import autograd as _autograd
+
+    ctx = examples[0].context
+
+    def fn(*vals):
+        ins = [NDArray(v, ctx=ctx) for v in vals]
+        with _autograd.pause():
+            out = block(*ins)
+        outs = out if isinstance(out, (list, tuple)) else (out,)
+        return tuple(o._read() for o in outs)
+
+    jitted = jax.jit(fn)
+    jax.block_until_ready(jitted(*[e._read() for e in examples]))
+    return jitted
+
+
+class ModelServer:
+    """Continuous-batching inference server over one model.
+
+    ``block`` is a :class:`~mxnet_tpu.gluon.HybridBlock` (served through
+    the direct cached-graph entry — no autograd bookkeeping) or any
+    Block (e.g. a ``SymbolBlock`` imported from the ``export()`` seam —
+    see :meth:`from_exported`), serving host-side numpy results.
+
+    Requests are single samples WITHOUT the batch dimension; the server
+    assembles them into padded, bucketed batches and runs one compiled
+    call per bucket.  ``submit`` is non-blocking and returns a
+    :class:`~mxnet_tpu.serving.batcher.Request` future; ``infer`` is the
+    blocking convenience wrapper.
+
+    Lifecycle: ``start()`` spawns the batcher + N dispatch workers;
+    ``stop(drain=True)`` (or context-manager exit, or SIGTERM via
+    :meth:`install_sigterm`) closes admission, drains every queued
+    request, and joins the threads.
+    """
+
+    def __init__(self, block, *, max_batch: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 workers: Optional[int] = None,
+                 length_buckets: Optional[Sequence[int]] = None,
+                 pad_axis: int = 0,
+                 batch_buckets: Optional[Sequence[int]] = None,
+                 batch_window_us: Optional[float] = None,
+                 unpad_outputs: bool = True,
+                 flight=None):
+        self._block = block
+        self.unpad_outputs = unpad_outputs
+        self.max_batch = int(get_env(MAX_BATCH_ENV) if max_batch is None
+                             else max_batch)
+        self.queue_depth = int(get_env(QUEUE_DEPTH_ENV)
+                               if queue_depth is None else queue_depth)
+        self.deadline_ms = float(get_env(DEADLINE_MS_ENV)
+                                 if deadline_ms is None else deadline_ms)
+        self.workers = max(1, int(get_env(WORKERS_ENV)
+                                  if workers is None else workers))
+        window_us = float(get_env(BATCH_WINDOW_US_ENV)
+                          if batch_window_us is None else batch_window_us)
+        self._bucketer = Bucketer(self.max_batch,
+                                  length_buckets=length_buckets,
+                                  pad_axis=pad_axis,
+                                  batch_buckets=batch_buckets)
+        reg = registry()
+        self._g_depth = reg.gauge(
+            "serving.queue_depth",
+            help="admission-queue depth (requests waiting for assembly)")
+        self._h_request = reg.histogram(
+            "serving.request_us",
+            help="per-request end-to-end latency (enqueue to done)")
+        self._h_dispatch = reg.histogram(
+            "serving.dispatch_us",
+            help="per-batch compiled-call wall time")
+        self._c_requests = reg.counter(
+            "serving.requests", help="requests admitted")
+        self._c_done = reg.counter(
+            "serving.requests_done", help="requests completed ok")
+        self._c_rej_429 = reg.counter(
+            "serving.rejected_429",
+            help="requests rejected at admission (queue full)")
+        self._c_rej_deadline = reg.counter(
+            "serving.rejected_deadline",
+            help="requests rejected at assembly (deadline expired)")
+        self._c_batches = reg.counter(
+            "serving.batches", help="batched compiled calls dispatched")
+        self._c_real = reg.counter(
+            "serving.tokens_real",
+            help="real (unpadded) elements served — batch-efficiency "
+                 "numerator")
+        self._c_padded = reg.counter(
+            "serving.tokens_padded",
+            help="padded elements dispatched — batch-efficiency "
+                 "denominator")
+        self._flight = _flight_recorder() if flight is None else flight
+        self._admission = AdmissionQueue(self.queue_depth,
+                                         gauge=self._g_depth)
+        self._out: _queue.Queue = _queue.Queue(
+            maxsize=max(2, 2 * self.workers))
+        self._batcher = Batcher(self._admission, self._bucketer,
+                                self._out, self.max_batch,
+                                window_us / 1e6, self._expire,
+                                on_error=self._fail)
+        self._graphs: Dict[Tuple, object] = {}
+        self._compile_lock = threading.Lock()
+        self._lifecycle_lock = threading.Lock()
+        self._threads = []
+        self._started = False
+        self._stopped = False
+        self._drain_down = False
+        self._rid = itertools.count()
+        self._prev_sigterm = None
+
+    # -- construction helpers ----------------------------------------------
+    @classmethod
+    def from_exported(cls, symbol_file: str, input_names,
+                      param_file: Optional[str] = None, ctx=None, **kw
+                      ) -> "ModelServer":
+        """Serve an exported symbol/params pair (the
+        ``examples/serve_c_api.md`` export seam): loads via
+        ``SymbolBlock.imports`` and serves through one jitted graph."""
+        from ..gluon.block import SymbolBlock
+        blk = SymbolBlock.imports(symbol_file, input_names, param_file,
+                                  ctx=ctx)
+        return cls(blk, **kw)
+
+    # -- lifecycle ----------------------------------------------------------
+    def start(self) -> "ModelServer":
+        with self._lifecycle_lock:
+            if self._started:
+                return self
+            if self._stopped:
+                raise ServerClosed("server already stopped")
+            self._batcher.start()
+            for i in range(self.workers):
+                t = threading.Thread(target=self._worker_loop,
+                                     name=f"mxtpu-serving-worker-{i}",
+                                     daemon=True)
+                t.start()
+                self._threads.append(t)
+            self._started = True
+        return self
+
+    def stop(self, drain: bool = True, timeout: Optional[float] = None
+             ) -> None:
+        """Shut down: close admission (further submits raise
+        ServerClosed), then either drain every queued request through
+        the normal path (``drain=True``) or fail them immediately."""
+        with self._lifecycle_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+            self._admission.close()
+            if not drain:
+                for r in self._admission.shed():
+                    self._finish(r, error=ServerClosed(
+                        "server stopped without draining"))
+            if self._started:
+                self._batcher.join(timeout)
+                if self._batcher.is_alive():
+                    # timed-out join: the batcher may still be putting
+                    # batches — sentinels would race AHEAD of them and
+                    # strand their requests.  Flag the workers down
+                    # instead; they drain whatever still arrives and
+                    # exit on an idle tick.
+                    self._drain_down = True
+                else:
+                    for _ in self._threads:
+                        try:
+                            self._out.put(None, timeout=1.0)
+                        except _queue.Full:   # a wedged worker: flag
+                            self._drain_down = True
+                            break
+                for t in self._threads:
+                    t.join(timeout)
+            else:
+                # never started: nothing will drain the queue — shed
+                for r in self._admission.shed():
+                    self._finish(r, error=ServerClosed(
+                        "server stopped before start"))
+            self._g_depth.set(0)
+
+    def __enter__(self) -> "ModelServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(drain=exc_type is None)
+
+    def install_sigterm(self) -> None:
+        """Chain a SIGTERM handler that drains and stops the server
+        (the k8s/preemption graceful-shutdown contract), then calls the
+        previous handler.  The drain runs on its OWN (non-daemon)
+        thread: the signal may have interrupted a frame on this very
+        thread holding the locks stop() needs, so blocking inside the
+        handler would deadlock — the handler returns immediately, the
+        interrupted frame resumes and releases its locks, and the drain
+        thread keeps the process alive until shutdown completes."""
+        prev = signal.getsignal(signal.SIGTERM)
+        self._prev_sigterm = prev
+
+        def drain_then_chain(signum, frame):
+            self.stop(drain=True)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        def handler(signum, frame):
+            threading.Thread(target=drain_then_chain,
+                             args=(signum, frame),
+                             name="mxtpu-serving-sigterm-drain",
+                             daemon=False).start()
+
+        signal.signal(signal.SIGTERM, handler)
+
+    def uninstall_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
+
+    # -- client surface ------------------------------------------------------
+    def submit(self, *inputs, deadline_ms: Optional[float] = None
+               ) -> Request:
+        """Enqueue one sample (inputs WITHOUT the batch dim); returns a
+        Request future.  Raises :class:`ServerOverloaded` when the
+        admission queue is full, :class:`ServerClosed` after stop, and
+        :class:`NoBucketError` when no shape bucket fits."""
+        arrs = []
+        for x in inputs:
+            a = x.asnumpy() if isinstance(x, NDArray) else _np.asarray(x)  # mxlint: disable=hidden-host-sync — request ingestion: client samples become host buffers at the serving boundary
+            cd = jax_compute_dtype(a.dtype)
+            if a.dtype != cd:
+                a = a.astype(cd)
+            arrs.append(a)
+        key = self._bucketer.sample_key(arrs)
+        ms = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        deadline = (time.monotonic() + ms / 1e3) if ms > 0 else None
+        req = Request(next(self._rid), tuple(arrs), key, deadline)
+        try:
+            self._admission.submit(req)
+        except ServerOverloaded:
+            self._c_rej_429.inc()
+            raise
+        self._c_requests.inc()
+        return req
+
+    def infer(self, *inputs, timeout: Optional[float] = None,
+              deadline_ms: Optional[float] = None):
+        """Blocking convenience: submit + wait; returns host numpy
+        output(s)."""
+        return self.submit(*inputs, deadline_ms=deadline_ms
+                           ).result(timeout)
+
+    def warmup(self, *samples) -> int:
+        """Precompile every (shape bucket, batch bucket) signature the
+        given example samples imply, so no live request pays a compile.
+        Each sample is one request's input tuple (or a single array).
+        Returns the number of executables now resident."""
+        for sample in samples:
+            sample = sample if isinstance(sample, (tuple, list)) \
+                else (sample,)
+            # canonicalize dtypes exactly as submit() does, or the
+            # warmed signatures can never match live requests
+            arrs = []
+            for a in sample:
+                a = _np.asarray(a)
+                cd = jax_compute_dtype(a.dtype)
+                arrs.append(a.astype(cd) if a.dtype != cd else a)
+            key = self._bucketer.sample_key(arrs)
+            for bsz in self._bucketer.batch_buckets:
+                self._graph_for(key, bsz)
+        return len(self._graphs)
+
+    def stats(self) -> dict:
+        """Serving-side registry view plus the derived
+        batch-formation-efficiency ratio."""
+        real, padded = self._c_real.n, self._c_padded.n
+        return {
+            "requests": self._c_requests.n,
+            "done": self._c_done.n,
+            "rejected_429": self._c_rej_429.n,
+            "rejected_deadline": self._c_rej_deadline.n,
+            "batches": self._c_batches.n,
+            "queue_depth": self._g_depth.value,
+            "tokens_real": real,
+            "tokens_padded": padded,
+            "batch_efficiency": round(real / padded, 4) if padded else 0.0,
+            "executables": len(self._graphs),
+        }
+
+    # -- compiled-graph resolution (cold path) -------------------------------
+    def _graph_for(self, key: Tuple, batch: int):
+        """The executable for one (shape bucket, batch bucket): built on
+        first use (``warmup()`` prebuilds), then a dict hit forever."""
+        gk = (key, batch)
+        g = self._graphs.get(gk)
+        if g is not None:
+            return g
+        with self._compile_lock:
+            g = self._graphs.get(gk)
+            if g is not None:
+                return g
+            examples = [nd_array(_np.zeros((batch,) + tuple(shape),
+                                           dtype=dt))
+                        for shape, dt in key]
+            from ..gluon.block import HybridBlock
+            if isinstance(self._block, HybridBlock):
+                g = self._block.cached_graph(*examples).raw
+            else:
+                g = _freeze_generic(self._block, examples)
+            self._graphs[gk] = g
+            return g
+
+    # -- dispatch (hot path) -------------------------------------------------
+    def _worker_loop(self) -> None:
+        while True:
+            try:
+                batch = self._out.get(timeout=0.25)
+            except _queue.Empty:
+                if self._drain_down:
+                    break
+                continue
+            if batch is None:
+                break
+            try:
+                graph = self._graph_for(batch.key, batch.batch)
+                self._dispatch_batch(graph, batch)
+            except Exception as e:  # a failed batch fails ITS requests,
+                for req in batch.requests:      # never the server
+                    if not req.done():
+                        self._finish(req, error=e)
+
+    @hot_path("dispatch")
+    def _dispatch_batch(self, graph, batch) -> None:
+        """Serving dispatch entry point: ONE compiled call for the whole
+        bucket, one batched device→host transfer, then per-request
+        fan-out."""
+        t0 = time.monotonic()
+        for req in batch.requests:
+            req.t_dispatch = t0
+        flat = graph(*batch.arrays)
+        # response materialization: ONE batched device→host transfer per
+        # BATCH (results are host values by contract), not per request
+        outs = [_np.asarray(v) for v in flat]  # mxlint: disable=hidden-host-sync,hot-path-purity — batched response readback, one transfer (and one buffer) per batch
+        # inc(), not .n bumps: N workers finish batches concurrently and
+        # the direct-bump idiom is reserved for single-threaded hot loops
+        self._h_dispatch.observe((time.monotonic() - t0) * 1e6)
+        self._c_batches.inc()
+        self._c_real.inc(batch.real)
+        self._c_padded.inc(batch.padded)
+        for i, req in enumerate(batch.requests):
+            req.batch_size = batch.batch
+            row = self._unpad_row(tuple(o[i] for o in outs), req)
+            self._finish(req, result=row[0] if len(row) == 1 else row)
+
+    def _unpad_row(self, row, req: Request):
+        """Undo length-bucket padding on a request's outputs: slice axis
+        ``pad_axis`` (per-sample) back to the request's real length when
+        its size equals the padded bucket — a per-position output like
+        BERT's MLM logits trims; a pooled output with a different
+        ``pad_axis`` extent passes through.  A pooled dim that
+        COINCIDES with a bucket size (e.g. a 64-wide embedding under a
+        64-token bucket) is indistinguishable from a length axis —
+        construct with ``unpad_outputs=False`` and slice client-side
+        for such models.  The padded positions' VALUES remain a model
+        contract: a sequence model that attends everywhere must take a
+        mask/valid-length input (pass it as part of the request) — the
+        server cannot invent one."""
+        bkt = self._bucketer
+        if not bkt.length_buckets or not self.unpad_outputs:
+            return row
+        ax = bkt.pad_axis
+        padded = req.key[0][0][ax]
+        real = req.inputs[0].shape[ax]
+        if real == padded:
+            return row
+        out = []
+        for o in row:
+            if o.ndim > ax and o.shape[ax] == padded:
+                sl = [slice(None)] * o.ndim
+                sl[ax] = slice(0, real)
+                o = o[tuple(sl)]
+            out.append(o)
+        return tuple(out)
+
+    def _finish(self, req: Request, result=None, error=None) -> None:
+        """Complete one request: latency histogram, counters, flight
+        record, wake the client."""
+        req.t_done = time.monotonic()
+        req._result = result
+        req._error = error
+        dur_us = (req.t_done - req.t_enqueue) * 1e6
+        if error is None:
+            self._h_request.observe(dur_us)
+            self._c_done.inc()
+        self._flight.record_request(
+            request_id=req.rid,
+            enqueue=round(req.t_enqueue, 6),
+            assemble=round(req.t_assemble, 6),
+            dispatch=round(req.t_dispatch, 6),
+            done=round(req.t_done, 6),
+            bucket=_key_str(req.key),
+            batch_size=req.batch_size,
+            us=round(dur_us, 1),
+            ok=error is None)
+        req._event.set()
+
+    def _expire(self, req: Request) -> None:
+        self._c_rej_deadline.inc()
+        self._finish(req, error=DeadlineExceeded(
+            f"request {req.rid} spent its deadline queued (429-style); "
+            f"the server is over capacity — back off"))
+
+    def _fail(self, req: Request, error: BaseException) -> None:
+        """Assembly-failure path: same accounting as every other
+        completion (flight record, timestamps), just with an error."""
+        self._finish(req, error=error)

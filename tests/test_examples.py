@@ -24,6 +24,14 @@ def test_example_mnist_mlp_runs():
     assert "epoch 1:" in r.stdout
 
 
+def test_example_serve_continuous_batching_runs():
+    r = _run(["examples/serve_continuous_batching.py", "--clients", "2",
+              "--requests", "20"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "served 40 requests" in r.stdout
+    assert "batch efficiency" in r.stdout
+
+
 def test_example_imagenet_style_runs(tmp_path):
     rec = str(tmp_path / "t.rec")
     r = _run(["examples/train_imagenet_style.py", "--epochs", "1",

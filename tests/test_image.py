@@ -54,6 +54,32 @@ def test_augmenter_pipeline():
     assert out.dtype == np.float32
 
 
+def test_legacy_call_only_augmenter_still_works(tmp_path):
+    """A user augmenter written against the pre-refactor surface
+    (overrides ONLY __call__, NDArray in/out) must keep working both
+    called directly and inside the iterator's apply_np chain."""
+    class Legacy(mimg.Augmenter):
+        def __call__(self, src):
+            return mx.nd.array(src.asnumpy() * 2.0)
+
+    img = mx.nd.array(np.full((4, 4, 3), 3.0, np.float32))
+    out = Legacy()(img)
+    np.testing.assert_array_equal(out.asnumpy(), np.full((4, 4, 3), 6.0))
+    # via the numpy chain entry the iterators use
+    arr = Legacy().apply_np(np.full((4, 4, 3), 3.0, np.float32))
+    np.testing.assert_array_equal(arr, np.full((4, 4, 3), 6.0))
+    # and end-to-end in ImageIter
+    p = str(tmp_path / "img0.png")
+    _save_img(p, seed=0)
+    it = mimg.ImageIter(batch_size=1, data_shape=(3, 24, 24),
+                        path_root=str(tmp_path),
+                        imglist=[[0.0, "img0.png"]],
+                        aug_list=[mimg.ForceResizeAug((24, 24)),
+                                  Legacy()])
+    batch = next(iter(it))
+    assert batch.data[0].shape == (1, 3, 24, 24)
+
+
 def test_image_iter(tmp_path):
     paths = []
     for i in range(6):
