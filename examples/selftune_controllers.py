@@ -1,0 +1,106 @@
+"""Self-tuning runtime: controller configuration (the config snippet).
+
+Arms the feedback-controller layer over a small imperative training
+loop: the BulkSizeController hill-climbs the live
+``MXNET_ENGINE_BULK_SIZE`` cap from the ``engine.flush_us`` histogram
+the loop itself produces, while the prefetch controller watches the
+loader gauge.  Demonstrates the three configuration surfaces:
+
+1. **stock, knob-gated** — ``tuning.start()`` arms all four standard
+   controllers; ``MXTPU_TUNE_*`` env knobs enable/disable each one and
+   ``MXTPU_TUNE_DRY_RUN=1`` turns the whole layer into an observer;
+2. **custom rails** — construct controllers yourself with explicit
+   guard rails / hysteresis and pass them to ``tuning.start``;
+3. **synchronous ticks** — skip the timer thread entirely and call
+   ``runtime().tick_all()`` at your own cadence (what this script does,
+   so the demo is deterministic and prints each decision).
+
+Pair with ``MXTPU_COMPILE_CACHE_DIR=/path`` to also persist every
+compiled executable across restarts (the second run of this script
+then performs ~0 recompiles — watch ``tuning.compiles``).
+
+    python examples/selftune_controllers.py --steps 8 --cpu
+"""
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=8,
+                    help="controller ticks (one workload slice each)")
+    ap.add_argument("--ops", type=int, default=400,
+                    help="fusable ops dispatched per slice")
+    ap.add_argument("--dry-run", action="store_true",
+                    help="record decisions, apply nothing")
+    ap.add_argument("--cpu", action="store_true",
+                    help="force the virtual CPU mesh (CI smoke mode)")
+    args = ap.parse_args()
+
+    if args.cpu:
+        from mxnet_tpu.base import force_cpu_mesh
+        force_cpu_mesh(1)
+
+    import mxnet_tpu as mx  # noqa: F401 — backend init
+    from mxnet_tpu import nd, tuning
+    from mxnet_tpu.observability.registry import registry
+
+    # -- configuration surface 2: custom rails --------------------------
+    controllers = [
+        tuning.BulkSizeController(
+            vmin=4, vmax=48,          # guard rails for this host class
+            min_segments=4,           # decide on few segments (demo)
+            hysteresis=1,
+            enabled=True,             # bypass the MXTPU_TUNE_BULK knob
+            dry_run=args.dry_run),
+        tuning.PrefetchController(
+            initial=4, vmax=32, enabled=True, dry_run=args.dry_run),
+    ]
+    rt = tuning.runtime()
+    for c in controllers:
+        rt.add(c)
+    # configuration surface 1 would instead be just:  tuning.start()
+    # (stock controllers, every one gated by its MXTPU_TUNE_* knob)
+
+    def slice_of_work():
+        """One workload slice: a chain of fusable elementwise ops —
+        each chain becomes bulk segments capped at the LIVE bulk
+        size, feeding the engine.flush_us histogram the controller
+        steers on."""
+        x = nd.ones((256, 256))
+        y = x
+        for _ in range(args.ops):
+            y = y * 1.0001 + 0.0001
+        return float(y.asnumpy()[0, 0])   # sync point: flush
+
+    print(f"{'tick':>4} {'bulk':>5} {'flush p50us':>12} "
+          f"{'decision':<60}")
+    for t in range(args.steps):
+        slice_of_work()
+        # -- configuration surface 3: synchronous ticks ----------------
+        decisions = rt.tick_all()
+        bulk = os.environ.get("MXNET_ENGINE_BULK_SIZE", "15")
+        p50 = registry().snapshot()["engine.flush_us"]["p50"]
+        what = "; ".join(
+            f"{d['controller']}: {d['from']:g}->{d['to']:g}"
+            f"{'' if d['applied'] else ' (dry-run/held)'}"
+            for d in decisions) or "-"
+        print(f"{t:>4} {bulk:>5} {p50:>12.1f} {what:<60}")
+
+    snap = registry().snapshot()
+    print(f"\ndecisions={snap.get('tuning.bulk_size.decisions', 0)} "
+          f"applied={snap.get('tuning.bulk_size.applied', 0)} "
+          f"clamped={snap.get('tuning.bulk_size.clamped', 0)} "
+          f"converged_bulk={os.environ.get('MXNET_ENGINE_BULK_SIZE')}")
+    print("flight tuning ring:",
+          len(__import__('mxnet_tpu').observability.flight.recorder()
+              .tunings()), "decision record(s)")
+    print("SELFTUNE_EXAMPLE_OK")
+
+
+if __name__ == "__main__":
+    main()

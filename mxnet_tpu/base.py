@@ -257,7 +257,44 @@ register_env("MXTPU_SERVING_WORKERS", 2, int,
              "formation overlap device execution.")
 register_env("MXTPU_SERVING_BATCH_WINDOW_US", 2000.0, float,
              "Serving: how long the batcher waits for the current "
-             "shape bucket to fill before dispatching a partial batch.")
+             "shape bucket to fill before dispatching a partial batch. "
+             "Read live per batch, so the BatchWindowController (and "
+             "operators) can adapt it on a running server.")
+register_env("MXTPU_TUNE_INTERVAL", 2.0, float,
+             "Self-tuning: seconds between controller timer-thread "
+             "ticks (mxnet_tpu.tuning).")
+register_env("MXTPU_TUNE_DRY_RUN", False, bool,
+             "Self-tuning: compute and record every controller "
+             "decision (tuning.* metrics + flight ring) but apply "
+             "nothing — the observe-before-trust mode.")
+register_env("MXTPU_TUNE_BULK", True, bool,
+             "Self-tuning: enable the BulkSizeController "
+             "(hill-climbs MXNET_ENGINE_BULK_SIZE from the live "
+             "engine.flush_us histogram) when the runtime starts.")
+register_env("MXTPU_TUNE_PREFETCH", True, bool,
+             "Self-tuning: enable the PrefetchController (adapts the "
+             "DataLoader prefetch depth from the loader.prefetch_depth "
+             "gauge) when the runtime starts.")
+register_env("MXTPU_TUNE_BATCH_WINDOW", True, bool,
+             "Self-tuning: enable the BatchWindowController (adapts "
+             "MXTPU_SERVING_BATCH_WINDOW_US from serving.queue_depth "
+             "and serving.request_us p99) when the runtime starts.")
+register_env("MXTPU_TUNE_FLEET_GATHER", True, bool,
+             "Self-tuning: enable the FleetGatherController (streams "
+             "the multi-host metric gather over the barrier-free "
+             "KV-store transport on the timer thread) when the runtime "
+             "starts in an initialized process group.")
+register_env("MXTPU_COMPILE_CACHE_DIR", "", str,
+             "Persistent compilation cache directory: exact-mode bulk "
+             "segments and HybridBlock cached-graph executables are "
+             "serialized here and reloaded by later processes, so a "
+             "restart (auto-resume, server cold start) skips the XLA "
+             "compile.  Unset disables.")
+register_env("MXTPU_COMPILE_CACHE_JAX", True, bool,
+             "With MXTPU_COMPILE_CACHE_DIR set, also point jax's own "
+             "persistent compilation cache at <dir>/jax so plain "
+             "jax.jit paths (per-op fns, training vjp graphs) reuse "
+             "compiles across processes too.")
 
 
 # ---------------------------------------------------------------------------

@@ -182,6 +182,15 @@ class Engine:
         n = get_env("MXNET_ENGINE_BULK_SIZE")
         return max(1, int(n))
 
+    def set_bulk_size(self, n: int) -> None:
+        """Set the live ``MXNET_ENGINE_BULK_SIZE`` cap — the
+        BulkSizeController's apply path.  Environment-backed on purpose:
+        the ``bulk_size`` property reads the knob at segment creation,
+        so the new cap takes effect on the very next segment, and child
+        processes (bench subprocesses, spawned workers) inherit the
+        tuned value."""
+        os.environ["MXNET_ENGINE_BULK_SIZE"] = str(max(1, int(n)))
+
     @property
     def bulk_fuse_mode(self) -> str:
         """Segment codegen mode: 'exact' (default — one dispatch per

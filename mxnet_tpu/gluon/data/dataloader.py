@@ -36,7 +36,28 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 _RETRYABLE_WORKER_ERRORS = (TransientFault, OSError, TimeoutError,
                             ConnectionError)
 
-__all__ = ["DataLoader", "default_batchify_fn"]
+__all__ = ["DataLoader", "default_batchify_fn", "set_prefetch_override",
+           "prefetch_override"]
+
+# live prefetch-depth override (the PrefetchController's apply target):
+# when set, every DataLoader's next __iter__ uses this depth for its
+# prefetch queue and in-flight window instead of its constructor value.
+# Process-wide by design — the controller steers the one signal
+# (loader.prefetch_depth) all loaders share.
+_prefetch_override: Optional[int] = None
+
+
+def set_prefetch_override(depth: Optional[int]) -> None:
+    """Set (or clear, with None) the live prefetch-depth target.  Takes
+    effect at each loader's next ``__iter__`` — epoch boundaries, the
+    natural reconfiguration point for a pipeline whose queue is sized
+    at iterator construction."""
+    global _prefetch_override
+    _prefetch_override = None if depth is None else max(1, int(depth))
+
+
+def prefetch_override() -> Optional[int]:
+    return _prefetch_override
 
 
 class _WorkerError:
@@ -98,6 +119,13 @@ class DataLoader:
             help="prefetch queue depth sampled at each batch handoff — "
                  "near-capacity means workers keep ahead of the device; "
                  "near-zero means the pipeline is starving the step")
+        self._g_capacity = reg.gauge(
+            "loader.prefetch_capacity",
+            help="prefetch queue capacity of the most recent __iter__ "
+                 "— what the depth gauge can reach; the "
+                 "PrefetchController's evidence that an applied target "
+                 "is actually live (overrides apply at epoch "
+                 "boundaries)")
 
     def __len__(self):
         return len(self._batch_sampler)
@@ -154,12 +182,17 @@ class DataLoader:
                 yield self._make_batch(indices, bi)
             return
         # threaded prefetch pipeline with a bounded in-flight window so a
-        # slow consumer never materializes more than window batches
+        # slow consumer never materializes more than window batches.
+        # The live override (PrefetchController) wins over the
+        # constructor depth, resolved per epoch at iterator build.
         import collections
         from concurrent.futures import ThreadPoolExecutor
-        q: "queue.Queue" = queue.Queue(maxsize=self._prefetch or 2)
+        prefetch = _prefetch_override if _prefetch_override is not None \
+            else (self._prefetch or 2)
+        self._g_capacity.set(prefetch)
+        q: "queue.Queue" = queue.Queue(maxsize=prefetch)
         sentinel = object()
-        window = self._num_workers + (self._prefetch or 2)
+        window = self._num_workers + prefetch
         active: dict = {}   # worker thread name -> batch index in progress
 
         def producer():

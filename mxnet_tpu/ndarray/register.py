@@ -349,6 +349,30 @@ def segment_cache_clear() -> None:
     _segment_cache.cache_clear()
 
 
+# persistent compile-cache seam (installed by tuning.compile_cache when
+# MXTPU_COMPILE_CACHE_DIR is set): (lookup, store) callables consulted
+# ONLY on an in-memory segment-cache miss — the cold compile path.  A
+# hook indirection, not an import: the frontend layer stays free of a
+# tuning dependency, and the calls resolve to no edge in mxlint's call
+# graph, keeping the disk tier provably off the dispatch hot path.
+_persist_hooks = None
+
+
+def _install_persist_hooks(lookup, store) -> None:
+    global _persist_hooks
+    _persist_hooks = (lookup, store)
+
+
+def _segment_persist_key(needed, nodes, ext_vals) -> str:
+    """Canonical string form of the segment signature for the disk tier
+    — the in-memory ``_segment_cache`` key minus the device id (the
+    cache's backend fingerprint covers platform/device kind, so an
+    executable can be replayed by any process on the same chip type)."""
+    return repr((needed, nodes,
+                 tuple((tuple(v.shape), str(_np.dtype(v.dtype)))
+                       for v in ext_vals)))
+
+
 def clear_op_caches() -> None:
     """Drop every Operator's compiled fn/vjp caches, plus the fused-segment
     executables (which close over per-op fns) and the abstract-eval cache.
@@ -485,7 +509,8 @@ _exact_compile_broken = False
 
 
 def _compile_segment_exact(nodes: Tuple, needed: Optional[Tuple],
-                           ext_vals: Sequence, device) -> Callable:
+                           ext_vals: Sequence, device,
+                           persist_key: Optional[str] = None) -> Callable:
     """'exact' codegen (the default): ONE PJRT executable per segment but
     with XLA's fusion passes disabled, so every node keeps the same
     kernels the unbulked per-op path compiles — results are BITWISE
@@ -493,6 +518,12 @@ def _compile_segment_exact(nodes: Tuple, needed: Optional[Tuple],
     reductions) while the host still pays a single dispatch for the whole
     segment (the reference's bulking economics exactly: batch the pushes,
     not the arithmetic).
+
+    With ``persist_key`` set (the persistent compile cache is wired), a
+    previously-compiled executable for the same signature+backend is
+    deserialized from disk instead of compiled — the restart-without-
+    recompile path; a real compile is serialized back for the next
+    process.
 
     Falls back to a node-by-node interpreter over the per-op jitted fns
     (still bitwise, one jit dispatch per node) if the lower/compile
@@ -505,11 +536,6 @@ def _compile_segment_exact(nodes: Tuple, needed: Optional[Tuple],
             from jax._src.lib import xla_client as xc
             jax_array_cls = jax.Array
             device_put = jax.device_put
-            # keep_unused: liveness-DCE can leave some external inputs
-            # unused; the raw executable is fed ALL of them, so jit must
-            # not prune its parameter list (kept_var_idx filtering is a
-            # jit-call-path service we bypass here)
-            lowered = jax.jit(fused, keep_unused=True).lower(*ext_vals)
             opts = xc.CompileOptions()
             opts.executable_build_options.debug_options \
                 .xla_disable_hlo_passes = "fusion,cpu-instruction-fusion"
@@ -517,8 +543,22 @@ def _compile_segment_exact(nodes: Tuple, needed: Optional[Tuple],
                 xc.DeviceAssignment.create(
                     # mxlint: disable=hot-path-purity — compile miss
                     _np.asarray([[device.id]], dtype=_np.int32))
-            exe = device.client.compile(
-                lowered.compiler_ir().operation.get_asm(), opts)
+            exe = None
+            hooks = _persist_hooks
+            if hooks is not None and persist_key is not None:
+                exe = hooks[0](persist_key, device, opts)
+            if exe is None:
+                # keep_unused: liveness-DCE can leave some external
+                # inputs unused; the raw executable is fed ALL of them,
+                # so jit must not prune its parameter list
+                # (kept_var_idx filtering is a jit-call-path service we
+                # bypass here)
+                lowered = jax.jit(fused,
+                                  keep_unused=True).lower(*ext_vals)
+                exe = device.client.compile(
+                    lowered.compiler_ir().operation.get_asm(), opts)
+                if hooks is not None and persist_key is not None:
+                    hooks[1](persist_key, device, exe)
 
             def run(*vals):
                 try:
@@ -649,9 +689,15 @@ class _BulkSegment:
         try:
             if not hit:
                 if self.fuse == "exact" and not taped:
+                    # the disk-tier key is only built on a true
+                    # in-memory miss — the steady-state flush never
+                    # pays the repr
+                    pkey = None if _persist_hooks is None else \
+                        _segment_persist_key(needed, tuple(self.nodes),
+                                             self.ext_vals)
                     fn = _compile_segment_exact(
                         tuple(self.nodes), needed, self.ext_vals,
-                        self.ctx.device)
+                        self.ctx.device, persist_key=pkey)
                 else:
                     fn = _compile_segment(tuple(self.nodes), taped,
                                           needed)

@@ -27,6 +27,15 @@ dump carries both rings side by side (``steps`` + ``requests``), so a
 dying server explains its last ~256 requests the same way a dying
 trainer explains its last steps.
 
+Self-tuning (PR-8): a third ring holds the last-N **controller
+decisions** — ``record_tuning()`` appends one record per
+:mod:`mxnet_tpu.tuning` controller decision (controller, from → to,
+applied/held/dry-run, the reason string).  A crash dump carries it as
+``tuning`` next to ``steps``/``requests``, so a bad controller decision
+— the knob flap that preceded the OOM — is visible in the post-mortem
+ring, not just in a Prometheus history that died with the scrape
+endpoint.
+
 Cost discipline: ``record()`` is a dict build and a deque append — no
 formatting, no I/O, no device sync.  Device-backed values (the step
 loss) are stored as live references and materialized only at dump time,
@@ -99,6 +108,8 @@ class FlightRecorder:
             maxlen=max(1, self.capacity))
         self._req_ring: Deque[dict] = collections.deque(
             maxlen=max(1, self.capacity))
+        self._tune_ring: Deque[dict] = collections.deque(
+            maxlen=max(1, self.capacity))
         self._lock = threading.Lock()
         self._installed = False
         self._prev_hook = None
@@ -124,6 +135,14 @@ class FlightRecorder:
         with self._lock:
             self._req_ring.append(fields)
 
+    def record_tuning(self, **fields) -> None:
+        """Append one controller-decision record to the tuning ring
+        (same cost discipline: dict build + deque append)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._tune_ring.append(fields)
+
     def records(self) -> List[dict]:
         with self._lock:
             return list(self._ring)
@@ -132,10 +151,15 @@ class FlightRecorder:
         with self._lock:
             return list(self._req_ring)
 
+    def tunings(self) -> List[dict]:
+        with self._lock:
+            return list(self._tune_ring)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._req_ring.clear()
+            self._tune_ring.clear()
 
     def _resolve_path(self, path: Optional[str]) -> str:
         if path:
@@ -162,6 +186,8 @@ class FlightRecorder:
                      for rec in self._ring]
             requests = [{k: _materialize(v) for k, v in rec.items()}
                         for rec in self._req_ring]
+            tunings = [{k: _materialize(v) for k, v in rec.items()}
+                       for rec in self._tune_ring]
         try:
             snapshot = registry().snapshot()
         except Exception:   # noqa: BLE001 — a half-torn registry still
@@ -176,6 +202,8 @@ class FlightRecorder:
             "steps": steps,
             "n_requests": len(requests),
             "requests": requests,
+            "n_tuning": len(tunings),
+            "tuning": tunings,
             "snapshot": snapshot,
         }
         try:

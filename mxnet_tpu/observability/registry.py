@@ -37,7 +37,8 @@ from ..base import MXNetError
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
            "host_id", "gather_host_states", "last_host_states",
-           "merge_host_states", "group_host_entries", "state_bounds",
+           "ingest_host_states", "merge_host_states",
+           "group_host_entries", "state_bounds",
            "state_cumulative_buckets"]
 
 # namespaced dotted names: `engine.ops_dispatched`, `loader.batches`, ...
@@ -415,6 +416,22 @@ def gather_host_states(reg: Optional[MetricsRegistry] = None
                       f"local view only ({e})", RuntimeWarning,
                       stacklevel=2)
     return states
+
+
+def ingest_host_states(states: List[Tuple[int, dict]]) -> None:
+    """Install externally-gathered per-host states as the remote view
+    ``last_host_states`` (and the ``MXTPU_METRICS_AGGREGATE`` endpoint)
+    serve between collective gathers.
+
+    The timer-thread fleet gather
+    (:class:`~mxnet_tpu.tuning.FleetGatherController`) feeds this from
+    the barrier-free KV transport: hosts publish and collect at their
+    own cadence, so a peer's state may be one of its ticks stale —
+    exactly the "remote as-of last gather" contract the serving path
+    already documents, just timer-fresh instead of checkpoint-fresh."""
+    global _last_host_states
+    _last_host_states = sorted(
+        ((int(h), dict(st)) for h, st in states), key=lambda hs: hs[0])
 
 
 def last_host_states(reg: Optional[MetricsRegistry] = None

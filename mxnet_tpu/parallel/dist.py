@@ -42,7 +42,7 @@ from ..base import MXNetError, get_env
 
 __all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
            "allreduce_host", "allgather_host", "allgather_bytes",
-           "broadcast_host", "barrier"]
+           "broadcast_host", "barrier", "kv_publish", "kv_collect"]
 
 
 def is_initialized() -> bool:
@@ -289,6 +289,97 @@ def allgather_bytes(data: bytes, timeout: Optional[float] = None):
         # (e.g. CPU: "Multiprocess computations aren't implemented");
         # deterministic per backend, so every rank takes the same branch
         return _allgather_bytes_kv(data, timeout)
+
+
+# -- barrier-free KV publish/collect ----------------------------------------
+#
+# NOT collectives: no barrier, no blocking peer read, no lockstep
+# call-count requirement — which is exactly why the timer-thread fleet
+# metric gather (tuning.FleetGatherController) can run free on every
+# host at its own cadence.  Each rank overwrite-publishes its newest
+# payload under a generation-stamped key; a collect reads whatever
+# generation every peer has published most recently (possibly one tick
+# stale — staleness is the price of barrier freedom, and the consumer's
+# contract already labels remote hosts "as-of last gather").
+
+_kv_pub_lock = threading.Lock()
+_kv_pub_gens = {}      # prefix -> next generation for THIS process
+
+
+def kv_publish(prefix: str, payload: bytes) -> None:
+    """Publish this rank's ``payload`` under ``prefix`` (overwrite
+    semantics: a fresh generation-stamped key is written, older own
+    generations deleted best-effort).  Requires an initialized process
+    group.
+
+    Restart-safe: the first publish of a fresh process resumes ABOVE
+    any generations a dead predecessor of the same rank left in the
+    store (and purges them), so ``kv_collect`` prefers the live
+    incarnation's state immediately instead of serving the dead
+    process's frozen payload until the new counter catches up."""
+    import base64
+    from jax._src import distributed
+    if not is_initialized():
+        raise MXNetError("kv_publish requires an initialized process "
+                         "group (init_process_group)")
+    client = distributed.global_state.client
+    r = rank()
+    own = f"{prefix}/{r}"
+    with _kv_pub_lock:
+        gen = _kv_pub_gens.get(prefix)
+        if gen is None:
+            gen = 0
+            try:
+                for k, _v in client.key_value_dir_get(own):
+                    try:
+                        gen = max(gen, int(k.rsplit("/", 1)[1]) + 1)
+                    except (ValueError, IndexError):
+                        continue
+            except Exception:   # noqa: BLE001 — empty/missing dir (the
+                pass            # common case) or transport hiccup: gen 0
+        _kv_pub_gens[prefix] = gen + 1
+    key = f"{own}/{gen:012d}"
+    client.key_value_set(key, base64.b64encode(payload).decode("ascii"))
+    try:
+        # purge every strictly-OLDER own generation — the previous
+        # tick's and any dead predecessor's.  Gen-compared, not
+        # key-compared: a concurrent publisher (two controllers on one
+        # process) may have already written a NEWER generation, which
+        # must survive this purge.  Best-effort; collect picks the
+        # highest either way.
+        for k, _v in client.key_value_dir_get(own):
+            try:
+                if int(k.rsplit("/", 1)[1]) < gen:
+                    client.key_value_delete(k)
+            except (ValueError, IndexError):
+                continue
+    except Exception:   # noqa: BLE001 — cleanup is best-effort; a few
+        pass            # stale keys beat a failed publish
+
+
+def kv_collect(prefix: str):
+    """Every rank's most recently published payload under ``prefix`` as
+    ``{rank: bytes}`` (only ranks that have published appear).  Never
+    blocks on a peer: a rank that has not published yet is simply
+    absent from this collect and present in a later one."""
+    import base64
+    from jax._src import distributed
+    if not is_initialized():
+        raise MXNetError("kv_collect requires an initialized process "
+                         "group (init_process_group)")
+    client = distributed.global_state.client
+    newest = {}            # rank -> (gen, value)
+    for key, value in client.key_value_dir_get(prefix):
+        parts = key.rsplit("/", 2)
+        if len(parts) != 3:
+            continue
+        try:
+            r, gen = int(parts[1]), int(parts[2])
+        except ValueError:
+            continue
+        if r not in newest or gen > newest[r][0]:
+            newest[r] = (gen, value)
+    return {r: base64.b64decode(v) for r, (_g, v) in newest.items()}
 
 
 def broadcast_host(x):

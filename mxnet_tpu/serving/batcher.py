@@ -206,7 +206,7 @@ class Batcher:
     (its ``put`` blocking is how dispatch pressure reaches admission)."""
 
     def __init__(self, admission: AdmissionQueue, bucketer, out_queue,
-                 max_batch: int, window_s: float,
+                 max_batch: int, window_s,
                  on_expired: Callable[[Request], None],
                  on_error: Optional[Callable[[Request, BaseException],
                                              None]] = None):
@@ -214,6 +214,10 @@ class Batcher:
         self._bucketer = bucketer
         self._out = out_queue
         self._max_batch = max_batch
+        # a float is a frozen window; a CALLABLE is re-read before every
+        # batch pop — the live-knob mode the BatchWindowController
+        # adapts (one get_env per assembled batch: noise next to the
+        # window it configures)
         self._window = window_s
         self._on_expired = on_expired
         self._on_error = on_error
@@ -234,8 +238,9 @@ class Batcher:
 
     def _run(self) -> None:
         while True:
-            popped = self._admission.pop_bucket(self._max_batch,
-                                                self._window)
+            window = self._window() if callable(self._window) \
+                else self._window
+            popped = self._admission.pop_bucket(self._max_batch, window)
             if popped is None:
                 break
             batch_reqs, expired = popped

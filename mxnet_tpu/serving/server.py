@@ -53,6 +53,12 @@ WORKERS_ENV = "MXTPU_SERVING_WORKERS"
 BATCH_WINDOW_US_ENV = "MXTPU_SERVING_BATCH_WINDOW_US"
 
 
+def _live_window_s() -> float:
+    """The knob-governed batch window, re-read before every batch pop
+    (the BatchWindowController's live adaptation seam)."""
+    return float(get_env(BATCH_WINDOW_US_ENV)) / 1e6
+
+
 def _key_str(key: Tuple) -> str:
     """Compact human-readable bucket tag for records/debugging:
     ``32:int32|32:int32`` — dtype included, so two buckets differing
@@ -128,8 +134,13 @@ class ModelServer:
                                  if deadline_ms is None else deadline_ms)
         self.workers = max(1, int(get_env(WORKERS_ENV)
                                   if workers is None else workers))
-        window_us = float(get_env(BATCH_WINDOW_US_ENV)
-                          if batch_window_us is None else batch_window_us)
+        # explicit argument = frozen window; knob-governed (the default)
+        # = read LIVE per batch, so the BatchWindowController (or an
+        # operator export) retunes a running server
+        if batch_window_us is None:
+            window_s = _live_window_s
+        else:
+            window_s = float(batch_window_us) / 1e6
         self._bucketer = Bucketer(self.max_batch,
                                   length_buckets=length_buckets,
                                   pad_axis=pad_axis,
@@ -171,7 +182,7 @@ class ModelServer:
             maxsize=max(2, 2 * self.workers))
         self._batcher = Batcher(self._admission, self._bucketer,
                                 self._out, self.max_batch,
-                                window_us / 1e6, self._expire,
+                                window_s, self._expire,
                                 on_error=self._fail)
         self._graphs: Dict[Tuple, object] = {}
         self._compile_lock = threading.Lock()

@@ -775,7 +775,15 @@ class EnvKnobRule(Rule):
     ``os.environ.get("MXNET_X", ...)`` silently forks the default from
     the documented one; an undeclared name read via ``get_env`` is a
     knob the docs don't know exists.  Module-level ``X_ENV = "MXTPU_Y"``
-    name constants are resolved."""
+    name constants are resolved.
+
+    Writes are checked too (PR 8): ``os.environ["MXNET_X"] = v`` of a
+    name the table doesn't declare is a knob being *invented* at the
+    mutation site — the self-tuning controllers apply their decisions
+    exactly this way, so an undeclared write is a controller steering a
+    knob the docs, the typed-default parser, and the README table have
+    never heard of.  Declared-name writes are the sanctioned apply
+    path."""
 
     name = "env-knob"
     description = "MXNET_*/MXTPU_* reads go through base.get_env"
@@ -814,12 +822,14 @@ class EnvKnobRule(Rule):
             return
         if t is ast.Subscript:
             base = node.value
-            if isinstance(node.ctx, ast.Load) and (
-                    (isinstance(base, ast.Attribute)
-                     and base.attr == "environ")
-                    or (isinstance(base, ast.Name)
-                        and base.id == "environ")):
+            on_environ = (isinstance(base, ast.Attribute)
+                          and base.attr == "environ") or \
+                         (isinstance(base, ast.Name)
+                          and base.id == "environ")
+            if on_environ and isinstance(node.ctx, ast.Load):
                 self._events.append(("read", node.slice, node.lineno))
+            elif on_environ and isinstance(node.ctx, ast.Store):
+                self._events.append(("write", node.slice, node.lineno))
             return
         # Call
         fn = node.func
@@ -859,6 +869,13 @@ class EnvKnobRule(Rule):
                            f"through mxnet_tpu.base.get_env so the "
                            f"declared default/type applies (register_env"
                            f" in {BASE_RELPATH})")
+            elif kind == "write" and knob not in declared:
+                ctx.report(self, line,
+                           f"environ write of undeclared knob '{knob}': "
+                           f"mutating a knob outside the declared table "
+                           f"invents config the docs/typed defaults "
+                           f"never see — register_env('{knob}', ...) in "
+                           f"{BASE_RELPATH} first")
             elif kind == "declared" and knob not in declared:
                 ctx.report(self, line,
                            f"env knob '{knob}' is not declared: add "

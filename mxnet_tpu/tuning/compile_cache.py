@@ -1,0 +1,351 @@
+"""Persistent compilation cache: compiled executables that survive the
+process.
+
+PERF.md documents multi-minute XLA compiles inside 2-minute chip
+windows: every restart — a preemption auto-resume, a ModelServer cold
+start, a bench subprocess — re-pays the full compile for graphs the
+previous process already built.  The in-memory caches this repo already
+keys carefully (``_segment_cache`` in ndarray/register.py, the
+per-signature ``HybridBlock._cached_graph``) die with the process; this
+module gives those same keys a disk tier.
+
+Design:
+
+- **Keyed on the existing signature keys + a backend fingerprint.**
+  A cache entry's name is ``sha256(kind + canonical-key + fingerprint)``
+  where the fingerprint covers jax/jaxlib versions, the backend
+  platform, and the device kind — a cache written by one toolchain or
+  chip generation can never be replayed onto another (the stale entry
+  simply never matches and ages out).
+- **Written atomically** (tmp + ``os.replace``), so a crash mid-write
+  leaves no torn entry and concurrent processes can share one
+  directory — last writer wins, both wrote the same bytes.
+- **Loaded lazily on first miss.**  Nothing is read at import or
+  construction; a lookup happens only where the in-memory cache already
+  missed, i.e. on the cold compile path — the steady-state hot path
+  never touches this module (the mxlint ``hot-path-purity`` reachability
+  proof holds because the wiring seams are installed hooks, not direct
+  calls).
+
+Two payload formats, matching the two compile paths in the repo:
+
+- **pjrt** — exact-mode bulk segments compile through the raw PJRT
+  client (``device.client.compile``); ``client.serialize_executable``
+  round-trips those directly.
+- **jit** — cached-graph executables are ``jax.jit`` artifacts; the AOT
+  ``jax.experimental.serialize_executable`` pickle (payload + in/out
+  trees) round-trips a ``lowered.compile()`` result.  Entries are
+  trusted local state (same trust level as jax's own persistent cache,
+  which uses the same mechanism).
+
+Metrics (process-global registry): ``tuning.compile_cache_hits`` /
+``_misses`` / ``_stores`` / ``_errors``, and ``tuning.compiles`` — the
+count of actual backend compiles performed at cache-wired sites.  A
+warm-started process replaying only previously-seen signatures holds
+``tuning.compiles`` at ~0; the subprocess test asserts exactly that.
+
+Enabled by ``MXTPU_COMPILE_CACHE_DIR``; with ``MXTPU_COMPILE_CACHE_JAX``
+(default on) the same directory also hosts jax's own persistent
+compilation cache (``<dir>/jax``), so plain ``jax.jit`` paths — per-op
+fns, training vjp graphs — reuse compiles across processes too.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import warnings
+from typing import Optional
+
+from ..base import get_env
+from ..observability.registry import registry as _metrics_registry
+
+__all__ = ["CompileCache", "active", "configure", "install",
+           "CACHE_DIR_ENV", "CACHE_JAX_ENV"]
+
+CACHE_DIR_ENV = "MXTPU_COMPILE_CACHE_DIR"
+CACHE_JAX_ENV = "MXTPU_COMPILE_CACHE_JAX"
+
+
+def _fingerprint() -> str:
+    """Toolchain + backend identity baked into every key: an entry
+    compiled by a different jax/jaxlib or for a different chip must
+    never deserialize into this process."""
+    import jax
+    import jaxlib
+    try:
+        dev = jax.devices()[0]
+        backend = f"{dev.platform}/{dev.device_kind}"
+    except Exception:   # noqa: BLE001 — no backend yet: fingerprint
+        backend = "unknown"        # conservatively mismatches later runs
+    return f"jax={jax.__version__};jaxlib={jaxlib.__version__};" \
+           f"backend={backend}"
+
+
+class CompileCache:
+    """One directory of serialized executables (see module docstring).
+
+    All I/O failures degrade to a miss (and count in
+    ``tuning.compile_cache_errors``): a broken cache dir must never take
+    down the compile it was supposed to skip.
+    """
+
+    def __init__(self, path: str):
+        self.path = os.path.abspath(path)
+        self._fp: Optional[str] = None
+        self._lock = threading.Lock()
+        reg = _metrics_registry()
+        self._c_hits = reg.counter(
+            "tuning.compile_cache_hits",
+            help="persistent compile-cache entries deserialized instead "
+                 "of compiled")
+        self._c_misses = reg.counter(
+            "tuning.compile_cache_misses",
+            help="persistent compile-cache lookups that found no entry")
+        self._c_stores = reg.counter(
+            "tuning.compile_cache_stores",
+            help="executables serialized into the persistent cache")
+        self._c_errors = reg.counter(
+            "tuning.compile_cache_errors",
+            help="cache I/O or (de)serialization failures, each "
+                 "degraded to a miss")
+        self._c_compiles = reg.counter(
+            "tuning.compiles",
+            help="actual backend compiles at persistent-cache-wired "
+                 "sites — ~0 on a warm start replaying known "
+                 "signatures")
+
+    # -- keys / paths --------------------------------------------------------
+    def _fingerprint(self) -> str:
+        fp = self._fp
+        if fp is None:
+            fp = self._fp = _fingerprint()
+        return fp
+
+    def entry_key(self, kind: str, canonical: str) -> str:
+        h = hashlib.sha256()
+        h.update(kind.encode("utf-8"))
+        h.update(b"\0")
+        h.update(self._fingerprint().encode("utf-8"))
+        h.update(b"\0")
+        h.update(canonical.encode("utf-8"))
+        return f"{kind}-{h.hexdigest()}"
+
+    def _entry_path(self, key: str) -> str:
+        return os.path.join(self.path, f"{key}.bin")
+
+    def __len__(self) -> int:
+        try:
+            return sum(1 for n in os.listdir(self.path)
+                       if n.endswith(".bin"))
+        except OSError:
+            return 0
+
+    # -- raw byte tier -------------------------------------------------------
+    def load_bytes(self, key: str) -> Optional[bytes]:
+        try:
+            with open(self._entry_path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+        except OSError:
+            self._c_errors.inc()
+            return None
+
+    def store_bytes(self, key: str, data: bytes) -> bool:
+        """Atomic write: tmp + rename, pid-suffixed so concurrent
+        processes never clobber each other's tmp files."""
+        path = self._entry_path(key)
+        tmp = f"{path}.tmp.{os.getpid()}"
+        try:
+            os.makedirs(self.path, exist_ok=True)
+            with open(tmp, "wb") as f:
+                f.write(data)
+            os.replace(tmp, path)
+            self._c_stores.inc()
+            return True
+        except OSError:
+            self._c_errors.inc()
+            try:
+                os.unlink(tmp)
+            except OSError:
+                pass
+            return False
+
+    # -- pjrt tier: exact-mode bulk segments ---------------------------------
+    def load_pjrt(self, key: str, client, options):
+        """Deserialize a raw PJRT executable, or None on miss.  The
+        caller supplies the same CompileOptions it would compile with —
+        PJRT needs them to rebuild the device assignment."""
+        data = self.load_bytes(key)
+        if data is None:
+            self._c_misses.inc()
+            return None
+        try:
+            exe = client.deserialize_executable(data, options)
+        except Exception:   # noqa: BLE001 — stale/foreign entry: a miss,
+            self._c_errors.inc()       # never a crash on the compile path
+            return None
+        self._c_hits.inc()
+        return exe
+
+    def store_pjrt(self, key: str, client, exe) -> None:
+        self._c_compiles.inc()         # a store follows a real compile
+        try:
+            data = client.serialize_executable(exe)
+        except Exception:   # noqa: BLE001 — backend without executable
+            self._c_errors.inc()       # serialization: run-only, no disk
+            return
+        self.store_bytes(key, bytes(data))
+
+    # -- jit tier: AOT-compiled jax.jit executables --------------------------
+    def load_jit(self, key: str):
+        """Deserialize an AOT ``Compiled`` callable, or None on miss."""
+        data = self.load_bytes(key)
+        if data is None:
+            self._c_misses.inc()
+            return None
+        try:
+            from jax.experimental import serialize_executable as _se
+            payload, in_tree, out_tree = pickle.loads(data)
+            compiled = _se.deserialize_and_load(payload, in_tree,
+                                                out_tree)
+        except Exception:   # noqa: BLE001 — toolchain drift or torn
+            self._c_errors.inc()       # entry reads as a plain miss
+            return None
+        self._c_hits.inc()
+        return compiled
+
+    def store_jit(self, key: str, compiled) -> None:
+        self._c_compiles.inc()
+        try:
+            from jax.experimental import serialize_executable as _se
+            payload, in_tree, out_tree = _se.serialize(compiled)
+            data = pickle.dumps((payload, in_tree, out_tree))
+        except Exception:   # noqa: BLE001 — same degradation as pjrt
+            self._c_errors.inc()
+            return
+        self.store_bytes(key, data)
+
+
+# -- process-global instance + wiring ---------------------------------------
+
+_active_lock = threading.Lock()
+_active: Optional[CompileCache] = None
+_configured_for: Optional[str] = None
+_jax_cache_warned = False
+
+
+def active() -> Optional[CompileCache]:
+    """THE process-global cache, or None when ``MXTPU_COMPILE_CACHE_DIR``
+    is unset.  Resolved live so a test (or a late-exported env) can
+    enable it after import; the instance is rebuilt if the dir changes."""
+    global _active, _configured_for
+    path = (get_env(CACHE_DIR_ENV) or "").strip()
+    if not path:
+        return None
+    inst = _active
+    if inst is not None and _configured_for == path:
+        return inst
+    with _active_lock:
+        if _active is None or _configured_for != path:
+            _active = CompileCache(path)
+            _configured_for = path
+            _wire(_active)
+    return _active
+
+
+def configure(path: Optional[str] = None) -> Optional[CompileCache]:
+    """Explicit enable: point the cache at ``path`` (exported to the
+    env so child processes inherit it) and wire every seam.  With no
+    argument, just resolves from the env like :func:`active`."""
+    if path:
+        os.environ[CACHE_DIR_ENV] = os.path.abspath(path)
+    return active()
+
+
+# back-compat alias: install() == configure-from-env
+install = configure
+
+
+def _wire(cache: CompileCache) -> None:
+    """Install the lazy-load seams.  Hook indirection keeps the cache
+    OFF the dispatch hot path in mxlint's reachability proof and keeps
+    the frontend layers free of a tuning import."""
+    from ..ndarray import register as _register
+    _register._install_persist_hooks(_segment_lookup, _segment_store)
+    _maybe_configure_jax_cache(cache)
+
+
+def _maybe_configure_jax_cache(cache: CompileCache) -> None:
+    """Point jax's own persistent compilation cache at ``<dir>/jax`` so
+    the plain ``jax.jit`` paths (per-op fns, training vjp graphs) also
+    survive restarts.  Best-effort: refused config updates (backend
+    already live on some versions) only cost the jit tier."""
+    global _jax_cache_warned
+    if not get_env(CACHE_JAX_ENV):
+        return
+    try:
+        import jax
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.join(cache.path, "jax"))
+        # default thresholds skip sub-second compiles and tiny
+        # executables — this repo's segment graphs are exactly those
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                          0)
+    except Exception as e:   # noqa: BLE001 — version drift in config
+        if not _jax_cache_warned:  # names must not disable OUR tiers
+            _jax_cache_warned = True
+            warnings.warn(
+                f"persistent compile cache: could not configure jax's "
+                f"own compilation cache ({e}); segment/cached-graph "
+                f"tiers remain active", RuntimeWarning, stacklevel=2)
+
+
+# -- the segment seam (installed into ndarray.register) ---------------------
+
+def _segment_lookup(canonical: str, device, options):
+    """Hook: exact-mode segment cache miss → try the disk tier."""
+    cache = active()
+    if cache is None:
+        return None
+    key = cache.entry_key("seg", canonical)
+    return cache.load_pjrt(key, device.client, options)
+
+
+def _segment_store(canonical: str, device, exe) -> None:
+    """Hook: a segment executable was compiled → persist it."""
+    cache = active()
+    if cache is None:
+        return
+    key = cache.entry_key("seg", canonical)
+    cache.store_pjrt(key, device.client, exe)
+
+
+# -- the cached-graph seam (called from gluon.block) ------------------------
+
+def aot_compile(lowered, kind: str = "graph"):
+    """Compile a ``jax.jit(...).lower(...)`` artifact through the
+    persistent cache: the lowered StableHLO text (plus the backend
+    fingerprint) is the key, so identical traces in a fresh process
+    deserialize instead of compiling.  Returns the AOT ``Compiled``
+    callable, or None when the cache is disabled (callers then keep
+    their plain jit path)."""
+    cache = active()
+    if cache is None:
+        return None
+    try:
+        canonical = lowered.as_text()
+    except Exception:   # noqa: BLE001 — no text form: nothing to key on
+        cache._c_errors.inc()
+        return None
+    key = cache.entry_key(kind, canonical)
+    compiled = cache.load_jit(key)
+    if compiled is not None:
+        return compiled
+    compiled = lowered.compile()
+    cache.store_jit(key, compiled)
+    return compiled

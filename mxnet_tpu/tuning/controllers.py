@@ -1,0 +1,625 @@
+"""Feedback controllers: close the loops the metrics spine measures.
+
+Rounds 3–7 built an observability layer that measures everything and
+controls nothing — ``engine.flush_us`` was recorded explicitly as "the
+signal the MXNET_ENGINE_BULK_SIZE auto-tune follow-up needs", the loader
+and serving layers export queue-depth gauges nobody read.  Each
+controller here reads those exact signals and closes its loop:
+
+==========================  ===============================================
+:class:`BulkSizeController`  hill-climbs the live ``MXNET_ENGINE_BULK_SIZE``
+                             cap from ``engine.flush_us`` interval deltas
+:class:`PrefetchController`  adapts the DataLoader prefetch-depth target
+                             from the ``loader.prefetch_depth`` gauge
+:class:`BatchWindowController`  adapts ``MXTPU_SERVING_BATCH_WINDOW_US``
+                             from ``serving.queue_depth`` +
+                             ``serving.request_us`` p99 (PR-7 follow-up)
+:class:`FleetGatherController`  streams the multi-host metric gather over
+                             the barrier-free KV transport on the timer
+                             thread instead of checkpoint boundaries
+                             (PR-4 follow-up)
+==========================  ===============================================
+
+Shared discipline (the :class:`Controller` base):
+
+- **guard rails** — every proposal clamps to ``[vmin, vmax]`` before it
+  can touch anything (clamps are counted: a controller pinned to a rail
+  is a controller whose model of the system is wrong);
+- **hysteresis** — a change applies only after ``hysteresis`` consecutive
+  ticks proposed a move in the same direction, so a single noisy
+  interval cannot flap a knob;
+- **dry run** — ``MXTPU_TUNE_DRY_RUN`` (or the per-instance flag)
+  computes and records every decision but applies nothing: the
+  observe-before-trust mode for new deployments;
+- **auditable decisions** — every decision lands in the ``tuning.*``
+  metrics (``tuning.<name>.value`` gauge, ``.decisions``/``.applied``/
+  ``.clamped`` counters) AND as a flight-recorder tuning record, so a
+  bad controller decision is visible in the crash post-mortem ring.
+
+Controllers are deliberately *pull-based and tick-driven*: ``tick()``
+reads registry metric deltas accumulated since the previous tick — no
+wall-clock inside, so tests drive them with synthetic metric streams and
+zero sleeps.  The shared timer thread lives in
+:class:`mxnet_tpu.tuning.TuningRuntime`.
+"""
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+from ..base import get_env
+from ..observability.flight import recorder as _flight_recorder
+from ..observability.registry import (_percentile_from, registry,
+                                      state_bounds)
+
+__all__ = ["Controller", "BulkSizeController", "PrefetchController",
+           "BatchWindowController", "FleetGatherController",
+           "HistogramDelta", "CounterDelta"]
+
+DRY_RUN_ENV = "MXTPU_TUNE_DRY_RUN"
+
+
+class HistogramDelta:
+    """Interval view over a registry Histogram: ``take()`` returns the
+    aggregate of observations since the previous ``take()`` (count,
+    total, p50/p99 from the bucket-count delta) — the per-tick signal a
+    controller steers on, immune to the lifetime average's inertia."""
+
+    def __init__(self, hist):
+        self._h = hist
+        self._last: Optional[dict] = None
+
+    def take(self) -> Optional[dict]:
+        st = self._h.state()
+        last, self._last = self._last, st
+        if last is None:
+            return None
+        counts = [a - b for a, b in zip(st["counts"], last["counts"])]
+        n = st["count"] - last["count"]
+        total = st["total"] - last["total"]
+        if n <= 0:
+            return {"count": 0, "total": 0.0, "p50": 0.0, "p99": 0.0,
+                    "mean": 0.0}
+        bounds = state_bounds(st)
+        # lifetime min/max clamp the edge buckets — close enough for a
+        # steering signal, and strictly conservative
+        p50 = _percentile_from(bounds, counts, n, st["min"], st["max"],
+                               50)
+        p99 = _percentile_from(bounds, counts, n, st["min"], st["max"],
+                               99)
+        return {"count": n, "total": total, "p50": p50, "p99": p99,
+                "mean": total / n}
+
+
+class CounterDelta:
+    """Interval view over a registry Counter (see HistogramDelta)."""
+
+    def __init__(self, counter):
+        self._c = counter
+        self._last: Optional[int] = None
+
+    def take(self) -> int:
+        n = self._c.n
+        last, self._last = self._last, n
+        return 0 if last is None else max(0, n - last)
+
+
+class Controller:
+    """Base feedback controller (see module docstring for the shared
+    discipline).  Subclasses implement:
+
+    - ``current()`` — the live value of the controlled quantity;
+    - ``decide()`` — ``(proposal, reason)`` from this tick's metric
+      deltas, or None to hold;
+    - ``apply(value)`` — actually mutate the knob/target.
+
+    ``tick()`` runs the template: enable gate → decide → clamp to the
+    guard rails → hysteresis → (dry-run-gated) apply → record the
+    decision as ``tuning.*`` metrics + a flight-recorder tuning record.
+    """
+
+    #: metric namespace component (``tuning.<name>.*``) — snake_case
+    name = "controller"
+    #: the env knob this controller owns (documentation + decision
+    #: records); None for non-knob controllers
+    knob: Optional[str] = None
+    #: per-controller enable knob (``MXTPU_TUNE_*``); None = always on
+    enable_env: Optional[str] = None
+
+    def __init__(self, *, vmin: float, vmax: float, hysteresis: int = 1,
+                 enabled: Optional[bool] = None,
+                 dry_run: Optional[bool] = None, flight=None):
+        self.vmin = vmin
+        self.vmax = vmax
+        self.hysteresis = max(1, int(hysteresis))
+        self._enabled = enabled
+        self._dry_run = dry_run
+        self._pending_dir = 0
+        self._pending_n = 0
+        self._flight = _flight_recorder() if flight is None else flight
+        reg = registry()
+        self._g_value = reg.gauge(
+            f"tuning.{self.name}.value",
+            help=f"live value of the {self.name} controller's target")
+        self._c_decisions = reg.counter(
+            f"tuning.{self.name}.decisions",
+            help="decisions recorded (applied, held by hysteresis, or "
+                 "dry-run)")
+        self._c_applied = reg.counter(
+            f"tuning.{self.name}.applied",
+            help="decisions actually applied to the live knob/target")
+        self._c_clamped = reg.counter(
+            f"tuning.{self.name}.clamped",
+            help="proposals clamped by the min/max guard rails — "
+                 "sustained clamping means the rails disagree with the "
+                 "controller's model")
+
+    # -- knobs ---------------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        if self._enabled is not None:
+            return self._enabled
+        if self.enable_env is not None:
+            return bool(get_env(self.enable_env))
+        return True
+
+    @property
+    def dry_run(self) -> bool:
+        if self._dry_run is not None:
+            return self._dry_run
+        return bool(get_env(DRY_RUN_ENV))
+
+    # -- subclass surface ----------------------------------------------------
+    def current(self) -> float:
+        raise NotImplementedError
+
+    def decide(self) -> Optional[Tuple[float, str]]:
+        raise NotImplementedError
+
+    def apply(self, value) -> None:
+        raise NotImplementedError
+
+    def on_applied(self, value) -> None:
+        """Post-apply hook for search-state baselines (hill climbers
+        reset their comparison score here — NOT called in dry-run, so a
+        dry-run controller never believes a move it didn't make)."""
+
+    # -- the template --------------------------------------------------------
+    def tick(self) -> Optional[dict]:
+        """One control decision; returns the decision record (also sent
+        to metrics + flight ring) or None when holding."""
+        if not self.enabled:
+            return None
+        out = self.decide()
+        cur = self.current()
+        self._g_value.set(cur)
+        if out is None:
+            return None
+        proposal, reason = out
+        clamped = min(max(proposal, self.vmin), self.vmax)
+        if clamped != proposal:
+            self._c_clamped.inc()
+            reason += f" [clamped {proposal:g} -> {clamped:g}]"
+        if clamped == cur:
+            self._pending_dir = 0
+            self._pending_n = 0
+            return None
+        direction = 1 if clamped > cur else -1
+        if direction == self._pending_dir:
+            self._pending_n += 1
+        else:
+            self._pending_dir = direction
+            self._pending_n = 1
+        applied = False
+        held = self._pending_n < self.hysteresis
+        if not held:
+            self._pending_dir = 0
+            self._pending_n = 0
+            if not self.dry_run:
+                self.apply(clamped)
+                self.on_applied(clamped)
+                applied = True
+                self._g_value.set(clamped)
+        decision = {
+            "controller": self.name,
+            "knob": self.knob,
+            "from": cur,
+            "to": clamped,
+            "applied": applied,
+            "held": held,
+            "dry_run": self.dry_run,
+            "reason": reason,
+        }
+        self._c_decisions.inc()
+        if applied:
+            self._c_applied.inc()
+        self._flight.record_tuning(**decision)
+        return decision
+
+
+# ---------------------------------------------------------------------------
+# BulkSizeController — the PR-2/PR-3 staged follow-up
+# ---------------------------------------------------------------------------
+
+class BulkSizeController(Controller):
+    """Hill-climb the live ``MXNET_ENGINE_BULK_SIZE`` cap to minimize
+    host-side dispatch cost per bulked op.
+
+    Signal: the interval delta of ``engine.flush_us`` (per-segment flush
+    latency — recorded unconditionally since PR 3 precisely for this
+    loop) over the interval delta of ``engine.bulked_ops_flushed``:
+    ``us-per-op = Δflush_total / Δops``.  Larger segments amortize the
+    fixed dispatch overhead until compile variety / cache pressure turns
+    the curve back up; the climb follows the measured gradient:
+
+    - first move probes upward (the default cap of 15 was chosen for a
+      1-core CI host; real hosts usually profit from more);
+    - an interval that improved us-per-op by > ``tol`` keeps the
+      direction; one that regressed by > ``tol`` reverses it; a plateau
+      holds (that IS convergence — the controller then sits still until
+      the workload shifts);
+    - a p99 guard (``p99_budget_us``) forces downward pressure when tail
+      flushes blow the budget regardless of the mean trend.
+
+    Steps are multiplicative (``factor``), so the sweep covers the
+    useful range (2..64) in a handful of decisions.
+    """
+
+    name = "bulk_size"
+    knob = "MXNET_ENGINE_BULK_SIZE"
+    enable_env = "MXTPU_TUNE_BULK"
+
+    def __init__(self, *, vmin: int = 2, vmax: int = 64,
+                 factor: float = 1.5, min_segments: int = 20,
+                 tol: float = 0.03, settle_intervals: int = 1,
+                 p99_budget_us: Optional[float] = None, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, **kw)
+        self.factor = float(factor)
+        self.min_segments = int(min_segments)
+        self.tol = float(tol)
+        self.settle_intervals = int(settle_intervals)
+        self.p99_budget_us = p99_budget_us
+        reg = registry()
+        self._flush = HistogramDelta(reg.histogram("engine.flush_us"))
+        self._ops = CounterDelta(reg.counter(
+            "engine.bulked_ops_flushed"))
+        self._dir = 1
+        self._settle = 0
+        self._last_score: Optional[float] = None
+
+    def current(self) -> float:
+        return int(get_env("MXNET_ENGINE_BULK_SIZE"))
+
+    def on_applied(self, value) -> None:
+        # the first interval(s) after a cap change are contaminated by
+        # the new segment signatures' COMPILES (orders of magnitude
+        # above a steady-state flush) — judging the move on them reads
+        # every move as a regression and the climb degenerates into
+        # oscillation (measured).  Discard them; judge the move on the
+        # first clean interval.
+        self._settle = self.settle_intervals
+
+    def decide(self):
+        d = self._flush.take()
+        ops = self._ops.take()
+        if d is None or d["count"] < self.min_segments or ops <= 0:
+            return None
+        if self._settle > 0:
+            # the settle credit must be spent on an interval that
+            # actually CARRIES flushes at the new cap (the compile
+            # spikes) — an empty lull interval must not consume it, or
+            # the contamination lands on the next judged interval and
+            # the oscillation returns
+            self._settle -= 1
+            return None
+        score = d["total"] / ops          # host us per bulked op
+        cur = int(self.current())
+        if self.p99_budget_us is not None and \
+                d["p99"] > self.p99_budget_us:
+            self._dir = -1
+            self._last_score = score
+        elif self._last_score is not None:
+            if score > self._last_score * (1 + self.tol):
+                self._dir = -self._dir    # regressed: turn around
+                self._last_score = score
+            elif score < self._last_score * (1 - self.tol):
+                self._last_score = score  # improved: keep climbing
+            else:
+                # plateau: converged — hold here until the curve moves
+                self._last_score = score
+                return None
+        else:
+            self._last_score = score      # first full interval: probe up
+        nxt = cur * self.factor if self._dir > 0 else cur / self.factor
+        proposal = max(1, int(round(nxt)))
+        if proposal == cur:               # factor rounding stuck
+            proposal = cur + self._dir
+        return proposal, (f"flush us/op={score:.2f} "
+                          f"p50={d['p50']:.1f} p99={d['p99']:.1f} "
+                          f"segments={d['count']} dir={self._dir:+d}")
+
+    def apply(self, value) -> None:
+        from ..engine import engine
+        engine().set_bulk_size(int(value))
+
+
+# ---------------------------------------------------------------------------
+# PrefetchController
+# ---------------------------------------------------------------------------
+
+class PrefetchController(Controller):
+    """Adapt the DataLoader prefetch-depth target from the
+    ``loader.prefetch_depth`` gauge (sampled at every batch handoff).
+
+    The gauge's own help text is the policy: *near-capacity means
+    workers keep ahead of the device; near-zero means the pipeline is
+    starving the step*.  A starving queue gets a deeper in-flight
+    window (more batches in parallel absorb worker jitter); a queue
+    pinned at capacity for ``hysteresis`` consecutive ticks gets a
+    shallower one (each slot is a materialized host batch — memory).
+    The applied target takes effect on the next ``__iter__`` (epoch
+    boundary) via :func:`mxnet_tpu.gluon.data.dataloader.
+    set_prefetch_override`.
+
+    Two guards keep the model honest:
+
+    - an interval with fewer than ``min_batches`` loader batches holds
+      — an idle (or serving-only) process's zero gauge must not read
+      as starvation and ratchet the override to the rail;
+    - a depth EMA *above* the target means some loader was constructed
+      deeper than the controller's model — the observed depth is
+      adopted as the new baseline instead of being fought down, and
+      the shrink branch only ever fires once the override (this
+      controller's own sizing) is live.
+    """
+
+    name = "prefetch"
+    enable_env = "MXTPU_TUNE_PREFETCH"
+
+    def __init__(self, *, vmin: int = 1, vmax: int = 64,
+                 initial: int = 4, low_frac: float = 0.25,
+                 high_frac: float = 0.9, ema: float = 0.5,
+                 min_batches: int = 8, hysteresis: int = 2, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, hysteresis=hysteresis,
+                         **kw)
+        self.low_frac = float(low_frac)
+        self.high_frac = float(high_frac)
+        self.ema = float(ema)
+        self.min_batches = int(min_batches)
+        self._target = int(initial)
+        self._depth_ema: Optional[float] = None
+        reg = registry()
+        self._g_depth = reg.gauge("loader.prefetch_depth")
+        self._g_capacity = reg.gauge("loader.prefetch_capacity")
+        self._batches = CounterDelta(reg.counter("loader.batches"))
+
+    def current(self) -> float:
+        return self._target
+
+    def _clamp(self, v: float) -> int:
+        return max(int(self.vmin), min(int(v), int(self.vmax)))
+
+    def decide(self):
+        produced = self._batches.take()
+        if produced < self.min_batches:
+            return None                   # idle pipeline: no evidence
+        depth = self._g_depth.value
+        if self._depth_ema is None:
+            self._depth_ema = depth
+        else:
+            self._depth_ema = (self.ema * depth
+                               + (1 - self.ema) * self._depth_ema)
+        t = self._target
+        capacity = self._g_capacity.value   # what the gauge CAN reach
+        if self._depth_ema > t:
+            # a loader sized deeper than our model (constructor
+            # prefetch > target, override not yet applied): adopt the
+            # observed depth as the baseline rather than throttling a
+            # correctly-sized pipeline.  Clamped to the guard rails —
+            # an unclamped adopt above vmax would later make a clamped
+            # "grow" proposal read as a shrink
+            self._target = self._clamp(self._depth_ema)
+            return None
+        if self._depth_ema <= self.low_frac * t:
+            if 0 < capacity < t:
+                # an applied target only takes effect at the next
+                # __iter__; until the live capacity reaches it, "deep
+                # starvation" is just the old small queue still in use
+                # — growing again here ratchets straight to the rail
+                return None
+            return t * 2, (f"queue starving (depth ema "
+                           f"{self._depth_ema:.1f} <= {self.low_frac} "
+                           f"x {t})")
+        from ..gluon.data import dataloader as _dl
+        if self._depth_ema >= self.high_frac * t and t > self.vmin \
+                and _dl.prefetch_override() is not None:
+            return max(self.vmin, t // 2), (
+                f"queue pinned at capacity (depth ema "
+                f"{self._depth_ema:.1f} >= {self.high_frac} x {t})")
+        return None
+
+    def apply(self, value) -> None:
+        from ..gluon.data import dataloader as _dl
+        self._target = int(value)
+        _dl.set_prefetch_override(self._target)
+
+
+# ---------------------------------------------------------------------------
+# BatchWindowController — the PR-7 named follow-up
+# ---------------------------------------------------------------------------
+
+class BatchWindowController(Controller):
+    """Adapt ``MXTPU_SERVING_BATCH_WINDOW_US`` — how long the serving
+    batcher waits for a shape bucket to fill — from the live
+    ``serving.queue_depth`` gauge and ``serving.request_us`` p99.
+
+    The window only matters in the middle of the load curve: under
+    light load the queue never backs up and every microsecond of window
+    is pure added latency — shrink it; under sustained queueing a wider
+    window packs fuller batches (higher goodput per dispatch) — widen
+    it, but hill-climb on the measured request p99 so a widen that
+    made the tail WORSE (depth was batch-starved, not arrival-limited)
+    reverses instead of compounding.  The knob is read live per batch
+    by the Batcher, so an applied decision reaches a running server on
+    its next assembly.
+    """
+
+    name = "batch_window"
+    knob = "MXTPU_SERVING_BATCH_WINDOW_US"
+    enable_env = "MXTPU_TUNE_BATCH_WINDOW"
+
+    def __init__(self, *, vmin: float = 200.0, vmax: float = 20000.0,
+                 factor: float = 2.0, min_requests: int = 20,
+                 tol: float = 0.05, depth_low: float = 1.0,
+                 depth_high: float = 4.0, ema: float = 0.5, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, **kw)
+        self.factor = float(factor)
+        self.min_requests = int(min_requests)
+        self.tol = float(tol)
+        self.depth_low = float(depth_low)
+        self.depth_high = float(depth_high)
+        self.ema = float(ema)
+        reg = registry()
+        self._req = HistogramDelta(reg.histogram("serving.request_us"))
+        self._g_depth = reg.gauge("serving.queue_depth")
+        self._depth_ema: Optional[float] = None
+        self._last_p99: Optional[float] = None
+        self._last_dir = 0
+
+    def current(self) -> float:
+        return float(get_env("MXTPU_SERVING_BATCH_WINDOW_US"))
+
+    def decide(self):
+        depth = self._g_depth.value
+        if self._depth_ema is None:
+            self._depth_ema = depth
+        else:
+            self._depth_ema = (self.ema * depth
+                               + (1 - self.ema) * self._depth_ema)
+        d = self._req.take()
+        if d is None or d["count"] < self.min_requests:
+            return None
+        cur = self.current()
+        p99, last_p99 = d["p99"], self._last_p99
+        self._last_p99 = p99
+        if self._depth_ema < self.depth_low:
+            self._last_dir = -1
+            return cur / self.factor, (
+                f"light load (depth ema {self._depth_ema:.2f} < "
+                f"{self.depth_low}): shed window latency")
+        if self._depth_ema >= self.depth_high:
+            direction = 1
+            if self._last_dir > 0 and last_p99 is not None and \
+                    p99 > last_p99 * (1 + self.tol):
+                direction = -1            # the widen hurt the tail
+            self._last_dir = direction
+            nxt = cur * self.factor if direction > 0 else \
+                cur / self.factor
+            return nxt, (f"queued (depth ema {self._depth_ema:.2f} >= "
+                         f"{self.depth_high}) p99={p99:.0f}us "
+                         f"dir={direction:+d}")
+        return None
+
+    def apply(self, value) -> None:
+        # a declared-knob write is the sanctioned mutation path (the
+        # env-knob lint rejects writes of UNdeclared names); the Batcher
+        # reads this knob live per assembled batch
+        os.environ["MXTPU_SERVING_BATCH_WINDOW_US"] = repr(float(value))
+
+
+# ---------------------------------------------------------------------------
+# FleetGatherController — the PR-4 named follow-up
+# ---------------------------------------------------------------------------
+
+class FleetGatherController(Controller):
+    """Stream the multi-host metric gather on the timer thread.
+
+    PR 4's fleet view refreshes only at checkpoint boundaries because
+    ``allgather_bytes`` is a collective — every host must reach it in
+    lockstep, and a free-running timer cannot guarantee that.  This
+    controller uses the **barrier-free KV-store transport** instead
+    (:func:`mxnet_tpu.parallel.dist.kv_publish` / ``kv_collect``): each
+    tick *publishes* this host's ``export_state()`` under a
+    generation-stamped key and *collects* every peer's newest published
+    state — no blocking get, no barrier, no lockstep requirement, so
+    hosts may tick at different rates (a peer's view is at most one of
+    its ticks stale, tracked by the ``tuning.fleet_gather.hosts``
+    gauge).  Collected states feed the same memo the
+    ``MXTPU_METRICS_AGGREGATE`` Prometheus endpoint serves, turning the
+    fleet view from checkpoint-fresh into timer-fresh.
+
+    Not a knob controller: ``tick()`` is overridden — the "decision" is
+    the gather itself (recorded in metrics + the flight tuning ring);
+    dry-run publishes and collects but does not install the collected
+    view.
+    """
+
+    name = "fleet_gather"
+    enable_env = "MXTPU_TUNE_FLEET_GATHER"
+    _KV_PREFIX = "mxtpu/fleetgather"
+
+    def __init__(self, **kw):
+        kw.setdefault("vmin", 0)
+        kw.setdefault("vmax", 0)
+        super().__init__(**kw)
+        self._last_hosts: Optional[Tuple[int, ...]] = None
+        self._g_hosts = registry().gauge(
+            "tuning.fleet_gather.hosts",
+            help="hosts visible in the latest barrier-free fleet "
+                 "gather (this host included)")
+        self._c_gathers = registry().counter(
+            "tuning.fleet_gather.gathers",
+            help="timer-thread fleet gathers streamed (every tick; "
+                 "`.decisions` counts only membership CHANGES)")
+
+    def current(self) -> float:
+        return 0.0
+
+    def tick(self) -> Optional[dict]:
+        if not self.enabled:
+            return None
+        from ..parallel import dist
+        if not dist.is_initialized():
+            return None
+        from ..observability.registry import ingest_host_states
+        reg = registry()
+        local = reg.export_state()
+        dist.kv_publish(self._KV_PREFIX,
+                        json.dumps(local).encode("utf-8"))
+        blobs = dist.kv_collect(self._KV_PREFIX)
+        states: List[Tuple[int, dict]] = sorted(
+            (r, json.loads(b.decode("utf-8")))
+            for r, b in blobs.items())
+        applied = False
+        if not self.dry_run and states:
+            ingest_host_states(states)
+            applied = True
+        self._g_hosts.set(len(states))
+        self._c_gathers.inc()
+        hosts = tuple(r for r, _ in states)
+        if hosts == self._last_hosts:
+            # steady state: the gather streamed (gauge + counter above)
+            # but a per-tick flight record would flood the shared
+            # fixed-capacity tuning ring and evict the rare
+            # knob-decision records the crash post-mortem exists for —
+            # only fleet-membership CHANGES are decisions worth a slot
+            return None
+        self._last_hosts = hosts
+        self._c_decisions.inc()
+        if applied:
+            self._c_applied.inc()
+        decision = {
+            "controller": self.name,
+            "knob": None,
+            # compact string: the flight dump materializer keeps
+            # None/bool/int/str and numbers, not lists
+            "hosts": ",".join(str(r) for r in hosts),
+            "applied": applied,
+            "held": False,
+            "dry_run": self.dry_run,
+            "reason": f"fleet membership now {len(states)} host(s) in "
+                      f"the KV-transport gather",
+        }
+        self._flight.record_tuning(**decision)
+        return decision

@@ -1,0 +1,11 @@
+"""mxlint fixture: must trip env-knob (and nothing else) — a
+controller-style apply path that MUTATES a knob outside the declared
+table."""
+import os
+
+
+class RogueController:
+    """Steers a knob register_env has never heard of."""
+
+    def apply(self, value):
+        os.environ["MXTPU_FIXTURE_ONLY_UNDECLARED"] = str(value)

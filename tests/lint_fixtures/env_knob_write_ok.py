@@ -1,0 +1,14 @@
+"""mxlint fixture: declared-knob writes (the sanctioned controller
+apply path) and non-knob environ writes lint clean."""
+import os
+
+WINDOW_ENV = "MXTPU_SERVING_BATCH_WINDOW_US"
+
+
+class DeclaredController:
+    """Applies decisions only to table-declared knobs."""
+
+    def apply(self, value):
+        os.environ["MXNET_ENGINE_BULK_SIZE"] = str(value)
+        os.environ[WINDOW_ENV] = repr(float(value))   # via the constant
+        os.environ["TMPDIR"] = "/tmp"  # not an MXNET_*/MXTPU_* knob

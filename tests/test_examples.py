@@ -32,6 +32,14 @@ def test_example_serve_continuous_batching_runs():
     assert "batch efficiency" in r.stdout
 
 
+def test_example_selftune_controllers_runs():
+    r = _run(["examples/selftune_controllers.py", "--steps", "4",
+              "--ops", "120", "--cpu"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "SELFTUNE_EXAMPLE_OK" in r.stdout
+    assert "bulk_size:" in r.stdout      # at least one live decision
+
+
 def test_example_imagenet_style_runs(tmp_path):
     rec = str(tmp_path / "t.rec")
     r = _run(["examples/train_imagenet_style.py", "--epochs", "1",

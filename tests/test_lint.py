@@ -37,6 +37,7 @@ RULE_FOR_FIXTURE = {
     "hot_path_purity": "hot-path-purity",
     "hidden_host_sync": "hidden-host-sync",
     "env_knob": "env-knob",
+    "env_knob_write": "env-knob",
 }
 
 
