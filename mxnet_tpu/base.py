@@ -295,6 +295,42 @@ register_env("MXTPU_COMPILE_CACHE_JAX", True, bool,
              "persistent compilation cache at <dir>/jax so plain "
              "jax.jit paths (per-op fns, training vjp graphs) reuse "
              "compiles across processes too.")
+register_env("MXTPU_ELASTIC", False, bool,
+             "Elastic-fleet mode for init_process_group: raises the "
+             "coordination service's own task-heartbeat tolerance to "
+             "effectively-forever so a dead host does NOT make the "
+             "service propagate a fatal error that terminates every "
+             "survivor (~100s after the death, with jax defaults).  "
+             "Liveness then belongs solely to the membership lease "
+             "layer (parallel.membership), which detects the loss "
+             "within MXTPU_ELASTIC_LEASE_TTL and re-forms.  Leave off "
+             "for non-elastic jobs, where whole-fleet fail-fast on a "
+             "dead host is the desired behavior.")
+register_env("MXTPU_ELASTIC_LEASE_TTL", 10.0, float,
+             "Elastic-fleet membership lease TTL in seconds: a host "
+             "whose heartbeat lease has not advanced for this long (on "
+             "the OBSERVER's clock — no cross-host clock trust) is "
+             "declared dead and the survivors re-form.  Lower = faster "
+             "host-loss detection, higher = more tolerance for GC/IO "
+             "pauses.")
+register_env("MXTPU_ELASTIC_HEARTBEAT", 2.0, float,
+             "Elastic-fleet heartbeat publish interval in seconds "
+             "(should be several times smaller than "
+             "MXTPU_ELASTIC_LEASE_TTL so one dropped publish never "
+             "reads as a death).")
+register_env("MXTPU_ELASTIC_COORD_LINGER", 8.0, float,
+             "Seconds a dirty-detaching process that HOSTS the "
+             "coordination service lingers before its final os._exit: "
+             "the service's death severs every peer's fabric mid-RPC "
+             "(jax's error polling then aborts them), so the "
+             "coordinator gives peers still wrapping up — or a fenced "
+             "host still discovering its exclusion — time to exit "
+             "with their own clean codes first.")
+register_env("MXTPU_ELASTIC_REFORM_TIMEOUT", 60.0, float,
+             "Wall-clock budget in seconds for one fleet re-form round "
+             "(view exchange, plan, acks, commit).  A survivor that "
+             "cannot complete the round within it raises FleetLost "
+             "instead of waiting forever on a fleet that cannot agree.")
 
 
 # ---------------------------------------------------------------------------

@@ -14,12 +14,29 @@ Fault-plan grammar (env var ``MXTPU_FAULT_PLAN`` or :class:`FaultPlan`):
     entry := kind '@' index ['x' count] [':' arg]
 
 ``kind`` names an instrumented site (an open set — current sites:
-``step_error``, ``nan``, ``ckpt_fail``, ``loader_stall``, ``loader_error``),
-``index`` is the 1-based step / save / batch counter at that site,
-``xN`` repeats the entry for N consecutive indices, and ``arg`` is an
-optional float payload (e.g. stall seconds).  Each entry fires exactly
-once and is then consumed — a retried step therefore sees the fault on
-the first attempt only, which is what makes injected faults *transient*.
+``step_error``, ``nan``, ``ckpt_fail``, ``loader_stall``,
+``loader_error``, plus the **host-level** kinds below), ``index`` is the
+1-based step / save / batch counter at that site, ``xN`` repeats the
+entry for N consecutive indices, and ``arg`` is an optional float
+payload (e.g. stall seconds).  Each entry fires exactly once and is
+then consumed — a retried step therefore sees the fault on the first
+attempt only, which is what makes injected faults *transient*.
+
+Host-level kinds (the elastic-fleet fault surface; each process reads
+its OWN plan, so the targeted rank is simply the process whose plan
+carries the entry):
+
+- ``host_loss@N`` — the process hard-exits at supervisor step N:
+  SIGKILL to itself by default (indistinguishable from a machine
+  loss — no flush, no atexit), or ``:code`` to ``os._exit(code)``
+  instead.  The survivors' membership layer must detect the expired
+  lease and re-form.
+- ``heartbeat_stall@N[:secs]`` — the lease publisher freezes at step N
+  (for ``secs`` seconds, or forever without an arg) while the process
+  KEEPS STEPPING: the false-death/split-brain case.  Peers reap the
+  silent lease and re-form with a bumped fencing generation; when this
+  process notices the fence it must exit (:class:`~mxnet_tpu.parallel.
+  membership.HostFenced`), never rejoin.
 
 Example::
 

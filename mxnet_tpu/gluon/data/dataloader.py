@@ -14,6 +14,22 @@ times per batch, and a stalled pipeline raises after ``timeout`` seconds
 with the stuck worker→batch map instead of blocking forever.  The
 ``loader_stall`` / ``loader_error`` sites of the deterministic fault plan
 (``MXTPU_FAULT_PLAN`` — see mxnet_tpu.faults) exercise both paths on CPU.
+
+Data-parallel sharding (elastic fleet): ``num_shards``/``shard_index``
+stripe the epoch's batches round-robin across the fleet (batch ``i``
+belongs to shard ``i % num_shards`` — the reference's
+``num_parts``/``part_index`` idiom, at batch granularity so the batch
+size never changes).  ``num_shards="dist"`` resolves BOTH values from
+the active process group at each ``__iter__`` — after a fleet re-form
+the next epoch automatically re-partitions over the survivors.  The
+loader also keeps a **position cursor** (epoch + per-shard batches
+consumed + the shard count they were consumed under):
+``state_dict()``/``load_state_dict()`` ride the ResilientTrainer
+checkpoint payload, and a restore fast-forwards the next epoch to the
+equivalent GLOBAL position under the (possibly different) new shard
+assignment — skipped batches are never built, their index lists are
+simply dropped — so post-re-form resume re-winds the loader instead of
+replaying the epoch from batch 0.
 """
 from __future__ import annotations
 
@@ -86,8 +102,37 @@ class DataLoader:
     def __init__(self, dataset, batch_size=None, shuffle=False, sampler=None,
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
-                 thread_pool=False, timeout=120, worker_retries=0):
+                 thread_pool=False, timeout=120, worker_retries=0,
+                 num_shards=None, shard_index=None):
         self._dataset = dataset
+        if num_shards == "dist":
+            if shard_index is not None:
+                raise MXNetError(
+                    "num_shards='dist' resolves shard_index from the "
+                    "process group — don't pass both")
+        elif num_shards is not None:
+            num_shards = int(num_shards)
+            shard_index = int(shard_index if shard_index is not None else 0)
+            if not 0 <= shard_index < num_shards:
+                raise MXNetError(
+                    f"shard_index must be in [0, {num_shards}), got "
+                    f"{shard_index}")
+        elif shard_index is not None:
+            raise MXNetError("shard_index requires num_shards")
+        self._num_shards = num_shards
+        self._shard_index = shard_index
+        # position cursor: epoch (1-based once iteration starts),
+        # per-shard batches consumed this epoch, the shard count they
+        # were consumed under, and the exact GLOBAL base the epoch
+        # (re)started from — `global = base + consumed * k` stays exact
+        # across repeated re-shards, where reconstructing it from the
+        # per-shard count alone would drift by the division remainder
+        self._epoch = 0
+        self._cursor_batch = 0
+        self._cursor_shards = 1
+        self._cursor_gbase = 0
+        self._cursor_start = 0
+        self._pending_state: Optional[dict] = None
         if batch_sampler is None:
             if batch_size is None:
                 raise MXNetError("batch_size required when no batch_sampler")
@@ -127,8 +172,69 @@ class DataLoader:
                  "is actually live (overrides apply at epoch "
                  "boundaries)")
 
+    def _resolve_shard(self):
+        """(num_shards, shard_index) for the NEXT epoch.  ``"dist"``
+        reads the active process group live, so a fleet re-form is
+        picked up at the next ``__iter__`` with no loader surgery."""
+        if self._num_shards == "dist":
+            from ...parallel import dist
+            if dist.is_initialized():
+                return dist.num_workers(), dist.rank()
+            return 1, 0
+        if self._num_shards is None:
+            return 1, 0
+        return self._num_shards, self._shard_index
+
+    # -- position cursor (checkpoint payload) -------------------------------
+    def state_dict(self) -> dict:
+        """The loader's position cursor — what the ResilientTrainer
+        checkpoint payload carries so resume re-winds instead of
+        replaying the epoch.  ``global`` is the exact fleet-wide batch
+        position (every shard advances in lockstep with the training
+        step); ``batch``/``num_shards`` describe this shard's local
+        count, kept for observability."""
+        consumed = self._cursor_batch - self._cursor_start
+        return {"epoch": self._epoch,
+                "batch": self._cursor_batch,
+                "num_shards": self._cursor_shards,
+                "global": self._cursor_gbase +
+                consumed * self._cursor_shards}
+
+    def load_state_dict(self, state: dict) -> None:
+        """Restore a :meth:`state_dict` cursor.  Takes effect at the
+        next ``__iter__``: the epoch counter is restored and the epoch
+        fast-forwards to the saved GLOBAL position re-mapped onto the
+        CURRENT shard assignment — index lists are dropped unbuilt, no
+        dataset reads.  (Cursors without ``global`` — a pre-PR-9 or
+        hand-built dict — fall back to ``batch * num_shards``.)"""
+        g = state.get("global")
+        if g is None:
+            g = int(state.get("batch", 0)) * \
+                max(1, int(state.get("num_shards", 1)))
+        self._pending_state = {
+            "epoch": int(state.get("epoch", 0)),
+            "global": int(g)}
+
+    def _epoch_plan(self, num_shards, shard_index, start_batch):
+        """(global_index, sample_indices) pairs for THIS shard this
+        epoch, skipping the first ``start_batch`` shard-local batches
+        without building them."""
+        taken = 0
+        for i, indices in enumerate(self._batch_sampler):
+            if num_shards > 1 and i % num_shards != shard_index:
+                continue
+            taken += 1
+            if taken <= start_batch:
+                continue   # fast-forward: the index list is dropped,
+                # the samples are never read
+            yield i, indices
+
     def __len__(self):
-        return len(self._batch_sampler)
+        n = len(self._batch_sampler)
+        k, s = self._resolve_shard()
+        if k <= 1:
+            return n
+        return len(range(s, n, k))
 
     def _make_batch(self, indices, batch_idx=None):
         # the batch id rides to the chrome-trace timeline as event args
@@ -177,9 +283,33 @@ class DataLoader:
             active.pop(worker, None)
 
     def __iter__(self):
+        k, s = self._resolve_shard()
+        start_batch = 0
+        gbase = 0
+        if self._pending_state is not None:
+            pending = self._pending_state
+            self._pending_state = None
+            self._epoch = pending["epoch"]
+            # global batches [0, G) are consumed fleet-wide; this shard
+            # owns global indices ≡ shard_index (mod k), of which
+            # [0, G) contains G//k plus one more when the shard's index
+            # falls inside the G%k remainder — without that correction,
+            # shards below the remainder re-train one already-consumed
+            # batch after a re-shard
+            gbase = pending["global"]
+            start_batch = gbase // k + (1 if gbase % k > s else 0)
+        else:
+            self._epoch += 1
+        self._cursor_shards = k
+        self._cursor_gbase = gbase
+        self._cursor_start = start_batch
+        self._cursor_batch = start_batch
+        plan = self._epoch_plan(k, s, start_batch)
         if self._num_workers == 0:
-            for bi, indices in enumerate(self._batch_sampler):
-                yield self._make_batch(indices, bi)
+            for bi, indices in plan:
+                batch = self._make_batch(indices, bi)
+                self._cursor_batch += 1
+                yield batch
             return
         # threaded prefetch pipeline with a bounded in-flight window so a
         # slow consumer never materializes more than window batches.
@@ -194,45 +324,78 @@ class DataLoader:
         sentinel = object()
         window = self._num_workers + prefetch
         active: dict = {}   # worker thread name -> batch index in progress
+        # abandonment flag: an epoch iterator dropped mid-epoch (a
+        # `break` at a target step, FleetReformed at a step boundary —
+        # a DESIGNED, recurring path under elastic supervision) must
+        # release the producer, which would otherwise block forever in
+        # q.put with its whole worker pool pinned
+        abandoned = threading.Event()
+
+        def hand_over(item) -> bool:
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
 
         def producer():
             try:
                 with ThreadPoolExecutor(self._num_workers) as pool:
-                    it = iter(self._batch_sampler)
                     inflight = collections.deque()
-                    for i, idx in enumerate(it):
+                    for i, idx in plan:
+                        if abandoned.is_set():
+                            return
                         inflight.append(pool.submit(
                             self._worker_batch, i, idx, active))
                         if len(inflight) >= window:
-                            q.put(inflight.popleft().result())
+                            if not hand_over(inflight.popleft().result()):
+                                return
                     while inflight:
-                        q.put(inflight.popleft().result())
+                        if not hand_over(inflight.popleft().result()):
+                            return
             except BaseException as exc:   # surface worker failures
-                q.put(_WorkerError(exc))
+                hand_over(_WorkerError(exc))
             finally:
-                q.put(sentinel)
+                try:
+                    q.put_nowait(sentinel)
+                except queue.Full:
+                    pass   # only reachable when abandoned: nobody reads
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
         expected = 0
-        while True:
-            try:
-                item = q.get(timeout=self._timeout)
-            except queue.Empty:
-                stuck = dict(active)
-                raise MXNetError(
-                    f"DataLoader prefetch timed out after "
-                    f"{self._timeout}s waiting for batch {expected}"
-                    + (f"; stalled workers (worker -> batch): {stuck}"
-                       if stuck else "; no worker is active — the "
-                       "producer thread may have died")) from None
-            if item is sentinel:
-                break
-            if isinstance(item, _WorkerError):
-                raise item.exc
-            # queue depth AFTER taking our batch: what the consumer
-            # would find if it came back immediately (the ROADMAP's
-            # prefetch-health gauge; also in flight-recorder records)
-            self._g_depth.set(q.qsize())
-            yield item
-            expected += 1
+        try:
+            while True:
+                try:
+                    item = q.get(timeout=self._timeout)
+                except queue.Empty:
+                    stuck = dict(active)
+                    raise MXNetError(
+                        f"DataLoader prefetch timed out after "
+                        f"{self._timeout}s waiting for batch {expected}"
+                        + (f"; stalled workers (worker -> batch): {stuck}"
+                           if stuck else "; no worker is active — the "
+                           "producer thread may have died")) from None
+                if item is sentinel:
+                    break
+                if isinstance(item, _WorkerError):
+                    raise item.exc
+                # queue depth AFTER taking our batch: what the consumer
+                # would find if it came back immediately (the ROADMAP's
+                # prefetch-health gauge; also in flight-recorder records)
+                self._g_depth.set(q.qsize())
+                self._cursor_batch += 1
+                yield item
+                expected += 1
+        finally:
+            # runs on normal exhaustion AND on generator close
+            # (GeneratorExit from an abandoned for-loop): unblock the
+            # producer and drop whatever it already queued
+            abandoned.set()
+            while True:
+                try:
+                    q.get_nowait()
+                except queue.Empty:
+                    break

@@ -36,6 +36,15 @@ applied/held/dry-run, the reason string).  A crash dump carries it as
 ring, not just in a Prometheus history that died with the scrape
 endpoint.
 
+Elastic fleet (PR-9): a fourth ring holds **membership events** —
+``record_membership()`` appends one record per lease-expiry suspicion,
+fencing discovery, and committed re-form (with the detect → quiesce →
+reform → resume timeline), fed by
+:mod:`mxnet_tpu.parallel.membership` and the ``ResilientTrainer``
+re-form arc.  A crash dump carries it as ``membership`` next to
+``steps``/``requests``/``tuning``, so a post-mortem shows *when* the
+fleet shrank and what the survivors did about it.
+
 Cost discipline: ``record()`` is a dict build and a deque append — no
 formatting, no I/O, no device sync.  Device-backed values (the step
 loss) are stored as live references and materialized only at dump time,
@@ -80,6 +89,12 @@ def _materialize(v):
     broken to read them yields None instead of blocking the dump."""
     if v is None or isinstance(v, (bool, int, str)):
         return v
+    if isinstance(v, (list, tuple)):
+        # membership records carry member lists and (phase, ts)
+        # timelines — recurse instead of degrading them to None
+        return [_materialize(x) for x in v]
+    if isinstance(v, dict):
+        return {str(k): _materialize(x) for k, x in v.items()}
     try:
         if hasattr(v, "asnumpy"):
             # crash-dump materialization: the process is dying and
@@ -109,6 +124,8 @@ class FlightRecorder:
         self._req_ring: Deque[dict] = collections.deque(
             maxlen=max(1, self.capacity))
         self._tune_ring: Deque[dict] = collections.deque(
+            maxlen=max(1, self.capacity))
+        self._member_ring: Deque[dict] = collections.deque(
             maxlen=max(1, self.capacity))
         self._lock = threading.Lock()
         self._installed = False
@@ -143,6 +160,15 @@ class FlightRecorder:
         with self._lock:
             self._tune_ring.append(fields)
 
+    def record_membership(self, **fields) -> None:
+        """Append one fleet-membership event (lease suspicion, fencing,
+        committed re-form with its timeline) to the membership ring
+        (same cost discipline: dict build + deque append)."""
+        if not self.enabled:
+            return
+        with self._lock:
+            self._member_ring.append(fields)
+
     def records(self) -> List[dict]:
         with self._lock:
             return list(self._ring)
@@ -155,11 +181,16 @@ class FlightRecorder:
         with self._lock:
             return list(self._tune_ring)
 
+    def memberships(self) -> List[dict]:
+        with self._lock:
+            return list(self._member_ring)
+
     def clear(self) -> None:
         with self._lock:
             self._ring.clear()
             self._req_ring.clear()
             self._tune_ring.clear()
+            self._member_ring.clear()
 
     def _resolve_path(self, path: Optional[str]) -> str:
         if path:
@@ -188,6 +219,8 @@ class FlightRecorder:
                         for rec in self._req_ring]
             tunings = [{k: _materialize(v) for k, v in rec.items()}
                        for rec in self._tune_ring]
+            memberships = [{k: _materialize(v) for k, v in rec.items()}
+                           for rec in self._member_ring]
         try:
             snapshot = registry().snapshot()
         except Exception:   # noqa: BLE001 — a half-torn registry still
@@ -204,6 +237,8 @@ class FlightRecorder:
             "requests": requests,
             "n_tuning": len(tunings),
             "tuning": tunings,
+            "n_membership": len(memberships),
+            "membership": memberships,
             "snapshot": snapshot,
         }
         try:

@@ -376,11 +376,17 @@ _last_host_states: Optional[List[Tuple[int, dict]]] = None
 
 
 def host_id() -> int:
-    """This process's index in the fleet (0 when single-process)."""
+    """This process's index in the fleet (0 when single-process).
+
+    The PHYSICAL (launcher-assigned) id, deliberately not the logical
+    rank: a fleet re-form re-assigns logical ranks contiguously over
+    the survivors, and a metric series whose ``host`` label silently
+    remapped mid-run would splice two different machines' histories
+    together."""
     try:
         from ..parallel import dist
         if dist.is_initialized():
-            return dist.rank()
+            return dist.phys_rank()
     except Exception:   # noqa: BLE001 — jax state probing must not
         pass            # break local-only metrics
     return 0

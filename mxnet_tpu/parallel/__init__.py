@@ -10,10 +10,13 @@ from .mesh import (MESH_AXES, ShardingRules, default_mesh, make_mesh,
 from .optim import FunctionalOptimizer, make_functional_optimizer
 from .ring import ring_attention
 from .trainer import ShardedTrainer
+from .membership import (FleetLost, FleetReformed, HostFenced,
+                         MembershipManager)
 from .resilience import ResilientTrainer, TrainingPreempted
 from . import dist
 
 __all__ = ["MESH_AXES", "ShardingRules", "default_mesh", "make_mesh",
            "replicated", "shard", "FunctionalOptimizer",
            "make_functional_optimizer", "ring_attention", "ShardedTrainer",
-           "ResilientTrainer", "TrainingPreempted", "dist"]
+           "ResilientTrainer", "TrainingPreempted", "MembershipManager",
+           "FleetReformed", "FleetLost", "HostFenced", "dist"]

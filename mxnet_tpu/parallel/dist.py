@@ -36,13 +36,16 @@ from __future__ import annotations
 
 import os
 import threading
-from typing import Optional
+from typing import Optional, Tuple
 
 from ..base import MXNetError, get_env
 
 __all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
+           "phys_rank", "active_members", "fence_generation",
+           "set_active_members", "reset_active_members",
            "allreduce_host", "allgather_host", "allgather_bytes",
-           "broadcast_host", "barrier", "kv_publish", "kv_collect"]
+           "broadcast_host", "barrier", "kv_publish", "kv_collect",
+           "kv_purge_rank"]
 
 
 def is_initialized() -> bool:
@@ -62,7 +65,8 @@ def init_process_group(coordinator: Optional[str] = None,
                        process_id: Optional[int] = None,
                        timeout: Optional[float] = None,
                        retries: int = 2,
-                       backoff: float = 1.0) -> None:
+                       backoff: float = 1.0,
+                       elastic: Optional[bool] = None) -> None:
     """Join the multi-process runtime (idempotent).
 
     Arguments default to the reference's launcher env vars
@@ -76,6 +80,16 @@ def init_process_group(coordinator: Optional[str] = None,
     seconds — under a real launcher the coordinator routinely comes up
     AFTER the workers.  The final failure is wrapped in an
     :class:`MXNetError` naming the coordinator and rank.
+
+    ``elastic`` (default: the ``MXTPU_ELASTIC`` env knob) prepares the
+    group for host loss: the coordination service's OWN task-heartbeat
+    reaper is effectively disabled, because its reaction to a silent
+    task is to propagate a fatal error that TERMINATES every surviving
+    process (~100s after the death, with jax defaults) — the opposite
+    of surviving it.  Liveness judgment then belongs solely to the
+    membership lease layer (:mod:`mxnet_tpu.parallel.membership`),
+    which detects the loss within one lease TTL and re-forms the fleet
+    instead of dying with it.
     """
     if is_initialized():
         return
@@ -108,16 +122,44 @@ def init_process_group(coordinator: Optional[str] = None,
             "num_processes, process_id) before kv.create('dist_sync')")
     if timeout is None:
         timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
+    if elastic is None:
+        elastic = bool(get_env("MXTPU_ELASTIC"))
+    join_kwargs = {}
+    if elastic:
+        # the service reaper would otherwise broadcast a FATAL error on
+        # the first silent task and jax's error-polling thread would
+        # terminate every survivor — the membership lease layer is the
+        # liveness authority in an elastic fleet
+        join_kwargs["service_heartbeat_interval_seconds"] = 10
+        join_kwargs["service_max_missing_heartbeats"] = 1_000_000
     import jax
     from ..faults import retry_call
 
     def _join():
         try:
-            jax.distributed.initialize(
-                coordinator_address=coordinator,
-                num_processes=num_processes,
-                process_id=process_id,
-                initialization_timeout=max(1, int(timeout)))
+            if not join_kwargs:
+                jax.distributed.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    initialization_timeout=max(1, int(timeout)))
+            else:
+                # the public wrapper does not forward the heartbeat
+                # knobs — replicate its two lines (backend guard +
+                # global_state.initialize) with them added
+                from jax._src import distributed as _jdist
+                from jax._src import xla_bridge as _xb
+                if _xb.backends_are_initialized():
+                    raise MXNetError(
+                        "init_process_group(elastic=True) must run "
+                        "before any JAX computation initializes the "
+                        "backend")
+                _jdist.global_state.initialize(
+                    coordinator_address=coordinator,
+                    num_processes=num_processes,
+                    process_id=process_id,
+                    initialization_timeout=max(1, int(timeout)),
+                    **join_kwargs)
         except Exception:
             # a failed connect leaves jax's global client/service assigned
             # (State.initialize sets them BEFORE connect()), and a retry
@@ -146,14 +188,125 @@ def init_process_group(coordinator: Optional[str] = None,
             f"({timeout:.0f}s connect timeout each): {exc}") from exc
 
 
-def rank() -> int:
+# -- the active process group (elastic-fleet narrowing) ---------------------
+#
+# The coordination service is joined ONCE at the launcher's world size and
+# its process ids never change.  After a host loss the survivors re-form
+# the logical process group at the new world size (parallel/membership.py):
+# the surviving ORIGINAL process ids become the active member set, logical
+# ranks are re-assigned contiguously by sorting them, and every KV-path
+# collective below iterates the active set only — so the group keeps
+# working over the same coordinator without the dead host.  Physical ids
+# (``phys_rank``) stay stable across re-forms and key every per-host KV
+# namespace; logical coordinates (``rank``/``num_workers``) are what data
+# sharding and collective result indexing see.
+
+_group_lock = threading.Lock()
+_members: Optional[Tuple[int, ...]] = None   # original ids, sorted; None =
+_fence = 0                                   # full launcher world
+
+
+def phys_rank() -> int:
+    """This process's ORIGINAL id in the coordination service — stable
+    across fleet re-forms (logical :func:`rank` is not)."""
     import jax
     return jax.process_index()
 
 
+def rank() -> int:
+    """Logical rank: contiguous in the ACTIVE member set.  Equal to
+    :func:`phys_rank` until a fleet re-form narrows the group."""
+    with _group_lock:
+        members = _members
+    if members is None:
+        import jax
+        return jax.process_index()
+    return members.index(phys_rank())
+
+
 def num_workers() -> int:
+    """Logical world size: the ACTIVE member count after re-forms."""
+    with _group_lock:
+        members = _members
+    if members is None:
+        import jax
+        return jax.process_count()
+    return len(members)
+
+
+def active_members() -> Tuple[int, ...]:
+    """The ORIGINAL process ids of the active group, sorted (logical
+    rank r is ``active_members()[r]``)."""
+    with _group_lock:
+        members = _members
+    if members is not None:
+        return members
     import jax
-    return jax.process_count()
+    return tuple(range(jax.process_count()))
+
+
+def fence_generation() -> int:
+    """The membership fencing generation: bumped by every fleet re-form;
+    KV state stamped with an older generation belongs to a fenced-out
+    incarnation and must be ignored."""
+    with _group_lock:
+        return _fence
+
+
+def set_active_members(members, fence: int) -> None:
+    """Install a re-formed process group (every survivor calls this with
+    the SAME committed member set — parallel/membership.py's consensus
+    round is the only sanctioned caller).  ``members`` are original
+    process ids; this process must be one of them."""
+    global _members, _fence
+    members = tuple(sorted(int(m) for m in members))
+    if not members:
+        raise MXNetError("set_active_members: empty member set")
+    me = phys_rank()
+    if me not in members:
+        raise MXNetError(
+            f"set_active_members: this process (id {me}) is not in the "
+            f"re-formed member set {members} — it has been fenced out "
+            f"and must exit, not install the group")
+    with _group_lock:
+        _members = members
+        _fence = int(fence)
+
+
+def reset_active_members() -> None:
+    """Drop the narrowed group (back to the full launcher world)."""
+    global _members, _fence
+    with _group_lock:
+        _members = None
+        _fence = 0
+
+
+def _deadline_wait(what: str, timeout: float, fn, *args, **kwargs):
+    """Run one blocking coordination-service call and convert its
+    DEADLINE_EXCEEDED into the typed :class:`~mxnet_tpu.faults.
+    DeadlineExceeded` every KV wait path promises.  A dead host then
+    produces a catchable fault the membership watcher takes over from,
+    instead of an opaque runtime error (or, before timeouts were
+    threaded through, an unbounded hang)."""
+    from ..faults import DeadlineExceeded
+    try:
+        return fn(*args, **kwargs)
+    except TimeoutError as exc:
+        raise DeadlineExceeded(
+            f"{what} timed out after {timeout:.1f}s "
+            f"(MXTPU_DIST_TIMEOUT) — a peer never arrived; if a host "
+            f"died, the membership layer (parallel.membership) re-forms "
+            f"the fleet from this signal") from exc
+    except Exception as exc:   # noqa: BLE001 — narrow re-raise below:
+        # jaxlib surfaces coordination-service timeouts as
+        # XlaRuntimeError('DEADLINE_EXCEEDED: ...'), not TimeoutError
+        if "DEADLINE_EXCEEDED" not in str(exc):
+            raise
+        raise DeadlineExceeded(
+            f"{what} timed out after {timeout:.1f}s "
+            f"(MXTPU_DIST_TIMEOUT) — a peer never arrived; if a host "
+            f"died, the membership layer (parallel.membership) re-forms "
+            f"the fleet from this signal") from exc
 
 
 def _gather_arrays_kv(arr, timeout: Optional[float] = None):
@@ -196,6 +349,11 @@ def allgather_host(x):
     import numpy as np
     from jax.experimental import multihost_utils
     arr = np.asarray(x)
+    if _narrowed():
+        # a re-formed group no longer matches the device world the
+        # backend was built with (the dead host is still in it) — the
+        # KV path over the surviving member set is the only transport
+        return _gather_arrays_kv(arr)
     try:
         return np.asarray(multihost_utils.process_allgather(arr))
     except Exception:   # noqa: BLE001 — backend capability, determinis-
@@ -236,32 +394,63 @@ _gen_lock = threading.Lock()
 _agb_gen = 0
 
 
+def _narrowed() -> bool:
+    """True once a fleet re-form has narrowed the active group below the
+    launcher world — device collectives (which still span the ORIGINAL
+    world, dead host included) are then off the table and every
+    collective takes its coordination-service KV path."""
+    with _group_lock:
+        return _members is not None
+
+
+def _barrier_ids(members: Tuple[int, ...]):
+    """``process_ids`` for a coordination-service barrier: None (= the
+    full launcher world, every jaxlib supports it) until a re-form has
+    narrowed the group, then the explicit surviving id list."""
+    with _group_lock:
+        narrowed = _members is not None
+    return list(members) if narrowed else None
+
+
 def _allgather_bytes_kv(data: bytes, timeout: float):
     """Byte gather over the coordination-service KV store (the same
     coordinator TCP fabric ``jax.distributed.initialize`` joined): each
     rank publishes its payload under a generation-unique key and blocks
     reading every peer's.  No device round-trip and no padding — and it
     works on backends whose device collectives don't span processes
-    (the multi-process CPU backend used in tests)."""
+    (the multi-process CPU backend used in tests).
+
+    Every blocking read is bounded by ``timeout`` and a peer that never
+    arrives raises :class:`~mxnet_tpu.faults.DeadlineExceeded` naming
+    it — the signal the membership watcher turns into a fleet re-form.
+    Peers are the ACTIVE member set: after a re-form the gather spans
+    the survivors only, indexed by logical rank."""
     import base64
     from jax._src import distributed
     global _agb_gen
     client = distributed.global_state.client
-    r, nw = rank(), num_workers()
+    me = phys_rank()
+    members = active_members()
     with _gen_lock:
         gen = _agb_gen
         _agb_gen += 1
-    key = f"mxtpu/agb/{gen}"
+    # fence-scoped namespace: a fenced-out incarnation's in-flight gather
+    # writes under the OLD fence and can never collide with the re-formed
+    # group's generation counters
+    key = f"mxtpu/agb/{fence_generation()}/{gen}"
     timeout_ms = max(1000, int(timeout * 1000))
-    client.key_value_set(f"{key}/{r}",
+    client.key_value_set(f"{key}/{me}",
                          base64.b64encode(data).decode("ascii"))
-    out = [base64.b64decode(
-        client.blocking_key_value_get(f"{key}/{i}", timeout_ms))
-        for i in range(nw)]
+    out = [base64.b64decode(_deadline_wait(
+        f"allgather_bytes gen {gen}: waiting for rank {i}", timeout,
+        client.blocking_key_value_get, f"{key}/{i}", timeout_ms))
+        for i in members]
     try:
         # only safe to delete our key once EVERY rank has read it
-        client.wait_at_barrier(f"mxtpu_agb_{gen}", timeout_ms)
-        client.key_value_delete(f"{key}/{r}")
+        client.wait_at_barrier(
+            f"mxtpu_agb_{fence_generation()}_{gen}", timeout_ms,
+            _barrier_ids(members))
+        client.key_value_delete(f"{key}/{me}")
     except Exception:   # noqa: BLE001 — cleanup is best-effort; a few
         pass            # stale keys beat a wedged gather
     return out
@@ -283,6 +472,8 @@ def allgather_bytes(data: bytes, timeout: Optional[float] = None):
         return [data]
     if timeout is None:
         timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
+    if _narrowed():
+        return _allgather_bytes_kv(data, timeout)
     try:
         return _allgather_bytes_device(data)
     except Exception:   # noqa: BLE001 — backend-dependent capability
@@ -323,8 +514,8 @@ def kv_publish(prefix: str, payload: bytes) -> None:
         raise MXNetError("kv_publish requires an initialized process "
                          "group (init_process_group)")
     client = distributed.global_state.client
-    r = rank()
-    own = f"{prefix}/{r}"
+    r = phys_rank()   # stable across re-forms: a host's namespace is its
+    own = f"{prefix}/{r}"   # ORIGINAL id, so survivors' keys never move
     with _kv_pub_lock:
         gen = _kv_pub_gens.get(prefix)
         if gen is None:
@@ -382,11 +573,50 @@ def kv_collect(prefix: str):
     return {r: base64.b64decode(v) for r, (_g, v) in newest.items()}
 
 
+def kv_purge_rank(prefix: str, dead_rank: int) -> int:
+    """Best-effort deletion of every key under ``prefix`` belonging to
+    ``dead_rank`` (by its ORIGINAL process id); returns the count
+    removed.  Covers both per-rank key shapes used in this module:
+    ``{prefix}/{rank}/{gen}`` (the :func:`kv_publish` namespace — lease
+    and fleet-gather state) and ``{prefix}/.../{rank}`` (the allgather
+    generation keys).  The membership reaper calls this after a re-form
+    commits so a dead host's frozen generations can never be served to
+    a later collect — the restart-safety purge in :func:`kv_publish`
+    only covers the SAME rank coming back, not a rank that never
+    returns."""
+    from jax._src import distributed
+    if not is_initialized():
+        return 0
+    client = distributed.global_state.client
+    tag = str(int(dead_rank))
+    removed = 0
+    try:
+        entries = client.key_value_dir_get(prefix)
+    except Exception:   # noqa: BLE001 — purge is best-effort; a few
+        return 0        # stale keys beat a crashed reaper
+    for key, _value in entries:
+        parts = key.split("/")
+        owned = parts[-1] == tag or \
+            (len(parts) >= 2 and parts[-2] == tag and parts[-1].isdigit())
+        if not owned:
+            continue
+        try:
+            client.key_value_delete(key)
+            removed += 1
+        except Exception:   # noqa: BLE001 — same best-effort contract
+            continue
+    return removed
+
+
 def broadcast_host(x):
     """Broadcast rank 0's host-local numpy array to all processes."""
     import numpy as np
     from jax.experimental import multihost_utils
     arr = np.asarray(x)
+    if _narrowed():
+        # logical rank 0 = the lowest surviving member: its slot leads
+        # the KV gather, same contract as the device broadcast
+        return _gather_arrays_kv(arr)[0]
     try:
         return np.asarray(multihost_utils.broadcast_one_to_all(arr))
     except Exception:   # noqa: BLE001 — same tiering as allgather_host
@@ -398,22 +628,49 @@ def broadcast_host(x):
 _barrier_gen = 0
 
 
-def barrier(name: str = "mxnet_tpu_barrier") -> None:
+def _barrier_kv(name: str, timeout: Optional[float] = None) -> None:
+    """Coordination-service barrier over the ACTIVE member set, bounded
+    by ``timeout`` (default ``MXTPU_DIST_TIMEOUT``) — an absent peer
+    raises :class:`~mxnet_tpu.faults.DeadlineExceeded` instead of
+    wedging the fleet.  Barrier ids must be unique per use; the
+    generation counter stays in lockstep because barrier() is a
+    collective, and it is fence-scoped so a fenced-out incarnation's
+    barriers can never alias the re-formed group's."""
     global _barrier_gen   # noqa: PLW0603 — lockstep generation counter
+    from jax._src import distributed
+    with _gen_lock:
+        gen = _barrier_gen
+        _barrier_gen += 1
+    if timeout is None:
+        timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
+    timeout_ms = max(1000, int(timeout * 1000))
+    members = active_members()
+    _deadline_wait(
+        f"barrier '{name}' gen {gen} over ranks {list(members)}",
+        timeout, distributed.global_state.client.wait_at_barrier,
+        f"mxtpu_barrier_{fence_generation()}_{name}_{gen}", timeout_ms,
+        _barrier_ids(members))
+
+
+def barrier(name: str = "mxnet_tpu_barrier",
+            timeout: Optional[float] = None) -> None:
+    """Fleet barrier, tiered like the gathers.  ``timeout`` bounds the
+    coordination-service tier (typed ``DeadlineExceeded`` on an absent
+    peer); the device-collective tier, when the backend supports it, is
+    bounded only by the backend's own collective timeout — Python
+    cannot interrupt an XLA collective.  The elastic arc therefore
+    never relies on this function for loss detection: the membership
+    layer's ``step_barrier`` goes straight to the bounded
+    coordination-service barrier."""
+    if _narrowed():
+        _barrier_kv(name, timeout)
+        return
     from jax.experimental import multihost_utils
     try:
         multihost_utils.sync_global_devices(name)
     except Exception:   # noqa: BLE001 — same tiering: the coordination
         # service's own barrier when device collectives can't span
-        # processes.  Barrier ids must be unique per use; the generation
-        # counter stays in lockstep because barrier() is a collective.
+        # processes
         if not is_initialized():
             raise
-        from jax._src import distributed
-        with _gen_lock:
-            gen = _barrier_gen
-            _barrier_gen += 1
-        timeout_ms = max(1000, int(float(
-            get_env("MXTPU_DIST_TIMEOUT")) * 1000))
-        distributed.global_state.client.wait_at_barrier(
-            f"mxtpu_barrier_{name}_{gen}", timeout_ms)
+        _barrier_kv(name, timeout)

@@ -28,26 +28,43 @@ actually survive preemptible TPU pods:
   step/checkpoint/resume wall-times record as ``resilience.*_us``
   histograms via trace spans.
 
+- **elastic-fleet supervision** (with a
+  :class:`~mxnet_tpu.parallel.membership.MembershipManager` attached): a
+  membership watcher at every step boundary plus a bounded per-step
+  fleet sync, so a lost HOST — not just a failed step — is detected
+  within a lease TTL; the survivors then quiesce, run the KV-consensus
+  re-form, restore the last committed checkpoint, re-wind the attached
+  loader onto the new shard assignment, and raise the *recoverable*
+  :class:`~mxnet_tpu.parallel.membership.FleetReformed` for the epoch
+  loop to catch and continue — no operator action, no hung collective.
+
 Every failure path is exercisable on CPU through the deterministic fault
-plan in :mod:`mxnet_tpu.faults` (``MXTPU_FAULT_PLAN``).
+plan in :mod:`mxnet_tpu.faults` (``MXTPU_FAULT_PLAN``) — including the
+host-level kinds ``host_loss`` (self-SIGKILL at a step) and
+``heartbeat_stall`` (silent lease, the false-death case).
 """
 from __future__ import annotations
 
 import atexit
+import json
 import os
 import shutil
 import signal
 import threading
+import time
 from typing import Optional, Tuple, Type
 
 from ..base import MXNetError, hot_path
-from ..faults import FaultPlan, TransientFault, active_plan, retry_call
+from ..faults import (DeadlineExceeded, FaultPlan, TransientFault,
+                      active_plan, retry_call)
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry as _metrics_registry
 from ..observability.trace import span as _span
+from .membership import FleetReformed, HostFenced, MembershipManager
 from .trainer import ShardedTrainer
 
-__all__ = ["ResilientTrainer", "TrainingPreempted"]
+__all__ = ["ResilientTrainer", "TrainingPreempted", "FleetReformed",
+           "HostFenced"]
 
 
 class TrainingPreempted(MXNetError):
@@ -168,6 +185,23 @@ class ResilientTrainer:
     skip_nonfinite : bool — enable the in-graph all-finite guard.
     dynamic_loss_scale : bool — carry a loss scale in the step (decayed on
         skipped steps, grown after ``scale_growth_interval`` clean steps).
+    membership : MembershipManager — an (already started) elastic-fleet
+        membership layer; the supervisor then watches it at every step
+        boundary, runs a per-step bounded fleet sync, and on host loss
+        quiesces → re-forms → restores the last committed checkpoint →
+        raises the recoverable :class:`FleetReformed`.
+    elastic : bool — convenience: build and start a default
+        ``MembershipManager`` (requires an initialized process group).
+    loader : DataLoader — attach the data pipeline so its position
+        cursor rides every checkpoint (sidecar ``loader-<t>.json``) and
+        resume/re-form re-winds it on the current shard assignment.
+    fleet_sync_every : int — run the bounded per-step fleet barrier
+        every N supervised steps (default 1: full lockstep).  Each sync
+        is a coordination-service round trip serialized on the slowest
+        host; jobs with millisecond steps can raise N — host-loss
+        detection only needs to beat the lease TTL (seconds), which the
+        watcher provides regardless, so a larger N trades in-band
+        detection latency for per-step overhead.
     """
 
     def __init__(self, trainer: ShardedTrainer, *,
@@ -185,7 +219,11 @@ class ResilientTrainer:
                  dynamic_loss_scale: bool = False,
                  init_loss_scale: float = 2.0 ** 15,
                  scale_growth_interval: int = 2000,
-                 scale_backoff: float = 0.5):
+                 scale_backoff: float = 0.5,
+                 membership: Optional[MembershipManager] = None,
+                 elastic: bool = False,
+                 loader=None,
+                 fleet_sync_every: int = 1):
         if not isinstance(trainer, ShardedTrainer):
             raise MXNetError(
                 f"ResilientTrainer wraps a ShardedTrainer, got "
@@ -220,7 +258,7 @@ class ResilientTrainer:
             _metrics_registry(),
             ("steps_skipped", "steps_retried", "steps_failed",
              "rollbacks", "checkpoints_written", "checkpoints_pruned",
-             "checkpoints_failed", "resumes"))
+             "checkpoints_failed", "resumes", "fleet_reforms"))
         reg = _metrics_registry()
         self._g_loss_scale = reg.gauge(
             "resilience.loss_scale",
@@ -248,6 +286,14 @@ class ResilientTrainer:
         self._prev_handlers: dict = {}
         self._resume_checked = False
         self.resumed_t: Optional[int] = None
+        # elastic fleet: the membership watcher consulted at every step
+        # boundary (host loss -> quiesce/re-form/resume arc)
+        if elastic and membership is None:
+            membership = MembershipManager()
+            membership.start()
+        self._membership = membership
+        self._fleet_sync_every = max(1, int(fleet_sync_every))
+        self._loader = loader
         # interpreter-exit fallback: an in-flight async write must commit
         # even if the loop never reaches another step boundary
         _register_exit_flush(trainer)
@@ -256,6 +302,15 @@ class ResilientTrainer:
     @property
     def trainer(self) -> ShardedTrainer:
         return self._trainer
+
+    @property
+    def membership(self) -> Optional[MembershipManager]:
+        return self._membership
+
+    def attach_loader(self, loader) -> None:
+        """Attach (or replace) the data pipeline whose position cursor
+        rides the checkpoint payload."""
+        self._loader = loader
 
     @property
     def loss_scale(self) -> float:
@@ -357,6 +412,7 @@ class ResilientTrainer:
             self._trainer.load_checkpoint(self._ckpt_dir)
         self.resumed_t = self._trainer.num_update
         self._last_saved_t = self.resumed_t
+        self._restore_loader_cursor(self.resumed_t)
         self._metrics.inc("resumes")
         return self.resumed_t
 
@@ -374,6 +430,14 @@ class ResilientTrainer:
         self._step_index += 1
         i = self._step_index
         plan = self._plan
+        if plan is not None:
+            self._fire_host_faults(i, plan)
+        if self._membership is not None:
+            # the membership watcher's step-boundary surface: this
+            # host's own fencing first, then any pending re-form
+            self._membership.raise_if_fenced()
+            if self._membership.reform_needed:
+                self._reform_and_resume(i)
 
         def one_attempt():
             if self._step_unsafe:
@@ -455,6 +519,9 @@ class ResilientTrainer:
             self._pending_finite.append(self._trainer.last_step_finite)
             if len(self._pending_finite) >= 128:
                 self._drain_finite()
+        if self._membership is not None and \
+                i % self._fleet_sync_every == 0:
+            self._fleet_step_sync(i)
         if self.preempted:
             self._flush_and_raise()
         if self._ckpt_dir is not None and self._every > 0 and \
@@ -465,6 +532,139 @@ class ResilientTrainer:
                 pass   # counted in checkpoints_failed; the next periodic
                 # save (or the preemption path) covers the gap
         return loss
+
+    # -- elastic fleet ------------------------------------------------------
+    def _fire_host_faults(self, i: int, plan) -> None:
+        """The host-level fault sites (MXTPU_FAULT_PLAN), wired exactly
+        like the step-level kinds — 1-based supervisor step counter,
+        each entry consumed on fire.  Only the process whose own plan
+        carries the entry is affected: that is how a rank is targeted
+        (plans are per-process env/state, not fleet-shared)."""
+        spec = plan.scheduled("host_loss", i)
+        if spec is not None:
+            # a machine loss, not a shutdown: no flush, no atexit, no
+            # SIGTERM grace — SIGKILL ourselves (or arg as an exit code
+            # for platforms where a test must distinguish the two)
+            if spec.arg is None:
+                os.kill(os.getpid(), signal.SIGKILL)
+                os._exit(137)   # unreachable; SIGKILL is not maskable
+            os._exit(int(spec.arg))
+        spec = plan.scheduled("heartbeat_stall", i)
+        if spec is not None:
+            if self._membership is None:
+                raise MXNetError(
+                    "fault 'heartbeat_stall': no membership layer is "
+                    "attached (pass membership=/elastic=True)")
+            self._membership.stall_heartbeats(spec.arg)
+
+    def _fleet_step_sync(self, i: int) -> None:
+        """Per-step bounded lockstep sync over the active members.  A
+        dead peer turns this into ``DeadlineExceeded`` within ~2 lease
+        TTLs; a forced lease scan then decides: confirmed loss (or a
+        peer already opened a re-form round) routes into the re-form
+        arc, anything else re-raises — a timeout with every lease fresh
+        is real desync, not host loss, and hiding it would be worse."""
+        try:
+            self._membership.step_barrier()
+        except DeadlineExceeded:
+            self._membership.scan()
+            self._membership.raise_if_fenced()
+            if self._membership.reform_needed:
+                self._reform_and_resume(i)
+            raise
+
+    def quiesce(self) -> None:
+        """Stop touching shared state at a step boundary: resolve the
+        pending device-side skip flags and flush any in-flight async
+        checkpoint write.  Fleet-synchronized like a collective — every
+        survivor quiesces before the re-form round (the
+        collective-safety lint rule checks nothing reaches this from a
+        rank-divergent branch)."""
+        self._drain_finite()
+        try:
+            self._trainer.wait_checkpoint()
+        except Exception:   # noqa: BLE001 — a torn in-flight write is
+            # abandoned; resume only ever reads COMMITTED checkpoints
+            pass
+
+    def _reform_and_resume(self, i: int) -> None:
+        """The quiesce → re-form → resume arc.  Runs at a step
+        boundary on every survivor, then raises the *recoverable*
+        :class:`FleetReformed`: the training loop catches it, rebuilds
+        its epoch iterator (the shard assignment changed), and
+        continues — no operator action.
+
+        Resume restores the newest committed checkpoint (params,
+        optimizer state, RNG stream, update counter) and re-winds the
+        attached loader's cursor onto the new shard assignment.  With
+        no committed checkpoint yet, training state is left as-is
+        (survivors are self-consistent — each kept its own params) and
+        ``result.resumed_t`` is None."""
+        mship = self._membership
+        self._flight.record_membership(
+            event="quiesce", ts=round(time.time(), 3), step=i,
+            t=self._trainer.num_update if self._trainer.built else 0)
+        with _span("resilience.reform_us", args={"step": i}):
+            self.quiesce()
+            result = mship.reform()
+            resumed = None
+            if self._ckpt_dir is not None and self._trainer.built and \
+                    ShardedTrainer.latest_checkpoint(self._ckpt_dir) \
+                    is not None:
+                self._trainer.load_checkpoint(self._ckpt_dir)
+                resumed = self._trainer.num_update
+                self._last_saved_t = resumed
+                self._restore_loader_cursor(resumed)
+                self._metrics.inc("resumes")
+                self.resumed_t = resumed
+        self._metrics.inc("fleet_reforms")
+        self._flight.record_membership(
+            event="resume", ts=round(time.time(), 3), step=i,
+            t=resumed, fence=result.fence,
+            members=list(result.members))
+        raise FleetReformed(
+            result._replace(resumed_t=resumed),
+            f"fleet re-formed at generation {result.fence}: lost rank(s) "
+            f"{list(result.dead)}, continuing at world size "
+            f"{result.new_world} (this host is now rank "
+            f"{result.new_rank})"
+            + (f" from the step-{resumed} checkpoint" if resumed
+               is not None else " with no committed checkpoint to "
+               "restore — training state left as-is"))
+
+    # -- loader position sidecar --------------------------------------------
+    def _loader_sidecar(self, t: int) -> str:
+        return os.path.join(self._ckpt_dir, f"loader-{t:08d}.json")
+
+    def _save_loader_cursor(self, t: int) -> None:
+        """Write the attached loader's position cursor next to the
+        step's checkpoint dir (synchronous — it is a few bytes; the
+        orbax state write stays async).  Best-effort by design: a
+        missing sidecar degrades resume to epoch start, never blocks
+        the checkpoint."""
+        if self._loader is None or \
+                not hasattr(self._loader, "state_dict"):
+            return
+        try:
+            payload = json.dumps(self._loader.state_dict())
+            tmp = self._loader_sidecar(t) + f".tmp.{os.getpid()}"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, self._loader_sidecar(t))
+        except Exception:   # noqa: BLE001 — see docstring
+            pass
+
+    def _restore_loader_cursor(self, t: int) -> None:
+        if self._loader is None or \
+                not hasattr(self._loader, "load_state_dict"):
+            return
+        try:
+            with open(self._loader_sidecar(t)) as f:
+                self._loader.load_state_dict(json.load(f))
+        except FileNotFoundError:
+            return   # pre-sidecar checkpoint: epoch restarts from 0
+        except Exception:   # noqa: BLE001 — a torn sidecar degrades the
+            return          # same way, never blocks resume
 
     def _record_step(self, i: int, loss, step_us: float,
                      failed: bool = False) -> None:
@@ -517,6 +717,7 @@ class ResilientTrainer:
             # the training loop
             self._trainer.save_checkpoint(self._ckpt_dir)
             self._last_saved_t = t
+            self._save_loader_cursor(t)
             self._metrics.inc("checkpoints_written")
             if wait:
                 self._trainer.wait_checkpoint()
@@ -555,6 +756,13 @@ class ResilientTrainer:
         committed = ShardedTrainer.committed_checkpoints(self._ckpt_dir)
         for path in committed[:-self._keep_last]:
             shutil.rmtree(path, ignore_errors=True)
+            # the loader-position sidecar rides its step dir's lifetime
+            digits = os.path.basename(path).split("-", 1)[-1]
+            try:
+                os.remove(os.path.join(self._ckpt_dir,
+                                       f"loader-{digits}.json"))
+            except OSError:
+                pass
             self._metrics.inc("checkpoints_pruned")
         if not committed:
             return

@@ -476,10 +476,44 @@ class ShardedTrainer:
     def _checkpointer(self):
         # one long-lived async checkpointer: save() returns once the
         # arrays are snapshotted and the write overlaps training; call
-        # wait_checkpoint() (or let process exit paths flush) to block
+        # wait_checkpoint() (or let process exit paths flush) to block.
+        #
+        # Multi-process groups get explicit MultiprocessingOptions:
+        # orbax's default process sync is a DEVICE collective
+        # (sync_global_devices), which the multi-process CPU backend
+        # cannot run at all and which, on any backend, spans the FULL
+        # launcher world — a dead host would wedge every later save.
+        # Passing active_processes routes every orbax barrier through
+        # the coordination service over the ACTIVE member set (the same
+        # tiering dist.py uses), and each host is its own primary
+        # because checkpoint directories are per-host in this stack
+        # (ResilientTrainer's per-rank layout): every host writes its
+        # own commit metadata.  Rebuilt whenever a fleet re-form
+        # changes the member set — the old instance's barrier set
+        # still contains the dead host.
+        from . import dist
+        members = tuple(dist.active_members()) \
+            if dist.is_initialized() else None
+        if getattr(self, "_ckptr", None) is not None and \
+                getattr(self, "_ckptr_members", None) != members:
+            try:
+                self._ckptr.wait_until_finished()
+            except Exception:   # noqa: BLE001 — an in-flight write
+                pass            # racing a re-form is abandoned; resume
+            self._ckptr = None  # only ever reads COMMITTED checkpoints
         if getattr(self, "_ckptr", None) is None:
             import orbax.checkpoint as ocp
-            self._ckptr = ocp.StandardCheckpointer()
+            if members is not None and len(members) > 1:
+                mp = ocp.options.MultiprocessingOptions(
+                    primary_host=dist.phys_rank(),
+                    active_processes=set(members),
+                    barrier_sync_key_prefix=(
+                        f"mxtpu_f{dist.fence_generation()}"))
+                self._ckptr = ocp.StandardCheckpointer(
+                    multiprocessing_options=mp)
+            else:
+                self._ckptr = ocp.StandardCheckpointer()
+            self._ckptr_members = members
         return self._ckptr
 
     def _ckpt_inflight_gauge(self):
@@ -494,6 +528,23 @@ class ShardedTrainer:
         if getattr(self, "_ckptr", None) is not None:
             self._ckptr.wait_until_finished()
             self._ckpt_inflight_gauge().set(0)
+
+    def _host_local_checkpoint(self) -> bool:
+        """True when this trainer's state must be saved as HOST values:
+        a multi-process group whose mesh is local to this host (each
+        process trains its own replica — the elastic-fleet CPU layout).
+        Orbax refuses to serialize such 'host-local' jax arrays, and
+        they carry no cross-host sharding worth preserving anyway.  A
+        mesh that genuinely spans processes (TPU pod) keeps the sharded
+        orbax path."""
+        from . import dist
+        if not dist.is_initialized():
+            return False
+        import jax
+        if jax.process_count() <= 1:
+            return False
+        local = set(jax.local_devices())
+        return all(d in local for d in self._mesh.devices.flat)
 
     def save_checkpoint(self, directory: str) -> None:
         """Write the trainer-owned SHARDED state (params, aux, optimizer
@@ -516,12 +567,78 @@ class ShardedTrainer:
             # loss scale + clean-step counter ride along so a resumed run
             # replays the dynamic-scale trajectory bit-for-bit
             tree["guard"] = list(self._gstate)
+        if self._host_local_checkpoint():
+            self._save_host_local(directory, tree)
+            self._ckpt_inflight_gauge().set(0)
+            return
         self._checkpointer().save(
             os.path.join(directory, f"state-{self._t:08d}"), tree,
             force=True)
         # the write overlaps training from here until the next
         # wait_checkpoint() — the ROADMAP's checkpoint-in-flight gauge
         self._ckpt_inflight_gauge().set(1)
+
+    _HOST_LOCAL_NPZ = "host_local.npz"
+
+    def _save_host_local(self, directory: str, tree: dict) -> None:
+        """Per-host atomic checkpoint for multi-process groups whose
+        mesh is host-local: orbax refuses to serialize host-local jax
+        arrays, and its replicated-numpy handler writes on GLOBAL
+        process 0 only — neither fits a fleet of independent per-host
+        replicas.  This path writes the host's full state itself (npz
+        into a tmp dir, commit marker, atomic rename), producing
+        exactly the committed-dir shape ``committed_checkpoints`` /
+        ``latest_checkpoint`` already filter on.  Synchronous and
+        barrier-free by design: per-host independence is the
+        elastic-fleet story — no cross-host coordination can wedge
+        this save when a peer is dead."""
+        import os
+        import shutil
+        import jax
+        import numpy as _nnp
+        flat = {f"p{i}": v for i, v in enumerate(tree["params"])}
+        flat.update({f"a{i}": v for i, v in enumerate(tree["aux"])})
+        flat.update({f"s{i}": v for i, v in
+                     enumerate(jax.tree.leaves(tree["opt_state"]))})
+        flat["rng"] = tree["rng"]
+        flat["t"] = tree["t"]
+        if "guard" in tree:
+            flat.update({f"g{i}": v for i, v in enumerate(tree["guard"])})
+        flat = jax.device_get(flat)
+        final = os.path.join(directory, f"state-{self._t:08d}")
+        tmp = f"{final}.mxtpu-tmp-{os.getpid()}"
+        os.makedirs(tmp, exist_ok=True)
+        _nnp.savez(os.path.join(tmp, self._HOST_LOCAL_NPZ), **flat)
+        with open(os.path.join(tmp, _COMMIT_MARKER), "w") as f:
+            f.write("mxtpu host-local checkpoint\n")
+        if os.path.isdir(final):
+            shutil.rmtree(final, ignore_errors=True)
+        os.replace(tmp, final)
+
+    def _load_host_local(self, path: str) -> None:
+        """Restore a :meth:`_save_host_local` checkpoint onto this
+        trainer's shardings."""
+        import os
+        import jax
+        import jax.numpy as jnp
+        import numpy as _nnp
+        data = _nnp.load(os.path.join(path, self._HOST_LOCAL_NPZ))
+        self._pvals = [jax.device_put(data[f"p{i}"], s)
+                       for i, s in enumerate(self._p_sh)]
+        self._avals = [jax.device_put(data[f"a{i}"], s)
+                       for i, s in enumerate(self._a_sh)]
+        s_flat, s_def = jax.tree.flatten(self._state)
+        sh_flat = jax.tree.leaves(self._s_sh)
+        self._state = jax.tree.unflatten(
+            s_def, [jax.device_put(data[f"s{i}"], sh)
+                    for i, sh in enumerate(sh_flat[:len(s_flat)])])
+        _grandom.set_state(jnp.asarray(data["rng"]))
+        self._t = int(data["t"])
+        self._optimizer.num_update = self._t
+        if "g0" in data and self._guard:
+            self._gstate = tuple(
+                jax.device_put(jnp.asarray(data[f"g{i}"]), self._r_sh)
+                for i in range(2))
 
     @staticmethod
     def committed_checkpoints(directory: str) -> List[str]:
@@ -568,6 +685,12 @@ class ShardedTrainer:
         if path is None:
             raise MXNetError(f"no checkpoint under {directory!r}")
         self.wait_checkpoint()
+        import os
+        if os.path.exists(os.path.join(path, self._HOST_LOCAL_NPZ)):
+            # written by _save_host_local (per-host multi-process
+            # checkpoint) — restore without orbax
+            self._load_host_local(path)
+            return
         rng_now = _grandom.get_state()
         if rng_now is None:              # seed the stream so the
             _grandom.next_key()          # template has a concrete leaf

@@ -44,15 +44,23 @@ __all__ = ["Project", "FuncFacts", "ModuleFacts", "build_project",
 #: over-approximation cannot walk the whole repo from one call site.
 MAX_CALL_DEPTH = 6
 
-#: fleet collectives: every host must reach these or none may
+#: fleet collectives: every host must reach these or none may.  The
+#: elastic-fleet membership/quiesce entry points are in the checked set
+#: too: `reform`/`quiesce` are fleet-synchronized protocols (every
+#: survivor runs them or the KV consensus round never completes) and
+#: `step_barrier` IS a barrier — so none of them may be reachable from
+#: a surviving-rank branch either
 COLLECTIVES = frozenset((
     "allgather_bytes", "allgather_host", "allreduce_host",
-    "broadcast_host", "barrier"))
+    "broadcast_host", "barrier", "reform", "quiesce", "step_barrier"))
 
-#: identifiers whose value DIVERGES across hosts
+#: identifiers whose value DIVERGES across hosts — including the
+#: re-form protocol's survivor/leader coordinates (`if me == leader:`
+#: is exactly as host-divergent as `if rank == 0:`)
 HOST_TOKENS = frozenset((
     "process_index", "process_id", "host_id", "rank", "worker_id",
-    "local_rank", "host"))
+    "local_rank", "host", "leader", "is_leader", "phys_rank",
+    "new_rank", "survivor", "survivors"))
 
 #: the decorator name marking hot-path roots (mxnet_tpu.base.hot_path)
 HOT_PATH_MARK = "hot_path"

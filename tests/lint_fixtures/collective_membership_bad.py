@@ -1,0 +1,19 @@
+"""mxlint fixture: must trip collective-safety (and nothing else) —
+the elastic-fleet membership entry points (reform/quiesce/step_barrier)
+are fleet-synchronized like collectives: a leader-only re-form means
+the other survivors never join the consensus round and the fleet never
+re-forms."""
+
+
+def _recover(trainer, membership):
+    # fleet-synchronized protocol hiding inside a helper
+    trainer.quiesce()
+    return membership.reform()
+
+
+def on_host_loss(trainer, membership, leader, me):
+    if me == leader:
+        # the non-leader survivors never enter the consensus round:
+        # the view exchange waits for them until FleetLost
+        return _recover(trainer, membership)
+    return None

@@ -1,0 +1,17 @@
+"""mxlint fixture: the membership/quiesce entry points lint clean when
+EVERY survivor reaches them — the branch is fleet-uniform (all
+survivors observe the same reform_needed flag once their reapers
+converge), and leader-only work stays inside the protocol, off the
+entry-point surface."""
+
+
+def _recover(trainer, membership):
+    trainer.quiesce()
+    return membership.reform()
+
+
+def on_host_loss(trainer, membership):
+    if membership.reform_needed:
+        # every survivor's reaper raises the same flag: fleet-uniform
+        return _recover(trainer, membership)
+    return None

@@ -32,6 +32,17 @@ def test_example_serve_continuous_batching_runs():
     assert "batch efficiency" in r.stdout
 
 
+def test_example_elastic_fleet_runs():
+    """3-worker fleet, one host SIGKILLed mid-run: the example must
+    print both survivors' re-form lines and the OK marker."""
+    r = _run(["examples/elastic_fleet.py", "--target", "8",
+              "--kill-step", "3"])
+    assert r.returncode == 0, r.stdout[-2000:] + r.stderr[-2000:]
+    assert "ELASTIC_EXAMPLE_OK" in r.stdout
+    assert "killed as planned" in r.stdout
+    assert r.stdout.count("fleet re-formed at generation 1") == 2
+
+
 def test_example_selftune_controllers_runs():
     r = _run(["examples/selftune_controllers.py", "--steps", "4",
               "--ops", "120", "--cpu"])

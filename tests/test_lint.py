@@ -34,6 +34,7 @@ RULE_FOR_FIXTURE = {
     "lock_reacquire": "lock-discipline",
     "collective_safety": "collective-safety",
     "collective_transitive": "collective-safety",
+    "collective_membership": "collective-safety",
     "hot_path_purity": "hot-path-purity",
     "hidden_host_sync": "hidden-host-sync",
     "env_knob": "env-knob",
