@@ -796,6 +796,120 @@ print("RESULT " + json.dumps({
 """
 
 
+def _multichip_one_main(spec):
+    """Entry for ONE multichip config subprocess (``--multichip-one
+    dp,zero``): pin THIS process to dp cores BEFORE the first jax
+    import (XLA's execution-pool threads inherit the main thread's
+    affinity at client creation — set it later and every virtual chip
+    still sees the whole host), then time the ZeRO-sharded step on a
+    dp-device virtual CPU mesh.  One pinned core per virtual chip
+    keeps per-chip resources constant across dp — the weak-scaling
+    contract a real pod slice has."""
+    dp, zero = (int(v) for v in spec.split(","))
+    try:
+        os.sched_setaffinity(0, set(range(dp)))
+    except (AttributeError, OSError):
+        pass   # non-linux / restricted: unpinned, still measured
+    from mxnet_tpu.base import force_cpu_mesh
+    force_cpu_mesh(dp)
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(1024, activation="relu", in_units=784),
+                nn.Dense(1024, activation="relu", in_units=1024),
+                nn.Dense(10, in_units=1024))
+    net.initialize()
+    np.random.seed(0)
+    mx.random.seed(0)
+    tr = par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "adam",
+                            {"learning_rate": 1e-3},
+                            mesh=par.make_mesh({"dp": dp}),
+                            zero_stage=zero)
+    per_chip, iters, warmup = 256, 10, 3
+    B = per_chip * dp
+    x = np.random.randn(B, 784).astype(np.float32)
+    y = np.random.randint(0, 10, (B,))
+    xs, ys = tr.shard_batch(x, y)
+    for _ in range(warmup):
+        tr.step(xs, ys)
+    jax.block_until_ready(tr._pvals)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = tr.step(xs, ys)
+    jax.block_until_ready(loss._read())
+    dt = time.perf_counter() - t0
+    print(json.dumps({
+        "dp": dp, "zero_stage": zero,
+        "img_s": round(B * iters / dt, 1),
+        "opt_state_bytes_per_chip": tr.peak_opt_state_bytes(),
+        "global_batch": B,
+    }))
+
+
+def bench_multichip(per_config_timeout=600):
+    """Multichip row (ROADMAP #3 acceptance): weak-scaling aggregate
+    img/s and peak optimizer-state bytes/chip for the ZeRO-sharded
+    training step, dp=1/2/4/8 x zero_stage=0/1/2, on the virtual
+    CPU-host mesh.  Every config runs in its own subprocess because the
+    core pinning must precede XLA client creation (see
+    ``_multichip_one_main``); zero_stage changes the STATE LAYOUT only,
+    so its img/s columns double as a collective-overhead check while
+    the bytes columns are the ZeRO story.  The on-chip (real pod
+    slice) rerun is queued in the PERF.md runbook."""
+    import subprocess
+    import sys
+    grid = {}
+    for dp in (1, 2, 4, 8):
+        for zero in (0, 1, 2):
+            env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
+                       JAX_PLATFORMS="cpu")
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__),
+                     "--multichip-one", f"{dp},{zero}"],
+                    capture_output=True, text=True,
+                    timeout=per_config_timeout, env=env)
+                rec = json.loads(r.stdout.strip().splitlines()[-1])
+            except Exception as e:  # noqa: BLE001 — one failed cell
+                # must not zero the row
+                rec = {"error": f"{type(e).__name__}: {e}"[:200]}
+            grid.setdefault(f"dp{dp}", {})[f"zero{zero}"] = rec
+    row = {"model": "mlp 784-1024-1024-10, adam, fp32",
+           "per_chip_batch": 256,
+           "chip": "1 pinned CPU core per virtual chip (weak scaling: "
+                   "global batch = 256 x dp)",
+           "grid": grid}
+    try:
+        base = grid["dp1"]["zero0"]["img_s"]
+        for dp in (2, 4, 8):
+            v = grid[f"dp{dp}"]["zero0"]["img_s"]
+            row[f"speedup_dp{dp}"] = round(v / base, 2)
+            row[f"scaling_efficiency_dp{dp}"] = round(v / (dp * base), 3)
+        b0 = grid["dp4"]["zero0"]["opt_state_bytes_per_chip"]
+        row["opt_state_reduction_zero1_dp4"] = round(
+            1 - grid["dp4"]["zero1"]["opt_state_bytes_per_chip"] / b0, 3)
+        row["opt_state_reduction_zero2_dp4"] = round(
+            1 - grid["dp4"]["zero2"]["opt_state_bytes_per_chip"] / b0, 3)
+        # the satellite's 'scaling efficiency printed' — stderr, the
+        # stdout line stays the one-JSON protocol
+        print(f"multichip: dp2 {row['speedup_dp2']}x / dp4 "
+              f"{row['speedup_dp4']}x / dp8 {row['speedup_dp8']}x "
+              f"aggregate img/s vs dp1 (efficiency "
+              f"{row['scaling_efficiency_dp2']}, "
+              f"{row['scaling_efficiency_dp4']}, "
+              f"{row['scaling_efficiency_dp8']}); zero1 opt-state "
+              f"-{100 * row['opt_state_reduction_zero1_dp4']:.0f}%/chip "
+              f"at dp4", file=sys.stderr)
+    except (KeyError, TypeError, ZeroDivisionError):
+        row["error_summary"] = "one or more grid cells failed " \
+                               "(see grid entries)"
+    return row
+
+
 def bench_autotune(duration_s=2.0):
     """Autotune row — the three self-tuning acceptance comparisons:
 
@@ -1047,8 +1161,12 @@ def main():
                                        "mnist_mlp", "eager_dispatch",
                                        "bert", "bert_bf16",
                                        "nmt", "ssd", "pipeline",
-                                       "serving", "autotune"],
+                                       "serving", "autotune",
+                                       "multichip"],
                     help="run a single row (default: the full suite)")
+    ap.add_argument("--multichip-one", metavar="DP,ZERO",
+                    help="internal: measure ONE multichip grid config "
+                         "(core-pinned subprocess of --only multichip)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
                     help="kept for compat: forces the single resnet row")
@@ -1061,6 +1179,21 @@ def main():
     args = ap.parse_args()
 
     import sys
+    if args.multichip_one:
+        # config child of --only multichip: affinity must be set before
+        # any jax touch, and the backend probe is pointless (CPU-forced)
+        _multichip_one_main(args.multichip_one)
+        return
+    if args.only == "multichip":
+        # CPU-host row by definition: every measurement runs in its own
+        # CPU-forced subprocess, so the chip probe (which would CLAIM
+        # the accelerator from the real rows) is skipped
+        row = bench_multichip()
+        print(json.dumps({
+            "metric": "multichip_speedup_dp2", "unit": "x vs dp=1",
+            "value": row.get("speedup_dp2", 0.0), "vs_baseline": 0.0,
+            "rows": {"multichip": row}}))
+        return
     if not _backend_reachable():
         # the chip is gone, but two BASELINE rows are host-side by
         # nature: run each in its OWN timeout-guarded CPU-forced
@@ -1263,6 +1396,7 @@ def main():
         sub_row("pipeline", ["input_pipeline"], 900)
         sub_row("serving", ["serving"], 900)
         sub_row("autotune", ["autotune"], 900)
+        sub_row("multichip", ["multichip"], 1800)
 
     # per-row headline field + unit, so --only rows are labeled honestly
     HEADLINE = {
@@ -1279,6 +1413,7 @@ def main():
         "input_pipeline": ("images_per_sec", "images/sec"),
         "serving": ("requests_per_sec", "req/s"),
         "autotune": ("converged_bulk_size", "ops/segment"),
+        "multichip": ("speedup_dp2", "x aggregate img/s vs dp=1"),
     }
     ok = {k: v for k, v in rows.items() if "error" not in v}
     if "resnet50_bf16" in ok:

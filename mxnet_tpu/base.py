@@ -331,6 +331,34 @@ register_env("MXTPU_ELASTIC_REFORM_TIMEOUT", 60.0, float,
              "(view exchange, plan, acks, commit).  A survivor that "
              "cannot complete the round within it raises FleetLost "
              "instead of waiting forever on a fleet that cannot agree.")
+register_env("MXTPU_ZERO_STAGE", 0, int,
+             "Default ZeRO optimizer-state partitioning stage for "
+             "ShardedTrainer (0, 1 or 2).  0 = optimizer state "
+             "replicated on every chip (bitwise-identical to the "
+             "pre-ZeRO step); 1 = state sharded 1/dp per chip, "
+             "gradients reduce-scattered into each chip's slice and "
+             "updated params all-gathered inside the one jitted step; "
+             "2 = the gradient (accumulation) buffer is sharded too.  "
+             "The zero_stage= constructor argument overrides.")
+register_env("MXTPU_ACCUM_STEPS", 1, int,
+             "Default microbatched gradient accumulation for "
+             "ShardedTrainer: the step consumes its global batch as N "
+             "sequential microbatches under a lax.scan (per-microbatch "
+             "RNG split, rescale-correct vs the full batch), so global "
+             "batch scales past per-chip activation memory.  The "
+             "accum_steps= constructor argument overrides.")
+register_env("MXTPU_PREEMPT_COORD", True, bool,
+             "Coordinated preemption checkpoints: in a multi-process "
+             "group, a SIGTERM'd ResilientTrainer publishes a flush "
+             "vote over the coordination-service KV tier (no device "
+             "collective) and every host commits the SAME state-<t> "
+             "checkpoint — the agreed step is the max of all hosts' "
+             "votes.  Off = each host flushes unilaterally at its own "
+             "step (the pre-coordination behavior).")
+register_env("MXTPU_PREEMPT_POLL", 0.05, float,
+             "Poll interval in seconds for the preemption-coordination "
+             "vote wait (bounded overall by MXTPU_DIST_TIMEOUT, after "
+             "which the host falls back to a unilateral flush).")
 
 
 # ---------------------------------------------------------------------------

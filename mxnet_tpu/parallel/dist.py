@@ -44,8 +44,8 @@ __all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
            "phys_rank", "active_members", "fence_generation",
            "set_active_members", "reset_active_members",
            "allreduce_host", "allgather_host", "allgather_bytes",
-           "broadcast_host", "barrier", "kv_publish", "kv_collect",
-           "kv_purge_rank"]
+           "reduce_scatter_host", "broadcast_host", "barrier",
+           "kv_publish", "kv_collect", "kv_purge_rank"]
 
 
 def is_initialized() -> bool:
@@ -335,6 +335,34 @@ def allreduce_host(x):
     """
     import numpy as np
     return np.sum(allgather_host(x), axis=0)
+
+
+def reduce_scatter_host(x):
+    """Reduce-scatter a host-local numpy array: sum it across all
+    processes and return THIS rank's 1/num_workers slice along dim 0.
+
+    The DCN object plane's analog of the in-graph ZeRO gradient
+    reduce-scatter (``ShardedTrainer(zero_stage>=1)`` — there XLA emits
+    the collective inside the jitted step; here the object plane gets
+    the same reduce-then-own-slice contract for host-side state).  Dim
+    0 must divide by the active world size.  Like every entry point in
+    this module it is a COLLECTIVE: all ranks must call it or none —
+    the collective-safety lint rule enforces that, rank-gated calls are
+    a lint failure."""
+    import numpy as np
+    if not is_initialized():
+        # local-only fallback (1-rank world): the sum is the input and
+        # the slice is everything — same tiering as allgather_bytes
+        return np.asarray(x)
+    total = allreduce_host(x)
+    n = num_workers()
+    if total.shape[0] % n:
+        raise MXNetError(
+            f"reduce_scatter_host: dim 0 of {total.shape} does not "
+            f"divide by the world size {n}")
+    chunk = total.shape[0] // n
+    r = rank()
+    return total[r * chunk:(r + 1) * chunk]
 
 
 def allgather_host(x):

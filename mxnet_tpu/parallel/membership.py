@@ -270,6 +270,16 @@ class MembershipManager:
         self._h_reform = reg.histogram(
             "dist.membership.reform_us",
             help="wall time of one committed re-form round")
+        self._g_dp = reg.gauge(
+            "dist.membership.dp_size",
+            help="post-re-form data-parallel world size (set when the "
+                 "resilience layer re-builds the sharded step at the "
+                 "new world)")
+        self._h_reshard = reg.histogram(
+            "dist.membership.reshard_us",
+            help="wall time of the in-graph re-shard after a re-form "
+                 "(sharding re-derivation + state re-placement + jit "
+                 "rebuild)")
         self._g_alive.set(len(self._members))
         self._g_world.set(len(self._members))
         self._g_fence.set(self._fence)
@@ -349,6 +359,19 @@ class MembershipManager:
             reason = self._fenced
         if reason is not None:
             raise HostFenced(reason)
+
+    def record_reshard(self, dp_size: int, duration_us: float) -> None:
+        """Record the in-graph re-shard that followed a committed
+        re-form: the resilience layer rebuilds the sharded step at the
+        new world size and reports the post-re-form dp size + re-shard
+        wall time here, so elastic re-form timelines (metrics AND the
+        flight membership ring) show the re-shard step between restore
+        and resume."""
+        self._g_dp.set(int(dp_size))
+        self._h_reshard.observe(float(duration_us))
+        self._flight.record_membership(
+            event="reshard", ts=round(time.time(), 3),
+            dp_size=int(dp_size), reshard_us=round(float(duration_us), 1))
 
     def _set_fenced(self, reason: str) -> None:
         with self._lock:

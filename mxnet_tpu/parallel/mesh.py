@@ -26,7 +26,7 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "default_mesh", "ShardingRules", "replicated",
-           "shard", "MESH_AXES"]
+           "shard", "zero_sharding", "axis_size", "MESH_AXES"]
 
 #: canonical axis order — dp outermost (DCN/ICI-friendly), then pipeline,
 #: then the intra-layer axes
@@ -85,6 +85,44 @@ def shard(mesh, *spec):
     shard(mesh, 'dp') for batch-dim sharding."""
     from jax.sharding import NamedSharding, PartitionSpec
     return NamedSharding(mesh, PartitionSpec(*spec))
+
+
+def axis_size(mesh, name: str) -> int:
+    """Size of mesh axis ``name`` (1 when the mesh has no such axis)."""
+    return int(mesh.shape[name]) if name in mesh.axis_names else 1
+
+
+def zero_sharding(mesh, spec, shape, axis: str = "dp"):
+    """ZeRO-style NamedSharding for a per-parameter optimizer-state (or
+    gradient-accumulation) tensor: partition dim 0 over the data-parallel
+    axis ON TOP of the parameter's own PartitionSpec, so each dp rank
+    owns a 1/dp slice of the state it updates (PAPERS.md ZeRO stage 1/2).
+
+    Falls back to the parameter's own sharding — replicated state, the
+    pre-ZeRO layout — whenever the partition cannot be formed: no/size-1
+    ``axis`` on the mesh, a scalar tensor, dim 0 not divisible by the
+    axis size, dim 0 already sharded by the parameter's rules, or the
+    axis already consumed by another dim (a dp-sharded parameter cannot
+    also dp-shard its state).  The fallback is per-parameter: a model
+    keeps ZeRO savings on its big matrices even when a stray odd-shaped
+    vector cannot split."""
+    from jax.sharding import NamedSharding, PartitionSpec
+    dp = axis_size(mesh, axis)
+    entries = list(spec) + [None] * (len(shape) - len(spec))
+
+    def uses(entry, name):
+        if entry is None:
+            return False
+        if isinstance(entry, (tuple, list)):
+            return name in entry
+        return entry == name
+
+    if (dp <= 1 or not shape or int(shape[0]) % dp != 0 or
+            entries[0] is not None or
+            any(uses(e, axis) for e in entries)):
+        return NamedSharding(mesh, PartitionSpec(*spec))
+    entries[0] = axis
+    return NamedSharding(mesh, PartitionSpec(*entries))
 
 
 class ShardingRules:

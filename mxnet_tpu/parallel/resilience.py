@@ -54,7 +54,7 @@ import threading
 import time
 from typing import Optional, Tuple, Type
 
-from ..base import MXNetError, hot_path
+from ..base import MXNetError, get_env, hot_path
 from ..faults import (DeadlineExceeded, FaultPlan, TransientFault,
                       active_plan, retry_call)
 from ..observability.flight import recorder as _flight_recorder
@@ -283,6 +283,7 @@ class ResilientTrainer:
         self._save_index = 0          # checkpoint-write counter (fault site)
         self._last_saved_t = None
         self._preempt_signum: Optional[int] = None
+        self._preempt_flush_t: Optional[int] = None
         self._prev_handlers: dict = {}
         self._resume_checked = False
         self.resumed_t: Optional[int] = None
@@ -369,9 +370,11 @@ class ResilientTrainer:
 
     def _flush_and_raise(self) -> None:
         signum = self._preempt_signum
+        cause = f"signal {signum}" if signum is not None else \
+            "a peer's preemption (coordinated flush)"
         # the run is about to end: leave the postmortem dump next to the
         # preemption checkpoint BEFORE the (fallible) save below
-        self._flight.dump(f"preempted by signal {signum}")
+        self._flight.dump(f"preempted by {cause}")
         save_err = None
         try:
             if self._ckpt_dir is not None and self._trainer.built and \
@@ -387,11 +390,110 @@ class ResilientTrainer:
         where = f" (flushed to {self._ckpt_dir})" if self._ckpt_dir else ""
         if save_err is not None:
             raise TrainingPreempted(
-                f"training preempted by signal {signum}; the preemption "
+                f"training preempted by {cause}; the preemption "
                 f"checkpoint FAILED ({save_err!r}) — resume will use the "
                 f"last committed checkpoint") from save_err
         raise TrainingPreempted(
-            f"training preempted by signal {signum}{where}")
+            f"training preempted by {cause}{where}")
+
+    # -- coordinated preemption (multi-process) -----------------------------
+    #
+    # A fleet whose hosts each flush "at the next step boundary" commits
+    # DIFFERENT state-<t> dirs (SIGTERM lands at different wall times on
+    # different hosts) — resume would then mix steps across hosts.  In a
+    # multi-process group the preemption flush is therefore agreed over
+    # the bounded coordination-service KV tier (no device collective —
+    # the fabric may already be degrading when the preemption arrives):
+    # every host that sees a preemption (its own SIGTERM, or a peer's
+    # vote in the KV store) publishes its current update counter as a
+    # VOTE and waits (bounded poll, no lockstep) until every active
+    # member has voted; the agreed flush step is max(votes).  A host
+    # already at the agreed step checkpoints and raises; a host behind
+    # it keeps stepping until its counter reaches the agreed step, so
+    # every host commits the SAME `state-<t>` (the oldest carried
+    # follow-up, PR 1).  The vote wait is bounded by MXTPU_DIST_TIMEOUT:
+    # an unreachable peer degrades to the old unilateral flush rather
+    # than wedging the shutdown.
+
+    def _preempt_prefix(self) -> str:
+        from . import dist
+        return f"mxtpu/preempt/{dist.fence_generation()}"
+
+    def _preempt_coord_on(self) -> bool:
+        if not bool(get_env("MXTPU_PREEMPT_COORD")):
+            return False
+        from . import dist
+        return dist.is_initialized() and dist.num_workers() > 1
+
+    def _peer_preempt_pending(self) -> bool:
+        """A peer has opened a preemption round (its vote is in the KV
+        store).  Barrier-free read; checked at every step boundary in a
+        multi-process group."""
+        if not self._preempt_coord_on():
+            return False
+        from . import dist
+        try:
+            # kv_collect is a coordination-service RPC (host<->
+            # coordinator TCP), not a device readback — nothing here
+            # touches the async engine
+            # mxlint: disable=hidden-host-sync — KV RPC, no device sync
+            return bool(dist.kv_collect(self._preempt_prefix()))
+        except Exception:   # noqa: BLE001 — a degraded KV read must not
+            return False    # fail the step; the local signal still flushes
+
+    def _coordinate_flush_step(self) -> int:
+        """Publish this host's vote (its current update counter) and
+        wait — bounded — for every active member's; the agreed flush
+        step is the max.  Falls back to this host's own counter (the
+        unilateral pre-coordination behavior) when peers never arrive
+        within MXTPU_DIST_TIMEOUT."""
+        from . import dist
+        t_vote = self._trainer.num_update
+        prefix = self._preempt_prefix()
+        try:
+            dist.kv_publish(prefix, str(t_vote).encode("ascii"))
+        except Exception:   # noqa: BLE001 — a severed/degraded KV store
+            # (e.g. the coordinator host already exited — exactly the
+            # degraded fabric a preemption often rides in on) must not
+            # cost this host its preemption checkpoint: degrade to the
+            # unilateral flush
+            return t_vote
+        members = set(dist.active_members())
+        deadline = time.monotonic() + float(get_env("MXTPU_DIST_TIMEOUT"))
+        poll = max(0.005, float(get_env("MXTPU_PREEMPT_POLL")))
+        while True:
+            votes = {}
+            try:
+                for r, v in dist.kv_collect(prefix).items():
+                    votes[int(r)] = int(v.decode("ascii"))
+            except Exception:   # noqa: BLE001 — transient KV failure:
+                votes = {}      # retry until the deadline
+            if members <= set(votes):
+                flush_t = max(votes[r] for r in members)
+                _metrics_registry().counter(
+                    "resilience.preempt_coordinated",
+                    help="preemption rounds that agreed a fleet-wide "
+                         "flush step over the KV tier").inc()
+                return flush_t
+            if time.monotonic() > deadline:
+                return t_vote
+            time.sleep(poll)
+
+    def _preempt_pending(self) -> bool:
+        return (self.preempted or self._preempt_flush_t is not None or
+                self._peer_preempt_pending())
+
+    def _preempt_boundary(self) -> None:
+        """The step-boundary preemption surface.  Single-process (or
+        coordination off): checkpoint-and-raise immediately, exactly the
+        pre-coordination behavior.  Multi-process: agree on one flush
+        step, then flush only once this host's counter reaches it."""
+        if self._preempt_flush_t is None:
+            if not self._preempt_coord_on():
+                self._flush_and_raise()
+            self._preempt_flush_t = self._coordinate_flush_step()
+        if self._trainer.num_update >= self._preempt_flush_t:
+            self._flush_and_raise()
 
     # -- resume ------------------------------------------------------------
     def maybe_resume(self, x, y, batch_size: Optional[int] = None):
@@ -423,8 +525,12 @@ class ResilientTrainer:
         injection, bounded retry, skip accounting, preemption handling,
         periodic checkpointing.  Returns the (device) mean loss —
         NaN on a skipped step, with params untouched."""
-        if self.preempted:
-            self._flush_and_raise()
+        if self.preempted or self._preempt_flush_t is not None:
+            # local-state check only — the peer-vote KV probe runs ONCE
+            # per step (at the end-of-step boundary below); a vote
+            # landing mid-step is caught one boundary later, and the
+            # hot path never pays two dir_get RPCs per step
+            self._preempt_boundary()
         if self._auto_resume and not self._resume_checked:
             self.maybe_resume(x, y, batch_size)
         self._step_index += 1
@@ -520,10 +626,17 @@ class ResilientTrainer:
             if len(self._pending_finite) >= 128:
                 self._drain_finite()
         if self._membership is not None and \
+                self._preempt_flush_t is None and \
                 i % self._fleet_sync_every == 0:
+            # during a coordinated preemption round the lockstep sync is
+            # skipped: the initiator is parked in its vote-wait (the
+            # barrier would only time out, ~2 TTLs per catch-up step —
+            # long enough to blow the initiator's vote deadline and
+            # split the agreed flush), and the fleet is about to flush
+            # and exit anyway
             self._fleet_step_sync(i)
-        if self.preempted:
-            self._flush_and_raise()
+        if self._preempt_pending():
+            self._preempt_boundary()
         if self._ckpt_dir is not None and self._every > 0 and \
                 self._trainer.num_update % self._every == 0:
             try:
@@ -567,6 +680,19 @@ class ResilientTrainer:
         try:
             self._membership.step_barrier()
         except DeadlineExceeded:
+            # a peer parked in a preemption vote-wait skips the step
+            # barrier by design — route into the coordination round
+            # instead of treating the timeout as desync
+            if self._peer_preempt_pending():
+                self._preempt_boundary()
+                # _preempt_boundary returned instead of raising: this
+                # host is BEHIND the agreed flush step — swallow the
+                # barrier timeout and keep stepping toward it (the
+                # end-of-step boundary flushes once the counter
+                # arrives); re-raising here would surface the peer's
+                # vote-wait as desync and strand the fleet's agreed
+                # `state-<t>` without this host's commit
+                return
             self._membership.scan()
             self._membership.raise_if_fenced()
             if self._membership.reform_needed:
@@ -607,6 +733,25 @@ class ResilientTrainer:
         with _span("resilience.reform_us", args={"step": i}):
             self.quiesce()
             result = mship.reform()
+            # in-graph re-shard hook (ROADMAP #3): re-build the sharded
+            # step at the new world size — shardings re-derived, live
+            # state re-placed, jits re-lowered — BEFORE the restore, so
+            # the checkpoint (possibly saved at the old dp size) lands
+            # on the new layout.  On a host-local mesh (unchanged by a
+            # peer's death) reshard() is a no-op; the record still
+            # carries the post-re-form fleet dp size so re-form
+            # timelines show the re-shard between reform and resume.
+            # A mesh that truly SPANS hosts cannot take this path at
+            # all: the jax runtime cannot shrink a live multi-host
+            # world (the old world's collectives can never complete —
+            # the same fact that forces the dirty detach on teardown),
+            # so spanning-mesh survivors restart into a new world and
+            # re-shard on restore instead.
+            if self._trainer.built:
+                t0 = time.monotonic()
+                self._trainer.reshard()
+                mship.record_reshard(result.new_world,
+                                     (time.monotonic() - t0) * 1e6)
             resumed = None
             if self._ckpt_dir is not None and self._trainer.built and \
                     ShardedTrainer.latest_checkpoint(self._ckpt_dir) \

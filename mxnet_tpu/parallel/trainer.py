@@ -16,6 +16,20 @@ gradient psum over ICI that the reference performed through NCCL, fuses it
 with the optimizer update, and donates the param buffers (true in-place
 update at the HBM level).  Numerics match the imperative Trainer exactly
 (same formulas — parallel/optim.py).
+
+ZeRO scale-out (``zero_stage``, PAPERS.md ZeRO / Megatron-LM lineage):
+stage 0 replicates optimizer state on every chip (the reference's
+NCCL-KVStore layout, bitwise-identical to the pre-ZeRO step); stage 1
+shards optimizer state 1/dp per chip — gradients are reduce-SCATTERED
+into each chip's slice instead of psum-replicated, each chip runs its
+slice of the functional optimizer update, and the updated params are
+all-gathered, all inside the one donated jit so XLA overlaps the
+collectives with backward compute; stage 2 additionally keeps the
+gradient (accumulation) buffer sharded, so with ``accum_steps > 1`` the
+carried grad state costs 1/dp per chip too.  ``accum_steps=N``
+microbatches the global batch through a ``lax.scan`` (per-microbatch
+RNG split, rescale-correct: the accumulated gradient equals the
+full-batch gradient), so global batch scales past per-chip memory.
 """
 from __future__ import annotations
 
@@ -24,7 +38,7 @@ from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as _np
 
-from ..base import MXNetError, hot_path
+from ..base import MXNetError, get_env, hot_path
 from ..context import current_context
 from .. import autograd as _autograd
 from .. import optimizer as opt_mod
@@ -33,7 +47,8 @@ from ..ndarray import NDArray
 from ..gluon.block import _TraceCtx, _KeyScope
 from ..gluon.parameter import Parameter
 from ..observability.registry import registry as _metrics_registry
-from .mesh import ShardingRules, default_mesh, replicated, shard
+from .mesh import (ShardingRules, axis_size, default_mesh, replicated,
+                   shard, zero_sharding)
 from .optim import make_functional_optimizer
 
 __all__ = ["ShardedTrainer"]
@@ -60,6 +75,19 @@ class ShardedTrainer:
     data_spec / label_spec : PartitionSpec tuples for the batch, default
         ('dp',) — add 'sp' on the sequence dim for context parallelism,
         e.g. data_spec=('dp', 'sp').
+    zero_stage : {0, 1, 2} — optimizer-state partitioning over the 'dp'
+        axis (default: the ``MXTPU_ZERO_STAGE`` knob).  0 = replicated
+        state (bitwise-identical to the pre-ZeRO step); 1 = state
+        sharded, gradients reduce-scattered for the update, updated
+        params all-gathered; 2 = the gradient (accumulation) buffer is
+        sharded too.  Per-parameter fallback: a tensor whose dim 0
+        cannot split over dp keeps replicated state (see
+        :func:`~mxnet_tpu.parallel.mesh.zero_sharding`).
+    accum_steps : int — microbatched gradient accumulation (default: the
+        ``MXTPU_ACCUM_STEPS`` knob).  The step consumes the same global
+        batch but runs it as N sequential microbatches under a
+        ``lax.scan``; peak activation memory drops ~N-fold while the
+        update is rescale-correct against the full batch.
     """
 
     def __init__(self, block, loss: Callable, optimizer,
@@ -67,6 +95,8 @@ class ShardedTrainer:
                  rules: Optional[ShardingRules] = None,
                  data_spec: Sequence = ("dp",),
                  label_spec: Optional[Sequence] = None,
+                 zero_stage: Optional[int] = None,
+                 accum_steps: Optional[int] = None,
                  guard_nonfinite: bool = False,
                  dynamic_loss_scale: bool = False,
                  init_loss_scale: float = 2.0 ** 15,
@@ -81,6 +111,18 @@ class ShardedTrainer:
         self._data_spec = tuple(data_spec)
         self._label_spec = tuple(label_spec) if label_spec is not None \
             else (self._data_spec[0],)
+        if zero_stage is None:
+            zero_stage = int(get_env("MXTPU_ZERO_STAGE"))
+        if zero_stage not in (0, 1, 2):
+            raise MXNetError(
+                f"zero_stage must be 0, 1 or 2, got {zero_stage!r}")
+        self._zero = int(zero_stage)
+        if accum_steps is None:
+            accum_steps = int(get_env("MXTPU_ACCUM_STEPS"))
+        if int(accum_steps) < 1:
+            raise MXNetError(
+                f"accum_steps must be >= 1, got {accum_steps!r}")
+        self._accum = int(accum_steps)
         optimizer_params = optimizer_params or {}
         self._optimizer = opt_mod.create(optimizer, **optimizer_params)
         self._scale = self._optimizer.rescale_grad
@@ -136,22 +178,15 @@ class ShardedTrainer:
         names = [p.name for p in self._train_params]
         self._fopt = make_functional_optimizer(self._optimizer, names)
 
-        mesh = self._mesh
-        self._p_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
-                      for p in self._train_params]
-        self._a_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
-                      for p in self._aux_params]
-        # per-input sharding: the data spec truncated to each input's rank
-        self._x_sh = tuple(
-            shard(mesh, *self._data_spec[:v.ndim]) for v in xs)
-        # tuple labels (multi-stream, e.g. MLM+NSP) shard element-wise
+        # input/label structure, captured once: reshard() re-derives the
+        # shardings and rebuilds the jits on a new mesh without needing
+        # fresh example data
+        self._x_ndims = tuple(v.ndim for v in xs)
         self._y_multi = isinstance(y, tuple)
-        if self._y_multi:
-            self._y_sh = tuple(shard(mesh, *self._label_spec[:v.ndim])
-                               for v in y)
-        else:
-            self._y_sh = shard(mesh, *self._label_spec[:y.ndim])
-        self._r_sh = replicated(mesh)
+        self._y_ndims = tuple(v.ndim for v in y) if self._y_multi \
+            else y.ndim
+
+        self._make_shardings()
 
         # move weights onto the mesh — the trainer owns them from here on
         self._pvals = [jax.device_put(p.data(self._ctx)._read(), s)
@@ -159,10 +194,56 @@ class ShardedTrainer:
         self._avals = [jax.device_put(p.data(self._ctx)._read(), s)
                        for p, s in zip(self._aux_params, self._a_sh)]
         state = self._fopt.init(self._pvals)
-        self._s_sh = [jax.tree.map(lambda _, sh=sh: sh, st)
-                      for st, sh in zip(state, self._p_sh)]
+        self._s_sh = self._state_shardings(state)
         self._state = jax.tree.map(
             lambda v, s: jax.device_put(v, s), state, self._s_sh)
+
+        self._build_jits()
+        self._built = True
+
+    def _make_shardings(self) -> None:
+        """Derive every sharding from the CURRENT mesh: parameter/aux
+        (rules), inputs/labels (data_spec), and the ZeRO layout for
+        optimizer state + stage-2 gradient buffers.  Split out of the
+        lazy build so :meth:`reshard` can re-derive them when the mesh
+        (dp size) changes."""
+        mesh = self._mesh
+        self._dp = axis_size(mesh, "dp")
+        self._p_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
+                      for p in self._train_params]
+        self._a_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
+                      for p in self._aux_params]
+        # ZeRO layout: stage >= 1 partitions optimizer state (and the
+        # stage-2 grad buffer) dim-0 over 'dp' — per-parameter fallback
+        # to the parameter's own sharding when dim 0 cannot split
+        if self._zero >= 1:
+            self._z_sh = [
+                zero_sharding(mesh, self._rules.spec_for(p.name, p.shape),
+                              p.shape)
+                for p in self._train_params]
+        else:
+            self._z_sh = list(self._p_sh)
+        # per-input sharding: the data spec truncated to each input's rank
+        self._x_sh = tuple(
+            shard(mesh, *self._data_spec[:nd]) for nd in self._x_ndims)
+        # tuple labels (multi-stream, e.g. MLM+NSP) shard element-wise
+        if self._y_multi:
+            self._y_sh = tuple(shard(mesh, *self._label_spec[:nd])
+                               for nd in self._y_ndims)
+        else:
+            self._y_sh = shard(mesh, *self._label_spec[:self._y_ndims])
+        self._r_sh = replicated(mesh)
+
+    def _state_shardings(self, state):
+        """Optimizer-state shardings: every leaf of param i's state tree
+        carries the ZeRO sharding (== param sharding at stage 0)."""
+        import jax
+        return [jax.tree.map(lambda _, sh=sh: sh, st)
+                for st, sh in zip(state, self._z_sh)]
+
+    def _build_jits(self) -> None:
+        import jax
+        import jax.numpy as jnp
 
         block, loss_blk = self._block, self._loss
         tparams, aparams = self._train_params, self._aux_params
@@ -195,23 +276,138 @@ class ShardedTrainer:
                          for w, v in zip(aw, avals)]
             return out, l_nd, new_avals
 
-        if not self._guard:
-            def step_fn(pvals, avals, state, key, t, lr, rescale, xv, yv):
-                def loss_of(pv):
-                    _, l_nd, new_avals = apply_fn(pv, avals, key, xv, True,
-                                                  yv)
-                    lraw = l_nd._read()
-                    # reference semantics: loss.backward() seeds ones (sum),
-                    # and Trainer.step(batch_size) folds the 1/batch rescale
-                    # into the optimizer — so differentiate the SUM and
-                    # apply `rescale` in the update; the MEAN is what we
-                    # report
-                    return jnp.sum(lraw), (jnp.mean(lraw), new_avals)
+        accum, zero = self._accum, self._zero
+        dp = self._dp
+        z_sh, p_sh = list(self._z_sh), list(self._p_sh)
+        wsc = jax.lax.with_sharding_constraint
+        if accum > 1:
+            # microbatch shardings: after the (B, ...) -> (accum, B/accum,
+            # ...) reshape the batch axis moves to dim 1; the scan axis
+            # (dim 0) stays unsharded
+            mb_x_sh = tuple(shard(self._mesh, None,
+                                  *self._data_spec[:nd])
+                            for nd in self._x_ndims)
+            if self._y_multi:
+                mb_y_sh = tuple(shard(self._mesh, None,
+                                      *self._label_spec[:nd])
+                                for nd in self._y_ndims)
+            else:
+                mb_y_sh = shard(self._mesh, None,
+                                *self._label_spec[:self._y_ndims])
 
-                (_, (lval, new_avals)), grads = \
-                    jax.value_and_grad(loss_of, has_aux=True)(pvals)
-                new_pvals, new_state = fopt.update(
-                    pvals, grads, state, t, lr, rescale)
+        def split_mb(v):
+            return v.reshape((accum, v.shape[0] // accum) + v.shape[1:])
+
+        def make_grads(scaled):
+            """grads_of(pvals, avals, key, xv, yv, ls) ->
+            (grads, mean_loss, new_avals) — the gradient of the
+            FULL-batch SUM loss (reference semantics: loss.backward()
+            seeds ones, Trainer.step(batch_size) folds the 1/batch
+            rescale into the optimizer update; the MEAN is what we
+            report).  ``scaled`` (trace-time bool) multiplies the
+            differentiated loss by ``ls`` — the guarded path's loss
+            scaling.  ``accum == 1`` traces EXACTLY the
+            pre-accumulation graph (the zero_stage=0 bitwise contract);
+            ``accum > 1`` scans the batch as microbatches with a
+            per-microbatch RNG split, accumulating gradients — the sum
+            over microbatch sum-loss gradients equals the full-batch
+            gradient, so the optimizer's rescale is unchanged."""
+            def grads_of(pvals, avals, key, xv, yv, ls):
+                if accum == 1:
+                    def loss_of(pv):
+                        _, l_nd, new_avals = apply_fn(pv, avals, key, xv,
+                                                      True, yv)
+                        lraw = l_nd._read()
+                        total = jnp.sum(lraw)
+                        if scaled:
+                            total = total * ls
+                        return total, (jnp.mean(lraw), new_avals)
+
+                    (_, (lval, new_avals)), grads = \
+                        jax.value_and_grad(loss_of, has_aux=True)(pvals)
+                    if zero >= 2:
+                        # ZeRO-2: the gradient is reduce-scattered the
+                        # moment it exists — never replicated
+                        grads = [wsc(g, s) for g, s in zip(grads, z_sh)]
+                    return grads, lval, new_avals
+
+                def mb(v, s):
+                    # constrain the microbatched view back onto the dp
+                    # layout only when the microbatch still divides the
+                    # axis — an uneven constraint would force XLA into a
+                    # full rematerialization instead of a local reshape
+                    m = split_mb(v)
+                    return wsc(m, s) if m.shape[1] % dp == 0 else m
+
+                keys = jax.random.split(key, accum)
+                xms = tuple(mb(v, s) for v, s in zip(xv, mb_x_sh))
+                if isinstance(yv, tuple):
+                    yms = tuple(mb(v, s) for v, s in zip(yv, mb_y_sh))
+                else:
+                    yms = mb(yv, mb_y_sh)
+
+                def body(carry, mb):
+                    g_acc, av, lsum = carry
+                    k_m, xm, ym = mb
+
+                    def loss_of(pv):
+                        _, l_nd, new_av = apply_fn(pv, av, k_m, xm, True,
+                                                   ym)
+                        lraw = l_nd._read()
+                        total = jnp.sum(lraw)
+                        if scaled:
+                            total = total * ls
+                        return total, (jnp.mean(lraw).astype(jnp.float32),
+                                       new_av)
+
+                    (_, (lmean, new_av)), g = \
+                        jax.value_and_grad(loss_of, has_aux=True)(pvals)
+                    g_acc = [a + b for a, b in zip(g_acc, g)]
+                    if zero >= 2:
+                        # ZeRO-2: the carried accumulation buffer stays
+                        # sharded — 1/dp of the grads per chip across
+                        # the whole scan
+                        g_acc = [wsc(a, s) for a, s in zip(g_acc, z_sh)]
+                    return (g_acc, new_av, lsum + lmean), None
+
+                g0 = [jnp.zeros_like(p) for p in pvals]
+                if zero >= 2:
+                    g0 = [wsc(a, s) for a, s in zip(g0, z_sh)]
+                (grads, new_avals, lsum), _ = jax.lax.scan(
+                    body, (g0, list(avals), jnp.float32(0.0)),
+                    (keys, xms, yms))
+                # equal microbatches: full-batch mean = mean of means
+                return grads, lsum / accum, new_avals
+            return grads_of
+
+        def run_update(pvals, grads, state, t, lr, rescale):
+            """The (optionally ZeRO-sharded) optimizer update.  Stage 0
+            is the plain call — bitwise the pre-ZeRO step.  Stage >= 1
+            pins the collective schedule with sharding constraints:
+            grads constrained to the ZeRO layout (XLA lowers the dp
+            gradient reduction to a reduce-SCATTER into each chip's
+            slice instead of a full psum), each chip updates its slice
+            of params/state, and the updated params constrained back to
+            the parameter layout (the all-gather) — all inside the one
+            donated jit, so XLA overlaps the collectives with
+            compute."""
+            if zero >= 1:
+                grads = [wsc(g, s) for g, s in zip(grads, z_sh)]
+            new_pvals, new_state = fopt.update(pvals, grads, state, t,
+                                               lr, rescale)
+            if zero >= 1:
+                new_pvals = [wsc(wsc(w, zs), ps) for w, zs, ps in
+                             zip(new_pvals, z_sh, p_sh)]
+            return new_pvals, new_state
+
+        if not self._guard:
+            grads_of = make_grads(scaled=False)
+
+            def step_fn(pvals, avals, state, key, t, lr, rescale, xv, yv):
+                grads, lval, new_avals = grads_of(pvals, avals, key, xv,
+                                                  yv, None)
+                new_pvals, new_state = run_update(pvals, grads, state, t,
+                                                  lr, rescale)
                 return new_pvals, new_avals, new_state, lval
 
             self._jit_step = jax.jit(
@@ -233,24 +429,18 @@ class ShardedTrainer:
             growth_n = self._growth_interval
             backoff = self._scale_backoff
             min_ls, max_ls = self._min_ls, self._max_ls
+            grads_of = make_grads(scaled=True)
 
             def step_fn(pvals, avals, state, key, t, lr, rescale, gstate,
                         xv, yv):
                 ls, good = gstate
-
-                def loss_of(pv):
-                    _, l_nd, new_avals = apply_fn(pv, avals, key, xv, True,
-                                                  yv)
-                    lraw = l_nd._read()
-                    return jnp.sum(lraw) * ls, (jnp.mean(lraw), new_avals)
-
-                (_, (lval, new_avals)), grads = \
-                    jax.value_and_grad(loss_of, has_aux=True)(pvals)
+                grads, lval, new_avals = grads_of(pvals, avals, key, xv,
+                                                  yv, ls)
                 finite = jnp.isfinite(lval)
                 for g in jax.tree.leaves(grads):
                     finite = jnp.logical_and(finite,
                                              jnp.all(jnp.isfinite(g)))
-                new_pvals, new_state = fopt.update(
+                new_pvals, new_state = run_update(
                     pvals, grads, state, t, lr, rescale / ls)
 
                 def keep(new, old):
@@ -298,7 +488,6 @@ class ShardedTrainer:
         self._jit_fwd = jax.jit(
             fwd_fn, in_shardings=(self._p_sh, self._a_sh,
                                   self._r_sh, self._x_sh))
-        self._built = True
 
     # -- public API --------------------------------------------------------
     @property
@@ -323,6 +512,90 @@ class ShardedTrainer:
     @property
     def guard_enabled(self) -> bool:
         return self._guard
+
+    @property
+    def zero_stage(self) -> int:
+        """ZeRO optimizer-state partitioning stage (0, 1 or 2)."""
+        return self._zero
+
+    @property
+    def accum_steps(self) -> int:
+        """Microbatches per step (1 = no accumulation)."""
+        return self._accum
+
+    @property
+    def dp_size(self) -> int:
+        """Size of the mesh's 'dp' axis (1 before the first build only
+        if the mesh has no dp axis)."""
+        return axis_size(self._mesh, "dp")
+
+    def opt_state_bytes_per_device(self) -> dict:
+        """Actually-resident optimizer-state bytes per device id — the
+        ZeRO acceptance metric.  At stage 0 every chip carries the full
+        state; at stage >= 1 each chip carries ~1/dp of every
+        partitionable tensor."""
+        import jax
+        if not self._built:
+            raise MXNetError("run at least one step() before "
+                             "opt_state_bytes_per_device()")
+        out: dict = {}
+        for leaf in jax.tree.leaves(self._state):
+            for sh in leaf.addressable_shards:
+                d = sh.device.id
+                out[d] = out.get(d, 0) + int(sh.data.nbytes)
+        return out
+
+    def peak_opt_state_bytes(self) -> int:
+        """max over devices of :meth:`opt_state_bytes_per_device`."""
+        per_dev = self.opt_state_bytes_per_device()
+        return max(per_dev.values()) if per_dev else 0
+
+    def reshard(self, mesh=None) -> None:
+        """Rebuild shardings and the jitted step on ``mesh`` and
+        re-place the live training state onto the new layout.  A
+        ``mesh`` equal to the current one (or None) is a no-op on a
+        built trainer — safe to call unconditionally after a fleet
+        re-form.  This is the in-graph re-shard hook the elastic
+        fleet uses after a re-form changes the dp world size, and what
+        makes a checkpoint saved at one dp size restorable at another
+        (load_checkpoint builds its restore template from the CURRENT
+        shardings, so a re-sharded trainer restores any layout).
+
+        Fleet-synchronized like a collective: every host must reshard
+        together (the rebuilt step's collectives span the new mesh), so
+        the collective-safety lint rule keeps it off rank-divergent
+        branches.  Unbuilt trainers just adopt the mesh — the first
+        step builds everything on it."""
+        unchanged = mesh is None or mesh == self._mesh
+        if mesh is not None:
+            self._mesh = mesh
+        if not self._built or unchanged:
+            # identical mesh = identical layout: skip the full state
+            # host round-trip and jit rebuild.  The elastic re-form
+            # hook calls reshard() unconditionally after every re-form;
+            # on host-local meshes (each process owns its devices) the
+            # local mesh survives a peer's death unchanged, and paying
+            # a recompile for a bit-identical layout would only stretch
+            # the re-form timeline
+            return
+        import jax
+        host = jax.device_get({
+            "p": list(self._pvals), "a": list(self._avals),
+            "s": self._state,
+            "g": list(self._gstate) if self._gstate is not None else None,
+        })
+        self._make_shardings()
+        self._s_sh = self._state_shardings(host["s"])
+        self._pvals = [jax.device_put(v, s)
+                       for v, s in zip(host["p"], self._p_sh)]
+        self._avals = [jax.device_put(v, s)
+                       for v, s in zip(host["a"], self._a_sh)]
+        self._state = jax.tree.map(
+            lambda v, s: jax.device_put(v, s), host["s"], self._s_sh)
+        if host["g"] is not None:
+            self._gstate = tuple(jax.device_put(v, self._r_sh)
+                                 for v in host["g"])
+        self._build_jits()
 
     @property
     def last_step_finite(self):
@@ -386,6 +659,11 @@ class ShardedTrainer:
             raise MXNetError(
                 f"step() label structure changed: the trainer was built "
                 f"with {want} — labels must keep the first call's shape")
+        if self._accum > 1 and int(xv[0].shape[0]) % self._accum:
+            raise MXNetError(
+                f"step() batch of {int(xv[0].shape[0])} does not divide "
+                f"into accum_steps={self._accum} microbatches — pad the "
+                f"batch or change accum_steps")
         if batch_size is None:
             batch_size = int(xv[0].shape[0])
         self._t += 1

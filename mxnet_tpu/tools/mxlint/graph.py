@@ -49,10 +49,15 @@ MAX_CALL_DEPTH = 6
 #: too: `reform`/`quiesce` are fleet-synchronized protocols (every
 #: survivor runs them or the KV consensus round never completes) and
 #: `step_barrier` IS a barrier — so none of them may be reachable from
-#: a surviving-rank branch either
+#: a surviving-rank branch either.  The SPMD scale-out entry points
+#: joined with ZeRO (PR 10): `reduce_scatter_host` reduces like the
+#: other host collectives, and `reshard` rebuilds the sharded step
+#: whose collectives span the new mesh — a rank that skips either
+#: leaves the fleet's collective schedules desynced.
 COLLECTIVES = frozenset((
     "allgather_bytes", "allgather_host", "allreduce_host",
-    "broadcast_host", "barrier", "reform", "quiesce", "step_barrier"))
+    "reduce_scatter_host", "broadcast_host", "barrier", "reform",
+    "quiesce", "step_barrier", "reshard"))
 
 #: identifiers whose value DIVERGES across hosts — including the
 #: re-form protocol's survivor/leader coordinates (`if me == leader:`

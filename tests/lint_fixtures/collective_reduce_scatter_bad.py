@@ -1,0 +1,20 @@
+"""mxlint fixture: must trip collective-safety (and nothing else) —
+the ZeRO scale-out entry points are collectives: a rank-gated
+reduce-scatter means the other ranks never contribute their gradient
+slice and the reduction wedges; a rank-gated re-shard leaves the fleet
+running two different collective schedules."""
+
+
+def shard_gradients(dist, grads, rank):
+    if rank == 0:
+        # only rank 0 enters the reduction — every other rank's peers
+        # block in it until the DCN timeout
+        return dist.reduce_scatter_host(grads)
+    return grads
+
+
+def rebuild_step(trainer, rank):
+    if rank == 0:
+        # the rebuilt step's collectives span the NEW mesh; ranks that
+        # kept the old step desync every later collective
+        trainer.reshard()

@@ -1,0 +1,15 @@
+"""mxlint fixture: the ZeRO scale-out entry points lint clean when
+every rank reaches them — rank-dependent behavior belongs INSIDE the
+collective (reduce_scatter_host returns each rank its own slice), and
+re-shards gate on fleet-uniform state only."""
+
+
+def shard_gradients(dist, grads):
+    # every rank enters; the per-rank slice choice happens inside
+    return dist.reduce_scatter_host(grads)
+
+
+def rebuild_step(trainer, membership):
+    if membership.reform_needed:
+        # every survivor's reaper raises the same flag: fleet-uniform
+        trainer.reshard()

@@ -153,6 +153,15 @@ WORKER = textwrap.dedent("""
     assert np.allclose(out_sp.data[0], np.arange(12, 16)), out_sp.data
     assert np.allclose(out_sp.data[1], np.arange(28, 32)), out_sp.data
 
+    # --- invariant 8: reduce-scatter = fleet sum, then THIS rank's slice
+    # (the ZeRO object-plane entry point; in-graph the trainer's
+    # zero_stage>=1 path does the same through XLA) ------------------------
+    contrib = np.full((2 * nw, 3), float(rank + 1), np.float32)
+    rs = _dist.reduce_scatter_host(contrib)
+    expect_sum = sum(w + 1 for w in range(nw))
+    assert rs.shape == (2, 3), rs.shape
+    assert np.allclose(rs, expect_sum), (rank, rs)
+
     store.barrier()
     print(f"WORKER_{rank}_OK")
 """)

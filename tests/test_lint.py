@@ -35,6 +35,7 @@ RULE_FOR_FIXTURE = {
     "collective_safety": "collective-safety",
     "collective_transitive": "collective-safety",
     "collective_membership": "collective-safety",
+    "collective_reduce_scatter": "collective-safety",
     "hot_path_purity": "hot-path-purity",
     "hidden_host_sync": "hidden-host-sync",
     "env_knob": "env-knob",

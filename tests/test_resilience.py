@@ -648,6 +648,128 @@ def test_init_process_group_retries_then_clear_error(monkeypatch):
     assert calls["n"] == 2
 
 
+# -- coordinated preemption checkpoints (multi-process) ---------------------
+
+_PREEMPT_WORKER = r'''
+import os, signal, sys, time
+sys.path.insert(0, os.environ["MXNET_TEST_ROOT"])
+from mxnet_tpu.base import force_cpu_mesh
+force_cpu_mesh(1, verify=False)   # distributed init must precede the
+import numpy as np                # first backend query
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.parallel import dist
+from mxnet_tpu.parallel.resilience import ResilientTrainer, \
+    TrainingPreempted
+from mxnet_tpu.gluon import nn, loss as gloss
+
+dist.init_process_group()
+rank = dist.rank()
+np.random.seed(0)
+mx.random.seed(0)
+net = nn.HybridSequential()
+with net.name_scope():
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+net.initialize()
+import jax
+tr = par.ShardedTrainer(
+    net, gloss.SoftmaxCrossEntropyLoss(), "sgd", {"learning_rate": 0.1},
+    mesh=par.make_mesh({"dp": 1}, devices=jax.local_devices()[:1]))
+ckpt = os.path.join(os.environ["CKPT_ROOT"], f"rank{rank}")
+rt = ResilientTrainer(tr, checkpoint_dir=ckpt, auto_resume=False)
+rt.install_signal_handlers()
+x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+y = np.random.RandomState(1).randint(0, 4, (8,))
+# deliberately UNEQUAL step cadence: at SIGTERM time the two hosts sit
+# at different update counters — exactly the skew the coordination
+# protocol must resolve into one agreed flush step
+delay = 0.02 if rank == 0 else 0.06
+try:
+    for i in range(600):
+        rt.step(x, y)
+        if i == 2:   # both hosts demonstrably stepping before the signal
+            open(os.path.join(os.environ["CKPT_ROOT"],
+                              f"ready-{rank}"), "w").close()
+        time.sleep(delay)
+    print(f"NOT_PREEMPTED_{rank}", flush=True)
+    sys.exit(2)
+except TrainingPreempted:
+    newest = par.ShardedTrainer.latest_checkpoint(ckpt)
+    name = os.path.basename(newest) if newest else "NONE"
+    print(f"PREEMPTED_{rank} t={tr.num_update} ckpt={name}", flush=True)
+'''
+
+
+def test_coordinated_preemption_two_procs(tmp_path):
+    """SIGTERM one of two workers: BOTH must exit preempted and commit
+    the SAME `state-<t>` checkpoint — the flush step agreed over the
+    coordination-service KV tier (max of the hosts' votes), not each
+    host's own next boundary (PR-1 carried follow-up)."""
+    import re
+    import socket
+    import subprocess
+    import sys as _sys
+    import time as _time
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    script = tmp_path / "worker.py"
+    script.write_text(_PREEMPT_WORKER)
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    procs = []
+    for r in range(2):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "MXNET_TEST_ROOT": root,
+            "CKPT_ROOT": str(tmp_path),
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": "2",
+            "DMLC_WORKER_ID": str(r),
+        })
+        procs.append(subprocess.Popen(
+            [_sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    # SIGTERM rank 0 only — but not before both hosts are demonstrably
+    # stepping (a pre-handler signal would just kill the process)
+    deadline = _time.monotonic() + 120
+    while _time.monotonic() < deadline:
+        if all(os.path.exists(tmp_path / f"ready-{r}") for r in range(2)):
+            break
+        if any(p.poll() is not None for p in procs):
+            break
+        _time.sleep(0.05)
+    _time.sleep(0.3)
+    procs[0].send_signal(signal.SIGTERM)
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((r, p.returncode, out))
+    records = {}
+    for r, rc, out in outs:
+        assert rc == 0, f"worker {r} rc={rc}:\n{out}"
+        m = re.search(rf"PREEMPTED_{r} t=(\d+) ckpt=(state-\d+)", out)
+        assert m, f"worker {r} never reported a preemption flush:\n{out}"
+        records[r] = (int(m.group(1)), m.group(2))
+    # the satellite's whole point: ONE agreed step, fleet-wide
+    assert records[0] == records[1], records
+    t, name = records[0]
+    assert name == f"state-{t:08d}"
+    # the agreed step is COMMITTED in both hosts' checkpoint dirs
+    for r in range(2):
+        assert os.path.exists(tmp_path / f"rank{r}" / name /
+                              "_CHECKPOINT_METADATA")
+
+
 # -- lint gate: no bare except under mxnet_tpu/ (satellite 6) ---------------
 # The AST walker that used to live here moved into the mxlint subsystem
 # (mxnet_tpu/tools/mxlint — the 'bare-except' rule); this thin assertion
