@@ -796,39 +796,68 @@ print("RESULT " + json.dumps({
 """
 
 
-def _multichip_one_main(spec):
-    """Entry for ONE multichip config subprocess (``--multichip-one
-    dp,zero``): pin THIS process to dp cores BEFORE the first jax
-    import (XLA's execution-pool threads inherit the main thread's
-    affinity at client creation — set it later and every virtual chip
-    still sees the whole host), then time the ZeRO-sharded step on a
-    dp-device virtual CPU mesh.  One pinned core per virtual chip
-    keeps per-chip resources constant across dp — the weak-scaling
-    contract a real pod slice has."""
-    dp, zero = (int(v) for v in spec.split(","))
+def _pin_cpu_mesh(dp):
+    """Shared preamble of every multichip/overlap grid cell: pin THIS
+    process to dp cores BEFORE the first jax import (XLA's
+    execution-pool threads inherit the main thread's affinity at
+    client creation — set it later and every virtual chip still sees
+    the whole host), then force a dp-device virtual CPU mesh.  One
+    pinned core per virtual chip keeps per-chip resources constant
+    across dp — the weak-scaling contract a real pod slice has."""
     try:
         os.sched_setaffinity(0, set(range(dp)))
     except (AttributeError, OSError):
         pass   # non-linux / restricted: unpinned, still measured
     from mxnet_tpu.base import force_cpu_mesh
     force_cpu_mesh(dp)
-    import jax
+
+
+def _weak_scaling_mlp(dp, zero=0, comm_bucket_mb=0.0):
+    """The multichip/overlap rows' shared model: MLP 784-1024-1024-10,
+    adam, fp32, seeded identically, on a dp-device mesh."""
     import mxnet_tpu as mx
     from mxnet_tpu import parallel as par
     from mxnet_tpu.gluon import nn, loss as gloss
-
+    np.random.seed(0)
+    mx.random.seed(0)
     net = nn.HybridSequential()
     with net.name_scope():
         net.add(nn.Dense(1024, activation="relu", in_units=784),
                 nn.Dense(1024, activation="relu", in_units=1024),
                 nn.Dense(10, in_units=1024))
     net.initialize()
-    np.random.seed(0)
-    mx.random.seed(0)
-    tr = par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "adam",
-                            {"learning_rate": 1e-3},
-                            mesh=par.make_mesh({"dp": dp}),
-                            zero_stage=zero)
+    return par.ShardedTrainer(
+        net, gloss.SoftmaxCrossEntropyLoss(), "adam",
+        {"learning_rate": 1e-3}, mesh=par.make_mesh({"dp": dp}),
+        zero_stage=zero, comm_bucket_mb=comm_bucket_mb)
+
+
+def _grid_cell(flag, spec, timeout):
+    """Run ONE grid-config subprocess (``bench.py <flag> <spec>``)
+    with the CPU-forced env and parse its one-JSON-line stdout; a
+    failure becomes an ``{"error": ...}`` cell so one dead config
+    never zeroes its row — the shared cell discipline of the
+    multichip and overlap rows."""
+    import subprocess
+    import sys
+    env = dict(os.environ, PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS="cpu")
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), flag, spec],
+            capture_output=True, text=True, timeout=timeout, env=env)
+        return json.loads(r.stdout.strip().splitlines()[-1])
+    except Exception as e:  # noqa: BLE001
+        return {"error": f"{type(e).__name__}: {e}"[:200]}
+
+
+def _multichip_one_main(spec):
+    """Entry for ONE multichip config subprocess (``--multichip-one
+    dp,zero``): time the ZeRO-sharded step on the pinned-core virtual
+    CPU mesh (see :func:`_pin_cpu_mesh`)."""
+    dp, zero = (int(v) for v in spec.split(","))
+    _pin_cpu_mesh(dp)
+    import jax
+    tr = _weak_scaling_mlp(dp, zero)
     per_chip, iters, warmup = 256, 10, 3
     B = per_chip * dp
     x = np.random.randn(B, 784).astype(np.float32)
@@ -860,24 +889,12 @@ def bench_multichip(per_config_timeout=600):
     so its img/s columns double as a collective-overhead check while
     the bytes columns are the ZeRO story.  The on-chip (real pod
     slice) rerun is queued in the PERF.md runbook."""
-    import subprocess
     import sys
     grid = {}
     for dp in (1, 2, 4, 8):
         for zero in (0, 1, 2):
-            env = dict(os.environ, PALLAS_AXON_POOL_IPS="",
-                       JAX_PLATFORMS="cpu")
-            try:
-                r = subprocess.run(
-                    [sys.executable, os.path.abspath(__file__),
-                     "--multichip-one", f"{dp},{zero}"],
-                    capture_output=True, text=True,
-                    timeout=per_config_timeout, env=env)
-                rec = json.loads(r.stdout.strip().splitlines()[-1])
-            except Exception as e:  # noqa: BLE001 — one failed cell
-                # must not zero the row
-                rec = {"error": f"{type(e).__name__}: {e}"[:200]}
-            grid.setdefault(f"dp{dp}", {})[f"zero{zero}"] = rec
+            grid.setdefault(f"dp{dp}", {})[f"zero{zero}"] = _grid_cell(
+                "--multichip-one", f"{dp},{zero}", per_config_timeout)
     row = {"model": "mlp 784-1024-1024-10, adam, fp32",
            "per_chip_batch": 256,
            "chip": "1 pinned CPU core per virtual chip (weak scaling: "
@@ -908,6 +925,204 @@ def bench_multichip(per_config_timeout=600):
         row["error_summary"] = "one or more grid cells failed " \
                                "(see grid entries)"
     return row
+
+
+def _overlap_one_main(spec):
+    """Entry for ONE overlap config subprocess (``--overlap-one
+    MODE:ARGS``) — same discipline as the multichip row: pin THIS
+    process to dp cores BEFORE the first jax import, one pinned core
+    per virtual chip, then measure one overlap configuration.
+
+    - ``bucket:dp,zero,mb`` — step time of the ZeRO-sharded step with
+      the gradient reduction fused (mb=0) vs bucketed (comm_bucket_mb);
+    - ``prefetch:dp,depth`` — per-step wall time of a DataLoader-fed
+      training loop with the device double-buffer off (0) vs N-deep
+      (every step pays / hides the host→device ingestion transfer);
+    - ``ckpt:dp,async`` — a training loop with periodic host-local npz
+      checkpoints: the per-save boundary stall and the loop wall time,
+      blocking (async=0) vs background commit (async=1).
+    """
+    mode, args = spec.split(":", 1)
+    vals = args.split(",")
+    dp = int(vals[0])
+    _pin_cpu_mesh(dp)
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import nn, loss as gloss
+
+    per_chip = 256
+    B = per_chip * dp
+    rng = np.random.RandomState(0)
+    x = rng.randn(B, 784).astype(np.float32)
+    y = rng.randint(0, 10, (B,))
+
+    if mode == "bucket":
+        zero, mb = int(vals[1]), float(vals[2])
+        tr = _weak_scaling_mlp(dp, zero, comm_bucket_mb=mb)
+        xs, ys = tr.shard_batch(x, y)    # device-resident: this cell
+        iters, warmup = 12, 3            # measures the STEP, not ingest
+        for _ in range(warmup):
+            tr.step(xs, ys)
+        jax.block_until_ready(tr._pvals)
+        t0 = time.perf_counter()
+        for _ in range(iters):
+            loss = tr.step(xs, ys)
+        jax.block_until_ready(loss._read())
+        dt = time.perf_counter() - t0
+        print(json.dumps({
+            "dp": dp, "zero_stage": zero, "comm_bucket_mb": mb,
+            "n_buckets": len(tr.grad_buckets or []) or 1,
+            "step_us": round(dt / iters * 1e6, 1),
+            "img_s": round(B * iters / dt, 1)}))
+    elif mode == "prefetch":
+        depth = int(vals[1])
+        from mxnet_tpu.gluon.data import DataLoader
+        # a SMALL model on purpose: the cell measures the ingestion
+        # transfer on the step's critical path, so the step must not
+        # dwarf it (the bucket cells own the big-model story).  The
+        # dataset is pre-batched (one sample IS one batch, pass-through
+        # batchify), so host-side batch assembly — a separate, already-
+        # overlapped pipeline stage — cannot drown the transfer either.
+        np.random.seed(0)
+        mx.random.seed(0)
+        net = nn.HybridSequential()
+        with net.name_scope():
+            net.add(nn.Dense(256, activation="relu", in_units=784),
+                    nn.Dense(10, in_units=256))
+        net.initialize()
+        tr = par.ShardedTrainer(
+            net, gloss.SoftmaxCrossEntropyLoss(), "adam",
+            {"learning_rate": 1e-3}, mesh=par.make_mesh({"dp": dp}))
+        n_batches = 16
+        ds = [(rng.randn(B, 784).astype(np.float32),
+               rng.randint(0, 10, (B,)).astype(np.float32))
+              for _ in range(n_batches)]
+        loader = DataLoader(ds, batch_size=1, num_workers=1,
+                            batchify_fn=lambda s: s[0],
+                            device_prefetch=depth,
+                            device_put_fn=tr.place_batch)
+        for xb, yb in loader:            # epoch 0: build + compile
+            tr.step(xb, yb)
+        losses = None
+        t0 = time.perf_counter()
+        for _ in range(3):
+            for xb, yb in loader:
+                losses = tr.step(xb, yb)
+        jax.block_until_ready(losses._read())
+        dt = time.perf_counter() - t0
+        steps = 3 * n_batches
+        print(json.dumps({
+            "dp": dp, "device_prefetch": depth,
+            "batch_bytes": int(B * 784 * 4),
+            "step_us": round(dt / steps * 1e6, 1),
+            "img_s": round(B * steps / dt, 1)}))
+    elif mode == "ckpt":
+        import tempfile
+        os.environ["MXTPU_ASYNC_CKPT"] = vals[1]
+        tr = _weak_scaling_mlp(dp)
+        tr.host_local_ckpt = True        # the npz fleet path, 1 process
+        xs, ys = tr.shard_batch(x, y)
+        for _ in range(3):
+            tr.step(xs, ys)
+        jax.block_until_ready(tr._pvals)
+        stalls = []
+        with tempfile.TemporaryDirectory() as d:
+            t0 = time.perf_counter()
+            for i in range(12):
+                loss = tr.step(xs, ys)
+                if (i + 1) % 3 == 0:
+                    # the BOUNDARY STALL: what the save call costs the
+                    # step loop.  The blocking path pays the full
+                    # serialize+commit here; the async path only the
+                    # device_get snapshot + thread handoff.
+                    s0 = time.perf_counter()
+                    tr.save_checkpoint(d)
+                    stalls.append(time.perf_counter() - s0)
+            jax.block_until_ready(loss._read())
+            # the final commit drains INSIDE the timed region: the
+            # async cell's last write has no steps left to hide
+            # behind, and excluding its tail would overstate the loop
+            # win by ~one commit per measurement window
+            tr.wait_checkpoint()
+            wall = time.perf_counter() - t0
+        stalls.sort()
+        print(json.dumps({
+            "dp": dp, "async": vals[1] == "1", "saves": len(stalls),
+            "save_stall_us": round(
+                stalls[len(stalls) // 2] * 1e6, 1),
+            "loop_wall_us_per_step": round(wall / 12 * 1e6, 1)}))
+    else:
+        raise SystemExit(f"unknown overlap mode {mode!r}")
+
+
+def bench_overlap(per_config_timeout=600):
+    """Overlap row (ROADMAP #4 / 'hide the fleet' acceptance): the
+    three serialized phases measured against their overlapped
+    versions on the pinned-core CPU mesh — (a) fused vs bucketed
+    gradient reduce-scatter at dp=4/8 (zero_stage=1), (b) device-input
+    double buffering off vs 2-deep at dp=4, (c) blocking vs async
+    host-local checkpoint commit at dp=4.  Every cell runs in its own
+    core-pinned subprocess (the multichip discipline: affinity must
+    precede XLA client creation).  The on-chip half — confirming the
+    latency-hiding scheduler actually interleaves the per-bucket
+    collectives — is queued in the PERF.md runbook."""
+    import sys
+
+    def cell(spec):
+        return _grid_cell("--overlap-one", spec, per_config_timeout)
+
+    rows = {}
+    for dp in (4, 8):
+        g = {"off": cell(f"bucket:{dp},1,0"),
+             "bucket_1mb": cell(f"bucket:{dp},1,1"),
+             "bucket_4mb": cell(f"bucket:{dp},1,4")}
+        try:
+            best = min(g["bucket_1mb"]["step_us"],
+                       g["bucket_4mb"]["step_us"])
+            g["step_improvement_x"] = round(g["off"]["step_us"] / best, 3)
+        except (KeyError, TypeError, ZeroDivisionError):
+            pass
+        rows[f"grad_bucket_dp{dp}"] = g
+    p = {"off": cell("prefetch:4,0"), "depth2": cell("prefetch:4,2")}
+    try:
+        p["step_improvement_x"] = round(
+            p["off"]["step_us"] / p["depth2"]["step_us"], 3)
+    except (KeyError, TypeError, ZeroDivisionError):
+        pass
+    rows["device_prefetch_dp4"] = p
+    c = {"blocking": cell("ckpt:4,0"), "async": cell("ckpt:4,1")}
+    try:
+        c["stall_reduction_x"] = round(
+            c["blocking"]["save_stall_us"] / c["async"]["save_stall_us"],
+            2)
+        c["step_improvement_x"] = round(
+            c["blocking"]["loop_wall_us_per_step"] /
+            c["async"]["loop_wall_us_per_step"], 3)
+    except (KeyError, TypeError, ZeroDivisionError):
+        pass
+    rows["async_ckpt_dp4"] = c
+    # failed cells are flagged explicitly: a .get(..., 0.0) default
+    # would make an all-cells-dead row indistinguishable from a real
+    # measured "no improvement"
+    failed = sorted(
+        k for k, v in rows.items()
+        if any(isinstance(cc, dict) and "error" in cc
+               for cc in v.values()))
+    if failed:
+        rows["error_summary"] = \
+            f"cells failed in: {', '.join(failed)} (see cell entries)"
+    improvements = [v["step_improvement_x"] for v in rows.values()
+                    if isinstance(v, dict) and "step_improvement_x" in v]
+    if improvements:
+        rows["best_step_improvement_x"] = max(improvements)
+        rows["async_ckpt_stall_reduction_x"] = \
+            c.get("stall_reduction_x", 0.0)
+        print(f"overlap: best step improvement "
+              f"{rows['best_step_improvement_x']}x; async-ckpt boundary "
+              f"stall -{rows['async_ckpt_stall_reduction_x']}x",
+              file=sys.stderr)
+    return rows
 
 
 def bench_autotune(duration_s=2.0):
@@ -1162,11 +1377,14 @@ def main():
                                        "bert", "bert_bf16",
                                        "nmt", "ssd", "pipeline",
                                        "serving", "autotune",
-                                       "multichip"],
+                                       "multichip", "overlap"],
                     help="run a single row (default: the full suite)")
     ap.add_argument("--multichip-one", metavar="DP,ZERO",
                     help="internal: measure ONE multichip grid config "
                          "(core-pinned subprocess of --only multichip)")
+    ap.add_argument("--overlap-one", metavar="MODE:ARGS",
+                    help="internal: measure ONE overlap config "
+                         "(core-pinned subprocess of --only overlap)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
                     help="kept for compat: forces the single resnet row")
@@ -1183,6 +1401,20 @@ def main():
         # config child of --only multichip: affinity must be set before
         # any jax touch, and the backend probe is pointless (CPU-forced)
         _multichip_one_main(args.multichip_one)
+        return
+    if args.overlap_one:
+        _overlap_one_main(args.overlap_one)
+        return
+    if args.only == "overlap":
+        # CPU-host row like multichip: every cell is its own CPU-forced
+        # core-pinned subprocess, so the chip probe is skipped
+        row = bench_overlap()
+        print(json.dumps({
+            "metric": "overlap_best_step_improvement",
+            "unit": "x vs overlap-off",
+            "value": row.get("best_step_improvement_x", 0.0),
+            "vs_baseline": 0.0,
+            "rows": {"overlap": row}}))
         return
     if args.only == "multichip":
         # CPU-host row by definition: every measurement runs in its own
@@ -1397,6 +1629,7 @@ def main():
         sub_row("serving", ["serving"], 900)
         sub_row("autotune", ["autotune"], 900)
         sub_row("multichip", ["multichip"], 1800)
+        sub_row("overlap", ["overlap"], 1800)
 
     # per-row headline field + unit, so --only rows are labeled honestly
     HEADLINE = {
@@ -1414,6 +1647,7 @@ def main():
         "serving": ("requests_per_sec", "req/s"),
         "autotune": ("converged_bulk_size", "ops/segment"),
         "multichip": ("speedup_dp2", "x aggregate img/s vs dp=1"),
+        "overlap": ("best_step_improvement_x", "x vs overlap-off"),
     }
     ok = {k: v for k, v in rows.items() if "error" not in v}
     if "resnet50_bf16" in ok:

@@ -359,6 +359,51 @@ register_env("MXTPU_PREEMPT_POLL", 0.05, float,
              "Poll interval in seconds for the preemption-coordination "
              "vote wait (bounded overall by MXTPU_DIST_TIMEOUT, after "
              "which the host falls back to a unilateral flush).")
+register_env("MXTPU_COMM_BUCKET_MB", 0.0, float,
+             "Bucketed gradient reduce-scatter for ShardedTrainer: "
+             "split the step's gradients into buckets of at most this "
+             "many MB (in reverse parameter order — the order backward "
+             "materializes them) and pin each bucket's dp-reduction "
+             "with an optimization_barrier-ordered sharding "
+             "constraint, so XLA's latency-hiding scheduler can "
+             "overlap the per-bucket collectives with the remaining "
+             "backward compute.  0 (the default) = one fused "
+             "reduction after the full backward — bitwise-identical "
+             "to the pre-bucketing step.  The comm_bucket_mb= "
+             "constructor argument overrides.")
+register_env("MXTPU_DEVICE_PREFETCH", 0, int,
+             "DataLoader device-input double buffering: keep up to N "
+             "batches resident on device beyond the one being "
+             "consumed, transferred through an async jax.device_put "
+             "stage (sharding-aware when a ShardedTrainer's "
+             "place_batch is attached), so step t's jit consumes an "
+             "already-resident batch while t+1 transfers.  0 (the "
+             "default) = off: every step pays the host->device "
+             "ingestion transfer on the critical path.  The "
+             "device_prefetch= constructor argument overrides; "
+             "applied at each __iter__.")
+register_env("MXTPU_ASYNC_CKPT", False, bool,
+             "Async distributed checkpoints: the host-local npz "
+             "checkpoint write (the multi-process fleet path) "
+             "snapshots state at the step boundary and commits on a "
+             "background thread, and the coordinated-preemption KV "
+             "vote wait moves off the step path (hosts keep stepping "
+             "toward the highest vote seen while the round resolves). "
+             "Committed-dir semantics are unchanged: a crash mid-"
+             "write leaves a torn tmp dir that resume filters out.  "
+             "Off (the default) = the blocking PR-10 flush.")
+register_env("MXTPU_TUNE_COMM_BUCKET", True, bool,
+             "Self-tuning: enable the CommBucketController (hill-"
+             "climbs a ShardedTrainer's MXTPU_COMM_BUCKET_MB on the "
+             "resilience.step_us interval mean) when one is "
+             "constructed with a trainer.  Not in the stock runtime "
+             "set — it needs a live trainer reference.")
+register_env("MXTPU_TUNE_DEVICE_PREFETCH", True, bool,
+             "Self-tuning: enable the DevicePrefetchController "
+             "(adapts the DataLoader device-prefetch depth from the "
+             "loader.device_buffer_depth gauge — each slot is a "
+             "resident device batch, i.e. HBM) when the runtime "
+             "starts.")
 
 
 # ---------------------------------------------------------------------------

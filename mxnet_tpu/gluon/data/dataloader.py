@@ -15,6 +15,22 @@ with the stuck worker→batch map instead of blocking forever.  The
 ``loader_stall`` / ``loader_error`` sites of the deterministic fault plan
 (``MXTPU_FAULT_PLAN`` — see mxnet_tpu.faults) exercise both paths on CPU.
 
+Device-input double buffering (``device_prefetch`` /
+``MXTPU_DEVICE_PREFETCH``): the prefetch pipeline above ends at the
+HOST — every training step still pays the host→device ingestion
+transfer on its critical path.  With a depth N > 0 the iterator grows
+a device stage: each pulled batch is handed to an (async)
+``jax.device_put`` and up to N batches stay resident on device beyond
+the one being consumed, so step t's jit consumes an already-resident
+batch while batch t+1's transfer overlaps it.  The placement is
+pluggable (``set_device_put_fn``): a ``ShardedTrainer.place_batch``
+makes the stage sharding-aware for the dp mesh (the ResilientTrainer
+wires this for an attached loader).  ``loader.device_put_us`` /
+``loader.device_buffer_depth`` measure the stage; the
+``DevicePrefetchController`` steers the depth (each slot is a resident
+device batch — HBM) via :func:`set_device_prefetch_override`, applied
+at the next ``__iter__``.
+
 Data-parallel sharding (elastic fleet): ``num_shards``/``shard_index``
 stripe the epoch's batches round-robin across the fleet (batch ``i``
 belongs to shard ``i % num_shards`` — the reference's
@@ -40,7 +56,7 @@ from typing import Optional
 
 import numpy as _np
 
-from ...base import MXNetError
+from ...base import MXNetError, get_env
 from ...faults import TransientFault, active_plan, retry_call
 from ...ndarray import NDArray, array as nd_array
 from ...observability.registry import registry as _metrics_registry
@@ -52,8 +68,9 @@ from .sampler import BatchSampler, RandomSampler, SequentialSampler
 _RETRYABLE_WORKER_ERRORS = (TransientFault, OSError, TimeoutError,
                             ConnectionError)
 
-__all__ = ["DataLoader", "default_batchify_fn", "set_prefetch_override",
-           "prefetch_override"]
+__all__ = ["DataLoader", "default_batchify_fn", "default_device_put",
+           "set_prefetch_override", "prefetch_override",
+           "set_device_prefetch_override", "device_prefetch_override"]
 
 # live prefetch-depth override (the PrefetchController's apply target):
 # when set, every DataLoader's next __iter__ uses this depth for its
@@ -74,6 +91,41 @@ def set_prefetch_override(depth: Optional[int]) -> None:
 
 def prefetch_override() -> Optional[int]:
     return _prefetch_override
+
+
+# live DEVICE-prefetch depth override (the DevicePrefetchController's
+# apply target): when set, every DataLoader's next __iter__ uses this
+# depth for its device double-buffer stage instead of its constructor /
+# knob value.  Process-wide, like the host override above.
+_device_prefetch_override: Optional[int] = None
+
+
+def set_device_prefetch_override(depth: Optional[int]) -> None:
+    """Set (or clear, with None) the live device-prefetch depth.
+    Takes effect at each loader's next ``__iter__`` — the buffer holds
+    live device arrays, so resizing mid-epoch would mean dropping or
+    re-transferring batches."""
+    global _device_prefetch_override
+    _device_prefetch_override = None if depth is None \
+        else max(0, int(depth))
+
+
+def device_prefetch_override() -> Optional[int]:
+    return _device_prefetch_override
+
+
+def default_device_put(batch):
+    """Leaf-wise default-device placement: NDArray leaves re-land via
+    ``jax.device_put`` (async — the transfer overlaps the consumer),
+    numpy leaves become device NDArrays, tuples recurse.  The fallback
+    ``put_fn`` when no sharding-aware placer (e.g.
+    ``ShardedTrainer.place_batch``) is attached."""
+    import jax
+    if isinstance(batch, (tuple, list)):
+        return tuple(default_device_put(b) for b in batch)
+    if isinstance(batch, NDArray):
+        return NDArray(jax.device_put(batch._read()), ctx=batch.context)
+    return nd_array(batch)
 
 
 class _WorkerError:
@@ -103,7 +155,8 @@ class DataLoader:
                  last_batch=None, batch_sampler=None, batchify_fn=None,
                  num_workers=0, pin_memory=False, prefetch=None,
                  thread_pool=False, timeout=120, worker_retries=0,
-                 num_shards=None, shard_index=None):
+                 num_shards=None, shard_index=None,
+                 device_prefetch=None, device_put_fn=None):
         self._dataset = dataset
         if num_shards == "dist":
             if shard_index is not None:
@@ -150,6 +203,11 @@ class DataLoader:
         self._worker_retries = max(0, int(worker_retries))
         self._prefetch = max(0, prefetch if prefetch is not None
                              else 2 * num_workers)
+        # device double-buffer: None defers to the live override / the
+        # MXTPU_DEVICE_PREFETCH knob at each __iter__; 0 = off
+        self._device_prefetch = None if device_prefetch is None \
+            else max(0, int(device_prefetch))
+        self._device_put_fn = device_put_fn
         # `loader.*` observability metrics (process-global; see
         # mxnet_tpu.observability): batches built, per-batch build time,
         # transient worker retries
@@ -171,6 +229,39 @@ class DataLoader:
                  "PrefetchController's evidence that an applied target "
                  "is actually live (overrides apply at epoch "
                  "boundaries)")
+        self._h_device_put = reg.histogram(
+            "loader.device_put_us",
+            help="device-prefetch stage: time to DISPATCH one batch's "
+                 "device_put (the transfer itself is async and "
+                 "overlaps the consumer) — a large value means the "
+                 "placement fn is synchronizing")
+        self._g_device_depth = reg.gauge(
+            "loader.device_buffer_depth",
+            help="device-resident batches buffered beyond the one "
+                 "being consumed (each slot is HBM); pinned at zero "
+                 "with device prefetch on means transfers cannot keep "
+                 "ahead of the step")
+
+    def set_device_put_fn(self, fn) -> None:
+        """Attach the device-placement callable the device-prefetch
+        stage applies to each batch (e.g. a ``ShardedTrainer``'s
+        ``place_batch`` for dp-mesh-sharded placement).  None restores
+        the leaf-wise default.  Takes effect at the next __iter__."""
+        self._device_put_fn = fn
+
+    @property
+    def device_put_fn(self):
+        return self._device_put_fn
+
+    def _resolve_device_depth(self) -> int:
+        """Device-prefetch depth for the NEXT epoch: the live
+        controller override wins, then the constructor value, then the
+        MXTPU_DEVICE_PREFETCH knob (0 = off)."""
+        if _device_prefetch_override is not None:
+            return _device_prefetch_override
+        if self._device_prefetch is not None:
+            return self._device_prefetch
+        return max(0, int(get_env("MXTPU_DEVICE_PREFETCH")))
 
     def _resolve_shard(self):
         """(num_shards, shard_index) for the NEXT epoch.  ``"dist"``
@@ -306,11 +397,53 @@ class DataLoader:
         self._cursor_batch = start_batch
         plan = self._epoch_plan(k, s, start_batch)
         if self._num_workers == 0:
-            for bi, indices in plan:
-                batch = self._make_batch(indices, bi)
+            src = (self._make_batch(indices, bi) for bi, indices in plan)
+        else:
+            src = self._threaded_iter(plan)
+        depth = self._resolve_device_depth()
+        if depth > 0:
+            src = self._device_stage(src, depth)
+        # the position cursor counts batches HANDED TO the consumer —
+        # bumped here, at the outermost yield, so device-stage batches
+        # still in the buffer (transferred but never trained) are not
+        # counted and a checkpoint resume replays them
+        try:
+            for batch in src:
                 self._cursor_batch += 1
                 yield batch
-            return
+        finally:
+            src.close()
+
+    def _device_stage(self, src, depth: int):
+        """Device double buffering: dispatch each pulled host batch to
+        the placement fn immediately (``jax.device_put`` is async — the
+        transfer proceeds in the background) and keep up to ``depth``
+        placed batches in flight beyond the one being yielded, so the
+        consumer's step t overlaps batch t+1's host→device transfer
+        instead of paying it on the critical path."""
+        import collections
+        put = self._device_put_fn
+        if put is None:
+            put = default_device_put
+        buf = collections.deque()
+        try:
+            for item in src:
+                # span, not a bare clock pair: the put-dispatch cost
+                # rides the unified trace timeline too
+                with _span("loader.device_put_us"):
+                    buf.append(put(item))
+                if len(buf) > depth:
+                    self._g_device_depth.set(len(buf) - 1)
+                    yield buf.popleft()
+            while buf:
+                self._g_device_depth.set(len(buf) - 1)
+                yield buf.popleft()
+        finally:
+            close = getattr(src, "close", None)
+            if close is not None:
+                close()
+
+    def _threaded_iter(self, plan):
         # threaded prefetch pipeline with a bounded in-flight window so a
         # slow consumer never materializes more than window batches.
         # The live override (PrefetchController) wins over the
@@ -358,10 +491,14 @@ class DataLoader:
             except BaseException as exc:   # surface worker failures
                 hand_over(_WorkerError(exc))
             finally:
-                try:
-                    q.put_nowait(sentinel)
-                except queue.Full:
-                    pass   # only reachable when abandoned: nobody reads
+                # BLOCKING hand-over, not put_nowait: a consumer busy
+                # downstream of the queue (e.g. the device-prefetch
+                # stage compiling the step on its first batch) can
+                # leave the queue momentarily full right as the epoch
+                # ends — a dropped sentinel then strands it in q.get
+                # until the loader timeout.  hand_over waits for space
+                # and still exits promptly on abandonment.
+                hand_over(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
         t.start()
@@ -386,7 +523,6 @@ class DataLoader:
                 # would find if it came back immediately (the ROADMAP's
                 # prefetch-health gauge; also in flight-recorder records)
                 self._g_depth.set(q.qsize())
-                self._cursor_batch += 1
                 yield item
                 expected += 1
         finally:

@@ -26,7 +26,8 @@ import numpy as _np
 from ..base import MXNetError
 
 __all__ = ["make_mesh", "default_mesh", "ShardingRules", "replicated",
-           "shard", "zero_sharding", "axis_size", "MESH_AXES"]
+           "shard", "zero_sharding", "axis_size", "comm_buckets",
+           "MESH_AXES"]
 
 #: canonical axis order — dp outermost (DCN/ICI-friendly), then pipeline,
 #: then the intra-layer axes
@@ -123,6 +124,44 @@ def zero_sharding(mesh, spec, shape, axis: str = "dp"):
         return NamedSharding(mesh, PartitionSpec(*spec))
     entries[0] = axis
     return NamedSharding(mesh, PartitionSpec(*entries))
+
+
+def comm_buckets(nbytes, cap_bytes):
+    """Partition gradient indices into size-capped communication
+    buckets for the bucketed reduce-scatter (PAPER.md's L4 design
+    point: MXNet issued per-parameter KVStore pushes as backward
+    produced each gradient; the SPMD-native analog is per-bucket
+    collectives the latency-hiding scheduler interleaves with the
+    remaining backward compute).
+
+    ``nbytes`` is the per-gradient byte size in PARAMETER order; the
+    returned buckets are lists of indices in REVERSE parameter order —
+    the order backward materializes gradients (last layer first) — so
+    bucket 0's collective can issue while earlier layers' gradients
+    are still being computed.  Greedy fill: a bucket closes once it
+    holds >= 1 gradient and adding the next would exceed
+    ``cap_bytes``; a single gradient larger than the cap gets its own
+    bucket.  ``cap_bytes`` of 0/None/inf (or a cap that swallows
+    everything) returns ONE bucket — callers treat that as the fused
+    (pre-bucketing) path."""
+    n = len(nbytes)
+    if not n:
+        return []
+    if not cap_bytes or cap_bytes <= 0 or cap_bytes == float("inf"):
+        return [list(range(n - 1, -1, -1))]
+    buckets: List[List[int]] = []
+    cur: List[int] = []
+    cur_bytes = 0
+    for i in range(n - 1, -1, -1):
+        b = int(nbytes[i])
+        if cur and cur_bytes + b > cap_bytes:
+            buckets.append(cur)
+            cur, cur_bytes = [], 0
+        cur.append(i)
+        cur_bytes += b
+    if cur:
+        buckets.append(cur)
+    return buckets
 
 
 class ShardingRules:

@@ -163,6 +163,87 @@ class _InstanceCounters:
         return dict(self._local)
 
 
+def _run_vote_round(prefix: str, own_vote: int, members, timeout: float,
+                    poll: float, on_votes=None) -> int:
+    """THE coordinated-preemption vote protocol — one implementation
+    shared by the blocking path (:meth:`ResilientTrainer.
+    _coordinate_flush_step` calls it inline) and the async path
+    (:class:`_AsyncVoteRound` calls it on a voter thread), so a
+    protocol change can never split the agreed flush step between
+    async and blocking hosts in a mixed-config fleet.
+
+    Publish ``own_vote`` under ``prefix``, then poll the KV tier until
+    every active member has voted; the agreed flush step is
+    ``max(votes)``.  Degrades to ``own_vote`` — the unilateral
+    pre-coordination flush — when the publish fails (severed KV store:
+    exactly the degraded fabric a preemption often rides in on) or the
+    ``timeout`` deadline passes with members missing.  ``on_votes``
+    observes every successful collect (the async round's known_max
+    feed)."""
+    from . import dist
+    try:
+        dist.kv_publish(prefix, str(own_vote).encode("ascii"))
+    except Exception:   # noqa: BLE001 — degrade, never lose the
+        return own_vote  # preemption checkpoint
+    members = set(members)
+    deadline = time.monotonic() + float(timeout)
+    poll = max(0.005, float(poll))
+    while True:
+        votes = {}
+        try:
+            for r, v in dist.kv_collect(prefix).items():
+                votes[int(r)] = int(v.decode("ascii"))
+        except Exception:   # noqa: BLE001 — transient KV failure:
+            votes = {}      # retry until the deadline
+        if on_votes is not None and votes:
+            on_votes(votes)
+        if members <= set(votes):
+            _metrics_registry().counter(
+                "resilience.preempt_coordinated",
+                help="preemption rounds that agreed a fleet-wide "
+                     "flush step over the KV tier").inc()
+            return max(votes[r] for r in members)
+        if time.monotonic() > deadline:
+            return own_vote
+        time.sleep(poll)
+
+
+class _AsyncVoteRound:
+    """Background runner for :func:`_run_vote_round`
+    (``MXTPU_ASYNC_CKPT``): the same protocol, on its OWN thread, so
+    the step path never blocks in the vote wait the way
+    :meth:`ResilientTrainer._coordinate_flush_step` does.
+
+    Consistency argument (why hosts may keep stepping while the round
+    is open): a host only steps while its update counter is strictly
+    below ``known_max`` — the highest vote it has OBSERVED so far.
+    Since ``known_max`` never exceeds the final agreed step (the max
+    over ALL votes), no host can overshoot the agreement; once every
+    active member has voted, everyone steps up to exactly
+    ``max(votes)`` and commits the SAME ``state-<t>`` — the PR-10
+    invariant, minus the initiator parking while peers catch up."""
+
+    def __init__(self, prefix: str, own_vote: int, members, timeout: float,
+                 poll: float):
+        self.own_vote = int(own_vote)
+        self.known_max = int(own_vote)   # monotone int store (GIL-atomic)
+        self.agreed: Optional[int] = None
+        self.resolved = threading.Event()
+        self._poll = max(0.005, float(poll))
+
+        def run():
+            self.agreed = _run_vote_round(
+                prefix, self.own_vote, members, timeout, self._poll,
+                on_votes=lambda votes: setattr(
+                    self, "known_max",
+                    max(self.known_max, max(votes.values()))))
+            self.resolved.set()
+
+        self._thread = threading.Thread(
+            target=run, name="mxtpu-preempt-vote", daemon=True)
+        self._thread.start()
+
+
 class ResilientTrainer:
     """Wrap a :class:`ShardedTrainer` with failure handling.
 
@@ -294,7 +375,10 @@ class ResilientTrainer:
             membership.start()
         self._membership = membership
         self._fleet_sync_every = max(1, int(fleet_sync_every))
-        self._loader = loader
+        self._loader = None
+        self.attach_loader(loader)
+        self._g_ckpt_inflight = reg.gauge("resilience.ckpt_inflight")
+        self._vote_round: Optional[_AsyncVoteRound] = None
         # interpreter-exit fallback: an in-flight async write must commit
         # even if the loop never reaches another step boundary
         _register_exit_flush(trainer)
@@ -310,8 +394,16 @@ class ResilientTrainer:
 
     def attach_loader(self, loader) -> None:
         """Attach (or replace) the data pipeline whose position cursor
-        rides the checkpoint payload."""
+        rides the checkpoint payload.  Also wires the loader's
+        device-prefetch stage (if it has one and no custom placement
+        was set) to this trainer's sharding-aware ``place_batch``, so
+        ``MXTPU_DEVICE_PREFETCH`` double-buffers batches directly onto
+        the dp mesh instead of the default device."""
         self._loader = loader
+        if loader is not None and \
+                getattr(loader, "device_put_fn", True) is None and \
+                hasattr(loader, "set_device_put_fn"):
+            loader.set_device_put_fn(self._trainer.place_batch)
 
     @property
     def loss_scale(self) -> float:
@@ -443,55 +535,70 @@ class ResilientTrainer:
 
     def _coordinate_flush_step(self) -> int:
         """Publish this host's vote (its current update counter) and
-        wait — bounded — for every active member's; the agreed flush
-        step is the max.  Falls back to this host's own counter (the
-        unilateral pre-coordination behavior) when peers never arrive
-        within MXTPU_DIST_TIMEOUT."""
+        wait — bounded, INLINE (this host does not step while the
+        round is open; MXTPU_ASYNC_CKPT moves the same protocol onto
+        a voter thread instead) — for every active member's; the
+        agreed flush step is the max.  Falls back to this host's own
+        counter (the unilateral pre-coordination behavior) when peers
+        never arrive within MXTPU_DIST_TIMEOUT."""
         from . import dist
-        t_vote = self._trainer.num_update
-        prefix = self._preempt_prefix()
-        try:
-            dist.kv_publish(prefix, str(t_vote).encode("ascii"))
-        except Exception:   # noqa: BLE001 — a severed/degraded KV store
-            # (e.g. the coordinator host already exited — exactly the
-            # degraded fabric a preemption often rides in on) must not
-            # cost this host its preemption checkpoint: degrade to the
-            # unilateral flush
-            return t_vote
-        members = set(dist.active_members())
-        deadline = time.monotonic() + float(get_env("MXTPU_DIST_TIMEOUT"))
-        poll = max(0.005, float(get_env("MXTPU_PREEMPT_POLL")))
-        while True:
-            votes = {}
-            try:
-                for r, v in dist.kv_collect(prefix).items():
-                    votes[int(r)] = int(v.decode("ascii"))
-            except Exception:   # noqa: BLE001 — transient KV failure:
-                votes = {}      # retry until the deadline
-            if members <= set(votes):
-                flush_t = max(votes[r] for r in members)
-                _metrics_registry().counter(
-                    "resilience.preempt_coordinated",
-                    help="preemption rounds that agreed a fleet-wide "
-                         "flush step over the KV tier").inc()
-                return flush_t
-            if time.monotonic() > deadline:
-                return t_vote
-            time.sleep(poll)
+        return _run_vote_round(
+            self._preempt_prefix(), self._trainer.num_update,
+            dist.active_members(),
+            float(get_env("MXTPU_DIST_TIMEOUT")),
+            float(get_env("MXTPU_PREEMPT_POLL")))
 
     def _preempt_pending(self) -> bool:
         return (self.preempted or self._preempt_flush_t is not None or
+                self._vote_round is not None or
                 self._peer_preempt_pending())
+
+    def _preempt_round_open(self) -> bool:
+        """A coordinated flush is agreed or being agreed — the window
+        in which the per-step fleet barrier is skipped (peers are in
+        vote waits, not barriers)."""
+        return (self._preempt_flush_t is not None or
+                self._vote_round is not None)
 
     def _preempt_boundary(self) -> None:
         """The step-boundary preemption surface.  Single-process (or
         coordination off): checkpoint-and-raise immediately, exactly the
         pre-coordination behavior.  Multi-process: agree on one flush
-        step, then flush only once this host's counter reaches it."""
+        step, then flush only once this host's counter reaches it.
+
+        With ``MXTPU_ASYNC_CKPT`` the vote wait moves to a background
+        thread (:class:`_AsyncVoteRound`): this boundary RETURNS —
+        keep stepping — while the round is unresolved and this host's
+        counter is below the highest vote seen, so the initiator
+        catches up toward the agreement instead of parking while its
+        peers do (see the round's consistency argument)."""
         if self._preempt_flush_t is None:
             if not self._preempt_coord_on():
                 self._flush_and_raise()
-            self._preempt_flush_t = self._coordinate_flush_step()
+            if bool(get_env("MXTPU_ASYNC_CKPT")):
+                from . import dist
+                if self._vote_round is None:
+                    self._vote_round = _AsyncVoteRound(
+                        self._preempt_prefix(),
+                        self._trainer.num_update,
+                        dist.active_members(),
+                        float(get_env("MXTPU_DIST_TIMEOUT")),
+                        float(get_env("MXTPU_PREEMPT_POLL")))
+                r = self._vote_round
+                # check BEFORE waiting: a host behind the highest
+                # known vote must step immediately, not after a poll
+                # sleep (this boundary runs twice per step — a
+                # leading sleep would throttle the very catch-up the
+                # async round exists for and could blow peers' vote
+                # deadlines); park only when caught up, and a new
+                # higher vote arriving mid-park resumes stepping
+                while not r.resolved.is_set():
+                    if self._trainer.num_update < r.known_max:
+                        return
+                    r.resolved.wait(r._poll)
+                self._preempt_flush_t = r.agreed
+            else:
+                self._preempt_flush_t = self._coordinate_flush_step()
         if self._trainer.num_update >= self._preempt_flush_t:
             self._flush_and_raise()
 
@@ -525,7 +632,7 @@ class ResilientTrainer:
         injection, bounded retry, skip accounting, preemption handling,
         periodic checkpointing.  Returns the (device) mean loss —
         NaN on a skipped step, with params untouched."""
-        if self.preempted or self._preempt_flush_t is not None:
+        if self.preempted or self._preempt_round_open():
             # local-state check only — the peer-vote KV probe runs ONCE
             # per step (at the end-of-step boundary below); a vote
             # landing mid-step is caught one boundary later, and the
@@ -626,7 +733,7 @@ class ResilientTrainer:
             if len(self._pending_finite) >= 128:
                 self._drain_finite()
         if self._membership is not None and \
-                self._preempt_flush_t is None and \
+                not self._preempt_round_open() and \
                 i % self._fleet_sync_every == 0:
             # during a coordinated preemption round the lockstep sync is
             # skipped: the initiator is parked in its vote-wait (the
@@ -832,6 +939,10 @@ class ResilientTrainer:
             steps_skipped=self._c_skipped.n,
             rollbacks=self._c_rollbacks.n,
             loader_depth=self._g_loader_depth.value,
+            # in-flight async checkpoint (the PR-4 gauge, now a
+            # per-step flight field): 1 while a background orbax/npz
+            # commit overlaps these steps
+            ckpt_inflight=self._g_ckpt_inflight.value,
             failed=failed,
         )
 

@@ -34,6 +34,8 @@ full-batch gradient), so global batch scales past per-chip memory.
 from __future__ import annotations
 
 import re as _re
+import threading as _threading
+import time as _time
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as _np
@@ -47,8 +49,8 @@ from ..ndarray import NDArray
 from ..gluon.block import _TraceCtx, _KeyScope
 from ..gluon.parameter import Parameter
 from ..observability.registry import registry as _metrics_registry
-from .mesh import (ShardingRules, axis_size, default_mesh, replicated,
-                   shard, zero_sharding)
+from .mesh import (ShardingRules, axis_size, comm_buckets, default_mesh,
+                   replicated, shard, zero_sharding)
 from .optim import make_functional_optimizer
 
 __all__ = ["ShardedTrainer"]
@@ -88,6 +90,15 @@ class ShardedTrainer:
         batch but runs it as N sequential microbatches under a
         ``lax.scan``; peak activation memory drops ~N-fold while the
         update is rescale-correct against the full batch.
+    comm_bucket_mb : float — bucketed gradient reduce-scatter (default:
+        the ``MXTPU_COMM_BUCKET_MB`` knob).  0 (off) keeps ONE fused
+        reduction after the full backward — bitwise-identical to the
+        pre-bucketing step; > 0 splits the gradients into buckets of
+        at most this many MB (reverse parameter order — the order
+        backward materializes them) whose dp-reductions are pinned
+        with ``optimization_barrier``-chained sharding constraints so
+        XLA's latency-hiding scheduler overlaps each bucket's
+        collective with the remaining backward compute.
     """
 
     def __init__(self, block, loss: Callable, optimizer,
@@ -97,6 +108,7 @@ class ShardedTrainer:
                  label_spec: Optional[Sequence] = None,
                  zero_stage: Optional[int] = None,
                  accum_steps: Optional[int] = None,
+                 comm_bucket_mb: Optional[float] = None,
                  guard_nonfinite: bool = False,
                  dynamic_loss_scale: bool = False,
                  init_loss_scale: float = 2.0 ** 15,
@@ -123,6 +135,20 @@ class ShardedTrainer:
             raise MXNetError(
                 f"accum_steps must be >= 1, got {accum_steps!r}")
         self._accum = int(accum_steps)
+        if comm_bucket_mb is None:
+            comm_bucket_mb = float(get_env("MXTPU_COMM_BUCKET_MB"))
+        if float(comm_bucket_mb) < 0:
+            raise MXNetError(
+                f"comm_bucket_mb must be >= 0 (0 = one fused "
+                f"reduction), got {comm_bucket_mb!r}")
+        self._bucket_mb = float(comm_bucket_mb)
+        self._grad_buckets = None
+        # forced checkpoint layout: None = auto (_host_local_checkpoint
+        # decides from the process group); tests/bench set True to
+        # exercise the self-contained npz writer in a single process
+        self.host_local_ckpt: Optional[bool] = None
+        self._hl_writer = None       # in-flight async npz commit thread
+        self._hl_error = None
         optimizer_params = optimizer_params or {}
         self._optimizer = opt_mod.create(optimizer, **optimizer_params)
         self._scale = self._optimizer.rescale_grad
@@ -280,6 +306,44 @@ class ShardedTrainer:
         dp = self._dp
         z_sh, p_sh = list(self._z_sh), list(self._p_sh)
         wsc = jax.lax.with_sharding_constraint
+        # communication buckets for the gradient reduction (reverse
+        # parameter order — the order backward materializes gradients);
+        # a single bucket IS the fused path, kept as None so the
+        # pre-bucketing trace stays byte-for-byte the same graph
+        cap = self._bucket_mb * 2 ** 20 if self._bucket_mb else 0
+        bks = comm_buckets([int(v.nbytes) for v in self._pvals], cap)
+        self._grad_buckets = bks if len(bks) > 1 else None
+        buckets = self._grad_buckets
+
+        def constrain_grads(grads):
+            """The gradient-reduction schedule.  Fused (``buckets is
+            None``): one constraint sweep — at stage >= 1 XLA lowers
+            every gradient's dp reduction to a reduce-scatter right
+            before the update, all after the full backward (the PR-10
+            trace).  Bucketed: each bucket is constrained separately
+            and chained through ``jax.lax.optimization_barrier`` —
+            bucket k's gradients are tied to bucket k-1's constrained
+            output, so XLA can neither merge the per-bucket
+            reductions back into one fused collective nor sink them
+            all past the backward; the latency-hiding scheduler then
+            issues bucket 0's collective (the last layers' grads, the
+            first to materialize) while earlier layers' gradients are
+            still being computed."""
+            if buckets is None:
+                return [wsc(g, s) for g, s in zip(grads, z_sh)]
+            out = list(grads)
+            prev = None
+            for idx in buckets:
+                vals = [out[i] for i in idx]
+                if prev is not None:
+                    tied = jax.lax.optimization_barrier(
+                        tuple(vals) + (prev,))
+                    vals = list(tied[:-1])
+                vals = [wsc(v, z_sh[i]) for v, i in zip(vals, idx)]
+                prev = vals[0]
+                for i, v in zip(idx, vals):
+                    out[i] = v
+            return out
         if accum > 1:
             # microbatch shardings: after the (B, ...) -> (accum, B/accum,
             # ...) reshape the batch axis moves to dim 1; the scan axis
@@ -391,8 +455,12 @@ class ShardedTrainer:
             the parameter layout (the all-gather) — all inside the one
             donated jit, so XLA overlaps the collectives with
             compute."""
-            if zero >= 1:
-                grads = [wsc(g, s) for g, s in zip(grads, z_sh)]
+            if zero >= 1 or buckets is not None:
+                # stage 0 with bucketing on: the constraint target is
+                # the param's own (replicated) sharding — the barrier
+                # chain still pins WHERE each bucket's psum lands in
+                # the schedule
+                grads = constrain_grads(grads)
             new_pvals, new_state = fopt.update(pvals, grads, state, t,
                                                lr, rescale)
             if zero >= 1:
@@ -529,6 +597,45 @@ class ShardedTrainer:
         if the mesh has no dp axis)."""
         return axis_size(self._mesh, "dp")
 
+    @property
+    def comm_bucket_mb(self) -> float:
+        """Gradient-reduction bucket cap in MB (0 = one fused
+        reduction, the pre-bucketing trace)."""
+        return self._bucket_mb
+
+    @property
+    def grad_buckets(self):
+        """The live bucket partition (index lists in reverse parameter
+        order), or None on the fused path.  Introspection only."""
+        return None if self._grad_buckets is None \
+            else [list(b) for b in self._grad_buckets]
+
+    def set_comm_bucket_mb(self, mb: float) -> None:
+        """Change the communication bucket cap on a live trainer — the
+        CommBucketController's apply target.  Rebuilds the jitted step
+        (a recompile) only when the cap actually changes the bucket
+        PARTITION; a cap move that lands on the same partition is
+        free.  Training state is untouched (the jit closes over
+        shardings, not values)."""
+        mb = float(mb or 0.0)
+        if mb < 0:
+            # same contract as the constructor: a negative cap is a
+            # caller bug, not a request to turn bucketing off
+            raise MXNetError(
+                f"comm_bucket_mb must be >= 0 (0 = one fused "
+                f"reduction), got {mb!r}")
+        if mb == self._bucket_mb:
+            return
+        self._bucket_mb = mb
+        if not self._built:
+            return
+        cap = mb * 2 ** 20 if mb else 0
+        bks = comm_buckets([int(v.nbytes) for v in self._pvals], cap)
+        new = bks if len(bks) > 1 else None
+        if new == self._grad_buckets:
+            return
+        self._build_jits()
+
     def opt_state_bytes_per_device(self) -> dict:
         """Actually-resident optimizer-state bytes per device id — the
         ZeRO acceptance metric.  At stage 0 every chip carries the full
@@ -637,6 +744,21 @@ class ShardedTrainer:
         else:
             ys = jax.device_put(yv, self._y_sh)
         return (xs if len(xs) > 1 else xs[0], ys)
+
+    def place_batch(self, batch):
+        """Sharding-aware device placement for ONE loader batch — the
+        DataLoader device-prefetch stage's ``put_fn``
+        (``loader.set_device_put_fn(trainer.place_batch)``; the
+        ResilientTrainer wires this automatically for an attached
+        loader).  A ``(x, y)`` pair routes through :meth:`shard_batch`
+        (building the trainer on first use); any other batch shape
+        falls back to leaf-wise default-device placement, so a loader
+        that yields something this trainer cannot shard still
+        double-buffers plain transfers."""
+        if isinstance(batch, (tuple, list)) and len(batch) == 2:
+            return self.shard_batch(batch[0], batch[1])
+        from ..gluon.data.dataloader import default_device_put
+        return default_device_put(batch)
 
     @hot_path("step")
     def step(self, x, y, batch_size: Optional[int] = None):
@@ -802,10 +924,35 @@ class ShardedTrainer:
                  "trainer process)")
 
     def wait_checkpoint(self) -> None:
-        """Block until any in-flight async checkpoint write commits."""
+        """Block until any in-flight async checkpoint write commits
+        (the orbax writer AND the host-local npz commit thread)."""
+        self._wait_host_local()
         if getattr(self, "_ckptr", None) is not None:
             self._ckptr.wait_until_finished()
             self._ckpt_inflight_gauge().set(0)
+
+    def _join_host_local(self) -> None:
+        """Drain the background npz commit thread WITHOUT raising —
+        the step-path variant: a periodic save must be able to start
+        its own write after a failed predecessor (the previous
+        committed dir is intact; that is the whole crash contract).
+        The stored error stays armed for the next explicit flush."""
+        th, self._hl_writer = self._hl_writer, None
+        if th is not None:
+            th.join()
+            self._ckpt_inflight_gauge().set(0)
+
+    def _wait_host_local(self) -> None:
+        """Join the background npz commit thread (MXTPU_ASYNC_CKPT)
+        and surface its failure, if any, HERE — the same contract as
+        orbax's wait_until_finished: the write path never raises into
+        the training step, only into the explicit flush."""
+        self._join_host_local()
+        err, self._hl_error = self._hl_error, None
+        if err is not None:
+            raise MXNetError(
+                f"async host-local checkpoint write failed: "
+                f"{err!r}") from err
 
     def _host_local_checkpoint(self) -> bool:
         """True when this trainer's state must be saved as HOST values:
@@ -814,7 +961,11 @@ class ShardedTrainer:
         Orbax refuses to serialize such 'host-local' jax arrays, and
         they carry no cross-host sharding worth preserving anyway.  A
         mesh that genuinely spans processes (TPU pod) keeps the sharded
-        orbax path."""
+        orbax path.  ``self.host_local_ckpt`` (a plain attribute)
+        overrides the auto-detection either way — how the bench and
+        the torn-dir tests exercise the npz writer in one process."""
+        if self.host_local_ckpt is not None:
+            return bool(self.host_local_ckpt)
         from . import dist
         if not dist.is_initialized():
             return False
@@ -846,8 +997,12 @@ class ShardedTrainer:
             # replays the dynamic-scale trajectory bit-for-bit
             tree["guard"] = list(self._gstate)
         if self._host_local_checkpoint():
-            self._save_host_local(directory, tree)
-            self._ckpt_inflight_gauge().set(0)
+            # _save_host_local owns the inflight gauge on the async
+            # path (set to 1 before its thread starts — no race with
+            # the thread's own set(0)); synchronous writes are
+            # committed by the time it returns
+            if not self._save_host_local(directory, tree):
+                self._ckpt_inflight_gauge().set(0)
             return
         self._checkpointer().save(
             os.path.join(directory, f"state-{self._t:08d}"), tree,
@@ -858,7 +1013,7 @@ class ShardedTrainer:
 
     _HOST_LOCAL_NPZ = "host_local.npz"
 
-    def _save_host_local(self, directory: str, tree: dict) -> None:
+    def _save_host_local(self, directory: str, tree: dict) -> bool:
         """Per-host atomic checkpoint for multi-process groups whose
         mesh is host-local: orbax refuses to serialize host-local jax
         arrays, and its replicated-numpy handler writes on GLOBAL
@@ -866,14 +1021,22 @@ class ShardedTrainer:
         replicas.  This path writes the host's full state itself (npz
         into a tmp dir, commit marker, atomic rename), producing
         exactly the committed-dir shape ``committed_checkpoints`` /
-        ``latest_checkpoint`` already filter on.  Synchronous and
-        barrier-free by design: per-host independence is the
-        elastic-fleet story — no cross-host coordination can wedge
-        this save when a peer is dead."""
+        ``latest_checkpoint`` already filter on.  Barrier-free by
+        design: per-host independence is the elastic-fleet story — no
+        cross-host coordination can wedge this save when a peer is
+        dead.
+
+        Synchronous by default.  With ``MXTPU_ASYNC_CKPT`` the
+        device_get SNAPSHOT still happens here, at the step boundary
+        (the next donated step invalidates these buffers), but the npz
+        serialization + commit rename — the part whose cost scales
+        with model size — move to a background thread; the boundary
+        stall shrinks to the host copy.  A crash mid-write leaves the
+        tmp dir uncommitted (no marker, no rename), which resume
+        already filters out, so the previous committed ``state-<t>``
+        always survives.  Returns True when the write went async."""
         import os
-        import shutil
         import jax
-        import numpy as _nnp
         flat = {f"p{i}": v for i, v in enumerate(tree["params"])}
         flat.update({f"a{i}": v for i, v in enumerate(tree["aux"])})
         flat.update({f"s{i}": v for i, v in
@@ -882,11 +1045,54 @@ class ShardedTrainer:
         flat["t"] = tree["t"]
         if "guard" in tree:
             flat.update({f"g{i}": v for i, v in enumerate(tree["guard"])})
-        flat = jax.device_get(flat)
+        flat = jax.device_get(flat)          # the boundary snapshot
         final = os.path.join(directory, f"state-{self._t:08d}")
         tmp = f"{final}.mxtpu-tmp-{os.getpid()}"
+        if not bool(get_env("MXTPU_ASYNC_CKPT")):
+            self._write_host_local(flat, tmp, final)
+            return False
+        # one write in flight at a time (the orbax contract): a second
+        # save first drains the previous commit — without raising (a
+        # failed predecessor must not abort the step-path save that
+        # replaces it; its error stays armed for the explicit flush)
+        self._join_host_local()
+        hist = _metrics_registry().histogram(
+            "ckpt.async_commit_us",
+            help="background npz checkpoint commit time (serialize + "
+                 "marker + atomic rename) — the write the async path "
+                 "takes OFF the step boundary")
+
+        def commit():
+            t0 = _time.perf_counter()
+            try:
+                self._write_host_local(flat, tmp, final)
+                hist.observe((_time.perf_counter() - t0) * 1e6)
+            except BaseException as exc:   # noqa: BLE001 — re-raised
+                self._hl_error = exc       # by the next wait_checkpoint
+            finally:
+                self._ckpt_inflight_gauge().set(0)
+
+        th = _threading.Thread(target=commit, name="mxtpu-ckpt-writer",
+                               daemon=True)
+        self._hl_writer = th
+        # gauge up BEFORE the thread starts: a fast commit's set(0)
+        # must never be overwritten by a caller-side set(1) racing it
+        self._ckpt_inflight_gauge().set(1)
+        th.start()
+        return True
+
+    @staticmethod
+    def _write_host_local(flat: dict, tmp: str, final: str) -> None:
+        """The commit sequence: npz into the tmp dir, marker, atomic
+        rename.  Interruptible at any point without losing the
+        previous committed dir — the marker is written only after the
+        full npz, and the rename is the single commit point."""
+        import os
+        import shutil
+        import numpy as _nnp
         os.makedirs(tmp, exist_ok=True)
-        _nnp.savez(os.path.join(tmp, self._HOST_LOCAL_NPZ), **flat)
+        _nnp.savez(os.path.join(tmp, ShardedTrainer._HOST_LOCAL_NPZ),
+                   **flat)
         with open(os.path.join(tmp, _COMMIT_MARKER), "w") as f:
             f.write("mxtpu host-local checkpoint\n")
         if os.path.isdir(final):

@@ -12,9 +12,13 @@ observability spine already measures:
   (loader prefetch depth from its queue gauge),
   :class:`~.controllers.BatchWindowController`
   (``MXTPU_SERVING_BATCH_WINDOW_US`` from the serving queue gauge +
-  request p99) and :class:`~.controllers.FleetGatherController`
+  request p99), :class:`~.controllers.FleetGatherController`
   (timer-thread fleet metric gather over the barrier-free KV
-  transport);
+  transport), :class:`~.controllers.DevicePrefetchController` (the
+  loader's device double-buffer depth vs HBM from the
+  ``loader.device_put_us`` jitter) and — constructed per trainer, not
+  stock — :class:`~.controllers.CommBucketController`
+  (``MXTPU_COMM_BUCKET_MB`` hill-climb on ``resilience.step_us``);
 - :mod:`.compile_cache` — compiled executables (exact-mode bulk
   segments, HybridBlock cached graphs) serialized to
   ``MXTPU_COMPILE_CACHE_DIR`` and reloaded by later processes, so
@@ -48,13 +52,15 @@ from ..base import get_env
 from ..observability.registry import registry as _metrics_registry
 from . import compile_cache
 from .controllers import (BatchWindowController, BulkSizeController,
-                          Controller, CounterDelta, FleetGatherController,
+                          CommBucketController, Controller, CounterDelta,
+                          DevicePrefetchController, FleetGatherController,
                           HistogramDelta, PrefetchController)
 
 __all__ = ["TuningRuntime", "runtime", "standard_controllers", "start",
            "stop", "Controller", "BulkSizeController",
            "PrefetchController", "BatchWindowController",
-           "FleetGatherController", "HistogramDelta", "CounterDelta",
+           "FleetGatherController", "CommBucketController",
+           "DevicePrefetchController", "HistogramDelta", "CounterDelta",
            "compile_cache"]
 
 INTERVAL_ENV = "MXTPU_TUNE_INTERVAL"
@@ -171,7 +177,7 @@ def runtime() -> TuningRuntime:
 
 
 def standard_controllers(**overrides) -> List[Controller]:
-    """The four stock controllers, each gated by its own
+    """The stock controllers, each gated by its own
     ``MXTPU_TUNE_*`` enable knob (evaluated live at every tick, so a
     controller can be switched off on a running process).  Keyword
     overrides are forwarded per controller:
@@ -181,12 +187,16 @@ def standard_controllers(**overrides) -> List[Controller]:
         PrefetchController(**overrides.get("prefetch", {})),
         BatchWindowController(**overrides.get("batch_window", {})),
         FleetGatherController(**overrides.get("fleet_gather", {})),
+        DevicePrefetchController(**overrides.get("device_prefetch", {})),
+        # CommBucketController is NOT stock: it needs a live
+        # ShardedTrainer reference (apply rebuilds that trainer's jit)
+        # — construct it with the trainer and runtime().add() it
     ]
 
 
 def start(controllers: Optional[List[Controller]] = None,
           **overrides) -> TuningRuntime:
-    """Convenience: register ``controllers`` (default: the stock four)
+    """Convenience: register ``controllers`` (default: the stock set)
     on the global runtime and start its timer thread.  Also resolves
     the persistent compile cache from the env (``configure``), so one
     call arms both halves of the self-tuning runtime."""

@@ -55,6 +55,7 @@ from ..observability.registry import (_percentile_from, registry,
 
 __all__ = ["Controller", "BulkSizeController", "PrefetchController",
            "BatchWindowController", "FleetGatherController",
+           "CommBucketController", "DevicePrefetchController",
            "HistogramDelta", "CounterDelta"]
 
 DRY_RUN_ENV = "MXTPU_TUNE_DRY_RUN"
@@ -526,6 +527,217 @@ class BatchWindowController(Controller):
         # env-knob lint rejects writes of UNdeclared names); the Batcher
         # reads this knob live per assembled batch
         os.environ["MXTPU_SERVING_BATCH_WINDOW_US"] = repr(float(value))
+
+
+# ---------------------------------------------------------------------------
+# CommBucketController — the overlap tradeoff with a real optimum
+# ---------------------------------------------------------------------------
+
+class CommBucketController(Controller):
+    """Hill-climb a :class:`~mxnet_tpu.parallel.ShardedTrainer`'s
+    ``MXTPU_COMM_BUCKET_MB`` — the gradient reduce-scatter bucket cap —
+    on the measured ``resilience.step_us`` interval mean.
+
+    The tradeoff is real in both directions: buckets too LARGE expose
+    the collective after backward (no overlap — the serialized phase
+    this knob exists to hide); too SMALL and per-collective launch
+    overhead dominates and the barrier chain over-constrains the
+    scheduler.  The optimum is model- and fabric-dependent, so it is
+    searched, not configured: probe upward first (more MB = fewer
+    collectives), follow the measured gradient, hold on a plateau.
+
+    Needs a live trainer (``set_comm_bucket_mb`` is an instance
+    surface — a cap change rebuilds the jitted step), so it is NOT in
+    the stock :func:`~mxnet_tpu.tuning.standard_controllers` set; the
+    intervals right after an applied move are discarded
+    (``settle_intervals``) because they carry the rebuild's compile,
+    which would read as a regression and degenerate the climb into
+    oscillation (the BulkSizeController lesson).  Unlike that
+    controller (whose apply is a cheap env write), every move here is
+    a RECOMPILE — so the climb also carries a bracketing stop: two
+    direction reversals mean both neighboring caps measured worse
+    than the current one, and the controller parks there instead of
+    cycling optimum→neighbor→optimum forever (the plateau hold alone
+    cannot catch that cycle: its comparison baseline is always the
+    just-regressed neighbor).  It re-arms only when the interval mean
+    drifts ``rearm`` above the best score seen — the workload
+    actually changed.  Holds while the trainer has bucketing OFF
+    (cap 0) — overlap-off is an operator choice the controller must
+    not silently reverse."""
+
+    name = "comm_bucket"
+    knob = "MXTPU_COMM_BUCKET_MB"
+    enable_env = "MXTPU_TUNE_COMM_BUCKET"
+
+    def __init__(self, trainer, *, vmin: float = 0.25, vmax: float = 256.0,
+                 factor: float = 2.0, min_steps: int = 8,
+                 tol: float = 0.03, settle_intervals: int = 1,
+                 rearm: float = 1.25, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, **kw)
+        self._trainer = trainer
+        self.factor = float(factor)
+        self.min_steps = int(min_steps)
+        self.tol = float(tol)
+        self.settle_intervals = int(settle_intervals)
+        self.rearm = float(rearm)
+        self._step_us = HistogramDelta(
+            registry().histogram("resilience.step_us"))
+        self._dir = 1
+        self._settle = 0
+        self._flips = 0      # reversals since the last NEW best score
+        self._best: Optional[float] = None
+        self._best_cap: float = 0.0
+        self._last_score: Optional[float] = None
+
+    def current(self) -> float:
+        return float(self._trainer.comm_bucket_mb)
+
+    def on_applied(self, value) -> None:
+        self._settle = self.settle_intervals
+
+    def decide(self):
+        d = self._step_us.take()
+        if d is None or d["count"] < self.min_steps:
+            return None
+        cur = self.current()
+        if cur <= 0:
+            return None                  # bucketing off: hold (see doc)
+        if self._settle > 0:
+            # spend the settle credit only on an interval that carried
+            # steps at the new cap (the jit-rebuild compile spike)
+            self._settle -= 1
+            return None
+        score = d["mean"]                # step us, interval mean
+        new_best = self._best is None or \
+            score < self._best * (1 - self.tol)
+        if self._best is None or score < self._best:
+            self._best = score
+            self._best_cap = cur
+        if self._flips < 2:
+            if self._last_score is None:
+                self._last_score = score  # first full interval: probe up
+            elif score > self._last_score * (1 + self.tol):
+                self._dir = -self._dir   # regressed: turn around
+                # an improvement that merely RETURNS to the best does
+                # not reset the flip count — only a NEW best does, so
+                # an optimum->neighbor->optimum cycle reaches 2 flips
+                self._flips += 1
+                self._last_score = score
+            elif score < self._last_score * (1 - self.tol):
+                self._last_score = score  # improved: keep climbing
+                if new_best:
+                    self._flips = 0       # genuine progress re-arms
+            else:
+                self._last_score = score  # plateau: converged — hold
+                return None
+        if self._flips >= 2:
+            # bracketed: both neighbors of the best cap measured
+            # worse — one final move back to the best, then park
+            # there (each move is a recompile) until the workload
+            # shifts, read as the mean drifting well above the best
+            if score > self._best * self.rearm:
+                self._flips = 0
+                self._best = score
+                self._best_cap = cur
+                self._last_score = score
+                return None
+            if cur != self._best_cap:
+                return self._best_cap, (
+                    f"bracketed (2 reversals): parking at the best "
+                    f"measured cap {self._best_cap:g}MB")
+            return None
+        nxt = cur * self.factor if self._dir > 0 else cur / self.factor
+        return nxt, (f"step mean={score:.0f}us p99={d['p99']:.0f}us "
+                     f"steps={d['count']} dir={self._dir:+d}")
+
+    def apply(self, value) -> None:
+        self._trainer.set_comm_bucket_mb(float(value))
+
+
+# ---------------------------------------------------------------------------
+# DevicePrefetchController — depth vs HBM
+# ---------------------------------------------------------------------------
+
+class DevicePrefetchController(Controller):
+    """Adapt the DataLoader device-prefetch depth — how many batches
+    stay resident on device beyond the one being consumed — from the
+    ``loader.device_put_us`` transfer-dispatch distribution.
+
+    Depth exists to absorb transfer JITTER: if every ``device_put``
+    dispatches in uniform time, one buffered batch already hides the
+    transfer and each extra slot is pure HBM (a full resident batch).
+    A heavy dispatch tail (interval p99 ≫ p50 — host contention,
+    sharding layout work, a synchronizing placement fn) means the
+    consumer can catch up with the stage during a slow transfer, so
+    deeper buffering earns its memory.  The applied target reaches
+    every loader at its next ``__iter__`` via
+    :func:`~mxnet_tpu.gluon.data.dataloader.set_device_prefetch_override`;
+    the ``loader.device_buffer_depth`` gauge is the evidence a target
+    is live.  An interval with fewer than ``min_batches`` transfers
+    holds — an idle pipeline must not read as smooth and ratchet the
+    depth to the floor.  At target 0 (the env knob off) a loader whose
+    device stage is nonetheless LIVE — ``device_prefetch=`` passed to
+    its constructor, visible as a nonzero buffer-depth gauge — is
+    ADOPTED as the baseline (the PrefetchController idiom: observed
+    reality beats the controller's model), so constructor-enabled
+    pipelines get tuned too; with no live stage anywhere, 0 holds —
+    off is an operator choice this controller never reverses.  Note
+    the applied override wins over constructor depths at the next
+    ``__iter__`` (the same process-wide semantics as the host-side
+    prefetch override)."""
+
+    name = "device_prefetch"
+    knob = "MXTPU_DEVICE_PREFETCH"
+    enable_env = "MXTPU_TUNE_DEVICE_PREFETCH"
+
+    def __init__(self, *, vmin: int = 1, vmax: int = 8,
+                 initial: Optional[int] = None,
+                 jitter_high: float = 4.0, jitter_low: float = 1.5,
+                 min_batches: int = 8, hysteresis: int = 2, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, hysteresis=hysteresis,
+                         **kw)
+        if initial is None:
+            initial = int(get_env("MXTPU_DEVICE_PREFETCH"))
+        self._target = max(0, int(initial))
+        self.jitter_high = float(jitter_high)
+        self.jitter_low = float(jitter_low)
+        self.min_batches = int(min_batches)
+        self._put = HistogramDelta(
+            registry().histogram("loader.device_put_us"))
+        self._g_depth = registry().gauge("loader.device_buffer_depth")
+
+    def current(self) -> float:
+        return self._target
+
+    def decide(self):
+        d = self._put.take()
+        if d is None or d["count"] < self.min_batches:
+            return None
+        t = self._target
+        if t <= 0:
+            live = self._g_depth.value
+            if live > 0:
+                # a loader enabled via its CONSTRUCTOR is running a
+                # device stage the env-seeded target never saw: adopt
+                # the observed depth as the baseline so it gets tuned
+                self._target = max(int(self.vmin),
+                                   min(int(live), int(self.vmax)))
+            return None                   # prefetch off (or adopting)
+        jitter = d["p99"] / max(d["p50"], 1e-9)
+        if jitter >= self.jitter_high:
+            return t * 2, (f"transfer dispatch tail heavy (p99/p50 "
+                           f"{jitter:.1f} >= {self.jitter_high}): "
+                           f"deepen the double buffer")
+        if jitter <= self.jitter_low and t > self.vmin:
+            return t - 1, (f"transfer dispatch uniform (p99/p50 "
+                           f"{jitter:.1f} <= {self.jitter_low}): "
+                           f"reclaim a resident-batch slot")
+        return None
+
+    def apply(self, value) -> None:
+        from ..gluon.data import dataloader as _dl
+        self._target = int(value)
+        _dl.set_device_prefetch_override(self._target)
 
 
 # ---------------------------------------------------------------------------

@@ -704,7 +704,8 @@ def test_flight_recorder_dump_on_injected_crash(tmp_path, monkeypatch):
     assert crashed["step"] == 2 and crashed["failed"] is True
     assert crashed["loss"] is None
     for k in ("loss_scale", "flush_us_p99", "flush_count",
-              "steps_skipped", "rollbacks", "loader_depth", "t"):
+              "steps_skipped", "rollbacks", "loader_depth", "t",
+              "ckpt_inflight"):
         assert k in ok, k
     assert d["snapshot"]["resilience.steps_retried"] >= 1
 

@@ -310,18 +310,19 @@ def test_sharded_trainer_tuple_labels():
 # -- ZeRO scale-out (zero_stage / accum_steps / re-shard) --------------------
 
 def _zero_run(zero, accum, opt="adam", opt_args=None, mesh=None, steps=5,
-              guard=False):
+              guard=False, bucket=0.0):
     """One short training run; returns (trainer, params-by-suffix,
     final loss).  Every call re-seeds identically, so two runs differ
     only by the knobs under test."""
     np.random.seed(7)
     mx.random.seed(3)
-    net = _mlp(f"zr{zero}a{accum}{'g' if guard else ''}_")
+    btag = str(bucket).replace(".", "p").replace("-", "m").replace("+", "")
+    net = _mlp(f"zr{zero}a{accum}{'g' if guard else ''}b{btag}_")
     net.initialize(mx.init.Xavier(rnd_type="gaussian"))
     tr = par.ShardedTrainer(
         net, gloss.SoftmaxCrossEntropyLoss(), opt,
         dict(opt_args or {"learning_rate": 0.01}), mesh=mesh,
-        zero_stage=zero, accum_steps=accum)
+        zero_stage=zero, accum_steps=accum, comm_bucket_mb=bucket)
     if guard:
         tr.enable_nonfinite_guard(dynamic_loss_scale=True)
     rng = np.random.RandomState(11)
@@ -468,6 +469,137 @@ def test_reshard_in_place_preserves_state():
           for p in tr_b._block.collect_params().values()]
     for a, b in zip(pa, pb):
         np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6)
+
+
+# -- bucketed gradient reduce-scatter (comm_bucket_mb) -----------------------
+
+def test_comm_bucket_infinite_bitwise_matches_fused():
+    """comm_bucket_mb large enough to hold EVERY gradient is one
+    bucket, and one bucket short-circuits to the fused constraint
+    sweep — byte-for-byte the PR-10 trace (the bucket=∞ half of the
+    acceptance contract), asserted bitwise against bucketing off."""
+    tr_off, p_off, l_off = _zero_run(1, 1)
+    tr_inf, p_inf, l_inf = _zero_run(1, 1, bucket=1e9)
+    assert tr_off.grad_buckets is None and tr_inf.grad_buckets is None
+    assert l_off == l_inf
+    for n in p_off:
+        np.testing.assert_array_equal(p_off[n], p_inf[n], err_msg=n)
+
+
+@pytest.mark.parametrize("zero,accum,bucket", [
+    (0, 1, 1e-5), (1, 1, 1e-5), (2, 1, 1e-5), (1, 2, 1e-5),
+    (1, 1, 2e-3),    # mid cap: some buckets hold > 1 gradient
+])
+def test_comm_bucket_allclose_across_sizes(zero, accum, bucket):
+    """Bucketing is a SCHEDULE change, not a numerics change: any cap,
+    at any zero stage (and under accumulation), must match the fused
+    replicated step to float tolerance (the optimization_barrier chain
+    is an identity; only collective placement moves)."""
+    _, p_ref, _ = _zero_run(0, 1)
+    tr_b, p_b, _ = _zero_run(zero, accum, bucket=bucket)
+    assert tr_b.grad_buckets is not None   # the cap really bucketed
+    for n in p_ref:
+        np.testing.assert_allclose(p_ref[n], p_b[n], rtol=1e-5,
+                                   atol=1e-6, err_msg=n)
+
+
+def test_comm_bucket_partition_and_validation():
+    """The partition is reverse parameter order (backward materializes
+    last layers' gradients first) and a negative cap is rejected."""
+    tr, _, _ = _zero_run(1, 1, bucket=1e-5)   # tiny: one grad per bucket
+    bks = tr.grad_buckets
+    n_params = len(tr._train_params)
+    assert [b for bs in bks for b in bs] == list(range(n_params - 1,
+                                                       -1, -1))
+    net = _mlp("bval_")
+    net.initialize()
+    with pytest.raises(mx.MXNetError, match="comm_bucket_mb"):
+        par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                           {"learning_rate": 0.1}, comm_bucket_mb=-1)
+    # the live setter shares the constructor's contract: a negative
+    # cap is rejected, not silently coerced to bucketing-off
+    with pytest.raises(mx.MXNetError, match="comm_bucket_mb"):
+        tr.set_comm_bucket_mb(-2)
+
+
+def test_set_comm_bucket_live_rebuild_matches():
+    """set_comm_bucket_mb on a built trainer (the CommBucketController
+    apply target) rebuilds the jitted step without touching training
+    state: continued training matches a never-rebucketed run."""
+    tr_a, _, _ = _zero_run(1, 1, steps=3)
+    tr_b, _, _ = _zero_run(1, 1, steps=3)
+    tr_b.set_comm_bucket_mb(1e-5)
+    assert tr_b.grad_buckets is not None and tr_b.num_update == 3
+    rng = np.random.RandomState(11)
+    x = rng.randn(16, 16).astype(np.float32)
+    y = rng.randint(0, 10, (16,))
+    for _ in range(2):
+        la = tr_a.step(x, y)
+        lb = tr_b.step(x, y)
+    assert abs(float(la.asnumpy()) - float(lb.asnumpy())) < 1e-5
+    # a cap move that lands on the SAME partition is free (no rebuild)
+    jit_before = tr_b._jit_step
+    tr_b.set_comm_bucket_mb(1.1e-5)       # still one grad per bucket
+    assert tr_b._jit_step is jit_before
+
+
+# -- accumulation-aware BatchNorm (the PR-10 carried follow-up) ---------------
+
+def test_accum_batchnorm_stats_sequential_per_microbatch():
+    """Pins the accumulation-aware BatchNorm semantics the README's
+    SPMD section documents: with accum_steps=N the BN aux stats update
+    SEQUENTIALLY, once per microbatch, through the scan carry —
+    equivalent to stepping the N microbatches one after another — and
+    are NOT a single update computed from the aggregate global batch
+    (nor just the last microbatch's stats: every microbatch
+    contributes through the momentum recursion)."""
+    def build(prefix):
+        np.random.seed(4)
+        mx.random.seed(4)                # identical weights in every net
+        net = nn.HybridSequential(prefix=prefix)
+        with net.name_scope():
+            net.add(nn.Dense(8, in_units=4), nn.BatchNorm(),
+                    nn.Dense(3, in_units=8))
+        net.initialize()
+        return net
+
+    def running_mean(net):
+        return [p for n, p in net.collect_params().items()
+                if n.endswith("running_mean")][0].data().asnumpy()
+
+    rng = np.random.RandomState(2)
+    # microbatches with deliberately DIFFERENT distributions (block i
+    # ~ N(i, 1)) so the three candidate semantics give far-apart stats
+    x = np.concatenate([
+        rng.randn(8, 4).astype(np.float32) + i for i in range(4)])
+    y = rng.randint(0, 3, (32,))
+    # lr=0 freezes params; only the aux stats move
+    net_a = build("bnacc_")
+    tr_a = par.ShardedTrainer(net_a, gloss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {"learning_rate": 0.0},
+                              accum_steps=4)
+    tr_a.step(x, y)
+    tr_a.sync_params()
+    rm_accum = running_mean(net_a)
+
+    net_s = build("bnseq_")
+    tr_s = par.ShardedTrainer(net_s, gloss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {"learning_rate": 0.0})
+    for i in range(4):
+        tr_s.step(x[8 * i:8 * (i + 1)], y[8 * i:8 * (i + 1)])
+    tr_s.sync_params()
+    rm_seq = running_mean(net_s)
+    np.testing.assert_allclose(rm_accum, rm_seq, rtol=1e-4, atol=1e-5)
+
+    net_g = build("bnagg_")
+    tr_g = par.ShardedTrainer(net_g, gloss.SoftmaxCrossEntropyLoss(),
+                              "sgd", {"learning_rate": 0.0})
+    tr_g.step(x, y)                      # ONE aggregate-batch update
+    tr_g.sync_params()
+    rm_agg = running_mean(net_g)
+    # the discriminator: sequential-momentum stats weight 4 updates
+    # (sum of 0.1 * 0.9^k) — far from one aggregate update's 0.1
+    assert not np.allclose(rm_accum, rm_agg, rtol=0.05, atol=1e-3)
 
 
 def test_reduce_scatter_host_local_fallback():

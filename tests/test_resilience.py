@@ -701,11 +701,17 @@ except TrainingPreempted:
 '''
 
 
-def test_coordinated_preemption_two_procs(tmp_path):
+@pytest.mark.parametrize("async_ckpt", ["0", "1"])
+def test_coordinated_preemption_two_procs(tmp_path, async_ckpt):
     """SIGTERM one of two workers: BOTH must exit preempted and commit
     the SAME `state-<t>` checkpoint — the flush step agreed over the
     coordination-service KV tier (max of the hosts' votes), not each
-    host's own next boundary (PR-1 carried follow-up)."""
+    host's own next boundary (PR-1 carried follow-up).
+
+    Parametrized over MXTPU_ASYNC_CKPT: '1' routes the vote wait
+    through the background _AsyncVoteRound (hosts keep stepping toward
+    the highest vote seen instead of parking) — the agreed-state
+    invariant must hold identically on both paths."""
     import re
     import socket
     import subprocess
@@ -730,6 +736,7 @@ def test_coordinated_preemption_two_procs(tmp_path):
             "DMLC_PS_ROOT_PORT": str(port),
             "DMLC_NUM_WORKER": "2",
             "DMLC_WORKER_ID": str(r),
+            "MXTPU_ASYNC_CKPT": async_ckpt,
         })
         procs.append(subprocess.Popen(
             [_sys.executable, str(script)], env=env,
@@ -768,6 +775,154 @@ def test_coordinated_preemption_two_procs(tmp_path):
     for r in range(2):
         assert os.path.exists(tmp_path / f"rank{r}" / name /
                               "_CHECKPOINT_METADATA")
+
+
+# -- async distributed checkpoint (MXTPU_ASYNC_CKPT) ------------------------
+
+def _host_local_trainer(seed=0):
+    mx.random.seed(seed)
+    np.random.seed(seed)
+    net = nn.HybridSequential()
+    with net.name_scope():
+        net.add(nn.Dense(16, activation="relu", in_units=8),
+                nn.Dense(4, in_units=16))
+    net.initialize()
+    tr = ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+    tr.host_local_ckpt = True        # force the npz writer in 1 process
+    return tr
+
+
+def test_async_ckpt_commit_off_step_path(tmp_path, monkeypatch):
+    """With MXTPU_ASYNC_CKPT the npz write + commit rename run on a
+    background thread: save_checkpoint returns with the write in
+    flight (inflight gauge 1, commit histogram grows only after the
+    wait), and the committed checkpoint restores a bit-identical
+    continuation — same contract as the synchronous path."""
+    from mxnet_tpu.observability.registry import registry
+    monkeypatch.setenv("MXTPU_ASYNC_CKPT", "1")
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (8,))
+    tr = _host_local_trainer()
+    for _ in range(3):
+        tr.step(x, y)
+    h = registry().histogram("ckpt.async_commit_us")
+    n0 = h.count
+    tr.save_checkpoint(str(tmp_path))
+    assert registry().gauge("resilience.ckpt_inflight").value == 1
+    tr.wait_checkpoint()
+    assert registry().gauge("resilience.ckpt_inflight").value == 0
+    assert h.count == n0 + 1
+    assert os.path.basename(
+        ShardedTrainer.latest_checkpoint(str(tmp_path))) \
+        == "state-00000003"
+    loss_a = tr.step(x, y)
+
+    tr2 = _host_local_trainer(seed=9)    # different weights: restore wins
+    tr2.step(x, y)
+    tr2.load_checkpoint(str(tmp_path))
+    assert tr2.num_update == 3
+    loss_b = tr2.step(x, y)
+    assert float(loss_a.asnumpy()) == float(loss_b.asnumpy())
+
+
+def test_async_ckpt_writer_error_surfaces_at_wait(tmp_path, monkeypatch):
+    """A failed background write must raise at the next explicit flush
+    (wait_checkpoint), not vanish with the thread — and never into the
+    training step itself."""
+    monkeypatch.setenv("MXTPU_ASYNC_CKPT", "1")
+    tr = _host_local_trainer()
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (8,))
+    tr.step(x, y)
+
+    def boom(flat, tmp, final):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(ShardedTrainer, "_write_host_local",
+                        staticmethod(boom))
+    tr.save_checkpoint(str(tmp_path))
+    tr.step(x, y)                        # the step path stays clean
+    tr.save_checkpoint(str(tmp_path))    # a periodic save after the
+    # failure drains the dead writer WITHOUT raising (the previous
+    # committed dir is intact — the step path must keep going)
+    with pytest.raises(MXNetError, match="async host-local checkpoint"):
+        tr.wait_checkpoint()             # ...the explicit flush raises
+    tr.wait_checkpoint()                 # error consumed, not sticky
+
+
+_ASYNC_TORN_WORKER = r'''
+import os, sys, time
+sys.path.insert(0, os.environ["MXNET_TEST_ROOT"])
+import numpy as np
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import nn, loss as gloss
+
+np.random.seed(0); mx.random.seed(0)
+net = nn.HybridSequential()
+with net.name_scope():
+    net.add(nn.Dense(16, activation="relu", in_units=8),
+            nn.Dense(4, in_units=16))
+net.initialize()
+tr = par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(), "sgd",
+                        {"learning_rate": 0.1, "momentum": 0.9})
+tr.host_local_ckpt = True
+x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+y = np.random.RandomState(1).randint(0, 4, (8,))
+ckpt = os.environ["CKPT_ROOT"]
+tr.step(x, y)
+tr.save_checkpoint(ckpt)               # ckpt #1, async
+tr.wait_checkpoint()                   # ...committed
+tr.step(x, y)
+# die DURING ckpt #2's background write: the npz lands in the tmp dir,
+# the commit marker and the atomic rename never happen
+real_savez = np.savez
+def dying_savez(path, **kw):
+    real_savez(path, **kw)
+    os._exit(17)
+np.savez = dying_savez
+tr.save_checkpoint(ckpt)
+time.sleep(60)                         # never reached: the writer kills us
+'''
+
+
+def test_async_ckpt_crash_mid_write_leaves_committed(tmp_path):
+    """The torn-dir filter test of the async-checkpoint acceptance: a
+    crash mid-background-write leaves ONLY an uncommitted tmp partial
+    behind; resume sees exactly the previous committed state-<t>."""
+    import subprocess
+    import sys as _sys
+    script = tmp_path / "worker.py"
+    script.write_text(_ASYNC_TORN_WORKER)
+    ckpt_root = tmp_path / "ckpt"
+    env = dict(os.environ,
+               MXNET_TEST_ROOT=os.path.dirname(
+                   os.path.dirname(os.path.abspath(__file__))),
+               CKPT_ROOT=str(ckpt_root),
+               MXTPU_ASYNC_CKPT="1",
+               JAX_PLATFORMS="cpu")
+    r = subprocess.run([_sys.executable, str(script)], env=env,
+                       capture_output=True, text=True, timeout=300)
+    assert r.returncode == 17, (r.returncode, r.stdout, r.stderr)
+    entries = sorted(os.listdir(ckpt_root))
+    assert "state-00000001" in entries
+    torn = [d for d in entries if ".mxtpu-tmp-" in d]
+    assert torn and torn[0].startswith("state-00000002"), entries
+    # the partial carries DATA but no commit marker — and the filters
+    # never serve it
+    assert os.path.exists(ckpt_root / torn[0] / "host_local.npz")
+    assert not os.path.exists(ckpt_root / torn[0] /
+                              "_CHECKPOINT_METADATA")
+    committed = ShardedTrainer.committed_checkpoints(str(ckpt_root))
+    assert [os.path.basename(p) for p in committed] == \
+        ["state-00000001"]
+    tr = _host_local_trainer()
+    x = np.random.RandomState(0).randn(8, 8).astype(np.float32)
+    y = np.random.RandomState(1).randint(0, 4, (8,))
+    tr.step(x, y)
+    tr.load_checkpoint(str(ckpt_root))
+    assert tr.num_update == 1
 
 
 # -- lint gate: no bare except under mxnet_tpu/ (satellite 6) ---------------

@@ -355,6 +355,201 @@ def test_prefetch_adopt_clamps_to_guard_rails():
         g.set(0.0)
 
 
+# -- CommBucketController (overlap: bucketed reduce-scatter) -----------------
+
+class _FakeBucketTrainer:
+    """comm_bucket_mb surface only — the controller's apply target."""
+
+    def __init__(self, mb):
+        self.comm_bucket_mb = mb
+        self.applied = []
+
+    def set_comm_bucket_mb(self, mb):
+        self.comm_bucket_mb = float(mb)
+        self.applied.append(float(mb))
+
+
+def _feed_steps(us, n=12):
+    h = registry().histogram("resilience.step_us")
+    for _ in range(n):
+        h.observe(us)
+
+
+def test_comm_bucket_controller_hill_climb_with_settle():
+    """Probe up, keep an improving direction, reverse a regression —
+    and discard the first interval after every applied move (the jit
+    REBUILD's compile rides it and would read as a regression)."""
+    from mxnet_tpu.tuning import CommBucketController
+    tr = _FakeBucketTrainer(4.0)
+    c = CommBucketController(tr, min_steps=4, settle_intervals=1,
+                             hysteresis=1, enabled=True, dry_run=False)
+    c.tick()                             # baseline the interval view
+    _feed_steps(1000.0)
+    d = c.tick()                         # first interval: probe up
+    assert d["applied"] and tr.comm_bucket_mb == 8.0
+    _feed_steps(5000.0)                  # rebuild-contaminated interval
+    assert c.tick() is None              # ...spent on the settle credit
+    _feed_steps(900.0)                   # clean + improved: keep going
+    d = c.tick()
+    assert d["applied"] and tr.comm_bucket_mb == 16.0
+    _feed_steps(4000.0)
+    assert c.tick() is None              # settle again
+    _feed_steps(1200.0)                  # regressed > tol: turn around
+    d = c.tick()
+    assert d["applied"] and tr.comm_bucket_mb == 8.0
+    _feed_steps(3000.0)
+    assert c.tick() is None
+    _feed_steps(1190.0)                  # within tol: plateau = hold
+    assert c.tick() is None
+    assert tr.applied == [8.0, 16.0, 8.0]
+
+
+def test_comm_bucket_controller_brackets_instead_of_cycling():
+    """The recompile-cost guard: when both neighbors of the optimum
+    measure worse (>tol), the naive hill-climb would cycle
+    optimum->neighbor->optimum forever — every lap a full jit
+    rebuild.  Two reversals without a NEW best score instead park the
+    controller at the best measured cap; it re-arms only when the
+    interval mean drifts well above that best (the workload shifted)."""
+    from mxnet_tpu.tuning import CommBucketController
+    tr = _FakeBucketTrainer(4.0)
+    c = CommBucketController(tr, min_steps=4, settle_intervals=0,
+                             hysteresis=1, enabled=True, dry_run=False)
+    c.tick()
+    _feed_steps(100.0)
+    d = c.tick()                         # probe up: 4 -> 8
+    assert d["applied"] and tr.comm_bucket_mb == 8.0
+    _feed_steps(115.0)                   # 8 is worse: reversal #1
+    d = c.tick()
+    assert d["applied"] and tr.comm_bucket_mb == 4.0
+    _feed_steps(100.0)                   # back at the optimum — NOT a
+    d = c.tick()                         # new best: keeps descending
+    assert d["applied"] and tr.comm_bucket_mb == 2.0
+    _feed_steps(110.0)                   # 2 is worse: reversal #2 —
+    d = c.tick()                         # bracketed; park at the best
+    assert d["applied"] and tr.comm_bucket_mb == 4.0
+    assert "bracketed" in d["reason"]
+    for _ in range(3):                   # parked: no more recompiles
+        _feed_steps(101.0)
+        assert c.tick() is None
+    assert tr.applied == [8.0, 4.0, 2.0, 4.0]
+    _feed_steps(160.0)                   # workload shift (> rearm x
+    assert c.tick() is None              # best): re-arm, re-baseline
+    _feed_steps(120.0)                   # improving again: climb resumes
+    assert c.tick() is not None
+
+
+def test_comm_bucket_controller_holds_when_bucketing_off():
+    """comm_bucket_mb=0 (overlap off) is an operator choice — the
+    controller must not silently switch bucketing on."""
+    from mxnet_tpu.tuning import CommBucketController
+    tr = _FakeBucketTrainer(0.0)
+    c = CommBucketController(tr, min_steps=4, hysteresis=1,
+                             enabled=True, dry_run=False)
+    c.tick()
+    for _ in range(3):
+        _feed_steps(1000.0)
+        assert c.tick() is None
+    assert tr.applied == []
+
+
+# -- DevicePrefetchController (overlap: device-input double buffer) ----------
+
+def _feed_device_puts(values):
+    h = registry().histogram("loader.device_put_us")
+    for v in values:
+        h.observe(v)
+
+
+def test_device_prefetch_controller_depth_vs_jitter():
+    """A heavy transfer-dispatch tail (p99 >> p50) earns a deeper
+    double buffer; uniform dispatch reclaims HBM one slot at a time.
+    The applied depth reaches loaders via the live override."""
+    from mxnet_tpu.gluon.data import dataloader as dl
+    from mxnet_tpu.tuning import DevicePrefetchController
+    c = DevicePrefetchController(initial=2, min_batches=8, hysteresis=1,
+                                 enabled=True, dry_run=False)
+    try:
+        c.tick()                         # baseline
+        _feed_device_puts([10.0] * 20 + [400.0] * 2)   # jittery
+        d = c.tick()
+        assert d["applied"] and d["to"] == 4
+        assert dl.device_prefetch_override() == 4
+        _feed_device_puts([10.0] * 20)   # uniform: shrink by one slot
+        d = c.tick()
+        assert d["applied"] and d["to"] == 3
+        assert dl.device_prefetch_override() == 3
+        _feed_device_puts([10.0] * 4)    # too little evidence: hold
+        assert c.tick() is None
+    finally:
+        dl.set_device_prefetch_override(None)
+
+
+def test_device_prefetch_controller_holds_at_zero():
+    """Depth 0 (device prefetch off) with NO live device stage is an
+    operator choice — no evidence stream may switch it on."""
+    from mxnet_tpu.gluon.data import dataloader as dl
+    from mxnet_tpu.tuning import DevicePrefetchController
+    registry().gauge("loader.device_buffer_depth").set(0.0)
+    c = DevicePrefetchController(initial=0, min_batches=4, hysteresis=1,
+                                 enabled=True, dry_run=False)
+    c.tick()
+    _feed_device_puts([10.0] * 10 + [500.0] * 2)
+    assert c.tick() is None
+    assert dl.device_prefetch_override() is None
+    assert c.current() == 0
+
+
+def test_device_prefetch_controller_adopts_constructor_loader():
+    """A loader whose device stage was enabled via its CONSTRUCTOR
+    (env knob 0, so the controller's target starts at 0) is adopted
+    as the baseline from the live buffer-depth gauge — then tuned."""
+    from mxnet_tpu.gluon.data import dataloader as dl
+    from mxnet_tpu.tuning import DevicePrefetchController
+    g = registry().gauge("loader.device_buffer_depth")
+    c = DevicePrefetchController(initial=0, min_batches=8, hysteresis=1,
+                                 enabled=True, dry_run=False)
+    try:
+        c.tick()
+        g.set(3.0)                       # DataLoader(device_prefetch=3)
+        _feed_device_puts([10.0] * 10)
+        assert c.tick() is None          # adopt, don't apply
+        assert c.current() == 3 and dl.device_prefetch_override() is None
+        _feed_device_puts([10.0] * 20 + [400.0] * 2)   # jittery: tune
+        d = c.tick()
+        assert d["applied"] and d["to"] == 6
+        assert dl.device_prefetch_override() == 6
+    finally:
+        dl.set_device_prefetch_override(None)
+        g.set(0.0)
+
+
+def test_dataloader_honors_device_prefetch_override():
+    """set_device_prefetch_override is picked up at the next __iter__
+    (the satellite's acceptance): the placement fn starts running and
+    batch order/values stay exact."""
+    from mxnet_tpu.gluon.data import dataloader as dl
+    data = [np.full((3,), i, np.float32) for i in range(16)]
+    calls = []
+
+    def counting_put(batch):
+        calls.append(1)
+        return batch
+
+    loader = dl.DataLoader(data, batch_size=4, num_workers=2,
+                           device_put_fn=counting_put)
+    try:
+        assert len(list(loader)) == 4 and not calls   # depth 0: fn idle
+        dl.set_device_prefetch_override(3)
+        batches = [b.asnumpy() for b in loader]       # next __iter__
+        assert len(batches) == 4 and len(calls) == 4
+        assert batches[0][0][0] == 0.0 and batches[3][3][0] == 15.0
+        snap = registry().snapshot()
+        assert snap.get("loader.device_put_us", {}).get("count", 0) >= 4
+    finally:
+        dl.set_device_prefetch_override(None)
+
+
 def test_dataloader_honors_live_prefetch_override():
     from mxnet_tpu.gluon.data import dataloader as dl
     data = [np.full((3,), i, np.float32) for i in range(16)]
@@ -472,10 +667,14 @@ def test_runtime_contains_controller_failures():
     assert registry().counter("tuning.errors").n == errs0 + 2
 
 
-def test_standard_controllers_cover_all_four():
+def test_standard_controllers_cover_stock_set():
     cs = tuning.standard_controllers()
     assert [c.name for c in cs] == ["bulk_size", "prefetch",
-                                    "batch_window", "fleet_gather"]
+                                    "batch_window", "fleet_gather",
+                                    "device_prefetch"]
+    # CommBucketController stays out of the stock set by design: it
+    # needs a live trainer whose jit its apply rebuilds
+    assert "comm_bucket" not in [c.name for c in cs]
 
 
 # -- flight-recorder tuning ring --------------------------------------------
