@@ -207,6 +207,7 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
     overhead = _metrics_overhead_pct(per_op_us,
                                      stats["mean_segment_length"] or 15)
     snapshot_us, flight_record_us = _observability_costs()
+    trace_span_off_us, trace_span_us = _tracing_costs()
     return {"ops_per_sec_bulk": round(results["bulk"], 1),
             "ops_per_sec_bulk_aggressive": round(
                 results["bulk_aggressive"], 1),
@@ -233,6 +234,12 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
             # per-step record
             "snapshot_us": snapshot_us,
             "flight_record_us": flight_record_us,
+            # causal tracing: the instrumented-call-site probe with
+            # tracing OFF (a memoized env dict hit — the always-paid
+            # cost) and one fully-sampled begin+finish span (the
+            # 1-in-N cost)
+            "trace_span_off_us": trace_span_off_us,
+            "trace_span_us": trace_span_us,
             "host_cores": _host_cores()}
 
 
@@ -294,6 +301,53 @@ def _observability_costs(reps=2_000):
         fr.record(**rec)
     flight_record_us = (time.perf_counter() - t0) / reps * 1e6
     return round(snapshot_us, 2), round(flight_record_us, 3)
+
+
+def _tracing_costs(reps=20_000):
+    """Measured cost of the causal-tracing seam: the OFF path (what
+    every instrumented call site pays when ``MXTPU_TRACE`` is unset —
+    one memoized env probe returning None) and one fully sampled
+    begin+finish span (ids, clocks, ring append).  Probe instance, not
+    the process tracer — bench spans must not pollute the live ring."""
+    from mxnet_tpu.observability.registry import registry as _reg
+    from mxnet_tpu.observability.tracing import Tracer
+    # jsonl="" pins the stream OFF: the probe instance must not resolve
+    # an operator's MXTPU_TRACE_JSONL and flush 2k bench spans into the
+    # production trace file
+    t = Tracer(ring=1024, jsonl="")
+    # the tracer's tracing.* counters are get-or-create on the shared
+    # registry: snapshot and restore them so ~22k probe begin/finishes
+    # don't inflate the live series (bench.py is a standalone tool — no
+    # concurrent traced workload runs in this process, which also makes
+    # the MXTPU_TRACE flip below safe)
+    probe_counters = [_reg().counter(n) for n in
+                      ("tracing.spans_recorded", "tracing.roots_sampled",
+                       "tracing.roots_unsampled")]
+    saved_ns = [c.n for c in probe_counters]
+    # pin BOTH knobs: an ambient MXTPU_TRACE_SAMPLE > 1 would make the
+    # ON loop's root begins return None
+    prev = {k: os.environ.pop(k, None)
+            for k in ("MXTPU_TRACE", "MXTPU_TRACE_SAMPLE")}
+    try:
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            t.begin("bench.trace_probe")
+        off_us = (time.perf_counter() - t0) / reps * 1e6
+        os.environ["MXTPU_TRACE"] = "1"
+        t0 = time.perf_counter()
+        for _ in range(reps // 10):
+            sp = t.begin("bench.trace_probe", activate=False)
+            sp.finish()
+        on_us = (time.perf_counter() - t0) / (reps // 10) * 1e6
+    finally:
+        for k, v in prev.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        for c, n in zip(probe_counters, saved_ns):
+            c.n = n
+    return round(off_us, 3), round(on_us, 2)
 
 
 def bench_bert_base(iters=10, warmup=3, batch=8, seq=256,

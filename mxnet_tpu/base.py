@@ -398,6 +398,33 @@ register_env("MXTPU_TUNE_COMM_BUCKET", True, bool,
              "resilience.step_us interval mean) when one is "
              "constructed with a trainer.  Not in the stock runtime "
              "set — it needs a live trainer reference.")
+register_env("MXTPU_TRACE", False, bool,
+             "Causal tracing: record request/step span trees with "
+             "W3C-style trace/span ids (observability.tracing), "
+             "propagate contexts through serving batches, training "
+             "steps, and the coordination-service KV tier, and attach "
+             "trace-id exemplars to every histogram bucket.  Off (the "
+             "default) = the instrumented paths pay one memoized env "
+             "probe and nothing else.")
+register_env("MXTPU_TRACE_SAMPLE", 1, int,
+             "Causal tracing head sampling: start a new ROOT trace for "
+             "1 in N sampling decisions (1 = trace every root; "
+             "children of a sampled trace are always recorded, so "
+             "traces stay whole).  Fleet-lockstep roots (training "
+             "steps) sample deterministically on the step index, so "
+             "every host keeps or drops the same step.")
+register_env("MXTPU_TRACE_RING", 2048, int,
+             "Causal tracing: bounded ring capacity of completed spans "
+             "kept in memory for exemplar resolution, chrome-trace "
+             "export, and crash dumps (resolved when tracing first "
+             "switches on).")
+register_env("MXTPU_TRACE_JSONL", "", str,
+             "Causal tracing: append completed spans to this JSONL "
+             "path (size-rotated, buffered ~64 spans per write; one "
+             "file per host — concatenate hosts' files and feed "
+             "tracing.chrome_trace_from_spans for a cross-host "
+             "timeline).  Unset disables the stream; the in-memory "
+             "ring always records.")
 register_env("MXTPU_TUNE_DEVICE_PREFETCH", True, bool,
              "Self-tuning: enable the DevicePrefetchController "
              "(adapts the DataLoader device-prefetch depth from the "

@@ -229,6 +229,17 @@ class DataLoader:
                  "PrefetchController's evidence that an applied target "
                  "is actually live (overrides apply at epoch "
                  "boundaries)")
+        self._h_wait = reg.histogram(
+            "loader.consume_wait_us",
+            help="time the CONSUMER blocked waiting for the next batch "
+                 "(the loader-bound share of the step interval; the "
+                 "step-trace 'loader' critical-path segment)")
+        # pending per-batch attribution the resilience supervisor drains
+        # (consume_trace): the loader wait happens BETWEEN steps, so the
+        # step trace adopts it retroactively
+        self._trace_wait_us = 0.0
+        self._trace_wait_end = 0.0
+        self._trace_devput_us = 0.0
         self._h_device_put = reg.histogram(
             "loader.device_put_us",
             help="device-prefetch stage: time to DISPATCH one batch's "
@@ -406,13 +417,38 @@ class DataLoader:
         # the position cursor counts batches HANDED TO the consumer —
         # bumped here, at the outermost yield, so device-stage batches
         # still in the buffer (transferred but never trained) are not
-        # counted and a checkpoint resume replays them
+        # counted and a checkpoint resume replays them.  The span around
+        # each pull is the CONSUMER's wait (how long the training loop
+        # starved on input) — recorded as a histogram and banked for the
+        # next step's causal trace / critical-path breakdown.
         try:
-            for batch in src:
+            while True:
+                with _span("loader.consume_wait_us") as sp:
+                    try:
+                        batch = next(src)
+                    except StopIteration:
+                        break
+                self._trace_wait_us += sp.duration_us
+                self._trace_wait_end = sp.t_end
                 self._cursor_batch += 1
                 yield batch
         finally:
             src.close()
+
+    def consume_trace(self) -> dict:
+        """Drain the pending consumer-wait attribution accumulated
+        since the last call: ``wait_us`` (time the consumer blocked in
+        the loader), ``wait_end`` (``tracing.now()`` timestamp of the
+        last wait's end — where a retroactive trace span anchors) and
+        ``device_put_us`` (device-prefetch dispatch time nested inside
+        that wait).  The ResilientTrainer drains this at each step to
+        attribute loader time into the step trace and breakdown."""
+        out = {"wait_us": self._trace_wait_us,
+               "wait_end": self._trace_wait_end,
+               "device_put_us": self._trace_devput_us}
+        self._trace_wait_us = 0.0
+        self._trace_devput_us = 0.0
+        return out
 
     def _device_stage(self, src, depth: int):
         """Device double buffering: dispatch each pulled host batch to
@@ -430,8 +466,9 @@ class DataLoader:
             for item in src:
                 # span, not a bare clock pair: the put-dispatch cost
                 # rides the unified trace timeline too
-                with _span("loader.device_put_us"):
+                with _span("loader.device_put_us") as dsp:
                     buf.append(put(item))
+                self._trace_devput_us += dsp.duration_us
                 if len(buf) > depth:
                     self._g_device_depth.set(len(buf) - 1)
                     yield buf.popleft()

@@ -40,7 +40,7 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        registry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
-           "trace", "export", "span", "flight"]
+           "trace", "export", "span", "flight", "tracing"]
 
 
 def __getattr__(name):
@@ -50,7 +50,7 @@ def __getattr__(name):
     if name in ("trace", "span"):
         mod = importlib.import_module(".trace", __name__)
         return mod if name == "trace" else mod.span
-    if name in ("export", "flight"):
+    if name in ("export", "flight", "tracing"):
         return importlib.import_module("." + name, __name__)
     raise AttributeError(
         f"module 'mxnet_tpu.observability' has no attribute {name!r}")

@@ -73,11 +73,19 @@ def _help_line(pname: str, help_text: str) -> Optional[str]:
     return f"# HELP {pname} {escaped}"
 
 
-def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
+def prometheus_text(reg: Optional[MetricsRegistry] = None,
+                    exemplars: bool = False) -> str:
     """The registry in Prometheus text exposition format (version 0.0.4):
     counters/gauges as single samples, histograms as cumulative
     ``_bucket{le=...}`` series plus ``_sum``/``_count``; ``# HELP``
-    lines for metrics registered with a description."""
+    lines for metrics registered with a description.
+
+    ``exemplars=True`` appends OpenMetrics exemplar suffixes to bucket
+    lines — only legal in OpenMetrics-shaped output (the endpoint's
+    explicit ``/metrics?exemplars=1`` opt-in, which it serves under the
+    ``application/openmetrics-text`` content type with the ``# EOF``
+    terminator); the classic 0.0.4 parser rejects a whole scrape
+    containing them, so the default text format never carries any."""
     reg = reg if reg is not None else registry()
     lines = []
     for name in reg.names():
@@ -96,9 +104,20 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None) -> str:
             lines.append(f"{pname} {_fmt(m.value)}")
         elif isinstance(m, Histogram):
             lines.append(f"# TYPE {pname} histogram")
+            # OpenMetrics exemplars (negotiated scrapes only): a bucket
+            # line carries the newest trace_id observed into it —
+            # `# {trace_id="..."} <value> <ts>` — so the p99 bucket in
+            # a dashboard resolves to a real trace in the span ring.
+            # Empty when tracing is off.
+            ex = m.exemplars() if exemplars else {}
             for bound, cum in m.cumulative_buckets():
-                lines.append(
-                    f'{pname}_bucket{{le="{_fmt(bound)}"}} {cum}')
+                line = f'{pname}_bucket{{le="{_fmt(bound)}"}} {cum}'
+                e = ex.get(bound)
+                if e:
+                    tid, val, ts = e[-1]
+                    line += (f' # {{trace_id="{tid}"}} {_fmt(val)} '
+                             f'{ts}')
+                lines.append(line)
             lines.append(f"{pname}_sum {_fmt(m.total)}")
             lines.append(f"{pname}_count {m.count}")
     return "\n".join(lines) + "\n"
@@ -149,12 +168,29 @@ class _Handler(BaseHTTPRequestHandler):
     server_version = "mxtpu-metrics"
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        if self.path.split("?")[0] == "/metrics":
-            text = prometheus_text_aggregate() if aggregate_mode() \
-                else prometheus_text()
+        path, _, query = self.path.partition("?")
+        if path == "/metrics":
+            # exemplar suffixes are legal only in OpenMetrics-shaped
+            # output — a 0.0.4 scraper receiving them rejects the
+            # ENTIRE scrape — so they are an explicit opt-in
+            # (`/metrics?exemplars=1`), never the default exposition
+            exemplars = "exemplars=1" in query.split("&")
+            if aggregate_mode():
+                text = prometheus_text_aggregate()
+                exemplars = False   # the fleet view carries none
+            else:
+                text = prometheus_text(exemplars=exemplars)
+            if exemplars:
+                # exemplar suffixes are OpenMetrics syntax: label the
+                # body so a parser that routes on Content-Type picks
+                # the right grammar (EOF terminator required)
+                text += "# EOF\n"
+                ctype = ("application/openmetrics-text; "
+                         "version=1.0.0; charset=utf-8")
+            else:
+                ctype = "text/plain; version=0.0.4; charset=utf-8"
             body = text.encode()
-            ctype = "text/plain; version=0.0.4; charset=utf-8"
-        elif self.path.split("?")[0] == "/metrics.json":
+        elif path == "/metrics.json":
             body = json.dumps(registry().snapshot(), sort_keys=True,
                               indent=1).encode()
             ctype = "application/json"

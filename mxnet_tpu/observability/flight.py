@@ -225,6 +225,16 @@ class FlightRecorder:
             snapshot = registry().snapshot()
         except Exception:   # noqa: BLE001 — a half-torn registry still
             snapshot = {}   # leaves the step ring worth dumping
+        # causal cross-reference: step/request records carry trace_id
+        # fields — ship the tracer's completed-span ring alongside so a
+        # crash dump resolves those ids without hunting for the JSONL
+        # stream.  Best-effort; an empty ring costs one key.
+        trace_spans: List[dict] = []
+        try:
+            from . import tracing as _tracing
+            trace_spans = _tracing.tracer().spans()
+        except Exception:   # noqa: BLE001 — tracing must never block
+            pass            # the dump
         payload = {
             "reason": reason,
             "ts": round(time.time(), 3),
@@ -239,6 +249,8 @@ class FlightRecorder:
             "tuning": tunings,
             "n_membership": len(memberships),
             "membership": memberships,
+            "n_trace_spans": len(trace_spans),
+            "trace_spans": trace_spans,
             "snapshot": snapshot,
         }
         try:

@@ -30,8 +30,9 @@ from __future__ import annotations
 import json
 import re
 import threading
+import time as _time
 from bisect import bisect_left
-from typing import Dict, List, Optional, Tuple, Union
+from typing import Callable, Dict, List, Optional, Tuple, Union
 
 from ..base import MXNetError
 
@@ -39,7 +40,29 @@ __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
            "host_id", "gather_host_states", "last_host_states",
            "ingest_host_states", "merge_host_states",
            "group_host_entries", "state_bounds",
-           "state_cumulative_buckets"]
+           "state_cumulative_buckets", "set_exemplar_trace_hook"]
+
+# -- histogram exemplars (causal tracing) ------------------------------------
+#
+# When the tracing layer is live it installs a hook returning the ACTIVE
+# trace_id (or None); every Histogram.observe then records that id into
+# the observed bucket (last-EXEMPLAR_K per bucket), so a histogram's p99
+# bucket points at real traces instead of an anonymous count.  With no
+# hook installed (tracing never imported/enabled) observe pays exactly
+# one module-global read over its pre-exemplar cost.
+
+EXEMPLAR_K = 4
+
+_exemplar_trace_hook: Optional[Callable[[], Optional[str]]] = None
+
+
+def set_exemplar_trace_hook(fn: Optional[Callable[[], Optional[str]]]
+                            ) -> None:
+    """Install (or clear, with None) the active-trace-id provider the
+    tracing layer exposes — :func:`mxnet_tpu.observability.tracing.
+    tracer` is the only sanctioned caller."""
+    global _exemplar_trace_hook
+    _exemplar_trace_hook = fn
 
 # namespaced dotted names: `engine.ops_dispatched`, `loader.batches`, ...
 _NAME_RE = re.compile(r"^[a-z_][a-z0-9_]*(\.[a-z0-9_]+)+$")
@@ -151,7 +174,7 @@ class Histogram:
     """
 
     __slots__ = ("name", "base", "growth", "bounds", "counts", "count",
-                 "total", "vmin", "vmax", "help", "_lock")
+                 "total", "vmin", "vmax", "help", "_lock", "_ex")
     kind = "histogram"
 
     def __init__(self, name: str, base: float = 1.0,
@@ -173,9 +196,14 @@ class Histogram:
         self.vmax: Optional[float] = None
         self.help = help
         self._lock = threading.Lock()
+        # bucket index -> [(trace_id, value, wall_ts), ...] last-K, only
+        # ever populated while the tracing exemplar hook is installed
+        self._ex: Optional[Dict[int, list]] = None
 
-    def observe(self, value: float) -> None:
+    def observe(self, value: float,
+                trace_id: Optional[str] = None) -> None:
         i = bisect_left(self.bounds, value)
+        hook = _exemplar_trace_hook
         with self._lock:
             self.counts[i] += 1
             self.count += 1
@@ -184,6 +212,31 @@ class Histogram:
                 self.vmin = value
             if self.vmax is None or value > self.vmax:
                 self.vmax = value
+            if hook is not None:
+                tid = trace_id if trace_id is not None else hook()
+                if tid:
+                    ex = self._ex
+                    if ex is None:
+                        ex = self._ex = {}
+                    lst = ex.get(i)
+                    if lst is None:
+                        ex[i] = lst = []
+                    lst.append((tid, value, round(_time.time(), 3)))
+                    if len(lst) > EXEMPLAR_K:
+                        lst.pop(0)
+
+    def exemplars(self) -> Dict[float, list]:
+        """Recorded exemplars keyed by bucket UPPER BOUND (``inf`` for
+        the overflow bucket): ``{bound: [(trace_id, value, ts), ...]}``
+        newest last.  The resolution path for a tail outlier: p99 bucket
+        → trace_id → the span ring
+        (:meth:`~mxnet_tpu.observability.tracing.Tracer.find`)."""
+        with self._lock:
+            if not self._ex:
+                return {}
+            n = len(self.bounds)
+            return {(self.bounds[i] if i < n else float("inf")): list(lst)
+                    for i, lst in self._ex.items()}
 
     def percentile(self, q: float) -> float:
         """Approximate q-th percentile (q in [0, 100]) from the buckets.
@@ -203,6 +256,7 @@ class Histogram:
             self.total = 0.0
             self.vmin = None
             self.vmax = None
+            self._ex = None
 
     def read(self) -> dict:
         """Aggregate view (the snapshot() value for histograms)."""

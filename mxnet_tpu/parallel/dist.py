@@ -455,6 +455,11 @@ def _allgather_bytes_kv(data: bytes, timeout: float):
     the survivors only, indexed by logical rank."""
     import base64
     from jax._src import distributed
+
+    # the KV gather is a blocking fleet-wide wait: measured as a span so
+    # it lands in the histogram AND — inside a traced region (a step or
+    # re-form trace) — as a child span attributing collective time
+    from ..observability.trace import span as _span
     global _agb_gen
     client = distributed.global_state.client
     me = phys_rank()
@@ -469,10 +474,11 @@ def _allgather_bytes_kv(data: bytes, timeout: float):
     timeout_ms = max(1000, int(timeout * 1000))
     client.key_value_set(f"{key}/{me}",
                          base64.b64encode(data).decode("ascii"))
-    out = [base64.b64decode(_deadline_wait(
-        f"allgather_bytes gen {gen}: waiting for rank {i}", timeout,
-        client.blocking_key_value_get, f"{key}/{i}", timeout_ms))
-        for i in members]
+    with _span("dist.allgather_kv_us", args={"gen": gen}):
+        out = [base64.b64decode(_deadline_wait(
+            f"allgather_bytes gen {gen}: waiting for rank {i}", timeout,
+            client.blocking_key_value_get, f"{key}/{i}", timeout_ms))
+            for i in members]
     try:
         # only safe to delete our key once EVERY rank has read it
         client.wait_at_barrier(
@@ -673,11 +679,16 @@ def _barrier_kv(name: str, timeout: Optional[float] = None) -> None:
         timeout = float(get_env("MXTPU_DIST_TIMEOUT"))
     timeout_ms = max(1000, int(timeout * 1000))
     members = active_members()
-    _deadline_wait(
-        f"barrier '{name}' gen {gen} over ranks {list(members)}",
-        timeout, distributed.global_state.client.wait_at_barrier,
-        f"mxtpu_barrier_{fence_generation()}_{name}_{gen}", timeout_ms,
-        _barrier_ids(members))
+    # span: the barrier wait is collective time on the step/re-form
+    # critical path — histogram always, trace child inside a traced
+    # region
+    from ..observability.trace import span as _span
+    with _span("dist.barrier_kv_us", args={"name": name, "gen": gen}):
+        _deadline_wait(
+            f"barrier '{name}' gen {gen} over ranks {list(members)}",
+            timeout, distributed.global_state.client.wait_at_barrier,
+            f"mxtpu_barrier_{fence_generation()}_{name}_{gen}",
+            timeout_ms, _barrier_ids(members))
 
 
 def barrier(name: str = "mxnet_tpu_barrier",

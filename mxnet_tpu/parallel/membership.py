@@ -62,6 +62,7 @@ from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 from ..base import MXNetError, get_env
 from ..faults import Deadline, DeadlineExceeded
+from ..observability import tracing as _tracing
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry as _metrics_registry
 from . import dist
@@ -593,8 +594,26 @@ class MembershipManager:
             old_members, fence = self._members, self._fence
         fence_next = fence + 1
         base = f"{MEMBER_PREFIX}/reform/{fence_next}"
+        # causal tracing: every survivor opens a (never-sampled-away)
+        # re-form span and ships its traceparent on a SIDE key next to
+        # its posted view (the consensus payloads stay byte-identical
+        # to the pre-tracing protocol); once the views are in, everyone
+        # re-parents onto the lowest-rank poster's context — a 2-proc
+        # re-form stitches into ONE trace through the KV tier, no
+        # matter who opened it
+        trc = _tracing.tracer()
+        tspan = None if not trc.enabled else trc.begin(
+            "membership.reform",
+            trace_id=_tracing.gen_trace_id(),
+            args={"fence": fence_next, "rank": me})
         try:
-            views = self._exchange_views(base, deadline)
+            views, view_tps = self._exchange_views(base, deadline,
+                                                   tspan)
+            if tspan is not None and view_tps:
+                low = min(view_tps)
+                if low < me:
+                    tspan.adopt(
+                        _tracing.parse_traceparent(view_tps[low]))
             plan = self._plan_round(base, views, fence_next, deadline)
             members = tuple(sorted(int(m) for m in plan["members"]))
             timeline.append(("plan", round(time.time(), 3)))
@@ -607,10 +626,18 @@ class MembershipManager:
                 self.raise_if_fenced()
             self._commit_round(base, members, fence_next, deadline)
         except DeadlineExceeded as exc:
+            if tspan is not None:
+                tspan.annotate(error="DeadlineExceeded")
+                tspan.finish()
             raise FleetLost(
                 f"fleet re-form at generation {fence_next} did not "
                 f"complete within {self.reform_timeout:.0f}s "
                 f"(MXTPU_ELASTIC_REFORM_TIMEOUT): {exc}") from exc
+        except Exception:
+            if tspan is not None:
+                tspan.annotate(error="reform-failed")
+                tspan.finish()
+            raise
         dead = tuple(sorted(set(old_members) - set(members)))
         # install: the narrowed group is live from here on this host
         dist.set_active_members(members, fence_next)
@@ -637,10 +664,17 @@ class MembershipManager:
                 f"mxtpu_reform_{fence_next}",
                 max(1000, int(timeout * 1000)), list(members))
         except DeadlineExceeded as exc:
+            if tspan is not None:
+                tspan.annotate(error="rejoin-barrier-timeout")
+                tspan.finish()
             raise FleetLost(
                 f"a survivor never reached the rejoin barrier at "
                 f"generation {fence_next}: {exc}") from exc
         timeline.append(("reformed", round(time.time(), 3)))
+        if tspan is not None:
+            tspan.annotate(members=",".join(str(m) for m in members),
+                           dead=",".join(str(d) for d in dead))
+            tspan.finish()
         # the original world's shutdown barrier can never complete again
         # — every survivor must detach dirty at exit (see _hard_exit)
         _install_dirty_exit()
@@ -659,15 +693,29 @@ class MembershipManager:
             new_world=len(members), timeline=tuple(timeline))
 
     # -- round phases -------------------------------------------------------
-    def _exchange_views(self, base: str, deadline: Deadline):
+    def _exchange_views(self, base: str, deadline: Deadline,
+                        tspan=None):
         """Phase 1: post this host's view, gather every view it is
-        waiting on, dropping ranks that die mid-round."""
+        waiting on, dropping ranks that die mid-round.
+
+        The view payload stays the bare member list every fleet
+        version parses; the causal-tracing traceparent rides a SIDE
+        key (``{base}/viewtp/{rank}``) on the same KV tier, so tracing
+        can never perturb the consensus and a tp-less (older or
+        tracing-off) host simply stitches nothing.  Returns
+        ``(views, view_tps)``."""
         me = self._phys
         self.scan()   # freshest possible verdict before voting
         with self._lock:
             view = sorted((set(self._members) - self._suspects) | {me})
+        if tspan is not None:
+            try:
+                _kv_set(f"{base}/viewtp/{me}", tspan.traceparent)
+            except Exception:   # noqa: BLE001 — tracing is
+                pass            # best-effort; the round decides
         _kv_set(f"{base}/view/{me}", json.dumps(view))
         views: Dict[int, List[int]] = {}
+        view_tps: Dict[int, str] = {}
         while True:
             deadline.check("re-form view exchange")
             try:
@@ -683,7 +731,13 @@ class MembershipManager:
                 except ValueError:
                     continue
             if all(r in views for r in view):
-                return {r: v for r, v in views.items() if r in view}
+                if tspan is not None:
+                    try:
+                        view_tps = _dir_by_rank(f"{base}/viewtp")
+                    except Exception:   # noqa: BLE001 — tracing is
+                        view_tps = {}   # best-effort
+                return ({r: v for r, v in views.items() if r in view},
+                        view_tps)
             # a rank in our view may die while we wait: re-scan, shrink
             # the view, re-post so peers stop waiting on our old vote
             self.scan()

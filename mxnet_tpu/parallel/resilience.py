@@ -57,11 +57,22 @@ from typing import Optional, Tuple, Type
 from ..base import MXNetError, get_env, hot_path
 from ..faults import (DeadlineExceeded, FaultPlan, TransientFault,
                       active_plan, retry_call)
+from ..observability import tracing as _tracing
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry as _metrics_registry
 from ..observability.trace import span as _span
 from .membership import FleetReformed, HostFenced, MembershipManager
 from .trainer import ShardedTrainer
+
+#: critical-path stages of one supervised step interval, in the order
+#: the `step.breakdown.bottleneck` gauge indexes them
+BREAKDOWN_STAGES = ("compute", "loader", "device_prefetch", "collective",
+                    "ckpt", "other")
+
+# per-process nonce keying single-process step trace ids (a multi-proc
+# group keys on the fleet-shared fencing generation instead, so every
+# host's step-N spans land in ONE deterministic trace)
+_RUN_NONCE = os.urandom(8).hex()
 
 __all__ = ["ResilientTrainer", "TrainingPreempted", "FleetReformed",
            "HostFenced"]
@@ -163,8 +174,24 @@ class _InstanceCounters:
         return dict(self._local)
 
 
+def _collect_vote_tps(prefix: str):
+    """Peers' traceparents for one vote round, from the SIDE namespace
+    (``<prefix>_tp``) — the vote payload itself stays the bare ascii
+    int every fleet version parses, so tracing can never split the
+    agreed flush step; a host that publishes no tp simply stitches
+    nothing."""
+    from . import dist
+    out = {}
+    try:
+        for r, v in dist.kv_collect(f"{prefix}_tp").items():
+            out[int(r)] = v.decode("ascii", "replace")
+    except Exception:   # noqa: BLE001 — tracing is best-effort on a
+        pass            # possibly-degrading fabric
+    return out
+
+
 def _run_vote_round(prefix: str, own_vote: int, members, timeout: float,
-                    poll: float, on_votes=None) -> int:
+                    poll: float, on_votes=None, trace_parent=None) -> int:
     """THE coordinated-preemption vote protocol — one implementation
     shared by the blocking path (:meth:`ResilientTrainer.
     _coordinate_flush_step` calls it inline) and the async path
@@ -179,15 +206,49 @@ def _run_vote_round(prefix: str, own_vote: int, members, timeout: float,
     exactly the degraded fabric a preemption often rides in on) or the
     ``timeout`` deadline passes with members missing.  ``on_votes``
     observes every successful collect (the async round's known_max
-    feed)."""
+    feed).
+
+    Causal tracing: this host's traceparent rides a SIDE key
+    (``<prefix>_tp`` — the vote payload itself stays the bare ascii int
+    every fleet version parses, so tracing can never perturb the
+    protocol), and the fleet's rounds stitch — the round's span parents
+    on ``trace_parent`` (the initiating step's trace; the async path
+    captures it before hopping threads), or adopts the lowest-rank
+    voter's traceparent when this host joined a PEER's round."""
     from . import dist
+    tr = _tracing.tracer()
+    sp = None
+    t0 = _tracing.now()
+    if tr.enabled:
+        if trace_parent is None:
+            trace_parent = _tracing.current()
+        if trace_parent is not None:
+            sp = tr.begin("resilience.vote_round", parent=trace_parent,
+                          activate=False, t0=t0,
+                          args={"vote": own_vote})
+
+    def _finish(agreed: int) -> int:
+        if sp is not None:
+            sp.annotate(agreed=agreed)
+            sp.finish()
+        return agreed
+
+    if sp is not None:
+        try:
+            dist.kv_publish(f"{prefix}_tp",
+                            sp.traceparent.encode("ascii"))
+        except Exception:   # noqa: BLE001 — tracing is best-effort;
+            pass            # the vote below decides what matters
     try:
         dist.kv_publish(prefix, str(own_vote).encode("ascii"))
     except Exception:   # noqa: BLE001 — degrade, never lose the
-        return own_vote  # preemption checkpoint
+        return _finish(own_vote)  # preemption checkpoint
     members = set(members)
     deadline = time.monotonic() + float(timeout)
     poll = max(0.005, float(poll))
+    tp_probes = 3   # bounded: no peer publishing a tp (e.g. the step
+    # was unsampled fleet-wide) must not cost an extra KV dir-get on
+    # EVERY poll of a round riding an already-degrading fabric
     while True:
         votes = {}
         try:
@@ -195,6 +256,18 @@ def _run_vote_round(prefix: str, own_vote: int, members, timeout: float,
                 votes[int(r)] = int(v.decode("ascii"))
         except Exception:   # noqa: BLE001 — transient KV failure:
             votes = {}      # retry until the deadline
+        if sp is None and tr.enabled and tp_probes > 0:
+            # joined a peer-initiated round with no trace of our own:
+            # adopt the lowest-rank voter's context so the whole
+            # fleet's round lands in ONE trace
+            tp_probes -= 1
+            tps = _collect_vote_tps(prefix)
+            if tps:
+                ctx = _tracing.parse_traceparent(tps[min(tps)])
+                if ctx is not None:
+                    sp = tr.begin("resilience.vote_round", parent=ctx,
+                                  activate=False, t0=t0,
+                                  args={"vote": own_vote})
         if on_votes is not None and votes:
             on_votes(votes)
         if members <= set(votes):
@@ -202,9 +275,9 @@ def _run_vote_round(prefix: str, own_vote: int, members, timeout: float,
                 "resilience.preempt_coordinated",
                 help="preemption rounds that agreed a fleet-wide "
                      "flush step over the KV tier").inc()
-            return max(votes[r] for r in members)
+            return _finish(max(votes[r] for r in members))
         if time.monotonic() > deadline:
-            return own_vote
+            return _finish(own_vote)
         time.sleep(poll)
 
 
@@ -230,13 +303,18 @@ class _AsyncVoteRound:
         self.agreed: Optional[int] = None
         self.resolved = threading.Event()
         self._poll = max(0.005, float(poll))
+        # the contextvar does not cross the voter-thread hop: capture
+        # the initiating step's trace context HERE (construction runs
+        # on the stepping thread) so the round's span joins its trace
+        parent = _tracing.current()
 
         def run():
             self.agreed = _run_vote_round(
                 prefix, self.own_vote, members, timeout, self._poll,
                 on_votes=lambda votes: setattr(
                     self, "known_max",
-                    max(self.known_max, max(votes.values()))))
+                    max(self.known_max, max(votes.values()))),
+                trace_parent=parent)
             self.resolved.set()
 
         self._thread = threading.Thread(
@@ -379,6 +457,33 @@ class ResilientTrainer:
         self.attach_loader(loader)
         self._g_ckpt_inflight = reg.gauge("resilience.ckpt_inflight")
         self._vote_round: Optional[_AsyncVoteRound] = None
+        # causal tracing + critical-path attribution: the step ROOT
+        # span covers boundary-to-boundary wall time (previous step's
+        # exit to this step's exit — the interval a training loop
+        # actually experiences, loader wait included), decomposed into
+        # the child-span segments below.  `resilience.step_us` keeps
+        # its body-only semantics (the CommBucketController's signal).
+        self._boundary_pc: Optional[float] = None
+        self._h_step_wall = reg.histogram(
+            "resilience.step_wall_us",
+            help="boundary-to-boundary supervised-step wall time "
+                 "(loader wait + step body + checkpoint/collective "
+                 "work); carries trace-id exemplars when causal "
+                 "tracing is on — the p99 bucket points at real step "
+                 "traces")
+        self._g_breakdown = {
+            s: reg.gauge(
+                f"step.breakdown.{s}_us",
+                help=f"last step's '{s}' share of the "
+                     f"boundary-to-boundary wall time (critical-path "
+                     f"attribution)")
+            for s in BREAKDOWN_STAGES}
+        self._g_bottleneck = reg.gauge(
+            "step.breakdown.bottleneck",
+            help="dominant stage of the last step's wall time, as an "
+                 "index into (compute, loader, device_prefetch, "
+                 "collective, ckpt, other) — the one-number answer to "
+                 "'why is this step slow'")
         # interpreter-exit fallback: an in-flight async write must commit
         # even if the loop never reaches another step boundary
         _register_exit_flush(trainer)
@@ -651,6 +756,20 @@ class ResilientTrainer:
             self._membership.raise_if_fenced()
             if self._membership.reform_needed:
                 self._reform_and_resume(i)
+        # causal tracing + critical-path attribution: drain the
+        # attached loader's pending consume-wait (it happened BETWEEN
+        # steps, on the epoch loop) into the breakdown, then open the
+        # step's deterministic trace root — every host in a lockstep
+        # fleet derives the SAME trace id for step i, so cross-host
+        # step traces stitch with zero communication
+        seg = dict.fromkeys(BREAKDOWN_STAGES, 0.0)
+        lw = None
+        if self._loader is not None and \
+                hasattr(self._loader, "consume_trace"):
+            lw = self._loader.consume_trace()
+            seg["device_prefetch"] = lw["device_put_us"]
+            seg["loader"] = max(0.0, lw["wait_us"] - lw["device_put_us"])
+        root = self._begin_step_trace(i)
 
         def one_attempt():
             if self._step_unsafe:
@@ -707,51 +826,161 @@ class ResilientTrainer:
             self._metrics.inc("steps_retried")
 
         try:
-            # step/update ids ride to the chrome-trace timeline as event
-            # args (the histogram never sees them — no label explosion)
-            with _span("resilience.step_us",
-                       args={"step": i,
-                             "t": self._trainer.num_update}) as sp:
-                loss = retry_call(one_attempt, retries=self._max_retries,
-                                  base_delay=self._retry_base,
-                                  max_delay=self._retry_max,
-                                  retry_on=self._retry_on,
-                                  on_retry=on_retry)
-        except self._retry_on:
-            self._metrics.inc("steps_failed")
-            # retries exhausted: the caller may catch and abandon the
-            # run, so the postmortem ring dumps NOW, not only from the
-            # excepthook
-            self._record_step(i, None, sp.duration_us, failed=True)
-            self._flight.dump(
-                f"step {i} failed after {self._max_retries + 1} "
-                f"attempt(s)")
-            raise
-        self._record_step(i, loss, sp.duration_us)
-        if self._trainer.guard_enabled:
-            self._pending_finite.append(self._trainer.last_step_finite)
-            if len(self._pending_finite) >= 128:
-                self._drain_finite()
-        if self._membership is not None and \
-                not self._preempt_round_open() and \
-                i % self._fleet_sync_every == 0:
-            # during a coordinated preemption round the lockstep sync is
-            # skipped: the initiator is parked in its vote-wait (the
-            # barrier would only time out, ~2 TTLs per catch-up step —
-            # long enough to blow the initiator's vote deadline and
-            # split the agreed flush), and the fleet is about to flush
-            # and exit anyway
-            self._fleet_step_sync(i)
-        if self._preempt_pending():
-            self._preempt_boundary()
-        if self._ckpt_dir is not None and self._every > 0 and \
-                self._trainer.num_update % self._every == 0:
             try:
-                self.checkpoint()
-            except TransientFault:
-                pass   # counted in checkpoints_failed; the next periodic
-                # save (or the preemption path) covers the gap
-        return loss
+                # step/update ids ride to the chrome-trace timeline as
+                # event args (the histogram never sees them — no label
+                # explosion)
+                with _span("resilience.step_us",
+                           args={"step": i,
+                                 "t": self._trainer.num_update}) as sp:
+                    loss = retry_call(one_attempt,
+                                      retries=self._max_retries,
+                                      base_delay=self._retry_base,
+                                      max_delay=self._retry_max,
+                                      retry_on=self._retry_on,
+                                      on_retry=on_retry)
+            except self._retry_on:
+                self._metrics.inc("steps_failed")
+                seg["compute"] = sp.duration_us
+                # retries exhausted: the caller may catch and abandon
+                # the run, so the postmortem ring dumps NOW, not only
+                # from the excepthook
+                self._finalize_step(i, None, sp.duration_us, root, seg,
+                                    lw, failed=True)
+                if root is not None:
+                    # close the root BEFORE the dump ships the span
+                    # ring, or the dumped trace the step record's
+                    # trace_id points at would lack its own root
+                    # (finish() is idempotent — the finally re-runs it)
+                    root.finish()
+                self._flight.dump(
+                    f"step {i} failed after {self._max_retries + 1} "
+                    f"attempt(s)")
+                raise
+            seg["compute"] = sp.duration_us
+            if self._trainer.guard_enabled:
+                self._pending_finite.append(
+                    self._trainer.last_step_finite)
+                if len(self._pending_finite) >= 128:
+                    self._drain_finite()
+            if self._membership is not None and \
+                    not self._preempt_round_open() and \
+                    i % self._fleet_sync_every == 0:
+                # during a coordinated preemption round the lockstep
+                # sync is skipped: the initiator is parked in its
+                # vote-wait (the barrier would only time out, ~2 TTLs
+                # per catch-up step — long enough to blow the
+                # initiator's vote deadline and split the agreed
+                # flush), and the fleet is about to flush and exit
+                # anyway
+                with _span("resilience.fleet_sync_us",
+                           args={"step": i}) as fsp:
+                    self._fleet_step_sync(i)
+                seg["collective"] = fsp.duration_us
+            if self._preempt_pending():
+                self._preempt_boundary()
+            if self._ckpt_dir is not None and self._every > 0 and \
+                    self._trainer.num_update % self._every == 0:
+                csp = None
+                try:
+                    # the ckpt-commit child of the step trace (the
+                    # inner resilience.checkpoint_us span nests under
+                    # it); histogram=False — checkpoint_us already IS
+                    # the metric
+                    with _span("resilience.ckpt_commit_us",
+                               histogram=False) as csp:
+                        self.checkpoint()
+                except TransientFault:
+                    pass   # counted in checkpoints_failed; the next
+                    # periodic save (or the preemption path) covers
+                    # the gap
+                if csp is not None:
+                    seg["ckpt"] = csp.duration_us
+            self._finalize_step(i, loss, sp.duration_us, root, seg, lw)
+            return loss
+        finally:
+            # the root must close on EVERY exit — success, retry
+            # exhaustion, preemption raise, fleet re-form — or the
+            # leaked context would adopt unrelated later work
+            if root is not None:
+                root.finish()
+
+    # -- causal tracing / critical-path attribution --------------------------
+    def _step_trace_key(self) -> str:
+        """The fleet-uniform component of the deterministic step trace
+        id: the fencing generation in a multi-process group (shared by
+        every host with zero communication — the lockstep IS the
+        causal key), a per-process nonce single-process (so two runs'
+        step-N traces never collide)."""
+        try:
+            from . import dist
+            if dist.is_initialized():
+                return f"fence{dist.fence_generation()}"
+        except Exception:   # noqa: BLE001 — tracing must never fail
+            pass            # the step it traces
+        return _RUN_NONCE
+
+    def _begin_step_trace(self, i: int):
+        """Open step ``i``'s trace root, or None when tracing is off or
+        deterministic head sampling dropped this step (every host drops
+        or keeps the SAME steps).  The root is backdated to the
+        previous step's boundary, so the trace covers the full interval
+        the training loop experienced — loader wait included."""
+        tr = _tracing.tracer()
+        if not tr.sampled_index(i):
+            return None
+        tid = _tracing.deterministic_trace_id(
+            "resilience.step", self._step_trace_key(), i)
+        return tr.begin(
+            "resilience.step", trace_id=tid, t0=self._boundary_pc,
+            args={"step": i,
+                  "t": self._trainer.num_update
+                  if self._trainer.built else 0})
+
+    def _finalize_step(self, i: int, loss, jit_us: float, root, seg,
+                       lw, failed: bool = False) -> None:
+        """Close out one supervised step: decompose the
+        boundary-to-boundary wall into the measured segments, name the
+        bottleneck, attribute the between-steps loader work into the
+        trace retroactively, and write gauges + histogram + flight
+        record.  Runs while the step root is still ACTIVE, so the
+        ``resilience.step_wall_us`` observation carries this trace's
+        exemplar."""
+        end = _tracing.now()
+        start, self._boundary_pc = self._boundary_pc, end
+        known = (seg["compute"] + seg["loader"] + seg["device_prefetch"]
+                 + seg["collective"] + seg["ckpt"])
+        wall = (end - start) * 1e6 if start is not None else known
+        seg["other"] = max(0.0, wall - known)
+        bottleneck = max(BREAKDOWN_STAGES, key=lambda s: seg[s])
+        for s, g in self._g_breakdown.items():
+            g.set(round(seg[s], 1))
+        self._g_bottleneck.set(BREAKDOWN_STAGES.index(bottleneck))
+        if root is not None and lw is not None and lw["wait_us"] > 0:
+            # the loader wait happened before this step's body, on the
+            # epoch loop — adopt it into the trace retroactively (the
+            # device-prefetch dispatch nests inside the same window)
+            tr = _tracing.tracer()
+            ch = tr.begin("loader.wait", parent=root, activate=False,
+                          t0=lw["wait_end"] - lw["wait_us"] / 1e6)
+            if ch is not None:
+                ch.finish(t_end=lw["wait_end"])
+            if lw["device_put_us"] > 0:
+                dp = tr.begin(
+                    "loader.device_prefetch", parent=root,
+                    activate=False,
+                    t0=lw["wait_end"] - lw["device_put_us"] / 1e6)
+                if dp is not None:
+                    dp.finish(t_end=lw["wait_end"])
+        if root is not None:
+            root.annotate(bottleneck=bottleneck,
+                          wall_us=round(wall, 1))
+        self._h_step_wall.observe(wall)
+        self._record_step(i, loss, jit_us, failed=failed,
+                          wall_us=wall, breakdown=seg,
+                          bottleneck=bottleneck,
+                          trace_id=root.trace_id
+                          if root is not None else None)
 
     # -- elastic fleet ------------------------------------------------------
     def _fire_host_faults(self, i: int, plan) -> None:
@@ -919,12 +1148,22 @@ class ResilientTrainer:
             return          # same way, never blocks resume
 
     def _record_step(self, i: int, loss, step_us: float,
-                     failed: bool = False) -> None:
+                     failed: bool = False,
+                     wall_us: Optional[float] = None,
+                     breakdown: Optional[dict] = None,
+                     bottleneck: Optional[str] = None,
+                     trace_id: Optional[str] = None) -> None:
         """One flight-recorder record per supervised step.  Cheap by
         construction: counter/gauge reads, one bucket-percentile pass
         over the flush histogram, and a deque append — the loss is
         stored as its live device reference and only materialized if a
-        dump ever happens."""
+        dump ever happens.
+
+        ``trace_id`` cross-references the causal span ring (a crash
+        dump's step records point into the trace JSONL/ring);
+        ``breakdown``/``bottleneck`` are the step's critical-path
+        attribution — the one-line answer to "why was this step slow"
+        sits in the postmortem ring itself."""
         if not self._flight.enabled:
             return
         flush = self._h_flush
@@ -932,6 +1171,7 @@ class ResilientTrainer:
             step=i,
             t=self._trainer.num_update if self._trainer.built else 0,
             step_us=round(step_us, 1),
+            wall_us=None if wall_us is None else round(wall_us, 1),
             loss=loss,
             loss_scale=self._g_loss_scale.value,
             flush_us_p99=round(flush.percentile(99), 1),
@@ -943,6 +1183,10 @@ class ResilientTrainer:
             # per-step flight field): 1 while a background orbax/npz
             # commit overlaps these steps
             ckpt_inflight=self._g_ckpt_inflight.value,
+            breakdown=None if breakdown is None else
+            {s: round(v, 1) for s, v in breakdown.items()},
+            bottleneck=bottleneck,
+            trace_id=trace_id,
             failed=failed,
         )
 

@@ -163,6 +163,10 @@ class Profiler:
             self._listener_installed = False
 
     # -- output ------------------------------------------------------------
+    #: tracing events render on their own tid lanes, offset past the
+    #: profiler's per-thread lanes so the two namespaces never collide
+    _TRACE_TID_BASE = 64
+
     def dump(self, finished: bool = True) -> None:
         pid = self._host_pid()
         with self._lock:
@@ -174,8 +178,28 @@ class Profiler:
                 meta.append({"name": "thread_name", "ph": "M",
                              "pid": pid, "tid": lane,
                              "args": {"name": tname}})
-            payload = {"traceEvents": meta + list(self._events),
-                       "displayTimeUnit": "ms"}
+            events = list(self._events)
+        # causal-tracing merge: the tracer's completed-span ring joins
+        # the op/span timeline as duration events PLUS flow arrows
+        # (parent -> child, batch -> member requests) on the same
+        # perf_counter clock — the profiler's view of "what caused
+        # what", not just "what ran when"
+        try:
+            from .observability import tracing as _tracing
+            trc = _tracing.tracer()
+            tev = trc.chrome_events(base_pc=self._t0,
+                                    tid_offset=self._TRACE_TID_BASE)
+            if tev:
+                for lane, tname in sorted(trc.lane_names().items()):
+                    meta.append({"name": "thread_name", "ph": "M",
+                                 "pid": pid,
+                                 "tid": self._TRACE_TID_BASE + lane,
+                                 "args": {"name": f"trace:{tname}"}})
+                events += tev
+        except Exception:   # noqa: BLE001 — a broken tracer must not
+            pass            # break the profile dump
+        payload = {"traceEvents": meta + events,
+                   "displayTimeUnit": "ms"}
         with open(self.filename, "w") as f:
             json.dump(payload, f)
 

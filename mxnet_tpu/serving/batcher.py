@@ -54,11 +54,18 @@ class DeadlineExceeded(ServingError):
 
 class Request:
     """One in-flight inference request: inputs, lifecycle timestamps
-    (the flight-recorder record), and a one-shot completion event."""
+    (the flight-recorder record), and a one-shot completion event.
+
+    ``trace`` is the request's causal-tracing root span (None when
+    tracing is off or head sampling dropped it): opened at submit,
+    finished at completion, and the parent every batch the request
+    rides links back to — the contextvar cannot cross the
+    submit→batcher→worker thread hops, so the request object IS the
+    context carrier on this path."""
 
     __slots__ = ("rid", "inputs", "key", "deadline", "batch_size",
                  "t_enqueue", "t_assemble", "t_dispatch", "t_done",
-                 "_event", "_result", "_error")
+                 "trace", "_event", "_result", "_error")
 
     def __init__(self, rid: int, inputs: Tuple, key: Tuple,
                  deadline: Optional[float]):
@@ -71,6 +78,7 @@ class Request:
         self.t_assemble = 0.0
         self.t_dispatch = 0.0
         self.t_done = 0.0
+        self.trace = None
         self._event = threading.Event()
         self._result = None
         self._error: Optional[BaseException] = None
@@ -187,17 +195,23 @@ class AdmissionQueue:
 
 
 class _Batch:
-    """One assembled, padded batch headed for a single compiled call."""
+    """One assembled, padded batch headed for a single compiled call.
+    ``trace`` carries the assembly span across the dispatch-queue hop
+    (the dispatch span's parent); None when no member request is
+    traced."""
 
-    __slots__ = ("key", "batch", "arrays", "requests", "real", "padded")
+    __slots__ = ("key", "batch", "arrays", "requests", "real", "padded",
+                 "trace")
 
-    def __init__(self, key, batch, arrays, requests, real, padded):
+    def __init__(self, key, batch, arrays, requests, real, padded,
+                 trace=None):
         self.key = key
         self.batch = batch
         self.arrays = arrays
         self.requests = requests
         self.real = real
         self.padded = padded
+        self.trace = trace
 
 
 class Batcher:
@@ -263,10 +277,39 @@ class Batcher:
     @hot_path("dispatch")
     def _assemble(self, requests: List[Request]) -> _Batch:
         """Batch-assembly entry point (serving hot path): stamp the
-        assembly timestamp and pad-and-stack via the bucketer."""
+        assembly timestamp and pad-and-stack via the bucketer.
+
+        Causal tracing: when any member request carries a trace, the
+        assembly gets a span parented on the FIRST traced request and
+        LINKED to every other traced member — one batch, many causes;
+        the links render as flow arrows from each request's root.
+        Tracing off = every ``r.trace`` is None = no tracer touch."""
+        sp = parent_req = None
+        for r in requests:
+            if r.trace is not None:
+                from ..observability import tracing as _tracing
+                sp = _tracing.tracer().begin(
+                    "serving.assemble", parent=r.trace, activate=False)
+                parent_req = r
+                break
         t = time.monotonic()
         for r in requests:
             r.t_assemble = t
-        arrays, bsz, real, padded = self._bucketer.assemble(requests)
+        try:
+            arrays, bsz, real, padded = self._bucketer.assemble(requests)
+        except BaseException as exc:
+            # a poison batch still records its assembly span (the pump
+            # fails these requests and keeps pumping — the trace should
+            # show where they died)
+            if sp is not None:
+                sp.annotate(error=type(exc).__name__)
+                sp.finish()
+            raise
+        if sp is not None:
+            for r in requests:
+                if r.trace is not None and r is not parent_req:
+                    sp.link(r.trace)
+            sp.annotate(batch=bsz, real=real, padded=padded)
+            sp.finish()
         return _Batch(requests[0].key, bsz, arrays, requests, real,
-                      padded)
+                      padded, trace=sp)

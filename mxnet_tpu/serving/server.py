@@ -38,6 +38,7 @@ import numpy as _np
 
 from ..base import MXNetError, get_env, hot_path, jax_compute_dtype
 from ..ndarray import NDArray, array as nd_array
+from ..observability import tracing as _tracing
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry
 from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded, Request,
@@ -318,6 +319,13 @@ class ModelServer:
         ms = self.deadline_ms if deadline_ms is None else float(deadline_ms)
         deadline = (time.monotonic() + ms / 1e3) if ms > 0 else None
         req = Request(next(self._rid), tuple(arrs), key, deadline)
+        # causal tracing root: ONE trace per request, head-sampled here
+        # at admission (explicit lifecycle — finished in _finish on a
+        # worker thread; the Request object carries the context across
+        # the queue hops)
+        req.trace = _tracing.tracer().begin(
+            "serving.request", activate=False,
+            args={"rid": req.rid, "bucket": _key_str(key)})
         try:
             self._admission.submit(req)
         except ServerOverloaded:
@@ -417,13 +425,35 @@ class ModelServer:
         """Serving dispatch entry point: ONE compiled call for the whole
         bucket, one batched device→host transfer, then per-request
         fan-out."""
-        t0 = time.monotonic()
-        for req in batch.requests:
-            req.t_dispatch = t0
-        flat = graph(*batch.arrays)
-        # response materialization: ONE batched device→host transfer per
-        # BATCH (results are host values by contract), not per request
-        outs = [_np.asarray(v) for v in flat]  # mxlint: disable=hidden-host-sync,hot-path-purity — batched response readback, one transfer (and one buffer) per batch
+        # dispatch span: child of the batch's assembly span (tracing
+        # off = batch.trace is None = no tracer touch on this hot root)
+        sp = None if batch.trace is None else _tracing.tracer().begin(
+            "serving.dispatch", parent=batch.trace, activate=False,
+            args={"batch": batch.batch, "bucket": _key_str(batch.key)})
+        rb = None
+        try:
+            t0 = time.monotonic()
+            for req in batch.requests:
+                req.t_dispatch = t0
+            flat = graph(*batch.arrays)
+            rb = None if sp is None else _tracing.tracer().begin(
+                "serving.readback", parent=sp, activate=False)
+            # response materialization: ONE batched device→host transfer
+            # per BATCH (results are host values by contract), not per
+            # request
+            outs = [_np.asarray(v) for v in flat]  # mxlint: disable=hidden-host-sync,hot-path-purity — batched response readback, one transfer (and one buffer) per batch
+        except BaseException as exc:
+            # a failed batch must still record its dispatch span — the
+            # postmortem trace of exactly the batch that died
+            if sp is not None:
+                sp.annotate(error=type(exc).__name__)
+                if rb is not None:
+                    rb.finish()
+                sp.finish()
+            raise
+        if rb is not None:
+            rb.finish()
+            sp.finish()
         # inc(), not .n bumps: N workers finish batches concurrently and
         # the direct-bump idiom is reserved for single-threaded hot loops
         self._h_dispatch.observe((time.monotonic() - t0) * 1e6)
@@ -472,8 +502,16 @@ class ModelServer:
         req._result = result
         req._error = error
         dur_us = (req.t_done - req.t_enqueue) * 1e6
+        trace_id = None
+        if req.trace is not None:
+            trace_id = req.trace.trace_id
+            if error is not None:
+                req.trace.annotate(error=type(error).__name__)
+            req.trace.finish()
         if error is None:
-            self._h_request.observe(dur_us)
+            # the explicit trace_id puts the exemplar on THIS request's
+            # trace (no contextvar crosses the worker-thread hop)
+            self._h_request.observe(dur_us, trace_id=trace_id)
             self._c_done.inc()
         self._flight.record_request(
             request_id=req.rid,
@@ -484,6 +522,9 @@ class ModelServer:
             bucket=_key_str(req.key),
             batch_size=req.batch_size,
             us=round(dur_us, 1),
+            # causal cross-reference: a crash dump's request ring points
+            # into the span ring / JSONL stream
+            trace_id=trace_id,
             ok=error is None)
         req._event.set()
 

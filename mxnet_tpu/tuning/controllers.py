@@ -56,9 +56,27 @@ from ..observability.registry import (_percentile_from, registry,
 __all__ = ["Controller", "BulkSizeController", "PrefetchController",
            "BatchWindowController", "FleetGatherController",
            "CommBucketController", "DevicePrefetchController",
-           "HistogramDelta", "CounterDelta"]
+           "HistogramDelta", "CounterDelta", "exemplar_ids"]
 
 DRY_RUN_ENV = "MXTPU_TUNE_DRY_RUN"
+
+
+def exemplar_ids(hist, k: int = 3) -> str:
+    """Comma-joined trace_ids from ``hist``'s highest (slowest)
+    exemplar-carrying buckets, newest first — the concrete traces
+    behind the tail a controller is steering on.  Empty when causal
+    tracing is off (exemplars only exist while tracing records)."""
+    ex = hist.exemplars()
+    if not ex:
+        return ""
+    ids: List[str] = []
+    for bound in sorted(ex, reverse=True):
+        for tid, _v, _ts in reversed(ex[bound]):
+            if tid not in ids:
+                ids.append(tid)
+            if len(ids) >= k:
+                return ",".join(ids)
+    return ",".join(ids)
 
 
 class HistogramDelta:
@@ -70,6 +88,11 @@ class HistogramDelta:
     def __init__(self, hist):
         self._h = hist
         self._last: Optional[dict] = None
+
+    @property
+    def hist(self):
+        """The underlying registry Histogram (exemplar access)."""
+        return self._h
 
     def take(self) -> Optional[dict]:
         st = self._h.state()
@@ -232,6 +255,13 @@ class Controller:
             "dry_run": self.dry_run,
             "reason": reason,
         }
+        # causal audit: controllers that steer on an exemplar-carrying
+        # histogram stash the tail's trace_ids in decide() — the
+        # decision record then names the actual traces that drove it
+        ex = getattr(self, "_tick_exemplars", "")
+        if ex:
+            decision["exemplars"] = ex
+            self._tick_exemplars = ""
         self._c_decisions.inc()
         if applied:
             self._c_applied.inc()
@@ -314,6 +344,7 @@ class BulkSizeController(Controller):
             # the oscillation returns
             self._settle -= 1
             return None
+        self._tick_exemplars = exemplar_ids(self._flush.hist)
         score = d["total"] / ops          # host us per bulked op
         cur = int(self.current())
         if self.p99_budget_us is not None and \
@@ -501,6 +532,7 @@ class BatchWindowController(Controller):
         d = self._req.take()
         if d is None or d["count"] < self.min_requests:
             return None
+        self._tick_exemplars = exemplar_ids(self._req.hist)
         cur = self.current()
         p99, last_p99 = d["p99"], self._last_p99
         self._last_p99 = p99
@@ -599,6 +631,7 @@ class CommBucketController(Controller):
         d = self._step_us.take()
         if d is None or d["count"] < self.min_steps:
             return None
+        self._tick_exemplars = exemplar_ids(self._step_us.hist)
         cur = self.current()
         if cur <= 0:
             return None                  # bucketing off: hold (see doc)
