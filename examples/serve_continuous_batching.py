@@ -89,14 +89,15 @@ def main():
     n = len(lat_ms)
     snap = registry().snapshot()
     real = snap["serving.tokens_real"]
-    padded = snap["serving.tokens_padded"]
+    padded = snap["serving.tokens_padded"]   # sequence-pad positions
+    slots = snap.get("serving.slots_padded", 0)
     print(f"served {n} requests from {args.clients} clients in "
           f"{wall:.2f}s ({n / wall:.0f} req/s), {rejected[0]} rejected")
     if n:
         print(f"latency p50 {lat_ms[n // 2]:.2f} ms, "
               f"p99 {lat_ms[int(n * 0.99)]:.2f} ms")
-    print(f"batch efficiency {real / max(padded, 1):.2%} "
-          f"(real/padded elements)")
+    print(f"batch efficiency {real / max(real + padded, 1):.2%} "
+          f"(real / real+padded positions; {slots} padded slots)")
 
 
 if __name__ == "__main__":

@@ -260,6 +260,37 @@ register_env("MXTPU_SERVING_BATCH_WINDOW_US", 2000.0, float,
              "shape bucket to fill before dispatching a partial batch. "
              "Read live per batch, so the BatchWindowController (and "
              "operators) can adapt it on a running server.")
+register_env("MXTPU_SERVING_KV_BLOCK", 16, int,
+             "Serving: KV-cache block size in token positions; the "
+             "paging granularity of the generation scheduler's block "
+             "manager (serving.kv_cache).")
+register_env("MXTPU_SERVING_KV_BLOCKS", 128, int,
+             "Serving: total KV-cache blocks pre-allocated per "
+             "generation server (block 0 is reserved scratch, so "
+             "usable capacity is one less).  Admission to the running "
+             "batch gates on a worst-case block reservation against "
+             "this pool.")
+register_env("MXTPU_SERVING_DECODE_SLOTS", 4, int,
+             "Serving: running-batch slot count of the iteration-level "
+             "decode scheduler — how many requests decode together in "
+             "one compiled decode step.  Recompile-costly; the "
+             "DecodeSlotController hill-climbs it between generations.")
+register_env("MXTPU_SERVING_PREFILL_MODE", "interleave", str,
+             "Serving: 'interleave' admits at most one prompt prefill "
+             "per decode iteration (smooth decode cadence); 'step' "
+             "prefills every admissible queued request before the next "
+             "decode step (fastest drain of a burst).  Read live per "
+             "iteration.")
+register_env("MXTPU_SERVING_MAX_NEW_TOKENS", 64, int,
+             "Serving: default cap on generated tokens per request "
+             "when submit_generate() is not given max_new_tokens; also "
+             "bounds the worst-case KV block reservation.")
+register_env("MXTPU_TUNE_DECODE_SLOTS", False, bool,
+             "Self-tuning: enable the DecodeSlotController (hill-climbs "
+             "MXTPU_SERVING_DECODE_SLOTS on interval tokens/s with the "
+             "bracketing stop; recompiles are the cost, so it parks at "
+             "the bracketed best).  Off by default: attach it to a "
+             "generation server explicitly.")
 register_env("MXTPU_TUNE_INTERVAL", 2.0, float,
              "Self-tuning: seconds between controller timer-thread "
              "ticks (mxnet_tpu.tuning).")

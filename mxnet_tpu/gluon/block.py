@@ -500,7 +500,8 @@ class HybridBlock(Block):
                   for k, p in self._reg_params.items()}
         return self.hybrid_forward(nd_mod, *inputs, **params)
 
-    def cached_graph(self, *inputs) -> "CachedGraph":
+    def cached_graph(self, *inputs, entry: str = "forward"
+                     ) -> "CachedGraph":
         """Freeze ONE compiled inference signature into a
         :class:`CachedGraph` — the direct cached-graph entry the serving
         subsystem dispatches through (no autograd bookkeeping, no
@@ -512,7 +513,20 @@ class HybridBlock(Block):
         a block that already served this signature through ``block(x)``
         hands back the *identical* executable; the call compiles (and
         warms) the graph before returning, so the first real request
-        never pays the compile."""
+        never pays the compile.
+
+        ``entry`` selects the traced method: ``"forward"`` (the default,
+        ``hybrid_forward``) or a generation variant the block implements
+        — ``"prefill"`` traces ``hybrid_prefill`` (prompt pass: scatters
+        K/V into the block pool, returns last-position logits) and
+        ``"decode"`` traces ``hybrid_decode`` (one token per running
+        slot; the carried state is the KV pool, passed in and returned).
+        Non-forward entries compile once per input signature — for
+        decode that means once per (slot-count, max-blocks) pair — and
+        resolve through the persistent compile cache exactly like the
+        forward graph, so a warm process restart skips the XLA compile."""
+        if entry != "forward":
+            return self._cached_entry_graph(entry, inputs)
         inputs = tuple(a if isinstance(a, NDArray) else nd_mod.array(a)
                        for a in inputs)
         ctx = inputs[0].context
@@ -556,6 +570,98 @@ class HybridBlock(Block):
             jax.block_until_ready(flat)        # compile + warm, here
         return CachedGraph(entry_fn, pvals, key, n_outs_cell[0], ctx,
                            self.name)
+
+    def _cached_entry_graph(self, entry: str, inputs) -> "CachedGraph":
+        """Non-forward cached-graph entry (``hybrid_prefill`` /
+        ``hybrid_decode``): same trace-compile-warm flow as the forward
+        path, keyed separately per entry name so one block can hold its
+        prompt buckets and its decode-step signatures side by side."""
+        import jax
+        method_name = "hybrid_" + entry
+        if not callable(getattr(self, method_name, None)):
+            raise AttributeError(
+                f"{type(self).__name__} has no {method_name}(); a "
+                f"generation-servable block implements hybrid_prefill "
+                f"and hybrid_decode (see serving.ModelServer docs)")
+        inputs = tuple(a if isinstance(a, NDArray) else nd_mod.array(a)
+                       for a in inputs)
+        ctx = inputs[0].context
+        with _autograd.pause():
+            sig = (entry,
+                   tuple((tuple(a.shape), str(a.dtype)) for a in inputs),
+                   False, ctx)
+            cached = self._cached_graph.get(sig)
+            if cached is None:
+                cached = self._build_entry_cached(method_name, inputs, ctx)
+                self._cached_graph.put(sig, cached)
+            jitted, params, n_outs_cell, infer_cell = cached
+            pvals = [p.data(ctx)._read() for p in params]
+            # generation graphs are inference-only: dropout is off, the
+            # RNG input is dead — pin one key (same discipline as the
+            # forward path) so dispatch stays allocation-free
+            key = _grandom.next_key()
+            entry_fn = infer_cell[0] if infer_cell[0] is not None \
+                else jitted
+            try:
+                flat = entry_fn(key, *pvals,
+                                *[a._read() for a in inputs])
+            except TypeError:
+                if entry_fn is jitted:
+                    raise
+                infer_cell[0] = None   # aval drift: jit path forever
+                entry_fn = jitted
+                flat = entry_fn(key, *pvals,
+                                *[a._read() for a in inputs])
+            jax.block_until_ready(flat)    # compile + warm, here
+        return CachedGraph(entry_fn, pvals, key, n_outs_cell[0], ctx,
+                           f"{self.name}:{entry}")
+
+    def _build_entry_cached(self, method_name, inputs, ctx):
+        """Trace one generation entry into a jitted fn (+ AOT cell).
+        Mirrors ``_build_cached`` minus everything inference never
+        needs: no vjp, no aux write-back (generation entries thread
+        their state — the KV pool — explicitly as an output)."""
+        import jax
+        params = self._ordered_params(ctx)
+        n_outs_cell = [None]
+        block = self
+        n_params = len(params)
+        method = getattr(block, method_name)
+
+        def pure_fn(key, *vals):
+            pvals = vals[:n_params]
+            invals = vals[n_params:]
+            wrappers = [NDArray(v, ctx=ctx) for v in pvals]
+            win = [NDArray(v, ctx=ctx) for v in invals]
+            subs = {id(p): w for p, w in zip(params, wrappers)}
+            with _TraceCtx(subs), \
+                    _autograd._RecordingScope(False, False), \
+                    _KeyScope(key):
+                pkw = {k: _param_data_maybe_traced(p, ctx)
+                       for k, p in block._reg_params.items()}
+                out = method(nd_mod, *win, **pkw)
+            outs = list(out) if isinstance(out, (list, tuple)) else [out]
+            out_vals = [o._read() for o in outs]
+            n_outs_cell[0] = len(out_vals)
+            return tuple(out_vals)
+
+        jitted = jax.jit(pure_fn)
+        # persistent compile cache: same disk tier as the forward graph
+        # (key = lowered StableHLO + backend fingerprint), so a server
+        # restart populates every decode-step signature with
+        # deserialization instead of XLA compiles
+        infer_cell = [None]
+        try:
+            from ..tuning import compile_cache as _cc
+            if _cc.active() is not None:
+                sample_key = jax.random.PRNGKey(0)
+                vals = [p.data(ctx)._read() for p in params] + \
+                       [a._read() for a in inputs]
+                lowered = jitted.lower(sample_key, *vals)
+                infer_cell[0] = _cc.aot_compile(lowered, "graph")
+        except Exception:   # noqa: BLE001 — AOT/serialization drift
+            infer_cell[0] = None   # degrades to the plain jit path
+        return jitted, params, n_outs_cell, infer_cell
 
     def export(self, path: str, epoch: int = 0) -> Tuple[str, str]:
         """Reference parity: save -symbol.json + -%04d.params for the

@@ -30,6 +30,7 @@ __all__ = ["SlidingWindowSelfAttention", "LongformerEncoderCell",
            "TransformerDecoderCell", "TransformerEncoder",
            "TransformerDecoder", "TransformerNMT", "BERTEncoder",
            "BERTModel", "bert_base", "bert_small", "transformer_nmt_base",
+           "CausalLMCell", "CausalLM", "causal_lm_small",
            "TP_RULES"]
 
 #: megatron-style tensor-parallel PartitionSpecs for this family — pass to
@@ -676,3 +677,230 @@ class LongformerEncoder(HybridBlock):
         for cell in self._cells:
             h = cell(h, valid_len)
         return h
+
+
+class CausalLMCell(HybridBlock):
+    """Pre-factored decoder-only layer: the generation scheduler's
+    prefill/decode graphs reach its children (``qkv``/``proj``/``ln1``/
+    ``ffn``/``ln2``) directly, so the cell is both a standard post-LN
+    causal layer (``hybrid_forward``) and the parameter container for
+    :class:`CausalLM`'s paged-attention entries."""
+
+    def __init__(self, units, hidden_size, num_heads, activation="gelu",
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        if units % num_heads:
+            raise MXNetError(f"units {units} not divisible by heads "
+                             f"{num_heads}")
+        self._units = units
+        self._heads = num_heads
+        with self.name_scope():
+            self.qkv = Dense(3 * units, flatten=False, in_units=units,
+                             prefix="qkv_")
+            self.proj = Dense(units, flatten=False, in_units=units,
+                              prefix="proj_")
+            self.ln1 = LayerNorm(in_channels=units, prefix="ln1_")
+            self.ffn = PositionwiseFFN(units, hidden_size, 0.0,
+                                       activation, prefix="ffn_")
+            self.ln2 = LayerNorm(in_channels=units, prefix="ln2_")
+
+    def attend(self, F, x, k, v, mask, batch, sq, sk):
+        """Post-LN residual layer body around an explicit K/V set —
+        shared by the full pass (K/V = the pass's own projections) and
+        the decode step (K/V gathered from the block pool)."""
+        h = self._heads
+        d = self._units // h
+        q = F.split(self.qkv(x), num_outputs=3, axis=-1)[0]
+        q = F.reshape(F.transpose(
+            F.reshape(q, shape=(batch, sq, h, d)),
+            axes=(0, 2, 1, 3)), shape=(batch * h, sq, d))
+        kh = F.reshape(F.transpose(
+            F.reshape(k, shape=(batch, sk, h, d)),
+            axes=(0, 2, 1, 3)), shape=(batch * h, sk, d))
+        vh = F.reshape(F.transpose(
+            F.reshape(v, shape=(batch, sk, h, d)),
+            axes=(0, 2, 1, 3)), shape=(batch * h, sk, d))
+        scale = 1.0 / math.sqrt(d)
+        att = _masked_softmax(F, F.batch_dot(q, kh, transpose_b=True)
+                              * scale, mask)
+        out = F.batch_dot(att, vh)                 # (B*H, Sq, d)
+        out = F.reshape(F.transpose(
+            F.reshape(out, shape=(batch, h, sq, d)),
+            axes=(0, 2, 1, 3)), shape=(batch, sq, self._units))
+        x = self.ln1(x + self.proj(out))
+        return self.ln2(x + self.ffn(x))
+
+    def hybrid_forward(self, F, x, mask=None):
+        b, s = x.shape[0], x.shape[1]
+        kv = F.split(self.qkv(x), num_outputs=3, axis=-1)
+        return self.attend(F, x, kv[1], kv[2], mask, b, s, s)
+
+
+class CausalLM(HybridBlock):
+    """Decoder-only LM with a paged-KV generation contract.
+
+    Three compiled entries share one parameter set:
+
+    - ``hybrid_forward(tokens)`` — full causal pass, (B, S) -> (B, S, V)
+      logits (training / eval / the whole-sequence serving baseline);
+    - ``hybrid_prefill(tokens, seq_len, table, pool)`` — ONE prompt
+      (batch 1) padded to a length bucket: causal attention within the
+      prompt, every position's K/V scattered into the request's KV
+      blocks (``table`` maps position//block -> pool block id), returns
+      (last-real-position logits (1, V), updated pool);
+    - ``hybrid_decode(tokens, positions, tables, pool)`` — one token
+      per running slot: scatter the step's K/V at each slot's current
+      position, gather each slot's whole block list back, attend under
+      a per-slot length mask, return ((slots, V) logits, updated pool).
+
+    The pool is a single ``(2*num_layers, n_blocks, block, units)``
+    array (K rows even, V rows odd).  Block 0 is scratch by convention
+    (``serving.kv_cache``): empty slots and table-tail entries point at
+    it, and the additive -1e9 mask underflows their attention weight to
+    an exact float32 zero — so each slot's output is bitwise-independent
+    of every other slot and of pool garbage, which is what makes
+    continuous-batched greedy decode bitwise-equal to decoding alone.
+    """
+
+    def __init__(self, vocab_size=257, num_layers=2, units=64,
+                 hidden_size=128, num_heads=4, max_length=256,
+                 prefix=None, params=None):
+        super().__init__(prefix=prefix, params=params)
+        self._units = units
+        self._heads = num_heads
+        self._layers = num_layers
+        self._max_len = max_length
+        with self.name_scope():
+            self.word_embed = Embedding(vocab_size, units,
+                                        prefix="word_embed_")
+            self.pos_embed = Embedding(max_length, units,
+                                       prefix="pos_embed_")
+            self.layers = HybridSequential(prefix="layers_")
+            with self.layers.name_scope():
+                for _ in range(num_layers):
+                    self.layers.add(CausalLMCell(units, hidden_size,
+                                                 num_heads))
+            self.out_proj = Dense(vocab_size, flatten=False,
+                                  in_units=units, use_bias=False,
+                                  prefix="out_")
+            _tie_weight(self.out_proj, self.word_embed)
+        # public iteration order: prefill/decode thread extra state the
+        # Sequential __call__ cannot (the LongformerEncoder idiom)
+        self._cells = [c for c in self.layers]
+
+    # -- shared pieces ------------------------------------------------
+    def init_kv_pool(self, n_blocks, block_size):
+        """Zero-initialized pool with this model's layout — what the
+        generation scheduler allocates once per server."""
+        import numpy as _np
+        return _np.zeros((2 * self._layers, int(n_blocks),
+                          int(block_size), self._units), _np.float32)
+
+    def _causal(self, F, b, s):
+        pos = F.arange(s, dtype="int32")
+        causal = F.broadcast_greater_equal(F.reshape(pos, shape=(s, 1)),
+                                           F.reshape(pos, shape=(1, s)))
+        return F.broadcast_to(F.reshape(causal, shape=(1, s, s)),
+                              shape=(b * self._heads, s, s))
+
+    def _block_coords(self, F, positions):
+        """position -> (block index within the table, offset in block);
+        integer //, % via sub-and-divide (exact for pool-sized ints)."""
+        rem = positions % self._bs
+        bidx = F.cast((positions - rem) / float(self._bs), dtype="int32")
+        return bidx, rem
+
+    def _scatter_kv(self, F, pool, layer, blocks, offsets, k, v, n):
+        """Functional write of one layer's K and V rows at
+        (block, offset) per entry — positions past a request's
+        allocation land in scratch block 0 (masked, finite, ignored)."""
+        lk = F.full((n,), 2 * layer, dtype="int32")
+        lv = F.full((n,), 2 * layer + 1, dtype="int32")
+        pool = F._scatter_set_nd(
+            pool, k, F.stack(lk, blocks, offsets, axis=0, num_args=3))
+        return F._scatter_set_nd(
+            pool, v, F.stack(lv, blocks, offsets, axis=0, num_args=3))
+
+    # -- full pass (training / whole-sequence baseline) ---------------
+    def hybrid_forward(self, F, tokens):
+        b, s = tokens.shape[0], tokens.shape[1]
+        x = self.word_embed(tokens) + self.pos_embed(_positions(F, b, s))
+        mask = self._causal(F, b, s)
+        for cell in self._cells:
+            x = cell(x, mask)
+        return self.out_proj(x)
+
+    # -- generation entries (serving.ModelServer.serve_generation) ----
+    @property
+    def _bs(self):
+        return self._pool_block
+
+    def hybrid_prefill(self, F, tokens, seq_len, table, pool):
+        """tokens (1, L) int32 padded to a length bucket; seq_len (1,)
+        int32; table (1, W) int32 block ids (W = ceil(L/block), tail =
+        scratch); pool as in :meth:`init_kv_pool`.  Returns
+        ((1, V) logits at the last real position, updated pool)."""
+        l = tokens.shape[1]
+        bs = pool.shape[2]
+        self._pool_block = bs
+        x = self.word_embed(tokens) + self.pos_embed(_positions(F, 1, l))
+        mask = self._causal(F, 1, l)
+        pos = F.arange(l, dtype="int32")
+        bidx, rem = self._block_coords(F, pos)
+        blocks = F.take(F.reshape(table, shape=(-1,)), bidx, axis=0)
+        for i, cell in enumerate(self._cells):
+            kv = F.split(cell.qkv(x), num_outputs=3, axis=-1)
+            pool = self._scatter_kv(
+                F, pool, i, blocks, rem,
+                F.reshape(kv[1], shape=(l, self._units)),
+                F.reshape(kv[2], shape=(l, self._units)), l)
+            x = cell.attend(F, x, kv[1], kv[2], mask, 1, l, l)
+        last = F.take(F.reshape(x, shape=(l, self._units)),
+                      seq_len - 1, axis=0)              # (1, U)
+        return self.out_proj(last), pool
+
+    def hybrid_decode(self, F, tokens, positions, tables, pool):
+        """One decode step for the whole running batch: tokens (slots,)
+        int32; positions (slots,) int32 (each token's position = the
+        sequence length before it); tables (slots, W) int32; pool as in
+        :meth:`init_kv_pool`.  Returns ((slots, V) logits, updated
+        pool).  Every op is row-independent, so a slot's logits depend
+        only on its own token/position/table — the bitwise-equality
+        contract continuous batching is tested against."""
+        slots = tokens.shape[0]
+        w = tables.shape[1]
+        bs = pool.shape[2]
+        self._pool_block = bs
+        s_keys = w * bs
+        x = self.word_embed(tokens) + self.pos_embed(positions)
+        bidx, rem = self._block_coords(F, positions)
+        blocks = F.pick(tables, bidx, axis=-1)          # (slots,)
+        # per-slot prefix mask over the gathered key window: key j
+        # visible iff j <= position (the new token sees itself)
+        keep = F.broadcast_lesser_equal(
+            F.reshape(F.arange(s_keys, dtype="int32"), shape=(1, s_keys)),
+            F.reshape(positions, shape=(slots, 1)))     # (slots, S)
+        mask = F.reshape(F.broadcast_to(
+            F.reshape(keep, shape=(slots, 1, 1, s_keys)),
+            shape=(slots, self._heads, 1, s_keys)),
+            shape=(slots * self._heads, 1, s_keys))
+        for i, cell in enumerate(self._cells):
+            kv = F.split(cell.qkv(x), num_outputs=3, axis=-1)
+            pool = self._scatter_kv(F, pool, i, blocks, rem,
+                                    kv[1], kv[2], slots)
+            kc = F.reshape(F.take(pool[2 * i], tables, axis=0),
+                           shape=(slots, s_keys, self._units))
+            vc = F.reshape(F.take(pool[2 * i + 1], tables, axis=0),
+                           shape=(slots, s_keys, self._units))
+            x3 = F.reshape(x, shape=(slots, 1, self._units))
+            x3 = cell.attend(F, x3, kc, vc, mask, slots, 1, s_keys)
+            x = F.reshape(x3, shape=(slots, self._units))
+        return self.out_proj(x), pool
+
+
+def causal_lm_small(vocab_size=257, **kwargs):
+    """Tiny decoder-only LM for tests/benches — the generation-serving
+    counterpart of ``bert_small``."""
+    kwargs.setdefault("max_length", 256)
+    return CausalLM(vocab_size=vocab_size, num_layers=2, units=64,
+                    hidden_size=128, num_heads=4, **kwargs)

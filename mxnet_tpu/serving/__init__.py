@@ -21,7 +21,18 @@ batching**:
 - :mod:`.server` — the :class:`ModelServer` lifecycle (start / graceful
   drain on ``stop()`` and SIGTERM), per-request metrics
   (``serving.request_us``, ``serving.queue_depth``, ``serving.tokens_*``)
-  and flight-recorder request records.
+  and flight-recorder request records;
+- :mod:`.kv_cache` — the block-managed (paged) KV cache backing
+  generation: fixed-size token blocks handed out from a free list, a
+  worst-case reservation at admission, released the moment a request
+  leaves (finish, deadline, or shed) — the ``serving.kv_blocks_used``
+  gauge is the occupancy signal;
+- :class:`GenerationServer` (in :mod:`.server`) — **token-level
+  continuous batching** for autoregressive decode: an iteration-level
+  scheduler where the schedulable unit is one decode step, finished
+  requests exit the running batch every iteration, and queued prefills
+  join open slots immediately (``MXTPU_SERVING_PREFILL_MODE`` picks
+  interleaved vs batch-first prefill).
 
 Quick start::
 
@@ -30,17 +41,30 @@ Quick start::
     with ModelServer(net, max_batch=16) as srv:
         y = srv.infer(x)            # x: ONE sample, no batch dim
 
+Generation::
+
+    from mxnet_tpu.serving import GenerationServer
+    lm = causal_lm_small(); ...
+    with GenerationServer(lm, slots=4) as srv:
+        ids = srv.generate(prompt_ids)      # greedy token ids
+
 Knobs: ``MXTPU_SERVING_MAX_BATCH``, ``MXTPU_SERVING_QUEUE_DEPTH``,
 ``MXTPU_SERVING_DEADLINE_MS``, ``MXTPU_SERVING_WORKERS``,
-``MXTPU_SERVING_BATCH_WINDOW_US`` (see the README knob table).
+``MXTPU_SERVING_BATCH_WINDOW_US``, ``MXTPU_SERVING_KV_BLOCK``,
+``MXTPU_SERVING_KV_BLOCKS``, ``MXTPU_SERVING_DECODE_SLOTS``,
+``MXTPU_SERVING_PREFILL_MODE``, ``MXTPU_SERVING_MAX_NEW_TOKENS``
+(see the README knob table).
 """
 from __future__ import annotations
 
-from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded, Request,
-                      ServerClosed, ServerOverloaded, ServingError)
+from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded,
+                      GenRequest, Request, ServerClosed, ServerOverloaded,
+                      ServingError)
 from .buckets import Bucketer, NoBucketError
-from .server import ModelServer
+from .kv_cache import BlockKVCache, BlockTable, SCRATCH_BLOCK
+from .server import GenerationServer, ModelServer
 
-__all__ = ["ModelServer", "Bucketer", "Request", "AdmissionQueue",
-           "Batcher", "ServingError", "ServerClosed", "ServerOverloaded",
-           "DeadlineExceeded", "NoBucketError"]
+__all__ = ["ModelServer", "GenerationServer", "Bucketer", "Request",
+           "GenRequest", "AdmissionQueue", "Batcher", "BlockKVCache",
+           "BlockTable", "SCRATCH_BLOCK", "ServingError", "ServerClosed",
+           "ServerOverloaded", "DeadlineExceeded", "NoBucketError"]

@@ -30,8 +30,9 @@ from typing import Callable, List, Optional, Tuple
 
 from ..base import MXNetError, hot_path
 
-__all__ = ["Request", "AdmissionQueue", "Batcher", "ServingError",
-           "ServerClosed", "ServerOverloaded", "DeadlineExceeded"]
+__all__ = ["Request", "GenRequest", "AdmissionQueue", "Batcher",
+           "ServingError", "ServerClosed", "ServerOverloaded",
+           "DeadlineExceeded"]
 
 
 class ServingError(MXNetError):
@@ -96,6 +97,54 @@ class Request:
         if self._error is not None:
             raise self._error
         return self._result
+
+
+class GenRequest:
+    """One in-flight *generation* request for the iteration-level decode
+    scheduler (``ModelServer``'s generation mode): prompt in, greedy
+    token ids out, one token per decode step.
+
+    Lifecycle timestamps split time-to-first-token from total latency:
+    ``t_first`` is stamped when the prefill's logits yield token one
+    (the ``serving.ttft_us`` histogram); ``t_done`` when the request
+    leaves the running batch.  ``trace`` is the causal-tracing root
+    opened at submit (None when tracing is off/sampled out) — the
+    request object carries it across the submit→scheduler thread hop,
+    and every decode step the request rides links back to it."""
+
+    __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "eos",
+                 "tokens", "trace", "t_enqueue", "t_prefill", "t_first",
+                 "t_done", "pos", "_event", "_error")
+
+    def __init__(self, rid: int, prompt, max_new_tokens: int,
+                 deadline: Optional[float], eos: Optional[int]):
+        self.rid = rid
+        self.prompt = prompt                # 1-D int32 numpy token ids
+        self.max_new_tokens = max_new_tokens
+        self.deadline = deadline            # monotonic seconds, None = none
+        self.eos = eos                      # stop token id, None = run to cap
+        self.tokens: List[int] = []         # generated ids (EOS included)
+        self.trace = None
+        self.t_enqueue = time.monotonic()
+        self.t_prefill = 0.0
+        self.t_first = 0.0
+        self.t_done = 0.0
+        self.pos = 0          # position of the NEXT token to decode
+        self._event = threading.Event()
+        self._error: Optional[BaseException] = None
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> List[int]:
+        """Block until generation finishes; returns the generated token
+        ids (EOS included when hit) or raises the request's error."""
+        if not self._event.wait(timeout):
+            raise TimeoutError(
+                f"generation {self.rid} not completed within {timeout}s")
+        if self._error is not None:
+            raise self._error
+        return self.tokens
 
 
 class AdmissionQueue:
@@ -198,19 +247,22 @@ class _Batch:
     """One assembled, padded batch headed for a single compiled call.
     ``trace`` carries the assembly span across the dispatch-queue hop
     (the dispatch span's parent); None when no member request is
-    traced."""
+    traced.  Padding is carried split: ``slots_padded`` (empty batch
+    slots) vs ``tokens_padded`` (padded sequence positions in occupied
+    slots)."""
 
-    __slots__ = ("key", "batch", "arrays", "requests", "real", "padded",
-                 "trace")
+    __slots__ = ("key", "batch", "arrays", "requests", "real",
+                 "slots_padded", "tokens_padded", "trace")
 
-    def __init__(self, key, batch, arrays, requests, real, padded,
-                 trace=None):
+    def __init__(self, key, batch, arrays, requests, real, slots_padded,
+                 tokens_padded, trace=None):
         self.key = key
         self.batch = batch
         self.arrays = arrays
         self.requests = requests
         self.real = real
-        self.padded = padded
+        self.slots_padded = slots_padded
+        self.tokens_padded = tokens_padded
         self.trace = trace
 
 
@@ -296,7 +348,8 @@ class Batcher:
         for r in requests:
             r.t_assemble = t
         try:
-            arrays, bsz, real, padded = self._bucketer.assemble(requests)
+            arrays, bsz, real, slots_pad, tokens_pad = \
+                self._bucketer.assemble(requests)
         except BaseException as exc:
             # a poison batch still records its assembly span (the pump
             # fails these requests and keeps pumping — the trace should
@@ -309,7 +362,8 @@ class Batcher:
             for r in requests:
                 if r.trace is not None and r is not parent_req:
                     sp.link(r.trace)
-            sp.annotate(batch=bsz, real=real, padded=padded)
+            sp.annotate(batch=bsz, real=real, slots_padded=slots_pad,
+                        tokens_padded=tokens_pad)
             sp.finish()
         return _Batch(requests[0].key, bsz, arrays, requests, real,
-                      padded, trace=sp)
+                      slots_pad, tokens_pad, trace=sp)

@@ -19,9 +19,19 @@ Two bucket axes compose here:
   joins the 32-token bucket.
 
 Padding is real work the chip does for nothing, so the assembler
-reports it: *real elements / padded elements* feeds the
-``serving.tokens_real`` / ``serving.tokens_padded`` counters — the
-batch-formation-efficiency number the bench row prints.
+reports it — with the two pad axes kept SEPARATE, because they waste
+differently:
+
+- ``serving.tokens_padded`` — padded *sequence positions* inside
+  occupied batch slots (a 20-token request in a 32-token bucket wastes
+  12 positions): the length-bucket cost;
+- ``serving.slots_padded`` — *empty batch slots* (3 requests dispatched
+  as a padded batch of 4 waste one whole slot): the batch-bucket cost.
+
+``serving.tokens_real`` stays the numerator.  Conflating the two (as
+one "padded elements" denominator) polluted the sequence-padding
+efficiency number with batch-pad, which matters once the generation
+scheduler reports per-token decode efficiency.
 """
 from __future__ import annotations
 
@@ -126,14 +136,18 @@ class Bucketer:
 
     # -- assembly -----------------------------------------------------------
     @hot_path("dispatch")
-    def assemble(self, requests) -> Tuple[List[_np.ndarray], int, int, int]:
+    def assemble(self, requests
+                 ) -> Tuple[List[_np.ndarray], int, int, int, int]:
         """Pad-and-stack one bucket's requests into batch arrays.
 
-        Returns ``(arrays, batch_bucket, real_elements,
-        padded_elements)`` — the element counts (over the first input)
-        are the batch-formation-efficiency numerator/denominator.
-        Runs once per BATCH on the batcher thread; the pad buffers are
-        per-batch allocations amortized over every request in them.
+        Returns ``(arrays, batch_bucket, real_elements, slots_padded,
+        tokens_padded)``: element counts are over the first input.
+        ``slots_padded`` is the count of EMPTY batch slots (batch-bucket
+        rounding); ``tokens_padded`` is the padded sequence positions
+        within OCCUPIED slots (length-bucket rounding) — two different
+        wastes, counted apart.  Runs once per BATCH on the batcher
+        thread; the pad buffers are per-batch allocations amortized over
+        every request in them.
         """
         n = len(requests)
         bsz = self.batch_bucket(n)
@@ -148,7 +162,9 @@ class Bucketer:
                 buf[(i,) + tuple(slice(0, s) for s in a.shape)] = a
             arrays.append(buf)
         real = sum(int(req.inputs[0].size) for req in requests)
-        padded = bsz
+        slot_elems = 1
         for s in key[0][0]:
-            padded *= int(s)
-        return arrays, bsz, real, padded
+            slot_elems *= int(s)
+        slots_padded = bsz - n
+        tokens_padded = n * slot_elems - real
+        return arrays, bsz, real, slots_padded, tokens_padded

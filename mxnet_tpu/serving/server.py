@@ -41,17 +41,23 @@ from ..ndarray import NDArray, array as nd_array
 from ..observability import tracing as _tracing
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry
-from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded, Request,
-                      ServerClosed, ServerOverloaded)
-from .buckets import Bucketer
+from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded,
+                      GenRequest, Request, ServerClosed, ServerOverloaded)
+from .buckets import Bucketer, NoBucketError
+from .kv_cache import BlockKVCache
 
-__all__ = ["ModelServer"]
+__all__ = ["ModelServer", "GenerationServer"]
 
 MAX_BATCH_ENV = "MXTPU_SERVING_MAX_BATCH"
 QUEUE_DEPTH_ENV = "MXTPU_SERVING_QUEUE_DEPTH"
 DEADLINE_MS_ENV = "MXTPU_SERVING_DEADLINE_MS"
 WORKERS_ENV = "MXTPU_SERVING_WORKERS"
 BATCH_WINDOW_US_ENV = "MXTPU_SERVING_BATCH_WINDOW_US"
+KV_BLOCK_ENV = "MXTPU_SERVING_KV_BLOCK"
+KV_BLOCKS_ENV = "MXTPU_SERVING_KV_BLOCKS"
+DECODE_SLOTS_ENV = "MXTPU_SERVING_DECODE_SLOTS"
+PREFILL_MODE_ENV = "MXTPU_SERVING_PREFILL_MODE"
+MAX_NEW_ENV = "MXTPU_SERVING_MAX_NEW_TOKENS"
 
 
 def _live_window_s() -> float:
@@ -174,8 +180,14 @@ class ModelServer:
                  "numerator")
         self._c_padded = reg.counter(
             "serving.tokens_padded",
-            help="padded elements dispatched — batch-efficiency "
-                 "denominator")
+            help="padded sequence positions dispatched within occupied "
+                 "batch slots (length-bucket waste)")
+        self._c_slots_padded = reg.counter(
+            "serving.slots_padded",
+            help="empty batch slots dispatched (batch-bucket waste), "
+                 "counted in slots — kept apart from tokens_padded so "
+                 "sequence-padding efficiency is not polluted by "
+                 "batch-pad")
         self._flight = _flight_recorder() if flight is None else flight
         self._admission = AdmissionQueue(self.queue_depth,
                                          gauge=self._g_depth)
@@ -363,7 +375,9 @@ class ModelServer:
 
     def stats(self) -> dict:
         """Serving-side registry view plus the derived
-        batch-formation-efficiency ratio."""
+        sequence-padding-efficiency ratio (real positions over positions
+        dispatched in occupied slots — empty batch slots are reported
+        separately as ``slots_padded``, not folded into the ratio)."""
         real, padded = self._c_real.n, self._c_padded.n
         return {
             "requests": self._c_requests.n,
@@ -374,7 +388,9 @@ class ModelServer:
             "queue_depth": self._g_depth.value,
             "tokens_real": real,
             "tokens_padded": padded,
-            "batch_efficiency": round(real / padded, 4) if padded else 0.0,
+            "slots_padded": self._c_slots_padded.n,
+            "batch_efficiency": round(real / (real + padded), 4)
+            if real + padded else 0.0,
             "executables": len(self._graphs),
         }
 
@@ -398,6 +414,16 @@ class ModelServer:
                 g = self._block.cached_graph(*examples).raw
             else:
                 g = _freeze_generic(self._block, examples)
+            # one throwaway dispatch with HOST (numpy) arguments — the
+            # argument types live batches arrive with.  The build above
+            # warmed the executable against device-committed example
+            # arrays; jax keys the lowering on argument sharding, so
+            # without this the FIRST live batch would pay a second
+            # lowering+compile (measured: ~600ms on the transformer)
+            import jax as _jax
+            _jax.block_until_ready(g(
+                *[_np.zeros((batch,) + tuple(shape), dtype=dt)
+                  for shape, dt in key]))
             self._graphs[gk] = g
             return g
 
@@ -459,7 +485,8 @@ class ModelServer:
         self._h_dispatch.observe((time.monotonic() - t0) * 1e6)
         self._c_batches.inc()
         self._c_real.inc(batch.real)
-        self._c_padded.inc(batch.padded)
+        self._c_padded.inc(batch.tokens_padded)
+        self._c_slots_padded.inc(batch.slots_padded)
         for i, req in enumerate(batch.requests):
             req.batch_size = batch.batch
             row = self._unpad_row(tuple(o[i] for o in outs), req)
@@ -538,3 +565,597 @@ class ModelServer:
         """Assembly-failure path: same accounting as every other
         completion (flight record, timestamps), just with an error."""
         self._finish(req, error=error)
+
+
+class GenerationServer:
+    """ModelServer's generation mode: an **iteration-level** (token-level
+    continuous-batching) decode scheduler over a paged KV cache.
+
+    The whole-sequence :class:`ModelServer` batches one compiled call
+    per request set — fine for one-shot inference, but an autoregressive
+    decode loop batched that way strands the chip on the longest request
+    in every batch.  Here the schedulable unit is ONE DECODE STEP:
+
+    - ``submit_generate(prompt)`` enqueues a generation (bounded queue,
+      429 past the depth — same backpressure contract as ``submit``);
+    - admission into the *running batch* gates on **KV block
+      availability** (a worst-case reservation against the
+      :class:`~mxnet_tpu.serving.kv_cache.BlockKVCache` pool), not just
+      queue depth — an admitted request can never exhaust the pool
+      mid-decode;
+    - each admitted prompt runs ONE compiled **prefill** (batch 1,
+      padded to a length bucket — the existing bucketing discipline)
+      that scatters prompt K/V into the request's blocks and yields the
+      first token (TTFT is measured exactly here);
+    - every iteration dispatches ONE compiled **decode step** over all
+      running slots (signature = (slot-count, max-blocks), compiled
+      once, persistent-cache warm); finished requests leave their slot
+      and queued prefills join at the very next iteration — no request
+      ever waits for another's tail.
+
+    ``MXTPU_SERVING_PREFILL_MODE`` picks the prefill interleave:
+    ``"interleave"`` admits at most one prefill per decode iteration
+    (smooth decode cadence for running requests), ``"step"`` prefills
+    every admissible queued request before the next decode step (fastest
+    burst drain).  Read live per iteration; the bench measures both.
+
+    The model contract is three compiled entries sharing one parameter
+    set (see ``gluon.model_zoo.transformer.CausalLM``):
+    ``hybrid_forward`` (whole-sequence baseline), ``hybrid_prefill`` and
+    ``hybrid_decode`` (paged), plus ``init_kv_pool``.  Greedy decode
+    here is bitwise-reproducible per request regardless of batch
+    composition: every decode-step op is row-independent and the
+    additive mask underflows foreign/garbage keys to exact zero weight.
+    """
+
+    def __init__(self, block, *, slots: Optional[int] = None,
+                 kv_block: Optional[int] = None,
+                 kv_blocks: Optional[int] = None,
+                 queue_depth: Optional[int] = None,
+                 deadline_ms: Optional[float] = None,
+                 max_new_tokens: Optional[int] = None,
+                 prompt_buckets: Sequence[int] = (16, 32, 64),
+                 eos: Optional[int] = None,
+                 flight=None):
+        for need in ("hybrid_prefill", "hybrid_decode", "init_kv_pool"):
+            if not callable(getattr(block, need, None)):
+                raise MXNetError(
+                    f"generation serving needs a block with {need}() — "
+                    f"see gluon.model_zoo.transformer.CausalLM")
+        self._block = block
+        self._slots = max(1, int(get_env(DECODE_SLOTS_ENV)
+                                 if slots is None else slots))
+        self._target_slots = self._slots
+        self.queue_depth = int(get_env(QUEUE_DEPTH_ENV)
+                               if queue_depth is None else queue_depth)
+        self.deadline_ms = float(get_env(DEADLINE_MS_ENV)
+                                 if deadline_ms is None else deadline_ms)
+        self.max_new_cap = max(1, int(get_env(MAX_NEW_ENV)
+                                      if max_new_tokens is None
+                                      else max_new_tokens))
+        self.eos = eos
+        self._buckets = tuple(sorted(set(int(b) for b in prompt_buckets)))
+        self._kv = BlockKVCache(kv_blocks, kv_block)
+        # decode table width: worst-case blocks for the largest prompt
+        # bucket plus the generation cap — ONE decode signature per
+        # slot count
+        bs = self._kv.block_size
+        self._max_blocks = -(-(self._buckets[-1] + self.max_new_cap) // bs)
+        self._pool = block.init_kv_pool(self._kv.n_blocks, bs)
+        self._tables: Dict[int, object] = {}
+        reg = registry()
+        self._g_depth = reg.gauge(
+            "serving.queue_depth",
+            help="admission-queue depth (requests waiting for assembly)")
+        self._h_request = reg.histogram(
+            "serving.request_us",
+            help="per-request end-to-end latency (enqueue to done)")
+        self._h_ttft = reg.histogram(
+            "serving.ttft_us",
+            help="time to first token: generation enqueue to the "
+                 "prefill's first emitted token")
+        self._h_step = reg.histogram(
+            "serving.decode_step_us",
+            help="one iteration-level decode step: compiled call + "
+                 "batched logits readback over all running slots")
+        self._c_requests = reg.counter(
+            "serving.requests", help="requests admitted")
+        self._c_done = reg.counter(
+            "serving.requests_done", help="requests completed ok")
+        self._c_rej_429 = reg.counter(
+            "serving.rejected_429",
+            help="requests rejected at admission (queue full)")
+        self._c_rej_deadline = reg.counter(
+            "serving.rejected_deadline",
+            help="requests rejected at assembly (deadline expired)")
+        self._c_tokens = reg.counter(
+            "serving.tokens_generated",
+            help="tokens emitted by the generation scheduler (prefill "
+                 "first-tokens included)")
+        self._c_steps = reg.counter(
+            "serving.decode_steps", help="decode iterations dispatched")
+        self._flight = _flight_recorder() if flight is None else flight
+        self._queue = []
+        self._running = [None] * self._slots
+        self._lock = threading.Condition()
+        self._prefill_graphs: Dict[int, object] = {}
+        self._decode_graphs: Dict[int, object] = {}
+        # per-slot-count reusable decode-step assembly buffers (tokens,
+        # positions, tables), built with the graph so the per-step hot
+        # path allocates nothing
+        self._step_bufs: Dict[int, tuple] = {}
+        self._compile_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+        self._abort = False
+        self._rid = itertools.count()
+
+    # -- lifecycle ----------------------------------------------------
+    def start(self) -> "GenerationServer":
+        with self._lock:
+            if self._thread is not None:
+                return self
+            if self._closed:
+                raise ServerClosed("server already stopped")
+            self._thread = threading.Thread(
+                target=self._run, name="mxtpu-serving-decode-scheduler",
+                daemon=True)
+            self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Close admission; ``drain=True`` finishes every queued and
+        running generation through the normal path, else they fail with
+        ServerClosed (their KV blocks released either way)."""
+        with self._lock:
+            if self._closed and self._thread is None:
+                return
+            self._closed = True
+            if not drain:
+                self._abort = True
+            self._lock.notify_all()
+            t = self._thread
+        if t is not None:
+            t.join(timeout)
+        if t is None or not t.is_alive():
+            # never started (or fully joined): fail whatever remains
+            with self._lock:
+                shed, self._queue = self._queue, []
+                run = [r for r in self._running if r is not None]
+                self._running = [None] * self._slots
+            for r in shed + run:
+                self._finish_gen(r, error=ServerClosed(
+                    "server stopped" if t is not None
+                    else "server stopped before start"))
+            self._g_depth.set(0)
+        with self._lock:
+            self._thread = None
+
+    def __enter__(self) -> "GenerationServer":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(drain=exc_type is None)
+
+    # -- client surface -----------------------------------------------
+    def submit_generate(self, prompt, max_new_tokens: Optional[int] = None,
+                        deadline_ms: Optional[float] = None,
+                        eos: Optional[int] = None) -> GenRequest:
+        """Enqueue one generation: ``prompt`` is a 1-D sequence of token
+        ids; returns a :class:`GenRequest` future whose ``result()`` is
+        the greedy-decoded token ids (EOS included when hit).  Raises
+        :class:`ServerOverloaded` past the queue depth (429),
+        :class:`NoBucketError` when the prompt fits no length bucket or
+        the request could never fit the KV pool, and ``MXNetError`` past
+        the server's ``max_new_tokens`` cap (the cap sizes the compiled
+        decode signature's block table)."""
+        arr = _np.ascontiguousarray(_np.asarray(prompt).ravel(),
+                                    dtype=_np.int32)  # mxlint: disable=hidden-host-sync — request ingestion at the serving boundary
+        plen = int(arr.shape[0])
+        if plen < 1:
+            raise MXNetError("empty prompt")
+        self._bucket_for(plen)          # raises NoBucketError past max
+        mnt = self.max_new_cap if max_new_tokens is None \
+            else int(max_new_tokens)
+        if mnt < 1:
+            raise MXNetError("max_new_tokens must be >= 1")
+        if mnt > self.max_new_cap:
+            raise MXNetError(
+                f"max_new_tokens {mnt} exceeds the server cap "
+                f"{self.max_new_cap} (the cap sizes the decode "
+                f"signature; construct the server with a larger "
+                f"max_new_tokens)")
+        if not self._kv.fits(plen, mnt):
+            raise NoBucketError(
+                f"prompt of {plen} + {mnt} new tokens needs "
+                f"{self._kv.blocks_needed(plen, mnt)} KV blocks; the "
+                f"pool holds {self._kv.capacity}")
+        ms = self.deadline_ms if deadline_ms is None else float(deadline_ms)
+        deadline = (time.monotonic() + ms / 1e3) if ms > 0 else None
+        req = GenRequest(next(self._rid), arr, mnt, deadline,
+                         self.eos if eos is None else eos)
+        req.trace = _tracing.tracer().begin(
+            "serving.generate", activate=False,
+            args={"rid": req.rid, "prompt": plen, "max_new": mnt})
+        with self._lock:
+            if self._closed:
+                raise ServerClosed("server is shut down")
+            if len(self._queue) >= self.queue_depth:
+                self._c_rej_429.inc()
+                raise ServerOverloaded(
+                    f"admission queue full ({self.queue_depth} deep) — "
+                    f"retry with backoff (429)")
+            self._queue.append(req)
+            self._g_depth.set(len(self._queue))
+            self._lock.notify_all()
+        self._c_requests.inc()
+        return req
+
+    def generate(self, prompt, timeout: Optional[float] = None, **kw):
+        """Blocking convenience: submit + wait; returns the generated
+        token ids."""
+        return self.submit_generate(prompt, **kw).result(timeout)
+
+    def warmup(self) -> int:
+        """Precompile the decode-step signature and every prompt-bucket
+        prefill, so no live generation pays a compile.  On a warm
+        process with ``MXTPU_COMPILE_CACHE_DIR`` set this deserializes
+        instead of compiling (compiles==0).  Returns the number of
+        executables resident."""
+        self._decode_graph(self._slots)
+        for b in self._buckets:
+            self._prefill_graph(b)
+        return len(self._prefill_graphs) + len(self._decode_graphs)
+
+    def stats(self) -> dict:
+        with self._lock:
+            occupied = sum(1 for r in self._running if r is not None)
+            depth = len(self._queue)
+        return {
+            "requests": self._c_requests.n,
+            "done": self._c_done.n,
+            "rejected_429": self._c_rej_429.n,
+            "rejected_deadline": self._c_rej_deadline.n,
+            "queue_depth": depth,
+            "slots": self._slots,
+            "slots_occupied": occupied,
+            "tokens_generated": self._c_tokens.n,
+            "decode_steps": self._c_steps.n,
+            "kv_blocks_used": self._kv.used(),
+            "kv_blocks_total": self._kv.capacity,
+            "executables": len(self._prefill_graphs) +
+            len(self._decode_graphs),
+        }
+
+    # -- slot-count control (DecodeSlotController seam) ----------------
+    @property
+    def decode_slots(self) -> int:
+        return self._slots
+
+    def set_decode_slots(self, n: int) -> None:
+        """Retarget the running-batch slot count.  Takes effect between
+        iterations: growth immediately, shrink once occupancy allows —
+        running requests are never evicted.  A new slot count is a new
+        compiled decode signature (the recompile the
+        DecodeSlotController's bracketing stop economizes); previously
+        used counts stay cached."""
+        with self._lock:
+            self._target_slots = max(1, int(n))
+            self._lock.notify_all()
+
+    # -- compiled-graph resolution (cold path) -------------------------
+    def _bucket_for(self, plen: int) -> int:
+        for b in self._buckets:
+            if b >= plen:
+                return b
+        raise NoBucketError(
+            f"prompt length {plen} exceeds the largest prompt bucket "
+            f"{self._buckets[-1]}")
+
+    def _prefill_graph(self, bucket: int):
+        g = self._prefill_graphs.get(bucket)
+        if g is not None:
+            return g
+        with self._compile_lock:
+            g = self._prefill_graphs.get(bucket)
+            if g is None:
+                bs = self._kv.block_size
+                w = -(-bucket // bs)
+                g = self._block.cached_graph(
+                    _np.zeros((1, bucket), _np.int32),
+                    _np.zeros((1,), _np.int32),
+                    _np.zeros((1, w), _np.int32),
+                    self._pool, entry="prefill")
+                self._prewarm_locked(
+                    g, _np.zeros((1, bucket), _np.int32),
+                    _np.ones((1,), _np.int32),
+                    _np.zeros((1, w), _np.int32))
+                self._prefill_graphs[bucket] = g
+            return g
+
+    def _decode_graph(self, slots: int):
+        g = self._decode_graphs.get(slots)
+        if g is not None:
+            return g
+        with self._compile_lock:
+            g = self._decode_graphs.get(slots)
+            if g is None:
+                g = self._block.cached_graph(
+                    _np.zeros((slots,), _np.int32),
+                    _np.zeros((slots,), _np.int32),
+                    _np.zeros((slots, self._max_blocks), _np.int32),
+                    self._pool, entry="decode")
+                self._prewarm_locked(
+                    g, _np.zeros((slots,), _np.int32),
+                    _np.zeros((slots,), _np.int32),
+                    _np.zeros((slots, self._max_blocks), _np.int32))
+                self._step_bufs[slots] = (
+                    _np.zeros((slots,), _np.int32),
+                    _np.zeros((slots,), _np.int32),
+                    _np.zeros((slots, self._max_blocks), _np.int32))
+                self._decode_graphs[slots] = g
+            return g
+
+    def _prewarm_locked(self, graph, *host_args) -> None:
+        """Two throwaway ``raw`` dispatches with HOST (numpy) argument
+        types.  The cached-graph build warms the executable against
+        device-committed example arrays, but jax keys the lowering on
+        argument sharding — without this the FIRST live call would pay
+        a second lowering+compile (~700ms on the transformer).  Called
+        twice because the first flips ``self._pool`` from its initial
+        host array to the committed pool the graph returns, which is a
+        third signature; the second call IS steady state.  All-zero
+        block tables route the dummy KV writes into the scratch block,
+        which no real table row references."""
+        for _ in range(2):
+            logits, pool = graph.raw(*host_args, self._pool)
+            self._pool = pool
+        _np.asarray(logits)  # mxlint: disable=hidden-host-sync — cold-path warmup barrier, not a live request
+
+    # -- the scheduler loop --------------------------------------------
+    def _run(self) -> None:
+        while True:
+            with self._lock:
+                while (not self._queue
+                       and not any(r is not None for r in self._running)
+                       and not self._closed):
+                    self._lock.wait(0.1)
+                if self._abort:
+                    shed, self._queue = self._queue, []
+                    run = [r for r in self._running if r is not None]
+                    self._running = [None] * self._slots
+                    self._g_depth.set(0)
+                else:
+                    self._retarget_slots_locked()
+                    admit, expired = self._admit_locked()
+            if self._abort:
+                for r in shed + run:
+                    self._finish_gen(r, error=ServerClosed(
+                        "server stopped without draining"))
+                return
+            for r in expired:
+                self._expire_gen(r)
+            # graph/bucket resolution OUTSIDE the hot per-step root:
+            # first use compiles under the lock; after warmup these are
+            # dict hits
+            for req in admit:
+                bucket = self._bucket_for(len(req.prompt))
+                self._prefill(self._prefill_graph(bucket), req, bucket)
+            occupied = any(r is not None for r in self._running)
+            if occupied:
+                self._decode_step(self._decode_graph(self._slots))
+            elif not admit and not expired:
+                if self._closed:
+                    with self._lock:
+                        idle = not self._queue and not any(
+                            r is not None for r in self._running)
+                    if idle:
+                        return
+                else:
+                    # nothing flowed (e.g. pool exhausted by an earlier
+                    # admission wave): don't spin the condition hot
+                    time.sleep(0.002)
+
+    def _retarget_slots_locked(self) -> None:
+        tgt = self._target_slots
+        if tgt == self._slots:
+            return
+        occ = [r for r in self._running if r is not None]
+        if tgt < self._slots and len(occ) > tgt:
+            return          # shrink waits for occupancy, never evicts
+        self._running = occ + [None] * (tgt - len(occ))
+        self._slots = tgt
+
+    def _admit_locked(self):
+        """Sweep deadline-expired queued requests, then pop the FIFO
+        head while (a) a slot is open, (b) the KV pool honors the
+        worst-case block reservation, and (c) the live prefill-mode
+        budget allows — ``interleave`` admits at most one per decode
+        iteration, ``step`` fills every open slot."""
+        now = time.monotonic()
+        expired = [r for r in self._queue
+                   if r.deadline is not None and r.deadline < now]
+        if expired:
+            self._queue = [r for r in self._queue if r not in expired]
+        free = sum(1 for r in self._running if r is None)
+        mode = str(get_env(PREFILL_MODE_ENV)).lower()
+        budget = free if mode == "step" else min(free, 1)
+        admit = []
+        while budget > 0 and self._queue:
+            head = self._queue[0]
+            table = self._kv.reserve(head.rid, len(head.prompt),
+                                     head.max_new_tokens)
+            if table is None:
+                break           # blocks exhausted: FIFO holds the line
+            self._tables[head.rid] = table
+            self._queue.pop(0)
+            admit.append(head)
+            budget -= 1
+        self._g_depth.set(len(self._queue))
+        return admit, expired
+
+    # -- dispatch (hot path) -------------------------------------------
+    @hot_path("dispatch")
+    def _prefill(self, graph, req: GenRequest, bucket: int) -> None:
+        """One prompt prefill (batch 1, padded to ``bucket``): scatters
+        prompt K/V into the request's reserved blocks, emits the first
+        token (the TTFT measurement point), and seats the request in a
+        running-batch slot."""
+        sp = None if req.trace is None else _tracing.tracer().begin(
+            "serving.prefill", parent=req.trace, activate=False,
+            args={"bucket": bucket})
+        plen = len(req.prompt)
+        table = self._kv.ensure(req.rid, plen)
+        bs = self._kv.block_size
+        toks = _np.zeros((1, bucket), _np.int32)  # mxlint: disable=hot-path-purity — per-prefill pad buffer, amortized over the prompt
+        toks[0, :plen] = req.prompt
+        tb = _np.asarray([table.padded(-(-bucket // bs))], _np.int32)  # mxlint: disable=hot-path-purity — per-prefill block-table row, amortized over the prompt
+        req.t_prefill = time.monotonic()
+        try:
+            logits, pool = graph.raw(
+                toks, _np.asarray([plen], _np.int32), tb, self._pool)  # mxlint: disable=hot-path-purity — per-prefill scalar wrap, amortized over the prompt
+            self._pool = pool  # mxlint: disable=lock-discipline — scheduler-thread-owned; the lock-held writes happen in pre-start warmup
+            tok = int(_np.asarray(logits)[0].argmax())  # mxlint: disable=hidden-host-sync,hot-path-purity — first-token readback: TTFT is measured on host arrival
+        except BaseException as exc:
+            if sp is not None:
+                sp.annotate(error=type(exc).__name__)
+                sp.finish()
+            self._finish_gen(req, error=exc
+                             if isinstance(exc, Exception) else
+                             MXNetError(str(exc)))
+            if not isinstance(exc, Exception):
+                raise
+            return
+        req.t_first = time.monotonic()
+        trace_id = None if req.trace is None else req.trace.trace_id
+        self._h_ttft.observe((req.t_first - req.t_enqueue) * 1e6,
+                             trace_id=trace_id)
+        req.tokens.append(tok)
+        req.pos = plen          # the new token decodes at position plen
+        self._c_tokens.inc()
+        if sp is not None:
+            sp.finish()
+        if (req.eos is not None and tok == req.eos) \
+                or len(req.tokens) >= req.max_new_tokens:
+            self._finish_gen(req)
+            return
+        with self._lock:
+            slot = self._running.index(None)
+            self._running[slot] = req
+
+    @hot_path("dispatch")
+    def _decode_step(self, graph) -> None:
+        """ONE iteration of the decode scheduler: a single compiled call
+        advances every running slot by one token, then one batched
+        logits readback fans results out — finished requests free their
+        slot (and KV blocks) before the next iteration's admissions."""
+        occupied = [(i, r) for i, r in enumerate(self._running)
+                    if r is not None]
+        sp = None
+        for _, r in occupied:
+            if r.trace is not None:
+                sp = _tracing.tracer().begin(
+                    "serving.decode_step", parent=r.trace,
+                    activate=False,
+                    args={"occupied": len(occupied),
+                          "slots": self._slots})
+                for _, o in occupied:
+                    if o.trace is not None and o is not r:
+                        sp.link(o.trace)
+                break
+        # reused per-slot-count assembly buffers (built with the graph);
+        # zeroed every step so empty slots and table tails land in the
+        # scratch block, never a live request's blocks
+        tokens, positions, tables = self._step_bufs[self._slots]
+        tokens.fill(0)
+        positions.fill(0)
+        tables.fill(0)
+        for i, r in occupied:
+            # lazy block growth: back the write position; infallible
+            # under the admission-time reservation
+            table = self._kv.ensure(r.rid, r.pos + 1)
+            tokens[i] = r.tokens[-1]
+            positions[i] = r.pos
+            tables[i, :] = table.padded(self._max_blocks)
+        t0 = time.monotonic()
+        try:
+            logits, pool = graph.raw(tokens, positions, tables,
+                                     self._pool)
+            self._pool = pool  # mxlint: disable=lock-discipline — scheduler-thread-owned; the lock-held writes happen in pre-start warmup
+            lg = _np.asarray(logits)  # mxlint: disable=hidden-host-sync,hot-path-purity — ONE batched logits readback per decode step (results are host tokens by contract)
+        except BaseException as exc:
+            if sp is not None:
+                sp.annotate(error=type(exc).__name__)
+                sp.finish()
+            with self._lock:
+                for i, _ in occupied:
+                    self._running[i] = None
+            for _, r in occupied:
+                self._finish_gen(r, error=exc
+                                 if isinstance(exc, Exception) else
+                                 MXNetError(str(exc)))
+            if not isinstance(exc, Exception):
+                raise
+            return
+        trace_id = None if sp is None else sp.trace_id
+        self._h_step.observe((time.monotonic() - t0) * 1e6,
+                             trace_id=trace_id)
+        self._c_steps.inc()
+        finished = []
+        for i, r in occupied:
+            tok = int(lg[i].argmax())  # mxlint: disable=hidden-host-sync — lg is already host memory; this argmax is numpy, not a device round-trip
+            r.tokens.append(tok)
+            r.pos += 1
+            self._c_tokens.inc()
+            if (r.eos is not None and tok == r.eos) \
+                    or len(r.tokens) >= r.max_new_tokens:
+                finished.append((i, r))
+        if finished:
+            with self._lock:
+                for i, _ in finished:
+                    self._running[i] = None
+            for _, r in finished:
+                self._finish_gen(r)
+        if sp is not None:
+            sp.finish()
+
+    # -- completion paths ----------------------------------------------
+    def _finish_gen(self, req: GenRequest, error=None) -> None:
+        """Every generation exit path lands here — finish, deadline,
+        abort, dispatch failure — so KV blocks (and the unused tail of
+        the reservation) can never leak."""
+        self._kv.release(req.rid)
+        with self._lock:
+            self._tables.pop(req.rid, None)
+        req.t_done = time.monotonic()
+        req._error = error
+        dur_us = (req.t_done - req.t_enqueue) * 1e6
+        trace_id = None
+        if req.trace is not None:
+            trace_id = req.trace.trace_id
+            if error is not None:
+                req.trace.annotate(error=type(error).__name__)
+            req.trace.annotate(tokens=len(req.tokens))
+            req.trace.finish()
+        if error is None:
+            self._h_request.observe(dur_us, trace_id=trace_id)
+            self._c_done.inc()
+        self._flight.record_request(
+            request_id=req.rid,
+            enqueue=round(req.t_enqueue, 6),
+            assemble=round(req.t_prefill, 6),
+            dispatch=round(req.t_first, 6),
+            done=round(req.t_done, 6),
+            bucket=f"gen:{len(req.prompt)}+{len(req.tokens)}",
+            batch_size=self._slots,
+            us=round(dur_us, 1),
+            trace_id=trace_id,
+            ok=error is None)
+        req._event.set()
+
+    def _expire_gen(self, req: GenRequest) -> None:
+        self._c_rej_deadline.inc()
+        self._finish_gen(req, error=DeadlineExceeded(
+            f"generation {req.rid} spent its deadline queued "
+            f"(429-style); the server is over capacity — back off"))

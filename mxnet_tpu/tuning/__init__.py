@@ -16,9 +16,12 @@ observability spine already measures:
   (timer-thread fleet metric gather over the barrier-free KV
   transport), :class:`~.controllers.DevicePrefetchController` (the
   loader's device double-buffer depth vs HBM from the
-  ``loader.device_put_us`` jitter) and — constructed per trainer, not
-  stock — :class:`~.controllers.CommBucketController`
-  (``MXTPU_COMM_BUCKET_MB`` hill-climb on ``resilience.step_us``);
+  ``loader.device_put_us`` jitter) and — constructed per live
+  instance, not stock — :class:`~.controllers.CommBucketController`
+  (``MXTPU_COMM_BUCKET_MB`` hill-climb on ``resilience.step_us``) and
+  :class:`~.controllers.DecodeSlotController` (a GenerationServer's
+  decode-slot width hill-climbed on interval tokens/s, with the same
+  bracketing stop — every move is a recompile);
 - :mod:`.compile_cache` — compiled executables (exact-mode bulk
   segments, HybridBlock cached graphs) serialized to
   ``MXTPU_COMPILE_CACHE_DIR`` and reloaded by later processes, so
@@ -39,7 +42,7 @@ Quick start::
 
 Knobs: ``MXTPU_TUNE_INTERVAL``, ``MXTPU_TUNE_DRY_RUN``,
 ``MXTPU_TUNE_BULK`` / ``_PREFETCH`` / ``_BATCH_WINDOW`` /
-``_FLEET_GATHER``, ``MXTPU_COMPILE_CACHE_DIR``,
+``_FLEET_GATHER`` / ``_DECODE_SLOTS``, ``MXTPU_COMPILE_CACHE_DIR``,
 ``MXTPU_COMPILE_CACHE_JAX`` (see the README knob table).
 """
 from __future__ import annotations
@@ -53,15 +56,16 @@ from ..observability.registry import registry as _metrics_registry
 from . import compile_cache
 from .controllers import (BatchWindowController, BulkSizeController,
                           CommBucketController, Controller, CounterDelta,
-                          DevicePrefetchController, FleetGatherController,
-                          HistogramDelta, PrefetchController)
+                          DecodeSlotController, DevicePrefetchController,
+                          FleetGatherController, HistogramDelta,
+                          PrefetchController)
 
 __all__ = ["TuningRuntime", "runtime", "standard_controllers", "start",
            "stop", "Controller", "BulkSizeController",
            "PrefetchController", "BatchWindowController",
            "FleetGatherController", "CommBucketController",
-           "DevicePrefetchController", "HistogramDelta", "CounterDelta",
-           "compile_cache"]
+           "DecodeSlotController", "DevicePrefetchController",
+           "HistogramDelta", "CounterDelta", "compile_cache"]
 
 INTERVAL_ENV = "MXTPU_TUNE_INTERVAL"
 

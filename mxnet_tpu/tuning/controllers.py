@@ -55,7 +55,8 @@ from ..observability.registry import (_percentile_from, registry,
 
 __all__ = ["Controller", "BulkSizeController", "PrefetchController",
            "BatchWindowController", "FleetGatherController",
-           "CommBucketController", "DevicePrefetchController",
+           "CommBucketController", "DecodeSlotController",
+           "DevicePrefetchController",
            "HistogramDelta", "CounterDelta", "exemplar_ids"]
 
 DRY_RUN_ENV = "MXTPU_TUNE_DRY_RUN"
@@ -685,6 +686,136 @@ class CommBucketController(Controller):
 
     def apply(self, value) -> None:
         self._trainer.set_comm_bucket_mb(float(value))
+
+
+# ---------------------------------------------------------------------------
+# DecodeSlotController — running-batch width for the decode scheduler
+# ---------------------------------------------------------------------------
+
+class DecodeSlotController(Controller):
+    """Hill-climb a :class:`~mxnet_tpu.serving.GenerationServer`'s
+    decode-slot count (the running-batch width of the iteration-level
+    scheduler) on measured interval **tokens per second of decode
+    time**.
+
+    The tradeoff is real in both directions: too FEW slots and the chip
+    decodes a narrow batch while admissible prompts queue (throughput
+    left on the floor); too MANY and each step's gather spans a wider
+    KV working set, per-step latency grows, and — with slots the
+    offered load can't fill — padded rows dilute every step.  The
+    optimum depends on model size, KV pool, and traffic, so it is
+    searched, not configured.
+
+    Signal: ``Δserving.tokens_generated / Δserving.decode_step_us``
+    (counter delta over histogram-total delta) — tokens per second of
+    decode-step wall time, wall-clock-free like every controller here,
+    and immune to idle gaps between bursts (an interval with fewer
+    than ``min_steps`` decode steps holds).
+
+    Every move is a RECOMPILE — a new slot count is a new compiled
+    decode signature — so this controller carries the full
+    :class:`CommBucketController` discipline: settle intervals discard
+    the post-move compile spike, and a **bracketing stop** parks at the
+    best measured slot count after two direction reversals (both
+    neighbors measured worse), re-arming only when interval tokens/s
+    decays below ``1/rearm`` of the best — the traffic actually
+    changed.  Needs a live server (``set_decode_slots`` is an instance
+    surface), so it is NOT in the stock ``standard_controllers`` set —
+    attach it explicitly, gated by ``MXTPU_TUNE_DECODE_SLOTS``."""
+
+    name = "decode_slots"
+    knob = "MXTPU_SERVING_DECODE_SLOTS"
+    enable_env = "MXTPU_TUNE_DECODE_SLOTS"
+
+    def __init__(self, server, *, vmin: int = 1, vmax: int = 64,
+                 min_steps: int = 8, tol: float = 0.03,
+                 settle_intervals: int = 1, rearm: float = 1.25, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, **kw)
+        self._server = server
+        self.min_steps = int(min_steps)
+        self.tol = float(tol)
+        self.settle_intervals = int(settle_intervals)
+        self.rearm = float(rearm)
+        reg = registry()
+        self._step_us = HistogramDelta(
+            reg.histogram("serving.decode_step_us"))
+        self._tokens = CounterDelta(
+            reg.counter("serving.tokens_generated"))
+        self._dir = 1
+        self._settle = 0
+        self._flips = 0      # reversals since the last NEW best score
+        self._best: Optional[float] = None
+        self._best_slots: int = 0
+        self._last_score: Optional[float] = None
+
+    def current(self) -> float:
+        return int(self._server.decode_slots)
+
+    def on_applied(self, value) -> None:
+        self._settle = self.settle_intervals
+
+    def decide(self):
+        d = self._step_us.take()
+        tokens = self._tokens.take()
+        if d is None or d["count"] < self.min_steps or tokens <= 0:
+            return None
+        if self._settle > 0:
+            # spend the settle credit only on an interval that carried
+            # steps at the new width (the compile spike)
+            self._settle -= 1
+            return None
+        self._tick_exemplars = exemplar_ids(self._step_us.hist)
+        cur = int(self.current())
+        score = tokens / max(d["total"] / 1e6, 1e-9)   # tok/s decode time
+        # hill-climb MAXIMIZES here (CommBucket minimizes step time):
+        # "regressed" = fewer tokens/s than the last interval
+        new_best = self._best is None or \
+            score > self._best * (1 + self.tol)
+        if self._best is None or score > self._best:
+            self._best = score
+            self._best_slots = cur
+        if self._flips < 2:
+            if self._last_score is None:
+                self._last_score = score  # first full interval: probe up
+            elif score < self._last_score * (1 - self.tol):
+                self._dir = -self._dir   # regressed: turn around
+                # a recovery that merely RETURNS to the best does not
+                # reset the flip count — only a NEW best does, so an
+                # optimum->neighbor->optimum cycle reaches 2 flips
+                self._flips += 1
+                self._last_score = score
+            elif score > self._last_score * (1 + self.tol):
+                self._last_score = score  # improved: keep climbing
+                if new_best:
+                    self._flips = 0       # genuine progress re-arms
+            else:
+                self._last_score = score  # plateau: converged — hold
+                return None
+        if self._flips >= 2:
+            # bracketed: both neighbors of the best width measured
+            # worse — park at the best (each move is a recompile)
+            # until the traffic shifts, read as interval tokens/s
+            # decaying well below the best
+            if score < self._best / self.rearm:
+                self._flips = 0
+                self._best = score
+                self._best_slots = cur
+                self._last_score = score
+                return None
+            if cur != self._best_slots:
+                return self._best_slots, (
+                    f"bracketed (2 reversals): parking at the best "
+                    f"measured width {self._best_slots} slots")
+            return None
+        nxt = cur * 2 if self._dir > 0 else max(1, cur // 2)
+        if nxt == cur:
+            nxt = cur + self._dir
+        return nxt, (f"decode tok/s={score:.0f} "
+                     f"step p99={d['p99']:.0f}us steps={d['count']} "
+                     f"dir={self._dir:+d}")
+
+    def apply(self, value) -> None:
+        self._server.set_decode_slots(int(value))
 
 
 # ---------------------------------------------------------------------------

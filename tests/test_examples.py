@@ -32,6 +32,14 @@ def test_example_serve_continuous_batching_runs():
     assert "batch efficiency" in r.stdout
 
 
+def test_example_serve_generation_runs():
+    r = _run(["examples/serve_generation.py", "--clients", "2",
+              "--requests", "6"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "completed 12 generations" in r.stdout
+    assert "KV blocks used after drain: 0" in r.stdout
+
+
 def test_example_elastic_fleet_runs():
     """3-worker fleet, one host SIGKILLed mid-run: the example must
     print both survivors' re-form lines and the OK marker."""

@@ -674,6 +674,12 @@ def test_repo_hot_roots_are_declared():
         in roots
     assert "mxnet_tpu/serving/batcher.py::Batcher._assemble" in roots
     assert "mxnet_tpu/serving/buckets.py::Bucketer.assemble" in roots
+    # the generation path (PR-14): per-step decode + prompt prefill —
+    # graph/bucket resolution stays OUTSIDE these roots by design
+    assert ("mxnet_tpu/serving/server.py::GenerationServer._decode_step"
+            in roots)
+    assert ("mxnet_tpu/serving/server.py::GenerationServer._prefill"
+            in roots)
 
 
 def test_two_pass_full_repo_under_three_seconds():

@@ -10,6 +10,7 @@ paths, and the thin 'bare-except' mxlint gate (the walker itself lives
 in mxnet_tpu/tools/mxlint)."""
 import os
 import signal
+import threading
 
 import numpy as np
 import pytest
@@ -808,8 +809,22 @@ def test_async_ckpt_commit_off_step_path(tmp_path, monkeypatch):
         tr.step(x, y)
     h = registry().histogram("ckpt.async_commit_us")
     n0 = h.count
+    # gate the background writer so the in-flight window is observable
+    # deterministically (on a tiny model the commit can otherwise win
+    # the race and land before the gauge assertion runs)
+    gate = threading.Event()
+    real_write = ShardedTrainer._write_host_local
+
+    def gated_write(*a, **kw):
+        assert gate.wait(30)
+        return real_write(*a, **kw)
+
+    monkeypatch.setattr(ShardedTrainer, "_write_host_local",
+                        staticmethod(gated_write))
     tr.save_checkpoint(str(tmp_path))
     assert registry().gauge("resilience.ckpt_inflight").value == 1
+    assert h.count == n0          # commit strictly after the wait
+    gate.set()
     tr.wait_checkpoint()
     assert registry().gauge("resilience.ckpt_inflight").value == 0
     assert h.count == n0 + 1

@@ -76,9 +76,11 @@ def test_assembly_pads_and_counts_efficiency():
             self.key = b.sample_key(self.inputs)
 
     reqs = [R(10), R(20), R(5)]
-    arrays, bsz, real, padded = b.assemble(reqs)
+    arrays, bsz, real, slots_padded, tokens_padded = b.assemble(reqs)
     assert bsz == 4 and arrays[0].shape == (4, 32)
-    assert real == 35 and padded == 4 * 32
+    assert real == 35
+    assert slots_padded == 1                     # batch-bucket waste
+    assert tokens_padded == 3 * 32 - 35          # length-bucket waste
     np.testing.assert_array_equal(arrays[0][1, :20], np.arange(20))
     assert arrays[0][1, 20:].sum() == 0          # zero padding
     assert arrays[0][3].sum() == 0               # empty batch slot
@@ -302,21 +304,28 @@ def test_metrics_emitted():
     b0 = reg.counter("serving.batches").n
     r0 = reg.counter("serving.tokens_real").n
     p0 = reg.counter("serving.tokens_padded").n
+    s0 = reg.counter("serving.slots_padded").n
     net = _mlp()
-    srv = ModelServer(net, max_batch=4, deadline_ms=0)
+    # length buckets so sequence padding is exercised: 10-elem requests
+    # ride the 16 bucket (6 padded positions each, 0 padded slots)
+    srv = ModelServer(net, max_batch=4, deadline_ms=0,
+                      length_buckets=(16,), pad_axis=0)
     try:
         srv.warmup(np.zeros((16,), np.float32))
         srv.start()
         for _ in range(5):
-            srv.infer(np.zeros((16,), np.float32), timeout=60)
+            srv.infer(np.zeros((10,), np.float32), timeout=60)
     finally:
         srv.stop()
     assert reg.histogram("serving.request_us").count == h0 + 5
     assert reg.counter("serving.requests_done").n == d0 + 5
     assert reg.counter("serving.batches").n > b0
     real = reg.counter("serving.tokens_real").n - r0
-    padded = reg.counter("serving.tokens_padded").n - p0
-    assert real == 5 * 16 and padded >= real
+    tokens_padded = reg.counter("serving.tokens_padded").n - p0
+    slots_padded = reg.counter("serving.slots_padded").n - s0
+    assert real == 5 * 10
+    assert tokens_padded == 5 * 6       # length-bucket waste only
+    assert slots_padded >= 0            # batch-bucket waste counted apart
     assert "serving.queue_depth" in reg.snapshot()
 
 

@@ -453,6 +453,113 @@ def test_comm_bucket_controller_holds_when_bucketing_off():
     assert tr.applied == []
 
 
+# -- DecodeSlotController (generation: running-batch width) ------------------
+
+class _FakeGenServer:
+    """decode_slots surface only — the controller's apply target."""
+
+    def __init__(self, slots):
+        self.decode_slots = slots
+        self.applied = []
+
+    def set_decode_slots(self, n):
+        self.decode_slots = int(n)
+        self.applied.append(int(n))
+
+
+def _feed_decode(step_us, tokens, n=12):
+    h = registry().histogram("serving.decode_step_us")
+    for _ in range(n):
+        h.observe(step_us)
+    registry().counter("serving.tokens_generated").inc(tokens)
+
+
+def test_decode_slot_controller_hill_climb_with_settle():
+    """Probe up on interval tokens-per-decode-second, keep an improving
+    direction, reverse a regression — and discard the first interval
+    after every applied move (a new slot count is a new compiled decode
+    signature; its compile spike must not read as a regression)."""
+    from mxnet_tpu.tuning import DecodeSlotController
+    srv = _FakeGenServer(4)
+    c = DecodeSlotController(srv, min_steps=4, settle_intervals=1,
+                             hysteresis=1, enabled=True, dry_run=False)
+    c.tick()                             # baseline the interval views
+    _feed_decode(1000.0, tokens=48)
+    d = c.tick()                         # first interval: probe up
+    assert d["applied"] and srv.decode_slots == 8
+    _feed_decode(5000.0, tokens=48)      # compile-contaminated interval
+    assert c.tick() is None              # ...spent on the settle credit
+    _feed_decode(1000.0, tokens=60)      # clean + improved: keep going
+    d = c.tick()
+    assert d["applied"] and srv.decode_slots == 16
+    _feed_decode(4000.0, tokens=60)
+    assert c.tick() is None              # settle again
+    _feed_decode(1000.0, tokens=40)      # regressed > tol: turn around
+    d = c.tick()
+    assert d["applied"] and srv.decode_slots == 8
+    _feed_decode(3000.0, tokens=40)
+    assert c.tick() is None
+    _feed_decode(1000.0, tokens=40)      # within tol: plateau = hold
+    assert c.tick() is None
+    assert srv.applied == [8, 16, 8]
+
+
+def test_decode_slot_controller_brackets_instead_of_cycling():
+    """The recompile-cost guard (the CommBucketController discipline):
+    when both neighboring widths of the optimum measure worse, two
+    reversals without a NEW best park the controller at the best
+    measured slot count; it re-arms only when interval tokens/s decays
+    well below that best (the traffic shifted)."""
+    from mxnet_tpu.tuning import DecodeSlotController
+    srv = _FakeGenServer(4)
+    c = DecodeSlotController(srv, min_steps=4, settle_intervals=0,
+                             hysteresis=1, enabled=True, dry_run=False)
+    c.tick()
+    _feed_decode(100.0, tokens=48)
+    d = c.tick()                         # probe up: 4 -> 8
+    assert d["applied"] and srv.decode_slots == 8
+    _feed_decode(100.0, tokens=40)       # 8 is worse: reversal #1
+    d = c.tick()
+    assert d["applied"] and srv.decode_slots == 4
+    _feed_decode(100.0, tokens=48)       # back at the optimum — NOT a
+    d = c.tick()                         # new best: keeps descending
+    assert d["applied"] and srv.decode_slots == 2
+    _feed_decode(100.0, tokens=42)       # 2 is worse: reversal #2 —
+    d = c.tick()                         # bracketed; park at the best
+    assert d["applied"] and srv.decode_slots == 4
+    assert "bracketed" in d["reason"]
+    for _ in range(3):                   # parked: no more recompiles
+        _feed_decode(100.0, tokens=48)
+        assert c.tick() is None
+    assert srv.applied == [8, 4, 2, 4]
+    _feed_decode(100.0, tokens=30)       # traffic shift (tokens/s well
+    assert c.tick() is None              # below best): re-arm, re-base
+    _feed_decode(100.0, tokens=38)       # improving again: climb resumes
+    assert c.tick() is not None
+
+
+def test_decode_slot_controller_idle_interval_holds():
+    """An interval with too few decode steps (or zero tokens) is no
+    evidence — an idle server must not drive the width anywhere."""
+    from mxnet_tpu.tuning import DecodeSlotController
+    srv = _FakeGenServer(4)
+    c = DecodeSlotController(srv, min_steps=8, hysteresis=1,
+                             enabled=True, dry_run=False)
+    c.tick()
+    _feed_decode(1000.0, tokens=10, n=3)   # < min_steps
+    assert c.tick() is None
+    assert srv.applied == []
+
+
+def test_decode_slot_controller_enable_knob_defaults_off():
+    from mxnet_tpu.tuning import DecodeSlotController
+    srv = _FakeGenServer(4)
+    c = DecodeSlotController(srv)        # enabled=None -> knob-gated
+    assert c.enable_env == "MXTPU_TUNE_DECODE_SLOTS"
+    assert not c.enabled                 # off by default: attach is
+    assert c.tick() is None              # an explicit operator choice
+
+
 # -- DevicePrefetchController (overlap: device-input double buffer) ----------
 
 def _feed_device_puts(values):
@@ -672,9 +779,11 @@ def test_standard_controllers_cover_stock_set():
     assert [c.name for c in cs] == ["bulk_size", "prefetch",
                                     "batch_window", "fleet_gather",
                                     "device_prefetch"]
-    # CommBucketController stays out of the stock set by design: it
-    # needs a live trainer whose jit its apply rebuilds
+    # CommBucketController and DecodeSlotController stay out of the
+    # stock set by design: each needs a live instance (trainer /
+    # generation server) whose compiled artifact its apply rebuilds
     assert "comm_bucket" not in [c.name for c in cs]
+    assert "decode_slots" not in [c.name for c in cs]
 
 
 # -- flight-recorder tuning ring --------------------------------------------
