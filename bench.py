@@ -1423,6 +1423,127 @@ def bench_overlap(per_config_timeout=600):
     return rows
 
 
+def _recommender_one_main(spec):
+    """Entry for ONE recommender config subprocess
+    (``--recommender-one dp,sparse``): a wide-embedding two-tower MLP
+    (user/item towers over a shared 100k vocab) trained under
+    Zipfian(1.05) id traffic on the pinned-core CPU mesh, timing the
+    step and reading the sparse.* exchange counters back out of the
+    metrics registry."""
+    dp, sparse = (int(v) for v in spec.split(","))
+    _pin_cpu_mesh(dp)
+    import jax
+    import mxnet_tpu as mx
+    from mxnet_tpu import parallel as par
+    from mxnet_tpu.gluon import nn, loss as gloss
+    from mxnet_tpu.gluon.block import HybridBlock
+    from mxnet_tpu.observability.registry import registry
+
+    VOCAB, DIM, B = 100_000, 64, 2048
+    np.random.seed(0)
+    mx.random.seed(0)
+
+    class TwoTower(HybridBlock):
+        def __init__(self, **kw):
+            super().__init__(**kw)
+            with self.name_scope():
+                self.user = nn.Embedding(VOCAB, DIM,
+                                         sparse_grad=bool(sparse))
+                self.item = nn.Embedding(VOCAB, DIM,
+                                         sparse_grad=bool(sparse))
+                self.user_mlp = nn.Dense(64, activation="relu")
+                self.item_mlp = nn.Dense(64, activation="relu")
+                self.top = nn.Dense(2)
+
+        def hybrid_forward(self, F, x):
+            u = self.user_mlp(F.flatten(
+                self.user(F.slice_axis(x, axis=1, begin=0, end=1))))
+            i = self.item_mlp(F.flatten(
+                self.item(F.slice_axis(x, axis=1, begin=1, end=2))))
+            return self.top(F.concat(u, i, dim=1))
+
+    net = TwoTower(prefix="rec_")
+    net.initialize(mx.init.Xavier(rnd_type="uniform"))
+    tr = par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                            "adam", {"learning_rate": 1e-3},
+                            mesh=par.make_mesh({"dp": dp}))
+    # Zipfian(1.05) id traffic, the canonical recommender popularity
+    # skew; clip folds the open tail onto the coldest id
+    ids = np.minimum(np.random.zipf(1.05, (B, 2)) - 1,
+                     VOCAB - 1).astype(np.float32)
+    y = np.random.randint(0, 2, (B,))
+    uniq = max(len(np.unique(ids[:, 0])), len(np.unique(ids[:, 1])))
+    iters, warmup = 10, 3
+    for _ in range(warmup):
+        tr.step(ids, y)
+    jax.block_until_ready(tr._pvals)
+    s0 = registry().snapshot()
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        loss = tr.step(ids, y)
+    jax.block_until_ready(loss._read())
+    dt = time.perf_counter() - t0
+    s1 = registry().snapshot()
+
+    def delta(name):
+        return (s1.get(name, 0) - s0.get(name, 0)) / iters
+
+    print(json.dumps({
+        "dp": dp, "sparse": bool(sparse),
+        "step_us": round(dt / iters * 1e6, 1),
+        "examples_s": round(B * iters / dt, 1),
+        "unique_id_frac": round(uniq / VOCAB, 4),
+        "exchange_bytes_per_step": round(delta("sparse.exchange_bytes")),
+        "dense_equiv_bytes_per_step": round(
+            delta("sparse.exchange_bytes_dense_equiv")),
+        "grad_rows_per_step": round(delta("sparse.grad_rows")),
+    }))
+
+
+def bench_recommender(per_config_timeout=600):
+    """Recommender row (the sparse-embedding fast-path acceptance):
+    two-tower MLP over two 100k x 64 tables, Zipfian(1.05) ids
+    (batch-unique ids ~2% of vocab by construction), sparse_grad on
+    vs off at dp=1 and dp=4 on the pinned-core CPU mesh.  The dp=1
+    comparison is the in-graph win (segment-sum backward + lazy row
+    update vs dense scatter + full-table update); the dp=4 comparison
+    adds the wire story — logical exchange bytes of the (ids, rows)
+    layout vs the dense table-sized reduction it replaced.  The
+    on-chip rerun is queued in the PERF.md runbook."""
+    import sys
+    grid = {}
+    for dp in (1, 4):
+        grid[f"dp{dp}"] = {
+            "dense": _grid_cell("--recommender-one", f"{dp},0",
+                                per_config_timeout),
+            "sparse": _grid_cell("--recommender-one", f"{dp},1",
+                                 per_config_timeout)}
+    row = {"model": "two-tower MLP, 2 x (100k x 64) embedding tables, "
+                    "adam, fp32, Zipfian(1.05) ids, batch 2048",
+           "chip": "1 pinned CPU core per virtual chip",
+           "grid": grid}
+    try:
+        for dp in (1, 4):
+            d, s = grid[f"dp{dp}"]["dense"], grid[f"dp{dp}"]["sparse"]
+            row[f"sparse_step_speedup_dp{dp}"] = round(
+                d["step_us"] / s["step_us"], 2)
+        sp4 = grid["dp4"]["sparse"]
+        row["exchange_bytes_reduction_dp4"] = round(
+            sp4["dense_equiv_bytes_per_step"] /
+            sp4["exchange_bytes_per_step"], 1)
+        row["unique_id_frac"] = sp4["unique_id_frac"]
+        print(f"recommender: sparse step "
+              f"{row['sparse_step_speedup_dp1']}x at dp1 / "
+              f"{row['sparse_step_speedup_dp4']}x at dp4; exchange "
+              f"bytes -{row['exchange_bytes_reduction_dp4']}x at dp4 "
+              f"({100 * row['unique_id_frac']:.1f}% of vocab live "
+              f"per batch)", file=sys.stderr)
+    except (KeyError, TypeError, ZeroDivisionError):
+        row["error_summary"] = "one or more grid cells failed " \
+                               "(see grid entries)"
+    return row
+
+
 def bench_autotune(duration_s=2.0):
     """Autotune row — the three self-tuning acceptance comparisons:
 
@@ -1675,7 +1796,8 @@ def main():
                                        "bert", "bert_bf16",
                                        "nmt", "ssd", "pipeline",
                                        "serving", "generate", "autotune",
-                                       "multichip", "overlap"],
+                                       "multichip", "overlap",
+                                       "recommender"],
                     help="run a single row (default: the full suite)")
     ap.add_argument("--multichip-one", metavar="DP,ZERO",
                     help="internal: measure ONE multichip grid config "
@@ -1686,6 +1808,9 @@ def main():
     ap.add_argument("--generate-one", metavar="SCHED:ARGS",
                     help="internal: measure ONE generation cell "
                          "(core-pinned subprocess of --only generate)")
+    ap.add_argument("--recommender-one", metavar="DP,SPARSE",
+                    help="internal: measure ONE recommender grid config "
+                         "(core-pinned subprocess of --only recommender)")
     ap.add_argument("--dtype", choices=["float32", "bfloat16"],
                     default=None,
                     help="kept for compat: forces the single resnet row")
@@ -1708,6 +1833,20 @@ def main():
         return
     if args.generate_one:
         _generate_one_main(args.generate_one)
+        return
+    if args.recommender_one:
+        _recommender_one_main(args.recommender_one)
+        return
+    if args.only == "recommender":
+        # CPU-host row like multichip: every cell is its own CPU-forced
+        # core-pinned subprocess, so the chip probe is skipped
+        row = bench_recommender()
+        print(json.dumps({
+            "metric": "recommender_sparse_step_speedup_dp1",
+            "unit": "x vs dense grad",
+            "value": row.get("sparse_step_speedup_dp1", 0.0),
+            "vs_baseline": 0.0,
+            "rows": {"recommender": row}}))
         return
     if args.only == "generate":
         # CPU-host row like multichip/overlap: every cell is its own

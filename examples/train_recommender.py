@@ -1,0 +1,98 @@
+"""Two-tower recommender with row-sparse embedding gradients.
+
+The sparse-embedding fast path end to end: ``Embedding(sparse_grad=True)``
+makes the backward a segment-sum over the batch's unique ids and the
+optimizer a lazy gather->update->scatter over only those rows — the
+whole table is never touched.  Synthetic Zipfian(1.05) id traffic (the
+canonical recommender popularity skew) over a wide vocab, so each batch
+touches a few percent of the table at most.
+
+    python examples/train_recommender.py --steps 60
+    python examples/train_recommender.py --dense   # dense-grad baseline
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+import numpy as np
+
+import mxnet_tpu as mx
+from mxnet_tpu import parallel as par
+from mxnet_tpu.gluon import nn, loss as gloss
+from mxnet_tpu.gluon.block import HybridBlock
+from mxnet_tpu.observability.registry import registry
+
+
+class TwoTower(HybridBlock):
+    """User tower + item tower over one shared vocab, concat -> click
+    head.  Both tables ride the sparse gradient path."""
+
+    def __init__(self, vocab, dim, sparse_grad, **kwargs):
+        super().__init__(**kwargs)
+        with self.name_scope():
+            self.user = nn.Embedding(vocab, dim, sparse_grad=sparse_grad)
+            self.item = nn.Embedding(vocab, dim, sparse_grad=sparse_grad)
+            self.user_mlp = nn.Dense(64, activation="relu")
+            self.item_mlp = nn.Dense(64, activation="relu")
+            self.top = nn.Dense(2)
+
+    def hybrid_forward(self, F, x):
+        u = self.user_mlp(F.flatten(
+            self.user(F.slice_axis(x, axis=1, begin=0, end=1))))
+        i = self.item_mlp(F.flatten(
+            self.item(F.slice_axis(x, axis=1, begin=1, end=2))))
+        return self.top(F.concat(u, i, dim=1))
+
+
+def zipf_batch(rng, batch, vocab):
+    """(user_id, item_id) pairs under Zipfian(1.05) popularity; the
+    label is a synthetic click from a hidden affinity rule."""
+    ids = np.minimum(rng.zipf(1.05, (batch, 2)) - 1, vocab - 1)
+    y = ((ids[:, 0] + ids[:, 1]) % 3 == 0).astype(np.int64)
+    return ids.astype(np.float32), y
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=100)
+    ap.add_argument("--batch-size", type=int, default=512)
+    ap.add_argument("--vocab", type=int, default=50_000)
+    ap.add_argument("--dim", type=int, default=32)
+    ap.add_argument("--lr", type=float, default=1e-3)
+    ap.add_argument("--dense", action="store_true",
+                    help="disable sparse_grad (dense-gradient baseline)")
+    args = ap.parse_args()
+
+    mx.random.seed(7)
+    rng = np.random.RandomState(11)
+    net = TwoTower(args.vocab, args.dim, not args.dense, prefix="rec_")
+    net.initialize(mx.init.Xavier(rnd_type="uniform"))
+    tr = par.ShardedTrainer(net, gloss.SoftmaxCrossEntropyLoss(),
+                            "adam", {"learning_rate": args.lr})
+
+    t0 = time.perf_counter()
+    running = None
+    for step in range(1, args.steps + 1):
+        x, y = zipf_batch(rng, args.batch_size, args.vocab)
+        loss = float(tr.step(x, y).asnumpy())
+        running = loss if running is None else 0.9 * running + 0.1 * loss
+        if step % 20 == 0 or step == args.steps:
+            print(f"step {step}: loss {running:.4f}")
+    dt = time.perf_counter() - t0
+
+    mode = "dense" if args.dense else "sparse"
+    print(f"{mode} grads: {args.steps} steps in {dt:.2f}s "
+          f"({args.steps * args.batch_size / dt:.0f} examples/s)")
+    snap = registry().snapshot()
+    if not args.dense:
+        print(f"sparse.grad_rows: {snap.get('sparse.grad_rows', 0)} "
+              f"(density {snap.get('sparse.grad_density', 0.0):.4f}); "
+              f"tables touched row-wise, never densified")
+
+
+if __name__ == "__main__":
+    main()

@@ -423,6 +423,31 @@ register_env("MXTPU_ASYNC_CKPT", False, bool,
              "Committed-dir semantics are unchanged: a crash mid-"
              "write leaves a torn tmp dir that resume filters out.  "
              "Off (the default) = the blocking PR-10 flush.")
+register_env("MXTPU_SPARSE_GRAD", True, bool,
+             "Row-sparse embedding gradients inside the sharded step: "
+             "an Embedding(sparse_grad=True) produces its gradient as "
+             "(values, unique_ids) via an in-graph segment-sum over "
+             "the batch's deduplicated ids, and SGD/Adam lazy updates "
+             "gather/update/scatter only the live rows — per-step "
+             "update cost scales with batch-unique ids, not vocab.  "
+             "Off = such embeddings fall back to dense gradients "
+             "(bitwise the pre-sparse step).")
+register_env("MXTPU_SPARSE_ID_BUCKET", 0, int,
+             "Fixed id-bucket capacity for the sparse embedding "
+             "gradient path (rounded up to a power of 2).  0 (the "
+             "default) sizes the bucket per compiled batch shape: the "
+             "next power of 2 >= the batch's id count.  Setting it "
+             "larger pins ONE bucket size across varying batch "
+             "shapes (one compiled step); a value smaller than a "
+             "batch's id count is clamped up to that batch's own "
+             "bucket — capacity below the id count could drop rows.")
+register_env("MXTPU_SPARSE_EXCHANGE", True, bool,
+             "Coalesced cross-worker exchange for row-sparse "
+             "gradients in the gluon Trainer: workers allgather "
+             "(ids, rows) pairs over dist.allgather_rows and "
+             "dedup+sum on the host (the modern ps-lite push/pull) "
+             "instead of allreducing the dense matrix.  Off = sparse "
+             "grads densify before the wire.")
 register_env("MXTPU_TUNE_COMM_BUCKET", True, bool,
              "Self-tuning: enable the CommBucketController (hill-"
              "climbs a ShardedTrainer's MXTPU_COMM_BUCKET_MB on the "

@@ -15,7 +15,8 @@ from ..block import Block, HybridBlock
 from ..parameter import Parameter
 
 __all__ = ["Sequential", "HybridSequential", "Dense", "Dropout", "BatchNorm",
-           "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding", "Flatten",
+           "InstanceNorm", "LayerNorm", "GroupNorm", "Embedding",
+           "RowShardedEmbedding", "Flatten",
            "Lambda", "HybridLambda", "Activation", "LeakyReLU", "PReLU",
            "ELU", "SELU", "GELU", "Swish", "HybridConcurrent", "Identity",
            "ReflectionPad2D"]
@@ -273,20 +274,73 @@ class LayerNorm(HybridBlock):
 
 
 class Embedding(HybridBlock):
+    """Turns integer ids into dense vectors of ``output_dim``.
+
+    Out-of-range ids are CLIPPED into ``[0, input_dim - 1]`` (the
+    reference's ``take`` default and the only mode XLA gathers support
+    without a branch) — an id ``>= input_dim`` reads the last row and an
+    id ``< 0`` reads row 0, never a wrapped-around row.  Pinned by
+    ``test_embedding_clips_out_of_range_ids``.
+
+    With ``sparse_grad=True`` the weight is marked
+    ``grad_stype='row_sparse'``: under a ``ShardedTrainer`` step (and
+    ``MXTPU_SPARSE_GRAD=1``, the default) its gradient is produced
+    in-graph as a ``(values, unique_ids)`` pair via a segment-sum over
+    the batch's deduplicated ids, and the optimizer touches only those
+    rows — see ``sparse_grad.py``.  Outside a sharded step the flag has
+    the reference semantics via the gluon ``Trainer``'s row-sparse
+    exchange, or is simply dense.
+    """
+
     def __init__(self, input_dim, output_dim, dtype="float32",
                  weight_initializer=None, sparse_grad=False, prefix=None,
                  params=None):
         super().__init__(prefix=prefix, params=params)
         self._input_dim = input_dim
         self._output_dim = output_dim
+        self._sparse_grad = bool(sparse_grad)
         with self.name_scope():
             self.weight = self.params.get(
                 "weight", shape=(input_dim, output_dim), dtype=dtype,
-                init=weight_initializer)
+                init=weight_initializer,
+                grad_stype="row_sparse" if sparse_grad else "default")
 
     def hybrid_forward(self, F, x, weight):
+        if self._sparse_grad and hasattr(x, "_read"):
+            from ... import sparse_grad as _sg
+            ctx = _sg.trace_ctx()
+            if ctx is not None and ctx.wants(self.weight):
+                val = ctx.embedding(self.weight, x._read(), weight._read(),
+                                    self._input_dim)
+                return type(x)(val, ctx=x.context)
         return F.Embedding(x, weight, input_dim=self._input_dim,
-                           output_dim=self._output_dim)
+                           output_dim=self._output_dim,
+                           sparse_grad=self._sparse_grad)
+
+
+class RowShardedEmbedding(Embedding):
+    """An :class:`Embedding` whose table is partitioned along dim 0
+    (the vocab axis) across the mesh's ``'dp'`` axis, so a table larger
+    than one chip's HBM trains — each data-parallel rank holds
+    ``input_dim / dp`` rows, and the forward's gather is a cross-rank
+    collective XLA derives from the sharding (no manual all-to-all).
+
+    Only meaningful under a ``ShardedTrainer``: the trainer's sharding
+    pass sees the marker and places the weight (and, through
+    ``zero_sharding``'s fallback discipline, its optimizer state) with
+    dim 0 split over ``'dp'``.  Checkpoints save the logical table and
+    re-shard on load over whatever mesh restores it (PR-10 machinery).
+    Pairs with dense gradients — a row-sharded table's grad is produced
+    and reduce-scattered dense, so ``sparse_grad`` is rejected.
+    """
+
+    def __init__(self, input_dim, output_dim, dtype="float32",
+                 weight_initializer=None, prefix=None, params=None,
+                 shard_axis="dp"):
+        super().__init__(input_dim, output_dim, dtype=dtype,
+                         weight_initializer=weight_initializer,
+                         sparse_grad=False, prefix=prefix, params=params)
+        self.weight._row_shard_axis = shard_axis
 
 
 class Flatten(HybridBlock):

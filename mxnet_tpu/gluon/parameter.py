@@ -44,6 +44,8 @@ class Parameter:
         self.wd_mult = wd_mult
         self.init = init
         self.allow_deferred_init = allow_deferred_init
+        self.stype = stype
+        self.grad_stype = grad_stype
         self._data: Optional[Dict[Context, NDArray]] = None
         self._deferred_init = None   # (initializer, ctx_list, default_init)
 

@@ -11,7 +11,7 @@ from __future__ import annotations
 
 from typing import Dict, List, Optional
 
-from ..base import MXNetError
+from ..base import MXNetError, get_env, hot_path
 from .. import optimizer as opt_mod
 from .. import kvstore as kv_mod
 from .parameter import Parameter, ParameterDict
@@ -53,6 +53,9 @@ class Trainer:
         self._update_on_kvstore = update_on_kvstore
         self._dist_kv = False
         self._states: Dict = {}
+        # param index -> RowSparseNDArray grad stashed by the sparse
+        # exchange in allreduce_grads, consumed by update()'s lazy path
+        self._sparse_grads: Dict = {}
 
     # -- properties --------------------------------------------------------
     @property
@@ -146,6 +149,7 @@ class Trainer:
                 "allreduce_grads is not applicable when the optimizer runs "
                 "on the kvstore (update_on_kvstore=True)")
         push_keys, push_vals = [], []
+        sparse_on = bool(get_env("MXTPU_SPARSE_EXCHANGE"))
         for i, param in enumerate(self._params):
             grads = param.list_grad()
             if len(grads) > 1:
@@ -154,6 +158,15 @@ class Trainer:
                     reduced += g.as_in_context(reduced.context)
                 for g in grads:
                     reduced.copyto(g)
+            if (sparse_on and len(grads) == 1 and
+                    getattr(param, "grad_stype", "default")
+                    == "row_sparse"):
+                # coalesced sparse exchange (the modern ps-lite
+                # push/pull): ship only the touched rows, skip the
+                # dense store round-trip; update() consumes the stash
+                # through the optimizer's lazy row path
+                self._sparse_grads[i] = self._exchange_row_sparse(grads[0])
+                continue
             if self._dist_kv:
                 # cross-worker gradient sum through the store (no server
                 # optimizer in this mode; the local fused update applies
@@ -168,6 +181,25 @@ class Trainer:
                 grads = self._params[i].list_grad()
                 self._kvstore.pull(i, out=grads if len(grads) > 1
                                    else grads[0])
+
+    @hot_path("step")
+    def _exchange_row_sparse(self, grad):
+        """Turn one replica-reduced dense gradient into its row-sparse
+        form and (multi-worker) exchange it: extract the batch's live
+        rows, ``dist.allgather_rows`` the ``(ids, rows)`` slabs, and
+        dedup+sum — the wire carries touched rows, not the table."""
+        import numpy as np
+        import jax.numpy as jnp
+        from .. import sparse as sp_mod
+        from ..parallel import dist
+        g = grad._read()
+        idx = jnp.nonzero(jnp.any(g != 0, axis=tuple(range(1, g.ndim))))[0]
+        vals = jnp.take(g, idx, axis=0)
+        if dist.is_initialized() and dist.num_workers() > 1:
+            pairs = dist.allgather_rows(np.asarray(idx), np.asarray(vals))  # mxlint: disable=hidden-host-sync — the exchange IS the host boundary: rows leave the device to ride the DCN
+            uids, rows = dist.dedup_sum_rows(pairs)
+            idx, vals = jnp.asarray(uids), jnp.asarray(rows)
+        return sp_mod.RowSparseNDArray(vals, idx, shape=tuple(g.shape))
 
     def update(self, batch_size: int, ignore_stale_grad: bool = False) -> None:
         self._optimizer.rescale_grad = self._scale / batch_size
@@ -201,7 +233,15 @@ class Trainer:
                 if key not in self._states:
                     self._states[key] = \
                         self._optimizer.create_state_multi_precision(i, data)
-                if use_multi and len(param._data) == 1:
+                sparse_g = self._sparse_grads.pop(i, None)
+                if sparse_g is not None:
+                    # stashed row-sparse grad from the coalesced
+                    # exchange — always the direct path (the aggregate
+                    # group is dense-only), hits the optimizer's lazy
+                    # row update
+                    self._optimizer.update_multi_precision(
+                        i, data, sparse_g, self._states[key])
+                elif use_multi and len(param._data) == 1:
                     group.append((i, data, data.grad, self._states[key]))
                     if len(group) >= agg:
                         flush()

@@ -342,12 +342,18 @@ class Adam(Optimizer):
         self.beta1 = beta1
         self.beta2 = beta2
         self.epsilon = epsilon
+        self.lazy_update = lazy_update
 
     def create_state(self, index, weight):
         return (nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype),
                 nd_zeros(weight.shape, ctx=weight.context, dtype=weight.dtype))
 
     def update(self, index, weight, grad, state):
+        from .sparse import RowSparseNDArray
+        if isinstance(grad, RowSparseNDArray):
+            if self.lazy_update:
+                return self._update_row_sparse(index, weight, grad, state)
+            grad = grad.todense()
         self._update_count(index)
         t = self._index_update_count[index]
         coef1 = 1.0 - self.beta1 ** t
@@ -359,6 +365,33 @@ class Adam(Optimizer):
         lr = nd_array(_np.float32(lr_t), ctx=weight.context)
         invoke_by_name("adam_update", [weight, grad, mean, var, lr], kw,
                        out=[weight, mean, var])
+
+    def _update_row_sparse(self, index, weight, grad, state):
+        """Lazy Adam: mean/var/weight touched only on the grad's rows
+        (reference adam_update row_sparse kernel with lazy_update=True)
+        — untouched rows keep their moments frozen, so the update cost
+        scales with touched rows, not vocab.  Mirrors
+        parallel/optim.py's in-graph row path formula for formula."""
+        import jax.numpy as jnp
+        self._update_count(index)
+        t = self._index_update_count[index]
+        lr_t = self._get_lr(index) * \
+            math.sqrt(1.0 - self.beta2 ** t) / (1.0 - self.beta1 ** t)
+        wd = self._get_wd(index)
+        rows = jnp.asarray(grad.indices)
+        g = jnp.asarray(grad.data) * self.rescale_grad
+        if self.clip_gradient is not None:
+            g = jnp.clip(g, -self.clip_gradient, self.clip_gradient)
+        w = weight._read()
+        g = g + wd * w[rows]
+        mean, var = state
+        m, v = mean._read(), var._read()
+        m_rows = self.beta1 * m[rows] + (1.0 - self.beta1) * g
+        v_rows = self.beta2 * v[rows] + (1.0 - self.beta2) * jnp.square(g)
+        mean._set_data(m.at[rows].set(m_rows))
+        var._set_data(v.at[rows].set(v_rows))
+        weight._set_data(w.at[rows].add(
+            -lr_t * m_rows / (jnp.sqrt(v_rows) + self.epsilon)))
 
 
 @register

@@ -38,12 +38,13 @@ import os
 import threading
 from typing import Optional, Tuple
 
-from ..base import MXNetError, get_env
+from ..base import MXNetError, get_env, hot_path
 
 __all__ = ["init_process_group", "is_initialized", "rank", "num_workers",
            "phys_rank", "active_members", "fence_generation",
            "set_active_members", "reset_active_members",
            "allreduce_host", "allgather_host", "allgather_bytes",
+           "allgather_rows", "dedup_sum_rows",
            "reduce_scatter_host", "broadcast_host", "barrier",
            "kv_publish", "kv_collect", "kv_purge_rank"]
 
@@ -404,7 +405,7 @@ def _allgather_bytes_device(data: bytes):
     from jax.experimental import multihost_utils
     sizes = np.asarray(multihost_utils.process_allgather(
         np.asarray([len(data)], dtype=np.int64)))[:, 0]
-    cap = int(sizes.max())
+    cap = int(sizes.max())  # mxlint: disable=hidden-host-sync — the length gather is itself a host collective; its result sizes the payload buffer
     if cap == 0:
         return [b""] * len(sizes)
     buf = np.zeros((cap,), dtype=np.uint8)
@@ -514,6 +515,60 @@ def allgather_bytes(data: bytes, timeout: Optional[float] = None):
         # (e.g. CPU: "Multiprocess computations aren't implemented");
         # deterministic per backend, so every rank takes the same branch
         return _allgather_bytes_kv(data, timeout)
+
+
+# -- row-sparse gradient exchange --------------------------------------------
+
+
+@hot_path("step")
+def allgather_rows(ids, rows, timeout: Optional[float] = None):
+    """Gather one ``(ids, rows)`` row-sparse gradient slab from every
+    process; returns a list of ``num_workers`` ``(ids, rows)`` numpy
+    pairs indexed by rank.  The modern ps-lite push/pull: each worker
+    ships only the rows its batch touched (ids ``(n,)`` int, rows
+    ``(n, width)`` float) instead of allreducing the dense table, and
+    the caller reduces with :func:`dedup_sum_rows`.
+
+    Rides :func:`allgather_bytes` (device collective on pods, KV store
+    fallback), so slabs may be DIFFERENT lengths per rank — no padding
+    protocol needed.  Bumps the ``sparse.exchange_bytes`` counter with
+    the actual wire payload.  Single-process: a one-element list."""
+    import io
+    import numpy as np
+    from ..observability.registry import registry as _registry
+    ids = np.ascontiguousarray(np.asarray(ids))  # mxlint: disable=hidden-host-sync — the exchange IS the host boundary: ids leave the device to ride the DCN
+    rows = np.ascontiguousarray(np.asarray(rows))  # mxlint: disable=hidden-host-sync — same boundary: rows serialize into the wire payload
+    if ids.shape[0] != rows.shape[0]:
+        raise MXNetError(
+            f"allgather_rows: {ids.shape[0]} ids vs {rows.shape[0]} rows")
+    buf = io.BytesIO()
+    np.savez(buf, ids=ids, rows=rows)
+    payload = buf.getvalue()
+    _registry().counter(
+        "sparse.exchange_bytes",
+        "bytes of (ids, rows) row-sparse gradient payload "
+        "exchanged instead of dense table reductions").inc(len(payload))
+    out = []
+    for blob in allgather_bytes(payload, timeout=timeout):
+        z = np.load(io.BytesIO(blob))
+        out.append((z["ids"], z["rows"]))
+    return out
+
+
+def dedup_sum_rows(pairs):
+    """Reduce :func:`allgather_rows` output: union the id sets and sum
+    rows that collide — the server-side aggregation of the push/pull.
+    Returns one ``(ids, rows)`` pair with ids sorted unique."""
+    import numpy as np
+    pairs = [p for p in pairs if p[0].size]
+    if not pairs:
+        return np.zeros((0,), np.int64), np.zeros((0, 0), np.float32)
+    all_ids = np.concatenate([p[0] for p in pairs])
+    all_rows = np.concatenate([p[1] for p in pairs], axis=0)
+    uids, inv = np.unique(all_ids, return_inverse=True)
+    out = np.zeros((uids.size, all_rows.shape[1]), all_rows.dtype)
+    np.add.at(out, inv, all_rows)
+    return uids, out
 
 
 # -- barrier-free KV publish/collect ----------------------------------------

@@ -61,7 +61,11 @@ class FunctionalOptimizer:
         raise MXNetError(f"no functional lowering for optimizer {k!r}")
 
     # -- update ------------------------------------------------------------
-    def update(self, params, grads, state, t, base_lr, rescale):
+    def update(self, params, grads, state, t, base_lr, rescale,
+               sparse=frozenset()):
+        """Apply one step.  Indices in ``sparse`` carry their gradient as
+        a ``(values, unique_ids)`` pair (sparse_grad.py) and take the
+        lazy gather→update→scatter row path; everything else is dense."""
         import jax.numpy as jnp
         h = self.hyper
         clip = h.get("clip_gradient") or 0.0
@@ -69,6 +73,11 @@ class FunctionalOptimizer:
         for i, (w, g, s) in enumerate(zip(params, grads, state)):
             lr = (base_lr * self.lr_mults[i]).astype(w.dtype)
             wd = self.wds[i]
+            if i in sparse:
+                w, s = self._update_rows(w, g, s, t, lr, wd, rescale, clip)
+                new_p.append(w)
+                new_s.append(s)
+                continue
             g = g * rescale.astype(g.dtype)
             if clip and clip > 0:
                 g = jnp.clip(g, -clip, clip)
@@ -129,6 +138,48 @@ class FunctionalOptimizer:
             new_p.append(w)
             new_s.append(s)
         return new_p, new_s
+
+    # -- lazy row update ---------------------------------------------------
+    def _update_rows(self, w, grad, s, t, lr, wd, rescale, clip):
+        """The in-graph lazy update (reference optimizer_op.cc row_sparse
+        kernels): gather state for the batch's live rows, apply the dense
+        formula to those rows only, scatter back.  ``grad`` is the
+        ``(values, unique_ids)`` pair; padded bucket slots carry the
+        out-of-range id ``nrows`` so their scatters DROP (XLA out-of-bounds
+        scatter semantics) — untouched rows' weight AND optimizer state
+        are never read or written.  Weight decay applies to touched rows
+        only, the reference's documented lazy_update semantics."""
+        import jax.numpy as jnp
+        values, uids = grad
+        nrows = w.shape[0]
+        # clipped twin for GATHERS (padded slots read row 0's garbage,
+        # discarded because the uids scatter drops); raw uids for scatters
+        safe = jnp.clip(uids, 0, nrows - 1)
+        g = values * rescale.astype(values.dtype)
+        if clip and clip > 0:
+            g = jnp.clip(g, -clip, clip)
+        k = self.kind
+        g = g + wd * w[safe]
+        if k == "sgd":
+            mu = self.hyper.get("momentum", 0.0)
+            if mu:
+                m_rows = mu * s[safe] - lr * g
+                return w.at[uids].add(m_rows), s.at[uids].set(m_rows)
+            return w.at[uids].add(-lr * g), s
+        if k == "adam":
+            b1, b2 = self.hyper["beta1"], self.hyper["beta2"]
+            eps = self.hyper["epsilon"]
+            tt = t.astype(jnp.float32)
+            coef = jnp.sqrt(1.0 - b2 ** tt) / (1.0 - b1 ** tt)
+            m, v = s
+            m_rows = b1 * m[safe] + (1 - b1) * g
+            v_rows = b2 * v[safe] + (1 - b2) * jnp.square(g)
+            w = w.at[uids].add(-(lr * coef.astype(w.dtype)) * m_rows /
+                               (jnp.sqrt(v_rows) + eps))
+            return w, (m.at[uids].set(m_rows), v.at[uids].set(v_rows))
+        raise MXNetError(
+            f"optimizer {k!r} has no lazy row-sparse lowering — use "
+            f"sgd/adam or drop sparse_grad=True")
 
 
 def make_functional_optimizer(opt: "opt_mod.Optimizer",

@@ -36,6 +36,7 @@ from __future__ import annotations
 import re as _re
 import threading as _threading
 import time as _time
+import warnings as _warnings
 from typing import Any, Callable, List, Optional, Sequence
 
 import numpy as _np
@@ -49,6 +50,7 @@ from ..ndarray import NDArray
 from ..gluon.block import _TraceCtx, _KeyScope
 from ..gluon.parameter import Parameter
 from ..observability.registry import registry as _metrics_registry
+from ..sparse_grad import SparseGradTrace as _SparseGradTrace
 from .mesh import (ShardingRules, axis_size, comm_buckets, default_mesh,
                    replicated, shard, zero_sharding)
 from .optim import make_functional_optimizer
@@ -204,6 +206,32 @@ class ShardedTrainer:
         names = [p.name for p in self._train_params]
         self._fopt = make_functional_optimizer(self._optimizer, names)
 
+        # row-sparse gradient layout (sparse_grad.py): params marked
+        # grad_stype='row_sparse' whose gradient is produced in-graph as
+        # a (values, unique_ids) pair and updated lazily.  The mark is
+        # an intent; whether a given trace actually takes the sparse
+        # path is decided per-retrace by the eval_shape probe in
+        # make_grads (a hybridized table silently stays dense).
+        self._sparse_marked = frozenset(
+            i for i, p in enumerate(self._train_params)
+            if getattr(p, "grad_stype", "default") == "row_sparse")
+        if self._sparse_marked and not get_env("MXTPU_SPARSE_GRAD"):
+            self._sparse_marked = frozenset()
+        if self._sparse_marked and self._accum > 1:
+            _warnings.warn(
+                "sparse_grad embeddings fall back to dense gradients "
+                "under accum_steps > 1 (the scan's carried accumulation "
+                "buffer is dense)")
+            self._sparse_marked = frozenset()
+        if self._sparse_marked and self._fopt.kind not in ("sgd", "adam"):
+            _warnings.warn(
+                f"optimizer {self._fopt.kind!r} has no lazy row-sparse "
+                f"lowering — sparse_grad embeddings fall back to dense")
+            self._sparse_marked = frozenset()
+        # trace-time record {param_idx: (bucket, vocab)} from the last
+        # sparse probe — feeds the sparse.* metrics in step()
+        self._sparse_trace_info = {}
+
         # input/label structure, captured once: reshard() re-derives the
         # shardings and rebuilds the jits on a new mesh without needing
         # fresh example data
@@ -237,6 +265,16 @@ class ShardedTrainer:
         self._dp = axis_size(mesh, "dp")
         self._p_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
                       for p in self._train_params]
+        # RowShardedEmbedding: the table itself (not just its state)
+        # partitions dim 0 over the marked axis, with zero_sharding's
+        # per-parameter fallback (indivisible vocab / axis of size 1 /
+        # dim 0 already ruled → replicated as before)
+        for i, p in enumerate(self._train_params):
+            ax = getattr(p, "_row_shard_axis", None)
+            if ax is not None:
+                self._p_sh[i] = zero_sharding(
+                    mesh, self._rules.spec_for(p.name, p.shape), p.shape,
+                    axis=ax)
         self._a_sh = [self._rules.sharding_for(mesh, p.name, p.shape)
                       for p in self._aux_params]
         # ZeRO layout: stage >= 1 partitions optimizer state (and the
@@ -247,6 +285,11 @@ class ShardedTrainer:
                 zero_sharding(mesh, self._rules.spec_for(p.name, p.shape),
                               p.shape)
                 for p in self._train_params]
+            # a row-sharded table's state lives WITH its weight rows —
+            # the param sharding already is the 1/dp layout
+            for i, p in enumerate(self._train_params):
+                if getattr(p, "_row_shard_axis", None) is not None:
+                    self._z_sh[i] = self._p_sh[i]
         else:
             self._z_sh = list(self._p_sh)
         # per-input sharding: the data spec truncated to each input's rank
@@ -304,6 +347,8 @@ class ShardedTrainer:
 
         accum, zero = self._accum, self._zero
         dp = self._dp
+        marked = self._sparse_marked if accum == 1 else frozenset()
+        sparse_info = self._sparse_trace_info
         z_sh, p_sh = list(self._z_sh), list(self._p_sh)
         wsc = jax.lax.with_sharding_constraint
         # communication buckets for the gradient reduction (reverse
@@ -311,7 +356,13 @@ class ShardedTrainer:
         # a single bucket IS the fused path, kept as None so the
         # pre-bucketing trace stays byte-for-byte the same graph
         cap = self._bucket_mb * 2 ** 20 if self._bucket_mb else 0
-        bks = comm_buckets([int(v.nbytes) for v in self._pvals], cap)
+        # sparse-marked params never ride the dense reduction buckets —
+        # their (values, ids) grads have their own exchange
+        dense_i = [i for i in range(len(self._pvals))
+                   if i not in self._sparse_marked]
+        bks = comm_buckets([int(self._pvals[i].nbytes) for i in dense_i],
+                           cap)
+        bks = [[dense_i[j] for j in b] for b in bks]
         self._grad_buckets = bks if len(bks) > 1 else None
         buckets = self._grad_buckets
 
@@ -330,7 +381,9 @@ class ShardedTrainer:
             first to materialize) while earlier layers' gradients are
             still being computed."""
             if buckets is None:
-                return [wsc(g, s) for g, s in zip(grads, z_sh)]
+                # a (values, ids) sparse grad passes through unconstrained
+                return [g if isinstance(g, tuple) else wsc(g, s)
+                        for g, s in zip(grads, z_sh)]
             out = list(grads)
             prev = None
             for idx in buckets:
@@ -378,6 +431,62 @@ class ShardedTrainer:
             gradient, so the optimizer's rescale is unchanged."""
             def grads_of(pvals, avals, key, xv, yv, ls):
                 if accum == 1:
+                    # trace-time probe: which sparse-marked tables does
+                    # THIS trace's forward actually reach, and with how
+                    # many ids?  eval_shape emits no ops and re-runs on
+                    # every retrace, so a new batch shape re-sizes the
+                    # id buckets.
+                    sparse_idx, zb0 = [], []
+                    if marked:
+                        probe = _SparseGradTrace("probe")
+                        with probe:
+                            jax.eval_shape(
+                                lambda pv: apply_fn(
+                                    pv, avals, key, xv, True, yv)[1]._read(),
+                                pvals)
+                        for i in sorted(marked):
+                            pid = id(tparams[i])
+                            if pid in probe.buckets and \
+                                    pid not in probe.multi:
+                                sparse_idx.append(i)
+                                zb0.append(jnp.zeros(
+                                    (probe.buckets[pid],
+                                     pvals[i].shape[1]), pvals[i].dtype))
+                        sparse_info.clear()
+                        sparse_info.update(
+                            {i: (int(z.shape[0]), int(pvals[i].shape[0]))
+                             for i, z in zip(sparse_idx, zb0)})
+                    if sparse_idx:
+                        def loss_of_sp(pv, zb):
+                            tr = _SparseGradTrace("grad", {
+                                id(tparams[i]): z
+                                for i, z in zip(sparse_idx, zb)})
+                            with tr:
+                                _, l_nd, new_avals = apply_fn(
+                                    pv, avals, key, xv, True, yv)
+                            lraw = l_nd._read()
+                            total = jnp.sum(lraw)
+                            if scaled:
+                                total = total * ls
+                            uids = [tr.uids[id(tparams[i])]
+                                    for i in sparse_idx]
+                            return total, (jnp.mean(lraw), new_avals, uids)
+
+                        (_, (lval, new_avals, uids)), (grads, zgrads) = \
+                            jax.value_and_grad(loss_of_sp, argnums=(0, 1),
+                                               has_aux=True)(pvals, zb0)
+                        # the table itself sat behind stop_gradient: its
+                        # dense cotangent is an unused zeros buffer XLA
+                        # DCEs once we swap in the (values, ids) pair
+                        grads = list(grads)
+                        for i, zg, u in zip(sparse_idx, zgrads, uids):
+                            grads[i] = (zg, u)
+                        if zero >= 2:
+                            grads = [g if isinstance(g, tuple)
+                                     else wsc(g, s)
+                                     for g, s in zip(grads, z_sh)]
+                        return grads, lval, new_avals
+
                     def loss_of(pv):
                         _, l_nd, new_avals = apply_fn(pv, avals, key, xv,
                                                       True, yv)
@@ -461,8 +570,10 @@ class ShardedTrainer:
                 # chain still pins WHERE each bucket's psum lands in
                 # the schedule
                 grads = constrain_grads(grads)
+            sp = frozenset(i for i, g in enumerate(grads)
+                           if isinstance(g, tuple))
             new_pvals, new_state = fopt.update(pvals, grads, state, t,
-                                               lr, rescale)
+                                               lr, rescale, sparse=sp)
             if zero >= 1:
                 new_pvals = [wsc(wsc(w, zs), ps) for w, zs, ps in
                              zip(new_pvals, z_sh, p_sh)]
@@ -630,7 +741,11 @@ class ShardedTrainer:
         if not self._built:
             return
         cap = mb * 2 ** 20 if mb else 0
-        bks = comm_buckets([int(v.nbytes) for v in self._pvals], cap)
+        dense_i = [i for i in range(len(self._pvals))
+                   if i not in self._sparse_marked]
+        bks = comm_buckets([int(self._pvals[i].nbytes) for i in dense_i],
+                           cap)
+        bks = [[dense_i[j] for j in b] for b in bks]
         new = bks if len(bks) > 1 else None
         if new == self._grad_buckets:
             return
@@ -655,6 +770,30 @@ class ShardedTrainer:
     def peak_opt_state_bytes(self) -> int:
         """max over devices of :meth:`opt_state_bytes_per_device`."""
         per_dev = self.opt_state_bytes_per_device()
+        return max(per_dev.values()) if per_dev else 0
+
+    def table_bytes_per_device(self) -> dict:
+        """Actually-resident embedding-table bytes per device id, over
+        the ROW-SHARDED tables (RowShardedEmbedding) — the dp-sharded
+        table acceptance metric, sibling of
+        :meth:`opt_state_bytes_per_device`."""
+        if not self._built:
+            raise MXNetError("run at least one step() before "
+                             "table_bytes_per_device()")
+        out: dict = {}
+        for p, v in zip(self._train_params, self._pvals):
+            if getattr(p, "_row_shard_axis", None) is None:
+                continue
+            for sh in v.addressable_shards:
+                d = sh.device.id
+                out[d] = out.get(d, 0) + int(sh.data.nbytes)
+        return out
+
+    def peak_table_bytes(self) -> int:
+        """max over devices of :meth:`table_bytes_per_device` — what one
+        chip actually holds of the row-sharded tables (``vocab/dp``
+        rows each when the shard formed, the full table on fallback)."""
+        per_dev = self.table_bytes_per_device()
         return max(per_dev.values()) if per_dev else 0
 
     def reshard(self, mesh=None) -> None:
@@ -809,7 +948,47 @@ class ShardedTrainer:
             self._pvals, self._avals, self._state, lval = self._jit_step(
                 self._pvals, self._avals, self._state, key, t, lr, rescale,
                 xv, yv)
+        if self._sparse_trace_info:
+            self._record_sparse_metrics()
         return NDArray(lval, ctx=self._ctx)
+
+    def _record_sparse_metrics(self) -> None:
+        """Host-side sparse.* metrics from the last trace's probe record
+        — static shapes only, no device sync.  ``exchange_bytes`` counts
+        what the sparse layout PUTS ON THE WIRE per step (ids + rows,
+        once per dp peer pair is XLA's business; we count the logical
+        payload), vs the dense table-sized reduction it replaced."""
+        reg = _metrics_registry()
+        rows = buckets_b = dense_b = 0
+        vocab_sum = 0
+        for i, (bucket, vocab) in self._sparse_trace_info.items():
+            v = self._pvals[i]
+            width = int(v.shape[1])
+            item = int(_np.dtype(v.dtype).itemsize)
+            # the pow2 bucket can exceed a tiny vocab; a table never
+            # carries more live rows than it has
+            rows += min(bucket, vocab)
+            buckets_b += bucket * (4 + width * item)
+            dense_b += vocab * width * item
+            vocab_sum += vocab
+        reg.counter(
+            "sparse.grad_rows",
+            "embedding rows carried by row-sparse gradients").inc(rows)
+        if self._dp > 1:
+            reg.counter(
+                "sparse.exchange_bytes",
+                "bytes of (ids, rows) row-sparse gradient payload "
+                "exchanged instead of dense table reductions").inc(
+                    buckets_b)
+            reg.counter(
+                "sparse.exchange_bytes_dense_equiv",
+                "bytes the SAME gradients would have cost as dense "
+                "reductions — the wire win denominator").inc(dense_b)
+        if vocab_sum:
+            reg.gauge(
+                "sparse.grad_density",
+                "id-bucket rows / vocab across sparse tables (last "
+                "step)").set(rows / vocab_sum)
 
     # -- supervised-retry support (ResilientTrainer) -----------------------
     def step_state(self):

@@ -16,6 +16,14 @@ row_sparse optimizer updates are pure scatter ops on the dense weight
 reference's row_sparse kernels deliver), and everything else densifies
 explicitly — never silently: ``tostype`` is the only densification door,
 matching the reference's storage-fallback warnings.
+
+Storage backing: ``RowSparseNDArray`` data/indices are DEVICE arrays and
+its elemwise/retain/todense paths run as eager jax ops — the gradient
+fast path (gluon Trainer sparse exchange, lazy optimizer updates) never
+round-trips through host numpy.  CSR structure stays host-side
+(numpy/scipy — structure algebra is host work, exactly the reference's
+cpu FComputeEx role); the single sanctioned device→host sync for
+building CSR structure from dense operands is :func:`_host_ingest`.
 """
 from __future__ import annotations
 
@@ -63,7 +71,8 @@ class BaseSparseNDArray:
         return len(self._shape)
 
     def asnumpy(self) -> _np.ndarray:
-        return self.todense().asnumpy()
+        # the explicit export API — syncing is this method's contract
+        return self.todense().asnumpy()  # mxlint: disable=hidden-host-sync — explicit host-export API
 
     def todense(self) -> NDArray:
         raise NotImplementedError
@@ -131,6 +140,14 @@ class BaseSparseNDArray:
         return negative(self)
 
 
+def _host_ingest(arr: NDArray) -> _np.ndarray:
+    """The ONE sanctioned device→host sync of this module: CSR structure
+    (indptr/indices algebra) is host work, so dense operands entering a
+    CSR build or a CSR⊕dense elemwise cross here — every other sparse
+    path stays on-device."""
+    return arr.asnumpy()  # mxlint: disable=hidden-host-sync — CSR host-structure ingestion boundary
+
+
 class CSRNDArray(BaseSparseNDArray):
     stype = "csr"
 
@@ -155,7 +172,7 @@ class CSRNDArray(BaseSparseNDArray):
     @staticmethod
     def from_dense(arr: NDArray) -> "CSRNDArray":
         # single vectorized pass — this sits on the LibSVMIter hot path
-        a = arr.asnumpy()
+        a = _host_ingest(arr)
         rows, cols = a.shape
         r_idx, c_idx = _np.nonzero(a)           # row-major order
         indptr = _np.concatenate(
@@ -203,38 +220,49 @@ class CSRNDArray(BaseSparseNDArray):
 
 
 class RowSparseNDArray(BaseSparseNDArray):
+    """Device-backed row-sparse storage: ``data`` ((nnz_rows,) + row
+    shape) and ``indices`` ((nnz_rows,) int32) are jax arrays, so the
+    gradient fast path — from_dense extraction, exchange, retain, the
+    optimizer's lazy scatter — runs without a host round-trip.  jax
+    arrays are immutable; derive new instances instead of writing
+    ``.data`` in place."""
+
     stype = "row_sparse"
 
     def __init__(self, data, indices, shape, dtype=None, ctx=None):
-        data = _np.asarray(data)
+        import jax.numpy as jnp
+        data = jnp.asarray(data)
         dtype = dtype or data.dtype
         super().__init__(shape, dtype, ctx or current_context())
-        self.data = _np.asarray(data, dtype=dtype)
-        self.indices = _np.asarray(indices, dtype=_np.int64)
+        self.data = jnp.asarray(data, dtype=_np.dtype(dtype))
+        self.indices = jnp.asarray(indices, dtype=jnp.int32)
         if self.data.shape[0] != self.indices.shape[0]:
             raise MXNetError("data rows must match indices length")
 
     @staticmethod
     def from_dense(arr: NDArray) -> "RowSparseNDArray":
-        a = arr.asnumpy()
-        nz_rows = _np.nonzero(_np.any(
+        import jax.numpy as jnp
+        a = arr._read()
+        nz_rows = jnp.nonzero(jnp.any(
             a.reshape(a.shape[0], -1) != 0, axis=1))[0]
-        return RowSparseNDArray(a[nz_rows], nz_rows, a.shape,
-                                ctx=arr.context)
+        return RowSparseNDArray(jnp.take(a, nz_rows, axis=0), nz_rows,
+                                a.shape, ctx=arr.context)
 
     def todense(self) -> NDArray:
-        out = _np.zeros(self._shape, dtype=self._dtype)
-        out[self.indices] = self.data
-        return nd_array(out, ctx=self._ctx)
+        import jax.numpy as jnp
+        out = jnp.zeros(self._shape, dtype=self._dtype)
+        return NDArray(out.at[self.indices].set(self.data), ctx=self._ctx)
 
     def retain(self, indices) -> "RowSparseNDArray":
-        keep = _np.asarray(indices, dtype=_np.int64)
-        mask = _np.isin(self.indices, keep)
+        import jax.numpy as jnp
+        keep = jnp.asarray(indices, dtype=jnp.int32)
+        mask = jnp.isin(self.indices, keep)
         return RowSparseNDArray(self.data[mask], self.indices[mask],
                                 self._shape, ctx=self._ctx)
 
     def copy(self) -> "RowSparseNDArray":
-        return RowSparseNDArray(self.data.copy(), self.indices.copy(),
+        # jax buffers are immutable — sharing them IS a deep copy
+        return RowSparseNDArray(self.data, self.indices,
                                 self._shape, ctx=self._ctx)
 
 
@@ -341,7 +369,7 @@ def retain(arr: RowSparseNDArray, indices) -> RowSparseNDArray:
     if not isinstance(arr, RowSparseNDArray):
         raise MXNetError("retain expects a RowSparseNDArray")
     if isinstance(indices, NDArray):
-        indices = indices.asnumpy()
+        indices = indices._read()
     return arr.retain(indices)
 
 
@@ -373,33 +401,37 @@ def _csr_csr(lhs: CSRNDArray, rhs: CSRNDArray, op: str) -> CSRNDArray:
 
 
 def _rsp_union(lhs: RowSparseNDArray, rhs: RowSparseNDArray, rhs_sign=1.0):
-    """row_sparse ⊕ row_sparse over the union of row sets (add/sub)."""
-    idx = _np.union1d(lhs.indices, rhs.indices)
-    data = _np.zeros((len(idx),) + lhs.data.shape[1:],
-                     _np.result_type(lhs.data, rhs.data))
-    _np.add.at(data, _np.searchsorted(idx, lhs.indices), lhs.data)
-    _np.add.at(data, _np.searchsorted(idx, rhs.indices),
-               rhs_sign * rhs.data)
+    """row_sparse ⊕ row_sparse over the union of row sets (add/sub) —
+    eager device ops end to end (union1d/searchsorted/scatter-add)."""
+    import jax.numpy as jnp
+    idx = jnp.union1d(lhs.indices, rhs.indices)
+    data = jnp.zeros((idx.shape[0],) + lhs.data.shape[1:],
+                     jnp.result_type(lhs.data, rhs.data))
+    data = data.at[jnp.searchsorted(idx, lhs.indices)].add(lhs.data)
+    data = data.at[jnp.searchsorted(idx, rhs.indices)].add(
+        rhs_sign * rhs.data)
     return RowSparseNDArray(data, idx, lhs.shape, ctx=lhs.context)
 
 
-def _rsp_pointwise(lhs: RowSparseNDArray, rhs: RowSparseNDArray, np_op,
+def _rsp_pointwise(lhs: RowSparseNDArray, rhs: RowSparseNDArray, op: str,
                    intersect: bool):
     """mul/min/max on row_sparse pairs.  mul keeps only the row
     intersection (0·x = 0); min/max need the union with zero rows."""
+    import jax.numpy as jnp
+    fn = getattr(jnp, op)
     if intersect:
-        common, li, ri = _np.intersect1d(lhs.indices, rhs.indices,
+        common, li, ri = jnp.intersect1d(lhs.indices, rhs.indices,
                                          return_indices=True)
-        return RowSparseNDArray(np_op(lhs.data[li], rhs.data[ri]), common,
+        return RowSparseNDArray(fn(lhs.data[li], rhs.data[ri]), common,
                                 lhs.shape, ctx=lhs.context)
-    idx = _np.union1d(lhs.indices, rhs.indices)
+    idx = jnp.union1d(lhs.indices, rhs.indices)
     shape_tail = lhs.data.shape[1:]
-    dt = _np.result_type(lhs.data, rhs.data)
-    a = _np.zeros((len(idx),) + shape_tail, dt)
-    b = _np.zeros((len(idx),) + shape_tail, dt)
-    a[_np.searchsorted(idx, lhs.indices)] = lhs.data
-    b[_np.searchsorted(idx, rhs.indices)] = rhs.data
-    return RowSparseNDArray(np_op(a, b), idx, lhs.shape, ctx=lhs.context)
+    dt = jnp.result_type(lhs.data, rhs.data)
+    a = jnp.zeros((idx.shape[0],) + shape_tail, dt)
+    b = jnp.zeros((idx.shape[0],) + shape_tail, dt)
+    a = a.at[jnp.searchsorted(idx, lhs.indices)].set(lhs.data)
+    b = b.at[jnp.searchsorted(idx, rhs.indices)].set(rhs.data)
+    return RowSparseNDArray(fn(a, b), idx, lhs.shape, ctx=lhs.context)
 
 
 def _dense_fallback(name, lhs, rhs):
@@ -431,11 +463,12 @@ def elemwise_add(lhs, rhs):
     if isinstance(lhs, NDArray) and isinstance(rhs, BaseSparseNDArray):
         return elemwise_add(rhs, lhs)
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
-        out = rhs.asnumpy().copy()
-        _np.add.at(out, lhs.indices, lhs.data)
-        return nd_array(out, ctx=rhs.context)
+        # device scatter-add: rsp rows fold into the dense operand
+        # without leaving the chip
+        return NDArray(rhs._read().at[lhs.indices].add(lhs.data),
+                       ctx=rhs.context)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
-        out = rhs.asnumpy().copy()
+        out = _host_ingest(rhs).copy()
         row_ids = _np.repeat(_np.arange(lhs.shape[0]),
                              _np.diff(lhs.indptr))
         _np.add.at(out, (row_ids, lhs.indices), lhs.data)
@@ -471,18 +504,20 @@ def elemwise_mul(lhs, rhs):
     if isinstance(lhs, BaseSparseNDArray) and _np.isscalar(rhs):
         return _scalar_scale(lhs, rhs)
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
-        d = rhs.asnumpy()
-        return RowSparseNDArray(lhs.data * d[lhs.indices], lhs.indices,
-                                lhs.shape, ctx=lhs.context)
+        import jax.numpy as jnp
+        d = rhs._read()
+        return RowSparseNDArray(
+            lhs.data * jnp.take(d, lhs.indices, axis=0), lhs.indices,
+            lhs.shape, ctx=lhs.context)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
-        d = rhs.asnumpy()
+        d = _host_ingest(rhs)
         row_ids = _np.repeat(_np.arange(lhs.shape[0]),
                              _np.diff(lhs.indptr))
         return CSRNDArray(lhs.data * d[row_ids, lhs.indices], lhs.indices,
                           lhs.indptr, lhs.shape, ctx=lhs.context)
     if isinstance(lhs, RowSparseNDArray) and \
             isinstance(rhs, RowSparseNDArray):
-        return _rsp_pointwise(lhs, rhs, _np.multiply, intersect=True)
+        return _rsp_pointwise(lhs, rhs, "multiply", intersect=True)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
         return _csr_csr(lhs, rhs, "mul")
     from .ndarray.register import invoke_by_name
@@ -500,11 +535,13 @@ def elemwise_div(lhs, rhs):
                 return d / s
         return _scalar_apply(lhs, _div)
     if isinstance(lhs, RowSparseNDArray) and isinstance(rhs, NDArray):
-        d = rhs.asnumpy()
-        return RowSparseNDArray(lhs.data / d[lhs.indices], lhs.indices,
-                                lhs.shape, ctx=lhs.context)
+        import jax.numpy as jnp
+        d = rhs._read()
+        return RowSparseNDArray(
+            lhs.data / jnp.take(d, lhs.indices, axis=0), lhs.indices,
+            lhs.shape, ctx=lhs.context)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, NDArray):
-        d = rhs.asnumpy()
+        d = _host_ingest(rhs)
         row_ids = _np.repeat(_np.arange(lhs.shape[0]),
                              _np.diff(lhs.indptr))
         return CSRNDArray(lhs.data / d[row_ids, lhs.indices], lhs.indices,
@@ -520,7 +557,7 @@ def elemwise_div(lhs, rhs):
 def minimum(lhs, rhs):
     if isinstance(lhs, RowSparseNDArray) and \
             isinstance(rhs, RowSparseNDArray):
-        return _rsp_pointwise(lhs, rhs, _np.minimum, intersect=False)
+        return _rsp_pointwise(lhs, rhs, "minimum", intersect=False)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
         return _csr_csr(lhs, rhs, "minimum")
     if isinstance(lhs, BaseSparseNDArray) or \
@@ -533,7 +570,7 @@ def minimum(lhs, rhs):
 def maximum(lhs, rhs):
     if isinstance(lhs, RowSparseNDArray) and \
             isinstance(rhs, RowSparseNDArray):
-        return _rsp_pointwise(lhs, rhs, _np.maximum, intersect=False)
+        return _rsp_pointwise(lhs, rhs, "maximum", intersect=False)
     if isinstance(lhs, CSRNDArray) and isinstance(rhs, CSRNDArray):
         return _csr_csr(lhs, rhs, "maximum")
     if isinstance(lhs, BaseSparseNDArray) or \
@@ -561,7 +598,14 @@ def _unary_sparse(op_name: str, np_fn):
             return CSRNDArray(np_fn(arr.data), arr.indices, arr.indptr,
                               arr.shape, ctx=arr.context)
         if isinstance(arr, RowSparseNDArray):
-            return RowSparseNDArray(np_fn(arr.data), arr.indices,
+            # rsp values live on device: resolve the jnp twin so the op
+            # stays on-chip instead of bouncing through numpy
+            import jax.numpy as jnp
+            if op_name == "relu":
+                jfn = lambda d: jnp.maximum(d, 0)  # noqa: E731
+            else:
+                jfn = getattr(jnp, op_name)
+            return RowSparseNDArray(jfn(arr.data), arr.indices,
                                     arr.shape, ctx=arr.context)
         from .ndarray.register import invoke_by_name
         return invoke_by_name(op_name, [arr], {})

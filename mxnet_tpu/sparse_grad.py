@@ -1,0 +1,192 @@
+"""In-graph row-sparse embedding gradients (the TPU-native lowering of
+the reference's row_sparse kernels — SURVEY.md §2.1 sparse stypes,
+src/operator/optimizer_op.cc lazy updates).
+
+The reference materializes an embedding gradient as a ``RowSparseNDArray``
+(values + touched row ids) and the optimizer scatters into only those
+rows.  Under one jitted XLA step there is no NDArray object to carry a
+ragged row set, so the same economy is achieved with SHAPE-STABLE pieces:
+
+- the batch's ids are deduplicated in-graph with ``jnp.unique(size=B)``
+  into a fixed power-of-2 *id bucket* (``serving/buckets.py`` discipline:
+  one compiled step per bucket, not per batch histogram);
+- the forward gathers the live rows once, adds a zero *tap buffer*
+  (``zbuf``) of shape ``(B, dim)``, and looks embeddings up from those
+  rows through :func:`rows_lookup`, whose custom VJP is a literal
+  ``jax.ops.segment_sum`` over the dedup inverse — so the gradient of
+  the loss wrt ``zbuf`` IS the ``(values, unique_ids)`` row-sparse
+  gradient, while the table itself sits behind ``stop_gradient`` and
+  its dense cotangent is never built;
+- unused bucket slots carry the out-of-range id ``input_dim``: gathers
+  clip (reading a garbage row whose result is unused), and the
+  optimizer's scatters DROP out-of-bounds ids — the same scratch
+  convention as ``serving/kv_cache.py`` block 0, where unwritten slots
+  point at reserved scratch and garbage is masked to an exact zero.
+
+The trainer discovers which tables actually take the sparse path with a
+trace-time ``jax.eval_shape`` probe (no ops emitted, re-run on every
+retrace so changing batch shapes re-size the bucket), then differentiates
+wrt ``(params, zbufs)``.  ``parallel/optim.py`` turns the resulting
+``(values, unique_ids)`` pairs into gather→update→scatter lazy updates.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Dict, Optional
+
+import numpy as _np
+
+from .base import get_env
+
+__all__ = ["id_bucket", "rows_lookup", "SparseGradTrace", "trace_ctx"]
+
+
+def _next_pow2(n: int) -> int:
+    b = 1
+    while b < n:
+        b *= 2
+    return b
+
+
+def id_bucket(n_ids: int) -> int:
+    """Bucket capacity for a batch of ``n_ids`` embedding lookups: the
+    next power of 2 (one compiled step per bucket), floored by the
+    ``MXTPU_SPARSE_ID_BUCKET`` knob.  The knob can only RAISE the
+    bucket — capacity below the id count could silently drop rows."""
+    auto = _next_pow2(max(1, int(n_ids)))
+    knob = int(get_env("MXTPU_SPARSE_ID_BUCKET"))
+    if knob > 0:
+        return max(auto, _next_pow2(knob))
+    return auto
+
+
+def rows_lookup(rows, inv):
+    """Gather ``rows[inv]`` whose backward is a literal
+    ``jax.ops.segment_sum`` of the output cotangent over the dedup
+    inverse — the in-graph row-sparse gradient kernel.  XLA lowers the
+    segment-sum to a real scatter-add over ``rows.shape[0]`` segments
+    (PERF.md recommender runbook step verifies the lowering on-chip)."""
+    return _rows_lookup(rows, inv)
+
+
+def _make_rows_lookup():
+    import jax
+
+    @jax.custom_vjp
+    def lookup(rows, inv):
+        return rows[inv]
+
+    def fwd(rows, inv):
+        return rows[inv], (inv, rows.shape[0])
+
+    def bwd(res, g):
+        import jax.numpy as jnp   # noqa: F401 — keeps jax resident
+        inv, nrows = res
+        vals = jax.ops.segment_sum(g, inv, num_segments=nrows)
+        # int args take the symbolic-zero float0 cotangent
+        return vals, _np.zeros(inv.shape, jax.dtypes.float0)
+
+    lookup.defvjp(fwd, bwd)
+    return lookup
+
+
+_lookup_cache = None
+
+
+def _rows_lookup(rows, inv):
+    global _lookup_cache
+    if _lookup_cache is None:
+        _lookup_cache = _make_rows_lookup()
+    return _lookup_cache(rows, inv)
+
+
+class _TraceTLS(threading.local):
+    def __init__(self):
+        self.ctx: Optional["SparseGradTrace"] = None
+
+
+_tls = _TraceTLS()
+
+
+def trace_ctx() -> Optional["SparseGradTrace"]:
+    """The active sparse-gradient trace context, or None (eager mode,
+    inference, plain dense training)."""
+    return _tls.ctx
+
+
+class SparseGradTrace:
+    """Per-trace context the sharded trainer opens around the forward.
+
+    Two modes, same code path through ``Embedding.hybrid_forward``:
+
+    - ``probe``: an abstract ``jax.eval_shape`` pass that only RECORDS
+      each sparse table's batch id count (``id_counts``) so the trainer
+      can size the tap buffers; the forward itself stays dense.
+    - ``grad``: the differentiated pass — ``zbufs`` maps a sparse
+      Parameter (by ``id()``) to its ``(bucket, dim)`` tap buffer, and
+      the context collects the per-table ``unique_ids`` tracers
+      (``uids``) that ride out through the loss aux.
+
+    A sparse-marked table whose forward never reaches the context (e.g.
+    a hybridized cached graph that bypasses the NDArray path) simply
+    stays dense — probe and grad traces see identically nothing.
+    """
+
+    def __init__(self, mode: str, zbufs: Optional[Dict[int, object]] = None):
+        if mode not in ("probe", "grad"):
+            raise ValueError(f"mode must be 'probe' or 'grad', got {mode!r}")
+        self.mode = mode
+        self.zbufs = zbufs or {}
+        self.id_counts: Dict[int, int] = {}
+        self.buckets: Dict[int, int] = {}
+        self.uids: Dict[int, object] = {}
+        # tables looked up MORE THAN ONCE in a trace (shared weights):
+        # two independent dedups would each claim the one tap buffer, so
+        # the trainer keeps these dense
+        self.multi: set = set()
+
+    def __enter__(self):
+        self._prev = _tls.ctx
+        _tls.ctx = self
+        return self
+
+    def __exit__(self, *exc):
+        _tls.ctx = self._prev
+        return False
+
+    # -- the Embedding hook ------------------------------------------------
+    def wants(self, param) -> bool:
+        """True when ``param``'s gradient should take the sparse path in
+        THIS trace: every sparse-marked table during the probe; during
+        the grad pass only tables the probe sized a tap buffer for."""
+        if self.mode == "probe":
+            return True
+        return id(param) in self.zbufs
+
+    def embedding(self, param, x_val, w_val, input_dim: int):
+        """The sparse embedding forward for one table.  ``x_val`` /
+        ``w_val`` are raw (traced) arrays; returns the looked-up
+        embeddings.  Probe mode records the id count and returns the
+        dense gather (shapes only — this runs under eval_shape)."""
+        import jax
+        import jax.numpy as jnp
+        ids = jnp.clip(x_val.astype(jnp.int32), 0, input_dim - 1)
+        if self.mode == "probe":
+            if id(param) in self.id_counts:
+                self.multi.add(id(param))
+            self.id_counts[id(param)] = int(_np.prod(ids.shape)) \
+                if ids.ndim else 1
+            self.buckets[id(param)] = id_bucket(
+                self.id_counts[id(param)])
+            return jnp.take(w_val, ids, axis=0, mode="clip")
+        zbuf = self.zbufs[id(param)]
+        bucket = zbuf.shape[0]
+        # shape-stable dedup: unused slots get the out-of-range id
+        # input_dim (scratch convention — gathers clip, scatters drop)
+        uids, inv = jnp.unique(ids.ravel(), size=bucket,
+                               fill_value=input_dim, return_inverse=True)
+        table = jax.lax.stop_gradient(w_val)
+        rows = jnp.take(table, uids, axis=0, mode="clip") + zbuf
+        out = rows_lookup(rows, inv)
+        self.uids[id(param)] = uids
+        return out.reshape(ids.shape + (w_val.shape[1],))

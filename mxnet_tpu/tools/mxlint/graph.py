@@ -56,8 +56,8 @@ MAX_CALL_DEPTH = 6
 #: leaves the fleet's collective schedules desynced.
 COLLECTIVES = frozenset((
     "allgather_bytes", "allgather_host", "allreduce_host",
-    "reduce_scatter_host", "broadcast_host", "barrier", "reform",
-    "quiesce", "step_barrier", "reshard"))
+    "allgather_rows", "reduce_scatter_host", "broadcast_host", "barrier",
+    "reform", "quiesce", "step_barrier", "reshard"))
 
 #: identifiers whose value DIVERGES across hosts — including the
 #: re-form protocol's survivor/leader coordinates (`if me == leader:`

@@ -167,6 +167,80 @@ WORKER = textwrap.dedent("""
 """)
 
 
+SPARSE_WORKER = textwrap.dedent("""
+    import os, sys
+    sys.path.insert(0, os.environ["MXNET_TEST_ROOT"])
+    from mxnet_tpu.base import force_cpu_mesh
+    force_cpu_mesh(1, verify=False)
+    import numpy as np
+    import mxnet_tpu as mx
+    from mxnet_tpu import kvstore as kv
+
+    store = kv.create("dist_sync")    # joins the process group
+    rank, nw = store.rank, store.num_workers
+    from mxnet_tpu.parallel import dist
+
+    # --- invariant 1: allgather_rows round-trips variable-length slabs ----
+    n = rank + 1                      # DIFFERENT length per rank
+    ids = np.arange(n, dtype=np.int64) + 10 * rank
+    rows = np.full((n, 3), float(rank + 1), np.float32)
+    pairs = dist.allgather_rows(ids, rows)
+    assert len(pairs) == nw, len(pairs)
+    for r, (pi, pr) in enumerate(pairs):
+        assert pi.tolist() == [10 * r + k for k in range(r + 1)], (r, pi)
+        assert np.allclose(pr, r + 1) and pr.shape == (r + 1, 3), (r, pr)
+
+    # --- invariant 2: dedup_sum_rows == the dense scatter-sum -------------
+    ids2 = np.array([0, 3, 7], np.int64)      # same ids on every rank:
+    rows2 = np.full((3, 2), float(rank + 1), np.float32)  # full collision
+    uids, summed = dist.dedup_sum_rows(dist.allgather_rows(ids2, rows2))
+    assert uids.tolist() == [0, 3, 7], uids
+    expect = sum(r + 1 for r in range(nw))
+    assert np.allclose(summed, expect), summed
+
+    # --- invariant 3: coalesced sparse exchange trains identically to the
+    # dense kvstore path (sgd, wd=0: lazy == dense on touched rows) --------
+    from mxnet_tpu import nd, autograd, gluon
+    VOCAB, DIM = 40, 6
+    np.random.seed(100 + rank)        # per-rank batches: the exchange
+    Xe = nd.array(np.random.randint(  # must reconcile DIFFERENT row sets
+        0, VOCAB, (8, 2)).astype(np.float32))
+    Ye = nd.array(np.random.randint(0, 3, 8), dtype="int32")
+    lossfn = gluon.loss.SoftmaxCrossEntropyLoss()
+    finals = []
+    for knob in ("1", "0"):
+        os.environ["MXTPU_SPARSE_EXCHANGE"] = knob
+        mx.random.seed(5)
+        net = gluon.nn.HybridSequential(prefix=f"sx{knob}_")
+        with net.name_scope():
+            net.add(gluon.nn.Embedding(VOCAB, DIM, sparse_grad=True))
+            net.add(gluon.nn.Flatten())
+            net.add(gluon.nn.Dense(3))
+        net.initialize()
+        tr = gluon.Trainer(net.collect_params(), "sgd",
+                           {"learning_rate": 0.1},
+                           kvstore=kv.KVStore("dist_sync"),
+                           update_on_kvstore=False)
+        for _ in range(4):
+            with autograd.record():
+                L = lossfn(net(Xe), Ye).mean()
+            L.backward()
+            tr.step(1)
+        finals.append([p.data().asnumpy()
+                       for p in net.collect_params().values()])
+    for a, b in zip(*finals):
+        assert np.allclose(a, b, rtol=1e-5, atol=1e-6), \
+            (rank, np.abs(a - b).max())
+    # replicas in sync after the sparse exchange
+    wsum = float(sum(a.sum() for a in finals[0]))
+    allw = dist.allgather_host(np.array([wsum]))
+    assert np.allclose(allw, allw[0]), allw
+
+    store.barrier()
+    print(f"SPARSE_WORKER_{rank}_OK")
+""")
+
+
 def _free_port():
     s = socket.socket()
     s.bind(("127.0.0.1", 0))
@@ -206,6 +280,42 @@ def test_dist_sync_kvstore_multiprocess(tmp_path, n_workers):
     for r, rc, out in outs:
         assert rc == 0, f"worker {r} failed:\n{out}"
         assert f"WORKER_{r}_OK" in out, f"worker {r} output:\n{out}"
+
+
+def test_dist_sparse_exchange_multiprocess(tmp_path):
+    """2-proc coalesced row-sparse gradient exchange: allgather_rows
+    round-trip, dedup_sum_rows == dense scatter-sum, and gluon training
+    through the sparse exchange matches the dense kvstore path."""
+    n_workers = 2
+    port = _free_port()
+    script = tmp_path / "sparse_worker.py"
+    script.write_text(SPARSE_WORKER)
+    procs = []
+    for r in range(n_workers):
+        env = dict(os.environ)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        env.update({
+            "MXNET_TEST_ROOT": ROOT,
+            "DMLC_PS_ROOT_URI": "127.0.0.1",
+            "DMLC_PS_ROOT_PORT": str(port),
+            "DMLC_NUM_WORKER": str(n_workers),
+            "DMLC_WORKER_ID": str(r),
+        })
+        procs.append(subprocess.Popen(
+            [sys.executable, str(script)], env=env,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True))
+    outs = []
+    for r, p in enumerate(procs):
+        try:
+            out, _ = p.communicate(timeout=180)
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+        outs.append((r, p.returncode, out))
+    for r, rc, out in outs:
+        assert rc == 0, f"worker {r} failed:\n{out}"
+        assert f"SPARSE_WORKER_{r}_OK" in out, f"worker {r} output:\n{out}"
 
 
 def test_dist_sync_requires_process_group():

@@ -24,6 +24,14 @@ def test_example_mnist_mlp_runs():
     assert "epoch 1:" in r.stdout
 
 
+def test_example_recommender_runs():
+    r = _run(["examples/train_recommender.py", "--steps", "30",
+              "--vocab", "5000", "--batch-size", "128"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "sparse grads:" in r.stdout
+    assert "sparse.grad_rows:" in r.stdout
+
+
 def test_example_serve_continuous_batching_runs():
     r = _run(["examples/serve_continuous_batching.py", "--clients", "2",
               "--requests", "20"])

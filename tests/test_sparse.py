@@ -291,8 +291,11 @@ def test_sparse_copy_and_context_roundtrip():
     a = np.eye(4, dtype=np.float32)
     r = sparse.row_sparse_array(a)
     c = r.copy()
-    c.data[0, 0] = 99.0
-    assert r.todense().asnumpy()[0, 0] == 1.0   # deep copy
+    # device-backed rsp buffers are immutable; a copy is independent by
+    # construction — rebinding one must not alias through to the other
+    c.data = c.data.at[0, 0].set(99.0)
+    assert r.todense().asnumpy()[0, 0] == 1.0   # independent copy
+    assert c.todense().asnumpy()[0, 0] == 99.0
     import mxnet_tpu as mx
     moved = r.as_in_context(mx.cpu(0))
     np.testing.assert_allclose(moved.todense().asnumpy(), a)
