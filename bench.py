@@ -813,6 +813,269 @@ def bench_serving(duration_s=3.0):
 
 
 # ---------------------------------------------------------------------------
+# frontend row: two models with conflicting diurnal load on one HTTP host —
+# the SloController defends the priority model's p99 by shedding the other
+# ---------------------------------------------------------------------------
+
+
+def bench_frontend(duration_s=2.0):
+    """Frontend row: TWO models behind one :class:`HttpFrontend` over
+    real sockets — a high-priority MLP carrying a p99 SLO, and a
+    low-priority heavy model whose diurnal load ramps calm → surge →
+    calm.  The same three-phase offered-load script runs twice: with no
+    controller (the surge tramples the priority tail) and with the
+    SloController ticking (the low-priority class 429s at the door and
+    the priority p99 comes back under its SLO — ``surge_settled`` is
+    the second half of the surge, after the control loop's reaction
+    time).  Also streams SSE generations for the socket-measured TTFT
+    tail (the <10ms wire-overhead budget)."""
+    import http.client
+    import socket as socketlib
+    import threading
+
+    import mxnet_tpu as mx  # noqa: F401 — backend/session init
+    from mxnet_tpu import gluon
+    from mxnet_tpu.gluon.model_zoo.transformer import causal_lm_small
+    from mxnet_tpu.serving import (GenerationServer, HttpFrontend,
+                                   ModelRegistry, ModelServer)
+    from mxnet_tpu.tuning import SloController
+
+    rng = np.random.default_rng(0)
+    SLO_MS = 30.0
+    PRIO_RPS = 30.0
+    SURGE_HAMMERS = 6
+
+    def _mlp(in_units, units):
+        net = gluon.nn.HybridSequential()
+        with net.name_scope():
+            net.add(gluon.nn.Dense(units, activation="relu",
+                                   in_units=in_units),
+                    gluon.nn.Dense(units, activation="relu",
+                                   in_units=units),
+                    gluon.nn.Dense(10, in_units=units))
+        net.initialize()
+        net.hybridize()
+        return net
+
+    x_prio = rng.standard_normal((784,)).astype(np.float32)
+    x_heavy = rng.standard_normal((1024,)).astype(np.float32)
+    prio_body = json.dumps({"inputs": [x_prio.tolist()],
+                            "dtype": "float32"})
+    heavy_body = json.dumps({"inputs": [x_heavy.tolist()],
+                             "dtype": "float32"})
+
+    def run_pass(with_controller):
+        reg = ModelRegistry()
+        reg.load("prio", ModelServer(
+            _mlp(784, 128), max_batch=8, workers=2, queue_depth=256,
+            deadline_ms=0, batch_window_us=1000),
+            priority=3, slo_ms=SLO_MS, warm=[(x_prio,)])
+        reg.load("batch", ModelServer(
+            _mlp(1024, 1024), max_batch=8, workers=2, queue_depth=256,
+            deadline_ms=0, batch_window_us=1000),
+            priority=1, slo_ms=0.0, warm=[(x_heavy,)])
+        fe = HttpFrontend(reg, port=0).start()
+        port = fe.port
+
+        ctl = SloController(reg, enabled=True, dry_run=False,
+                            min_requests=4, recover_intervals=2,
+                            hysteresis=1) if with_controller else None
+        stop_ctl = threading.Event()
+        shed_seen = [0]
+
+        def ctl_loop():
+            while not stop_ctl.wait(0.2):
+                try:
+                    ctl.tick()
+                except Exception:  # noqa: BLE001 — keep ticking
+                    pass
+                shed_seen[0] = max(shed_seen[0], reg.shed_level)
+
+        # diurnal low-priority load: one always-on client plus a surge
+        # pool that only hammers during the middle window
+        done = threading.Event()
+        surge_on = threading.Event()
+        batch_200, batch_429 = [0], [0]
+        cnt_lock = threading.Lock()
+
+        def hammer(always):
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=60)
+            while not done.is_set():
+                if not always and not surge_on.is_set():
+                    time.sleep(0.02)
+                    continue
+                try:
+                    c.request("POST", "/v1/models/batch/predict",
+                              body=heavy_body)
+                    st = c.getresponse()
+                    st.read()
+                    with cnt_lock:
+                        if st.status == 200:
+                            batch_200[0] += 1
+                        elif st.status == 429:
+                            batch_429[0] += 1
+                    if st.status == 429:
+                        time.sleep(0.05)   # the 429 contract: back off
+                except OSError:
+                    try:
+                        c.close()
+                    except OSError:
+                        pass
+                    c = http.client.HTTPConnection("127.0.0.1", port,
+                                                   timeout=60)
+            c.close()
+
+        # priority client: fixed-rate open-loop arrivals (no coordinated
+        # omission — a slow response never delays the next arrival)
+        lat = []
+        lat_lock = threading.Lock()
+
+        def one_prio(t_sched):
+            c = http.client.HTTPConnection("127.0.0.1", port,
+                                           timeout=60)
+            t0 = time.perf_counter()
+            try:
+                c.request("POST", "/v1/models/prio/predict",
+                          body=prio_body)
+                r = c.getresponse()
+                r.read()
+                st = r.status
+            except OSError:
+                st = -1
+            finally:
+                c.close()
+            with lat_lock:
+                lat.append((t_sched,
+                            (time.perf_counter() - t0) * 1e3, st))
+
+        threads = [threading.Thread(target=hammer, args=(True,),
+                                    daemon=True)]
+        threads += [threading.Thread(target=hammer, args=(False,),
+                                     daemon=True)
+                    for _ in range(SURGE_HAMMERS)]
+        ctl_thread = threading.Thread(target=ctl_loop, daemon=True)
+        if ctl is not None:
+            ctl.tick()              # prime the interval baselines
+            ctl_thread.start()
+        for t in threads:
+            t.start()
+
+        d = duration_s
+        total = 4 * d               # calm | surge(2d) | recover
+        prio_threads = []
+        t_start = time.perf_counter()
+        k = 0
+        while True:
+            now = time.perf_counter() - t_start
+            if now >= total:
+                break
+            if d <= now < 3 * d:
+                surge_on.set()
+            else:
+                surge_on.clear()
+            t_k = k / PRIO_RPS
+            if now >= t_k:
+                th = threading.Thread(target=one_prio, args=(t_k,),
+                                      daemon=True)
+                th.start()
+                prio_threads.append(th)
+                k += 1
+            else:
+                time.sleep(min(t_k - now, 0.005))
+        done.set()
+        surge_on.clear()
+        for th in prio_threads:
+            th.join(timeout=60)
+        stop_ctl.set()
+        if ctl is not None:
+            ctl_thread.join(timeout=5)
+        workers_final = int(reg.get("prio").server.workers)
+        fe.stop(drain=True)
+
+        def phase(lo, hi):
+            vals = sorted(v for t, v, s in lat
+                          if lo <= t < hi and s == 200)
+            return {"n": len(vals),
+                    "p50_ms": round(_gen_percentile(vals, 0.50), 2),
+                    "p99_ms": round(_gen_percentile(vals, 0.99), 2)}
+
+        return {"phases": {"calm": phase(0, d),
+                           "surge_early": phase(d, 2 * d),
+                           "surge_settled": phase(2 * d, 3 * d),
+                           "recover": phase(3 * d, 4 * d)},
+                "priority_errors": sum(1 for _, _, s in lat
+                                       if s not in (200,)),
+                "batch_200": batch_200[0],
+                "batch_429": batch_429[0],
+                "max_shed_level": shed_seen[0],
+                "prio_workers_final": workers_final}
+
+    off = run_pass(with_controller=False)
+    on = run_pass(with_controller=True)
+
+    # --- SSE TTFT through the socket -------------------------------------
+    lm = causal_lm_small()
+    lm.initialize()
+    lm.hybridize()
+    reg = ModelRegistry()
+    reg.load("lm", GenerationServer(
+        lm, slots=4, kv_block=16, kv_blocks=64, max_new_tokens=8,
+        prompt_buckets=(16,), queue_depth=64, deadline_ms=0),
+        priority=1, warm=True)
+    fe = HttpFrontend(reg, port=0).start()
+    ttfts = []
+    try:
+        for i in range(30):
+            n = int(rng.integers(4, 13))
+            body = json.dumps({
+                "prompt": [int(t) for t in rng.integers(1, 250, (n,))],
+                "max_new_tokens": 8})
+            s = socketlib.create_connection(("127.0.0.1", fe.port),
+                                            timeout=60)
+            try:
+                t0 = time.perf_counter()
+                s.sendall(("POST /v1/models/lm/generate HTTP/1.1\r\n"
+                           f"Host: x\r\nContent-Length: {len(body)}"
+                           "\r\n\r\n" + body).encode())
+                buf = b""
+                while b"data:" not in buf:
+                    chunk = s.recv(65536)
+                    if not chunk:
+                        break
+                    buf += chunk
+                ttft_ms = (time.perf_counter() - t0) * 1e3
+                while s.recv(65536):   # drain: server closes SSE conns
+                    pass
+            finally:
+                s.close()
+            if i >= 5:                 # settle scheduler/alloc jitter
+                ttfts.append(ttft_ms)
+    finally:
+        fe.stop(drain=True)
+    ttfts.sort()
+
+    off_p99 = off["phases"]["surge_settled"]["p99_ms"]
+    on_p99 = on["phases"]["surge_settled"]["p99_ms"]
+    return {
+        "slo_ms": SLO_MS,
+        "priority_offered_rps": PRIO_RPS,
+        "without_slo_controller": off,
+        "with_slo_controller": on,
+        "surge_p99_no_controller_ms": off_p99,
+        "surge_p99_with_controller_ms": on_p99,
+        "slo_violated_without_controller": bool(off_p99 > SLO_MS),
+        "slo_held_with_controller": bool(0 < on_p99 <= SLO_MS),
+        "batch_shed_429": on["batch_429"],
+        "surge_p99_improvement_x": round(
+            off_p99 / max(on_p99, 1e-3), 2),
+        "sse_ttft_p50_ms": round(_gen_percentile(ttfts, 0.50), 2),
+        "sse_ttft_p99_ms": round(_gen_percentile(ttfts, 0.99), 2),
+        "sse_generations": len(ttfts),
+    }
+
+
+# ---------------------------------------------------------------------------
 # generation row: token-level continuous batching vs the whole-sequence
 # batcher
 # ---------------------------------------------------------------------------
@@ -1795,7 +2058,8 @@ def main():
                                        "mnist_mlp", "eager_dispatch",
                                        "bert", "bert_bf16",
                                        "nmt", "ssd", "pipeline",
-                                       "serving", "generate", "autotune",
+                                       "serving", "frontend",
+                                       "generate", "autotune",
                                        "multichip", "overlap",
                                        "recommender"],
                     help="run a single row (default: the full suite)")
@@ -1954,6 +2218,8 @@ def main():
         rows["input_pipeline"] = bench_pipeline()
     elif args.only == "serving":
         rows["serving"] = bench_serving()
+    elif args.only == "frontend":
+        rows["frontend"] = bench_frontend()
     elif args.only == "autotune":
         rows["autotune"] = bench_autotune()
     elif args.only in ("resnet_bf16", "resnet_fp32") or args.dtype:
@@ -2081,6 +2347,7 @@ def main():
         sub_row("ssd", ["ssd_detection"], row_budget)
         sub_row("pipeline", ["input_pipeline"], 900)
         sub_row("serving", ["serving"], 900)
+        sub_row("frontend", ["frontend"], 900)
         sub_row("generate", ["generate"], 1800)
         sub_row("autotune", ["autotune"], 900)
         sub_row("multichip", ["multichip"], 1800)
@@ -2100,6 +2367,8 @@ def main():
         "ssd_detection": ("images_per_sec", "images/sec"),
         "input_pipeline": ("images_per_sec", "images/sec"),
         "serving": ("requests_per_sec", "req/s"),
+        "frontend": ("surge_p99_improvement_x",
+                     "x priority p99 under surge vs no controller"),
         "autotune": ("converged_bulk_size", "ops/segment"),
         "multichip": ("speedup_dp2", "x aggregate img/s vs dp=1"),
         "overlap": ("best_step_improvement_x", "x vs overlap-off"),

@@ -285,6 +285,27 @@ register_env("MXTPU_SERVING_MAX_NEW_TOKENS", 64, int,
              "Serving: default cap on generated tokens per request "
              "when submit_generate() is not given max_new_tokens; also "
              "bounds the worst-case KV block reservation.")
+register_env("MXTPU_FRONTEND_PORT", "", str,
+             "Serving: TCP port for the multi-model HTTP frontend "
+             "(mxnet_tpu.serving.HttpFrontend — JSON predict, SSE "
+             "token streaming, W3C traceparent).  Empty (default) "
+             "binds an ephemeral port; the frontend only listens when "
+             "constructed explicitly.")
+register_env("MXTPU_FRONTEND_PRIORITY", 0, int,
+             "Serving: default priority for models loaded into the "
+             "ModelRegistry without an explicit one (higher = more "
+             "important; models below the registry shed level are "
+             "429'd at the door).")
+register_env("MXTPU_FRONTEND_SLO_MS", 0.0, float,
+             "Serving: default per-model p99 latency SLO in ms for "
+             "models loaded without an explicit slo_ms — the budget "
+             "the SloController defends (0 = no SLO, never watched).")
+register_env("MXTPU_TUNE_SLO", True, bool,
+             "Self-tuning: enable the SloController (watches each "
+             "registered model's socket-to-socket request p99 against "
+             "its SLO; sheds lowest-priority-first via the registry "
+             "gate and scales the violator's dispatch workers).  "
+             "Per-registry instance surface: attach it explicitly.")
 register_env("MXTPU_TUNE_DECODE_SLOTS", False, bool,
              "Self-tuning: enable the DecodeSlotController (hill-climbs "
              "MXTPU_SERVING_DECODE_SLOTS on interval tokens/s with the "

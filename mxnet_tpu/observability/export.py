@@ -51,6 +51,16 @@ PROM_PREFIX = "mxtpu_"
 
 _SANITIZE_RE = re.compile(r"[^a-zA-Z0-9_]")
 
+#: per-model serving metrics (``serving.model.<name>.<metric>`` — see
+#: :data:`mxnet_tpu.serving.registry.MODEL_METRIC_PREFIX`) re-render as
+#: ONE Prometheus family per <metric> with a real ``model="<name>"``
+#: label: ``mxtpu_serving_model_<metric>{model="<name>"}``.  The family
+#: name keeps the ``model`` component so it can never collide with the
+#: servers' own unlabeled ``mxtpu_serving_*`` spine (a family may only
+#: carry one TYPE header per exposition).
+_MODEL_METRIC_RE = re.compile(r"^serving\.model\.([a-z0-9_]+)\.(.+)$")
+_MODEL_HELP_PREFIX_RE = re.compile(r"^model [^:]*: ")
+
 
 def _prom_name(name: str) -> str:
     return PROM_PREFIX + _SANITIZE_RE.sub("_", name)
@@ -88,9 +98,21 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None,
     containing them, so the default text format never carries any."""
     reg = reg if reg is not None else registry()
     lines = []
+    # two passes: registry names sort with the model component BEFORE
+    # the metric (serving.model.a.request_us, serving.model.a.requests,
+    # serving.model.b.request_us, ...) but Prometheus requires all
+    # samples of one family contiguous under a single TYPE header — so
+    # per-model metrics are collected into families here and emitted
+    # after the plain spine.
+    families = {}                  # metric -> [(model, m)]
     for name in reg.names():
         m = reg.get(name)
         if m is None:                     # raced an (hypothetical) removal
+            continue
+        mm = _MODEL_METRIC_RE.match(name)
+        if mm:
+            families.setdefault(mm.group(2), []).append(
+                (mm.group(1), m))
             continue
         pname = _prom_name(name)
         hl = _help_line(pname, m.help)
@@ -120,6 +142,43 @@ def prometheus_text(reg: Optional[MetricsRegistry] = None,
                 lines.append(line)
             lines.append(f"{pname}_sum {_fmt(m.total)}")
             lines.append(f"{pname}_count {m.count}")
+    for metric in sorted(families):
+        entries = families[metric]
+        pname = _prom_name(f"serving.model.{metric}")
+        # the per-entry help embeds the model name; the family header
+        # is model-agnostic, so strip the "model <name>: " prefix
+        help_text = next((_MODEL_HELP_PREFIX_RE.sub("", m.help)
+                          for _, m in entries if m.help), "")
+        hl = _help_line(pname, help_text)
+        if hl:
+            lines.append(hl)
+        kind = entries[0][1]
+        if isinstance(kind, Counter):
+            lines.append(f"# TYPE {pname} counter")
+            for model, m in entries:
+                lines.append(f'{pname}{{model="{model}"}} {_fmt(m.n)}')
+        elif isinstance(kind, Gauge):
+            lines.append(f"# TYPE {pname} gauge")
+            for model, m in entries:
+                lines.append(
+                    f'{pname}{{model="{model}"}} {_fmt(m.value)}')
+        elif isinstance(kind, Histogram):
+            lines.append(f"# TYPE {pname} histogram")
+            for model, m in entries:
+                ex = m.exemplars() if exemplars else {}
+                for bound, cum in m.cumulative_buckets():
+                    line = (f'{pname}_bucket{{model="{model}",'
+                            f'le="{_fmt(bound)}"}} {cum}')
+                    e = ex.get(bound)
+                    if e:
+                        tid, val, ts = e[-1]
+                        line += (f' # {{trace_id="{tid}"}} '
+                                 f'{_fmt(val)} {ts}')
+                    lines.append(line)
+                lines.append(
+                    f'{pname}_sum{{model="{model}"}} {_fmt(m.total)}')
+                lines.append(
+                    f'{pname}_count{{model="{model}"}} {m.count}')
     return "\n".join(lines) + "\n"
 
 

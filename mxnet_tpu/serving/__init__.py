@@ -32,7 +32,13 @@ batching**:
   scheduler where the schedulable unit is one decode step, finished
   requests exit the running batch every iteration, and queued prefills
   join open slots immediately (``MXTPU_SERVING_PREFILL_MODE`` picks
-  interleaved vs batch-first prefill).
+  interleaved vs batch-first prefill);
+- :mod:`.registry` + :mod:`.frontend` — the production front door: a
+  :class:`ModelRegistry` holding N named servers with priorities and
+  per-model SLOs behind one admission gate, and the stdlib
+  :class:`HttpFrontend` speaking JSON predict / SSE token streaming /
+  W3C ``traceparent`` over it (``POST /v1/models/<name>/predict``,
+  ``.../generate``, ``GET /v1/models``, ``/healthz``, ``/readyz``).
 
 Quick start::
 
@@ -52,19 +58,24 @@ Knobs: ``MXTPU_SERVING_MAX_BATCH``, ``MXTPU_SERVING_QUEUE_DEPTH``,
 ``MXTPU_SERVING_DEADLINE_MS``, ``MXTPU_SERVING_WORKERS``,
 ``MXTPU_SERVING_BATCH_WINDOW_US``, ``MXTPU_SERVING_KV_BLOCK``,
 ``MXTPU_SERVING_KV_BLOCKS``, ``MXTPU_SERVING_DECODE_SLOTS``,
-``MXTPU_SERVING_PREFILL_MODE``, ``MXTPU_SERVING_MAX_NEW_TOKENS``
-(see the README knob table).
+``MXTPU_SERVING_PREFILL_MODE``, ``MXTPU_SERVING_MAX_NEW_TOKENS``,
+``MXTPU_FRONTEND_PORT``, ``MXTPU_FRONTEND_PRIORITY``,
+``MXTPU_FRONTEND_SLO_MS`` (see the README knob table).
 """
 from __future__ import annotations
 
 from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded,
-                      GenRequest, Request, ServerClosed, ServerOverloaded,
-                      ServingError)
+                      GenRequest, Request, RequestCancelled, ServerClosed,
+                      ServerOverloaded, ServingError)
 from .buckets import Bucketer, NoBucketError
+from .frontend import HttpFrontend
 from .kv_cache import BlockKVCache, BlockTable, SCRATCH_BLOCK
+from .registry import ModelEntry, ModelRegistry, UnknownModel
 from .server import GenerationServer, ModelServer
 
 __all__ = ["ModelServer", "GenerationServer", "Bucketer", "Request",
            "GenRequest", "AdmissionQueue", "Batcher", "BlockKVCache",
            "BlockTable", "SCRATCH_BLOCK", "ServingError", "ServerClosed",
-           "ServerOverloaded", "DeadlineExceeded", "NoBucketError"]
+           "ServerOverloaded", "DeadlineExceeded", "RequestCancelled",
+           "NoBucketError", "HttpFrontend", "ModelRegistry", "ModelEntry",
+           "UnknownModel"]

@@ -32,7 +32,7 @@ from ..base import MXNetError, hot_path
 
 __all__ = ["Request", "GenRequest", "AdmissionQueue", "Batcher",
            "ServingError", "ServerClosed", "ServerOverloaded",
-           "DeadlineExceeded"]
+           "DeadlineExceeded", "RequestCancelled"]
 
 
 class ServingError(MXNetError):
@@ -51,6 +51,12 @@ class ServerOverloaded(ServingError):
 
 class DeadlineExceeded(ServingError):
     """The request's deadline expired while it was still queued."""
+
+
+class RequestCancelled(ServingError):
+    """The client walked away (stream disconnect / explicit cancel);
+    the server dropped the request at the next iteration boundary and
+    released its resources."""
 
 
 class Request:
@@ -110,11 +116,19 @@ class GenRequest:
     leaves the running batch.  ``trace`` is the causal-tracing root
     opened at submit (None when tracing is off/sampled out) — the
     request object carries it across the submit→scheduler thread hop,
-    and every decode step the request rides links back to it."""
+    and every decode step the request rides links back to it.
+
+    Tokens are published through :meth:`push_token` (scheduler side)
+    and consumed either whole (:meth:`result`) or incrementally
+    (:meth:`stream` — the SSE frontend's per-token seam).  The
+    publisher never blocks: the token list grows under a condition the
+    consumer waits on, so a slow stream reader stalls only its own
+    socket, never the decode loop."""
 
     __slots__ = ("rid", "prompt", "max_new_tokens", "deadline", "eos",
                  "tokens", "trace", "t_enqueue", "t_prefill", "t_first",
-                 "t_done", "pos", "_event", "_error")
+                 "t_done", "pos", "cancelled", "_tcond", "_event",
+                 "_error")
 
     def __init__(self, rid: int, prompt, max_new_tokens: int,
                  deadline: Optional[float], eos: Optional[int]):
@@ -130,11 +144,52 @@ class GenRequest:
         self.t_first = 0.0
         self.t_done = 0.0
         self.pos = 0          # position of the NEXT token to decode
+        self.cancelled = False  # set by cancel(); honored by the
+        #                         scheduler at the next iteration edge
+        self._tcond = threading.Condition()
         self._event = threading.Event()
         self._error: Optional[BaseException] = None
 
     def done(self) -> bool:
         return self._event.is_set()
+
+    def push_token(self, tok: int) -> None:
+        """Scheduler side: publish one generated token and wake any
+        stream consumer.  Non-blocking by construction."""
+        with self._tcond:
+            self.tokens.append(tok)
+            self._tcond.notify_all()
+
+    def _wake_stream(self) -> None:
+        """Completion side: wake stream consumers blocked past the last
+        token (called after the done event is set)."""
+        with self._tcond:
+            self._tcond.notify_all()
+
+    def stream(self, timeout: Optional[float] = None):
+        """Incremental consumer: yield token ids as the scheduler emits
+        them, ending when the generation finishes (the streaming twin
+        of :meth:`result`).  ``timeout`` bounds each WAIT for the next
+        token, not the whole generation.  The request's error
+        (deadline, shed, cancel) is raised after every already-emitted
+        token has been yielded."""
+        i = 0
+        while True:
+            with self._tcond:
+                while i >= len(self.tokens) and not self._event.is_set():
+                    if not self._tcond.wait(timeout):
+                        raise TimeoutError(
+                            f"generation {self.rid}: no token within "
+                            f"{timeout}s")
+                fresh = self.tokens[i:]
+                finished = self._event.is_set()
+            for tok in fresh:
+                i += 1
+                yield tok
+            if finished and i >= len(self.tokens):
+                if self._error is not None:
+                    raise self._error
+                return
 
     def result(self, timeout: Optional[float] = None) -> List[int]:
         """Block until generation finishes; returns the generated token

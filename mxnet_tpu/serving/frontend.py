@@ -1,0 +1,426 @@
+"""HTTP frontend: the wire protocol over the model registry.
+
+The stdlib-``http.server`` front door (the ``MXTPU_METRICS_PORT``
+precedent — zero new dependencies) that turns "a server object in a
+process" into "a service": N named models behind one port, speaking
+JSON for one-shot inference and Server-Sent Events for token
+streaming.
+
+Wire surface::
+
+    GET  /healthz                      liveness (the process is up)
+    GET  /readyz                       readiness (models loaded+warm,
+                                       not draining) — 503 otherwise
+    GET  /v1/models                    registry listing + live stats
+    POST /v1/models/<name>/predict     {"inputs": [[...], ...]} ->
+                                       {"outputs": [...]} over
+                                       submit()/result()
+    POST /v1/models/<name>/generate    {"prompt": [ids]} -> SSE stream,
+                                       one `data:` event per token,
+                                       terminated by `event: done`
+
+Contracts the tests pin down:
+
+- **bitwise parity** — a predict response carries exactly the floats
+  ``submit()`` would have returned (JSON round-trips repr-precision);
+- **streaming** — tokens flush per decode iteration (TCP_NODELAY, one
+  ``flush()`` per event), so socket TTFT tracks in-process TTFT; a
+  client hanging up mid-stream cancels the generation at the next
+  iteration edge and its KV blocks return to the pool;
+- **trace stitching** — a W3C ``traceparent`` request header becomes
+  the parent of the request's ``serving.request``/``serving.generate``
+  root (one trace from the caller's socket to the decode step); the
+  response echoes the request root's traceparent back;
+- **admission** — the registry's priority gate runs before the model's
+  own admission queue; both reject as HTTP 429 with a JSON body naming
+  the reason;
+- **graceful shutdown** — ``stop()`` (or SIGTERM via
+  :meth:`HttpFrontend.install_sigterm`) closes the listener, then
+  drains every registered server — the GenerationServer drain included,
+  so KV occupancy is zero when the process exits.
+
+Knobs: ``MXTPU_FRONTEND_PORT`` (the deployment opt-in),
+``MXTPU_FRONTEND_SLO_MS``, ``MXTPU_FRONTEND_PRIORITY``.
+"""
+from __future__ import annotations
+
+import json
+import signal
+import socket as _socket
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+
+import numpy as _np
+
+from ..base import get_env, hot_path
+from ..observability import tracing as _tracing
+from .batcher import (DeadlineExceeded, RequestCancelled, ServerClosed,
+                      ServerOverloaded, ServingError)
+from .buckets import NoBucketError
+from .registry import ModelRegistry, UnknownModel
+
+__all__ = ["HttpFrontend", "FRONTEND_PORT_ENV"]
+
+FRONTEND_PORT_ENV = "MXTPU_FRONTEND_PORT"
+
+#: HTTP status for each serving-error shape (the wire contract)
+_STATUS = (
+    (UnknownModel, 404),
+    (ServerOverloaded, 429),
+    (DeadlineExceeded, 504),
+    (RequestCancelled, 499),      # nginx's "client closed request"
+    (ServerClosed, 503),
+    (NoBucketError, 400),
+)
+
+
+def _status_for(exc: BaseException) -> int:
+    for etype, code in _STATUS:
+        if isinstance(exc, etype):
+            return code
+    if isinstance(exc, TimeoutError):
+        return 504
+    return 400 if isinstance(exc, (ValueError, KeyError, TypeError)) \
+        else 500
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "mxtpu-frontend"
+    #: HTTP/1.1: keep-alive for the JSON endpoints (Content-Length
+    #: delimited); SSE responses opt out per-response via
+    #: ``Connection: close`` (close-delimited stream)
+    protocol_version = "HTTP/1.1"
+
+    # -- plumbing ------------------------------------------------------
+    @property
+    def _fe(self) -> "HttpFrontend":
+        return self.server.frontend
+
+    def log_message(self, fmt, *args):   # no stderr chatter per request
+        pass
+
+    def _read_json(self) -> dict:
+        n = int(self.headers.get("Content-Length") or 0)
+        if n <= 0:
+            return {}
+        body = self.rfile.read(n)
+        payload = json.loads(body)
+        if not isinstance(payload, dict):
+            raise ValueError("request body must be a JSON object")
+        return payload
+
+    def _drain_body(self) -> None:
+        """Discard the unread request body WITHOUT parsing it, so a
+        rejection issued before ingestion (shed 429, unknown model)
+        stays cheap under a retry storm while the keep-alive stream
+        keeps its framing."""
+        n = int(self.headers.get("Content-Length") or 0)
+        while n > 0:
+            chunk = self.rfile.read(min(n, 1 << 16))
+            if not chunk:
+                break
+            n -= len(chunk)
+
+    def _send_json(self, code: int, obj: dict,
+                   extra_headers=()) -> None:
+        body = json.dumps(obj).encode()
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(body)))
+        for k, v in extra_headers:
+            self.send_header(k, v)
+        self.end_headers()
+        self.wfile.write(body)
+
+    def _send_error_json(self, exc: BaseException) -> None:
+        code = _status_for(exc)
+        self._send_json(code, {"error": type(exc).__name__,
+                               "detail": str(exc), "status": code})
+
+    def _remote_ctx(self):
+        """The caller's W3C trace context, if the header carries one."""
+        return _tracing.parse_traceparent(
+            self.headers.get("traceparent"))
+
+    # -- GET -----------------------------------------------------------
+    def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.partition("?")[0]
+        if path == "/healthz":
+            self._send_json(200, {"ok": True})
+        elif path == "/readyz":
+            fe = self._fe
+            if fe.draining:
+                self._send_json(503, {"ready": False,
+                                      "reason": "draining"})
+            elif not fe.registry.ready():
+                self._send_json(503, {"ready": False,
+                                      "reason": "models not ready"})
+            else:
+                self._send_json(200, {"ready": True})
+        elif path == "/v1/models":
+            self._send_json(200, self._fe.registry.describe())
+        else:
+            self._send_json(404, {"error": "NotFound", "status": 404,
+                                  "detail": "try /v1/models, /healthz, "
+                                            "/readyz"})
+
+    # -- POST ----------------------------------------------------------
+    def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
+        path = self.path.partition("?")[0]
+        parts = [p for p in path.split("/") if p]
+        if len(parts) == 4 and parts[0] == "v1" and \
+                parts[1] == "models" and \
+                parts[3] in ("predict", "generate"):
+            name, verb = parts[2], parts[3]
+            try:
+                # admission BEFORE ingestion: a shed (429) or unknown
+                # model must not pay the JSON parse — the door has to
+                # stay cheap exactly when the SloController is
+                # turning traffic away
+                try:
+                    entry = self._fe.registry.get(name)
+                    self._fe.registry.admit(entry)
+                except Exception:
+                    self._drain_body()
+                    raise
+                payload = self._read_json()
+                if verb == "predict":
+                    if entry.kind != "predict":
+                        raise ValueError(
+                            f"model {name!r} is a generation model — "
+                            f"POST .../generate")
+                    self._predict(entry, payload)
+                else:
+                    if entry.kind != "generate":
+                        raise ValueError(
+                            f"model {name!r} is a predict model — "
+                            f"POST .../predict")
+                    self._generate(entry, payload)
+            except (BrokenPipeError, ConnectionResetError):
+                return                # client gone; nothing to answer
+            except Exception as e:    # noqa: BLE001 — wire boundary:
+                self._send_error_json(e)   # every failure is a status
+        else:
+            self._send_json(404, {"error": "NotFound", "status": 404,
+                                  "detail": "POST /v1/models/<name>/"
+                                            "predict|generate"})
+
+    # -- predict -------------------------------------------------------
+    def _predict(self, entry, payload: dict) -> None:
+        t0 = time.monotonic()
+        raw = payload["inputs"] if "inputs" in payload \
+            else [payload["input"]]
+        dtypes = payload.get("dtypes")
+        arrays = []
+        for i, v in enumerate(raw):
+            dt = dtypes[i] if dtypes else payload.get("dtype")
+            arrays.append(_np.asarray(v, dtype=dt) if dt
+                          else _np.asarray(v))
+        entry.c_requests.inc()
+        # the remote context (when given) parents the request root the
+        # server opens at submit — one trace from the caller's socket
+        # to the dispatch span
+        with _tracing.activate(self._remote_ctx()):
+            req = entry.server.submit(
+                *arrays, deadline_ms=payload.get("deadline_ms"))
+        try:
+            result = req.result(
+                timeout=float(payload.get("timeout_s", 60.0)))
+        except ServingError:
+            raise
+        rows = result if isinstance(result, tuple) else (result,)
+        dur_us = (time.monotonic() - t0) * 1e6
+        trace_id = None if req.trace is None else req.trace.trace_id
+        entry.h_request.observe(dur_us, trace_id=trace_id)
+        entry.c_done.inc()
+        headers = []
+        if req.trace is not None:
+            headers.append(("traceparent", req.trace.traceparent))
+        self._finish_predict(entry, req, rows, dur_us, headers)
+
+    @hot_path("dispatch")
+    def _finish_predict(self, entry, req, rows, dur_us,
+                        headers) -> None:
+        """Response serialization — the frontend's per-request hot
+        tail: one JSON body, one socket write."""
+        body = {"model": entry.name, "rid": req.rid,
+                "outputs": [r.tolist() for r in rows],
+                "shapes": [list(r.shape) for r in rows],
+                "us": round(dur_us, 1)}
+        self._send_json(200, body, extra_headers=headers)
+
+    # -- generate (SSE) ------------------------------------------------
+    def _generate(self, entry, payload: dict) -> None:
+        t0 = time.monotonic()
+        prompt = payload["prompt"]
+        kw = {}
+        if payload.get("max_new_tokens") is not None:
+            kw["max_new_tokens"] = int(payload["max_new_tokens"])
+        if payload.get("deadline_ms") is not None:
+            kw["deadline_ms"] = float(payload["deadline_ms"])
+        if payload.get("eos") is not None:
+            kw["eos"] = int(payload["eos"])
+        entry.c_requests.inc()
+        with _tracing.activate(self._remote_ctx()):
+            req = entry.server.submit_generate(prompt, **kw)
+        # SSE: close-delimited stream (no Content-Length), flushed per
+        # token.  TCP_NODELAY so each event leaves the host now — the
+        # socket-measured TTFT contract depends on it.
+        try:
+            self.connection.setsockopt(_socket.IPPROTO_TCP,
+                                       _socket.TCP_NODELAY, 1)
+        except OSError:
+            pass
+        self.send_response(200)
+        self.send_header("Content-Type", "text/event-stream")
+        self.send_header("Cache-Control", "no-cache")
+        if req.trace is not None:
+            self.send_header("traceparent", req.trace.traceparent)
+        self.send_header("Connection", "close")
+        self.close_connection = True
+        self.end_headers()
+        timeout = float(payload.get("timeout_s", 60.0))
+        n = 0
+        try:
+            for tok in req.stream(timeout=timeout):
+                if n == 0:
+                    trace_id = None if req.trace is None \
+                        else req.trace.trace_id
+                    entry.h_ttft.observe(
+                        (time.monotonic() - t0) * 1e6,
+                        trace_id=trace_id)
+                self._write_event(
+                    f'data: {{"token": {int(tok)}, "index": {n}}}\n\n')
+                n += 1
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            # the client hung up mid-stream: cancel so the scheduler
+            # retires the slot and the KV blocks return to the pool
+            entry.server.cancel(req)
+            return
+        except ServingError as e:
+            # stream already started — the error rides the stream
+            try:
+                self._write_event(
+                    "event: error\ndata: "
+                    + json.dumps({"error": type(e).__name__,
+                                  "detail": str(e),
+                                  "status": _status_for(e)}) + "\n\n")
+            except OSError:
+                pass
+            return
+        dur_us = (time.monotonic() - t0) * 1e6
+        trace_id = None if req.trace is None else req.trace.trace_id
+        entry.h_request.observe(dur_us, trace_id=trace_id)
+        entry.c_done.inc()
+        self._write_event(
+            "event: done\ndata: "
+            + json.dumps({"model": entry.name, "rid": req.rid,
+                          "tokens": req.tokens, "n": n,
+                          "us": round(dur_us, 1)}) + "\n\n")
+
+    @hot_path("dispatch")
+    def _write_event(self, event: str) -> None:
+        """One SSE event onto the wire — the frontend's per-token hot
+        path: encode, write, flush (TCP_NODELAY set at stream start, so
+        the flush IS the send)."""
+        self.wfile.write(event.encode())
+        self.wfile.flush()
+
+
+class _Server(ThreadingHTTPServer):
+    daemon_threads = True
+    #: a handler thread blocked in result()/stream() must not outlive
+    #: a stuck client forever
+    allow_reuse_address = True
+
+
+class HttpFrontend:
+    """The production front door: one listener over a
+    :class:`~mxnet_tpu.serving.registry.ModelRegistry`.
+
+    ``port=0`` binds an ephemeral port (tests) — the bound port is
+    ``frontend.port``.  ``stop(drain=True)`` closes the listener, then
+    drains every registered server (the graceful-shutdown contract);
+    :meth:`install_sigterm` wires that to SIGTERM the same way the
+    servers themselves do — the handler never blocks in signal
+    context."""
+
+    def __init__(self, registry: Optional[ModelRegistry] = None,
+                 port: Optional[int] = None, addr: str = "0.0.0.0",
+                 start: bool = False):
+        if port is None:
+            knob = str(get_env(FRONTEND_PORT_ENV)).strip()
+            port = int(knob) if knob else 0
+        self.registry = registry if registry is not None \
+            else ModelRegistry()
+        self._httpd = _Server((addr, int(port)), _Handler)
+        self._httpd.frontend = self
+        self._thread: Optional[threading.Thread] = None
+        self._prev_sigterm = None
+        self.draining = False
+        if start:
+            self.start()
+
+    @property
+    def port(self) -> int:
+        return self._httpd.server_address[1]
+
+    # -- lifecycle -----------------------------------------------------
+    def start(self) -> "HttpFrontend":
+        if self._thread is not None:
+            return self
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.25},
+            daemon=True, name="mxtpu-frontend")
+        self._thread.start()
+        return self
+
+    def stop(self, drain: bool = True,
+             timeout: Optional[float] = None) -> None:
+        """Graceful shutdown: stop accepting, then drain (or shed) every
+        registered server.  In-flight handler threads holding request
+        futures complete on the servers' own drain path."""
+        self.draining = True
+        if self._thread is not None:
+            self._httpd.shutdown()
+            self._httpd.server_close()
+            self._thread.join(timeout=5)
+            self._thread = None
+        self.registry.stop_all(drain=drain, timeout=timeout)
+
+    def __enter__(self) -> "HttpFrontend":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.stop(drain=exc_type is None)
+
+    def install_sigterm(self) -> None:
+        """Chain a SIGTERM handler that gracefully stops the frontend
+        (listener down, every model drained — the k8s preStop
+        contract).  Same discipline as the servers' own installers: the
+        handler spawns a non-daemon drain thread and returns
+        immediately, never blocking in signal context."""
+        prev = signal.getsignal(signal.SIGTERM)
+        self._prev_sigterm = prev
+
+        def drain_then_chain(signum, frame):
+            self.stop(drain=True)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        def handler(signum, frame):
+            threading.Thread(target=drain_then_chain,
+                             args=(signum, frame),
+                             name="mxtpu-frontend-sigterm-drain",
+                             daemon=False).start()
+
+        signal.signal(signal.SIGTERM, handler)
+
+    def uninstall_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None

@@ -42,7 +42,8 @@ from ..observability import tracing as _tracing
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry
 from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded,
-                      GenRequest, Request, ServerClosed, ServerOverloaded)
+                      GenRequest, Request, RequestCancelled, ServerClosed,
+                      ServerOverloaded)
 from .buckets import Bucketer, NoBucketError
 from .kv_cache import BlockKVCache
 
@@ -395,6 +396,31 @@ class ModelServer:
         }
 
     # -- compiled-graph resolution (cold path) -------------------------------
+    def _build_graph(self, block, key: Tuple, batch: int):
+        """Compile ``block``'s executable for one (shape bucket, batch
+        bucket) signature — lock-free, so :meth:`swap_block` can stage a
+        full replacement graph set while live traffic keeps hitting the
+        current one."""
+        examples = [nd_array(_np.zeros((batch,) + tuple(shape),
+                                       dtype=dt))
+                    for shape, dt in key]
+        from ..gluon.block import HybridBlock
+        if isinstance(block, HybridBlock):
+            g = block.cached_graph(*examples).raw
+        else:
+            g = _freeze_generic(block, examples)
+        # one throwaway dispatch with HOST (numpy) arguments — the
+        # argument types live batches arrive with.  The build above
+        # warmed the executable against device-committed example
+        # arrays; jax keys the lowering on argument sharding, so
+        # without this the FIRST live batch would pay a second
+        # lowering+compile (measured: ~600ms on the transformer)
+        import jax as _jax
+        _jax.block_until_ready(g(
+            *[_np.zeros((batch,) + tuple(shape), dtype=dt)
+              for shape, dt in key]))
+        return g
+
     def _graph_for(self, key: Tuple, batch: int):
         """The executable for one (shape bucket, batch bucket): built on
         first use (``warmup()`` prebuilds), then a dict hit forever."""
@@ -406,26 +432,73 @@ class ModelServer:
             g = self._graphs.get(gk)
             if g is not None:
                 return g
-            examples = [nd_array(_np.zeros((batch,) + tuple(shape),
-                                           dtype=dt))
-                        for shape, dt in key]
-            from ..gluon.block import HybridBlock
-            if isinstance(self._block, HybridBlock):
-                g = self._block.cached_graph(*examples).raw
-            else:
-                g = _freeze_generic(self._block, examples)
-            # one throwaway dispatch with HOST (numpy) arguments — the
-            # argument types live batches arrive with.  The build above
-            # warmed the executable against device-committed example
-            # arrays; jax keys the lowering on argument sharding, so
-            # without this the FIRST live batch would pay a second
-            # lowering+compile (measured: ~600ms on the transformer)
-            import jax as _jax
-            _jax.block_until_ready(g(
-                *[_np.zeros((batch,) + tuple(shape), dtype=dt)
-                  for shape, dt in key]))
+            g = self._build_graph(self._block, key, batch)
             self._graphs[gk] = g
             return g
+
+    # -- blue/green weight swap ----------------------------------------------
+    def swap_block(self, new_block) -> int:
+        """Rolling blue/green swap: compile ``new_block`` (the green
+        side — typically the same architecture with new parameters) for
+        EVERY signature the current graph set serves, all outside the
+        lock while live traffic keeps dispatching on the old
+        executables, then flip the block and the whole graph dict
+        atomically.  In-flight batches hold a reference to the old
+        executable and complete on it — zero requests drop.  With
+        ``MXTPU_COMPILE_CACHE_DIR`` set the green compiles deserialize
+        from the persistent cache (same architecture = same lowering).
+        Returns the number of executables in the new set."""
+        staged: Dict[Tuple, object] = {}
+        for gk in list(self._graphs.keys()):
+            staged[gk] = self._build_graph(new_block, gk[0], gk[1])
+        with self._compile_lock:
+            # a signature first compiled while we staged: build it for
+            # the green side too (rare — the race window is one compile)
+            for gk in list(self._graphs.keys()):
+                if gk not in staged:
+                    staged[gk] = self._build_graph(new_block, gk[0],
+                                                   gk[1])
+            self._block = new_block
+            self._graphs = staged
+        return len(staged)
+
+    # -- dispatch-worker scaling (SloController seam) ------------------------
+    def set_workers(self, n: int) -> int:
+        """Retarget the dispatch-worker count on a RUNNING server (the
+        :class:`~mxnet_tpu.tuning.controllers.SloController`'s scaling
+        surface).  Growth spawns workers immediately; shrink retires
+        one worker per sentinel, after any batches already queued ahead
+        of it — requests are never dropped by a shrink.  Returns the
+        new target."""
+        n = max(1, int(n))
+        with self._lifecycle_lock:
+            if self._stopped:
+                return self.workers
+            delta = n - self.workers
+            if delta == 0:
+                return n
+            if not self._started:
+                self.workers = n
+                return n
+            if delta > 0:
+                for _ in range(delta):
+                    t = threading.Thread(
+                        target=self._worker_loop,
+                        name=f"mxtpu-serving-worker-{len(self._threads)}",
+                        daemon=True)
+                    t.start()
+                    self._threads.append(t)
+            else:
+                for _ in range(-delta):
+                    try:
+                        self._out.put(None, timeout=1.0)
+                    except _queue.Full:
+                        # a wedged dispatch queue: scaling DOWN under
+                        # that much pressure is wrong anyway — keep the
+                        # workers we failed to retire
+                        n += 1
+            self.workers = n
+            return n
 
     # -- dispatch (hot path) -------------------------------------------------
     def _worker_loop(self) -> None:
@@ -689,6 +762,7 @@ class GenerationServer:
         self._closed = False
         self._abort = False
         self._rid = itertools.count()
+        self._prev_sigterm = None
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "GenerationServer":
@@ -737,6 +811,38 @@ class GenerationServer:
 
     def __exit__(self, exc_type, exc, tb) -> None:
         self.stop(drain=exc_type is None)
+
+    def install_sigterm(self) -> None:
+        """SIGTERM-drain parity with :meth:`ModelServer.install_sigterm`
+        (the k8s/preemption graceful-shutdown contract): chain a handler
+        that drains and stops the scheduler, then calls the previous
+        handler.  The drain runs on its OWN non-daemon thread — the
+        signal may have interrupted a frame holding the scheduler lock,
+        so the handler itself never blocks in signal context; the
+        non-daemon drain thread keeps the process alive until every
+        queued and running generation has finished and released its KV
+        blocks."""
+        prev = signal.getsignal(signal.SIGTERM)
+        self._prev_sigterm = prev
+
+        def drain_then_chain(signum, frame):
+            self.stop(drain=True)
+            if callable(prev) and prev not in (signal.SIG_IGN,
+                                               signal.SIG_DFL):
+                prev(signum, frame)
+
+        def handler(signum, frame):
+            threading.Thread(target=drain_then_chain,
+                             args=(signum, frame),
+                             name="mxtpu-serving-gen-sigterm-drain",
+                             daemon=False).start()
+
+        signal.signal(signal.SIGTERM, handler)
+
+    def uninstall_sigterm(self) -> None:
+        if self._prev_sigterm is not None:
+            signal.signal(signal.SIGTERM, self._prev_sigterm)
+            self._prev_sigterm = None
 
     # -- client surface -----------------------------------------------
     def submit_generate(self, prompt, max_new_tokens: Optional[int] = None,
@@ -796,6 +902,29 @@ class GenerationServer:
         """Blocking convenience: submit + wait; returns the generated
         token ids."""
         return self.submit_generate(prompt, **kw).result(timeout)
+
+    def cancel(self, req: GenRequest) -> bool:
+        """Cancel an in-flight generation (the stream-disconnect path):
+        a still-queued request is failed immediately; a running one is
+        marked and leaves the batch at the next iteration boundary —
+        either way :meth:`_finish_gen` releases its KV blocks, so a
+        client hanging up mid-stream returns the pool to zero.  Returns
+        False when the request had already completed."""
+        with self._lock:
+            if req.done():
+                return False
+            queued = req in self._queue
+            if queued:
+                self._queue.remove(req)
+                self._g_depth.set(len(self._queue))
+            else:
+                # running (or mid-admission): the scheduler owns it —
+                # flag it and let the iteration edge retire it
+                req.cancelled = True
+        if queued:
+            self._finish_gen(req, error=RequestCancelled(
+                f"generation {req.rid} cancelled while queued"))
+        return True
 
     def warmup(self) -> int:
         """Precompile the decode-step signature and every prompt-bucket
@@ -1031,11 +1160,15 @@ class GenerationServer:
         trace_id = None if req.trace is None else req.trace.trace_id
         self._h_ttft.observe((req.t_first - req.t_enqueue) * 1e6,
                              trace_id=trace_id)
-        req.tokens.append(tok)
+        req.push_token(tok)
         req.pos = plen          # the new token decodes at position plen
         self._c_tokens.inc()
         if sp is not None:
             sp.finish()
+        if req.cancelled:
+            self._finish_gen(req, error=RequestCancelled(
+                f"generation {req.rid} cancelled mid-stream"))
+            return
         if (req.eos is not None and tok == req.eos) \
                 or len(req.tokens) >= req.max_new_tokens:
             self._finish_gen(req)
@@ -1105,18 +1238,20 @@ class GenerationServer:
         finished = []
         for i, r in occupied:
             tok = int(lg[i].argmax())  # mxlint: disable=hidden-host-sync — lg is already host memory; this argmax is numpy, not a device round-trip
-            r.tokens.append(tok)
+            r.push_token(tok)
             r.pos += 1
             self._c_tokens.inc()
-            if (r.eos is not None and tok == r.eos) \
-                    or len(r.tokens) >= r.max_new_tokens:
+            if (r.cancelled or (r.eos is not None and tok == r.eos)
+                    or len(r.tokens) >= r.max_new_tokens):
                 finished.append((i, r))
         if finished:
             with self._lock:
                 for i, _ in finished:
                     self._running[i] = None
             for _, r in finished:
-                self._finish_gen(r)
+                self._finish_gen(r, error=RequestCancelled(
+                    f"generation {r.rid} cancelled mid-stream")
+                    if r.cancelled else None)
         if sp is not None:
             sp.finish()
 
@@ -1153,6 +1288,7 @@ class GenerationServer:
             trace_id=trace_id,
             ok=error is None)
         req._event.set()
+        req._wake_stream()
 
     def _expire_gen(self, req: GenRequest) -> None:
         self._c_rej_deadline.inc()

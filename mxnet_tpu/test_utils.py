@@ -64,8 +64,11 @@ def set_default_context(ctx: Context) -> None:
 
 
 def _as_numpy(x) -> np.ndarray:
+    """THE host-export boundary of this module: every check here ends
+    in a numpy comparison, and every device readback funnels through
+    this one call so the sync is deliberate and greppable."""
     if isinstance(x, NDArray):
-        return x.asnumpy()
+        return x.asnumpy()  # mxlint: disable=hidden-host-sync — test-utils comparisons are host-side by definition; this is the module's single readback funnel
     return np.asarray(x)
 
 
@@ -152,7 +155,7 @@ def simple_forward(sym, ctx=None, is_train: bool = False, **inputs):
     exe = sym.simple_bind(ctx=ctx, **shapes)
     for k, v in inputs.items():
         exe.arg_dict[k]._set_data(np.asarray(v, dtype=np.float32))
-    outs = [o.asnumpy() for o in exe.forward(is_train=is_train)]
+    outs = [_as_numpy(o) for o in exe.forward(is_train=is_train)]
     return outs[0] if len(outs) == 1 else outs
 
 
@@ -184,7 +187,7 @@ def check_symbolic_forward(sym, location, expected, rtol=1e-4, atol=1e-6,
     if isinstance(expected, dict):
         expected = [expected[k] for k in sym.list_outputs()]
     for out, exp in zip(outputs, expected):
-        assert_almost_equal(out.asnumpy(), exp, rtol, atol,
+        assert_almost_equal(_as_numpy(out), exp, rtol, atol,
                             ("forward", "expected"), equal_nan=equal_nan)
 
 
@@ -215,7 +218,7 @@ def check_symbolic_backward(sym, location, out_grads, expected, rtol=1e-4,
                             [_as_numpy(v) for v in expected]))
     for name, exp in expected.items():
         got = exe.grad_dict[name]
-        assert_almost_equal(got.asnumpy(), exp, rtol, atol,
+        assert_almost_equal(_as_numpy(got), exp, rtol, atol,
                             (f"grad({name})", "expected"),
                             equal_nan=equal_nan)
 
@@ -245,7 +248,7 @@ def check_numeric_gradient(sym, location, aux_states=None,
         outs = exe.forward(is_train=True)
         # reduce all outputs with a fixed random projection so a scalar
         # objective exists (reference uses sum via a random head grad of 1s)
-        return float(sum(o.asnumpy().astype(np.float64).sum()
+        return float(sum(_as_numpy(o).astype(np.float64).sum()
                          for o in outs))
 
     # analytic grads: forward + backward with all-ones head gradients
@@ -257,7 +260,7 @@ def check_numeric_gradient(sym, location, aux_states=None,
     outs = exe.forward(is_train=True)
     exe.backward([nd_array(np.ones(o.shape, np.float32), ctx=ctx)
                   for o in outs])
-    analytic = {k: exe.grad_dict[k].asnumpy().astype(np.float64)
+    analytic = {k: _as_numpy(exe.grad_dict[k]).astype(np.float64)
                 for k in grad_nodes}
 
     for name in grad_nodes:
@@ -315,8 +318,8 @@ def check_consistency(sym, ctx_list, scale: float = 1.0,
         if grad_req != "null":
             exe.backward([nd_array(np.ones(o.shape, np.float32), ctx=ctx)
                           for o in outs])
-            grads = {n: exe.grad_dict[n].asnumpy() for n in arg_params}
-        results.append(([o.asnumpy() for o in outs], grads,
+            grads = {n: _as_numpy(exe.grad_dict[n]) for n in arg_params}
+        results.append(([_as_numpy(o) for o in outs], grads,
                         list(dtypes.values()) or [np.float32]))
 
     ref_outs, ref_grads, _ = results[0]

@@ -21,7 +21,10 @@ observability spine already measures:
   (``MXTPU_COMM_BUCKET_MB`` hill-climb on ``resilience.step_us``) and
   :class:`~.controllers.DecodeSlotController` (a GenerationServer's
   decode-slot width hill-climbed on interval tokens/s, with the same
-  bracketing stop — every move is a recompile);
+  bracketing stop — every move is a recompile) and
+  :class:`~.controllers.SloController` (per-model p99 SLO defense over
+  the PR-18 frontend registry: shed lowest-priority-first, scale the
+  violator's dispatch workers);
 - :mod:`.compile_cache` — compiled executables (exact-mode bulk
   segments, HybridBlock cached graphs) serialized to
   ``MXTPU_COMPILE_CACHE_DIR`` and reloaded by later processes, so
@@ -58,14 +61,15 @@ from .controllers import (BatchWindowController, BulkSizeController,
                           CommBucketController, Controller, CounterDelta,
                           DecodeSlotController, DevicePrefetchController,
                           FleetGatherController, HistogramDelta,
-                          PrefetchController)
+                          PrefetchController, SloController)
 
 __all__ = ["TuningRuntime", "runtime", "standard_controllers", "start",
            "stop", "Controller", "BulkSizeController",
            "PrefetchController", "BatchWindowController",
            "FleetGatherController", "CommBucketController",
            "DecodeSlotController", "DevicePrefetchController",
-           "HistogramDelta", "CounterDelta", "compile_cache"]
+           "SloController", "HistogramDelta", "CounterDelta",
+           "compile_cache"]
 
 INTERVAL_ENV = "MXTPU_TUNE_INTERVAL"
 

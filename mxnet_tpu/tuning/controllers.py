@@ -56,7 +56,7 @@ from ..observability.registry import (_percentile_from, registry,
 __all__ = ["Controller", "BulkSizeController", "PrefetchController",
            "BatchWindowController", "FleetGatherController",
            "CommBucketController", "DecodeSlotController",
-           "DevicePrefetchController",
+           "DevicePrefetchController", "SloController",
            "HistogramDelta", "CounterDelta", "exemplar_ids"]
 
 DRY_RUN_ENV = "MXTPU_TUNE_DRY_RUN"
@@ -999,3 +999,185 @@ class FleetGatherController(Controller):
         }
         self._flight.record_tuning(**decision)
         return decision
+
+
+# ---------------------------------------------------------------------------
+# SloController — p99 SLO defense for the multi-model frontend
+# ---------------------------------------------------------------------------
+
+class SloController(Controller):
+    """Defend per-model p99 latency SLOs on a multi-model host by
+    shedding load lowest-priority-first and scaling the violating
+    model's dispatch workers — the PR-8 p99-budget knob generalized
+    into a closed loop over the PR-18 frontend.
+
+    One registered model per tenant, each carrying a ``priority`` and a
+    ``slo_ms`` (see :class:`~mxnet_tpu.serving.registry.ModelRegistry`).
+    The controller watches every SLO-carrying model's
+    ``serving.model.<name>.request_us`` interval p99 — the
+    socket-to-socket latency the frontend observes, i.e. what the
+    client experienced, queueing included.  When a model blows its
+    budget:
+
+    - **shed** — the controlled scalar is the registry's *shed level*:
+      requests for models with priority below it 429 at the door.  The
+      level sheds one priority class per tick, lowest first: it rises
+      to the rung *above* the lowest not-yet-shed class, capped at the
+      highest-priority violator's own priority (the protected model
+      itself is never shed), and steps back down one rung after
+      ``recover_intervals`` consecutive intervals with every watched
+      p99 under ``recover`` × its SLO — but only once the shed
+      classes' arrival rate (their 429 counters' interval delta) has
+      fallen under ``quiesce`` × its peak.  Watched latency looks
+      healthy *because* the shed is holding, so stepping down on
+      latency alone just probes the surge back in and oscillates; the
+      door counters are the explicit demand signal that says the surge
+      actually ended;
+    - **scale** — violating predict models get their dispatch-worker
+      pool doubled (up to ``workers_max``) via
+      :meth:`ModelServer.set_workers`; recovery halves back toward the
+      pool size the model started with.  Worker moves are side effects
+      reported in the decision reason (dry-run skips them like any
+      apply).
+
+    Interval-delta driven and wall-clock-free like every controller
+    here: tests tick it against synthetic latency streams.  Per-host
+    instance surface (needs the live registry), so NOT in
+    ``standard_controllers`` — attach explicitly, gated by
+    ``MXTPU_TUNE_SLO``."""
+
+    name = "slo"
+    knob = "MXTPU_FRONTEND_SLO_MS"
+    enable_env = "MXTPU_TUNE_SLO"
+
+    def __init__(self, model_registry, *, vmin: int = 0,
+                 vmax: int = 1 << 20, min_requests: int = 4,
+                 recover: float = 0.6, recover_intervals: int = 2,
+                 quiesce: float = 0.5, workers_max: int = 8, **kw):
+        super().__init__(vmin=vmin, vmax=vmax, **kw)
+        self._registry = model_registry
+        self.min_requests = int(min_requests)
+        self.recover = float(recover)
+        self.recover_intervals = int(recover_intervals)
+        self.quiesce = float(quiesce)
+        self.workers_max = int(workers_max)
+        self._deltas: Dict[str, HistogramDelta] = {}
+        self._base_workers: Dict[str, int] = {}
+        self._good = 0
+        self._shed_prev = 0          # registry-wide shed-counter sum
+        self._shed_peak = 0          # per-interval peak while level > 0
+
+    def current(self) -> float:
+        return int(self._registry.shed_level)
+
+    def _delta(self, entry) -> HistogramDelta:
+        d = self._deltas.get(entry.name)
+        if d is None:
+            d = self._deltas[entry.name] = HistogramDelta(
+                entry.h_request)
+        return d
+
+    def _scale(self, entry, target: int) -> Optional[str]:
+        """Move one model's worker pool (dry-run gated side effect);
+        returns a reason fragment when a move happened."""
+        server = entry.server
+        if entry.kind != "predict" or not hasattr(server,
+                                                  "set_workers"):
+            return None
+        cur = int(server.workers)
+        self._base_workers.setdefault(entry.name, cur)
+        target = max(self._base_workers[entry.name],
+                     min(self.workers_max, target))
+        if target == cur:
+            return None
+        if not self.dry_run:
+            server.set_workers(target)
+        return f"{entry.name}.workers {cur}->{target}"
+
+    def decide(self):
+        # demand signal first: the registry's shed counters tick for
+        # every 429'd arrival, so their per-interval delta measures how
+        # hard the shed classes are still knocking on the door —
+        # re-admitting while that rate is near its peak would only
+        # re-violate (the blind-probe oscillation), so recovery waits
+        # for it to quiesce
+        shed_sum = sum(int(e.c_shed.n)
+                       for e in self._registry.entries())
+        shed_delta = max(0, shed_sum - self._shed_prev)
+        self._shed_prev = shed_sum
+        cur = int(self.current())
+        if cur > 0:
+            self._shed_peak = max(self._shed_peak, shed_delta)
+        watched = []
+        for e in self._registry.entries():
+            d = self._delta(e).take()     # take() every tick: no stale
+            if e.slo_ms > 0 and d is not None and \
+                    d["count"] >= self.min_requests:
+                watched.append((e, d))
+        if not watched:
+            return None
+        ladder = self._registry.priorities()
+        violators = [(e, d) for e, d in watched
+                     if d["p99"] > e.slo_ms * 1000.0]
+        if violators:
+            self._good = 0
+            worst_e, worst_d = max(
+                violators,
+                key=lambda t: t[1]["p99"] / (t[0].slo_ms * 1000.0))
+            self._tick_exemplars = exemplar_ids(worst_e.h_request)
+            # shed lowest-priority-first, one class per tick: find the
+            # lowest resident class not yet shed (strictly below the
+            # protected violator — it is never shed itself), then raise
+            # the level to the NEXT rung so that class 429s
+            prot = max(e.priority for e, _ in violators)
+            q = next((p for p in ladder if cur <= p < prot), None)
+            nxt = cur if q is None else \
+                next((p for p in ladder if q < p <= prot), prot)
+            moves = [m for m in (self._scale(
+                e, int(getattr(e.server, "workers", 0)) * 2)
+                for e, _ in violators) if m]
+            reason = (f"{worst_e.name} p99={worst_d['p99'] / 1e3:.2f}ms "
+                      f"> slo={worst_e.slo_ms:g}ms "
+                      f"(n={worst_d['count']})")
+            if moves:
+                reason += " scaled " + ",".join(moves)
+            if nxt != cur:
+                return nxt, reason
+            # shed level already at the cap: the worker moves above
+            # are the whole response this tick
+            return None
+        if all(d["p99"] < e.slo_ms * 1000.0 * self.recover
+               for e, d in watched):
+            self._good += 1
+            # latency alone is not enough to step the level down — it
+            # only looks healthy BECAUSE the shed is holding.  The gate
+            # is the demand signal: re-admit once the shed classes'
+            # arrival rate has fallen under ``quiesce`` x its peak
+            # (_good keeps accumulating while the gate holds, so the
+            # step-down lands on the first quiesced tick)
+            if self._good >= self.recover_intervals and \
+                    (cur == 0 or
+                     shed_delta <= self.quiesce * self._shed_peak):
+                self._good = 0
+                moves = [m for m in (self._scale(
+                    e, max(self._base_workers.get(e.name, 1),
+                           int(getattr(e.server, "workers", 1)) // 2))
+                    for e, _ in watched) if m]
+                nxt = max([p for p in ladder if p < cur], default=0) \
+                    if cur > 0 else 0
+                if nxt == 0:
+                    self._shed_peak = 0
+                reason = ("all watched p99 < "
+                          f"{self.recover:g}x slo for "
+                          f"{self.recover_intervals} intervals, shed "
+                          f"demand quiesced ({shed_delta}/interval)")
+                if moves:
+                    reason += " scaled " + ",".join(moves)
+                if nxt != cur:
+                    return nxt, reason
+            return None
+        self._good = 0
+        return None
+
+    def apply(self, value) -> None:
+        self._registry.set_shed_level(int(value))

@@ -48,6 +48,16 @@ def test_example_serve_generation_runs():
     assert "KV blocks used after drain: 0" in r.stdout
 
 
+def test_example_serve_http_runs():
+    r = _run(["examples/serve_http.py", "--clients", "2",
+              "--requests", "4", "--generations", "2"])
+    assert r.returncode == 0, r.stderr[-2000:]
+    assert "bitwise: OK" in r.stdout
+    assert "zero dropped: OK" in r.stdout
+    assert "low-priority predict -> 429" in r.stdout
+    assert "KV blocks used: 0" in r.stdout
+
+
 def test_example_elastic_fleet_runs():
     """3-worker fleet, one host SIGKILLed mid-run: the example must
     print both survivors' re-form lines and the OK marker."""

@@ -1,0 +1,688 @@
+"""Multi-model production frontend: the HTTP wire protocol, the model
+registry's priority gate, SSE token streaming, blue/green weight swap,
+traceparent stitching, and the SloController loop.
+
+Covers the PR-18 acceptance surface: concurrent HTTP clients get
+bitwise the floats ``submit()`` returns, SSE streams tokens in decode
+order and a mid-stream disconnect releases every KV block, requests
+below the shed level 429 at the door, a weight swap under live traffic
+drops nothing, a W3C ``traceparent`` request header parents the
+server-side trace, and both server kinds drain on SIGTERM through the
+frontend's graceful-shutdown path.
+
+Model sizes are tiny (seconds of compile); the CausalLM is
+module-scoped because its compile dominates.  Every frontend/server is
+stopped in a finally block so a failing assertion never leaks threads.
+"""
+import http.client
+import json
+import os
+import signal
+import socket
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import mxnet_tpu as mx
+from mxnet_tpu import gluon
+from mxnet_tpu.gluon.model_zoo.transformer import causal_lm_small
+from mxnet_tpu.observability import tracing
+from mxnet_tpu.observability.export import prometheus_text
+from mxnet_tpu.serving import (GenerationServer, HttpFrontend,
+                               ModelRegistry, ModelServer,
+                               RequestCancelled, ServingError,
+                               UnknownModel)
+from mxnet_tpu.tuning import SloController
+
+
+class _Elemwise(gluon.HybridBlock):
+    """Row-independent elementwise model: batched rows are bitwise
+    identical to batch-1 rows regardless of batch composition."""
+
+    def hybrid_forward(self, F, x):
+        return F.tanh(x * 2.0) + 0.5
+
+
+class _Elemwise2(gluon.HybridBlock):
+    """The 'green' weights for the swap test — visibly different."""
+
+    def hybrid_forward(self, F, x):
+        return F.tanh(x * 3.0) - 0.25
+
+
+def _net(cls=_Elemwise):
+    net = cls()
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+@pytest.fixture(scope="module")
+def lm():
+    np.random.seed(0)
+    mx.random.seed(0)
+    net = causal_lm_small()
+    net.initialize()
+    net.hybridize()
+    return net
+
+
+def _gen_server(lm, **kw):
+    kw.setdefault("slots", 2)
+    kw.setdefault("kv_block", 16)
+    kw.setdefault("kv_blocks", 64)
+    kw.setdefault("max_new_tokens", 64)
+    kw.setdefault("prompt_buckets", (16,))
+    kw.setdefault("queue_depth", 16)
+    kw.setdefault("deadline_ms", 0)
+    return GenerationServer(lm, **kw)
+
+
+def _post(port, path, obj, headers=None, timeout=60.0):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("POST", path, body=json.dumps(obj),
+                  headers=headers or {})
+        r = c.getresponse()
+        return r.status, dict(r.getheaders()), json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _get(port, path, timeout=30.0):
+    c = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        c.request("GET", path)
+        r = c.getresponse()
+        return r.status, json.loads(r.read())
+    finally:
+        c.close()
+
+
+def _sse_events(raw: str):
+    """Parse an SSE body into (event_name, payload_dict) pairs."""
+    out = []
+    for chunk in raw.split("\n\n"):
+        name, data = "message", None
+        for line in chunk.strip().splitlines():
+            if line.startswith("event:"):
+                name = line.partition(":")[2].strip()
+            elif line.startswith("data:"):
+                data = json.loads(line.partition(":")[2])
+        if data is not None:
+            out.append((name, data))
+    return out
+
+
+def _sse_generate(port, name, prompt, timeout=120.0, **kw):
+    """Stream one generation over a raw socket; returns (events,
+    socket-measured TTFT seconds, response headers)."""
+    body = json.dumps(dict(prompt=list(map(int, prompt)), **kw))
+    s = socket.create_connection(("127.0.0.1", port), timeout=timeout)
+    try:
+        t0 = time.monotonic()
+        s.sendall((f"POST /v1/models/{name}/generate HTTP/1.1\r\n"
+                   f"Host: t\r\nContent-Type: application/json\r\n"
+                   f"Content-Length: {len(body)}\r\n\r\n{body}")
+                  .encode())
+        buf, ttft = b"", None
+        while True:
+            chunk = s.recv(65536)
+            if ttft is None and b"data:" in buf + chunk:
+                ttft = time.monotonic() - t0
+            if not chunk:
+                break
+            buf += chunk
+    finally:
+        s.close()
+    head, _, payload = buf.partition(b"\r\n\r\n")
+    headers = {}
+    for line in head.decode().splitlines()[1:]:
+        k, _, v = line.partition(":")
+        headers[k.strip().lower()] = v.strip()
+    return _sse_events(payload.decode()), ttft, headers
+
+
+# -- wire surface ------------------------------------------------------------
+
+def test_health_ready_models_and_404():
+    reg = ModelRegistry()
+    fe = HttpFrontend(reg, port=0).start()
+    try:
+        assert _get(fe.port, "/healthz")[0] == 200
+        # no models yet: alive but not ready
+        status, body = _get(fe.port, "/readyz")
+        assert status == 503 and body["ready"] is False
+        reg.load("m", ModelServer(_net(), max_batch=4,
+                                  batch_window_us=100.0), priority=1)
+        status, body = _get(fe.port, "/readyz")
+        assert status == 200 and body["ready"] is True
+        status, body = _get(fe.port, "/v1/models")
+        assert status == 200
+        (m,) = body["models"]
+        assert m["name"] == "m" and m["kind"] == "predict"
+        assert m["status"] == "ready" and "stats" in m
+        assert _get(fe.port, "/nope")[0] == 404
+        assert _post(fe.port, "/v1/models/ghost/predict",
+                     {"inputs": [[0.0]]})[0] == 404
+    finally:
+        fe.stop(drain=True)
+
+
+def test_concurrent_http_clients_bitwise_match_direct_submit():
+    srv = ModelServer(_net(), max_batch=8, batch_window_us=300.0)
+    reg = ModelRegistry()
+    reg.load("elem", srv, priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    rng = np.random.default_rng(7)
+    xs = [rng.uniform(-1, 1, (16,)).astype(np.float32)
+          for _ in range(24)]
+    direct = [srv.infer(x) for x in xs]
+    failures = []
+
+    def client(idx):
+        for i in range(idx, len(xs), 4):
+            status, _, body = _post(
+                fe.port, "/v1/models/elem/predict",
+                {"inputs": [xs[i].tolist()], "dtype": "float32"})
+            got = np.asarray(body["outputs"][0], dtype=np.float32)
+            if status != 200 or not np.array_equal(got, direct[i]):
+                failures.append((i, status))
+
+    try:
+        threads = [threading.Thread(target=client, args=(k,))
+                   for k in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert failures == []
+    finally:
+        fe.stop(drain=True)
+
+
+def test_predict_error_mapping(lm):
+    reg = ModelRegistry()
+    reg.load("p", ModelServer(_net(), max_batch=4,
+                              batch_window_us=100.0), priority=1)
+    reg.load("g", _gen_server(lm), priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    try:
+        # wrong verb for the model kind is a client error
+        assert _post(fe.port, "/v1/models/g/predict",
+                     {"inputs": [[1]]})[0] == 400
+        assert _post(fe.port, "/v1/models/p/generate",
+                     {"prompt": [1, 2]})[0] == 400
+        # malformed payloads
+        assert _post(fe.port, "/v1/models/p/predict", {})[0] == 400
+        status, _, body = _post(fe.port, "/v1/models/none/predict",
+                                {"inputs": [[1.0]]})
+        assert status == 404 and body["error"] == "UnknownModel"
+    finally:
+        fe.stop(drain=True)
+
+
+# -- SSE streaming -----------------------------------------------------------
+
+def test_sse_stream_token_order_and_done_event(lm):
+    srv = _gen_server(lm)
+    reg = ModelRegistry()
+    reg.load("lm", srv, priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    try:
+        prompt = np.array([3, 5, 7, 9], np.int32)
+        direct = srv.generate(prompt, max_new_tokens=6)
+        events, ttft, headers = _sse_generate(
+            fe.port, "lm", prompt, max_new_tokens=6)
+        assert headers["content-type"] == "text/event-stream"
+        toks = [e["token"] for n, e in events if n == "message"]
+        assert [e["index"] for n, e in events
+                if n == "message"] == list(range(len(toks)))
+        assert toks == list(direct)
+        (done,) = [e for n, e in events if n == "done"]
+        assert done["tokens"] == list(direct) and done["n"] == len(toks)
+        assert ttft is not None     # first token crossed the socket
+    finally:
+        fe.stop(drain=True)
+
+
+def test_sse_mid_stream_disconnect_releases_kv_blocks(lm):
+    srv = _gen_server(lm)
+    reg = ModelRegistry()
+    reg.load("lm", srv, priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    try:
+        # warm the decode path so the disconnect isn't compile-bound
+        srv.generate(np.array([3, 5, 7], np.int32), max_new_tokens=2)
+        body = json.dumps({"prompt": [3, 5, 7], "max_new_tokens": 64})
+        s = socket.create_connection(("127.0.0.1", fe.port),
+                                     timeout=60)
+        buf = b""
+        try:
+            s.sendall((f"POST /v1/models/lm/generate HTTP/1.1\r\n"
+                       f"Host: t\r\n"
+                       f"Content-Length: {len(body)}\r\n\r\n{body}")
+                      .encode())
+            while buf.count(b"data:") < 2:
+                buf += s.recv(4096)
+        finally:
+            s.close()               # hang up mid-generation
+        deadline = time.time() + 30
+        while time.time() < deadline and (srv._kv.used()
+                                          or srv._kv.reserved()):
+            time.sleep(0.05)
+        # the cancel propagated: every block back in the pool
+        assert srv._kv.used() == 0 and srv._kv.reserved() == 0
+    finally:
+        fe.stop(drain=True)
+
+
+def test_gen_request_stream_raises_cancel_error(lm):
+    srv = _gen_server(lm).start()
+    try:
+        srv.warmup()
+        req = srv.submit_generate(np.array([3, 5, 7], np.int32),
+                                  max_new_tokens=64)
+        it = req.stream(timeout=60)
+        next(it)                    # at least one token flowed
+        assert srv.cancel(req) is True
+        with pytest.raises(RequestCancelled):
+            for _ in it:
+                pass
+        assert srv.cancel(req) is False    # already finished
+    finally:
+        srv.stop(drain=False)
+
+
+# -- the registry gate -------------------------------------------------------
+
+def test_priority_shedding_429_lowest_first(lm):
+    reg = ModelRegistry()
+    low = ModelServer(_net(), max_batch=4, batch_window_us=100.0)
+    reg.load("low", low, priority=1)
+    reg.load("high", ModelServer(_net(_Elemwise2), max_batch=4,
+                                 batch_window_us=100.0), priority=3)
+    fe = HttpFrontend(reg, port=0).start()
+    x = {"inputs": [[0.5] * 16], "dtype": "float32"}
+    try:
+        reg.set_shed_level(2)       # sheds priority < 2
+        status, _, body = _post(fe.port, "/v1/models/low/predict", x)
+        assert status == 429 and "shed" in body["detail"]
+        assert _post(fe.port, "/v1/models/high/predict", x)[0] == 200
+        assert reg.get("low").c_shed.n == 1
+        assert reg.get("high").c_shed.n == 0
+        reg.set_shed_level(0)
+        assert _post(fe.port, "/v1/models/low/predict", x)[0] == 200
+    finally:
+        fe.stop(drain=True)
+
+
+def test_registry_load_validations():
+    reg = ModelRegistry()
+    srv = ModelServer(_net(), max_batch=2, batch_window_us=100.0)
+    reg.load("a", srv, priority=1)
+    try:
+        with pytest.raises(ServingError):
+            reg.load("a", srv)              # duplicate name
+        with pytest.raises(ServingError):
+            reg.load("sp ace", srv)         # invalid name
+        with pytest.raises(UnknownModel):
+            reg.unload("ghost")
+        with pytest.raises(UnknownModel):
+            reg.get("ghost")
+    finally:
+        reg.stop_all(drain=False)
+
+
+# -- blue/green swap ---------------------------------------------------------
+
+def test_blue_green_swap_drops_nothing_under_live_traffic():
+    srv = ModelServer(_net(), max_batch=4, batch_window_us=200.0)
+    reg = ModelRegistry()
+    reg.load("m", srv, priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    x = np.random.default_rng(3).uniform(-1, 1, (16,)) \
+        .astype(np.float32)
+    old = srv.infer(x)
+    outs, errors = [], []
+    stop = threading.Event()
+
+    def client():
+        c = http.client.HTTPConnection("127.0.0.1", fe.port,
+                                       timeout=30)
+        while not stop.is_set():
+            try:
+                c.request("POST", "/v1/models/m/predict",
+                          body=json.dumps({"inputs": [x.tolist()],
+                                           "dtype": "float32"}))
+                r = c.getresponse()
+                body = json.loads(r.read())
+                if r.status != 200:
+                    errors.append(body)
+                else:
+                    outs.append(np.asarray(body["outputs"][0],
+                                           np.float32))
+            except Exception as e:      # noqa: BLE001 — collected
+                errors.append(repr(e))
+        c.close()
+
+    try:
+        threads = [threading.Thread(target=client) for _ in range(4)]
+        for t in threads:
+            t.start()
+        time.sleep(0.3)
+        staged = reg.swap("m", _net(_Elemwise2))
+        time.sleep(0.3)
+        stop.set()
+        for t in threads:
+            t.join()
+        new = srv.infer(x)
+        assert staged >= 1
+        assert reg.get("m").swaps == 1
+        assert errors == []             # zero dropped requests
+        assert len(outs) > 0
+        # every response is exactly the old weights or the new — no
+        # torn state, no mixed executable
+        assert all(np.array_equal(o, old) or np.array_equal(o, new)
+                   for o in outs)
+        assert not np.array_equal(new, old)   # the flip happened
+    finally:
+        fe.stop(drain=True)
+
+
+def test_swap_rejected_for_generation_models(lm):
+    reg = ModelRegistry()
+    reg.load("g", _gen_server(lm), priority=1)
+    try:
+        with pytest.raises(ServingError):
+            reg.swap("g", causal_lm_small())
+    finally:
+        reg.stop_all(drain=False)
+
+
+# -- trace stitching ---------------------------------------------------------
+
+def test_traceparent_header_parents_server_trace(monkeypatch):
+    monkeypatch.setenv("MXTPU_TRACE", "1")
+    monkeypatch.delenv("MXTPU_TRACE_SAMPLE", raising=False)
+    tr = tracing.tracer()
+    tr.clear()
+    srv = ModelServer(_net(), max_batch=2, batch_window_us=100.0)
+    reg = ModelRegistry()
+    reg.load("m", srv, priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    remote_trace = "ab" * 16
+    tp_in = f"00-{remote_trace}-{'cd' * 8}-01"
+    try:
+        status, headers, _ = _post(
+            fe.port, "/v1/models/m/predict",
+            {"inputs": [[0.25] * 16], "dtype": "float32"},
+            headers={"traceparent": tp_in})
+        assert status == 200
+        # the response echoes the request root under the CALLER's trace
+        tp_out = headers.get("traceparent")
+        assert tp_out is not None
+        assert tracing.parse_traceparent(tp_out).trace_id == \
+            remote_trace
+        # and the server-side spans joined that trace
+        names = [s["name"] for s in tr.find(remote_trace)]
+        assert "serving.request" in names
+    finally:
+        fe.stop(drain=True)
+        tr.clear()
+
+
+# -- graceful shutdown -------------------------------------------------------
+
+def test_generation_server_sigterm_drains(lm):
+    srv = _gen_server(lm).start()
+    srv.warmup()
+    chained = []
+    prev = signal.signal(signal.SIGTERM, lambda s, f: chained.append(s))
+    srv.install_sigterm()
+    try:
+        req = srv.submit_generate(np.array([3, 5, 7], np.int32),
+                                  max_new_tokens=4)
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 30
+        while time.time() < deadline and not srv._closed:
+            time.sleep(0.02)
+        assert srv._closed
+        # the in-flight generation completed (drained, not dropped)
+        assert len(req.result(timeout=30)) == 4
+        deadline = time.time() + 10
+        while time.time() < deadline and not chained:
+            time.sleep(0.02)
+        assert chained == [signal.SIGTERM]   # previous handler chained
+    finally:
+        srv.uninstall_sigterm()
+        signal.signal(signal.SIGTERM, prev)
+        srv.stop(drain=False)
+
+
+def test_frontend_sigterm_drains_every_model(lm):
+    ms = ModelServer(_net(), max_batch=2, batch_window_us=100.0)
+    gs = _gen_server(lm)
+    reg = ModelRegistry()
+    reg.load("p", ms, priority=1)
+    reg.load("g", gs, priority=1)
+    fe = HttpFrontend(reg, port=0).start()
+    prev = signal.signal(signal.SIGTERM, signal.SIG_IGN)
+    fe.install_sigterm()
+    try:
+        os.kill(os.getpid(), signal.SIGTERM)
+        deadline = time.time() + 30
+        while time.time() < deadline and not (
+                gs._closed and ms._admission.closed):
+            time.sleep(0.02)
+        assert gs._closed and ms._admission.closed
+        assert fe.draining
+        # the listener is down: new connections fail
+        with pytest.raises(OSError):
+            socket.create_connection(("127.0.0.1", fe.port),
+                                     timeout=2).close()
+    finally:
+        fe.uninstall_sigterm()
+        signal.signal(signal.SIGTERM, prev)
+        fe.stop(drain=False)
+
+
+# -- worker scaling ----------------------------------------------------------
+
+def test_set_workers_grow_and_shrink_keeps_serving():
+    srv = ModelServer(_net(), max_batch=2, batch_window_us=100.0,
+                      workers=1)
+    reg = ModelRegistry()
+    reg.load("m", srv, priority=1)
+    x = np.linspace(-1, 1, 16).astype(np.float32)
+    want = srv.infer(x)
+    try:
+        assert srv.set_workers(4) == 4
+        assert np.array_equal(srv.infer(x), want)
+        assert srv.set_workers(1) == 1
+        for _ in range(4):          # sentinels drained, still serving
+            assert np.array_equal(srv.infer(x), want)
+    finally:
+        reg.stop_all(drain=True)
+
+
+# -- SloController -----------------------------------------------------------
+
+class _FakeServer:
+    """Registry-shaped stand-in: the SloController only touches
+    ``workers``/``set_workers``/``stats``/``start``/``stop``."""
+
+    def __init__(self, workers=2):
+        self.workers = workers
+
+    def start(self):
+        return self
+
+    def stop(self, drain=True, timeout=None):
+        pass
+
+    def stats(self):
+        return {"workers": self.workers}
+
+    def set_workers(self, n):
+        self.workers = int(n)
+        return self.workers
+
+
+def _slo_registry():
+    reg = ModelRegistry()
+    low = reg.load("batch", _FakeServer(), priority=1, slo_ms=1000.0)
+    high = reg.load("prio", _FakeServer(), priority=3, slo_ms=5.0)
+    return reg, low, high
+
+
+def test_slo_controller_sheds_lowest_first_and_recovers():
+    reg, low, high = _slo_registry()
+    ctl = SloController(reg, enabled=True, dry_run=False,
+                        min_requests=1, recover_intervals=1,
+                        hysteresis=1)
+    try:
+        ctl.tick()                  # prime the interval baselines
+        # interval 1: priority model blows its 5ms SLO
+        for _ in range(8):
+            high.h_request.observe(20_000.0)    # 20ms
+            low.h_request.observe(1_000.0)
+        d = ctl.tick()
+        assert d is not None and d["applied"]
+        # one class shed per tick: the level jumps past 'batch's own
+        # rung (1) to the next rung up (3) so priority-1 traffic 429s;
+        # the violator's own priority is the cap, so 'prio' never sheds
+        assert reg.shed_level == 3
+        assert high.server.workers == 4         # violator scaled up
+        with pytest.raises(ServingError):
+            reg.admit(low)
+        reg.admit(high)                         # protected model flows
+        # interval 2: still violating — level already at the cap, the
+        # worker pool keeps doubling
+        for _ in range(8):
+            high.h_request.observe(20_000.0)
+        ctl.tick()
+        assert reg.shed_level == 3
+        assert high.server.workers == 8         # doubled again
+        # recovery: comfortably inside budget -> level steps back down
+        # one rung per interval, workers halve back toward base
+        for _ in range(8):
+            high.h_request.observe(500.0)       # 0.5ms << 5ms
+            low.h_request.observe(500.0)
+        d = ctl.tick()
+        assert d is not None and reg.shed_level == 1
+        assert high.server.workers == 4
+        for _ in range(8):
+            high.h_request.observe(500.0)
+            low.h_request.observe(500.0)
+        ctl.tick()
+        assert reg.shed_level == 0
+        assert high.server.workers == 2         # back to base
+        reg.admit(low)
+    finally:
+        reg.stop_all(drain=False)
+
+
+def test_slo_controller_recovery_waits_for_demand_quiesce():
+    """Latency under the shed looks healthy BECAUSE the shed holds —
+    stepping down on latency alone re-admits the surge and oscillates.
+    The level must hold while the shed classes' arrival rate stays
+    near its peak, and step down once it quiesces."""
+    reg, low, high = _slo_registry()
+    ctl = SloController(reg, enabled=True, dry_run=False,
+                        min_requests=1, recover_intervals=1,
+                        hysteresis=1)
+
+    def knock(n):
+        for _ in range(n):
+            with pytest.raises(ServingError):
+                reg.admit(low)
+
+    try:
+        ctl.tick()                  # prime the interval baselines
+        for _ in range(8):
+            high.h_request.observe(20_000.0)
+        ctl.tick()
+        assert reg.shed_level == 3
+        # surge still knocking at full rate: 20 sheds/interval is the
+        # demand peak — latency recovery must NOT trigger a step-down
+        knock(20)
+        for _ in range(8):
+            high.h_request.observe(500.0)
+        assert ctl.tick() is None
+        assert reg.shed_level == 3
+        knock(20)
+        for _ in range(8):
+            high.h_request.observe(500.0)
+        assert ctl.tick() is None
+        assert reg.shed_level == 3
+        # demand falls to a trickle (< quiesce x peak): now re-admit
+        knock(4)
+        for _ in range(8):
+            high.h_request.observe(500.0)
+        d = ctl.tick()
+        assert d is not None and reg.shed_level == 1
+    finally:
+        reg.stop_all(drain=False)
+
+
+def test_slo_controller_dry_run_applies_nothing():
+    reg, low, high = _slo_registry()
+    ctl = SloController(reg, enabled=True, dry_run=True,
+                        min_requests=1, hysteresis=1)
+    try:
+        ctl.tick()                  # prime the interval baselines
+        for _ in range(8):
+            high.h_request.observe(50_000.0)
+        d = ctl.tick()
+        assert d is not None and d["dry_run"] and not d["applied"]
+        assert reg.shed_level == 0
+        assert high.server.workers == 2         # no side effects either
+    finally:
+        reg.stop_all(drain=False)
+
+
+def test_slo_controller_holds_without_traffic_or_slo():
+    reg = ModelRegistry()
+    e = reg.load("free", _FakeServer(), priority=1, slo_ms=0.0)
+    ctl = SloController(reg, enabled=True, dry_run=False,
+                        min_requests=1, hysteresis=1)
+    try:
+        assert ctl.tick() is None               # nothing watched
+        e.h_request.observe(9_999_999.0)        # slo_ms=0: never watched
+        assert ctl.tick() is None
+        assert ctl.tick() is None
+        assert reg.shed_level == 0
+    finally:
+        reg.stop_all(drain=False)
+
+
+# -- exporter: per-model labels ----------------------------------------------
+
+def test_prometheus_renders_model_labels():
+    reg = ModelRegistry()
+    reg.load("label-me", ModelServer(_net(), max_batch=2,
+                                     batch_window_us=100.0), priority=1)
+    try:
+        entry = reg.get("label-me")
+        entry.h_request.observe(1234.0)
+        entry.c_requests.inc()
+        text = prometheus_text()
+        # family renamed under mxtpu_serving_model_* with a model label
+        assert ('mxtpu_serving_model_requests{model="label_me"} 1'
+                in text)
+        assert ('mxtpu_serving_model_request_us_bucket{'
+                'model="label_me",le=' in text)
+        assert 'mxtpu_serving_model_request_us_sum{model="label_me"}' \
+            in text
+        # exactly ONE TYPE header per family (Prometheus rejects dups)
+        assert text.count(
+            "# TYPE mxtpu_serving_model_requests counter") == 1
+        assert text.count(
+            "# TYPE mxtpu_serving_model_request_us histogram") == 1
+        # the raw dotted name never leaks as its own family
+        assert "mxtpu_serving_model_label_me_request_us" not in text
+    finally:
+        reg.stop_all(drain=False)

@@ -203,7 +203,8 @@ _FROZEN_BASELINE = {
     # jax buffers, todense is a lazy scatter) — the only host crossings
     # left are the explicit asnumpy() export and the CSR ingestion
     # helper, both pragma'd at the boundary
-    ("hidden-host-sync", "mxnet_tpu/test_utils.py"),
+    # PR-18 shrink: test_utils.py paid down — every comparison helper
+    # reads back through the single pragma'd _as_numpy funnel
 }
 
 

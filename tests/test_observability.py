@@ -283,8 +283,13 @@ def test_snapshot_is_one_call():
 
 # -- exporters --------------------------------------------------------------
 
+# one or more label pairs: bare histograms carry {le=...}, the
+# frontend's per-model families carry {model=...} (and both on their
+# bucket series)
 _PROM_LINE = re.compile(
-    r'^[a-zA-Z_][a-zA-Z0-9_]*(\{le="[^"]+"\})? [^ ]+$')
+    r'^[a-zA-Z_][a-zA-Z0-9_]*'
+    r'(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [^ ]+$')
 
 
 def test_prometheus_text_wellformed():
