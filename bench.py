@@ -208,6 +208,7 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
                                      stats["mean_segment_length"] or 15)
     snapshot_us, flight_record_us = _observability_costs()
     trace_span_off_us, trace_span_us = _tracing_costs()
+    sampler_off_us, sampler_on_us = _sampler_costs()
     return {"ops_per_sec_bulk": round(results["bulk"], 1),
             "ops_per_sec_bulk_aggressive": round(
                 results["bulk_aggressive"], 1),
@@ -240,6 +241,14 @@ def bench_eager_dispatch(iters=150, chain=24, warmup=20, size=4096):
             # 1-in-N cost)
             "trace_span_off_us": trace_span_off_us,
             "trace_span_us": trace_span_us,
+            # stack sampler: the init-site probe with sampling OFF (a
+            # memoized env dict hit — the always-paid cost) and one
+            # full all-thread sampling pass (what each tick at
+            # MXTPU_PROF_SAMPLE_HZ costs the sampler daemon, NOT the
+            # sampled threads — their tax is GIL interference only,
+            # pinned <3% by the slow-marked overhead guard test)
+            "sampler_off_us": sampler_off_us,
+            "sampler_on_us": sampler_on_us,
             "host_cores": _host_cores()}
 
 
@@ -347,6 +356,36 @@ def _tracing_costs(reps=20_000):
                 os.environ[k] = v
         for c, n in zip(probe_counters, saved_ns):
             c.n = n
+    return round(off_us, 3), round(on_us, 2)
+
+
+def _sampler_costs(reps=20_000):
+    """Measured cost of the stack-sampler seam: the OFF path (what the
+    trainer/server init sites pay when ``MXTPU_PROF_SAMPLE_HZ`` is
+    unset — one memoized env probe) and ONE all-thread sampling pass
+    (the per-tick cost the sampler daemon pays at N Hz; sampled threads
+    pay only GIL interference, guarded <3% in the test suite)."""
+    from mxnet_tpu.observability import sampler as _smp
+    prev = os.environ.pop("MXTPU_PROF_SAMPLE_HZ", None)
+    try:
+        _smp.maybe_start_from_env()     # settle the memo on "unset"
+        t0 = time.perf_counter()
+        for _ in range(reps):
+            _smp.maybe_start_from_env()
+        off_us = (time.perf_counter() - t0) / reps * 1e6
+    finally:
+        if prev is not None:
+            os.environ["MXTPU_PROF_SAMPLE_HZ"] = prev
+    # probe window, not the process sampler: bench samples must not
+    # pollute a live profile ring
+    win = _smp.ProfileWindow(hz=100.0)
+    n = max(1, reps // 40)
+    t0 = time.perf_counter()
+    for _ in range(n):
+        # skip_ident=0 matches no thread: sample EVERY thread, the
+        # daemon's worst case
+        _smp._collect_into(win, skip_ident=0)
+    on_us = (time.perf_counter() - t0) / n * 1e6
     return round(off_us, 3), round(on_us, 2)
 
 

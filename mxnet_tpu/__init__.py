@@ -86,6 +86,12 @@ if get_env("MXTPU_COMPILE_CACHE_DIR"):
 # otherwise
 if get_env("MXTPU_METRICS_PORT") or get_env("MXTPU_METRICS_JSONL"):
     observability.export.maybe_start_from_env()
+# opt-in continuous stack sampler: a daemon folding all-thread stacks
+# into rotating flamegraph windows when MXTPU_PROF_SAMPLE_HZ > 0 (the
+# trainer/server constructors re-probe, so late env changes also take;
+# unset = the sampler module never even imports here)
+if get_env("MXTPU_PROF_SAMPLE_HZ"):
+    observability.sampler.maybe_start_from_env()
 
 
 def waitall() -> None:

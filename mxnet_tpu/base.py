@@ -508,6 +508,47 @@ register_env("MXTPU_TUNE_DEVICE_PREFETCH", True, bool,
              "loader.device_buffer_depth gauge — each slot is a "
              "resident device batch, i.e. HBM) when the runtime "
              "starts.")
+register_env("MXTPU_PROF_SAMPLE_HZ", 0.0, float,
+             "Continuous stack-sampling profiler: walk every thread's "
+             "frames (sys._current_frames) this many times per second, "
+             "folding them into collapsed-stack (flamegraph) counts in "
+             "rotating profile windows.  0 (the default) = off; the "
+             "off path on instrumented start sites is one memoized "
+             "env probe.")
+register_env("MXTPU_PROF_WINDOW_SECS", 60.0, float,
+             "Stack sampler: seconds of samples per profile window "
+             "before it rotates into the bounded window ring "
+             "(/debug/profile and watchdog postmortems serve the "
+             "current + recent windows).")
+register_env("MXTPU_PROF_WINDOWS", 8, int,
+             "Stack sampler: how many rotated profile windows to keep "
+             "(a bounded ring — memory is bounded by windows x "
+             "distinct folded stacks per window).")
+register_env("MXTPU_DEBUG_ENDPOINTS", False, bool,
+             "Serve the live-introspection /debug/* surface "
+             "(/debug/stacks, /debug/profile, /debug/flight, "
+             "/debug/trace/<id>, /debug/vars) from the serving "
+             "HttpFrontend and the MXTPU_METRICS_PORT exporter.  Off "
+             "(the default) = those paths 404; the endpoints are "
+             "auth-free, so only enable them on trusted networks.")
+register_env("MXTPU_WATCHDOG_FACTOR", 0.0, float,
+             "Progress watchdog: flag a heartbeat touchpoint (trainer "
+             "step, decode loop, dispatch workers) as stalled when it "
+             "goes silent for FACTOR x its own recent p99 interval "
+             "(from the metrics spine), then dump one postmortem "
+             "bundle (stacks + flight rings + span ring + profile "
+             "window).  0 (the default) = off; typical values 4-10.")
+register_env("MXTPU_WATCHDOG_ACTION", "dump", str,
+             "Progress watchdog action on a detected stall: 'dump' "
+             "(write the postmortem bundle and keep running) or "
+             "'term' (dump, then SIGTERM the process so the existing "
+             "drain/checkpoint handlers take over).")
+register_env("MXTPU_STACKS_SIGNAL", "SIGQUIT", str,
+             "Signal that dumps all-thread stacks + flight rings to "
+             "the flight path WITHOUT killing the process (the manual "
+             "'what is it doing right now' probe; chains any previous "
+             "handler).  Named signal (SIGQUIT, SIGUSR2, ...); empty "
+             "disables installation.")
 
 
 # ---------------------------------------------------------------------------

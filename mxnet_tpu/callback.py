@@ -6,14 +6,18 @@
 from __future__ import annotations
 
 import logging
-import time
 
 __all__ = ["Speedometer", "do_checkpoint", "module_checkpoint",
            "log_train_metric", "ProgressBar"]
 
 
 class Speedometer:
-    """Log throughput (samples/sec) and metrics every ``frequent`` batches."""
+    """Log throughput (samples/sec) and metrics every ``frequent`` batches.
+
+    The batch window is measured through ``observability.trace.span``
+    (``callback.speed_window_us``), so the same number that prints here
+    surfaces as a histogram on the metrics endpoint and as a block on
+    the unified chrome-trace timeline — one clock, three views."""
 
     def __init__(self, batch_size: int, frequent: int = 50,
                  auto_reset: bool = True):
@@ -21,8 +25,14 @@ class Speedometer:
         self.frequent = frequent
         self.auto_reset = auto_reset
         self.init = False
-        self.tic = 0.0
         self.last_count = 0
+        self._window = None           # open span over the current window
+
+    def _restart_window(self):
+        from .observability.trace import span
+        self._window = span("callback.speed_window_us",
+                            args={"frequent": self.frequent})
+        self._window.__enter__()
 
     def __call__(self, param) -> None:
         count = param.nbatch
@@ -31,8 +41,12 @@ class Speedometer:
         self.last_count = count
         if self.init:
             if count % self.frequent == 0:
+                win, self._window = self._window, None
+                if win is None:
+                    return
+                win.__exit__(None, None, None)
                 speed = self.frequent * self.batch_size / \
-                    (time.time() - self.tic)
+                    (win.duration_us / 1e6)
                 if param.eval_metric is not None:
                     name_value = param.eval_metric.get_name_value()
                     if self.auto_reset:
@@ -44,10 +58,10 @@ class Speedometer:
                     logging.info(
                         "Epoch[%d] Batch [%d]\tSpeed: %.2f samples/sec",
                         param.epoch, count, speed)
-                self.tic = time.time()
+                self._restart_window()
         else:
             self.init = True
-            self.tic = time.time()
+            self._restart_window()
 
 
 def do_checkpoint(prefix: str, period: int = 1):

@@ -20,6 +20,18 @@ registry (ROADMAP follow-up for both PRs):
   records dumped (with a full snapshot) to JSON on unhandled exception
   / preemption / retry exhaustion (``MXTPU_FLIGHT_STEPS`` /
   ``MXTPU_FLIGHT_PATH``).
+- :mod:`.sampler` — live introspection half 1: a continuous
+  stack-sampling profiler (``MXTPU_PROF_SAMPLE_HZ``) folding all-thread
+  stacks into collapsed/flamegraph counts in rotating windows, plus
+  on-demand ``thread_stacks()``/``profile()`` for the ``/debug/*``
+  endpoints (served by the HttpFrontend and the metrics exporter,
+  gated on ``MXTPU_DEBUG_ENDPOINTS``).
+- :mod:`.watchdog` — live introspection half 2: heartbeat touchpoints
+  in the trainer/serving progress loops, a monitor that flags a
+  touchpoint silent past ``MXTPU_WATCHDOG_FACTOR`` × its recent p99
+  interval, and a one-shot hang-postmortem bundle (stacks + flight
+  rings + span ring + profile window); plus the ``MXTPU_STACKS_SIGNAL``
+  (SIGQUIT) manual stack-dump probe.
 
 The fleet view: ``registry().snapshot(all_hosts=True)`` gathers every
 host's metrics over the DCN ``allgather_host`` path and merges them
@@ -40,7 +52,8 @@ from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        registry)
 
 __all__ = ["Counter", "Gauge", "Histogram", "MetricsRegistry", "registry",
-           "trace", "export", "span", "flight", "tracing"]
+           "trace", "export", "span", "flight", "tracing", "sampler",
+           "watchdog"]
 
 
 def __getattr__(name):
@@ -50,7 +63,7 @@ def __getattr__(name):
     if name in ("trace", "span"):
         mod = importlib.import_module(".trace", __name__)
         return mod if name == "trace" else mod.span
-    if name in ("export", "flight", "tracing"):
+    if name in ("export", "flight", "tracing", "sampler", "watchdog"):
         return importlib.import_module("." + name, __name__)
     raise AttributeError(
         f"module 'mxnet_tpu.observability' has no attribute {name!r}")

@@ -26,18 +26,20 @@ import re
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Optional
+from typing import Optional, Tuple
 
-from ..base import MXNetError, get_env
+from ..base import MXNetError, get_env, list_env
 from .registry import (Counter, Gauge, Histogram, MetricsRegistry,
                        group_host_entries, last_host_states, registry,
                        state_cumulative_buckets)
 
 __all__ = ["prometheus_text", "prometheus_text_aggregate",
            "aggregate_mode", "MetricsServer", "JsonlWriter",
-           "maybe_start_from_env"]
+           "maybe_start_from_env", "debug_route", "debug_enabled",
+           "DEBUG_ENDPOINTS_ENV"]
 
 METRICS_PORT_ENV = "MXTPU_METRICS_PORT"
+DEBUG_ENDPOINTS_ENV = "MXTPU_DEBUG_ENDPOINTS"
 METRICS_JSONL_ENV = "MXTPU_METRICS_JSONL"
 METRICS_INTERVAL_ENV = "MXTPU_METRICS_INTERVAL"
 #: serve the FLEET view (merged multi-host states, every series labeled
@@ -223,12 +225,145 @@ def prometheus_text_aggregate(
     return "\n".join(lines) + "\n"
 
 
+def debug_enabled() -> bool:
+    """Live read of the ``MXTPU_DEBUG_ENDPOINTS`` opt-in."""
+    return bool(get_env(DEBUG_ENDPOINTS_ENV))
+
+
+#: /debug/profile sampling bounds: a handler thread blocks for the
+#: whole window, so the knob-free query param is clamped hard
+PROFILE_MAX_SECONDS = 30.0
+PROFILE_MIN_SECONDS = 0.05
+PROFILE_DEFAULT_HZ = 100.0
+
+_DEBUG_INDEX = """\
+live introspection endpoints (MXTPU_DEBUG_ENDPOINTS=1):
+  GET /debug/stacks               all-thread stacks, trace-tagged JSON
+  GET /debug/profile?seconds=S    on-demand sample window (S<=30;
+      &hz=H&format=collapsed|chrome|json; &windows=1 serves the
+      daemon sampler's rotated windows instead of sampling now)
+  GET /debug/flight               live flight-recorder rings
+  GET /debug/trace/<trace_id>     span-ring lookup for one trace
+  GET /debug/vars                 every registered knob's live value
+"""
+
+
+def _query_params(query: str) -> dict:
+    params = {}
+    for part in query.split("&"):
+        if "=" in part:
+            k, _, v = part.partition("=")
+            params[k] = v
+    return params
+
+
+def _json_body(obj) -> Tuple[str, bytes]:
+    return ("application/json",
+            json.dumps(obj, sort_keys=True, indent=1).encode())
+
+
+def debug_route(path: str, query: str = ""
+                ) -> Optional[Tuple[int, str, bytes]]:
+    """The shared ``/debug/*`` dispatcher — one implementation serving
+    both the serving :class:`~mxnet_tpu.serving.frontend.HttpFrontend`
+    and this module's stdlib metrics endpoint (so trainers without a
+    frontend get the same surface).  Returns ``(status, content_type,
+    body)`` for debug paths, None for everything else (the caller falls
+    through to its own routing).  Knob-gated: with
+    ``MXTPU_DEBUG_ENDPOINTS`` unset every debug path 404s with an
+    explanation — the surface is auth-free and must be an explicit
+    opt-in."""
+    if path != "/debug" and not path.startswith("/debug/"):
+        return None
+    if not debug_enabled():
+        return (404, "text/plain; charset=utf-8",
+                f"debug endpoints disabled (set {DEBUG_ENDPOINTS_ENV}=1"
+                f" to enable)\n".encode())
+    try:
+        return _debug_route(path, _query_params(query))
+    except Exception as e:   # noqa: BLE001 — introspection of a
+        # possibly-wedged process: report the failure, never 500-loop
+        # the whole handler away
+        return (500, "text/plain; charset=utf-8",
+                f"debug handler error: {type(e).__name__}: {e}\n"
+                .encode())
+
+
+def _debug_route(path: str, params: dict
+                 ) -> Tuple[int, str, bytes]:
+    from . import flight as _flight
+    from . import sampler as _sampler
+    from . import tracing as _tracing
+    if path in ("/debug", "/debug/"):
+        return (200, "text/plain; charset=utf-8",
+                _DEBUG_INDEX.encode())
+    if path == "/debug/stacks":
+        ctype, body = _json_body({"ts": round(time.time(), 3),
+                                  "pid": os.getpid(),
+                                  "threads": _sampler.thread_stacks()})
+        return (200, ctype, body)
+    if path == "/debug/profile":
+        fmt = params.get("format", "collapsed")
+        if params.get("windows"):
+            wins = _sampler.sampler().windows()
+            if fmt == "json":
+                ctype, body = _json_body(
+                    {"windows": [w.to_dict() for w in wins]})
+                return (200, ctype, body)
+            text = _sampler.collapsed_from_windows(wins)
+            return (200, "text/plain; charset=utf-8",
+                    (text + "\n").encode())
+        try:
+            seconds = float(params.get("seconds", 1.0))
+        except ValueError:
+            seconds = 1.0
+        seconds = min(max(seconds, PROFILE_MIN_SECONDS),
+                      PROFILE_MAX_SECONDS)
+        try:
+            hz = float(params.get("hz", PROFILE_DEFAULT_HZ))
+        except ValueError:
+            hz = PROFILE_DEFAULT_HZ
+        hz = min(max(hz, 1.0), 1000.0)
+        win = _sampler.profile(seconds=seconds, hz=hz)
+        if fmt == "chrome":
+            ctype, body = _json_body(
+                {"traceEvents":
+                 _sampler.chrome_events_from_window(win),
+                 "displayTimeUnit": "ms"})
+            return (200, ctype, body)
+        if fmt == "json":
+            ctype, body = _json_body(win.to_dict())
+            return (200, ctype, body)
+        return (200, "text/plain; charset=utf-8",
+                (win.collapsed() + "\n").encode())
+    if path == "/debug/flight":
+        ctype, body = _json_body(_flight.recorder().live())
+        return (200, ctype, body)
+    if path.startswith("/debug/trace/"):
+        trace_id = path[len("/debug/trace/"):].strip("/")
+        spans = _tracing.tracer().find(trace_id) if trace_id else []
+        status = 200 if spans else 404
+        ctype, body = _json_body({"trace_id": trace_id,
+                                  "n_spans": len(spans),
+                                  "spans": spans})
+        return (status, ctype, body)
+    if path == "/debug/vars":
+        ctype, body = _json_body(list_env())
+        return (200, ctype, body)
+    return (404, "text/plain; charset=utf-8",
+            b"unknown debug endpoint; GET /debug for the index\n")
+
+
 class _Handler(BaseHTTPRequestHandler):
     server_version = "mxtpu-metrics"
 
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
         path, _, query = self.path.partition("?")
-        if path == "/metrics":
+        status = 200
+        dbg = debug_route(path, query)
+        if dbg is not None:
+            status, ctype, body = dbg
+        elif path == "/metrics":
             # exemplar suffixes are legal only in OpenMetrics-shaped
             # output — a 0.0.4 scraper receiving them rejects the
             # ENTIRE scrape — so they are an explicit opt-in
@@ -254,9 +389,9 @@ class _Handler(BaseHTTPRequestHandler):
                               indent=1).encode()
             ctype = "application/json"
         else:
-            self.send_error(404, "try /metrics or /metrics.json")
+            self.send_error(404, "try /metrics, /metrics.json, /debug")
             return
-        self.send_response(200)
+        self.send_response(status)
         self.send_header("Content-Type", ctype)
         self.send_header("Content-Length", str(len(body)))
         self.end_headers()

@@ -71,8 +71,8 @@ from typing import Deque, List, Optional
 from ..base import get_env
 from .registry import host_id, registry
 
-__all__ = ["FlightRecorder", "recorder", "FLIGHT_STEPS_ENV",
-           "FLIGHT_PATH_ENV"]
+__all__ = ["FlightRecorder", "recorder", "write_json_atomic",
+           "FLIGHT_STEPS_ENV", "FLIGHT_PATH_ENV"]
 
 FLIGHT_STEPS_ENV = "MXTPU_FLIGHT_STEPS"
 FLIGHT_PATH_ENV = "MXTPU_FLIGHT_PATH"
@@ -104,6 +104,21 @@ def _materialize(v):
         return float(v)
     except Exception:   # noqa: BLE001 — a crashed backend must not
         return None     # take the dump down with it
+
+
+def write_json_atomic(payload: dict, path: str) -> Optional[str]:
+    """Atomic JSON write (tmp-then-rename), never raises: the shared
+    dump primitive for crash dumps, watchdog postmortems, and signal
+    stack dumps — all of which run on processes in trouble.  Returns
+    the path, or None when the write failed."""
+    try:
+        tmp = f"{path}.tmp.{os.getpid()}"
+        with open(tmp, "w") as f:
+            json.dump(payload, f)
+        os.replace(tmp, path)
+        return path
+    except (OSError, TypeError, ValueError):
+        return None
 
 
 class FlightRecorder:
@@ -203,6 +218,42 @@ class FlightRecorder:
         return os.path.join(tempfile.gettempdir(),
                             f"mxtpu_flight_{os.getpid()}.json")
 
+    def sibling_path(self, suffix: str) -> str:
+        """A dump-adjacent path for companion bundles (watchdog
+        postmortems, signal stack dumps): the resolved flight path with
+        ``suffix`` spliced in before the extension."""
+        path = self._resolve_path(None)
+        root, ext = os.path.splitext(path)
+        return f"{root}.{suffix}{ext or '.json'}"
+
+    def _snapshot_rings(self) -> tuple:
+        """Ring contents as plain lists, under the lock and NOTHING
+        else: materialization can sync device values (``.asnumpy()``)
+        and must never run while writers are blocked on the lock."""
+        with self._lock:
+            return (list(self._ring), list(self._req_ring),
+                    list(self._tune_ring), list(self._member_ring))
+
+    def live(self) -> dict:
+        """Materialized view of all four rings for live introspection
+        (``/debug/flight``, watchdog postmortems) — snapshot under the
+        lock, encode outside it, same shape as the dump payload's ring
+        sections."""
+        raw_steps, raw_reqs, raw_tune, raw_member = self._snapshot_rings()
+        steps = [{k: _materialize(v) for k, v in rec.items()}
+                 for rec in raw_steps]
+        requests = [{k: _materialize(v) for k, v in rec.items()}
+                    for rec in raw_reqs]
+        tunings = [{k: _materialize(v) for k, v in rec.items()}
+                   for rec in raw_tune]
+        memberships = [{k: _materialize(v) for k, v in rec.items()}
+                       for rec in raw_member]
+        return {"n_steps": len(steps), "steps": steps,
+                "n_requests": len(requests), "requests": requests,
+                "n_tuning": len(tunings), "tuning": tunings,
+                "n_membership": len(memberships),
+                "membership": memberships}
+
     def dump(self, reason: str, path: Optional[str] = None
              ) -> Optional[str]:
         """Write the ring + a full registry snapshot to JSON (atomic
@@ -212,15 +263,19 @@ class FlightRecorder:
         if not self.enabled:
             return None
         path = self._resolve_path(path)
-        with self._lock:
-            steps = [{k: _materialize(v) for k, v in rec.items()}
-                     for rec in self._ring]
-            requests = [{k: _materialize(v) for k, v in rec.items()}
-                        for rec in self._req_ring]
-            tunings = [{k: _materialize(v) for k, v in rec.items()}
-                       for rec in self._tune_ring]
-            memberships = [{k: _materialize(v) for k, v in rec.items()}
-                           for rec in self._member_ring]
+        # snapshot-then-encode: the lock protects only the list() copies;
+        # _materialize may sync device values and JSON encoding is O(ring)
+        # — holding the ring lock across either would stall every
+        # concurrent record() (serving dispatch, trainer steps)
+        raw_steps, raw_reqs, raw_tune, raw_member = self._snapshot_rings()
+        steps = [{k: _materialize(v) for k, v in rec.items()}
+                 for rec in raw_steps]
+        requests = [{k: _materialize(v) for k, v in rec.items()}
+                    for rec in raw_reqs]
+        tunings = [{k: _materialize(v) for k, v in rec.items()}
+                   for rec in raw_tune]
+        memberships = [{k: _materialize(v) for k, v in rec.items()}
+                       for rec in raw_member]
         try:
             snapshot = registry().snapshot()
         except Exception:   # noqa: BLE001 — a half-torn registry still
@@ -253,12 +308,7 @@ class FlightRecorder:
             "trace_spans": trace_spans,
             "snapshot": snapshot,
         }
-        try:
-            tmp = f"{path}.tmp.{os.getpid()}"
-            with open(tmp, "w") as f:
-                json.dump(payload, f)
-            os.replace(tmp, path)
-        except OSError:
+        if write_json_atomic(payload, path) is None:
             return None
         try:
             registry().counter(

@@ -77,6 +77,8 @@ __all__ = ["Span", "RemoteContext", "Tracer", "tracer", "current",
            "traceparent", "parse_traceparent", "activate", "now",
            "deterministic_trace_id", "gen_trace_id", "record_child",
            "chrome_trace_from_spans", "chrome_events_from_spans",
+           "thread_spans", "enable_thread_span_tracking",
+           "disable_thread_span_tracking",
            "TRACE_ENV", "TRACE_SAMPLE_ENV", "TRACE_RING_ENV",
            "TRACE_JSONL_ENV"]
 
@@ -114,6 +116,75 @@ def _raw_env(key_bytes: bytes, key_str: str):
 # not-tracing probe.
 _active: contextvars.ContextVar = contextvars.ContextVar(
     "mxtpu_trace_span", default=None)
+
+# Cross-thread view of the active spans, for the stack sampler and the
+# watchdog postmortem: a ContextVar is unreadable from another thread,
+# so while introspection is enabled (refcounted — the sampler daemon,
+# the watchdog, an on-demand /debug/profile window) every activation
+# site mirrors the span into this ident-keyed dict.  OFF is the normal
+# state and costs one module-global bool read per span activation; the
+# dict itself needs no lock — each thread writes only its own ident
+# (GIL-atomic dict ops) and readers only snapshot via dict copy.
+_track_spans = False
+_track_refs = 0
+_track_lock = threading.Lock()
+_thread_spans: Dict[int, object] = {}
+
+
+def enable_thread_span_tracking() -> None:
+    """Start mirroring span activations into the cross-thread map
+    (refcounted: pairs with :func:`disable_thread_span_tracking`)."""
+    global _track_spans, _track_refs
+    with _track_lock:
+        _track_refs += 1
+        _track_spans = True
+
+
+def disable_thread_span_tracking() -> None:
+    """Drop one tracking ref; the map stops updating (and is cleared)
+    when the last consumer detaches."""
+    global _track_spans, _track_refs
+    off = False
+    with _track_lock:
+        _track_refs = max(0, _track_refs - 1)
+        if _track_refs == 0:
+            _track_spans = False
+            off = True
+    if off:
+        _thread_spans.clear()
+
+
+def thread_spans() -> Dict[int, object]:
+    """Snapshot of thread ident → active Span/RemoteContext.  Empty
+    unless tracking is enabled — callers treat a missing ident as "no
+    active span"."""
+    return dict(_thread_spans)
+
+
+def _set_active(obj):
+    """Install ``obj`` as the active context AND mirror it into the
+    cross-thread map when tracking is on.  Returns the reset token."""
+    token = _active.set(obj)
+    if _track_spans:
+        _thread_spans[threading.get_ident()] = obj
+    return token
+
+
+def _reset_active(token) -> None:
+    """Undo a :func:`_set_active` (ValueError = crossed a context
+    boundary: clearing beats leaking the span into unrelated work)."""
+    try:
+        _active.reset(token)
+    except ValueError:
+        _active.set(None)
+    if _track_spans:
+        cur = _active.get()
+        ident = threading.get_ident()
+        if cur is None:
+            _thread_spans.pop(ident, None)
+        else:
+            _thread_spans[ident] = cur
+
 
 _rng = random.Random()
 _rng.seed(int.from_bytes(os.urandom(8), "big"))
@@ -230,7 +301,7 @@ class Span:
         # context — a second set here would orphan the first token and
         # leak the span past its own `with` block
         if self._token is None and not self._done:
-            self._token = _active.set(self)
+            self._token = _set_active(self)
         return self
 
     def __exit__(self, exc_type, exc, tb) -> None:
@@ -247,12 +318,7 @@ class Span:
         end = perf_counter() if t_end is None else float(t_end)
         self.duration_us = max(0.0, (end - self.t0_pc) * 1e6)
         if self._token is not None:
-            try:
-                _active.reset(self._token)
-            except ValueError:
-                # crossed a context boundary (generator/thread hand-off):
-                # clearing beats leaking the span into unrelated work
-                _active.set(None)
+            _reset_active(self._token)
             self._token = None
         self._tracer._record(self)
 
@@ -388,7 +454,7 @@ class Tracer:
             self._c_sampled.inc()
             sp = Span(self, name, _gen_id(128), None, t0, args)
         if activate:
-            sp._token = _active.set(sp)
+            sp._token = _set_active(sp)
         return sp
 
     def record_child(self, name: str, t_end_pc: float, dur_us: float,
@@ -622,15 +688,12 @@ class activate:
 
     def __enter__(self):
         if self._ctx is not None:
-            self._token = _active.set(self._ctx)
+            self._token = _set_active(self._ctx)
         return self._ctx
 
     def __exit__(self, exc_type, exc, tb) -> None:
         if self._token is not None:
-            try:
-                _active.reset(self._token)
-            except ValueError:
-                _active.set(None)
+            _reset_active(self._token)
 
 
 def record_child(name: str, t_end_pc: float, dur_us: float,

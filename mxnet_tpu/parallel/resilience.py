@@ -484,6 +484,18 @@ class ResilientTrainer:
                  "index into (compute, loader, device_prefetch, "
                  "collective, ckpt, other) — the one-number answer to "
                  "'why is this step slow'")
+        # live introspection: heartbeat for the progress watchdog
+        # (thresholded on step_wall's recent p99 — a stalled loader or
+        # wedged collective goes silent between beats), sampler opt-in,
+        # and the manual SIGQUIT stack-dump probe
+        from ..observability.sampler import maybe_start_from_env as \
+            _maybe_start_sampler
+        from ..observability.watchdog import (install_stack_signal,
+                                              touchpoint as _touchpoint)
+        self._tp_step = _touchpoint("resilience.step",
+                                    hist="resilience.step_wall_us")
+        _maybe_start_sampler()
+        install_stack_signal()
         # interpreter-exit fallback: an in-flight async write must commit
         # even if the loop never reaches another step boundary
         _register_exit_flush(trainer)
@@ -745,6 +757,10 @@ class ResilientTrainer:
             self._preempt_boundary()
         if self._auto_resume and not self._resume_checked:
             self.maybe_resume(x, y, batch_size)
+        # watchdog heartbeat at step ENTRY: a loader stalled between
+        # steps (the epoch loop blocked in next(loader)) keeps this
+        # silent — exactly the hang the postmortem must catch
+        self._tp_step.beat()
         self._step_index += 1
         i = self._step_index
         plan = self._plan

@@ -56,6 +56,7 @@ import numpy as _np
 
 from ..base import get_env, hot_path
 from ..observability import tracing as _tracing
+from ..observability.export import debug_route as _debug_route
 from .batcher import (DeadlineExceeded, RequestCancelled, ServerClosed,
                       ServerOverloaded, ServingError)
 from .buckets import NoBucketError
@@ -146,8 +147,18 @@ class _Handler(BaseHTTPRequestHandler):
 
     # -- GET -----------------------------------------------------------
     def do_GET(self):  # noqa: N802 — BaseHTTPRequestHandler contract
-        path = self.path.partition("?")[0]
-        if path == "/healthz":
+        path, _, query = self.path.partition("?")
+        dbg = _debug_route(path, query)
+        if dbg is not None:
+            # the shared /debug/* surface (observability.export) —
+            # knob-gated, pre-encoded (status, content-type, body)
+            status, ctype, body = dbg
+            self.send_response(status)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+        elif path == "/healthz":
             self._send_json(200, {"ok": True})
         elif path == "/readyz":
             fe = self._fe
@@ -164,7 +175,7 @@ class _Handler(BaseHTTPRequestHandler):
         else:
             self._send_json(404, {"error": "NotFound", "status": 404,
                                   "detail": "try /v1/models, /healthz, "
-                                            "/readyz"})
+                                            "/readyz, /debug"})
 
     # -- POST ----------------------------------------------------------
     def do_POST(self):  # noqa: N802 — BaseHTTPRequestHandler contract
@@ -403,6 +414,11 @@ class HttpFrontend:
         contract).  Same discipline as the servers' own installers: the
         handler spawns a non-daemon drain thread and returns
         immediately, never blocking in signal context."""
+        # the manual stack-dump signal (SIGQUIT by default) rides along
+        # wherever the drain handler is wired: a wedged drain is exactly
+        # when an operator wants kill -QUIT introspection
+        from ..observability.watchdog import install_stack_signal
+        install_stack_signal()
         prev = signal.getsignal(signal.SIGTERM)
         self._prev_sigterm = prev
 

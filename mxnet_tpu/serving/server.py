@@ -41,6 +41,9 @@ from ..ndarray import NDArray, array as nd_array
 from ..observability import tracing as _tracing
 from ..observability.flight import recorder as _flight_recorder
 from ..observability.registry import registry
+from ..observability.sampler import maybe_start_from_env as \
+    _maybe_start_sampler
+from ..observability.watchdog import touchpoint as _touchpoint
 from .batcher import (AdmissionQueue, Batcher, DeadlineExceeded,
                       GenRequest, Request, RequestCancelled, ServerClosed,
                       ServerOverloaded)
@@ -207,6 +210,13 @@ class ModelServer:
         self._drain_down = False
         self._rid = itertools.count()
         self._prev_sigterm = None
+        # progress heartbeat for the watchdog: one bump per worker-loop
+        # iteration (idle pops included — a healthy-idle server keeps
+        # beating; only a wedged dispatch goes silent), thresholded on
+        # the dispatch histogram's recent p99
+        self._tp_dispatch = _touchpoint("serving.dispatch",
+                                        hist="serving.dispatch_us")
+        _maybe_start_sampler()
 
     # -- construction helpers ----------------------------------------------
     @classmethod
@@ -502,7 +512,9 @@ class ModelServer:
 
     # -- dispatch (hot path) -------------------------------------------------
     def _worker_loop(self) -> None:
+        tp = self._tp_dispatch
         while True:
+            tp.beat()
             try:
                 batch = self._out.get(timeout=0.25)
             except _queue.Empty:
@@ -763,6 +775,13 @@ class GenerationServer:
         self._abort = False
         self._rid = itertools.count()
         self._prev_sigterm = None
+        # progress heartbeat for the watchdog: bumped every scheduler
+        # iteration AND inside the idle condition-wait, thresholded on
+        # the decode-step histogram's recent p99 — a wedged decode
+        # dispatch goes silent, a merely-idle scheduler never does
+        self._tp_decode = _touchpoint("serving.decode",
+                                      hist="serving.decode_step_us")
+        _maybe_start_sampler()
 
     # -- lifecycle ----------------------------------------------------
     def start(self) -> "GenerationServer":
@@ -1044,12 +1063,15 @@ class GenerationServer:
 
     # -- the scheduler loop --------------------------------------------
     def _run(self) -> None:
+        tp = self._tp_decode
         while True:
+            tp.beat()
             with self._lock:
                 while (not self._queue
                        and not any(r is not None for r in self._running)
                        and not self._closed):
                     self._lock.wait(0.1)
+                    tp.beat()   # healthy-idle keeps the heartbeat alive
                 if self._abort:
                     shed, self._queue = self._queue, []
                     run = [r for r in self._running if r is not None]
