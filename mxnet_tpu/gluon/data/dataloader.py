@@ -538,7 +538,7 @@ class DataLoader:
                 hand_over(sentinel)
 
         t = threading.Thread(target=producer, daemon=True)
-        t.start()
+        t.start()  # mxlint: disable=thread-lifecycle — deliberate abandonment: the producer exits on `abandoned` at every hand-over, but joining would park generator close behind the pool's shutdown(wait=True) for in-flight worker batches
         expected = 0
         try:
             while True:

@@ -49,7 +49,7 @@ def create(metric, *args, **kwargs) -> "EvalMetric":
 
 def _to_np(x) -> _np.ndarray:
     if hasattr(x, "asnumpy"):
-        return x.asnumpy()
+        return x.asnumpy()  # mxlint: disable=hidden-host-sync — metric ingestion boundary: EvalMetric.update computes on host numpy by contract, and callers hand it outputs they are about to read anyway (eval loop, not the step path)
     return _np.asarray(x)
 
 

@@ -321,6 +321,13 @@ class _AsyncVoteRound:
             target=run, name="mxtpu-preempt-vote", daemon=True)
         self._thread.start()
 
+    def join(self, timeout: Optional[float] = None) -> None:
+        """Reap the voter thread.  ``resolved.set()`` is its final
+        statement, so after the event fires this returns ~immediately;
+        the flush boundary calls it so the round never leaves a zombie
+        racing interpreter teardown."""
+        self._thread.join(timeout)
+
 
 class ResilientTrainer:
     """Wrap a :class:`ShardedTrainer` with failure handling.
@@ -714,6 +721,7 @@ class ResilientTrainer:
                         return
                     r.resolved.wait(r._poll)
                 self._preempt_flush_t = r.agreed
+                r.join(timeout=r._poll)  # resolved ⇒ exiting; reap it
             else:
                 self._preempt_flush_t = self._coordinate_flush_step()
         if self._trainer.num_update >= self._preempt_flush_t:

@@ -351,8 +351,16 @@ class ModelServer:
             args={"rid": req.rid, "bucket": _key_str(key)})
         try:
             self._admission.submit(req)
-        except ServerOverloaded:
-            self._c_rej_429.inc()
+        except BaseException as exc:
+            # admission refused ownership: the request never enters the
+            # pipeline, so nobody downstream will ever finish this span.
+            # Span hygiene FIRST, metrics after — the close must not
+            # depend on anything else in the handler succeeding.
+            if req.trace is not None:
+                req.trace.annotate(error=type(exc).__name__)
+                req.trace.finish()
+            if isinstance(exc, ServerOverloaded):
+                self._c_rej_429.inc()
             raise
         self._c_requests.inc()
         return req
@@ -903,17 +911,25 @@ class GenerationServer:
         req.trace = _tracing.tracer().begin(
             "serving.generate", activate=False,
             args={"rid": req.rid, "prompt": plen, "max_new": mnt})
-        with self._lock:
-            if self._closed:
-                raise ServerClosed("server is shut down")
-            if len(self._queue) >= self.queue_depth:
-                self._c_rej_429.inc()
-                raise ServerOverloaded(
-                    f"admission queue full ({self.queue_depth} deep) — "
-                    f"retry with backoff (429)")
-            self._queue.append(req)
-            self._g_depth.set(len(self._queue))
-            self._lock.notify_all()
+        try:
+            with self._lock:
+                if self._closed:
+                    raise ServerClosed("server is shut down")
+                if len(self._queue) >= self.queue_depth:
+                    self._c_rej_429.inc()
+                    raise ServerOverloaded(
+                        f"admission queue full ({self.queue_depth} deep)"
+                        f" — retry with backoff (429)")
+                self._queue.append(req)
+                self._g_depth.set(len(self._queue))
+                self._lock.notify_all()
+        except BaseException as exc:
+            # rejected before entering the pipeline: nobody downstream
+            # holds the span, so close it here or it leaks open forever
+            if req.trace is not None:
+                req.trace.annotate(error=type(exc).__name__)
+                req.trace.finish()
+            raise
         self._c_requests.inc()
         return req
 
@@ -1157,13 +1173,16 @@ class GenerationServer:
             "serving.prefill", parent=req.trace, activate=False,
             args={"bucket": bucket})
         plen = len(req.prompt)
-        table = self._kv.ensure(req.rid, plen)
-        bs = self._kv.block_size
-        toks = _np.zeros((1, bucket), _np.int32)  # mxlint: disable=hot-path-purity — per-prefill pad buffer, amortized over the prompt
-        toks[0, :plen] = req.prompt
-        tb = _np.asarray([table.padded(-(-bucket // bs))], _np.int32)  # mxlint: disable=hot-path-purity — per-prefill block-table row, amortized over the prompt
-        req.t_prefill = time.monotonic()
         try:
+            # the prep is fallible too (ensure() asserts pool-table
+            # agreement) — it must fail the request AND close the span,
+            # exactly like a compiled-call failure
+            table = self._kv.ensure(req.rid, plen)
+            bs = self._kv.block_size
+            toks = _np.zeros((1, bucket), _np.int32)  # mxlint: disable=hot-path-purity — per-prefill pad buffer, amortized over the prompt
+            toks[0, :plen] = req.prompt
+            tb = _np.asarray([table.padded(-(-bucket // bs))], _np.int32)  # mxlint: disable=hot-path-purity — per-prefill block-table row, amortized over the prompt
+            req.t_prefill = time.monotonic()
             logits, pool = graph.raw(
                 toks, _np.asarray([plen], _np.int32), tb, self._pool)  # mxlint: disable=hot-path-purity — per-prefill scalar wrap, amortized over the prompt
             self._pool = pool  # mxlint: disable=lock-discipline — scheduler-thread-owned; the lock-held writes happen in pre-start warmup
@@ -1179,14 +1198,18 @@ class GenerationServer:
                 raise
             return
         req.t_first = time.monotonic()
+        # close the span at the TTFT point: it measures the prefill
+        # (prep + compiled call + first-token readback), and closing
+        # before the fan-out bookkeeping means a failure there can no
+        # longer strand it open
+        if sp is not None:
+            sp.finish()
         trace_id = None if req.trace is None else req.trace.trace_id
         self._h_ttft.observe((req.t_first - req.t_enqueue) * 1e6,
                              trace_id=trace_id)
         req.push_token(tok)
         req.pos = plen          # the new token decodes at position plen
         self._c_tokens.inc()
-        if sp is not None:
-            sp.finish()
         if req.cancelled:
             self._finish_gen(req, error=RequestCancelled(
                 f"generation {req.rid} cancelled mid-stream"))
@@ -1219,22 +1242,25 @@ class GenerationServer:
                     if o.trace is not None and o is not r:
                         sp.link(o.trace)
                 break
-        # reused per-slot-count assembly buffers (built with the graph);
-        # zeroed every step so empty slots and table tails land in the
-        # scratch block, never a live request's blocks
-        tokens, positions, tables = self._step_bufs[self._slots]
-        tokens.fill(0)
-        positions.fill(0)
-        tables.fill(0)
-        for i, r in occupied:
-            # lazy block growth: back the write position; infallible
-            # under the admission-time reservation
-            table = self._kv.ensure(r.rid, r.pos + 1)
-            tokens[i] = r.tokens[-1]
-            positions[i] = r.pos
-            tables[i, :] = table.padded(self._max_blocks)
-        t0 = time.monotonic()
         try:
+            # reused per-slot-count assembly buffers (built with the
+            # graph); zeroed every step so empty slots and table tails
+            # land in the scratch block, never a live request's blocks.
+            # Assembly is inside the try: a failed ensure() must fail
+            # the batch AND close the step span like a compiled-call
+            # failure would
+            tokens, positions, tables = self._step_bufs[self._slots]
+            tokens.fill(0)
+            positions.fill(0)
+            tables.fill(0)
+            for i, r in occupied:
+                # lazy block growth: back the write position; infallible
+                # under the admission-time reservation
+                table = self._kv.ensure(r.rid, r.pos + 1)
+                tokens[i] = r.tokens[-1]
+                positions[i] = r.pos
+                tables[i, :] = table.padded(self._max_blocks)
+            t0 = time.monotonic()
             logits, pool = graph.raw(tokens, positions, tables,
                                      self._pool)
             self._pool = pool  # mxlint: disable=lock-discipline — scheduler-thread-owned; the lock-held writes happen in pre-start warmup
@@ -1254,6 +1280,11 @@ class GenerationServer:
                 raise
             return
         trace_id = None if sp is None else sp.trace_id
+        # the step span measures the compiled call + batched readback;
+        # close it before the fan-out so a failure in per-request
+        # bookkeeping can no longer strand it open
+        if sp is not None:
+            sp.finish()
         self._h_step.observe((time.monotonic() - t0) * 1e6,
                              trace_id=trace_id)
         self._c_steps.inc()
@@ -1274,8 +1305,6 @@ class GenerationServer:
                 self._finish_gen(r, error=RequestCancelled(
                     f"generation {r.rid} cancelled mid-stream")
                     if r.cancelled else None)
-        if sp is not None:
-            sp.finish()
 
     # -- completion paths ----------------------------------------------
     def _finish_gen(self, req: GenRequest, error=None) -> None:

@@ -8,9 +8,15 @@ walks for the lexical rules (still ONE ``ast.parse`` per file).  Rules
 then run with interprocedural context via ``project_check``: findings
 reached through the call graph carry a ``reason`` chain naming every
 hop, and a stable ``id`` (rule + path + enclosing symbol, not line).
-Per-line ``# mxlint: disable=<rule>`` pragmas cover intentional
-exceptions; ONE frozen JSON baseline (``baseline.json``) holds
-grandfathered debt, file-level.
+PR 20 adds a flow-sensitive tier on the same trees: :mod:`.cfg` lowers
+each function to a basic-block CFG (branch/loop/``finally``/``with``
+regions, conservative exception edges), :mod:`.protocols` declares the
+repo's acquire→release disciplines, and the :mod:`.flow` rules search
+for exit paths that break them — such findings additionally carry
+``hops``, the ``file:line`` program-point path that exhibits the
+defect.  Per-line ``# mxlint: disable=<rule>`` pragmas cover
+intentional exceptions; ONE frozen JSON baseline (``baseline.json``)
+holds grandfathered debt, file-level.
 
 Rules (:mod:`.rules`) encode the codebase's actual contracts:
 
@@ -30,6 +36,15 @@ Rules (:mod:`.rules`) encode the codebase's actual contracts:
                           near ``@hot_path`` roots
 ``env-knob``              ``MXNET_*``/``MXTPU_*`` reads go through the
                           declared knob table (``base.register_env``)
+``resource-leak``         every acquire (KV block, span, tmp file,
+                          ContextVar token) reaches a release or an
+                          ownership transfer on EVERY path, exception
+                          edges included
+``thread-lifecycle``      every started thread is joined, stopped, or
+                          atexit-registered by someone
+``blocking-under-lock``   no indefinitely-blocking call (queue get/put
+                          sans timeout, ``join()``, socket recv) is
+                          reachable — even via callees — under a lock
 ========================  ===================================================
 
 CLI::
@@ -496,6 +511,10 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     else:
         for f in sorted(new, key=lambda f: (f.path, f.line, f.rule)):
             print(f"{f.path}:{f.line}: [{f.rule}] {f.message}")
+            for r in f.reason:
+                print(f"    reason: {r}")
+            if f.hops:
+                print("    path:   " + " -> ".join(f.hops))
         tail = []
         if old:
             tail.append(f"{len(old)} baselined")

@@ -1,0 +1,561 @@
+"""mxlint pass 2.5: per-function control-flow graphs.
+
+The flow-SENSITIVE tier (PR 20).  Passes 1-2 know *what* a function
+does (facts, lexical context); this module knows *in which order and on
+which paths* — the difference between "there is a ``release()`` in this
+function" and "every path from the ``reserve()`` to every exit crosses
+a ``release()``".  The costliest review fixes of PRs 11-19 were all
+exit-path bugs (KV blocks leaked on a failed batch, spans unfinished
+when a dispatch raised, membership daemons never joined): lexically the
+cleanup existed; a path skipped it.
+
+Design, in the order the constraints forced it:
+
+- **Statement-granular basic blocks.**  Each :class:`Block` holds an
+  ordered list of :class:`Event` records (calls in evaluation order,
+  assignments, returns, raises, with-enter/with-exit).  Analyses walk
+  *program points* ``(block, event_index)``, so an exception edge taken
+  mid-block sees exactly the events that already executed.
+- **One exception target per block** (``Block.exc``): blocks are split
+  at ``try`` boundaries, so every event in a block shares the same
+  innermost handler.  Only ``call``/``raise``/``assert``/``with-enter``
+  events take the edge (:data:`MAY_RAISE`) — inventing a raise at
+  ``x = 1`` would drown the real exit-path findings.
+- **``finally`` (and ``with``) by duplication.**  A ``finally`` body is
+  lowered once per way out — fall-through, each ``return``/``break``/
+  ``continue``, and the exception path — the same strategy CPython's
+  compiler used pre-3.8.  Duplication keeps every path explicit, which
+  is the whole point of the tier; lint-scale functions keep it cheap.
+- **Handler dispatch is conservative both ways**: an exception edge
+  lands on a dispatch block fanning out to every handler, and falls
+  through to the outer handler ONLY when no handler is a catch-all
+  (bare / ``Exception`` / ``BaseException``) — otherwise the standard
+  ``except Exception: cleanup(); raise`` idiom would leak through a
+  phantom unmatched path.
+- **Branch-arm facts** (``CFG.branches``): an ``if`` head records its
+  test and which successor is the true/false arm, so the leak analysis
+  can correlate ``tok = reserve()  # may be None`` with a later
+  ``if tok is None: return`` instead of reporting the absent-resource
+  arm as a leak.
+- **Generators**: ``yield`` is an ordinary event, not an exit — an
+  abandoned generator *can* strand a resource, but flagging every
+  generator that holds anything across a yield would bury the signal.
+
+Nested ``def``/``lambda`` bodies are *not* lowered into the enclosing
+CFG (each def gets its own graph from the rule layer); their default
+argument expressions, which do evaluate here, are.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import FUNC_TYPES
+
+__all__ = ["Event", "Block", "CFG", "build_cfg", "MAY_RAISE",
+           "leak_path", "iter_walk"]
+
+#: event kinds that grow an exception edge to the block's handler
+MAY_RAISE = frozenset(("call", "raise", "assert", "with-enter"))
+
+#: catch-all handler types: an exception edge into their dispatch block
+#: cannot fall through to the outer handler
+_CATCH_ALL = frozenset(("Exception", "BaseException"))
+
+
+class Event:
+    """One executed point inside a block.
+
+    ``kind`` is one of ``call`` (an ``ast.Call``, emitted in evaluation
+    order, inner calls first), ``assign`` (the store of an ``Assign``/
+    ``AugAssign``/``AnnAssign``, emitted after its value's calls),
+    ``return``/``raise``/``assert``/``yield``, and ``with-enter``/
+    ``with-exit`` (``node`` is the ``ast.withitem``; the exit event is
+    the ``__exit__`` guarantee, duplicated onto the exception path)."""
+
+    __slots__ = ("kind", "node", "line")
+
+    def __init__(self, kind: str, node: ast.AST, line: int):
+        self.kind = kind
+        self.node = node
+        self.line = line
+
+    def __repr__(self) -> str:
+        return f"<Event {self.kind}@{self.line}>"
+
+
+class Block:
+    """Basic block: ordered events, normal successor edges, and the
+    exception target every may-raise event in the block jumps to."""
+
+    __slots__ = ("id", "events", "succs", "exc", "kind")
+
+    def __init__(self, bid: int, exc: Optional[int], kind: str = "code"):
+        self.id = bid
+        self.events: List[Event] = []
+        self.succs: List[int] = []
+        self.exc = exc            # block id, or None for the two exits
+        self.kind = kind          # "code" | "exit" | "raise"
+
+    def __repr__(self) -> str:
+        return (f"<Block {self.id} {self.kind} events={len(self.events)} "
+                f"succs={self.succs} exc={self.exc}>")
+
+
+class CFG:
+    """One function's graph.  ``exit_id`` is the normal-return exit,
+    ``raise_id`` the exceptional one; both are empty terminal blocks.
+    ``branches`` maps an ``if``-head block id to ``(test_node,
+    true_succ, false_succ)`` for guard-correlation in the analyses."""
+
+    __slots__ = ("func", "blocks", "entry", "exit_id", "raise_id",
+                 "branches")
+
+    def __init__(self, func: ast.AST):
+        self.func = func
+        self.blocks: List[Block] = []
+        self.branches: Dict[int, Tuple[ast.expr, int, int]] = {}
+        self.exit_id = 0
+        self.raise_id = 0
+        self.entry = 0
+
+    def block(self, bid: int) -> Block:
+        return self.blocks[bid]
+
+    def is_exit(self, bid: int) -> bool:
+        return bid in (self.exit_id, self.raise_id)
+
+    def events(self) -> List[Tuple[int, int, Event]]:
+        """Every (block_id, index, event), block order — the scan the
+        rules use to find acquire sites."""
+        out = []
+        for b in self.blocks:
+            for i, e in enumerate(b.events):
+                out.append((b.id, i, e))
+        return out
+
+
+class _Loop:
+    __slots__ = ("continue_id", "break_id", "fin_depth")
+
+    def __init__(self, continue_id: int, break_id: int, fin_depth: int):
+        self.continue_id = continue_id
+        self.break_id = break_id
+        self.fin_depth = fin_depth
+
+
+class _Lowerer:
+    """One pass over one function body.  ``self.cur`` is the open block
+    (None while the current point is unreachable, e.g. right after a
+    ``return``); ``self.exc`` is the innermost handler target new
+    blocks inherit."""
+
+    def __init__(self, func: ast.AST):
+        self.cfg = CFG(func)
+        self.cfg.exit_id = self._new(exc=None, kind="exit").id
+        self.cfg.raise_id = self._new(exc=None, kind="raise").id
+        self.exc = self.cfg.raise_id
+        # pending finally bodies, outermost first: (stmts-or-items,
+        # kind "finally"|"with", exc target OUTSIDE the region)
+        self.finallies: List[Tuple[object, str, int]] = []
+        self.loops: List[_Loop] = []
+        entry = self._new()
+        self.cfg.entry = entry.id
+        self.cur: Optional[Block] = entry
+
+    # -- plumbing -----------------------------------------------------------
+    def _new(self, exc: Optional[int] = -1, kind: str = "code") -> Block:
+        b = Block(len(self.cfg.blocks),
+                  self.exc if exc == -1 else exc, kind)
+        self.cfg.blocks.append(b)
+        return b
+
+    def _edge(self, src: Block, dst: int) -> None:
+        if dst not in src.succs:
+            src.succs.append(dst)
+
+    def _emit(self, kind: str, node: ast.AST) -> None:
+        if self.cur is not None:
+            line = getattr(node, "lineno", 0)
+            if not line and isinstance(node, ast.withitem):
+                # withitem carries no lineno of its own
+                line = getattr(node.context_expr, "lineno", 0)
+            self.cur.events.append(Event(kind, node, line))
+
+    def _seal_to(self, dst: int) -> None:
+        """Close the open block with an edge to ``dst``; current point
+        becomes unreachable."""
+        if self.cur is not None:
+            self._edge(self.cur, dst)
+            self.cur = None
+
+    def _open(self, b: Block) -> None:
+        self.cur = b
+
+    # -- expression events --------------------------------------------------
+    def _expr(self, node: Optional[ast.AST]) -> None:
+        """Emit call/yield events of one expression in evaluation order
+        (post-order: a call's argument calls precede it).  Nested
+        def/lambda BODIES are skipped — they execute on some other
+        frame's path — but their default-arg expressions run here."""
+        if node is None or self.cur is None:
+            return
+        t = type(node)
+        if t in FUNC_TYPES or t is ast.Lambda:
+            for d in getattr(node, "decorator_list", ()):
+                self._expr(d)
+            for dflt in list(node.args.defaults) + \
+                    [d for d in node.args.kw_defaults if d is not None]:
+                self._expr(dflt)
+            return
+        if t is ast.Call:
+            self._expr(node.func)
+            for a in node.args:
+                self._expr(a)
+            for kw in node.keywords:
+                self._expr(kw.value)
+            self._emit("call", node)
+            return
+        if t in (ast.Yield, ast.YieldFrom, ast.Await):
+            if getattr(node, "value", None) is not None:
+                self._expr(node.value)
+            self._emit("yield", node)
+            return
+        for child in ast.iter_child_nodes(node):
+            self._expr(child)
+
+    # -- statements ---------------------------------------------------------
+    def lower(self, body: Sequence[ast.stmt]) -> CFG:
+        self._stmts(body)
+        self._seal_to(self.cfg.exit_id)      # fall off the end: return
+        return self.cfg
+
+    def _stmts(self, body: Sequence[ast.stmt]) -> None:
+        for s in body:
+            if self.cur is None:
+                break                        # unreachable tail
+            self._stmt(s)
+
+    def _stmt(self, s: ast.stmt) -> None:   # noqa: C901 — one dispatch hub
+        t = type(s)
+        if t is ast.If:
+            self._if(s)
+        elif t in (ast.While, ast.For, ast.AsyncFor):
+            self._loop_stmt(s)
+        elif t in (ast.With, ast.AsyncWith):
+            self._with(s)
+        elif t is ast.Try:
+            self._try(s)
+        elif t is ast.Return:
+            self._expr(s.value)
+            self._emit("return", s)
+            self._unwind(0)
+            self._seal_to(self.cfg.exit_id)
+        elif t is ast.Raise:
+            self._expr(s.exc)
+            self._expr(s.cause)
+            self._emit("raise", s)
+            if self.cur is not None:
+                self.cur = None              # control goes via Block.exc
+        elif t is ast.Break:
+            if self.loops:
+                lp = self.loops[-1]
+                self._unwind(lp.fin_depth)
+                self._seal_to(lp.break_id)
+        elif t is ast.Continue:
+            if self.loops:
+                lp = self.loops[-1]
+                self._unwind(lp.fin_depth)
+                self._seal_to(lp.continue_id)
+        elif t is ast.Assert:
+            self._expr(s.test)
+            self._expr(s.msg)
+            self._emit("assert", s)
+        elif t in (ast.Assign, ast.AugAssign, ast.AnnAssign):
+            self._expr(getattr(s, "value", None))
+            for tgt in (s.targets if t is ast.Assign else [s.target]):
+                # subscript/attribute stores evaluate their base
+                if not isinstance(tgt, ast.Name):
+                    self._expr(tgt)
+            if getattr(s, "value", None) is not None:
+                self._emit("assign", s)
+        elif t in FUNC_TYPES or t is ast.ClassDef:
+            for d in s.decorator_list:
+                self._expr(d)               # decorators run at def time
+        else:
+            # Expr, Delete, Import, Global, Pass, ...: events only
+            for child in ast.iter_child_nodes(s):
+                if isinstance(child, ast.expr):
+                    self._expr(child)
+
+    def _if(self, s: ast.If) -> None:
+        self._expr(s.test)
+        head = self.cur
+        then_b = self._new()
+        after = self._new()
+        self._edge(head, then_b.id)
+        if s.orelse:
+            else_b = self._new()
+            self._edge(head, else_b.id)
+            self.cfg.branches[head.id] = (s.test, then_b.id, else_b.id)
+            self._open(else_b)
+            self._stmts(s.orelse)
+            self._seal_to(after.id)
+        else:
+            self._edge(head, after.id)
+            self.cfg.branches[head.id] = (s.test, then_b.id, after.id)
+        self._open(then_b)
+        self._stmts(s.body)
+        self._seal_to(after.id)
+        self._open(after)
+
+    @staticmethod
+    def _always_true(test: ast.expr) -> bool:
+        return isinstance(test, ast.Constant) and bool(test.value)
+
+    def _loop_stmt(self, s) -> None:
+        is_while = isinstance(s, ast.While)
+        if not is_while:
+            self._expr(s.iter)              # iterable built once
+        head = self._new()
+        self._seal_to(head.id)
+        self._open(head)
+        if is_while:
+            self._expr(s.test)
+        body_b = self._new()
+        after = self._new()
+        self._edge(head, body_b.id)
+        exits_normally = not (is_while and self._always_true(s.test))
+        if exits_normally:
+            if s.orelse:
+                else_b = self._new()
+                self._edge(head, else_b.id)
+                self._open(else_b)
+                self._stmts(s.orelse)
+                self._seal_to(after.id)
+            else:
+                self._edge(head, after.id)
+        self.loops.append(_Loop(head.id, after.id, len(self.finallies)))
+        self._open(body_b)
+        self._stmts(s.body)
+        self._seal_to(head.id)              # back edge
+        self.loops.pop()
+        self._open(after)
+
+    # -- finally / with duplication -----------------------------------------
+    def _lower_cleanup(self, entry: Tuple[object, str, int]) -> None:
+        """Inline ONE pending cleanup region (a ``finally`` body or a
+        ``with`` exit) at the current point, with the exception target
+        that surrounds that region."""
+        stmts_or_items, kind, outer_exc = entry
+        saved_exc, self.exc = self.exc, outer_exc
+        if self.cur is not None and self.cur.events:
+            nxt = self._new()
+            self._seal_to(nxt.id)
+            self._open(nxt)
+        elif self.cur is not None:
+            self.cur.exc = outer_exc
+        if kind == "with":
+            for item in reversed(stmts_or_items):
+                self._emit("with-exit", item)
+        else:
+            self._stmts(stmts_or_items)
+        self.exc = saved_exc
+
+    def _unwind(self, down_to: int) -> None:
+        """Run every pending cleanup from innermost down to (excluding)
+        depth ``down_to`` — the ``return``/``break``/``continue`` path
+        through the finallies."""
+        for entry in reversed(self.finallies[down_to:]):
+            if self.cur is None:
+                return
+            self._lower_cleanup(entry)
+
+    def _exc_cleanup_copy(self, entry: Tuple[object, str, int]) -> int:
+        """The exception-path copy of one cleanup region: a fresh block
+        chain running the cleanup, then re-raising to the region's outer
+        exception target.  Returns its entry block id."""
+        _stmts, _kind, outer_exc = entry
+        saved_cur = self.cur
+        b = self._new(exc=outer_exc)
+        self._open(b)
+        self._lower_cleanup(entry)
+        self._seal_to(outer_exc)
+        self.cur = saved_cur
+        return b.id
+
+    def _with(self, s) -> None:
+        for item in s.items:
+            self._expr(item.context_expr)
+            self._emit("with-enter", item)
+        entry = (list(s.items), "with", self.exc)
+        exc_copy = self._exc_cleanup_copy(entry)
+        saved_exc, self.exc = self.exc, exc_copy
+        body_b = self._new()
+        self._seal_to(body_b.id)
+        self._open(body_b)
+        self.finallies.append(entry)
+        self._stmts(s.body)
+        self.finallies.pop()
+        self.exc = saved_exc
+        if self.cur is not None:
+            self._lower_cleanup(entry)      # normal-exit copy
+
+    def _try(self, s: ast.Try) -> None:
+        outer_exc = self.exc
+        if s.finalbody:
+            entry = (list(s.finalbody), "finally", outer_exc)
+            fin_exc = self._exc_cleanup_copy(entry)
+            self.finallies.append(entry)
+        else:
+            entry = None
+            fin_exc = outer_exc
+        after = self._new(exc=outer_exc)
+
+        if s.handlers:
+            dispatch = self._new(exc=fin_exc, kind="code")
+            body_exc = dispatch.id
+        else:
+            dispatch = None
+            body_exc = fin_exc
+
+        # try body
+        body_b = self._new(exc=body_exc)
+        self._seal_to(body_b.id)
+        saved_exc, self.exc = self.exc, body_exc
+        self._open(body_b)
+        self._stmts(s.body)
+        self.exc = saved_exc
+        # orelse runs on normal body completion, OUTSIDE the handlers
+        if s.orelse and self.cur is not None:
+            ob = self._new(exc=fin_exc)
+            self._seal_to(ob.id)
+            self.exc, saved2 = fin_exc, self.exc
+            self._open(ob)
+            self._stmts(s.orelse)
+            self.exc = saved2
+        self._seal_to(after.id)
+
+        # handlers fan out of the dispatch block
+        if dispatch is not None:
+            caught_all = False
+            for h in s.handlers:
+                if h.type is None:
+                    caught_all = True
+                else:
+                    names = [n.id if isinstance(n, ast.Name) else
+                             getattr(n, "attr", None)
+                             for n in (h.type.elts if isinstance(
+                                 h.type, ast.Tuple) else [h.type])]
+                    if any(n in _CATCH_ALL for n in names):
+                        caught_all = True
+                hb = self._new(exc=fin_exc)
+                self._edge(dispatch, hb.id)
+                self.exc, saved3 = fin_exc, self.exc
+                self._open(hb)
+                self._stmts(h.body)
+                self.exc = saved3
+                self._seal_to(after.id)
+            if not caught_all:
+                # the exception may match no handler and keep unwinding
+                self._edge(dispatch, fin_exc)
+
+        if s.finalbody:
+            self.finallies.pop()
+            self._open(after)
+            self._lower_cleanup(entry)      # normal-exit finally copy
+        else:
+            self._open(after)
+
+
+def build_cfg(func: ast.AST) -> CFG:
+    """Lower one ``FunctionDef``/``AsyncFunctionDef`` body to its CFG.
+    Decorators and argument defaults execute at DEF time on the
+    enclosing frame, so they are not part of this graph."""
+    return _Lowerer(func).lower(func.body)
+
+
+# -- generic path analyses ---------------------------------------------------
+
+def iter_walk(cfg: CFG, start: Tuple[int, int],
+              on_event: Callable[[Event], Optional[str]],
+              branch_hint: Optional[Callable[[ast.expr, bool],
+                                             Optional[str]]] = None,
+              ) -> Optional[List[Tuple[int, int]]]:
+    """DFS over program points from ``start`` (exclusive) hunting a path
+    to a function exit that ``on_event`` never closes.
+
+    ``on_event(event)`` returns ``"close"`` (this path is satisfied —
+    stop exploring it), ``"transfer-after-raise"`` (the event closes the
+    path ONLY if it completes: its exception edge is explored first with
+    the path still open — the call-that-raised-took-no-ownership
+    semantics), ``"noraise"`` (treat this event as unable to raise:
+    skip its exception edge — for infallible builtins and methods of
+    the managed resource itself), or None (keep walking).
+    ``branch_hint(test, is_true_arm) -> "close" | None`` prunes
+    guard-correlated arms (``if tok is None:`` — the arm where the
+    resource provably doesn't exist).
+
+    Returns the offending path as program points (including the exit
+    block) or None if every path closes.  Cycle-safe: each point is
+    expanded once; may-raise events additionally expand their block's
+    exception target."""
+    parent: Dict[Tuple[int, int], Tuple[int, int]] = {}
+    seen: Set[Tuple[int, int]] = {start}
+    stack: List[Tuple[int, int]] = [start]
+
+    def _path(pt: Tuple[int, int]) -> List[Tuple[int, int]]:
+        out = [pt]
+        while pt in parent:
+            pt = parent[pt]
+            out.append(pt)
+        out.reverse()
+        return out
+
+    def _push(src: Tuple[int, int], dst: Tuple[int, int]) -> None:
+        if dst not in seen:
+            seen.add(dst)
+            parent[dst] = src
+            stack.append(dst)
+
+    while stack:
+        bid, idx = stack.pop()
+        blk = cfg.block(bid)
+        if cfg.is_exit(bid):
+            return _path((bid, idx))
+        pt = (bid, idx)
+        if idx < len(blk.events):
+            ev = blk.events[idx]
+            verdict = on_event(ev)
+            if verdict == "close":
+                # path satisfied; a release that itself raises is the
+                # cleanup's bug, not this acquire's — no exc edge
+                continue
+            if verdict != "noraise" and ev.kind in MAY_RAISE and \
+                    blk.exc is not None:
+                _push(pt, (blk.exc, 0))
+            if verdict == "transfer-after-raise":
+                continue        # call completed => ownership moved on
+            _push(pt, (bid, idx + 1))
+            continue
+        # end of block: follow normal successors (branch-aware)
+        br = cfg.branches.get(bid)
+        for succ in blk.succs:
+            if br is not None and branch_hint is not None:
+                test, true_id, false_id = br
+                if succ in (true_id, false_id):
+                    if branch_hint(test, succ == true_id) == "close":
+                        continue
+            _push(pt, (succ, 0))
+    return None
+
+
+def leak_path(cfg: CFG, acquire_pt: Tuple[int, int],
+              on_event: Callable[[Event], Optional[str]],
+              branch_hint=None) -> Optional[List[Tuple[int, int]]]:
+    """Path from just AFTER the acquire event to an exit with no close:
+    the resource-leak primitive.  ``acquire_pt`` is the acquire event's
+    (block, index)."""
+    bid, idx = acquire_pt
+    return iter_walk(cfg, (bid, idx + 1), on_event,
+                     branch_hint=branch_hint)

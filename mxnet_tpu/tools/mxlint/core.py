@@ -44,19 +44,27 @@ class Finding:
     unrelated edits above a finding don't change its identity).
     ``reason`` is the interprocedural evidence chain: for a finding the
     analysis reached through the call graph, each entry is one hop
-    (``"a.py::f -> b.py::g"`` style), ending at the fact that fired."""
+    (``"a.py::f -> b.py::g"`` style), ending at the fact that fired.
+    ``hops`` is the flow-sensitive counterpart (PR 20): the *control-flow
+    path* that exhibits the defect, as ``file:line`` program points from
+    the acquire to the offending exit — what the CFG rules attach so a
+    reader can replay the leaking path instead of taking the verdict on
+    faith."""
 
-    __slots__ = ("rule", "path", "line", "message", "symbol", "reason")
+    __slots__ = ("rule", "path", "line", "message", "symbol", "reason",
+                 "hops")
 
     def __init__(self, rule: str, path: str, line: int, message: str,
                  symbol: Optional[str] = None,
-                 reason: Tuple[str, ...] = ()):
+                 reason: Tuple[str, ...] = (),
+                 hops: Tuple[str, ...] = ()):
         self.rule = rule
         self.path = path          # repo-relative, forward slashes
         self.line = line
         self.message = message
         self.symbol = symbol
         self.reason = tuple(reason)
+        self.hops = tuple(hops)
 
     @property
     def id(self) -> str:
@@ -68,12 +76,16 @@ class Finding:
              "message": self.message}
         if self.reason:
             d["reason"] = list(self.reason)
+        if self.hops:
+            d["hops"] = list(self.hops)
         return d
 
     def __repr__(self) -> str:
         base = f"{self.path}:{self.line}: [{self.rule}] {self.message}"
         if self.reason:
             base += "\n    reason: " + " | ".join(self.reason)
+        if self.hops:
+            base += "\n    path: " + " -> ".join(self.hops)
         return base
 
     def __eq__(self, other) -> bool:
@@ -121,11 +133,12 @@ class FileContext:
 
     def report(self, rule: "Rule", line: int, message: str,
                symbol: Optional[str] = None,
-               reason: Tuple[str, ...] = ()) -> None:
+               reason: Tuple[str, ...] = (),
+               hops: Tuple[str, ...] = ()) -> None:
         self.findings.append(Finding(
             rule.name, self.relpath, line, message,
             symbol=symbol if symbol is not None else self.qualname(),
-            reason=reason))
+            reason=reason, hops=hops))
 
     def current_class(self) -> Optional[ast.ClassDef]:
         return self.class_stack[-1] if self.class_stack else None

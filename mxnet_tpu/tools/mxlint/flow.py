@@ -1,0 +1,699 @@
+"""mxlint flow-sensitive rules: resource-leak, thread-lifecycle,
+blocking-under-lock.
+
+Pass 2 rules that consume the :mod:`.cfg` layer.  Each subscribes to
+``FunctionDef`` and analyzes top-level functions/methods only (the
+walker fires *before* the function is pushed, so an empty
+``ctx.func_stack`` means "this def is the top-level one"); nested defs
+run on some other frame's path and are skipped, in mxlint's usual
+missed-finding-over-false-finding direction.
+
+All three share one CFG per function (cached on the ``FileContext``),
+and all three attach ``Finding.hops`` — the actual ``file:line``
+program-point path that exhibits the defect — because a flow-sensitive
+verdict the reader cannot replay is indistinguishable from a false
+positive.
+
+What makes the leak search precise enough to run over this repo clean
+(every suppression below earned by a real near-miss in serving/):
+
+- **Release beats raise**: a ``release()`` call closes the path before
+  its own exception edge is considered — cleanup that throws is the
+  cleanup's bug, not this acquire's.
+- **Transfer after raise**: a call that receives the resource closes
+  the path only if it *completes*; its exception edge is explored with
+  the obligation still open.  This is exactly the shape of the real
+  span leaks this PR fixes: ``submit(req)`` raising ``ServerOverloaded``
+  did not take ownership of ``req.trace``.
+- **None-guard correlation**: on the arm of ``if table is None:`` the
+  resource provably does not exist, so the path is pruned — the
+  ``reserve() -> if None -> break`` admission loop is clean, not a leak.
+- **Proxy bindings**: ``req.trace = tracer().begin(...)`` binds the
+  obligation to ``req`` (the local carrier), while ``self.x = acquire()``
+  transfers it to the instance at birth.
+"""
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from . import protocols as _p
+from .cfg import CFG, MAY_RAISE as _MAY_RAISE, build_cfg, leak_path
+from .core import FUNC_TYPES, FileContext, Finding, Rule, _lock_token
+
+__all__ = ["ResourceLeakRule", "ThreadLifecycleRule",
+           "BlockingUnderLockRule"]
+
+
+def _names(expr: Optional[ast.AST]) -> Set[str]:
+    if expr is None:
+        return set()
+    return {n.id for n in ast.walk(expr) if isinstance(n, ast.Name)}
+
+
+def _contains(root: ast.AST, target: ast.AST) -> bool:
+    return any(n is target for n in ast.walk(root))
+
+
+#: calls that cannot meaningfully raise — treating them as raise sites
+#: would make every path between an acquire and its release "leaky via
+#: len()", drowning the real exit-path findings
+_INFALLIBLE_NAMES = frozenset(("len", "type", "id", "isinstance",
+                               "sorted", "min", "max"))
+_INFALLIBLE_METHODS = frozenset(("monotonic", "perf_counter", "time",
+                                 "get_ident", "append", "items",
+                                 "values", "keys", "get"))
+
+#: transfer verbs that either succeed or the process is already lost —
+#: a container insert does not need its exception edge explored the way
+#: an admission ``submit()`` (which raises BY DESIGN) does
+_INFALLIBLE_TRANSFER = frozenset(("append", "appendleft", "add",
+                                  "insert", "register",
+                                  "_register_atexit"))
+
+
+def _infallible(call: ast.Call) -> bool:
+    recv, meth = _p.call_desc(call)
+    if not recv:
+        return meth in _INFALLIBLE_NAMES
+    return meth in _INFALLIBLE_METHODS
+
+
+class _Scan:
+    """One shared lexical pass per top-level function: which flow rules
+    have any business building a CFG here?  Most functions touch no
+    protocol resource, thread, or lock — they skip the whole tier."""
+
+    __slots__ = ("acquire", "thread", "locks", "withitems")
+
+    def __init__(self) -> None:
+        self.acquire = False
+        self.thread = False
+        self.locks = False
+        self.withitems: Dict[int, ast.withitem] = {}
+
+
+class _FlowRule(Rule):
+    """Shared plumbing: per-function dispatch + CFG cache + hop strings."""
+
+    interests = FUNC_TYPES
+
+    def visit(self, node: ast.AST, ctx: FileContext) -> None:
+        if ctx.func_stack:            # nested def: enclosing frame's path
+            return
+        self.check_func(node, ctx)
+
+    def check_func(self, func: ast.AST, ctx: FileContext) -> None:
+        raise NotImplementedError
+
+    @staticmethod
+    def _scan(func: ast.AST, ctx: FileContext) -> _Scan:
+        cache = getattr(ctx, "_flow_scan", None)
+        if cache is None:
+            cache = ctx._flow_scan = {}
+        sc = cache.get(id(func))
+        if sc is not None:
+            return sc
+        sc = cache[id(func)] = _Scan()
+        for n in ast.walk(func):
+            if isinstance(n, (ast.With, ast.AsyncWith)):
+                for item in n.items:
+                    if not sc.locks and \
+                            _lock_token(item.context_expr) is not None:
+                        sc.locks = True
+                    for c in ast.walk(item.context_expr):
+                        if isinstance(c, ast.Call):
+                            sc.withitems[id(c)] = item
+            elif isinstance(n, ast.Call):
+                if not sc.acquire and _p.match_acquire(n) is not None:
+                    sc.acquire = True
+                if not sc.thread and (_p.is_thread_ctor(n) or
+                                      _p.thread_start(n)):
+                    sc.thread = True
+                if not sc.locks and isinstance(n.func, ast.Attribute) \
+                        and n.func.attr == "acquire" \
+                        and _lock_token(n.func.value) is not None:
+                    sc.locks = True
+        return sc
+
+    @staticmethod
+    def _cfg(func: ast.AST, ctx: FileContext) -> CFG:
+        cache = getattr(ctx, "_cfg_cache", None)
+        if cache is None:
+            cache = ctx._cfg_cache = {}
+        g = cache.get(id(func))
+        if g is None:
+            g = cache[id(func)] = build_cfg(func)
+        return g
+
+    @staticmethod
+    def _symbol(func: ast.AST, ctx: FileContext) -> str:
+        if ctx.class_stack:
+            return f"{ctx.class_stack[-1].name}.{func.name}"
+        return func.name
+
+    @staticmethod
+    def _hops(cfg: CFG, path, relpath: str,
+              lead_line: int = 0) -> Tuple[str, ...]:
+        """``lead_line`` seeds the list with the acquire/start site —
+        the path itself begins just AFTER that event, and when it is the
+        last event of its block (acquire-then-fall-off-the-end) the walk
+        crosses no further events at all; every flow finding still owes
+        the reader at least the one line the obligation was born on."""
+        out: List[str] = []
+        last = None
+        if lead_line:
+            out.append(f"{relpath}:{lead_line}")
+            last = lead_line
+        for bid, idx in path:
+            blk = cfg.block(bid)
+            if idx < len(blk.events):
+                ln = blk.events[idx].line
+                if ln and ln != last:
+                    out.append(f"{relpath}:{ln}")
+                    last = ln
+        return tuple(out)
+
+
+def _guard_name(e: ast.expr) -> Optional[str]:
+    if isinstance(e, ast.Name):
+        return e.id
+    if isinstance(e, ast.Attribute):      # `req.trace is not None`
+        return _p._expr_text(e)
+    return None
+
+
+def _none_guard(test: ast.expr) -> Optional[Tuple[str, bool]]:
+    """(name, absent_arm_is_true) when ``test`` is a presence guard on
+    ``name``: ``x is None`` → (x, True); ``x is not None`` / ``x`` →
+    (x, False); ``not x`` → (x, True).  ``name`` may be a dotted
+    attribute path (``req.trace``) — pruning only ever applies when it
+    matches a bound/twin name, so arbitrary truthiness tests stay
+    inert."""
+    if isinstance(test, ast.Compare) and len(test.ops) == 1 and \
+            isinstance(test.comparators[0], ast.Constant) and \
+            test.comparators[0].value is None:
+        nm = _guard_name(test.left)
+        if nm is not None:
+            if isinstance(test.ops[0], ast.Is):
+                return nm, True
+            if isinstance(test.ops[0], ast.IsNot):
+                return nm, False
+    nm = _guard_name(test)
+    if nm is not None:
+        return nm, False
+    if isinstance(test, ast.UnaryOp) and isinstance(test.op, ast.Not):
+        nm = _guard_name(test.operand)
+        if nm is not None:
+            return nm, True
+    return None
+
+
+class ResourceLeakRule(_FlowRule):
+    name = "resource-leak"
+    description = ("a path from a protocol acquire (KV block, span, "
+                   "tmp file, ContextVar token, ...) to a function exit "
+                   "— exception edges included — crosses no release or "
+                   "ownership transfer")
+
+    def check_func(self, func: ast.AST, ctx: FileContext) -> None:
+        sc = self._scan(func, ctx)
+        if not sc.acquire:
+            return
+        cfg = self._cfg(func, ctx)
+        withitems = sc.withitems
+        acquires = []
+        all_bound: Set[str] = set()
+        for bid, idx, ev in cfg.events():
+            if ev.kind != "call":
+                continue
+            proto = _p.match_acquire(ev.node)
+            if proto is None:
+                continue
+            item = withitems.get(id(ev.node))
+            if item is not None and proto.ctx_managed:
+                continue              # `with tracer().begin(...):` — safe
+            binding = self._binding(cfg, bid, idx, ev.node, item, proto)
+            if binding is None:
+                continue              # owner holds it from birth
+            acquires.append((bid, idx, ev, proto, binding))
+            all_bound |= binding[0]
+        for bid, idx, ev, proto, (bound, twins) in acquires:
+            self._search(cfg, (bid, idx), ev, proto, bound, twins,
+                         all_bound, ctx, self._symbol(func, ctx))
+
+    @staticmethod
+    def _binding(cfg: CFG, bid: int, idx: int, call: ast.Call,
+                 item: Optional[ast.withitem], proto: _p.Protocol
+                 ) -> Optional[Tuple[Set[str], Dict[str, bool]]]:
+        """(names carrying the obligation, twin guards), with an empty
+        name set for an unbound acquire — or None when ownership
+        transfers at the binding site itself (``self.x = acquire()`` /
+        ``d[k] = acquire()``).
+
+        Twin guards handle conditional binders: for ``rb = None if sp
+        is None else begin(...)`` the resource provably exists exactly
+        when ``sp`` does, so a later ``if sp is None:`` prunes the
+        absent arm the same way a direct ``if rb is None:`` would.
+        Each entry maps a twin name to its polarity — True when the
+        resource is absent exactly when the twin is None/falsy."""
+        blk = cfg.block(bid)
+        for later in blk.events[idx + 1:]:
+            if later.kind != "assign":
+                continue
+            n = later.node
+            if not _contains(getattr(n, "value", n) or n, call):
+                continue
+            twins: Dict[str, bool] = {}
+            val = getattr(n, "value", None)
+            if isinstance(val, ast.IfExp):
+                g = _none_guard(val.test)
+                if g is not None:
+                    nm, absent_if_true = g
+                    acquire_on_true = _contains(val.body, call)
+                    # resource exists on the arm holding the acquire;
+                    # polarity True = absent tracks "nm is None/falsy"
+                    twins[nm] = absent_if_true == (not acquire_on_true)
+            tgts = n.targets if isinstance(n, ast.Assign) else [n.target]
+            for tgt in tgts:
+                if isinstance(tgt, ast.Name):
+                    return {tgt.id}, twins
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name):
+                    if tgt.value.id in ("self", "cls"):
+                        return None   # instance owns it from birth
+                    # req.trace = begin(): the proxy (req) carries the
+                    # obligation for transfer purposes; the dotted path
+                    # itself is what presence guards and method calls
+                    # name
+                    return {tgt.value.id,
+                            f"{tgt.value.id}.{tgt.attr}"}, twins
+                if isinstance(tgt, (ast.Subscript, ast.Tuple)):
+                    return None
+            return set(), twins
+        if item is not None and isinstance(item.optional_vars, ast.Name):
+            return {item.optional_vars.id}, {}
+        if proto.needs_binding:
+            return None               # fire-and-forget lookalike
+        return set(), {}
+
+    def _search(self, cfg: CFG, acq_pt, ev, proto: _p.Protocol,
+                bound: Set[str], twins: Dict[str, bool],
+                all_bound: Set[str], ctx: FileContext,
+                symbol: str) -> None:
+        transfers: List[ast.Call] = []
+
+        def on_event(e) -> Optional[str]:
+            n, k = e.node, e.kind
+            if k == "call":
+                if _p.match_release(n, proto):
+                    return "close"
+                recv, meth = _p.call_desc(n)
+                if bound:
+                    argv = list(n.args) + [kw.value for kw in n.keywords]
+                    if any(bound & _names(a) for a in argv):
+                        if meth in _INFALLIBLE_TRANSFER:
+                            return "close"
+                        transfers.append(n)
+                        return "transfer-after-raise"
+                if recv in all_bound:
+                    # a method of a managed resource (sp.annotate(...))
+                    # raising is that resource's bug, not this path's
+                    return "noraise"
+                if _infallible(n):
+                    return "noraise"
+            elif k == "assign" and bound:
+                if not (bound & _names(getattr(n, "value", None))):
+                    return None
+                tgts = n.targets if isinstance(n, ast.Assign) \
+                    else [n.target]
+                for tgt in tgts:
+                    if isinstance(tgt, ast.Subscript) or \
+                            (isinstance(tgt, ast.Attribute) and
+                             isinstance(tgt.value, ast.Name) and
+                             tgt.value.id in ("self", "cls")):
+                        return "close"
+            elif k in ("return", "yield") and bound:
+                if bound & _names(getattr(n, "value", None)):
+                    return "close"
+            return None
+
+        def branch_hint(test, is_true) -> Optional[str]:
+            g = _none_guard(test)
+            if g is None:
+                return None
+            nm, absent_if_true = g
+            if nm in bound and is_true == absent_if_true:
+                return "close"
+            if nm in twins:
+                absent_arm = absent_if_true if twins[nm] \
+                    else not absent_if_true
+                if is_true == absent_arm:
+                    return "close"
+            return None
+
+        path = leak_path(cfg, acq_pt, on_event,
+                         branch_hint if (bound or twins) else None)
+        if path is None:
+            return
+        exits_raising = path[-1][0] == cfg.raise_id
+        exit_kind = "an exception exit" if exits_raising else \
+            "a normal return"
+        verbs = "/".join(sorted(proto.release_methods))
+        reason = [f"{proto.name} acquire at {ctx.relpath}:{ev.line}"]
+        # was the last thing on the path a would-be transfer that raised?
+        if exits_raising and len(path) >= 2:
+            pb, pi = path[-2]
+            pblk = cfg.block(pb)
+            if pi < len(pblk.events) and \
+                    any(pblk.events[pi].node is t for t in transfers):
+                reason.append(
+                    f"callee at line {pblk.events[pi].line} raised "
+                    "before taking ownership")
+                evidence = self._transfer_evidence(
+                    ctx, symbol, pblk.events[pi].node, proto)
+                if evidence:
+                    reason.append(evidence)
+        reason.append(f"reaches {exit_kind} with no {verbs} and no "
+                      "ownership transfer")
+        reason.append(f"fix: {proto.hint}")
+        ctx.report(self, ev.line,
+                   f"{proto.resource} can leak: a path reaches "
+                   f"{exit_kind} without {verbs}",
+                   symbol=symbol, reason=tuple(reason),
+                   hops=self._hops(cfg, path, ctx.relpath,
+                                   lead_line=ev.line))
+
+    @staticmethod
+    def _transfer_evidence(ctx: FileContext, symbol: str,
+                           call: ast.Call,
+                           proto: _p.Protocol) -> Optional[str]:
+        """Interprocedural color for a transfer-that-raised: resolve the
+        callee through the PR-6 call graph and cite where its chain
+        performs (or provably does not perform) the protocol release."""
+        proj = ctx.project
+        if proj is None:
+            return None
+        ff = proj.functions.get(f"{ctx.relpath}::{symbol}")
+        if ff is None:
+            return None
+        fn = call.func
+        if isinstance(fn, ast.Name):
+            desc = ("name", fn.id)
+        elif isinstance(fn, ast.Attribute) and \
+                isinstance(fn.value, ast.Name):
+            desc = ("self", fn.attr) if fn.value.id in ("self", "cls") \
+                else ("attr", fn.value.id, fn.attr)
+        else:
+            return None
+        ck = proj.resolve(ff, desc)
+        if ck is None or ck not in proj.functions:
+            return None
+        rel = proj.find_release(ck, proto.name)
+        if rel is None:
+            return (f"callee {proj.pretty(ck)} performs no "
+                    f"{proto.name} release on any reachable chain")
+        chain, line = rel
+        tgt = proj.functions[chain[-1]]
+        return (f"on success ownership lands in "
+                f"{proj.chain_str(chain)} (releases at "
+                f"{tgt.relpath}:{line})")
+
+
+class ThreadLifecycleRule(_FlowRule):
+    name = "thread-lifecycle"
+    description = ("a started thread nobody ever joins, stops, or "
+                   "atexit-registers — the teardown-race class: it "
+                   "outlives its owner and races interpreter/jax "
+                   "client shutdown")
+
+    def check_func(self, func: ast.AST, ctx: FileContext) -> None:
+        if not self._scan(func, ctx).thread:
+            return
+        cfg = self._cfg(func, ctx)
+        symbol = self._symbol(func, ctx)
+        locals_bound: Dict[str, int] = {}
+        for bid, idx, ev in cfg.events():
+            if ev.kind == "assign" and isinstance(ev.node, ast.Assign) \
+                    and _p.is_thread_ctor(ev.node.value):
+                for tgt in ev.node.targets:
+                    if isinstance(tgt, ast.Name):
+                        locals_bound[tgt.id] = ev.line
+            if ev.kind != "call" or not _p.thread_start(ev.node):
+                continue
+            recv, _meth = _p.call_desc(ev.node)
+            if recv.endswith(("Thread()", "Worker()")):
+                # inline Thread(...).start(): unjoinable from birth
+                ctx.report(self, ev.line,
+                           "fire-and-forget thread: "
+                           f"{recv[:-2]}(...).start() can never be "
+                           "joined, stopped, or atexit-registered",
+                           symbol=symbol,
+                           reason=("bind the thread and register its "
+                                   "join, or hand it to an owner that "
+                                   "outlives it",),
+                           hops=(f"{ctx.relpath}:{ev.line}",))
+                continue
+            if recv not in locals_bound:
+                continue              # self._t.start(): class-level check
+            if self._owned_elsewhere(func, recv):
+                continue              # lexically retired or handed off
+            self._search_local(cfg, (bid, idx), ev, recv,
+                               locals_bound[recv], ctx, symbol)
+
+    @staticmethod
+    def _owned_elsewhere(func: ast.AST, name: str) -> bool:
+        """Lexical ownership scan: is ``name`` retired, stored onto an
+        owner, passed to a call, or returned ANYWHERE in the function?
+
+        Order-insensitive on purpose — ``self._t = t`` before
+        ``t.start()`` is just as much a hand-off as after it, and a
+        conditional ``if wait: t.join()`` is a deliberate policy, not
+        a leak.  The path search only runs for names with no lexical
+        out-edge at all, where a leak is unambiguous."""
+        for n in ast.walk(func):
+            if isinstance(n, ast.Call):
+                if _p.thread_retire(n) == name:
+                    return True
+                argv = list(n.args) + [kw.value for kw in n.keywords]
+                if any(name in _names(a) for a in argv):
+                    return True
+            elif isinstance(n, (ast.Assign, ast.AnnAssign, ast.AugAssign)):
+                if name in _names(getattr(n, "value", None)):
+                    tgts = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    if any(not isinstance(t, ast.Name) for t in tgts):
+                        return True
+            elif isinstance(n, (ast.Return, ast.Yield)):
+                if name in _names(getattr(n, "value", None)):
+                    return True
+        return False
+
+    def _search_local(self, cfg: CFG, start_pt, ev, name: str,
+                      ctor_line: int, ctx: FileContext,
+                      symbol: str) -> None:
+        def on_event(e) -> Optional[str]:
+            n, k = e.node, e.kind
+            if k == "call":
+                if _p.thread_retire(n) == name:
+                    return "close"
+                argv = list(n.args) + [kw.value for kw in n.keywords]
+                if any(name in _names(a) for a in argv):
+                    _recv, meth = _p.call_desc(n)
+                    if meth in _INFALLIBLE_TRANSFER:
+                        return "close"
+                    return "transfer-after-raise"
+            elif k == "assign":
+                if name in _names(getattr(n, "value", None)):
+                    tgts = n.targets if isinstance(n, ast.Assign) \
+                        else [n.target]
+                    if any(not isinstance(t, ast.Name) for t in tgts):
+                        return "close"     # stored onto an owner
+            elif k in ("return", "yield"):
+                if name in _names(getattr(n, "value", None)):
+                    return "close"
+            return None
+
+        path = leak_path(cfg, start_pt, on_event)
+        if path is None:
+            return
+        ctx.report(self, ev.line,
+                   f"thread '{name}' started here can leave the "
+                   "function un-joined, un-stopped, and not "
+                   "atexit-registered",
+                   symbol=symbol,
+                   reason=(f"constructed at {ctx.relpath}:{ctor_line}",
+                           "join it (daemon or not), stop() it, or "
+                           "register the join via atexit before losing "
+                           "the last reference"),
+                   hops=self._hops(cfg, path, ctx.relpath,
+                                   lead_line=ev.line))
+
+    def project_check(self, project) -> List:
+        """Class-level half: ``self._t = Thread(...)`` + ``self._t
+        .start()`` with no retire of ``_t`` anywhere in the module."""
+        out: List[Finding] = []
+        for rp, mod in sorted(project.modules.items()):
+            retired: Set[str] = set()
+            readers: Dict[str, Set[str]] = {}
+            for ff in project.functions.values():
+                if ff.relpath != rp:
+                    continue
+                for op, recv, _ln in ff.thread_ops:
+                    if op == "retire":
+                        retired.add(recv.rsplit(".", 1)[-1])
+                for attr in ff.self_reads:
+                    readers.setdefault(attr, set()).add(ff.qualname)
+            for cls in mod.classes.values():
+                ctors: Dict[str, int] = {}
+                starts: Dict[str, Tuple[str, int]] = {}
+                for meth_key in cls.methods.values():
+                    ff = project.functions.get(meth_key)
+                    if ff is None:
+                        continue
+                    for op, recv, ln in ff.thread_ops:
+                        if op == "ctor-self":
+                            ctors.setdefault(recv, ln)
+                        elif op == "start" and recv.startswith("self."):
+                            starts.setdefault(recv[5:],
+                                              (ff.qualname, ln))
+                for attr, (qual, line) in sorted(starts.items()):
+                    if attr not in ctors or attr in retired:
+                        continue
+                    # a join through a local alias (``t, self._t =
+                    # self._t, None; t.join()``) never produces a
+                    # "retire" verb on the attribute — but it DOES
+                    # read it.  Any reader other than the starter is
+                    # taken as evidence of managed teardown.
+                    if readers.get(attr, set()) - {qual}:
+                        continue
+                    out.append(Finding(
+                        self.name, rp, line,
+                        f"thread self.{attr} is started but never "
+                        "joined/stopped/atexit-registered anywhere in "
+                        "this module",
+                        symbol=qual,
+                        reason=(f"constructed at {rp}:{ctors[attr]}",
+                                "give the owner a stop()/close() that "
+                                "joins it, or register the join via "
+                                "atexit"),
+                        hops=(f"{rp}:{ctors[attr]}", f"{rp}:{line}")))
+        return out
+
+
+class BlockingUnderLockRule(_FlowRule):
+    name = "blocking-under-lock"
+    description = ("a call that can block indefinitely (queue get/put "
+                   "without timeout, Thread.join(), socket recv, bare "
+                   "wait()) is reachable while a lock is held — every "
+                   "other acquirer of that lock stalls behind it")
+
+    #: states explored per block before the dataflow gives up (a bound,
+    #: not a correctness knob: lock nesting in this repo is depth ≤ 2)
+    MAX_STATES = 8
+
+    def check_func(self, func: ast.AST, ctx: FileContext) -> None:
+        if not self._scan(func, ctx).locks:
+            return
+        cfg = self._cfg(func, ctx)
+        symbol = self._symbol(func, ctx)
+        reported: Set[int] = set()
+        # state: frozenset of (lock display name, acquire line)
+        seen: Dict[int, Set[frozenset]] = {}
+        work: List[Tuple[int, frozenset]] = [(cfg.entry, frozenset())]
+        while work:
+            bid, state = work.pop()
+            if state in seen.setdefault(bid, set()):
+                continue
+            if len(seen[bid]) >= self.MAX_STATES:
+                continue
+            seen[bid].add(state)
+            blk = cfg.block(bid)
+            for ev in blk.events:
+                if ev.kind in _MAY_RAISE and blk.exc is not None:
+                    # the handler sees exactly the locks held when the
+                    # event raised, not the block's entry or exit set
+                    work.append((blk.exc, state))
+                state = self._apply(ev, state, ctx, symbol, reported,
+                                    cfg, bid)
+            for succ in blk.succs:
+                work.append((succ, state))
+
+    def _apply(self, ev, state: frozenset, ctx, symbol,
+               reported: Set[int], cfg, bid: int) -> frozenset:
+        n, k = ev.node, ev.kind
+        if k == "with-enter":
+            tok = _lock_token(n.context_expr)
+            if tok is not None:
+                return state | {(self._disp(n.context_expr), ev.line)}
+        elif k == "with-exit":
+            tok = _lock_token(n.context_expr)
+            if tok is not None:
+                disp = self._disp(n.context_expr)
+                return frozenset(s for s in state if s[0] != disp)
+        elif k == "call":
+            recv, meth = _p.call_desc(n)
+            if meth == "acquire" and recv and \
+                    _lock_token(n.func.value) is not None:
+                return state | {(recv, ev.line)}
+            if meth == "release" and recv and \
+                    _lock_token(n.func.value) is not None:
+                return frozenset(s for s in state if s[0] != recv)
+            if state:
+                desc = _p.blocking_call(n)
+                if desc is not None and ev.line not in reported:
+                    reported.add(ev.line)
+                    locks = ", ".join(sorted(s[0] for s in state))
+                    acq = min(s[1] for s in state)
+                    ctx.report(
+                        self, ev.line,
+                        f"{desc} while holding {locks}: every other "
+                        "acquirer stalls until this unblocks",
+                        symbol=symbol,
+                        reason=(f"lock held since {ctx.relpath}:{acq}",
+                                "use a timeout/_nowait variant, or move "
+                                "the blocking call outside the held "
+                                "region"),
+                        hops=(f"{ctx.relpath}:{acq}",
+                              f"{ctx.relpath}:{ev.line}"))
+        return state
+
+    @staticmethod
+    def _disp(expr: ast.expr) -> str:
+        return _p._expr_text(expr) or "<lock>"
+
+    def project_check(self, project) -> List:
+        """Interprocedural half: a call made while a lock is held whose
+        callee (transitively) contains an indefinitely-blocking call."""
+        out: List[Finding] = []
+        for key in sorted(project.functions):
+            ff = project.functions[key]
+            seen_locks: Set[Tuple] = set()
+            for cs in ff.calls:
+                if not cs.held:
+                    continue
+                ck = project.resolve(ff, cs.desc)
+                if ck is None or ck not in project.functions:
+                    continue
+                hit = project.find_blocking(ck)
+                if hit is None:
+                    continue
+                dedup = (cs.held, hit[1])
+                if dedup in seen_locks:
+                    continue
+                seen_locks.add(dedup)
+                chain, (desc, bline) = hit
+                tgt = project.functions[chain[-1]]
+                locks = ", ".join(t[-1] for t in cs.held)
+                out.append(Finding(
+                    self.name, ff.relpath, cs.line,
+                    f"call under lock {locks} reaches {desc} in "
+                    f"{project.pretty(chain[-1])}",
+                    symbol=ff.qualname,
+                    reason=(f"held at the call site: {locks}",
+                            "call chain: " + project.chain_str(
+                                (key,) + chain),
+                            f"blocks at {tgt.relpath}:{bline}"),
+                    hops=(f"{ff.relpath}:{cs.line}",
+                          f"{tgt.relpath}:{bline}")))
+        return out

@@ -33,6 +33,7 @@ import ast
 from collections import deque
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
+from . import protocols as _proto
 from .core import FUNC_TYPES, _lock_token
 
 __all__ = ["Project", "FuncFacts", "ModuleFacts", "build_project",
@@ -142,7 +143,8 @@ class FuncFacts:
 
     __slots__ = ("key", "relpath", "qualname", "class_name", "line",
                  "hot_kind", "calls", "collectives", "syncs", "impure",
-                 "acquires")
+                 "acquires", "proto_releases", "blocking", "thread_ops",
+                 "self_reads")
 
     def __init__(self, key: str, relpath: str, qualname: str,
                  class_name: Optional[str], line: int):
@@ -157,6 +159,18 @@ class FuncFacts:
         self.syncs: List[Tuple[str, int, str]] = []    # (kind, line, what)
         self.impure: List[Tuple[str, int, str]] = []   # (kind, line, what)
         self.acquires: List[Tuple[Tuple, int, Tuple]] = []  # (tok, ln, held)
+        # flow-tier facts (PR 20): protocol releases performed anywhere
+        # in this function (protocol name -> first line), indefinitely-
+        # blocking calls, and thread lifecycle ops (op, receiver, line)
+        # with op in {"ctor-local", "ctor-self", "start", "retire"}
+        self.proto_releases: Dict[str, int] = {}
+        self.blocking: List[Tuple[str, int]] = []
+        self.thread_ops: List[Tuple[str, str, int]] = []
+        # every self/cls attribute READ in this function — the thread
+        # rule's "does anyone else even look at this thread?" evidence
+        # (joins through local aliases are invisible to verb matching:
+        # `t, self._t = self._t, None; t.join()`)
+        self.self_reads: Set[str] = set()
 
     def __repr__(self) -> str:
         return f"<FuncFacts {self.key}>"
@@ -274,6 +288,12 @@ class _FactWalker:
 
     def _go(self, node: ast.AST) -> None:  # noqa: C901 — one dispatch hub
         t = type(node)
+        if t is ast.Attribute:
+            if isinstance(node.value, ast.Name) and \
+                    node.value.id in ("self", "cls") and \
+                    isinstance(node.ctx, ast.Load):
+                ff = self.cur_func if self.cur_func is not None else self.mod_func
+                ff.self_reads.add(node.attr)
         if t in FUNC_TYPES:
             self._enter_func(node)
             return
@@ -456,8 +476,20 @@ class _FactWalker:
             else:
                 self.mf.import_syms[local] = (base_rp, alias.name)
 
-    # -- assignments (lock kinds) -------------------------------------------
+    # -- assignments (lock kinds, thread ctors) ------------------------------
     def _do_assign(self, node: ast.Assign) -> None:
+        if _proto.is_thread_ctor(node.value):
+            ff = self.cur_func if self.cur_func is not None else \
+                self.mod_func
+            for tgt in node.targets:
+                if isinstance(tgt, ast.Attribute) and \
+                        isinstance(tgt.value, ast.Name) and \
+                        tgt.value.id in ("self", "cls"):
+                    ff.thread_ops.append(("ctor-self", tgt.attr,
+                                          node.lineno))
+                elif isinstance(tgt, ast.Name):
+                    ff.thread_ops.append(("ctor-local", tgt.id,
+                                          node.lineno))
         if _is_lock_factory(node.value):
             for tgt in node.targets:
                 if isinstance(tgt, ast.Attribute) and \
@@ -538,6 +570,21 @@ class _FactWalker:
                 fn.value.id in _NP_ALIASES:
             ff.impure.append(("allocation", node.lineno,
                               f"{fn.value.id}.{fn.attr}(...)"))
+        # flow-tier facts: protocol releases (interprocedural "the
+        # callee retired it" evidence), indefinitely-blocking calls
+        # (reachable-under-lock search), thread lifecycle ops
+        for proto_name in _proto.release_verbs(node):
+            ff.proto_releases.setdefault(proto_name, node.lineno)
+        blk = _proto.blocking_call(node)
+        if blk is not None:
+            ff.blocking.append((blk, node.lineno))
+        if _proto.thread_start(node) and isinstance(fn, ast.Attribute):
+            ff.thread_ops.append(
+                ("start", _proto.call_desc(node)[0], node.lineno))
+        else:
+            retired = _proto.thread_retire(node)
+            if retired is not None:
+                ff.thread_ops.append(("retire", retired, node.lineno))
         # call edge
         desc = None
         if isinstance(fn, ast.Name):
@@ -691,6 +738,51 @@ class Project:
                     seen.add(ck)
                     q.append((ck, chain + (ck,)))
         return out
+
+    def find_blocking(self, start: str, max_depth: int = MAX_CALL_DEPTH
+                      ) -> Optional[Tuple[Tuple[str, ...],
+                                          Tuple[str, int]]]:
+        """Shortest call chain from ``start`` (inclusive) to a function
+        containing an indefinitely-blocking call → (chain, (desc, line)),
+        or None.  The blocking-under-lock rule walks this from every
+        call site made while a lock is held."""
+        q = deque([(start, (start,))])
+        seen = {start}
+        while q:
+            key, chain = q.popleft()
+            ff = self.functions.get(key)
+            if ff is not None and ff.blocking:
+                return chain, ff.blocking[0]
+            if len(chain) > max_depth:
+                continue
+            for ck, _cs in self.callees(key):
+                if ck not in seen:
+                    seen.add(ck)
+                    q.append((ck, chain + (ck,)))
+        return None
+
+    def find_release(self, start: str, proto_name: str,
+                     max_depth: int = MAX_CALL_DEPTH
+                     ) -> Optional[Tuple[Tuple[str, ...], int]]:
+        """Shortest call chain from ``start`` (inclusive) to a function
+        that performs a ``proto_name`` release → (chain, line), or None.
+        Evidence-enrichment for ownership transfers: when a resource is
+        handed to a resolvable callee, the leak rule cites the release
+        the callee (transitively) performs."""
+        q = deque([(start, (start,))])
+        seen = {start}
+        while q:
+            key, chain = q.popleft()
+            ff = self.functions.get(key)
+            if ff is not None and proto_name in ff.proto_releases:
+                return chain, ff.proto_releases[proto_name]
+            if len(chain) > max_depth:
+                continue
+            for ck, _cs in self.callees(key):
+                if ck not in seen:
+                    seen.add(ck)
+                    q.append((ck, chain + (ck,)))
+        return None
 
     def reachable(self, roots: Iterable[str],
                   max_depth: int = MAX_CALL_DEPTH + 2

@@ -1,0 +1,294 @@
+"""Declarative acquire→release protocol specs for the flow rules.
+
+A :class:`Protocol` names one resource discipline this repo actually
+uses and teaches the CFG layer to recognize its three verbs purely
+syntactically (no imports resolved, same bar as the rest of mxlint):
+
+- **acquire**: a call that mints the resource (``kv.reserve(...)``,
+  ``tracer().begin(...)``, ``open(tmp_path, "w")``, ``var.set(...)``).
+- **release**: a call that retires it (``kv.release(rid)``,
+  ``span.finish()``, ``os.replace(tmp, final)``, ``var.reset(tok)``).
+- **transfer**: structural, shared by all protocols — storing the bound
+  name into ``self``/a subscript, returning/yielding it, or passing it
+  to another call moves ownership out of the function, and the local
+  path obligation ends (the interprocedural layer picks it up).
+
+Matchers are receiver-hint based: ``reserve`` only counts on a receiver
+whose name smells like a cache/pool (``kv``, ``_cache``, ``pool``…),
+``begin`` only on a tracer, so a domain-specific verb on an unrelated
+object stays silent.  Conservative in mxlint's usual direction — a
+missed acquire is a missed finding, never a false one.
+
+``ctx_managed=True`` marks protocols whose resource is its own context
+manager (spans): an acquire used directly as a ``with`` item is safe by
+construction and skipped.  The atomic-write protocol is deliberately
+NOT ctx_managed — ``with open(tmp) as f`` closes the *handle*, but the
+obligation is the rename/unlink of the *tmp file*, which outlives it.
+"""
+from __future__ import annotations
+
+import ast
+import re
+from typing import List, Optional, Tuple
+
+__all__ = ["Protocol", "PROTOCOLS", "match_acquire", "match_release",
+           "release_verbs", "blocking_call", "thread_start",
+           "thread_retire", "is_thread_ctor", "daemon_kwarg",
+           "call_desc"]
+
+
+def _rx(pat: str):
+    return re.compile(pat, re.IGNORECASE)
+
+
+class Protocol:
+    __slots__ = ("name", "resource", "acquire_methods", "acquire_recv",
+                 "release_methods", "release_recv", "ctx_managed",
+                 "needs_binding", "hint")
+
+    def __init__(self, name: str, resource: str, *,
+                 acquire_methods: Tuple[str, ...],
+                 acquire_recv: str,
+                 release_methods: Tuple[str, ...],
+                 release_recv: str = ".*",
+                 ctx_managed: bool = False,
+                 needs_binding: bool = False,
+                 hint: str = ""):
+        self.name = name
+        self.resource = resource
+        self.acquire_methods = acquire_methods
+        self.acquire_recv = _rx(acquire_recv)
+        self.release_methods = frozenset(release_methods)
+        self.release_recv = _rx(release_recv)
+        self.ctx_managed = ctx_managed
+        # acquire only counts when its result is bound to a name —
+        # kills ``gauge.set(v)`` / fire-and-forget lookalikes
+        self.needs_binding = needs_binding
+        self.hint = hint
+
+
+#: receivers that look like locks — ``lock.release()`` belongs to
+#: lock-discipline (PR 5), never to a resource protocol
+_LOCKISH = _rx(r"lock|mutex|sem|cond|rlock")
+
+PROTOCOLS: Tuple[Protocol, ...] = (
+    Protocol(
+        "kv-block", "KV-cache block table",
+        acquire_methods=("reserve",),
+        acquire_recv=r"kv|cache|pool|block|_bkc",
+        release_methods=("release", "free", "release_all"),
+        hint="pair BlockKVCache.reserve() with release(req_id) on "
+             "every exit, or hand the table to an owner",
+    ),
+    Protocol(
+        "span", "tracing span",
+        acquire_methods=("begin",),
+        acquire_recv=r"tracer|tracing|trace",
+        release_methods=("finish", "abandon"),
+        ctx_managed=True,
+        hint="finish() the span on every path (error paths included) "
+             "or use it as a context manager",
+    ),
+    Protocol(
+        "admission-slot", "admission-queue slot",
+        acquire_methods=("take_slot", "acquire_slot"),
+        acquire_recv=r"admission|_adm|queue|slots",
+        release_methods=("settle", "release_slot", "settle_slot"),
+        hint="settle the admission slot on every exit so shed "
+             "accounting stays exact",
+    ),
+    Protocol(
+        "atomic-write", "tmp file awaiting rename",
+        acquire_methods=("open",),
+        acquire_recv=r"^$",          # bare builtin open() only
+        release_methods=("replace", "rename", "unlink", "remove"),
+        release_recv=r"^os$|path",
+        hint="a '.tmp' open() must reach os.replace()/unlink() on "
+             "every path or a partial file survives the crash window",
+    ),
+    Protocol(
+        "ctxvar-token", "contextvars reset token",
+        acquire_methods=("set",),
+        acquire_recv=r"var$|_active|ctx|current",
+        release_methods=("reset",),
+        needs_binding=True,
+        hint="a ContextVar.set() token must reach .reset(token) or the "
+             "stale value bleeds into the next task on this thread",
+    ),
+)
+
+_TMPISH = _rx(r"\.tmp|\.part|tmp_|_tmp|temp")
+
+
+def call_desc(call: ast.Call) -> Tuple[str, str]:
+    """(receiver_text, method_name) for a call, '' when unnamed.
+    ``a.b.c(x)`` -> ("a.b", "c"); ``f(x)`` -> ("", "f");
+    ``tracer().begin(x)`` -> ("tracer()", "begin")."""
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return _expr_text(f.value), f.attr
+    if isinstance(f, ast.Name):
+        return "", f.id
+    return "", ""
+
+
+def _expr_text(e: ast.expr) -> str:
+    if isinstance(e, ast.Name):
+        return e.id
+    if isinstance(e, ast.Attribute):
+        base = _expr_text(e.value)
+        return f"{base}.{e.attr}" if base else e.attr
+    if isinstance(e, ast.Call):
+        return _expr_text(e.func) + "()"
+    return ""
+
+
+def match_acquire(call: ast.Call) -> Optional[Protocol]:
+    """The protocol this call acquires under, if any."""
+    recv, meth = call_desc(call)
+    for proto in PROTOCOLS:
+        if meth not in proto.acquire_methods:
+            continue
+        if proto.name == "atomic-write":
+            if recv:                         # only the builtin open()
+                continue
+            if not call.args or not _literalish_tmp(call.args[0]):
+                continue
+            return proto
+        if recv and _LOCKISH.search(recv):
+            continue
+        if proto.acquire_recv.pattern == r"^$":
+            if recv:
+                continue
+        elif not (recv and proto.acquire_recv.search(recv)):
+            continue
+        return proto
+    return None
+
+
+def _literalish_tmp(arg: ast.expr) -> bool:
+    """Does the first open() argument look like a tmp path?  Matches
+    string literals, f-strings, and names/attrs containing 'tmp'."""
+    for node in ast.walk(arg):
+        if isinstance(node, ast.Constant) and isinstance(node.value, str):
+            if _TMPISH.search(node.value):
+                return True
+        elif isinstance(node, (ast.Name, ast.Attribute)):
+            txt = _expr_text(node)
+            if txt and _TMPISH.search(txt):
+                return True
+    return False
+
+
+def match_release(call: ast.Call, proto: Protocol) -> bool:
+    """Is this call a release under ``proto``?  Receiver identity is
+    NOT checked against the acquire receiver — mxlint tracks at most a
+    couple of live resources per function, and a same-protocol release
+    on any plausible receiver is accepted (missed-leak over false-leak)."""
+    recv, meth = call_desc(call)
+    if meth not in proto.release_methods:
+        return False
+    if recv and _LOCKISH.search(recv):
+        return False
+    if proto.release_recv.pattern != ".*":
+        return bool(recv and proto.release_recv.search(recv))
+    return True
+
+
+def release_verbs(call: ast.Call) -> List[str]:
+    """Protocol names this call releases under — pass-1 fact for the
+    interprocedural transfer check ("the callee released it")."""
+    out = []
+    for proto in PROTOCOLS:
+        if match_release(call, proto):
+            out.append(proto.name)
+    return out
+
+
+# -- blocking-call matchers --------------------------------------------------
+
+_QUEUEISH = _rx(r"queue|_q$|^q$|inbox|outbox|mailbox")
+_SOCKISH = _rx(r"sock|conn|client|channel")
+
+
+def _kw(call: ast.Call, name: str) -> bool:
+    return any(k.arg == name for k in call.keywords)
+
+
+def blocking_call(call: ast.Call) -> Optional[str]:
+    """A human-readable description if this call can block indefinitely
+    (the under-a-lock hazard set), else None.  Timeouts exonerate:
+    ``q.get(timeout=...)``, ``t.join(0.5)``, ``cond.wait(0.1)`` pass."""
+    recv, meth = call_desc(call)
+    has_timeout = bool(call.args) or _kw(call, "timeout")
+    if meth == "join" and not has_timeout:
+        return "Thread.join() with no timeout"
+    if meth in ("get", "put") and recv and _QUEUEISH.search(recv):
+        if meth == "put" and (len(call.args) > 1 or _kw(call, "timeout")
+                              or _kw(call, "block")):
+            return None
+        if meth == "get" and (call.args or _kw(call, "timeout")
+                              or _kw(call, "block")):
+            return None
+        return f"queue.{meth}() with no timeout"
+    if meth in ("recv", "recvfrom", "accept") and recv and \
+            _SOCKISH.search(recv):
+        return f"socket.{meth}()"
+    if meth == "wait" and not has_timeout and recv and \
+            not _LOCKISH.search(recv):
+        # Event/Future-style wait; Condition.wait inside its own lock
+        # is the *point* of a condition variable, so lockish is exempt
+        return "wait() with no timeout"
+    if meth == "result" and not has_timeout and recv:
+        return "Future.result() with no timeout"
+    return None
+
+
+# -- thread lifecycle matchers -----------------------------------------------
+
+def thread_start(call: ast.Call) -> bool:
+    """``<x>.start()`` — the rule layer decides whether <x> is a
+    Thread from the binding site."""
+    _recv, meth = call_desc(call)
+    return meth == "start" and not call.args and not call.keywords
+
+
+_RETIRE_METHODS = frozenset(("join", "stop", "shutdown", "close",
+                             "cancel", "terminate"))
+
+
+def thread_retire(call: ast.Call) -> Optional[str]:
+    """(receiver_text) when this call retires a thread-like object:
+    ``t.join(...)``, ``t.stop()``, or an atexit registration mentioning
+    it (``atexit.register(t.join)`` / ``threading._register_atexit``)."""
+    recv, meth = call_desc(call)
+    if meth in _RETIRE_METHODS and recv:
+        return recv
+    if meth in ("register", "_register_atexit") and call.args:
+        a0 = call.args[0]
+        if isinstance(a0, ast.Attribute):
+            return _expr_text(a0.value)
+        if isinstance(a0, ast.Name):
+            return a0.id
+    return None
+
+
+def is_thread_ctor(value: ast.expr) -> bool:
+    """Does this expression construct a thread?  ``Thread(...)``,
+    ``threading.Thread(...)``, and repo wrappers whose class name ends
+    in Thread/Worker (``_CommitThread(...)``)."""
+    if not isinstance(value, ast.Call):
+        return False
+    _recv, name = call_desc(value)
+    if not name:
+        f = value.func
+        name = f.attr if isinstance(f, ast.Attribute) else ""
+    return name == "Thread" or name.endswith(("Thread", "Worker"))
+
+
+def daemon_kwarg(value: ast.Call) -> Optional[bool]:
+    """The ``daemon=`` literal on a thread ctor, if present."""
+    for k in value.keywords:
+        if k.arg == "daemon" and isinstance(k.value, ast.Constant):
+            return bool(k.value.value)
+    return None

@@ -1,5 +1,5 @@
-"""The repo's lint rules: four ported gates + three concurrency/config
-contracts.
+"""The repo's lint rules: four ported gates, three concurrency/config
+contracts, and the three flow-sensitive rules from :mod:`.flow`.
 
 Every rule encodes an invariant this codebase actually relies on — see
 each rule's docstring for the failure mode it prevents.  All rules run
@@ -13,6 +13,8 @@ import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from .core import FUNC_TYPES, FileContext, Rule
+from .flow import (BlockingUnderLockRule, ResourceLeakRule,
+                   ThreadLifecycleRule)
 
 __all__ = ["ALL_RULES", "make_rules", "declared_knobs", "BASE_RELPATH"]
 
@@ -896,6 +898,10 @@ def make_rules(repo_root: str) -> List[Rule]:
         HotPathPurityRule(),
         HiddenHostSyncRule(),
         EnvKnobRule(repo_root),
+        # the flow-sensitive tier (PR 20): CFG-based exit-path analyses
+        ResourceLeakRule(),
+        ThreadLifecycleRule(),
+        BlockingUnderLockRule(),
     ]
 
 

@@ -1,0 +1,17 @@
+"""mxlint fixture: must trip blocking-under-lock (and nothing else).
+
+A queue ``get()`` with no timeout while ``self._lock`` is held: every
+other acquirer of the lock stalls behind a consumer that may never
+arrive.
+"""
+import threading
+
+
+class Mailbox:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._q = q
+
+    def drain_one(self):
+        with self._lock:
+            return self._q.get()      # indefinite block, lock held

@@ -1,0 +1,30 @@
+"""mxlint fixture: blocking-under-lock must stay silent.
+
+The sanctioned shapes: nonblocking/timeout queue variants inside the
+lock, and path-awareness — the same indefinite ``put`` is fine once the
+explicit acquire/release pair has ended the held region.
+"""
+import threading
+
+
+class Mailbox:
+    def __init__(self, q):
+        self._lock = threading.Lock()
+        self._q = q
+
+    def drain_one(self):
+        with self._lock:
+            return self._q.get_nowait()
+
+    def offer(self, item):
+        with self._lock:
+            depth = self._q.qsize()
+        self._q.put(item, timeout=1.0)
+        return depth
+
+    def handoff(self, item):
+        self._lock.acquire()
+        depth = self._q.qsize()
+        self._lock.release()
+        self._q.put(item)             # blocking, but the lock is gone
+        return depth

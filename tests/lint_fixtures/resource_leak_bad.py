@@ -1,0 +1,14 @@
+"""mxlint fixture: must trip resource-leak (and nothing else).
+
+The serving-admission shape: a tracing span is begun, then a fallible
+hand-off — when ``admission.submit`` raises (it rejects BY DESIGN when
+the queue is full), the span is still open and nobody downstream will
+ever finish it.
+"""
+
+
+def submit(tracer, admission, req):
+    sp = tracer.begin("request", activate=False)
+    admission.enqueue(req)        # raises when full: sp leaks open
+    sp.finish()
+    return req

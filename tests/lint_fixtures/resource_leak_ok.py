@@ -1,0 +1,42 @@
+"""mxlint fixture: resource-leak must stay silent.
+
+Every shape the rule must prove clean: close-on-every-path via a
+catch-all handler, context-managed spans, try/finally, conditional
+binders with correlated presence guards, and ownership transfer by
+return.
+"""
+
+
+def submit(tracer, admission, req):
+    sp = tracer.begin("request", activate=False)
+    try:
+        admission.enqueue(req)
+    except Exception:
+        sp.finish()
+        raise
+    sp.finish()
+    return req
+
+
+def assemble(tracer, batch):
+    with tracer.begin("assemble"):
+        return list(batch)
+
+
+def cleanup_in_finally(tracer, work):
+    sp = tracer.begin("op", activate=False)
+    try:
+        work()
+    finally:
+        sp.finish()
+
+
+def maybe_trace(tracer, enabled):
+    sp = tracer.begin("step") if enabled else None
+    if sp is not None:
+        sp.finish()
+
+
+def handoff(tracer):
+    sp = tracer.begin("pipeline", activate=False)
+    return sp                     # caller owns the obligation now

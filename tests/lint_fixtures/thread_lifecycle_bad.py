@@ -1,0 +1,22 @@
+"""mxlint fixture: must trip thread-lifecycle (and nothing else).
+
+Both halves of the rule: a local thread started and dropped on the
+floor (no join/stop/atexit, no ownership hand-off anywhere in the
+function), and a class that starts ``self._thread`` which no method in
+the module ever joins, stops, or even reads again.
+"""
+import threading
+
+
+def poll_forever(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+
+
+class Poller:
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass

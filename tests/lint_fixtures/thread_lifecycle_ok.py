@@ -1,0 +1,44 @@
+"""mxlint fixture: thread-lifecycle must stay silent.
+
+Managed teardown in every idiom the repo uses: a direct join, an
+atexit-registered join, a hand-off to an owning container, and the
+local-alias join (``t, self._t = self._t, None``) that never names the
+attribute in a retire verb — the rule must take the read as evidence.
+"""
+import atexit
+import threading
+
+
+def run_owned(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    t.join(timeout=1.0)
+
+
+def run_registered(fn):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    atexit.register(t.join)
+
+
+def run_pooled(fn, pool):
+    t = threading.Thread(target=fn, daemon=True)
+    t.start()
+    pool.append(t)                # the pool's owner joins at shutdown
+
+
+class Worker:
+    def __init__(self):
+        self._thread = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self._run, daemon=True)
+        self._thread.start()
+
+    def _run(self):
+        pass
+
+    def stop(self):
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(timeout=1.0)

@@ -1,10 +1,22 @@
 #!/usr/bin/env python3
-"""Repo precommit gate: mxlint over the files this commit touches.
+"""Repo precommit gate: mxlint over this commit, in two stages.
 
-Runs ``mxlint --changed --fix --dry-run`` — lints only git-touched
-``.py`` files against the frozen baseline, and shows (without applying)
-any pending mechanical fixes.  Exit nonzero blocks the commit when
-there are NEW findings or pending fixes; run
+Stage 1 — ``mxlint --changed --fix --dry-run``: lints only git-touched
+``.py`` files against the frozen baseline and shows (without applying)
+any pending mechanical fixes.  Fast, file-local, catches the lexical
+rules.
+
+Stage 2 — a full repo run.  The flow-sensitive tier's interprocedural
+halves (a blocking call reached two files down while a lock is held, a
+callee that never releases a span handed to it, a class thread whose
+only reader lives in another method) build their call graph from the
+WHOLE project — ``--changed`` alone would judge the touched files
+against a truncated graph and miss exactly the cross-file findings the
+CFG tier exists for.  The full two-pass+CFG run is budgeted under 5s
+(test-enforced), cheap enough for a hook.
+
+Exit nonzero blocks the commit when either stage finds NEW findings or
+pending fixes; run
 
     python -m mxnet_tpu.tools.mxlint --changed --fix
 
@@ -31,6 +43,14 @@ def main() -> int:
               "(or apply pending rewrites with "
               "`python -m mxnet_tpu.tools.mxlint --changed --fix`)",
               file=sys.stderr)
+        return rc
+    rc = mxlint.main([])
+    if rc != 0:
+        print("precommit: repo-wide mxlint gate failed — the touched "
+              "files changed an interprocedural fact (call chain, "
+              "held-lock set, ownership transfer) that surfaces a "
+              "finding elsewhere; the hops/reason chains above point "
+              "at the path", file=sys.stderr)
     return rc
 
 
